@@ -1,0 +1,101 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas/pjit.
+
+Top-level namespace mirrors ``paddle.*`` (reference: python/paddle/__init__.py)
+so reference users find the same API shape; the execution model underneath is
+traced XLA programs, not per-op kernel dispatch.
+"""
+from __future__ import annotations
+
+import importlib
+
+# dtypes
+from .core.dtype import (
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.place import (
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .core.random import get_rng_state, seed, set_rng_state
+from .core.tensor import Tensor, is_tensor, to_tensor
+from .core.autograd import enable_grad, no_grad, set_grad_enabled, is_grad_enabled
+
+# functional op surface
+from .ops import *  # noqa: F401,F403
+
+__version__ = "0.1.0"
+
+# Subpackages load lazily (PEP 562): paddle_tpu.nn, .optimizer, .distributed...
+_LAZY_SUBMODULES = {
+    "amp",
+    "autograd",
+    "distributed",
+    "distribution",
+    "framework",
+    "hapi",
+    "incubate",
+    "io",
+    "jit",
+    "metric",
+    "nn",
+    "optimizer",
+    "profiler",
+    "sparse",
+    "static",
+    "vision",
+}
+
+_LAZY_ATTRS = {
+    "grad": ("paddle_tpu.autograd", "grad"),
+    "save": ("paddle_tpu.framework.io", "save"),
+    "load": ("paddle_tpu.framework.io", "load"),
+    "to_static": ("paddle_tpu.jit", "to_static"),
+    "DataParallel": ("paddle_tpu.distributed.parallel", "DataParallel"),
+    "Model": ("paddle_tpu.hapi.model", "Model"),
+    "summary": ("paddle_tpu.hapi.model_summary", "summary"),
+    "flops": ("paddle_tpu.hapi.dynamic_flops", "flops"),
+    "ParamAttr": ("paddle_tpu.nn.param_attr", "ParamAttr"),
+    "get_flags": ("paddle_tpu.framework.flags", "get_flags"),
+    "set_flags": ("paddle_tpu.framework.flags", "set_flags"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name in _LAZY_ATTRS:
+        mod_name, attr = _LAZY_ATTRS[name]
+        obj = getattr(importlib.import_module(mod_name), attr)
+        globals()[name] = obj
+        return obj
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def disable_static(place=None):  # dygraph is the only mode; API-parity no-op
+    return None
+
+
+def in_dynamic_mode() -> bool:
+    return True

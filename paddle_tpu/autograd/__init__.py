@@ -1,0 +1,15 @@
+"""User-facing autograd API (python/paddle/autograd/ parity)."""
+from .functional import backward, grad
+from .py_layer import PyLayer, PyLayerContext
+from ..core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+
+__all__ = [
+    "backward",
+    "grad",
+    "PyLayer",
+    "PyLayerContext",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+]

@@ -1,0 +1,56 @@
+"""paddle.grad / paddle.autograd.backward parity
+(reference: eager/backward.cc Backward + GeneralGrad at backward.cc:102)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from ..core.autograd import run_backward
+from ..core.tensor import Tensor
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = _as_list(tensors)
+    grad_tensors = _as_list(grad_tensors) if grad_tensors is not None else None
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad parity. create_graph (higher-order through the eager tape)
+    is not supported — use paddle_tpu.incubate.autograd functional transforms
+    (jax.grad composition) for higher-order derivatives."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: compose jax-level transforms via "
+            "paddle_tpu.incubate.autograd instead"
+        )
+    outputs = _as_list(outputs)
+    inputs = _as_list(inputs)
+    grad_outputs = _as_list(grad_outputs) if grad_outputs is not None else None
+    if retain_graph is None:
+        retain_graph = False
+    res = run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain_graph,
+        capture=inputs,
+        accumulate_leaf_grads=False,
+        allow_unused=allow_unused,
+    )
+    return res

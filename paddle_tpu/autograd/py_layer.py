@@ -1,0 +1,118 @@
+"""PyLayer: user-defined forward/backward pairs.
+
+Reference: python/paddle/autograd/py_layer.py:29,255 + C++ side
+fluid/eager/pylayer/. Here the custom backward plugs into the eager tape as a
+GradNode whose pullback calls the user's ``backward`` staticmethod — the same
+shape as ``jax.custom_vjp`` which we also expose for jitted paths.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..core.autograd import GradNode, is_grad_enabled
+from ..core.tensor import Tensor
+from jax.tree_util import tree_flatten, tree_unflatten
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self._non_diff = set()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        """Returns the saved tuple — METHOD, matching paddle's documented
+        ``ctx.saved_tensor()`` (python/paddle/autograd/py_layer.py)."""
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_diff.update(id(t) for t in tensors)
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+
+        tensor_inputs = [
+            a for a in tree_flatten((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))[0]
+            if isinstance(a, Tensor)
+        ]
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        if not needs_grad:
+            return outputs
+
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+        out_avals = [(tuple(o.shape), o.dtype) for o in out_tensors]
+
+        in_avals = [(tuple(t.shape), t.dtype) for t in tensor_inputs]
+
+        def vjp_fn(cotangents):
+            cots = list(cotangents) if isinstance(cotangents, (list, tuple)) else [cotangents]
+            grad_in = [Tensor(c, stop_gradient=True) for c in cots]
+            res = cls.backward(ctx, *grad_in)
+            if not isinstance(res, (list, tuple)):
+                res = (res,)
+            out = []
+            for i, r in enumerate(res):
+                if r is None:
+                    shape, dt = in_avals[i] if i < len(in_avals) else ((), jnp.float32)
+                    out.append(jnp.zeros(shape, dt))
+                elif isinstance(r, Tensor):
+                    out.append(r._value)
+                else:
+                    out.append(jnp.asarray(r))
+            # pad missing slots with zeros for remaining inputs
+            for i in range(len(out), len(in_avals)):
+                shape, dt = in_avals[i]
+                out.append(jnp.zeros(shape, dt))
+            return tuple(out)
+
+        import jax
+
+        node = GradNode(
+            vjp_fn,
+            tensor_inputs,
+            jax.tree_util.tree_structure(tuple(range(len(out_tensors)))),
+            out_avals,
+            name=cls.__name__,
+        )
+        for i, o in enumerate(out_tensors):
+            o._node = node
+            o._out_idx = i
+            o.stop_gradient = False
+        return outputs
+
+
+# Alias matching paddle.autograd.PyLayerContext import path
+LegacyPyLayer = PyLayer

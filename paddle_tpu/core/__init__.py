@@ -1,0 +1,23 @@
+from . import dtype, place, random
+from .autograd import (
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+from .tensor import Tensor, is_tensor, to_tensor
+
+__all__ = [
+    "Tensor",
+    "to_tensor",
+    "is_tensor",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "run_backward",
+    "dtype",
+    "place",
+    "random",
+]

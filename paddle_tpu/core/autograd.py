@@ -1,0 +1,381 @@
+"""Define-by-run autograd engine over JAX eager ops.
+
+TPU-native re-design of the reference eager autograd runtime
+(``paddle/fluid/eager/backward.cc:104`` RunBackward, ``grad_node_info.h:168``
+GradNodeBase): every differentiable op records a ``GradNode`` holding the
+``jax.vjp`` pullback (the residuals play the role of the reference's
+``TensorWrapper`` saved tensors). ``run_backward`` does the same queue-driven
+reverse-topological traversal with pending-edge counts and gradient hooks.
+
+On the hot path (jitted train step) none of this runs — ``paddle_tpu.jit``
+traces pure functions and uses ``jax.grad`` directly, which is the TPU analog
+of the reference's static-graph ``append_backward``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import tree_flatten, tree_unflatten
+
+__all__ = [
+    "GradNode",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "apply_op",
+    "run_backward",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    prev = _state.enabled
+    _state.enabled = bool(mode)
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+class _NoGrad(contextlib.ContextDecorator):
+    """paddle.no_grad parity — usable as context manager and decorator."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class _EnableGrad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+no_grad = _NoGrad
+enable_grad = _EnableGrad
+
+
+class GradNode:
+    """One recorded op in the tape (≙ reference GradNodeBase, grad_node_info.h:168).
+
+    Holds the vjp pullback, strong refs to parent Tensors (keeps the graph
+    alive the way TensorWrapper does), and the output structure needed to
+    assemble cotangents.
+    """
+
+    __slots__ = (
+        "vjp_fn",
+        "parents",
+        "out_treedef",
+        "out_avals",
+        "name",
+        "consumed",
+    )
+
+    def __init__(self, vjp_fn, parents, out_treedef, out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        self.parents = parents  # list[Tensor], order matches vjp cotangent outputs
+        self.out_treedef = out_treedef
+        self.out_avals = out_avals  # list[(shape, dtype)] per output leaf
+        self.name = name
+        self.consumed = False
+
+    def __repr__(self):
+        return f"GradNode({self.name}, n_out={len(self.out_avals)})"
+
+
+def _is_tensor(x) -> bool:
+    from .tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
+    """Execute ``fn`` on unwrapped values; record a GradNode if needed.
+
+    ``fn`` is a pure jax-level function. Tensor leaves anywhere in
+    (args, kwargs) are differentiable inputs; raw arrays / python scalars are
+    constants. Returns Tensor-wrapped outputs mirroring fn's output pytree.
+    """
+    from .tensor import Tensor
+
+    leaves, treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    raw = [l._value if isinstance(l, Tensor) else l for l in leaves]
+
+    grad_wanted = _state.enabled and any(
+        not leaves[i].stop_gradient for i in t_idx
+    )
+
+    if not grad_wanted:
+        a, k = tree_unflatten(treedef, raw)
+        out = fn(*a, **k)
+        return _wrap_outputs(out, None)
+
+    tvals = [raw[i] for i in t_idx]
+
+    def _pure(*tv):
+        buf = list(raw)
+        for i, v in zip(t_idx, tv):
+            buf[i] = v
+        a, k = tree_unflatten(treedef, buf)
+        return fn(*a, **k)
+
+    out, vjp_fn = jax.vjp(_pure, *tvals)
+    out_leaves, out_treedef = tree_flatten(out)
+    out_avals = [(jnp.shape(o), jnp.result_type(o)) for o in out_leaves]
+    node = GradNode(
+        vjp_fn,
+        [leaves[i] for i in t_idx],
+        out_treedef,
+        out_avals,
+        name=op_name or getattr(fn, "__name__", "op"),
+    )
+    return _wrap_outputs(out, node)
+
+
+def _wrap_outputs(out, node):
+    from .tensor import Tensor
+
+    out_leaves, out_treedef = tree_flatten(out)
+    wrapped = []
+    for i, o in enumerate(out_leaves):
+        t = Tensor(o, stop_gradient=(node is None))
+        if node is not None:
+            t._node = node
+            t._out_idx = i
+        wrapped.append(t)
+    res = tree_unflatten(out_treedef, wrapped)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Backward traversal (≙ egr::RunBackward, eager/backward.cc:104)
+# ---------------------------------------------------------------------------
+
+
+def _ones_like(value):
+    return jnp.ones(jnp.shape(value), jnp.result_type(value))
+
+
+def _zero_cotangent(shape, dtype):
+    import numpy as _np
+
+    if not jnp.issubdtype(dtype, jnp.floating) and not jnp.issubdtype(
+        dtype, jnp.complexfloating
+    ):
+        return _np.zeros(shape, jax.dtypes.float0)
+    return jnp.zeros(shape, dtype)
+
+
+def run_backward(
+    tensors: Sequence[Any],
+    grad_tensors: Optional[Sequence[Any]] = None,
+    retain_graph: bool = False,
+    capture: Optional[Sequence[Any]] = None,
+    accumulate_leaf_grads: bool = True,
+    allow_unused: bool = True,
+):
+    """Reverse-mode traversal from ``tensors`` seeding ``grad_tensors``.
+
+    If ``capture`` is given, returns the gradient arrays for those tensors
+    (paddle.grad path, ≙ GeneralGrad eager/backward.cc:102); otherwise
+    accumulates ``.grad`` on reachable leaves (loss.backward path).
+    """
+    from .tensor import Tensor
+
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    grad_tensors = list(grad_tensors)
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors length mismatch")
+
+    capture_ids = None
+    captured: Dict[int, Any] = {}
+    if capture is not None:
+        capture_ids = {id(t): i for i, t in enumerate(capture)}
+
+    # cotangent buffers: per-node list of per-output cotangents, plus direct
+    # per-tensor accumulation for leaves (GradTensorHolder analog).
+    node_cots: Dict[int, List[Optional[Any]]] = {}
+    nodes: Dict[int, GradNode] = {}
+
+    def _seed(t: Tensor, g):
+        if g is None:
+            if jnp.size(t._value) != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward seed"
+                )
+            g = _ones_like(t._value)
+        elif isinstance(g, Tensor):
+            g = g._value
+        _route(t, g)
+
+    def _route(t: Tensor, g):
+        """Deliver cotangent g to tensor t: hooks, capture, leaf accum, node slot."""
+        if getattr(g, "dtype", None) == jax.dtypes.float0:
+            return  # integer/bool primal path — no gradient flows
+        for hook in t._hooks:
+            out = hook(Tensor(g, stop_gradient=True))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else out
+        if capture_ids is not None and id(t) in capture_ids:
+            prev = captured.get(id(t))
+            captured[id(t)] = g if prev is None else prev + g
+        node = t._node
+        if node is not None and node.consumed and id(node) not in nodes:
+            raise RuntimeError(
+                "Trying to backward through a graph that was already freed; "
+                "set retain_graph=True on the first backward"
+            )
+        if node is None or node.consumed:
+            if accumulate_leaf_grads and not t.stop_gradient and node is None:
+                if t.grad is None:
+                    t.grad = Tensor(g, stop_gradient=True)
+                else:
+                    t.grad = Tensor(t.grad._value + g, stop_gradient=True)
+            return
+        nid = id(node)
+        nodes[nid] = node
+        slots = node_cots.setdefault(nid, [None] * len(node.out_avals))
+        idx = t._out_idx
+        slots[idx] = g if slots[idx] is None else slots[idx] + g
+        if t._retain_grad and accumulate_leaf_grads:
+            if t.grad is None:
+                t.grad = Tensor(g, stop_gradient=True)
+            else:
+                t.grad = Tensor(t.grad._value + g, stop_gradient=True)
+
+    # --- discover reachable graph, count child->parent edges per node ------
+    pending: Dict[int, int] = {}
+    seen = set()
+    stack = [t._node for t in tensors if isinstance(t, Tensor) and t._node is not None]
+    stack = [n for n in stack if not n.consumed]
+    for n in stack:
+        nodes[id(n)] = n
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        for p in n.parents:
+            pn = p._node
+            if pn is not None and not pn.consumed:
+                pending[id(pn)] = pending.get(id(pn), 0) + 1
+                nodes[id(pn)] = pn
+                if id(pn) not in seen:
+                    stack.append(pn)
+
+    # --- seed -------------------------------------------------------------
+    for t, g in zip(tensors, grad_tensors):
+        if not isinstance(t, Tensor):
+            raise TypeError("backward expects Tensors")
+        _seed(t, g)
+
+    # --- Kahn queue over nodes whose children have all fired ---------------
+    # A node whose pending count hits zero with NO cotangent slots (all its
+    # outputs' gradients were float0 / dead) must still release its parents'
+    # pending edges, else ancestors starve (e.g. an int-cast side branch off
+    # a shared float subgraph).
+    executed = set()
+    ready = []
+
+    def _release_dead(node):
+        stack_ = [node]
+        while stack_:
+            n = stack_.pop()
+            n.consumed = n.consumed or not retain_graph
+            for p in n.parents:
+                pn = p._node
+                if pn is None:
+                    continue
+                pid = id(pn)
+                if pid in pending:
+                    pending[pid] -= 1
+                    if pending[pid] == 0 and pid not in executed:
+                        if pid in node_cots:
+                            ready.append(pn)
+                        else:
+                            executed.add(pid)
+                            stack_.append(pn)
+
+    ready.extend(nodes[nid] for nid in node_cots if pending.get(nid, 0) == 0)
+    for nid, n in list(nodes.items()):
+        if pending.get(nid, 0) == 0 and nid not in node_cots and nid not in executed:
+            # seeded-dead root (all seeds float0) — release immediately
+            executed.add(nid)
+            _release_dead(n)
+    while ready:
+        node = ready.pop()
+        nid = id(node)
+        if nid in executed:
+            continue
+        executed.add(nid)
+        slots = node_cots.get(nid)
+        if slots is None:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to backward through node {node.name} a second time; "
+                "set retain_graph=True on the first backward"
+            )
+        cots = [
+            s if s is not None else _zero_cotangent(shape, dtype)
+            for s, (shape, dtype) in zip(slots, node.out_avals)
+        ]
+        cot_tree = tree_unflatten(node.out_treedef, cots)
+        parent_grads = node.vjp_fn(cot_tree)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.consumed = True
+        for p, pg in zip(node.parents, parent_grads):
+            _route(p, pg)
+            pn = p._node
+            if pn is not None:
+                pid = id(pn)
+                if pid in pending:
+                    pending[pid] -= 1
+                    if pending[pid] == 0 and pid not in executed:
+                        if pid in node_cots:
+                            ready.append(pn)
+                        else:
+                            executed.add(pid)
+                            _release_dead(pn)
+
+    if capture_ids is not None:
+        out = []
+        for t in capture:
+            g = captured.get(id(t))
+            if g is None and not allow_unused:
+                raise RuntimeError("One of the differentiated tensors was unused")
+            out.append(None if g is None else Tensor(g, stop_gradient=True))
+        return out
+    return None

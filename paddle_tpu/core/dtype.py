@@ -1,0 +1,120 @@
+"""Datatype registry.
+
+TPU-native analog of the reference's POD dtype layer
+(``paddle/phi/common/data_type.h``, ``float16.h``/``bfloat16.h``): dtypes are
+plain ``jnp.dtype`` objects; bfloat16 is the native TPU compute type rather
+than a hand-rolled struct.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (exposed as paddle_tpu.float32 etc.).
+#
+# TPU-native decision: XLA:TPU computes in 32-bit (64-bit emulation is slow
+# and JAX disables x64 by default), so 64-bit dtype NAMES are kept for API
+# parity but canonicalize to their 32-bit counterparts unless JAX_ENABLE_X64
+# is set. This mirrors jnp's own canonicalization and keeps paddle.int64 ==
+# actual array dtype consistent.
+import jax
+
+_X64 = bool(jax.config.jax_enable_x64)
+
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64) if _X64 else float32
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64) if _X64 else int32
+uint8 = jnp.dtype(jnp.uint8)
+uint16 = jnp.dtype(jnp.uint16)
+uint32 = jnp.dtype(jnp.uint32)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128) if _X64 else complex64
+
+_NAME_TO_DTYPE = {
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOATING_DTYPES = (float16, bfloat16, float32, float64)
+INTEGER_DTYPES = (int8, int16, int32, int64, uint8, uint16, uint32)
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize a user-provided dtype (str / np / jnp dtype) to jnp.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = jnp.dtype(dtype)
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in FLOATING_DTYPES
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in INTEGER_DTYPES or d == bool_
+
+
+_DEFAULT_DTYPE = [float32]
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype parity (reference: python/paddle/framework/framework.py)."""
+    d = convert_dtype(dtype)
+    if d not in FLOATING_DTYPES:
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def promote(*dtypes):
+    return jnp.result_type(*dtypes)
+
+
+def to_numpy_dtype(dtype):
+    d = convert_dtype(dtype)
+    if d == bfloat16:
+        # numpy has no native bfloat16; ml_dtypes provides it via jnp
+        return np.dtype(jnp.bfloat16)
+    return np.dtype(d)

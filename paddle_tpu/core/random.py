@@ -1,0 +1,89 @@
+"""RNG state management.
+
+TPU-native analog of the reference's ``phi::Generator`` (phi/core/generator.h):
+a named-stream counter-based design over JAX PRNG keys. Eager ops fold a
+monotonically increasing counter into the seed key; under ``paddle_tpu.jit``
+tracing, a traced key can be pushed so randomness varies per step inside a
+compiled function (the reference achieves this with stateful curand;
+functional keys are the XLA-friendly form).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["Generator", "default_generator", "seed", "get_rng_state",
+           "set_rng_state", "trace_key_scope"]
+
+
+class Generator:
+    def __init__(self, seed_: int = 0):
+        self._seed = seed_
+        self._counter = 0
+
+    def manual_seed(self, seed_: int):
+        self._seed = int(seed_)
+        self._counter = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = int(state[0]), int(state[1])
+
+    def next_key(self):
+        tk = _trace_key.value
+        if tk is not None:
+            # inside a traced/jitted region: derive from the traced key so the
+            # compiled program gets fresh randomness every invocation
+            sub = jax.random.fold_in(tk, _trace_key.bump())
+            return sub
+        self._counter += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), self._counter)
+
+
+class _TraceKey(threading.local):
+    def __init__(self):
+        self.value = None
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+
+_trace_key = _TraceKey()
+
+
+@contextlib.contextmanager
+def trace_key_scope(key):
+    prev, prev_n = _trace_key.value, _trace_key.n
+    _trace_key.value, _trace_key.n = key, 0
+    try:
+        yield
+    finally:
+        _trace_key.value, _trace_key.n = prev, prev_n
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int):
+    """paddle.seed parity (python/paddle/framework/random.py)."""
+    default_generator.manual_seed(s)
+    return default_generator
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(state):
+    default_generator.set_state(state[0])

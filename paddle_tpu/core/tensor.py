@@ -1,0 +1,340 @@
+"""Tensor facade over ``jax.Array``.
+
+TPU-native analog of the reference's ``phi::DenseTensor``
+(paddle/phi/core/dense_tensor.h:43) + eager tensor (pybind/eager_method.cc:101):
+a thin wrapper holding a jax array, the ``stop_gradient`` flag, an optional
+``.grad``, and a pointer into the autograd tape (GradNode). Device placement,
+layout and allocation are owned by JAX/XLA — there is no Place/Allocator
+plumbing to re-implement per op.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .autograd import apply_op, is_grad_enabled, no_grad, run_backward
+from .place import CPUPlace, Place, TPUPlace, _current_place
+
+__all__ = ["Tensor", "to_tensor", "is_tensor"]
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "name",
+        "persistable",
+        "_node",
+        "_out_idx",
+        "_hooks",
+        "_retain_grad",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = name
+        self.persistable = False
+        self._node = None
+        self._out_idx = 0
+        self._hooks = []
+        self._retain_grad = False
+
+    # -- interop -----------------------------------------------------------
+    def __jax_array__(self):
+        """Allow jnp.* functions to consume Tensor directly."""
+        return self._value
+
+    @property
+    def value(self):
+        return self._value
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return np.asarray(self._value).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(jnp.shape(self._value))
+
+    @property
+    def ndim(self) -> int:
+        return jnp.ndim(self._value)
+
+    def dim(self) -> int:
+        return self.ndim
+
+    def rank(self) -> int:
+        return self.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(jnp.shape(self._value), dtype=np.int64))
+
+    def numel(self) -> int:
+        return self.size
+
+    @property
+    def dtype(self):
+        return jnp.result_type(self._value)
+
+    @property
+    def place(self) -> Place:
+        devs = getattr(self._value, "devices", None)
+        if callable(devs):
+            try:
+                d = next(iter(self._value.devices()))
+                return CPUPlace(d.id) if d.platform == "cpu" else TPUPlace(d.id)
+            except Exception:
+                pass
+        return _current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    def __len__(self):
+        s = jnp.shape(self._value)
+        if not s:
+            raise TypeError("len() of a 0-d tensor")
+        return s[0]
+
+    def __repr__(self):
+        grad_tag = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_tag},\n"
+            f"       {np.asarray(self._value)!r})"
+        )
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(np.asarray(self._value).item(), spec)
+        return object.__format__(self, spec)
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        """loss.backward() parity (eager/backward.cc:104)."""
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Removable:
+            def remove(_self):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Removable()
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return apply_op(jnp.copy, self, op_name="clone")
+
+    # -- dtype / device ----------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        d = dtypes.convert_dtype(dtype)
+        return apply_op(lambda v: v.astype(d), self, op_name="cast")
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def cpu(self) -> "Tensor":
+        cpu_dev = jax.devices("cpu")[0]
+        # device_put is a differentiable jax primitive — keep the tape intact
+        return apply_op(
+            lambda v: jax.device_put(v, cpu_dev), self, op_name="to_cpu"
+        )
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        device = kwargs.get("device")
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and (a in dtypes._NAME_TO_DTYPE):
+                dtype = a
+            elif isinstance(a, str) or isinstance(a, Place):
+                device = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            place = device if isinstance(device, Place) else _parse_place(device)
+            dev = place.jax_device()
+            out = apply_op(
+                lambda v: jax.device_put(v, dev), out, op_name="to_device"
+            )
+        return out
+
+    def pin_memory(self):  # no-op on TPU; host staging is XLA's job
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- in-place (optimizer path; guarded against tape corruption) --------
+    def _inplace_(self, new_value) -> "Tensor":
+        if self._node is not None and is_grad_enabled():
+            raise RuntimeError(
+                "in-place update on a tensor recorded by autograd; wrap in no_grad()"
+            )
+        if isinstance(new_value, Tensor):
+            new_value = new_value._value
+        self._value = jnp.asarray(new_value, dtype=self.dtype)
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = jnp.asarray(value)
+        return self
+
+    def copy_(self, other, *args):
+        return self._inplace_(other)
+
+    def fill_(self, v):
+        return self._inplace_(jnp.full_like(self._value, v))
+
+    def zero_(self):
+        return self._inplace_(jnp.zeros_like(self._value))
+
+    def add_(self, other):
+        return self._inplace_(self._value + _unwrap(other))
+
+    def subtract_(self, other):
+        return self._inplace_(self._value - _unwrap(other))
+
+    def multiply_(self, other):
+        return self._inplace_(self._value * _unwrap(other))
+
+    def scale_(self, s, bias: float = 0.0):
+        return self._inplace_(self._value * s + bias)
+
+    def clip_(self, min=None, max=None):
+        return self._inplace_(jnp.clip(self._value, min, max))
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, idx) -> "Tensor":
+        idx = _unwrap_index(idx)
+        return apply_op(lambda v: v[idx], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        value = _unwrap(value)
+        if self._node is not None and is_grad_enabled():
+            raise RuntimeError(
+                "in-place __setitem__ on a non-leaf autograd tensor is not "
+                "supported; use paddle_tpu.scatter / tensor.at-style ops"
+            )
+        self._value = jnp.asarray(self._value).at[idx].set(value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- python protocol: arithmetic dunders wired in ops/_methods.py ------
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_unwrap(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray([_unwrap(i) for i in idx])
+    return _unwrap(idx)
+
+
+def _parse_place(device: str) -> Place:
+    if ":" in device:
+        kind, idx = device.split(":", 1)
+        idx = int(idx)
+    else:
+        kind, idx = device, 0
+    kind = {"gpu": "tpu", "cuda": "tpu"}.get(kind, kind)
+    return CPUPlace(idx) if kind == "cpu" else TPUPlace(idx)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    d = dtypes.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        value = data._value
+    else:
+        value = data
+    if d is None and not hasattr(value, "dtype"):
+        # python scalars / lists follow paddle's defaults: float->default dtype
+        arr = np.asarray(value)
+        if arr.dtype == np.float64:
+            d = dtypes.get_default_dtype()
+        elif arr.dtype == np.int64:
+            d = dtypes.int64
+    value = jnp.asarray(value, dtype=d)
+    if place is not None:
+        p = place if isinstance(place, Place) else _parse_place(str(place))
+        value = jax.device_put(value, p.jax_device())
+    return Tensor(value, stop_gradient=stop_gradient)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+# Register Tensor as a pytree so jax transforms can consume containers of them.
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), (t.stop_gradient, t.name)),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux[0], name=aux[1]),
+)

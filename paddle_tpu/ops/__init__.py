@@ -1,0 +1,16 @@
+"""Functional op surface (``paddle.*`` tensor functions).
+
+TPU-native analog of the reference's PHI op library (paddle/phi/kernels/,
+python/paddle/tensor/): each op is a thin differentiable wrapper over
+jax.numpy/lax — kernel selection, layout transform, and fusion are XLA's job,
+so the per-op dispatch machinery (phi/api/lib/kernel_dispatch.h:179)
+disappears.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from . import _methods  # noqa: F401  (attaches Tensor methods/dunders)

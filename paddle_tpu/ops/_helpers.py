@@ -1,0 +1,38 @@
+"""Op-definition helpers: differentiable vs non-differentiable wrappers."""
+from __future__ import annotations
+
+import functools
+
+from ..core.autograd import apply_op, no_grad
+from ..core.tensor import Tensor
+
+
+def diff_op(fn, name=None):
+    """Wrap a pure jax fn as a differentiable eager op."""
+
+    n = name or getattr(fn, "__name__", "op")
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        return apply_op(fn, *args, op_name=n, **kwargs)
+
+    wrapped.__name__ = n
+    return wrapped
+
+
+def nondiff_op(fn, name=None):
+    """Wrap a jax fn whose outputs never carry gradient (comparisons, argmax...)."""
+
+    n = name or getattr(fn, "__name__", "op")
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with no_grad():
+            return apply_op(fn, *args, op_name=n, **kwargs)
+
+    wrapped.__name__ = n
+    return wrapped
+
+
+def unwrap(x):
+    return x._value if isinstance(x, Tensor) else x

@@ -1,0 +1,115 @@
+"""Attach the functional op surface as Tensor methods + arithmetic dunders
+(reference: pybind/eager_method.cc:101 tensor methods table)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from . import linalg, logic, manipulation, math as math_ops, search
+from ._helpers import nondiff_op
+
+
+def _binary(fn, name, reflected=False):
+    def method(self, other):
+        if reflected:
+            return apply_op(lambda a, b: fn(b, a), self, other, op_name=name)
+        return apply_op(fn, self, other, op_name=name)
+
+    return method
+
+
+def _cmp(fn, name):
+    def method(self, other):
+        return nondiff_op(fn, name)(self, other)
+
+    return method
+
+
+Tensor.__add__ = _binary(jnp.add, "add")
+Tensor.__radd__ = _binary(jnp.add, "add", reflected=True)
+Tensor.__sub__ = _binary(jnp.subtract, "sub")
+Tensor.__rsub__ = _binary(jnp.subtract, "sub", reflected=True)
+Tensor.__mul__ = _binary(jnp.multiply, "mul")
+Tensor.__rmul__ = _binary(jnp.multiply, "mul", reflected=True)
+Tensor.__truediv__ = _binary(jnp.divide, "div")
+Tensor.__rtruediv__ = _binary(jnp.divide, "div", reflected=True)
+Tensor.__floordiv__ = _binary(jnp.floor_divide, "floordiv")
+Tensor.__rfloordiv__ = _binary(jnp.floor_divide, "floordiv", reflected=True)
+Tensor.__mod__ = _binary(jnp.mod, "mod")
+Tensor.__rmod__ = _binary(jnp.mod, "mod", reflected=True)
+Tensor.__pow__ = _binary(jnp.power, "pow")
+Tensor.__rpow__ = _binary(jnp.power, "pow", reflected=True)
+Tensor.__matmul__ = _binary(jnp.matmul, "matmul")
+Tensor.__rmatmul__ = _binary(jnp.matmul, "matmul", reflected=True)
+Tensor.__neg__ = lambda self: apply_op(jnp.negative, self, op_name="neg")
+Tensor.__abs__ = lambda self: apply_op(jnp.abs, self, op_name="abs")
+Tensor.__invert__ = lambda self: nondiff_op(jnp.logical_not, "not")(self)
+
+Tensor.__eq__ = _cmp(jnp.equal, "eq")
+Tensor.__ne__ = _cmp(jnp.not_equal, "ne")
+Tensor.__lt__ = _cmp(jnp.less, "lt")
+Tensor.__le__ = _cmp(jnp.less_equal, "le")
+Tensor.__gt__ = _cmp(jnp.greater, "gt")
+Tensor.__ge__ = _cmp(jnp.greater_equal, "ge")
+Tensor.__and__ = _cmp(jnp.bitwise_and, "and")
+Tensor.__or__ = _cmp(jnp.bitwise_or, "or")
+Tensor.__xor__ = _cmp(jnp.bitwise_xor, "xor")
+
+# augmented-assign: out-of-place (new value, same python name) like paddle
+Tensor.__iadd__ = Tensor.__add__
+Tensor.__isub__ = Tensor.__sub__
+Tensor.__imul__ = Tensor.__mul__
+Tensor.__itruediv__ = Tensor.__truediv__
+
+_METHOD_SOURCES = [
+    (
+        math_ops,
+        "exp log log2 log10 log1p sqrt rsqrt square abs neg sin cos tan asin "
+        "acos atan sinh cosh tanh asinh acosh atanh ceil floor round trunc "
+        "reciprocal sign erf erfinv sigmoid digamma lgamma frac add subtract "
+        "multiply divide floor_divide mod remainder pow maximum minimum fmax "
+        "fmin atan2 scale clip lerp sum mean prod max min amax amin nansum "
+        "nanmean logsumexp all any count_nonzero std var median quantile "
+        "cumsum cumprod cummax cummin logcumsumexp addmm inner outer kron "
+        "trace diff nan_to_num increment",
+    ),
+    (
+        manipulation,
+        "reshape reshape_ flatten squeeze unsqueeze transpose moveaxis "
+        "swapaxes tile expand expand_as broadcast_to flip rot90 roll gather "
+        "gather_nd scatter scatter_nd_add index_select index_sample index_add "
+        "index_put take_along_axis put_along_axis strided_slice pad unbind "
+        "repeat_interleave view view_as unfold masked_fill where numel cast "
+        "split chunk unstack",
+    ),
+    (
+        linalg,
+        "matmul mm bmm dot mv t norm dist cross cholesky solve inverse det "
+        "slogdet matrix_power qr svd pinv eig eigvals multi_dot histogram "
+        "bincount",
+    ),
+    (
+        logic,
+        "equal not_equal greater_than greater_equal less_than less_equal "
+        "equal_all allclose isclose logical_and logical_or logical_xor "
+        "logical_not bitwise_and bitwise_or bitwise_xor bitwise_not isnan "
+        "isinf isfinite is_empty isin",
+    ),
+    (
+        search,
+        "argmax argmin argsort sort topk nonzero masked_select searchsorted "
+        "kthvalue mode unique",
+    ),
+]
+
+for _mod, _names in _METHOD_SOURCES:
+    for _n in _names.split():
+        if not hasattr(Tensor, _n):
+            setattr(Tensor, _n, getattr(_mod, _n))
+
+# property-style helpers
+Tensor.T = property(lambda self: linalg.t(self))
+Tensor.mT = property(
+    lambda self: apply_op(lambda v: jnp.swapaxes(v, -1, -2), self, op_name="mT")
+)

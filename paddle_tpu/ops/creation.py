@@ -1,0 +1,174 @@
+"""Creation ops (python/paddle/tensor/creation.py parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, to_tensor
+from ._helpers import unwrap
+
+__all__ = [
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "diag",
+    "diagflat",
+    "tril",
+    "triu",
+    "meshgrid",
+    "assign",
+    "clone",
+    "to_tensor",
+    "tril_indices",
+    "triu_indices",
+    "one_hot",
+]
+
+
+def _d(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtypes.get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _d(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _d(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    if dtype is None:
+        return Tensor(jnp.full(_shape(shape), fill_value))
+    return Tensor(jnp.full(_shape(shape), fill_value, _d(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(
+        jnp.full_like(unwrap(x), unwrap(fill_value), dtype=dtypes.convert_dtype(dtype))
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(
+        jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=_d(dtype))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(
+            unwrap(start), unwrap(stop), int(unwrap(num)), base=base, dtype=_d(dtype)
+        )
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_d(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    v = unwrap(x)
+    if jnp.ndim(v) == 1 and padding_value != 0:
+        base = jnp.full(
+            (v.shape[0] + abs(offset),) * 2, padding_value, jnp.result_type(v)
+        )
+        return Tensor(base + jnp.diag(v - padding_value, k=offset))
+    return Tensor(jnp.diag(v, k=offset))
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(unwrap(x), k=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    from ._helpers import diff_op
+
+    return diff_op(lambda v: jnp.tril(v, k=diagonal), "tril")(x)
+
+
+def triu(x, diagonal=0, name=None):
+    from ._helpers import diff_op
+
+    return diff_op(lambda v: jnp.triu(v, k=diagonal), "triu")(x)
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    v = jnp.asarray(unwrap(x))
+    if output is not None:
+        output.set_value(v)
+        return output
+    return Tensor(v)
+
+
+def clone(x, name=None):
+    from ._helpers import diff_op
+
+    return diff_op(jnp.copy, "clone")(x)
+
+
+def tril_indices(row, col, offset=0, dtype=dtypes.int64):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype=dtypes.int64):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(dtypes.convert_dtype(dtype)))
+
+
+def one_hot(x, num_classes, name=None):
+    import jax
+
+    return Tensor(
+        jax.nn.one_hot(unwrap(x), num_classes, dtype=dtypes.get_default_dtype())
+    )

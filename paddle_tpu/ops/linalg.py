@@ -1,0 +1,288 @@
+"""Linear algebra ops (python/paddle/tensor/linalg.py parity).
+
+matmul maps straight onto the MXU via XLA dot_general — the reference's
+blas/cublas wrapper layer (phi/kernels/funcs/blas/) has no analog here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ._helpers import nondiff_op, unwrap
+
+__all__ = [
+    "matmul",
+    "mm",
+    "bmm",
+    "dot",
+    "mv",
+    "t",
+    "einsum",
+    "norm",
+    "dist",
+    "cond",
+    "cross",
+    "cholesky",
+    "cholesky_solve",
+    "triangular_solve",
+    "lu",
+    "qr",
+    "svd",
+    "pinv",
+    "inverse",
+    "det",
+    "slogdet",
+    "matrix_power",
+    "matrix_rank",
+    "eig",
+    "eigh",
+    "eigvals",
+    "eigvalsh",
+    "solve",
+    "lstsq",
+    "multi_dot",
+    "histogram",
+    "bincount",
+    "corrcoef",
+    "cov",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Reference: legacy_ops.yaml:507 / phi MatmulKernel
+    (phi/kernels/impl/matmul_kernel_impl.h:968)."""
+
+    def impl(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return a @ b
+
+    return apply_op(impl, x, y, op_name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return apply_op(jnp.matmul, input, mat2, op_name="mm")
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y, op_name="bmm")
+
+
+def dot(x, y, name=None):
+    return apply_op(
+        lambda a, b: jnp.sum(a * b, axis=-1), x, y, op_name="dot"
+    )
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, x, vec, op_name="mv")
+
+
+def t(input, name=None):
+    return apply_op(
+        lambda v: v.T if v.ndim <= 2 else jnp.swapaxes(v, -1, -2),
+        input,
+        op_name="t",
+    )
+
+
+def einsum(equation, *operands):
+    return apply_op(
+        lambda *ops: jnp.einsum(equation, *ops), *operands, op_name="einsum"
+    )
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def impl(v):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2)).astype(v.dtype)
+            return jnp.linalg.norm(v, axis=ax, keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return apply_op(impl, x, op_name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return norm(apply_op(jnp.subtract, x, y, op_name="sub"), p=p)
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(unwrap(x), p=p))
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+
+    def impl(a, b):
+        if ax is None:
+            for i, d in enumerate(a.shape):
+                if d == 3:
+                    return jnp.cross(a, b, axis=i)
+            raise ValueError("no axis of size 3")
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_op(impl, x, y, op_name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def impl(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return apply_op(impl, x, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def impl(b, chol):
+        c = jnp.swapaxes(chol, -1, -2) if upper else chol
+        z = jax.scipy.linalg.solve_triangular(c, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(c, -1, -2), z, lower=False
+        )
+
+    return apply_op(impl, x, y, op_name="cholesky_solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def impl(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        )
+
+    return apply_op(impl, x, y, op_name="triangular_solve")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    v = unwrap(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(v)
+    outs = (Tensor(lu_), Tensor(piv + 1))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(unwrap(x), mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def svd(x, full_matrices=False, name=None):
+    """Returns (U, S, VH) with U @ diag(S) @ VH == x, matching the reference
+    (python/paddle/tensor/linalg.py svd returns VH)."""
+    u, s, vh = jnp.linalg.svd(unwrap(x), full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(vh)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return Tensor(jnp.linalg.pinv(unwrap(x), rtol=rcond, hermitian=hermitian))
+
+
+def inverse(x, name=None):
+    return apply_op(jnp.linalg.inv, x, op_name="inverse")
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    def impl(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+
+    return apply_op(impl, x, op_name="slogdet")
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(
+        lambda v: jnp.linalg.matrix_power(v, n), x, op_name="matrix_power"
+    )
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return nondiff_op(
+        lambda v: jnp.linalg.matrix_rank(v, rtol=tol), "matrix_rank"
+    )(x)
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(unwrap(x))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(unwrap(x), UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.linalg.eigvals(unwrap(x)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(
+        lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x, op_name="eigvalsh"
+    )
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(unwrap(x), unwrap(y), rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def multi_dot(tensors, name=None):
+    return apply_op(
+        lambda *vs: jnp.linalg.multi_dot(vs), *tensors, op_name="multi_dot"
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def impl(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h
+
+    return nondiff_op(impl, "histogram")(input)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = unwrap(x)
+    w = unwrap(weights)
+    length = builtins_max(int(v.max()) + 1 if v.size else 0, minlength)
+    return Tensor(jnp.bincount(v, weights=w, length=length))
+
+
+builtins_max = max
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(unwrap(x), rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(
+        lambda v: jnp.cov(
+            v, rowvar=rowvar, ddof=1 if ddof else 0,
+            fweights=unwrap(fweights), aweights=unwrap(aweights),
+        ),
+        x,
+        op_name="cov",
+    )

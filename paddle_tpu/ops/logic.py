@@ -1,0 +1,85 @@
+"""Comparison / logical ops (python/paddle/tensor/logic.py parity). All
+outputs are non-differentiable."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import nondiff_op, unwrap
+
+__all__ = [
+    "equal",
+    "not_equal",
+    "greater_than",
+    "greater_equal",
+    "less_than",
+    "less_equal",
+    "equal_all",
+    "allclose",
+    "isclose",
+    "logical_and",
+    "logical_or",
+    "logical_xor",
+    "logical_not",
+    "bitwise_and",
+    "bitwise_or",
+    "bitwise_xor",
+    "bitwise_not",
+    "isnan",
+    "isinf",
+    "isfinite",
+    "is_empty",
+    "isin",
+]
+
+_BINARY = dict(
+    equal=jnp.equal,
+    not_equal=jnp.not_equal,
+    greater_than=jnp.greater,
+    greater_equal=jnp.greater_equal,
+    less_than=jnp.less,
+    less_equal=jnp.less_equal,
+    logical_and=jnp.logical_and,
+    logical_or=jnp.logical_or,
+    logical_xor=jnp.logical_xor,
+    bitwise_and=jnp.bitwise_and,
+    bitwise_or=jnp.bitwise_or,
+    bitwise_xor=jnp.bitwise_xor,
+)
+for _n, _f in _BINARY.items():
+    globals()[_n] = nondiff_op(_f, _n)
+
+_UNARY = dict(
+    logical_not=jnp.logical_not,
+    bitwise_not=jnp.bitwise_not,
+    isnan=jnp.isnan,
+    isinf=jnp.isinf,
+    isfinite=jnp.isfinite,
+)
+for _n, _f in _UNARY.items():
+    globals()[_n] = nondiff_op(_f, _n)
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(
+        jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol, equal_nan=equal_nan)
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(
+        jnp.isclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol, equal_nan=equal_nan)
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(jnp.size(unwrap(x)) == 0))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return Tensor(jnp.isin(unwrap(x), unwrap(test_x), invert=invert))

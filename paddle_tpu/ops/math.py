@@ -1,0 +1,397 @@
+"""Elementwise math + reductions (python/paddle/tensor/math.py parity).
+
+Each op is a differentiable wrapper over jnp — XLA fuses chains of these into
+single VPU loops on TPU, playing the role of the reference's elementwise
+kernel fusion (phi/kernels/funcs/broadcast_function.h + CINN fusion passes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ._helpers import diff_op, nondiff_op, unwrap
+
+__all__ = []
+
+
+def _export(name, fn):
+    globals()[name] = fn
+    __all__.append(name)
+
+
+# ---- unary elementwise -----------------------------------------------------
+_UNARY = dict(
+    exp=jnp.exp,
+    expm1=jnp.expm1,
+    log=jnp.log,
+    log2=jnp.log2,
+    log10=jnp.log10,
+    log1p=jnp.log1p,
+    sqrt=jnp.sqrt,
+    rsqrt=jax.lax.rsqrt,
+    square=jnp.square,
+    abs=jnp.abs,
+    neg=jnp.negative,
+    sin=jnp.sin,
+    cos=jnp.cos,
+    tan=jnp.tan,
+    asin=jnp.arcsin,
+    acos=jnp.arccos,
+    atan=jnp.arctan,
+    sinh=jnp.sinh,
+    cosh=jnp.cosh,
+    tanh=jnp.tanh,
+    asinh=jnp.arcsinh,
+    acosh=jnp.arccosh,
+    atanh=jnp.arctanh,
+    ceil=jnp.ceil,
+    floor=jnp.floor,
+    round=jnp.round,
+    trunc=jnp.trunc,
+    reciprocal=jnp.reciprocal,
+    sign=jnp.sign,
+    erf=jax.scipy.special.erf,
+    erfinv=jax.scipy.special.erfinv,
+    sigmoid=jax.nn.sigmoid,
+    digamma=jax.scipy.special.digamma,
+    lgamma=jax.scipy.special.gammaln,
+    i0=lambda v: jax.scipy.special.i0(v),
+    i1=lambda v: jax.scipy.special.i1(v),
+    frac=lambda v: v - jnp.trunc(v),
+    angle=jnp.angle,
+    conj=jnp.conj,
+    real=jnp.real,
+    imag=jnp.imag,
+    deg2rad=jnp.deg2rad,
+    rad2deg=jnp.rad2deg,
+)
+for _n, _f in _UNARY.items():
+    _export(_n, diff_op(_f, _n))
+
+# paddle.abs alias
+_export("absolute", globals()["abs"])
+_export("negative", globals()["neg"])
+
+# ---- binary elementwise ----------------------------------------------------
+_BINARY = dict(
+    add=jnp.add,
+    subtract=jnp.subtract,
+    multiply=jnp.multiply,
+    divide=jnp.divide,
+    floor_divide=jnp.floor_divide,
+    mod=jnp.mod,
+    remainder=jnp.remainder,
+    pow=jnp.power,
+    maximum=jnp.maximum,
+    minimum=jnp.minimum,
+    fmax=jnp.fmax,
+    fmin=jnp.fmin,
+    atan2=jnp.arctan2,
+    hypot=jnp.hypot,
+    logaddexp=jnp.logaddexp,
+    copysign=jnp.copysign,
+    nextafter=jnp.nextafter,
+    ldexp=jnp.ldexp,
+    heaviside=jnp.heaviside,
+    gcd=jnp.gcd,
+    lcm=jnp.lcm,
+)
+for _n, _f in _BINARY.items():
+    _export(_n, diff_op(_f, _n))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+    if bias_after_scale:
+        fn = lambda v: v * s + b
+    else:
+        fn = lambda v: (v + b) * s
+    return apply_op(fn, x, op_name="scale")
+
+
+_export("scale", scale)
+
+
+def clip(x, min=None, max=None, name=None):
+    mn, mx = unwrap(min), unwrap(max)
+    return apply_op(lambda v: jnp.clip(v, mn, mx), x, op_name="clip")
+
+
+_export("clip", clip)
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op(
+        lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp"
+    )
+
+
+_export("lerp", lerp)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(
+        lambda v: scale_b * jnp.tanh(scale_a * v), x, op_name="stanh"
+    )
+
+
+_export("stanh", stanh)
+
+
+def multiplex(inputs, index, name=None):
+    vals = [unwrap(i) for i in inputs]
+    idx = unwrap(index)
+    return apply_op(
+        lambda *vs: jnp.stack(vs, 0)[idx.squeeze(-1) if idx.ndim > 1 else idx,
+                                     jnp.arange(vs[0].shape[0])],
+        *inputs,
+        op_name="multiplex",
+    )
+
+
+_export("multiplex", multiplex)
+
+# ---- reductions ------------------------------------------------------------
+
+
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def _reduction(name, fn, int_promote=False):
+    def op(x, axis=None, keepdim=False, dtype=None, name=None):
+        ax = _norm_axis(axis)
+        d = dtypes.convert_dtype(dtype)
+
+        def impl(v):
+            out = fn(v, axis=ax, keepdims=keepdim)
+            if d is not None:
+                out = out.astype(d)
+            return out
+
+        return apply_op(impl, x, op_name=name)
+
+    op.__name__ = name
+    _export(name, op)
+    return op
+
+
+_reduction("sum", jnp.sum)
+_reduction("mean", jnp.mean)
+_reduction("prod", jnp.prod)
+_reduction("max", jnp.max)
+_reduction("min", jnp.min)
+_reduction("amax", jnp.max)
+_reduction("amin", jnp.min)
+_reduction("nansum", jnp.nansum)
+_reduction("nanmean", jnp.nanmean)
+_reduction("logsumexp", lambda v, axis, keepdims: jax.scipy.special.logsumexp(v, axis=axis, keepdims=keepdims))
+_reduction("all", lambda v, axis, keepdims: jnp.all(v, axis=axis, keepdims=keepdims))
+_reduction("any", lambda v, axis, keepdims: jnp.any(v, axis=axis, keepdims=keepdims))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return nondiff_op(
+        lambda v: jnp.count_nonzero(v, axis=_norm_axis(axis), keepdims=keepdim),
+        "count_nonzero",
+    )(x)
+
+
+_export("count_nonzero", count_nonzero)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op(
+        lambda v: jnp.std(v, axis=ax, ddof=ddof, keepdims=keepdim), x, op_name="std"
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op(
+        lambda v: jnp.var(v, axis=ax, ddof=ddof, keepdims=keepdim), x, op_name="var"
+    )
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda v: jnp.median(v, axis=ax, keepdims=keepdim), x, op_name="median"
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda v: jnp.quantile(v, jnp.asarray(unwrap(q)), axis=ax, keepdims=keepdim),
+        x,
+        op_name="quantile",
+    )
+
+
+for _n in ("std", "var", "median", "quantile"):
+    _export(_n, globals()[_n])
+
+# ---- cumulative ------------------------------------------------------------
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+
+    def impl(v):
+        if axis is None:
+            out = jnp.cumsum(v.reshape(-1))
+        else:
+            out = jnp.cumsum(v, axis=int(axis))
+        return out.astype(d) if d is not None else out
+
+    return apply_op(impl, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+
+    def impl(v):
+        out = jnp.cumprod(v, axis=int(dim))
+        return out.astype(d) if d is not None else out
+
+    return apply_op(impl, x, op_name="cumprod")
+
+
+def _cum_extreme(x, axis, scan_fn, name):
+    """Running max/min values + index of the extremum (last occurrence on ties)."""
+    flatten_first = axis is None
+
+    def vals_impl(u):
+        if flatten_first:
+            return scan_fn(u.reshape(-1), axis=0)
+        return scan_fn(u, axis=axis % u.ndim)
+
+    def idx_impl(u):
+        if flatten_first:
+            u = u.reshape(-1)
+            ax = 0
+        else:
+            ax = axis % u.ndim
+        running = scan_fn(u, axis=ax)
+        pos_shape = [1] * u.ndim
+        pos_shape[ax] = u.shape[ax]
+        pos = jnp.arange(u.shape[ax]).reshape(pos_shape)
+        pos = jnp.broadcast_to(pos, u.shape)
+        candidate = jnp.where(u == running, pos, -1)
+        return jax.lax.cummax(candidate, axis=ax).astype(dtypes.int64)
+
+    vals = apply_op(vals_impl, x, op_name=name)
+    idx = nondiff_op(idx_impl, name + "_idx")(x)
+    return vals, idx
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, jax.lax.cummax, "cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, jax.lax.cummin, "cummin")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def impl(v):
+        if axis is None:
+            return jax.lax.cumlogsumexp(v.reshape(-1))
+        return jax.lax.cumlogsumexp(v, axis=int(axis))
+
+    return apply_op(impl, x, op_name="logcumsumexp")
+
+
+for _n in ("cumsum", "cumprod", "cummax", "cummin", "logcumsumexp"):
+    _export(_n, globals()[_n])
+
+# ---- misc ------------------------------------------------------------------
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, op_name="addmm"
+    )
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, x, y, op_name="inner")
+
+
+def outer(x, y, name=None):
+    return apply_op(
+        lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)), x, y, op_name="outer"
+    )
+
+
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, x, y, op_name="kron")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+        x,
+        op_name="trace",
+    )
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre, app = unwrap(prepend), unwrap(append)
+    return apply_op(
+        lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app),
+        x,
+        op_name="diff",
+    )
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(
+        lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+        x,
+        op_name="nan_to_num",
+    )
+
+
+def increment(x, value=1.0, name=None):
+    x._inplace_(x._value + value)
+    return x
+
+
+def floor_mod(x, y, name=None):
+    return apply_op(jnp.mod, x, y, op_name="floor_mod")
+
+
+def divide_no_nan(x, y, name=None):
+    return apply_op(
+        lambda a, b: jnp.where(b == 0, jnp.zeros_like(a), a / jnp.where(b == 0, 1, b)),
+        x,
+        y,
+        op_name="divide_no_nan",
+    )
+
+
+for _n in (
+    "addmm",
+    "inner",
+    "outer",
+    "kron",
+    "trace",
+    "diff",
+    "nan_to_num",
+    "increment",
+    "floor_mod",
+    "divide_no_nan",
+):
+    _export(_n, globals()[_n])

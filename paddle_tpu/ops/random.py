@@ -1,0 +1,158 @@
+"""Random sampling ops (python/paddle/tensor/random.py parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.random import default_generator
+from ..core.tensor import Tensor
+from ._helpers import unwrap
+from ..core.dtype import int64 as _i64
+
+__all__ = [
+    "rand",
+    "randn",
+    "randint",
+    "randint_like",
+    "randperm",
+    "uniform",
+    "normal",
+    "standard_normal",
+    "poisson",
+    "bernoulli",
+    "multinomial",
+    "exponential_",
+    "rand_like",
+    "randn_like",
+    "normal_like",
+    "uniform_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def _d(dtype):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else dtypes.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    k = default_generator.next_key()
+    return Tensor(jax.random.uniform(k, _shape(shape), _d(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    k = default_generator.next_key()
+    return Tensor(jax.random.normal(k, _shape(shape), _d(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    k = default_generator.next_key()
+    return Tensor(
+        jax.random.randint(k, _shape(shape), low, high, dtypes.convert_dtype(dtype))
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    v = unwrap(x)
+    d = dtypes.convert_dtype(dtype) or jnp.result_type(v)
+    if high is None:
+        low, high = 0, low
+    k = default_generator.next_key()
+    return Tensor(jax.random.randint(k, jnp.shape(v), low, high, d))
+
+
+def randperm(n, dtype="int64", name=None):
+    k = default_generator.next_key()
+    return Tensor(jax.random.permutation(k, n).astype(dtypes.convert_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    k = default_generator.next_key() if seed == 0 else jax.random.PRNGKey(seed)
+    return Tensor(
+        jax.random.uniform(
+            k, _shape(shape), _d(dtype), minval=unwrap(min), maxval=unwrap(max)
+        )
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    v = uniform(x.shape, x.dtype, min, max, seed)
+    return x._inplace_(v._value)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    mean_v, std_v = unwrap(mean), unwrap(std)
+    k = default_generator.next_key()
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(mean_v), jnp.shape(std_v))
+    else:
+        shape = _shape(shape)
+    sample = jax.random.normal(k, shape, dtypes.get_default_dtype())
+    return Tensor(sample * std_v + mean_v)
+
+
+def normal_like(x, mean=0.0, std=1.0, name=None):
+    return normal(mean, std, jnp.shape(unwrap(x)))
+
+
+def rand_like(x, dtype=None, name=None):
+    return rand(x.shape, dtype or x.dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    return randn(x.shape, dtype or x.dtype)
+
+
+def poisson(x, name=None):
+    k = default_generator.next_key()
+    return Tensor(
+        jax.random.poisson(k, unwrap(x)).astype(jnp.result_type(unwrap(x)))
+    )
+
+
+def bernoulli(x, name=None):
+    k = default_generator.next_key()
+    v = unwrap(x)
+    return Tensor(
+        jax.random.bernoulli(k, v).astype(jnp.result_type(v))
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    k = default_generator.next_key()
+    v = unwrap(x)
+    logits = jnp.log(jnp.maximum(v, 1e-38))
+    if replacement:
+        # sample along a leading axis then move it last: (*batch, num_samples)
+        out = jax.random.categorical(
+            k, logits, axis=-1, shape=(num_samples, *v.shape[:-1])
+        )
+        out = jnp.moveaxis(out, 0, -1)
+        if v.ndim == 1:
+            out = out.reshape(num_samples)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(k, v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(_i64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    k = default_generator.next_key()
+    v = unwrap(x)
+    sample = jax.random.exponential(k, jnp.shape(v), jnp.result_type(v)) / lam
+    return x._inplace_(sample)

@@ -1,0 +1,185 @@
+"""Search / sort ops (python/paddle/tensor/search.py parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ._helpers import nondiff_op, unwrap
+from ..core.dtype import int64 as _i64
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "argsort",
+    "sort",
+    "topk",
+    "nonzero",
+    "masked_select",
+    "searchsorted",
+    "kthvalue",
+    "mode",
+    "unique",
+    "unique_consecutive",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def impl(v):
+        out = jnp.argmax(v if axis is not None else v.reshape(-1),
+                         axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        from ..core.dtype import convert_dtype
+        return out.astype(convert_dtype(dtype))
+
+    return nondiff_op(impl, "argmax")(x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def impl(v):
+        out = jnp.argmin(v if axis is not None else v.reshape(-1),
+                         axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        from ..core.dtype import convert_dtype
+        return out.astype(convert_dtype(dtype))
+
+    return nondiff_op(impl, "argmin")(x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def impl(v):
+        idx = jnp.argsort(v, axis=axis, stable=True, descending=descending)
+        return idx.astype(_i64)
+
+    return nondiff_op(impl, "argsort")(x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def impl(v):
+        out = jnp.sort(v, axis=axis, stable=True, descending=descending)
+        return out
+
+    return apply_op(impl, x, op_name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(unwrap(k))
+    ax = int(axis)
+
+    def vals_impl(v):
+        u = jnp.moveaxis(v, ax, -1)
+        if largest:
+            tv, _ = jax.lax.top_k(u, k)
+        else:
+            tv, _ = jax.lax.top_k(-u, k)
+            tv = -tv
+        return jnp.moveaxis(tv, -1, ax)
+
+    def idx_impl(v):
+        u = jnp.moveaxis(v, ax, -1)
+        _, ti = jax.lax.top_k(u if largest else -u, k)
+        return jnp.moveaxis(ti.astype(_i64), -1, ax)
+
+    values = apply_op(vals_impl, x, op_name="topk")
+    indices = nondiff_op(idx_impl, "topk_idx")(x)
+    return values, indices
+
+
+def nonzero(x, as_tuple=False, name=None):
+    v = unwrap(x)
+    idx = jnp.nonzero(v)  # host-sync: dynamic shape, eager-only
+    if as_tuple:
+        return tuple(Tensor(i.reshape(-1, 1).squeeze(-1)) for i in idx)
+    return Tensor(jnp.stack(idx, axis=-1).astype(_i64))
+
+
+def masked_select(x, mask, name=None):
+    v, m = unwrap(x), unwrap(mask)
+    return Tensor(v[m])  # dynamic shape: eager-only (reference: masked_select op)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def impl(s, v):
+        out = jnp.searchsorted(s, v, side="right" if right else "left")
+        return out.astype(jnp.int32 if out_int32 else _i64)
+
+    return nondiff_op(impl, "searchsorted")(sorted_sequence, values)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    ax = int(axis)
+
+    def vals(v):
+        s = jnp.sort(v, axis=ax)
+        out = jnp.take(s, k - 1, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    def idxs(v):
+        si = jnp.argsort(v, axis=ax)
+        out = jnp.take(si, k - 1, axis=ax).astype(_i64)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    return apply_op(vals, x, op_name="kthvalue"), nondiff_op(idxs, "kthvalue_idx")(x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = unwrap(x)
+    ax = int(axis)
+
+    def _mode_1d(row):
+        vals, counts = jnp.unique_counts(row, size=row.shape[0], fill_value=row[0])
+        i = jnp.argmax(counts)
+        return vals[i]
+
+    u = jnp.moveaxis(v, ax, -1)
+    flat = u.reshape(-1, u.shape[-1])
+    out = jax.vmap(_mode_1d)(flat).reshape(u.shape[:-1])
+    idx = jnp.argmax(
+        jnp.moveaxis(v, ax, -1) == out[..., None], axis=-1
+    ).astype(_i64)
+    if keepdim:
+        out = jnp.expand_dims(out, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return Tensor(out), Tensor(idx)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = unwrap(x)
+    res = jnp.unique(
+        v, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )  # dynamic shape: eager-only
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    import numpy as np
+
+    v = np.asarray(unwrap(x))
+    if axis is None:
+        v = v.reshape(-1)
+        keep = np.concatenate([[True], v[1:] != v[:-1]])
+    else:
+        diff = (v.take(range(1, v.shape[axis]), axis=axis)
+                != v.take(range(0, v.shape[axis] - 1), axis=axis))
+        keep = np.concatenate(
+            [[True], diff.reshape(diff.shape[axis] if v.ndim == 1 else -1, *[])
+             .any(axis=tuple(i for i in range(diff.ndim) if i != axis))]
+        ) if v.ndim > 1 else np.concatenate([[True], diff])
+    out = v.compress(keep, axis=axis if axis is not None else 0)
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(keep)))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)

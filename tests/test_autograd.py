@@ -1,0 +1,173 @@
+"""Eager autograd engine tests (reference pattern: OpTest.check_grad
+finite-difference checks, eager_op_test.py:2377 — here vs jax.grad)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_accumulation():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * 3
+    z = y * y + x  # dz/dx = 2*3x*3 + 1 = 18x + 1 = 37
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 37.0)
+
+
+def test_grad_accumulates_across_backwards():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5.0)
+
+
+def test_matmul_grad_vs_jax():
+    a_np = np.random.randn(3, 4).astype("float32")
+    b_np = np.random.randn(4, 2).astype("float32")
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    loss = paddle.matmul(a, b).sum()
+    loss.backward()
+    ga, gb = jax.grad(lambda x, y: (x @ y).sum(), argnums=(0, 1))(a_np, b_np)
+    np.testing.assert_allclose(a.grad.numpy(), ga, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), gb, rtol=1e-5)
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor(np.ones((3, 4), "float32"), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), "float32"), stop_gradient=False)
+    (x + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3, 3, 3, 3])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = (y * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])  # y treated const
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    a = x * 2
+    b = x * 5
+    ((a + b) * a).sum().backward()
+    # f = (2x+5x)*2x = 14x^2, df/dx = 28x = 84
+    np.testing.assert_allclose(x.grad.numpy(), 84.0)
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype="float32"), stop_gradient=False)
+    parts = paddle.split(x, 3)
+    (parts[0].sum() * 2 + parts[2].sum() * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 0, 0, 3, 3])
+
+
+def test_non_scalar_backward_needs_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8.0)
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    z = (x * y).sum()
+    gx, gy = paddle.grad([z], [x, y])
+    np.testing.assert_allclose(gx.numpy(), [3, 4])
+    np.testing.assert_allclose(gy.numpy(), [1, 2])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_hooks():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    y = x * 3
+    y.register_hook(hook)
+    y.sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_grad_through_getitem():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3),
+                         stop_gradient=False)
+    x[1, 2:].sum().backward()
+    expected = np.zeros((2, 3), "float32")
+    expected[1, 2] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_int_index_path_no_crash():
+    x = paddle.to_tensor(np.random.randn(5, 3).astype("float32"),
+                         stop_gradient=False)
+    idx = paddle.to_tensor([0, 2, 4])
+    paddle.gather(x, idx).sum().backward()
+    assert x.grad.shape == [5, 3]
+    np.testing.assert_allclose(x.grad.numpy().sum(), 9.0)
+
+
+def test_softmax_cross_entropy_style_graph():
+    logits_np = np.random.randn(4, 10).astype("float32")
+    x = paddle.to_tensor(logits_np, stop_gradient=False)
+    p = paddle.exp(x - paddle.logsumexp(x, axis=-1, keepdim=True))
+    loss = -paddle.log(p[:, 0]).mean()
+    loss.backward()
+
+    def ref(v):
+        lp = v - jax.scipy.special.logsumexp(v, axis=-1, keepdims=True)
+        return -lp[:, 0].mean()
+
+    g = jax.grad(ref)(logits_np)
+    np.testing.assert_allclose(x.grad.numpy(), g, rtol=1e-4, atol=1e-5)
+
+
+def test_clear_grad():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    x.clear_grad()
+    assert x.grad is None

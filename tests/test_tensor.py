@@ -1,0 +1,165 @@
+"""Tensor facade + op surface tests (reference pattern: OpTest check_output,
+test/legacy_test/eager_op_test.py:2193)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+    assert t.stop_gradient
+
+
+def test_scalar_and_int_dtypes():
+    assert paddle.to_tensor(3).dtype == paddle.int64
+    assert paddle.to_tensor(3.5).dtype == paddle.float32
+    assert paddle.to_tensor(np.float64(1.5)).dtype == paddle.float64
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a**2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    b = paddle.to_tensor(np.random.randn(4, 5).astype("float32"))
+    np.testing.assert_allclose(
+        paddle.matmul(a, b).numpy(), a.numpy() @ b.numpy(), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        paddle.matmul(a, a, transpose_y=True).numpy(),
+        a.numpy() @ a.numpy().T,
+        rtol=1e-5,
+    )
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(24, dtype="float32").reshape(2, 3, 4))
+    np.testing.assert_allclose(paddle.sum(x).numpy(), x.numpy().sum())
+    np.testing.assert_allclose(
+        paddle.mean(x, axis=1).numpy(), x.numpy().mean(axis=1)
+    )
+    np.testing.assert_allclose(
+        paddle.max(x, axis=[0, 2]).numpy(), x.numpy().max(axis=(0, 2))
+    )
+    np.testing.assert_allclose(
+        x.sum(axis=-1, keepdim=True).numpy(), x.numpy().sum(-1, keepdims=True)
+    )
+
+
+def test_manipulation():
+    x = paddle.arange(12, dtype="float32")
+    y = paddle.reshape(x, [3, 4])
+    assert y.shape == [3, 4]
+    z = paddle.transpose(y, [1, 0])
+    assert z.shape == [4, 3]
+    c = paddle.concat([y, y], axis=0)
+    assert c.shape == [6, 4]
+    s = paddle.split(c, 3, axis=0)
+    assert len(s) == 3 and s[0].shape == [2, 4]
+    st = paddle.stack([y, y], axis=0)
+    assert st.shape == [2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(y, 0), 0).shape == [3, 4]
+    assert paddle.flatten(st, 1).shape == [2, 12]
+    assert paddle.tile(y, [2, 1]).shape == [6, 4]
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(20, dtype="float32").reshape(4, 5))
+    np.testing.assert_allclose(x[1].numpy(), np.arange(5, 10))
+    np.testing.assert_allclose(x[1:3, 2].numpy(), [7, 12])
+    np.testing.assert_allclose(x[:, -1].numpy(), [4, 9, 14, 19])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(paddle.gather(x, idx, axis=0).numpy(), x.numpy()[[0, 2]])
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+    x[0, 0] = 1.0
+    assert x.numpy()[0, 0] == 1.0
+
+
+def test_comparison_and_logic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    assert bool(paddle.allclose(a, a))
+    assert (a < b).stop_gradient
+
+
+def test_cast():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == paddle.int32
+    z = x.astype(paddle.bfloat16)
+    assert z.dtype == paddle.bfloat16
+
+
+def test_search_ops():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]])
+    np.testing.assert_array_equal(paddle.argmax(x, axis=1).numpy(), [0, 0])
+    v, i = paddle.topk(x, 2, axis=1)
+    np.testing.assert_allclose(v.numpy(), [[3, 2], [6, 5]])
+    np.testing.assert_array_equal(i.numpy(), [[0, 2], [0, 1]])
+    s = paddle.sort(x, axis=1)
+    np.testing.assert_allclose(s.numpy(), np.sort(x.numpy(), axis=1))
+
+
+def test_where_and_masked():
+    x = paddle.to_tensor([1.0, -2.0, 3.0])
+    y = paddle.where(x > 0, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(y.numpy(), [1, 0, 3])
+    m = paddle.masked_select(x, x > 0)
+    np.testing.assert_allclose(m.numpy(), [1, 3])
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3], dtype="int32").dtype == paddle.int32
+    np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+    np.testing.assert_allclose(paddle.arange(0, 10, 2).numpy(), [0, 2, 4, 6, 8])
+    np.testing.assert_allclose(np.diagonal(paddle.eye(3).numpy()), [1, 1, 1])
+    tri = paddle.tril(paddle.ones([3, 3]))
+    assert tri.numpy()[0, 2] == 0 and tri.numpy()[2, 0] == 1
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.rand([4, 4])
+    paddle.seed(42)
+    b = paddle.rand([4, 4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = paddle.rand([4, 4])
+    assert not np.allclose(b.numpy(), c.numpy())
+
+
+def test_inplace_guards():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.add_(paddle.to_tensor([1.0, 1.0]))
+    with paddle.no_grad():
+        x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+
+
+def test_einsum():
+    a = paddle.to_tensor(np.random.randn(2, 3).astype("float32"))
+    b = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    out = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
