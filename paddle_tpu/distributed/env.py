@@ -1,0 +1,42 @@
+"""Distributed environment state (minimal core; full topology in topology.py).
+
+Holds the process-level parallel context: rank/world size and — TPU-native —
+the active named-mesh axis used when a layer wants cross-replica collectives
+while being traced under shard_map (e.g. SyncBatchNorm's pmean over 'dp').
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Optional
+
+
+class _Env(threading.local):
+    def __init__(self):
+        self.sync_axis: Optional[str] = None
+
+
+_env = _Env()
+
+
+def current_sync_axis() -> Optional[str]:
+    return _env.sync_axis
+
+
+@contextlib.contextmanager
+def sync_axis_scope(axis: Optional[str]):
+    prev = _env.sync_axis
+    _env.sync_axis = axis
+    try:
+        yield
+    finally:
+        _env.sync_axis = prev
+
+
+def get_rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+
+
+def get_world_size() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))

@@ -1,0 +1,106 @@
+"""Gradient clipping (python/paddle/nn/clip.py parity).
+
+Clip objects transform a list of (param, grad) pairs; HybridParallelClipGrad
+(distributed) subclasses ClipGradByGlobalNorm to allreduce partial norms
+across mesh axes.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g.value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            v = g.value
+            norm = jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2))
+            scale = jnp.where(norm > self.clip_norm, self.clip_norm / norm, 1.0)
+            out.append((p, Tensor((v * scale).astype(v.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self.auto_skip_clip = auto_skip_clip
+
+    def _global_norm_sq(self, params_grads):
+        total = jnp.zeros((), jnp.float32)
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            v = g.value.astype(jnp.float32)
+            total = total + jnp.sum(v * v)
+        return total
+
+    def _dygraph_clip(self, params_grads):
+        total = self._global_norm_sq(params_grads)
+        global_norm = jnp.sqrt(total)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-6), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            v = g.value
+            out.append((p, Tensor((v.astype(jnp.float32) * scale).astype(v.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g.value)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.value.astype(jnp.float32)) ** norm_type) for g in grads]
+        )) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad = Tensor((p.grad.value * scale).astype(p.grad.value.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    for p in params:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad.value, -clip_value, clip_value))
+    return params

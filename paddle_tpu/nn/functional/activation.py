@@ -1,0 +1,205 @@
+"""Activation functionals (python/paddle/nn/functional/activation.py parity).
+
+Each is a differentiable wrapper over jax.nn / jnp — XLA fuses these into
+surrounding matmuls on TPU, so there are no hand-written activation kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import diff_op, unwrap
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "relu", "relu6", "relu_", "elu", "elu_", "selu", "celu", "gelu", "silu",
+    "swish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "leaky_relu", "log_sigmoid", "log_softmax",
+    "maxout", "prelu", "rrelu", "softmax", "softmax_", "softplus", "softsign",
+    "mish", "tanh", "tanh_", "thresholded_relu", "glu", "gumbel_softmax",
+]
+
+relu = diff_op(jax.nn.relu, "relu")
+relu_ = relu
+sigmoid = diff_op(jax.nn.sigmoid, "sigmoid")
+silu = diff_op(jax.nn.silu, "silu")
+softsign = diff_op(jax.nn.soft_sign, "softsign")
+tanh = diff_op(jnp.tanh, "tanh")
+tanh_ = tanh
+log_sigmoid = diff_op(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def relu6(x, name=None):
+    return apply_op(lambda v: jnp.clip(v, 0.0, 6.0), x, op_name="relu6")
+
+
+def elu(x, alpha: float = 1.0, name=None):
+    return apply_op(lambda v: jax.nn.elu(v, alpha), x, op_name="elu")
+
+
+elu_ = elu
+
+
+def selu(x, scale: float = 1.0507009873554805, alpha: float = 1.6732632423543772, name=None):
+    return apply_op(
+        lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+        x, op_name="selu",
+    )
+
+
+def celu(x, alpha: float = 1.0, name=None):
+    return apply_op(lambda v: jax.nn.celu(v, alpha), x, op_name="celu")
+
+
+def gelu(x, approximate: bool = False, name=None):
+    return apply_op(
+        lambda v: jax.nn.gelu(v, approximate=approximate), x, op_name="gelu"
+    )
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def hardsigmoid(x, slope: float = 0.1666667, offset: float = 0.5, name=None):
+    return apply_op(
+        lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x, op_name="hardsigmoid"
+    )
+
+
+def hardswish(x, name=None):
+    return apply_op(
+        lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x, op_name="hardswish"
+    )
+
+
+def hardtanh(x, min: float = -1.0, max: float = 1.0, name=None):
+    return apply_op(lambda v: jnp.clip(v, min, max), x, op_name="hardtanh")
+
+
+def hardshrink(x, threshold: float = 0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x, op_name="hardshrink"
+    )
+
+
+def softshrink(x, threshold: float = 0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)),
+        x, op_name="softshrink",
+    )
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda v: v - jnp.tanh(v), x, op_name="tanhshrink")
+
+
+def leaky_relu(x, negative_slope: float = 0.01, name=None):
+    return apply_op(
+        lambda v: jax.nn.leaky_relu(v, negative_slope), x, op_name="leaky_relu"
+    )
+
+
+def log_softmax(x, axis: int = -1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+
+            v = v.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply_op(f, x, op_name="log_softmax")
+
+
+def softmax(x, axis: int = -1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+
+            v = v.astype(convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply_op(f, x, op_name="softmax")
+
+
+softmax_ = softmax
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0, name=None):
+    return apply_op(
+        lambda v: jnp.where(
+            beta * v > threshold, v, (1.0 / beta) * jnp.log1p(jnp.exp(beta * v))
+        ),
+        x, op_name="softplus",
+    )
+
+
+def mish(x, name=None):
+    return apply_op(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x, op_name="mish")
+
+
+def thresholded_relu(x, threshold: float = 1.0, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v, 0.0), x, op_name="thresholded_relu"
+    )
+
+
+def maxout(x, groups: int, axis: int = 1, name=None):
+    def f(v):
+        ax = axis if axis >= 0 else v.ndim + axis
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (groups, c // groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax)
+
+    return apply_op(f, x, op_name="maxout")
+
+
+def prelu(x, weight, data_format: str = "NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        ax = 1 if data_format in ("NCHW", "NCL", "NCDHW") else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ax] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+
+    return apply_op(f, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower: float = 0.125, upper: float = 0.3333333, training: bool = False, name=None):
+    if training:
+        from ...core.random import default_generator
+
+        k = default_generator.next_key()
+
+        def f(v):
+            a = jax.random.uniform(k, v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, a * v)
+
+        return apply_op(f, x, op_name="rrelu")
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def glu(x, axis: int = -1, name=None):
+    return apply_op(lambda v: jax.nn.glu(v, axis=axis), x, op_name="glu")
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False, axis: int = -1, name=None):
+    from ...core.random import default_generator
+
+    k = default_generator.next_key()
+
+    def f(v):
+        g = -jnp.log(-jnp.log(jax.random.uniform(k, v.shape, v.dtype, 1e-20, 1.0)))
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            onehot = jax.nn.one_hot(
+                jnp.argmax(y, axis=axis), y.shape[axis], axis=axis, dtype=y.dtype
+            )
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+
+    return apply_op(f, x, op_name="gumbel_softmax")

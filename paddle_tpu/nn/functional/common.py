@@ -1,0 +1,343 @@
+"""Common functionals: linear/dropout/embedding/pad/interpolate/one_hot...
+
+Parity surface: python/paddle/nn/functional/common.py + input.py.
+Everything lowers to lax ops XLA maps onto the MXU/VPU; dropout uses the
+functional PRNG stream (core/random.py) so it stays jit-traceable.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.random import default_generator
+from ...core.tensor import Tensor
+from ...ops._helpers import unwrap
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "one_hot", "pad", "zeropad2d", "interpolate", "upsample", "bilinear",
+    "cosine_similarity", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+    "label_smooth", "class_center_sample", "unfold", "fold",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W is [in, out] (reference: nn/functional/common.py linear).
+
+    The matmul is the MXU hot path — keep operands' trailing dims contiguous
+    and let XLA pick the tiling.
+    """
+    if bias is None:
+        return apply_op(lambda v, w: v @ w, x, weight, op_name="linear")
+    return apply_op(lambda v, w, b: v @ w + b, x, weight, bias, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training: bool = True, mode: str = "upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op(lambda v: v * (1.0 - p), x, op_name="dropout_infer")
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    k = default_generator.next_key()
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(k, keep, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(mask, v / keep, 0.0).astype(v.dtype)
+        return jnp.where(mask, v, 0.0).astype(v.dtype)
+
+    return apply_op(f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training: bool = True, data_format: str = "NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training: bool = True, data_format: str = "NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training: bool = True, name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    k = default_generator.next_key()
+
+    def f(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = 1.0 - p
+        a = (keep + alpha_p**2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(k, keep, v.shape)
+        return (a * jnp.where(mask, v, alpha_p) + b).astype(v.dtype)
+
+    return apply_op(f, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse: bool = False, name=None):
+    """Gather rows; padding_idx rows get zero grad (reference lookup_table_v2)."""
+
+    def f(w, ids):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            pid = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (ids == pid)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    ids = unwrap(x)
+    return apply_op(lambda w: f(w, ids), weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    v = unwrap(x)
+    return Tensor(jax.nn.one_hot(v, num_classes, dtype=jnp.float32))
+
+
+def _pad_width(pad_list, ndim, data_format):
+    """paddle pad format: [left, right] pairs starting from the LAST spatial dim."""
+    n = len(pad_list) // 2
+    pw = [(0, 0)] * ndim
+    # paddle order: pads apply to dims from last to first (W, H, D)
+    if data_format.startswith("NC"):
+        spatial = list(range(2, ndim))
+    else:
+        spatial = list(range(1, ndim - 1))
+    for i in range(n):
+        dim = spatial[-(i + 1)]
+        pw[dim] = (int(pad_list[2 * i]), int(pad_list[2 * i + 1]))
+    return pw
+
+
+def pad(x, pad, mode: str = "constant", value: float = 0.0,
+        data_format: str = "NCHW", pad_from_left_axis: bool = False, name=None):
+    pad_list = [int(p) for p in (pad.tolist() if isinstance(pad, Tensor) else pad)]
+
+    def f(v):
+        if len(pad_list) == 2 * v.ndim:
+            pw = [(pad_list[2 * i], pad_list[2 * i + 1]) for i in range(v.ndim)]
+        else:
+            pw = _pad_width(pad_list, v.ndim, data_format)
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, pw, mode="constant", constant_values=value)
+        return jnp.pad(v, pw, mode=jmode)
+
+    return apply_op(f, x, op_name="pad")
+
+
+def zeropad2d(x, padding, data_format: str = "NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = unwrap(prior_dist)
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+
+    return apply_op(f, label, op_name="label_smooth")
+
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply_op(f, x1, x2, op_name="cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bi):
+        # w: [out, in1, in2]
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply_op(f, *args, op_name="bilinear")
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply_op(f, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+
+    return apply_op(f, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups: int, data_format: str = "NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return apply_op(f, x, op_name="channel_shuffle")
+
+
+def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
+                align_corners: bool = False, align_mode: int = 0,
+                data_format: str = "NCHW", name=None):
+    """Resize via jax.image (reference: nn/functional/common.py interpolate)."""
+    mode = mode.lower()
+    jax_method = {"nearest": "nearest", "bilinear": "bilinear",
+                  "trilinear": "trilinear", "bicubic": "bicubic",
+                  "linear": "linear", "area": "linear"}[mode]
+
+    def f(v):
+        channel_last = not data_format.startswith("NC")
+        nd = v.ndim - 2
+        if channel_last:
+            spatial = v.shape[1:-1]
+        else:
+            spatial = v.shape[2:]
+        if size is not None:
+            out_sp = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+            out_sp = [int(s * float(unwrap(f_))) for s, f_ in zip(spatial, sf)]
+        if channel_last:
+            out_shape = (v.shape[0], *out_sp, v.shape[-1])
+        else:
+            out_shape = (v.shape[0], v.shape[1], *out_sp)
+        if mode == "nearest":
+            return jax.image.resize(v, out_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate with explicit gather
+            return _resize_align_corners(v, out_shape, jax_method, channel_last)
+        return jax.image.resize(v, out_shape, method=jax_method)
+
+    return apply_op(f, x, op_name="interpolate")
+
+
+def _resize_align_corners(v, out_shape, method, channel_last):
+    import numpy as np
+
+    if channel_last:
+        in_sp = v.shape[1:-1]
+        out_sp = out_shape[1:-1]
+        sp_axes = list(range(1, v.ndim - 1))
+    else:
+        in_sp = v.shape[2:]
+        out_sp = out_shape[2:]
+        sp_axes = list(range(2, v.ndim))
+    out = v
+    for ax, insz, outsz in zip(sp_axes, in_sp, out_sp):
+        if outsz == 1 or insz == 1:
+            idx = jnp.zeros((outsz,), jnp.float32)
+        else:
+            idx = jnp.arange(outsz, dtype=jnp.float32) * (insz - 1) / (outsz - 1)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, insz - 1)
+        w = (idx - lo).astype(v.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = outsz
+        w = w.reshape(shape)
+        out = (jnp.take(out, lo, axis=ax) * (1 - w)
+               + jnp.take(out, hi, axis=ax) * w)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference unfold op): [N,C,H,W] → [N, C*kh*kw, L]."""
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]  # [ph, pw] -> [top,left,bottom,right]? paddle: [h,w] sym
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=k, window_strides=s, padding="VALID",
+            rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # [N, C*kh*kw, oh, ow]
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return apply_op(f, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im — the VJP of unfold; implemented as transpose of the patch op."""
+    out_sz = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (k[0] * k[1])
+        zeros = jnp.zeros((n, c, out_sz[0], out_sz[1]), v.dtype)
+
+        def unfold_fn(img):
+            return unfold(Tensor(img), kernel_sizes, strides, paddings, dilations).value
+
+        _, vjp = jax.vjp(unfold_fn, zeros)
+        (out,) = vjp(v)
+        return out
+
+    return apply_op(f, x, op_name="fold")
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample is PartialFC-specific; planned with parallel margin loss"
+    )

@@ -1,0 +1,186 @@
+"""Convolution functionals over ``lax.conv_general_dilated``.
+
+Parity: python/paddle/nn/functional/conv.py (reference kernels:
+phi/kernels/gpu/conv_kernel.cu + cudnn autotuning). On TPU, XLA lowers
+conv_general_dilated straight onto the MXU — algorithm choice, layout
+(NCHW→XLA-internal), and fusion are the compiler's job, so there is no
+cudnn-workspace/autotune machinery to rebuild.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.autograd import apply_op
+from ...ops._helpers import unwrap
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _resolve_padding(padding, nd, strides, dilations, kernel):
+    """paddle padding: int | list | 'SAME' | 'VALID'. Returns lax-style pairs or str."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        p = [int(x) for x in padding]
+        if len(p) == nd:
+            return [(x, x) for x in p]
+        if len(p) == 2 * nd:
+            # [before0, after0, before1, after1, ...] paddle allows both
+            return [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        if len(p) == 1:
+            return [(p[0], p[0])] * nd
+    return [(int(padding), int(padding))] * nd
+
+
+def _dim_numbers(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    channel_last = not data_format.startswith("NC")
+    strides = _ntuple(stride, nd)
+    dilations = _ntuple(dilation, nd)
+    dn = _dim_numbers(nd, channel_last)
+    pad = _resolve_padding(padding, nd, strides, dilations, None)
+
+    def f(v, w, *b):
+        # paddle weight layout is [out_c, in_c/groups, *k] = OI... always
+        if channel_last:
+            perm = tuple(range(2, 2 + nd)) + (1, 0)  # OIHW -> HWIO
+            w_ = jnp.transpose(w, perm)
+        else:
+            w_ = w
+        out = lax.conv_general_dilated(
+            v, w_, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = (x, weight) + (() if bias is None else (bias,))
+    return apply_op(f, *args, op_name=f"conv{nd}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
+                    dilation, nd, data_format, output_size):
+    channel_last = not data_format.startswith("NC")
+    strides = _ntuple(stride, nd)
+    dilations = _ntuple(dilation, nd)
+    out_pad = _ntuple(output_padding, nd) if output_padding is not None else (0,) * nd
+    dn = _dim_numbers(nd, channel_last)
+
+    def f(v, w, *b):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *k] (IO...)
+        kdims = w.shape[2:]
+        pad_cfg = _resolve_padding(padding, nd, strides, dilations, kdims)
+        if isinstance(pad_cfg, str):
+            if pad_cfg == "SAME":
+                pads = []
+                for i in range(nd):
+                    eff_k = (kdims[i] - 1) * dilations[i] + 1
+                    total = eff_k - strides[i] if eff_k > strides[i] else 0
+                    pads.append((total // 2, total - total // 2))
+                pad_cfg = pads
+            else:
+                pad_cfg = [(0, 0)] * nd
+        # grad-of-conv formulation: lax.conv_transpose handles fractional stride
+        trans_pads = []
+        for i in range(nd):
+            lo, hi = pad_cfg[i]
+            eff_k = (kdims[i] - 1) * dilations[i] + 1
+            trans_pads.append((eff_k - 1 - lo, eff_k - 1 - hi + out_pad[i]))
+        if groups > 1:
+            # split channels; lax.conv_transpose has no feature_group_count
+            in_per_g = v.shape[-1 if channel_last else 1] // groups
+            outs = []
+            for g in range(groups):
+                if channel_last:
+                    vg = v[..., g * in_per_g:(g + 1) * in_per_g]
+                else:
+                    vg = v[:, g * in_per_g:(g + 1) * in_per_g]
+                wg = w[g * in_per_g:(g + 1) * in_per_g]
+                outs.append(_one_transpose(vg, wg, strides, trans_pads, dilations, dn, channel_last, nd))
+            out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+        else:
+            out = _one_transpose(v, w, strides, trans_pads, dilations, dn, channel_last, nd)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = (x, weight) + (() if bias is None else (bias,))
+    return apply_op(f, *args, op_name=f"conv{nd}d_transpose")
+
+
+def _one_transpose(v, w, strides, pads, dilations, dn, channel_last, nd):
+    # Use input-dilated conv: insert (stride-1) zeros between input elements,
+    # then convolve with the spatially-flipped kernel at stride 1.
+    # w layout IO...: [in_c, out_c, *k] → conv kernel [out_c, in_c, *k] flipped.
+    flip_axes = tuple(range(2, 2 + nd))
+    w_conv = jnp.flip(jnp.swapaxes(w, 0, 1), flip_axes)  # OI...k flipped
+    if channel_last:
+        perm = tuple(range(2, 2 + nd)) + (1, 0)
+        w_conv = jnp.transpose(w_conv, perm)
+    return lax.conv_general_dilated(
+        v, w_conv, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=strides, rhs_dilation=dilations, dimension_numbers=dn,
+    )
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 1, fmt, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 3, data_format, output_size)

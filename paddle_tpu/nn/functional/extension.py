@@ -1,0 +1,170 @@
+"""Misc functionals: distance, masks, vision warps, temporal shift.
+
+Parity: python/paddle/nn/functional/{distance,extension,vision}.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from ...ops._helpers import unwrap
+
+__all__ = [
+    "pairwise_distance", "pdist", "sequence_mask", "diag_embed",
+    "temporal_shift", "affine_grid", "grid_sample", "npair_loss",
+]
+
+
+def pairwise_distance(x, y, p: float = 2.0, epsilon: float = 1e-6,
+                      keepdim: bool = False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply_op(f, x, y, op_name="pairwise_distance")
+
+
+def pdist(x, p: float = 2.0, name=None):
+    def f(v):
+        n = v.shape[0]
+        diff = v[:, None, :] - v[None, :, :]
+        d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+
+    return apply_op(f, x, op_name="pdist")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    v = unwrap(x)
+    ml = int(maxlen) if maxlen is not None else int(jnp.max(v))
+    from ...core.dtype import convert_dtype
+
+    out = (jnp.arange(ml) < v[..., None]).astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def diag_embed(input, offset: int = 0, dim1: int = -2, dim2: int = -1):
+    def f(v):
+        last = v.shape[-1]
+        size = last + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (size, size), v.dtype)
+        idx = jnp.arange(last)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+            # insert the two diag dims at requested positions
+            order = {}
+            order[d1] = nd - 2
+            order[d2] = nd - 1
+            rest = iter(perm)
+            final = [order[i] if i in order else next(rest) for i in range(nd)]
+            out = jnp.transpose(out, final)
+        return out
+
+    return apply_op(f, input, op_name="diag_embed")
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25,
+                   data_format: str = "NCHW", name=None):
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+        keep = v[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op(f, x, op_name="temporal_shift")
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True, name=None):
+    shape = [int(unwrap(s)) for s in out_shape]
+
+    def f(th):
+        n, _, h, w = shape if len(shape) == 4 else (shape[0], shape[1], shape[2], shape[3])
+
+        def coords(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+        ys = coords(h)
+        xs = coords(w)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [h*w, 3]
+        out = jnp.einsum("nij,pj->npi", th.astype(jnp.float32), base)
+        return out.reshape(n, h, w, 2).astype(th.dtype)
+
+    return apply_op(f, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode: str = "bilinear", padding_mode: str = "zeros",
+                align_corners: bool = True, name=None):
+    def f(v, g):
+        n, c, h, w = v.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+            cx = jnp.clip(ix, 0, w - 1)
+            cy = jnp.clip(iy, 0, h - 1)
+            out = v[jnp.arange(n)[:, None, None], :, cy, cx]  # [n, gh, gw, c]
+            if padding_mode == "zeros":
+                out = jnp.where(valid[..., None], out, 0.0)
+            return out
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx).astype(jnp.int32), jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - fx) * (y1 - fy)
+            wb = (x1 - fx) * (fy - y0)
+            wc = (fx - x0) * (y1 - fy)
+            wd = (fx - x0) * (fy - y0)
+            out = (sample(x0, y0) * wa[..., None] + sample(x0, y1) * wb[..., None]
+                   + sample(x1, y0) * wc[..., None] + sample(x1, y1) * wd[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2))  # back to NCHW
+
+    return apply_op(f, x, grid, op_name="grid_sample")
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    lbl = unwrap(labels)
+
+    def f(a, p):
+        l2 = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / a.shape[0]
+        sim = a @ p.T
+        y = (lbl[:, None] == lbl[None, :]).astype(sim.dtype)
+        y = y / jnp.sum(y, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(y * logp, axis=1))
+        return ce + l2
+
+    return apply_op(f, anchor, positive, op_name="npair_loss")

@@ -1,0 +1,392 @@
+"""Loss functionals.
+
+Parity: python/paddle/nn/functional/loss.py (reference kernels:
+phi/kernels/gpu/cross_entropy_kernel.cu, funcs/cross_entropy.cu).
+cross_entropy fuses log_softmax+NLL the way the reference's
+softmax_with_cross_entropy kernel does — one traced graph, XLA fuses it.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from ...ops._helpers import unwrap
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "l1_loss", "mse_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "ctc_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "sigmoid_focal_loss", "dice_loss", "log_loss",
+    "square_error_cost", "poisson_nll_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(v, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index: int = -100,
+                  reduction: str = "mean", soft_label: bool = False, axis: int = -1,
+                  use_softmax: bool = True, label_smoothing: float = 0.0, name=None):
+    lbl = unwrap(label)
+    w = unwrap(weight) if weight is not None else None
+
+    def f(logits):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            tgt = lbl.astype(logp.dtype)
+            if label_smoothing > 0.0:
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / n_classes
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            mask = None
+        else:
+            ids = lbl
+            if ids.ndim == logits.ndim:  # trailing 1 dim
+                ids = jnp.squeeze(ids, axis)
+            mask = ids != ignore_index
+            safe = jnp.where(mask, ids, 0).astype(jnp.int32)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis
+            ).squeeze(axis)
+            if label_smoothing > 0.0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = -jnp.where(mask, picked, 0.0)
+            if w is not None:
+                wsel = jnp.where(mask, jnp.take(w, safe), 0.0)
+                loss = loss * wsel
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean" and mask is not None:
+            denom = jnp.maximum(jnp.sum(mask.astype(logp.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               ignore_index: int = -100, numeric_stable_mode: bool = True,
+                               return_softmax: bool = False, axis: int = -1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # reference keeps a trailing dim
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction: str = "mean", name=None):
+    lbl = unwrap(label)
+    w = unwrap(weight) if weight is not None else None
+
+    def f(p):
+        eps = 1e-12
+        loss = -(lbl * jnp.log(jnp.maximum(p, eps))
+                 + (1 - lbl) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction: str = "mean",
+                                     pos_weight=None, name=None):
+    lbl = unwrap(label)
+    w = unwrap(weight) if weight is not None else None
+    pw = unwrap(pos_weight) if pos_weight is not None else None
+
+    def f(z):
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)); pos_weight scales the y term
+        base = jnp.maximum(z, 0) - z * lbl + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            log_weight = 1 + (pw - 1) * lbl
+            base = jnp.maximum(z, 0) - z * lbl + log_weight * jnp.log1p(jnp.exp(-jnp.abs(z)))
+            # full form: loss = (1-y)z + log_weight*(log(1+exp(-|z|)) + max(-z,0))
+            base = (1 - lbl) * z + log_weight * (jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(-z, 0))
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+
+    return apply_op(f, logit, op_name="bce_with_logits")
+
+
+def nll_loss(input, label, weight=None, ignore_index: int = -100,
+             reduction: str = "mean", name=None):
+    lbl = unwrap(label)
+    w = unwrap(weight) if weight is not None else None
+
+    def f(logp):
+        mask = lbl != ignore_index
+        safe = jnp.where(mask, lbl, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = -jnp.where(mask, picked, 0.0)
+        if w is not None:
+            wsel = jnp.where(mask, jnp.take(w, safe), 0.0)
+            loss = loss * wsel
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(mask.astype(logp.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, op_name="nll_loss")
+
+
+def l1_loss(input, label, reduction: str = "mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    input, label, op_name="l1_loss")
+
+
+def mse_loss(input, label, reduction: str = "mean", name=None):
+    return apply_op(lambda a, b: _reduce((a - b) ** 2, reduction),
+                    input, label, op_name="mse_loss")
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: (a - b) ** 2, input, label, op_name="square_error_cost")
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0, name=None):
+    def f(a, b):
+        d = a - b
+        abs_d = jnp.abs(d)
+        loss = jnp.where(abs_d < delta, 0.5 * d * d, delta * (abs_d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, op_name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction: str = "mean", log_target: bool = False, name=None):
+    def f(logp, tgt):
+        if log_target:
+            loss = jnp.exp(tgt) * (tgt - logp)
+        else:
+            loss = jnp.where(tgt > 0, tgt * (jnp.log(jnp.maximum(tgt, 1e-12)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean", name=None):
+    def f(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0, reduction: str = "mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin: float = 0.0,
+                          reduction: str = "mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def soft_margin_loss(input, label, reduction: str = "mean", name=None):
+    def f(a, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * a)), reduction)
+
+    return apply_op(f, input, label, op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction: str = "mean", name=None):
+    w = unwrap(weight) if weight is not None else None
+
+    def f(z, y):
+        loss = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        if w is not None:
+            loss = loss * w
+        loss = jnp.mean(loss, axis=-1)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, op_name="multi_label_soft_margin_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin: float = 1.0, p: float = 2.0,
+                        epsilon: float = 1e-6, swap: bool = False,
+                        reduction: str = "mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p + epsilon, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p + epsilon, -1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p + epsilon, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(f, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None,
+                                      margin: float = 1.0, swap: bool = False,
+                                      reduction: str = "mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        from ...ops.math import minimum
+
+        dn = minimum(dn, distance_function(positive, negative))
+
+    def f(dpv, dnv):
+        return _reduce(jnp.maximum(dpv - dnv + margin, 0.0), reduction)
+
+    return apply_op(f, dp, dn, op_name="triplet_margin_with_distance_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum", name=None):
+    norm = unwrap(normalizer) if normalizer is not None else None
+
+    def f(z, y):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        loss = ce * ((1 - p_t) ** gamma)
+        if alpha >= 0:
+            a_t = alpha * y + (1 - alpha) * (1 - y)
+            loss = a_t * loss
+        if norm is not None:
+            loss = loss / norm
+        return _reduce(loss, reduction)
+
+    return apply_op(f, logit, label, op_name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon: float = 1e-5, name=None):
+    lbl = unwrap(label)
+
+    def f(p):
+        y = jax.nn.one_hot(jnp.squeeze(lbl, -1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y, axis=reduce_dims)
+        dice = (2 * inter + epsilon) / (union + epsilon)
+        return jnp.mean(1 - dice)
+
+    return apply_op(f, input, op_name="dice_loss")
+
+
+def log_loss(input, label, epsilon: float = 1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return apply_op(f, input, label, op_name="log_loss")
+
+
+def poisson_nll_loss(input, label, log_input: bool = True, full: bool = False,
+                     epsilon: float = 1e-8, reduction: str = "mean", name=None):
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, op_name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full: bool = False,
+                      epsilon: float = 1e-6, reduction: str = "mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi, mu.dtype))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, variance, op_name="gaussian_nll_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
+             reduction: str = "mean", norm_by_times: bool = False):
+    """CTC via the standard alpha-recursion in log space, vectorized with
+    lax.scan over time (reference: warpctc; here it is a traced XLA program)."""
+    lbl = unwrap(labels)
+    in_len = unwrap(input_lengths)
+    lb_len = unwrap(label_lengths)
+
+    def f(lp):
+        # lp: [T, B, C] log-probs (paddle layout: max_logit_length, batch, classes)
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        L = 2 * lb_len.astype(jnp.int32) + 1  # extended lengths
+
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf, lp.dtype)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lbl = lp[0][jnp.arange(B), ext[:, 1]]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lb_len > 0, first_lbl, neg_inf))
+
+        same = jnp.pad(ext[:, 2:] == ext[:, :-2], ((0, 0), (2, 0)),
+                       constant_values=True)
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=-1e30)
+            a_shift2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=-1e30)
+            a_shift2 = jnp.where(same, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = lp_t[jnp.arange(B)[:, None], ext]
+            return merged + emit, None
+
+        def scan_step(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            # only advance while t < input_length
+            keep = (t < in_len)[:, None]
+            return jnp.where(keep, new_alpha, alpha), None
+
+        alpha, _ = jax.lax.scan(scan_step, alpha0, jnp.arange(1, T))
+        idx_last = jnp.clip(L - 1, 0, 2 * S)
+        idx_prev = jnp.clip(L - 2, 0, 2 * S)
+        ll = jnp.logaddexp(
+            alpha[jnp.arange(B), idx_last], alpha[jnp.arange(B), idx_prev]
+        )
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lb_len.astype(lp.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, log_probs, op_name="ctc_loss")

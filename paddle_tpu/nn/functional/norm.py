@@ -1,0 +1,164 @@
+"""Normalization functionals.
+
+Parity: python/paddle/nn/functional/norm.py (reference kernels:
+phi/kernels/gpu/batch_norm_kernel.cu, layer_norm_kernel.cu). Plain jnp
+reductions — XLA fuses mean/var/scale/shift into one pass on TPU.
+batch_norm running-stat update happens eagerly on the module side.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from ...ops._helpers import unwrap
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "normalize"]
+
+
+def normalize(x, p: float = 2, axis: int = 1, epsilon: float = 1e-12, name=None):
+    def f(v):
+        norm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(norm, epsilon)
+
+    return apply_op(f, x, op_name="normalize")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9, epsilon: float = 1e-5,
+               data_format: str = "NCHW", use_global_stats=None, name=None):
+    """Functional batch norm. In training mode, updates running stats in-place
+    on the provided Tensors (matching reference mutable-state semantics)."""
+    channel_ax = 1 if data_format.startswith("NC") or data_format == "NC" else -1
+    if use_global_stats is None:
+        use_global_stats = not training
+
+    def stats_axes(ndim):
+        return tuple(i for i in range(ndim) if i != (channel_ax % ndim))
+
+    if training and not use_global_stats:
+        xv = unwrap(x)
+        axes = stats_axes(xv.ndim)
+        batch_mean = jnp.mean(xv, axis=axes)
+        batch_var = jnp.var(xv, axis=axes)
+        # running-stat update (reference: phi batch_norm updates with momentum)
+        if isinstance(running_mean, Tensor):
+            running_mean.set_value(momentum * running_mean.value + (1 - momentum) * batch_mean)
+            running_var.set_value(momentum * running_var.value + (1 - momentum) * batch_var)
+
+        def f(v, *wb):
+            shape = [1] * v.ndim
+            shape[channel_ax % v.ndim] = v.shape[channel_ax % v.ndim]
+            m = jnp.mean(v, axis=axes).reshape(shape)
+            var = jnp.var(v, axis=axes).reshape(shape)
+            out = (v - m) * jax.lax.rsqrt(var + epsilon)
+            if wb:
+                out = out * wb[0].reshape(shape) + wb[1].reshape(shape)
+            return out
+    else:
+        rm, rv = unwrap(running_mean), unwrap(running_var)
+
+        def f(v, *wb):
+            shape = [1] * v.ndim
+            shape[channel_ax % v.ndim] = v.shape[channel_ax % v.ndim]
+            out = (v - rm.reshape(shape)) * jax.lax.rsqrt(rv.reshape(shape) + epsilon)
+            if wb:
+                out = out * wb[0].reshape(shape) + wb[1].reshape(shape)
+            return out
+
+    if weight is not None:
+        return apply_op(f, x, weight, bias, op_name="batch_norm")
+    return apply_op(f, x, op_name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon: float = 1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(tuple(normalized_shape))
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(var + epsilon)
+        if wb:
+            w = wb[0]
+            out = out * w
+            if len(wb) > 1 and wb[1] is not None:
+                out = out + wb[1]
+        return out
+
+    if weight is not None and bias is not None:
+        return apply_op(f, x, weight, bias, op_name="layer_norm")
+    if weight is not None:
+        return apply_op(f, x, weight, op_name="layer_norm")
+    return apply_op(f, x, op_name="layer_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats: bool = True, momentum: float = 0.9,
+                  eps: float = 1e-5, data_format: str = "NCHW", name=None):
+    def f(v, *wb):
+        axes = tuple(range(2, v.ndim))  # per-sample, per-channel spatial stats
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(var + eps)
+        if wb:
+            shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+            out = out * wb[0].reshape(shape) + wb[1].reshape(shape)
+        return out
+
+    if weight is not None:
+        return apply_op(f, x, weight, bias, op_name="instance_norm")
+    return apply_op(f, x, op_name="instance_norm")
+
+
+def group_norm(x, num_groups: int, epsilon: float = 1e-5, weight=None, bias=None,
+               data_format: str = "NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
+    def f(v, *wb):
+        if channel_last:
+            v_ = jnp.moveaxis(v, -1, 1)
+        else:
+            v_ = v
+        n, c = v_.shape[0], v_.shape[1]
+        rest = v_.shape[2:]
+        g = v_.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(var + epsilon)).reshape(v_.shape)
+        if wb:
+            shape = [1, c] + [1] * (v_.ndim - 2)
+            out = out * wb[0].reshape(shape) + wb[1].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    if weight is not None:
+        return apply_op(f, x, weight, bias, op_name="group_norm")
+    return apply_op(f, x, op_name="group_norm")
+
+
+def local_response_norm(x, size: int, alpha: float = 1e-4, beta: float = 0.75,
+                        k: float = 1.0, data_format: str = "NCHW", name=None):
+    # paddle formula: out = x / (k + alpha/size * sum(x^2))^beta
+    def f2(v):
+        sq = v * v
+        half = size // 2
+        ch_ax = 1 if data_format.startswith("NC") else v.ndim - 1
+        pad_width = [(0, 0)] * v.ndim
+        pad_width[ch_ax] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pad_width)
+        window = [1] * v.ndim
+        window[ch_ax] = size
+        s = jax.lax.reduce_window(
+            padded, 0.0, jax.lax.add, window, [1] * v.ndim, "VALID"
+        )
+        return v / (k + (alpha / size) * s) ** beta
+
+    return apply_op(f2, x, op_name="local_response_norm")

@@ -1,0 +1,288 @@
+"""Pooling functionals over ``lax.reduce_window``.
+
+Parity: python/paddle/nn/functional/pooling.py (reference:
+phi/kernels/funcs/pooling.cu). reduce_window is XLA's native windowed
+reduction — maps directly to the VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from ...ops._helpers import unwrap
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _window_pads(padding, nd, ksize, strides, in_shape, ceil_mode):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _ntuple(padding, nd) if not (isinstance(padding, (list, tuple)) and len(padding) == 2 * nd) \
+        else None
+    if p is not None:
+        pads = [(x, x) for x in p]
+    else:
+        pl = [int(x) for x in padding]
+        pads = [(pl[2 * i], pl[2 * i + 1]) for i in range(nd)]
+    if ceil_mode:
+        # extend right pad so the last partial window is included
+        new = []
+        for i, (lo, hi) in enumerate(pads):
+            size = in_shape[i] + lo + hi
+            rem = (size - ksize[i]) % strides[i]
+            extra = (strides[i] - rem) % strides[i] if rem != 0 else 0
+            new.append((lo, hi + extra))
+        pads = new
+    return pads
+
+
+def _pool(x, ksize, strides, padding, nd, data_format, kind, ceil_mode=False,
+          exclusive=True, divisor_override=None):
+    channel_last = not data_format.startswith("NC")
+    k = _ntuple(ksize, nd)
+    s = _ntuple(strides if strides is not None else ksize, nd)
+
+    def f(v):
+        sp_off = 1 if channel_last else 2
+        in_sp = v.shape[sp_off:sp_off + nd]
+        pads = _window_pads(padding, nd, k, s, in_sp, ceil_mode)
+        window = [1] * v.ndim
+        stride_full = [1] * v.ndim
+        for i in range(nd):
+            window[sp_off + i] = k[i]
+            stride_full[sp_off + i] = s[i]
+        if isinstance(pads, str):
+            pad_full = pads
+        else:
+            pad_full = [(0, 0)] * v.ndim
+            for i in range(nd):
+                pad_full[sp_off + i] = pads[i]
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return lax.reduce_window(v, init, lax.max, window, stride_full, pad_full)
+        # avg
+        summed = lax.reduce_window(v, 0.0, lax.add, window, stride_full, pad_full)
+        if divisor_override is not None:
+            return summed / divisor_override
+        if exclusive and not isinstance(pad_full, str):
+            ones = jnp.ones(v.shape, v.dtype)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, stride_full, pad_full)
+            return summed / counts
+        denom = 1
+        for i in range(nd):
+            denom *= k[i]
+        return summed / denom
+
+    return apply_op(f, x, op_name=f"{kind}_pool{nd}d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCW", "avg", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg",
+                 ceil_mode, exclusive, divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg",
+                 ceil_mode, exclusive, divisor_override)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "NCW", "max", ceil_mode)
+    if return_mask:
+        return out, _max_mask(x, out, kernel_size, stride, padding, 1, "NCW")
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode)
+    if return_mask:
+        return out, _max_mask(x, out, kernel_size, stride, padding, 2, data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode)
+    if return_mask:
+        return out, _max_mask(x, out, kernel_size, stride, padding, 3, data_format)
+    return out
+
+
+def _max_mask(x, out, ksize, strides, padding, nd, data_format):
+    """Flat spatial argmax indices per window (reference max_pool_with_index)."""
+    channel_last = not data_format.startswith("NC")
+    k = _ntuple(ksize, nd)
+    s = _ntuple(strides if strides is not None else ksize, nd)
+    v = unwrap(x)
+    sp_off = 1 if channel_last else 2
+    in_sp = v.shape[sp_off:sp_off + nd]
+    flat_idx = jnp.arange(int(jnp.prod(jnp.asarray(in_sp))), dtype=jnp.int32).reshape(in_sp)
+    bshape = [1] * v.ndim
+    for i in range(nd):
+        bshape[sp_off + i] = in_sp[i]
+    flat_idx = jnp.broadcast_to(flat_idx.reshape(bshape), v.shape)
+
+    pads = _window_pads(padding, nd, k, s, in_sp, False)
+    window = [1] * v.ndim
+    stride_full = [1] * v.ndim
+    for i in range(nd):
+        window[sp_off + i] = k[i]
+        stride_full[sp_off + i] = s[i]
+    if isinstance(pads, str):
+        pad_full = pads
+    else:
+        pad_full = [(0, 0)] * v.ndim
+        for i in range(nd):
+            pad_full[sp_off + i] = pads[i]
+
+    def select(a, b):
+        av, ai = a
+        bv, bi = b
+        pick = av >= bv
+        return jnp.where(pick, av, bv), jnp.where(pick, ai, bi)
+
+    init_v = jnp.asarray(-jnp.inf, v.dtype) if jnp.issubdtype(v.dtype, jnp.floating) \
+        else jnp.asarray(jnp.iinfo(v.dtype).min, v.dtype)
+    vals, idxs = lax.reduce_window(
+        (v, flat_idx), (init_v, jnp.asarray(-1, jnp.int32)),
+        select, window, stride_full, pad_full,
+    )
+    return Tensor(idxs)
+
+
+def _adaptive_windows(in_sz, out_sz):
+    import numpy as np
+
+    starts = (np.arange(out_sz) * in_sz) // out_sz
+    ends = -(-((np.arange(out_sz) + 1) * in_sz) // out_sz)  # ceil div
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, nd, data_format, kind):
+    channel_last = not data_format.startswith("NC")
+    out_sp = _ntuple(output_size, nd)
+
+    def f(v):
+        sp_off = 1 if channel_last else 2
+        res = v
+        for i in range(nd):
+            ax = sp_off + i
+            insz = res.shape[ax]
+            outsz = out_sp[i]
+            if outsz == insz:
+                continue
+            if insz % outsz == 0:
+                # uniform windows: reshape-reduce (fast path, static)
+                kwin = insz // outsz
+                new_shape = res.shape[:ax] + (outsz, kwin) + res.shape[ax + 1:]
+                r = res.reshape(new_shape)
+                res = jnp.max(r, axis=ax + 1) if kind == "max" else jnp.mean(r, axis=ax + 1)
+            else:
+                starts, ends = _adaptive_windows(insz, outsz)
+                pieces = []
+                for j in range(outsz):
+                    sl = [slice(None)] * res.ndim
+                    sl[ax] = slice(int(starts[j]), int(ends[j]))
+                    seg = res[tuple(sl)]
+                    red = jnp.max(seg, axis=ax, keepdims=True) if kind == "max" \
+                        else jnp.mean(seg, axis=ax, keepdims=True)
+                    pieces.append(red)
+                res = jnp.concatenate(pieces, axis=ax)
+        return res
+
+    return apply_op(f, x, op_name=f"adaptive_{kind}_pool{nd}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCW", "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "NCHW", "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+    return (out, None) if return_mask else out
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, nd, output_size, data_format):
+    k = _ntuple(kernel_size, nd)
+    s = _ntuple(stride if stride is not None else kernel_size, nd)
+
+    def f(v, idx):
+        n, c = v.shape[0], v.shape[1]
+        in_sp = v.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(unwrap(o)) for o in output_size)[-nd:]
+        else:
+            p = _ntuple(padding, nd)
+            out_sp = tuple((in_sp[i] - 1) * s[i] - 2 * p[i] + k[i] for i in range(nd))
+        flat_out = 1
+        for o in out_sp:
+            flat_out *= o
+        vf = v.reshape(n, c, -1)
+        idxf = idx.reshape(n, c, -1)
+        out = jnp.zeros((n, c, flat_out), v.dtype)
+        bidx = jnp.arange(n)[:, None, None]
+        cidx = jnp.arange(c)[None, :, None]
+        out = out.at[bidx, cidx, idxf].set(vf)
+        return out.reshape((n, c) + out_sp)
+
+    idx_arr = unwrap(indices)
+    return apply_op(lambda v: f(v, idx_arr), x, op_name=f"max_unpool{nd}d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1, output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2, output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3, output_size, data_format)

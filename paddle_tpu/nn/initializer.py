@@ -1,0 +1,229 @@
+"""Parameter initializers.
+
+TPU-native analog of the reference's fill/init ops
+(python/paddle/nn/initializer/*.py): each initializer is a pure function of a
+PRNG key + shape + dtype, evaluated once at parameter creation (there is no
+lazy "init op" graph to run — jax arrays are values).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.random import default_generator
+
+__all__ = [
+    "Initializer",
+    "Constant",
+    "Normal",
+    "TruncatedNormal",
+    "Uniform",
+    "XavierNormal",
+    "XavierUniform",
+    "KaimingNormal",
+    "KaimingUniform",
+    "Assign",
+    "Dirac",
+    "Orthogonal",
+    "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    """python/paddle/nn/initializer/initializer.py:calculate_gain parity."""
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "conv1d_transpose": 1.0,
+        "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: paddle stores [out_c, in_c, *spatial]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None, key=None):
+        dtype = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        if key is None:
+            key = default_generator.next_key()
+        return self._generate(key, tuple(int(s) for s in shape), dtype)
+
+    def _generate(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _generate(self, key, shape, dtype):
+        return self.mean + self.std * jax.random.normal(key, shape, dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def _generate(self, key, shape, dtype):
+        # bounds are in units of std around mean (reference truncated_gaussian_random)
+        lo = (self.a - 0.0)
+        hi = (self.b - 0.0)
+        return self.mean + self.std * jax.random.truncated_normal(key, lo, hi, shape, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def _generate(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, key, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, key, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, key, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return std * jax.random.normal(key, shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, key, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def _generate(self, key, shape, dtype):
+        from ..core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.value
+        arr = jnp.asarray(np.asarray(v), dtype)
+        if tuple(arr.shape) != shape:
+            arr = arr.reshape(shape)
+        return arr
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv kernel init (nn/initializer/dirac.py)."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def _generate(self, key, shape, dtype):
+        arr = np.zeros(shape, dtype=np.float32)
+        out_c, in_c = shape[0], shape[1]
+        out_per_group = out_c // self.groups
+        mins = min(out_per_group, in_c)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for d in range(mins):
+                idx = (g * out_per_group + d, d, *centers)
+                arr[idx] = 1.0
+        return jnp.asarray(arr, dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def _generate(self, key, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal init needs >=2 dims")
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        q = jax.random.orthogonal(key, max(rows, cols), dtype=jnp.float32)
+        q = q[:rows, :cols]
+        return (self.gain * q).reshape(shape).astype(dtype)
+
+
+# paddle.nn.initializer.set_global_initializer support
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def get_global_initializer():
+    return _global_weight_init, _global_bias_init

@@ -1,0 +1,419 @@
+"""Layer — the module system.
+
+TPU-native analog of the reference's ``paddle.nn.Layer``
+(python/paddle/nn/layer/layers.py): named parameter/buffer/sublayer registry,
+state_dict round-trip, train/eval mode, forward hooks. Parameters hold jax
+arrays; a Layer is also viewable as a pytree of arrays (``raw_state``)
+so whole models drop into jitted/pjit-ed functions without translation.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as dtypes
+from ...core.tensor import Tensor
+from ..initializer import Constant, Initializer, XavierUniform, get_global_initializer
+from ..param_attr import ParamAttr
+from ..parameter import Parameter
+
+__all__ = ["Layer"]
+
+_layer_name_counters: Dict[str, int] = collections.defaultdict(int)
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, idx: int):
+        self._hooks = hooks
+        self._idx = idx
+
+    def remove(self):
+        self._hooks.pop(self._idx, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        cls = name_scope or self.__class__.__name__.lower()
+        _layer_name_counters[cls] += 1
+        object.__setattr__(self, "_full_name", f"{cls}_{_layer_name_counters[cls] - 1}")
+        object.__setattr__(self, "_dtype", dtypes.convert_dtype(dtype) or dtypes.get_default_dtype())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names_set", set())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        object.__setattr__(self, "_forward_pre_hooks", collections.OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", collections.OrderedDict())
+        object.__setattr__(self, "_hook_id", 0)
+        object.__setattr__(self, "_casted_by_pure_fp16", False)
+
+    # -- forward -----------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- registration ------------------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias: bool = False,
+        default_initializer: Optional[Initializer] = None,
+    ) -> Optional[Parameter]:
+        """Layer.create_parameter parity (nn/layer/layers.py)."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is None:  # attr=False → no parameter (e.g. bias_attr=False)
+            return None
+        dtype = dtypes.convert_dtype(dtype) or self._dtype
+        init = attr.initializer
+        if init is None:
+            gw, gb = get_global_initializer()
+            init = (gb if is_bias else gw) or default_initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        value = init(shape, dtype)
+        return Parameter(
+            value,
+            trainable=attr.trainable,
+            name=attr.name,
+            learning_rate=attr.learning_rate,
+            regularizer=attr.regularizer,
+            need_clip=attr.need_clip,
+            do_model_average=attr.do_model_average,
+        )
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter or None")
+        self._parameters[name] = parameter
+        if name in self.__dict__:
+            del self.__dict__[name]
+        return parameter
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(jnp.asarray(tensor), stop_gradient=True)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        else:
+            self._non_persistable_buffer_names_set.discard(name)
+        return tensor
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        if sublayer is not None and not isinstance(sublayer, Layer):
+            raise TypeError("add_sublayer expects a Layer")
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    # -- attribute routing -------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__() before assigning parameters")
+            self._buffers.pop(name, None)
+            self._sub_layers.pop(name, None)
+            params[name] = value
+            self.__dict__.pop(name, None)
+            return
+        layers = self.__dict__.get("_sub_layers")
+        if isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__() before assigning sublayers")
+            params.pop(name, None)
+            self._buffers.pop(name, None)
+            layers[name] = value
+            self.__dict__.pop(name, None)
+            return
+        # assigning over an existing registered slot
+        if params is not None and name in params:
+            if value is None:
+                params[name] = None
+                return
+            if isinstance(value, Tensor):
+                params[name].set_value(value)
+                return
+            del params[name]
+        buffers = self.__dict__.get("_buffers")
+        if buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+                return
+            del buffers[name]
+        if layers is not None and name in layers and not isinstance(value, Layer):
+            del layers[name]
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{self.__class__.__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = (
+            list(self._parameters) + list(self._buffers) + list(self._sub_layers)
+        )
+        return sorted(set(list(super().__dir__()) + extra))
+
+    # -- traversal ---------------------------------------------------------
+    def full_name(self) -> str:
+        return self._full_name
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, l in self.named_children():
+            if l is None:
+                continue
+            sub_prefix = prefix + ("." if prefix else "") + name
+            yield from l.named_sublayers(
+                prefix=sub_prefix, include_self=True, layers_set=layers_set
+            )
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        params_set = set()
+        layers = (
+            self.named_sublayers(prefix=prefix, include_self=True)
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for layer_prefix, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in params_set:
+                    continue
+                params_set.add(id(p))
+                yield layer_prefix + ("." if layer_prefix else "") + name, p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        buffers_set = set()
+        layers = (
+            self.named_sublayers(prefix=prefix, include_self=True)
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for layer_prefix, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in buffers_set:
+                    continue
+                buffers_set.add(id(b))
+                yield layer_prefix + ("." if layer_prefix else "") + name, b
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # -- modes -------------------------------------------------------------
+    def train(self) -> "Layer":
+        object.__setattr__(self, "training", True)
+        for l in self.children():
+            l.train()
+        return self
+
+    def eval(self) -> "Layer":
+        object.__setattr__(self, "training", False)
+        for l in self.children():
+            l.eval()
+        return self
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True
+                   ) -> Dict[str, Tensor]:
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip("."),
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        # persistable buffers only
+        layers = (
+            self.named_sublayers(prefix=structured_name_prefix.rstrip("."), include_self=True)
+            if include_sublayers
+            else [(structured_name_prefix.rstrip("."), self)]
+        )
+        seen = set()
+        for layer_prefix, layer in layers:
+            for name, b in layer._buffers.items():
+                if (b is None or id(b) in seen
+                        or name in layer._non_persistable_buffer_names_set):
+                    continue
+                seen.add(id(b))
+                dest[layer_prefix + ("." if layer_prefix else "") + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True):
+        """Returns (missing_keys, unexpected_keys) like the reference."""
+        own = self.state_dict()
+        missing, matched = [], set()
+        for key, target in own.items():
+            if key in state_dict:
+                v = state_dict[key]
+                if isinstance(v, Tensor):
+                    v = v.value
+                v = jnp.asarray(np.asarray(v))
+                if tuple(v.shape) != tuple(target.shape):
+                    raise ValueError(
+                        f"shape mismatch for {key}: receives {tuple(v.shape)}, "
+                        f"expects {tuple(target.shape)}"
+                    )
+                target.set_value(v.astype(target.dtype))
+                matched.add(key)
+            else:
+                missing.append(key)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    # aliases kept by the reference
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- dtype/device conversion -------------------------------------------
+    def _transform(self, fn):
+        for _, p in self.named_parameters():
+            p._value = fn(p._value)
+        for _, b in self.named_buffers():
+            b._value = fn(b._value)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None) -> "Layer":
+        d = dtypes.convert_dtype(dtype) if dtype is not None else None
+
+        def fn(v):
+            if d is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(d)
+            if device is not None:
+                from ...core.place import Place
+                from ...core.tensor import _parse_place
+
+                place = device if isinstance(device, Place) else _parse_place(str(device))
+                v = jax.device_put(v, place.jax_device())
+            return v
+
+        if d is not None:
+            object.__setattr__(self, "_dtype", d)
+        return self._transform(fn)
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def float16(self):
+        return self.half()
+
+    # -- misc --------------------------------------------------------------
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self.named_children():
+            mod_str = repr(l)
+            mod_str = _addindent(mod_str, 2)
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    # -- pytree view (TPU-native: drop a whole model into jit/pjit) --------
+    def raw_state(self) -> Dict[str, Any]:
+        """{name: jax array} for params + persistable buffers."""
+        return {k: v._value for k, v in self.state_dict().items()}
+
+    def load_raw_state(self, raw: Dict[str, Any]):
+        sd = self.state_dict()
+        for k, v in raw.items():
+            if k in sd:
+                sd[k]._value = jnp.asarray(v, sd[k].dtype)
+        return self
+
+
+def _addindent(s: str, num_spaces: int) -> str:
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    first = lines.pop(0)
+    rest = "\n".join((" " * num_spaces) + line for line in lines)
+    return first + "\n" + rest

@@ -1,0 +1,413 @@
+"""Recurrent layers (python/paddle/nn/layer/rnn.py parity).
+
+The time loop is a single ``lax.scan`` — compiled once, no per-step Python
+dispatch (the reference runs cudnn RNN kernels; scan+matmul is the XLA/TPU
+equivalent and lets the MXU batch the gate matmuls).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from ...ops._helpers import unwrap
+from ..initializer import Uniform
+from .container import LayerList
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+           "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        from ...ops.creation import full
+
+        state_shape = self.state_shape
+        if isinstance(state_shape, tuple):
+            return tuple(full([batch] + list(s), init_value) for s in state_shape)
+        return full([batch] + list(state_shape), init_value)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr, default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr, default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=Uniform(-std, std))
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self._act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = self._act
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, op_name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr, default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=Uniform(-std, std))
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f_, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f_ = jax.nn.sigmoid(f_)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = f_ * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = apply_op(f, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                        self.bias_ih, self.bias_hh, op_name="lstm_cell")
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr, default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=Uniform(-std, std))
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xc = jnp.split(xg, 3, axis=-1)
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            c = jnp.tanh(xc + r * hc)
+            return (1 - z) * c + z * h
+
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h
+
+
+def _cell_pure(cell):
+    """Return (pure_step(params, x_t, state) -> (out, state), params) for scan."""
+    if isinstance(cell, LSTMCell):
+        params = (cell.weight_ih.value, cell.weight_hh.value,
+                  cell.bias_ih.value, cell.bias_hh.value)
+
+        def step(p, x, st):
+            wi, wh, bi, bh = p
+            h, c = st
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f_, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f_) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, (h_new, c_new)
+
+        return step, params
+    if isinstance(cell, GRUCell):
+        params = (cell.weight_ih.value, cell.weight_hh.value,
+                  cell.bias_ih.value, cell.bias_hh.value)
+
+        def step(p, x, st):
+            wi, wh, bi, bh = p
+            xg = x @ wi.T + bi
+            hg = st @ wh.T + bh
+            xr, xz, xc = jnp.split(xg, 3, axis=-1)
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            c = jnp.tanh(xc + r * hc)
+            h = (1 - z) * c + z * st
+            return h, h
+
+        return step, params
+    # SimpleRNNCell
+    act = jnp.tanh if getattr(cell, "activation", "tanh") == "tanh" else jax.nn.relu
+    params = (cell.weight_ih.value, cell.weight_hh.value,
+              cell.bias_ih.value, cell.bias_hh.value)
+
+    def step(p, x, st):
+        wi, wh, bi, bh = p
+        h = act(x @ wi.T + bi + st @ wh.T + bh)
+        return h, h
+
+    return step, params
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over the time axis."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        step, _ = _cell_pure(self.cell)
+        is_lstm = isinstance(self.cell, LSTMCell)
+        time_major = self.time_major
+        reverse = self.is_reverse
+        seq_len = unwrap(sequence_length) if sequence_length is not None else None
+
+        if initial_states is None:
+            batch_ax = 1 if time_major else 0
+            from ...ops.creation import zeros
+
+            b = inputs.shape[batch_ax]
+            hs = self.cell.hidden_size
+            if is_lstm:
+                initial_states = (zeros([b, hs], dtype=str(inputs.dtype)),
+                                  zeros([b, hs], dtype=str(inputs.dtype)))
+            else:
+                initial_states = zeros([b, hs], dtype=str(inputs.dtype))
+
+        cell_params = [self.cell.weight_ih, self.cell.weight_hh,
+                       self.cell.bias_ih, self.cell.bias_hh]
+
+        def run(x, wi, wh, bi, bh, *states):
+            p = (wi, wh, bi, bh)
+            st = (states[0], states[1]) if is_lstm else states[0]
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, I]
+            T = xs.shape[0]
+            if reverse:
+                xs = jnp.flip(xs, 0)
+
+            def scan_fn(carry, inp):
+                if seq_len is not None:
+                    x_t, t = inp
+                else:
+                    x_t = inp
+                out, new_st = step(p, x_t, carry)
+                if seq_len is not None:
+                    # freeze state past each sequence's length
+                    tt = (T - 1 - t) if reverse else t
+                    mask = (tt < seq_len)[:, None]
+                    if is_lstm:
+                        new_st = (jnp.where(mask, new_st[0], carry[0]),
+                                  jnp.where(mask, new_st[1], carry[1]))
+                    else:
+                        new_st = jnp.where(mask, new_st, carry)
+                    out = jnp.where(mask, out, 0.0)
+                return new_st, out
+
+            xs_in = (xs, jnp.arange(T)) if seq_len is not None else xs
+            final, outs = jax.lax.scan(scan_fn, st, xs_in)
+            if reverse:
+                outs = jnp.flip(outs, 0)
+            if not time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            if is_lstm:
+                return outs, final[0], final[1]
+            return outs, final
+
+        if is_lstm:
+            outs, h, c = apply_op(run, inputs, *cell_params, *initial_states,
+                                  op_name="rnn_scan")
+            return outs, (h, c)
+        outs, h = apply_op(run, inputs, *cell_params, initial_states,
+                           op_name="rnn_scan")
+        return outs, h
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        from ...ops.manipulation import concat
+
+        outputs = concat([out_fw, out_bw], axis=-1)
+        return outputs, (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        self.num_directions = bidirect
+
+        def make_cell(in_sz):
+            kw = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+            if mode == "LSTM":
+                return LSTMCell(in_sz, hidden_size, **kw)
+            if mode == "GRU":
+                return GRUCell(in_sz, hidden_size, **kw)
+            act = "tanh" if mode == "RNN_TANH" else "relu"
+            return SimpleRNNCell(in_sz, hidden_size, activation=act, **kw)
+
+        self.rnns = LayerList()
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * bidirect
+            if bidirect == 2:
+                self.rnns.append(BiRNN(make_cell(in_sz), make_cell(in_sz), time_major))
+            else:
+                self.rnns.append(RNN(make_cell(in_sz), time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.mode == "LSTM"
+        D = self.num_directions
+        L = self.num_layers
+        states_per_layer = [None] * L
+        if initial_states is not None:
+            # paddle shape: [L*D, B, H] (h) and same for c
+            from ...ops.manipulation import split
+
+            if is_lstm:
+                h0, c0 = initial_states
+                hs = split(h0, L * D, axis=0)
+                cs = split(c0, L * D, axis=0)
+                for l in range(L):
+                    if D == 2:
+                        states_per_layer[l] = (
+                            ((hs[2 * l][0], cs[2 * l][0])),
+                            ((hs[2 * l + 1][0], cs[2 * l + 1][0])))
+                    else:
+                        states_per_layer[l] = (hs[l][0], cs[l][0])
+            else:
+                hs = split(initial_states, L * D, axis=0)
+                for l in range(L):
+                    if D == 2:
+                        states_per_layer[l] = (hs[2 * l][0], hs[2 * l + 1][0])
+                    else:
+                        states_per_layer[l] = hs[l][0]
+
+        out = inputs
+        finals = []
+        for l, rnn in enumerate(self.rnns):
+            out, st = rnn(out, states_per_layer[l], sequence_length)
+            finals.append(st)
+            if self.dropout > 0 and l < L - 1:
+                from .. import functional as F
+
+                out = F.dropout(out, self.dropout, training=self.training)
+
+        from ...ops.manipulation import stack
+
+        if is_lstm:
+            if D == 2:
+                hh = [s[d][0] for s in finals for d in range(2)]
+                cc = [s[d][1] for s in finals for d in range(2)]
+            else:
+                hh = [s[0] for s in finals]
+                cc = [s[1] for s in finals]
+            return out, (stack(hh, axis=0), stack(cc, axis=0))
+        if D == 2:
+            hh = [s[d] for s in finals for d in range(2)]
+        else:
+            hh = finals
+        return out, stack(hh, axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)

@@ -1,0 +1,43 @@
+"""Parameter — a trainable Tensor.
+
+Analog of the reference's ``EagerParamBase`` (python/paddle/fluid/framework.py)
+/ ``phi::DenseTensor`` held by a Layer: a Tensor with ``stop_gradient=False``
+by default plus optimizer metadata (lr multiplier, regularizer, clip flag).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["Parameter"]
+
+
+class Parameter(Tensor):
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "do_model_average", "is_distributed", "split_axis")
+
+    def __init__(self, value, trainable: bool = True, name=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 need_clip: bool = True, do_model_average: bool = True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": learning_rate}
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.do_model_average = do_model_average
+        self.persistable = True
+        # distributed metadata (TP): which axis this param is split along, or
+        # None if replicated (reference: param.is_distributed flag on mp layers)
+        self.is_distributed = False
+        self.split_axis = None
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda p: ((p._value,), (p.trainable, p.name)),
+    lambda aux, children: Parameter(children[0], trainable=aux[0], name=aux[1]),
+)

@@ -17,6 +17,7 @@ __all__ = [
     "reshape",
     "reshape_",
     "flatten",
+    "unflatten",
     "squeeze",
     "unsqueeze",
     "transpose",
@@ -94,6 +95,18 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
         return jnp.reshape(v, new_shape)
 
     return apply_op(impl, x, op_name="flatten")
+
+
+def unflatten(x, axis, shape, name=None):
+    from ._helpers import unwrap as _uw
+
+    shape = tuple(int(_uw(s)) for s in shape)
+
+    def impl(v):
+        ax = axis % v.ndim
+        return jnp.reshape(v, v.shape[:ax] + shape + v.shape[ax + 1:])
+
+    return apply_op(impl, x, op_name="unflatten")
 
 
 def squeeze(x, axis=None, name=None):

@@ -1,0 +1,27 @@
+"""Pallas TPU kernels (placeholder module — kernels land with the kernel track).
+
+The fused-op set the reference implements as hand-written CUDA
+(fluid/operators/fused/, phi/kernels/fusion/) maps here as Pallas TPU
+kernels. Until each kernel lands, callers fall back to XLA compositions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def flash_attention(q, k, v, causal: bool = False):
+    """[B, S, H, D] flash attention. Currently XLA composition; Pallas kernel
+    replaces this body on TPU (see paddle_tpu/ops/pallas_kernels/)."""
+    d = q.shape[-1]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / (d ** 0.5)
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
