@@ -60,6 +60,7 @@ _LAZY_SUBMODULES = {
     "nn",
     "optimizer",
     "profiler",
+    "regularizer",
     "sparse",
     "static",
     "vision",

@@ -12,6 +12,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["Parameter"]
 
+_param_counter = [0]
+
 
 class Parameter(Tensor):
     __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
@@ -20,6 +22,9 @@ class Parameter(Tensor):
     def __init__(self, value, trainable: bool = True, name=None,
                  learning_rate: float = 1.0, regularizer=None,
                  need_clip: bool = True, do_model_average: bool = True):
+        if name is None:
+            name = f"param_{_param_counter[0]}"
+            _param_counter[0] += 1
         super().__init__(value, stop_gradient=not trainable, name=name)
         self.trainable = trainable
         self.optimize_attr = {"learning_rate": learning_rate}

@@ -1,0 +1,15 @@
+"""paddle.optimizer parity (python/paddle/optimizer/__init__.py)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    LBFGS,
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    Optimizer,
+    RMSProp,
+)

@@ -1,0 +1,500 @@
+"""Optimizers (python/paddle/optimizer/optimizer.py parity).
+
+TPU-native design: each optimizer's update rule is a PURE function
+``_update(param, grad, state, lr) -> (new_param, new_state)`` over jax
+arrays. ``step()`` applies it eagerly (optionally under one jit for the whole
+parameter list); the same pure rule is reused inside compiled train steps by
+paddle_tpu.jit and the distributed sharding optimizers — matching how the
+reference shares phi optimizer kernels (phi/kernels/gpu/adam_kernel.cu)
+between eager and static executors.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradBase
+from ..nn.parameter import Parameter
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
+           "Adam", "AdamW", "Adamax", "Lamb", "LBFGS"]
+
+
+class L2Decay:
+    """paddle.regularizer.L2Decay — coupled weight decay added to the grad."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, p, g):
+        return g + self.coeff * p
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, p, g):
+        return g + self.coeff * jnp.sign(p)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # weight_decay: float → L2Decay (reference regularizer semantics)
+        if isinstance(weight_decay, (int, float)):
+            self._regularization = L2Decay(weight_decay)
+        else:
+            self._regularization = weight_decay
+        # state: name -> {param_name: array}
+        self._accumulators: Dict[str, Dict[int, Any]] = {}
+        self._step_count = 0
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for group in self._param_groups:
+                flat.extend(group["params"])
+            self._parameter_list = flat
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when lr is an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # -- state -------------------------------------------------------------
+    def _key(self, p) -> str:
+        return p.name if p.name else f"param_{id(p)}"
+
+    def _acc(self, name: str, p, init=None):
+        d = self._accumulators.setdefault(name, {})
+        k = self._key(p)
+        if k not in d:
+            d[k] = jnp.zeros_like(p.value) if init is None else init
+        return d[k]
+
+    def _set_acc(self, name: str, p, value):
+        self._accumulators[name][self._key(p)] = value
+
+    def state_dict(self) -> Dict[str, Any]:
+        sd: Dict[str, Any] = {}
+        for acc_name, d in self._accumulators.items():
+            for pkey, v in d.items():
+                sd[f"{pkey}_{acc_name}"] = Tensor(v)
+        sd["global_step"] = self._step_count
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict: Dict[str, Any]):
+        if "global_step" in state_dict:
+            v = state_dict["global_step"]
+            self._step_count = int(v.item() if hasattr(v, "item") else v)
+        if "LR_Scheduler" in state_dict and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        for acc_name, d in self._accumulators.items():
+            for pkey in list(d.keys()):
+                full = f"{pkey}_{acc_name}"
+                if full in state_dict:
+                    v = state_dict[full]
+                    d[pkey] = jnp.asarray(v.value if isinstance(v, Tensor) else v)
+
+    set_dict = set_state_dict
+
+    # -- core --------------------------------------------------------------
+    def _collect_params_grads(self) -> List[Tuple[Parameter, Optional[Tensor]]]:
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without parameters")
+        return [(p, p.grad) for p in self._parameter_list
+                if not getattr(p, "stop_gradient", False) or p.grad is not None]
+
+    def _apply_decay_and_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gv = g.value if isinstance(g, Tensor) else g
+            reg = getattr(p, "regularizer", None) or self._regularization
+            if reg is not None and not self._decoupled_wd():
+                gv = reg(p.value, gv)
+            out.append((p, Tensor(gv)))
+        if self._grad_clip is not None:
+            out = self._grad_clip(out)
+        return out
+
+    def _decoupled_wd(self) -> bool:
+        return False
+
+    def step(self):
+        params_grads = self._apply_decay_and_clip(self._collect_params_grads())
+        self._step_count += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            p_lr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else lr
+            p._value = self._update_param(p, g.value, p_lr)
+
+    def _update_param(self, p, g, lr):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero: bool = False):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _update_param(self, p, g, lr):
+        return (p.value - lr * g).astype(p.value.dtype)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._rescale = rescale_grad
+
+    def _update_param(self, p, g, lr):
+        g = g * self._rescale
+        v = self._acc("velocity", p)
+        v_new = self._momentum * v + g
+        self._set_acc("velocity", p, v_new)
+        if self._use_nesterov:
+            upd = g + self._momentum * v_new
+        else:
+            upd = v_new
+        return (p.value - lr * upd).astype(p.value.dtype)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g, lr):
+        m = self._acc("moment", p,
+                      init=jnp.full_like(p.value, self._init_acc))
+        m_new = m + g * g
+        self._set_acc("moment", p, m_new)
+        return (p.value - lr * g / (jnp.sqrt(m_new) + self._epsilon)).astype(p.value.dtype)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update_param(self, p, g, lr):
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_upd = self._acc("avg_squared_update", p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * g * g
+        upd = g * jnp.sqrt(avg_upd + self._epsilon) / jnp.sqrt(avg_sq + self._epsilon)
+        avg_upd = self._rho * avg_upd + (1 - self._rho) * upd * upd
+        self._set_acc("avg_squared_grad", p, avg_sq)
+        self._set_acc("avg_squared_update", p, avg_upd)
+        return (p.value - lr * upd).astype(p.value.dtype)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update_param(self, p, g, lr):
+        ms = self._acc("mean_square", p)
+        ms = self._rho * ms + (1 - self._rho) * g * g
+        self._set_acc("mean_square", p, ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_acc("mean_grad", p, mg)
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._acc("momentum", p)
+        mom = self._momentum * mom + lr * g / denom
+        self._set_acc("momentum", p, mom)
+        return (p.value - mom).astype(p.value.dtype)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _beta(self, b):
+        return float(b) if not isinstance(b, Tensor) else float(b)
+
+    def _update_param(self, p, g, lr):
+        b1, b2 = self._beta(self._beta1), self._beta(self._beta2)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b1p = b1p * b1
+        b2p = b2p * b2
+        gf = g.astype(jnp.float32) if g.dtype != jnp.float32 else g
+        pf = p.value.astype(jnp.float32)
+        m = b1 * m + (1 - b1) * gf
+        v = b2 * v + (1 - b2) * gf * gf
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        new_p = pf - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        self._set_acc("beta1_pow", p, b1p)
+        self._set_acc("beta2_pow", p, b2p)
+        return new_p.astype(p.value.dtype)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name=name)
+        self._wd_coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
+            else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _decoupled_wd(self):
+        return True
+
+    def _update_param(self, p, g, lr):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        decay = self._wd_coeff
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(
+                self._key(p)):
+            decay = 0.0
+        # decoupled decay BEFORE the adam update (reference adamw kernel order)
+        pv = p.value.astype(jnp.float32) * (1.0 - lr * decay)
+        b1, b2 = self._beta(self._beta1), self._beta(self._beta2)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b1p = b1p * b1
+        b2p = b2p * b2
+        gf = g.astype(jnp.float32) if g.dtype != jnp.float32 else g
+        m = b1 * m + (1 - b1) * gf
+        v = b2 * v + (1 - b2) * gf * gf
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        new_p = pv - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        self._set_acc("beta1_pow", p, b1p)
+        self._set_acc("beta2_pow", p, b2p)
+        return new_p.astype(p.value.dtype)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update_param(self, p, g, lr):
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b1p = b1p * self._beta1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        self._set_acc("moment", p, m)
+        self._set_acc("inf_norm", p, u)
+        self._set_acc("beta1_pow", p, b1p)
+        return (p.value - lr / (1 - b1p) * m / (u + self._epsilon)).astype(p.value.dtype)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr):
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b1p = b1p * self._beta1
+        b2p = b2p * self._beta2
+        gf = g.astype(jnp.float32)
+        pf = p.value.astype(jnp.float32)
+        m = self._beta1 * m + (1 - self._beta1) * gf
+        v = self._beta2 * v + (1 - self._beta2) * gf * gf
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        upd = r + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        u_norm = jnp.linalg.norm(upd)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        self._set_acc("beta1_pow", p, b1p)
+        self._set_acc("beta2_pow", p, b2p)
+        return (pf - lr * trust * upd).astype(p.value.dtype)
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with strong-Wolfe line search
+    (python/paddle/optimizer/lbfgs.py parity; host-driven loop — not a jit
+    target, matching the reference's Python implementation)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history_size = history_size
+        self._line_search_fn = line_search_fn
+        self._state = {"old_dirs": [], "old_stps": [], "ro": [], "prev_flat_grad": None,
+                       "H_diag": 1.0, "n_iter": 0, "d": None, "t": None}
+
+    def _gather_flat_grad(self):
+        return jnp.concatenate([
+            (p.grad.value if p.grad is not None else jnp.zeros_like(p.value)).reshape(-1)
+            for p in self._parameter_list])
+
+    def _add_to_params(self, step_size, direction):
+        offset = 0
+        for p in self._parameter_list:
+            n = p.value.size
+            p._value = (p.value + step_size * direction[offset:offset + n]
+                        .reshape(p.value.shape)).astype(p.value.dtype)
+            offset += n
+
+    def step(self, closure=None):
+        if closure is None:
+            raise RuntimeError("LBFGS.step requires a closure returning the loss")
+        st = self._state
+        loss = closure()
+        flat_grad = self._gather_flat_grad()
+        if float(jnp.max(jnp.abs(flat_grad))) <= self._tol_grad:
+            return loss
+        n_evals = 1
+        for _ in range(self._max_iter):
+            st["n_iter"] += 1
+            if st["n_iter"] == 1:
+                d = -flat_grad
+                H_diag = 1.0
+            else:
+                y = flat_grad - st["prev_flat_grad"]
+                s = st["d"] * st["t"]
+                ys = float(y @ s)
+                if ys > 1e-10:
+                    if len(st["old_dirs"]) >= self._history_size:
+                        st["old_dirs"].pop(0)
+                        st["old_stps"].pop(0)
+                        st["ro"].pop(0)
+                    st["old_dirs"].append(y)
+                    st["old_stps"].append(s)
+                    st["ro"].append(1.0 / ys)
+                    H_diag = ys / float(y @ y)
+                else:
+                    H_diag = st["H_diag"]
+                # two-loop recursion
+                q = -flat_grad
+                alphas = []
+                for s_i, y_i, ro_i in zip(reversed(st["old_stps"]),
+                                          reversed(st["old_dirs"]),
+                                          reversed(st["ro"])):
+                    a = ro_i * float(s_i @ q)
+                    alphas.append(a)
+                    q = q - a * y_i
+                d = q * H_diag
+                for (s_i, y_i, ro_i), a in zip(
+                        zip(st["old_stps"], st["old_dirs"], st["ro"]),
+                        reversed(alphas)):
+                    b = ro_i * float(y_i @ d)
+                    d = d + s_i * (a - b)
+            st["prev_flat_grad"] = flat_grad
+            st["H_diag"] = H_diag
+            t = self.get_lr() if st["n_iter"] > 1 else min(
+                1.0, 1.0 / float(jnp.sum(jnp.abs(flat_grad)))) * self.get_lr()
+            gtd = float(flat_grad @ d)
+            self._add_to_params(t, d)
+            st["d"], st["t"] = d, t
+            loss = closure()
+            flat_grad = self._gather_flat_grad()
+            n_evals += 1
+            if n_evals >= self._max_eval:
+                break
+            if float(jnp.max(jnp.abs(flat_grad))) <= self._tol_grad:
+                break
+            if float(jnp.max(jnp.abs(d * t))) <= self._tol_change:
+                break
+        return loss

@@ -1,0 +1,186 @@
+"""Optimizer tests — parity vs torch.optim on identical trajectories."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+
+
+def t2n(t):
+    return np.asarray(t.numpy(), dtype=np.float32)
+
+
+def _pair_models():
+    m = nn.Linear(4, 3)
+    tm = torch.nn.Linear(4, 3)
+    with torch.no_grad():
+        tm.weight.copy_(torch.tensor(t2n(m.weight).T))
+        tm.bias.copy_(torch.tensor(t2n(m.bias)))
+    return m, tm
+
+
+def _run_both(m, tm, optimizer, toptimizer, steps=5):
+    for i in range(steps):
+        x = np.random.randn(8, 4).astype(np.float32)
+        y = np.random.randn(8, 3).astype(np.float32)
+        loss = paddle.mean((m(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+
+        tloss = ((tm(torch.tensor(x)) - torch.tensor(y)) ** 2).mean()
+        toptimizer.zero_grad()
+        tloss.backward()
+        toptimizer.step()
+    np.testing.assert_allclose(t2n(m.weight), tm.weight.detach().numpy().T,
+                               rtol=1e-4, atol=1e-5)
+
+
+class TestOptimizers:
+    def test_sgd_vs_torch(self):
+        m, tm = _pair_models()
+        _run_both(m, tm, opt.SGD(0.1, parameters=m.parameters()),
+                  torch.optim.SGD(tm.parameters(), lr=0.1))
+
+    def test_momentum_vs_torch(self):
+        m, tm = _pair_models()
+        _run_both(m, tm,
+                  opt.Momentum(0.1, 0.9, parameters=m.parameters()),
+                  torch.optim.SGD(tm.parameters(), lr=0.1, momentum=0.9))
+
+    def test_momentum_nesterov(self):
+        m, tm = _pair_models()
+        _run_both(m, tm,
+                  opt.Momentum(0.05, 0.9, parameters=m.parameters(), use_nesterov=True),
+                  torch.optim.SGD(tm.parameters(), lr=0.05, momentum=0.9, nesterov=True))
+
+    def test_adam_vs_torch(self):
+        m, tm = _pair_models()
+        _run_both(m, tm,
+                  opt.Adam(0.01, parameters=m.parameters()),
+                  torch.optim.Adam(tm.parameters(), lr=0.01))
+
+    def test_adamw_vs_torch(self):
+        m, tm = _pair_models()
+        _run_both(m, tm,
+                  opt.AdamW(0.01, parameters=m.parameters(), weight_decay=0.1),
+                  torch.optim.AdamW(tm.parameters(), lr=0.01, weight_decay=0.1))
+
+    def test_rmsprop_vs_torch(self):
+        m, tm = _pair_models()
+        _run_both(m, tm,
+                  opt.RMSProp(0.01, rho=0.9, epsilon=1e-8, parameters=m.parameters()),
+                  torch.optim.RMSprop(tm.parameters(), lr=0.01, alpha=0.9, eps=1e-8))
+
+    def test_adagrad_vs_torch(self):
+        m, tm = _pair_models()
+        _run_both(m, tm,
+                  opt.Adagrad(0.05, epsilon=1e-10, parameters=m.parameters()),
+                  torch.optim.Adagrad(tm.parameters(), lr=0.05))
+
+    def test_l2_weight_decay_coupled(self):
+        # paddle weight_decay on SGD == torch SGD weight_decay (coupled L2)
+        m, tm = _pair_models()
+        _run_both(m, tm,
+                  opt.SGD(0.1, parameters=m.parameters(), weight_decay=0.01),
+                  torch.optim.SGD(tm.parameters(), lr=0.1, weight_decay=0.01))
+
+    def test_grad_clip_global_norm(self):
+        m = nn.Linear(4, 3)
+        o = opt.SGD(1.0, parameters=m.parameters(),
+                    grad_clip=nn.ClipGradByGlobalNorm(0.001))
+        before = t2n(m.weight).copy()
+        loss = paddle.sum(m(paddle.randn([2, 4])) * 100)
+        loss.backward()
+        o.step()
+        delta = np.linalg.norm(t2n(m.weight) - before) ** 2 + \
+            np.linalg.norm(t2n(m.bias) - np.zeros(3)) ** 2
+        assert np.sqrt(delta) <= 0.0011
+
+    def test_state_dict_roundtrip(self):
+        m = nn.Linear(4, 3)
+        o = opt.Adam(0.01, parameters=m.parameters())
+        loss = paddle.sum(m(paddle.randn([2, 4])))
+        loss.backward()
+        o.step()
+        sd = o.state_dict()
+        o2 = opt.Adam(0.01, parameters=m.parameters())
+        loss = paddle.sum(m(paddle.randn([2, 4])))
+        loss.backward()
+        o2.step()  # populate accumulators
+        o2.set_state_dict(sd)
+        k = m.weight.name
+        np.testing.assert_allclose(
+            np.asarray(o2._accumulators["moment1"][k]),
+            np.asarray(o._accumulators["moment1"][k]))
+
+    def test_lbfgs_quadratic(self):
+        p = nn.Parameter(paddle.to_tensor(np.array([3.0, -2.0], np.float32)).value)
+        o = opt.LBFGS(parameters=[p], max_iter=20)
+
+        def closure():
+            o.clear_grad()
+            loss = paddle.sum((paddle.to_tensor(p) - paddle.to_tensor(
+                np.array([1.0, 1.0], np.float32))) ** 2)
+            from paddle_tpu.core.autograd import run_backward
+            # p is a leaf; recompute loss through p directly
+            p2 = paddle.to_tensor(p.value, stop_gradient=False)
+            target = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+            l2 = paddle.sum((p2 - target) ** 2)
+            l2.backward()
+            p.grad = p2.grad
+            return l2
+
+        o.step(closure)
+        np.testing.assert_allclose(t2n(p), [1.0, 1.0], atol=1e-4)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_cosine(self):
+        s = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-9
+        s.step(5)
+        assert abs(s() - 0.5) < 1e-9
+        s.step(10)
+        assert abs(s() - 0.0) < 1e-9
+
+    def test_linear_warmup_wraps_scheduler(self):
+        inner = opt.lr.StepDecay(0.1, step_size=100)
+        s = opt.lr.LinearWarmup(inner, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(6):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075])
+        np.testing.assert_allclose(vals[4:], [0.1, 0.1])
+
+    def test_optimizer_uses_scheduler(self):
+        m = nn.Linear(2, 2)
+        sched = opt.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+        o = opt.SGD(sched, parameters=m.parameters())
+        assert o.get_lr() == 0.5
+        sched.step()
+        assert abs(o.get_lr() - 0.05) < 1e-12
+
+    def test_noam(self):
+        s = opt.lr.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+        s.step(5)
+        expected = (512 ** -0.5) * min(5 ** -0.5, 5 * 10 ** -1.5)
+        assert abs(s() - expected) < 1e-9
+
+    def test_reduce_on_plateau(self):
+        s = opt.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        assert abs(s() - 0.05) < 1e-12
