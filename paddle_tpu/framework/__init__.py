@@ -1,0 +1,27 @@
+"""paddle.framework parity (python/paddle/framework/__init__.py)."""
+from ..core.dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from ..core.random import seed  # noqa: F401
+from ..nn.parameter import Parameter  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .io import load, save  # noqa: F401
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """paddle.create_parameter parity (python/paddle/tensor/creation.py)."""
+    from ..nn.initializer import Constant, XavierUniform
+    from ..nn.param_attr import ParamAttr
+    from ..core import dtype as dtypes
+
+    attr = ParamAttr._to_attr(attr)
+    init = attr.initializer or default_initializer or (
+        Constant(0.0) if is_bias else XavierUniform())
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    value = init(shape, d)
+    return Parameter(value, trainable=attr.trainable, name=attr.name or name,
+                     learning_rate=attr.learning_rate,
+                     regularizer=attr.regularizer, need_clip=attr.need_clip)
+
+
+def in_dygraph_mode() -> bool:
+    return True

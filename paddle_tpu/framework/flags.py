@@ -1,0 +1,52 @@
+"""Flag registry (phi/core/flags.cc + pybind/global_value_getter_setter.cc parity).
+
+A typed registry with FLAGS_* environment-variable override — the reference's
+1,270-line PHI_DEFINE_EXPORTED_* corpus collapses to the flags that have
+meaning on TPU/XLA; unknown flags are accepted (stored) so reference scripts
+calling set_flags don't break.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Union
+
+__all__ = ["get_flags", "set_flags", "define_flag"]
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_: str = ""):
+    env = os.environ.get(name)
+    if env is not None:
+        if isinstance(default, bool):
+            default = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            default = int(env)
+        elif isinstance(default, float):
+            default = float(env)
+        else:
+            default = env
+    _REGISTRY[name] = default
+    return default
+
+
+# the flags that matter for the TPU runtime (reference analogs noted)
+define_flag("FLAGS_check_nan_inf", False)          # eager/nan_inf_utils.cc:83
+define_flag("FLAGS_allocator_strategy", "auto_growth")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92)
+define_flag("FLAGS_cudnn_deterministic", False)
+define_flag("FLAGS_embedding_deterministic", 0)
+define_flag("FLAGS_benchmark", False)
+define_flag("FLAGS_use_pallas_kernels", True)      # TPU-native: route fused ops to Pallas
+define_flag("FLAGS_log_level", 0)
+
+
+def get_flags(flags: Union[str, List[str]]):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _REGISTRY.get(f) for f in flags}
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        _REGISTRY[k] = v

@@ -1,0 +1,81 @@
+"""paddle.save / paddle.load (python/paddle/framework/io.py:646,888 parity).
+
+Serialization contract matches the reference: pickle files holding nested
+dicts of numpy arrays (state_dict key compatibility for porting weights),
+with >4GB protocol-4 chunked writes handled by pickle itself. Tensors are
+converted to numpy on save and restored as Tensors on load.
+
+For sharded/distributed checkpoints see paddle_tpu.distributed.checkpoint
+(tensorstore-style sharded layout, SURVEY.md §5.4 TPU design note).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj.value)
+        return _TensorPayload(arr, obj.name,
+                              trainable=not obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        if hasattr(obj, "_fields"):  # namedtuple
+            return t(*(_to_saveable(v) for v in obj))
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    """Tagged tensor payload so load() can restore Tensor objects."""
+
+    def __init__(self, array, name=None, trainable=False):
+        self.array = array
+        self.name = name
+        self.trainable = trainable
+
+
+def _from_saved(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(np.asarray(obj.array), stop_gradient=not obj.trainable,
+                   name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_saved(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        if hasattr(obj, "_fields"):
+            return t(*(_from_saved(v, return_numpy) for v in obj))
+        return t(_from_saved(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    if isinstance(path, str):
+        dirname = os.path.dirname(path)
+        if dirname and not os.path.exists(dirname):
+            os.makedirs(dirname, exist_ok=True)
+    payload = _to_saveable(obj)
+    with open(path, "wb") if isinstance(path, str) else path as f:
+        pickle.dump(payload, f, protocol=max(protocol, 4))
+
+
+def load(path: str, **configs) -> Any:
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") if isinstance(path, str) else path as f:
+        payload = pickle.load(f)
+    return _from_saved(payload, return_numpy)

@@ -1,0 +1,164 @@
+"""DataLoader (python/paddle/io/dataloader/dataloader_iter.py parity).
+
+The reference forks multiprocess workers feeding shared-memory tensors into a
+C++ blocking queue (_DataLoaderIterMultiProcess, dataloader_iter.py:358).
+TPU-native design: the input pipeline's job is to keep the host→HBM transfer
+ahead of the step; workers here are a process pool (true parallel decode for
+numpy-producing datasets) with a bounded prefetch queue, and batches stay as
+stacked numpy arrays — jit boundaries do the single host→device transfer.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id_, num_workers, dataset):
+        self.id = id_
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch: List[Any]):
+    """Stack samples into batched Tensors (reference:
+    python/paddle/io/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.stack([s.value for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.floating, np.integer)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(f)) for f in transposed)
+    raise TypeError(f"batch data cannot be a {type(sample)}")
+
+
+def _fetch(dataset, indices, collate_fn):
+    return collate_fn([dataset[i] for i in indices])
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = max(0, int(num_workers))
+        self.collate_fn = collate_fn or default_collate_fn
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset loader is unknown")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.batch_sampler is None:
+            # batch_size=None → sample-by-sample passthrough
+            return (self.collate_fn([self.dataset[i]])
+                    for i in range(len(self.dataset)))
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_workers()
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            yield _fetch(self.dataset, indices, self.collate_fn)
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch:
+                return
+            if len(batch) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn(batch)
+
+    def _iter_workers(self):
+        """Bounded-prefetch pipeline: worker threads run dataset.__getitem__
+        + collate in parallel (numpy decode releases the GIL), results are
+        delivered in order (≙ reference _DataLoaderIterMultiProcess out-of-
+        order reorder buffer, dataloader_iter.py:700)."""
+        max_inflight = self.num_workers * self.prefetch_factor
+        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        if self.worker_init_fn:
+            for i in range(self.num_workers):
+                pool.submit(self.worker_init_fn, i)
+        indices_iter = iter(self.batch_sampler)
+        futures: "queue.Queue" = queue.Queue()
+        stop = threading.Event()
+
+        def submitter():
+            for indices in indices_iter:
+                if stop.is_set():
+                    break
+                while futures.qsize() >= max_inflight and not stop.is_set():
+                    stop.wait(0.001)
+                futures.put(pool.submit(_fetch, self.dataset, indices,
+                                        self.collate_fn))
+            futures.put(None)
+
+        t = threading.Thread(target=submitter, daemon=True)
+        t.start()
+        try:
+            while True:
+                fut = futures.get()
+                if fut is None:
+                    return
+                yield fut.result()
+        finally:
+            stop.set()
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def __call__(self):
+        return self.__iter__()

@@ -1,0 +1,2 @@
+"""paddle.vision parity (python/paddle/vision/__init__.py)."""
+from . import models  # noqa: F401

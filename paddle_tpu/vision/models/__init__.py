@@ -1,0 +1,2 @@
+"""paddle.vision.models parity (LeNet/VGG/MobileNet land with the vision widening)."""
+from .resnet import *  # noqa: F401,F403
