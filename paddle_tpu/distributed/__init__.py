@@ -1,0 +1,56 @@
+"""paddle.distributed parity (python/paddle/distributed/__init__.py).
+
+TPU-native distributed stack: one jax.sharding.Mesh carries the hybrid
+topology (dp/pp/sharding/mp/sp/ep); collectives are XLA collectives over
+mesh axes (see communication/core.py for the execution contract).
+"""
+from .communication import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    all_to_all_single,
+    barrier,
+    broadcast,
+    irecv,
+    isend,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream,
+)
+from .communication.core import get_group, new_group  # noqa: F401
+from .env import get_rank, get_world_size  # noqa: F401
+from .parallel import DataParallel, ParallelEnv, init_parallel_env  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology,
+    Group,
+    HybridCommunicateGroup,
+    build_mesh,
+    get_mesh,
+    set_mesh,
+)
+
+
+def is_initialized() -> bool:
+    from .parallel import _initialized
+
+    return _initialized[0]
+
+
+def get_backend() -> str:
+    return "xla"
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("fleet", "sharding", "checkpoint", "utils", "meta_parallel",
+                "auto_parallel", "launch"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

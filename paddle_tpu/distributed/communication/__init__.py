@@ -1,0 +1,201 @@
+"""paddle.distributed communication API (communication/all_reduce.py:19 etc.).
+
+Signatures match the reference; semantics follow the stacked-ranks /
+traced-shard contract documented in core.py.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import unwrap
+from .core import ReduceOp, collective, get_group, in_traced_context, new_group
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
+           "all_to_all", "all_to_all_single", "reduce_scatter", "broadcast",
+           "reduce", "scatter", "send", "recv", "isend", "irecv", "barrier",
+           "stream"]
+
+
+class _Task:
+    """≙ ProcessGroup::Task (collective/process_group.h) — XLA collectives are
+    launched by the compiled program; wait() is a device sync."""
+
+    def __init__(self, result=None):
+        self.result = result
+
+    def wait(self):
+        if self.result is not None:
+            v = self.result.value if isinstance(self.result, Tensor) else self.result
+            try:
+                v.block_until_ready()
+            except AttributeError:
+                pass
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    out = collective("all_reduce", tensor, group, extra=(op,))
+    if isinstance(tensor, Tensor):
+        tensor.set_value(out.value)
+    return _Task(out)
+
+
+def all_gather(tensor_list: Optional[List], tensor, group=None, sync_op=True):
+    g = get_group(group)
+    if g.axis_name is not None and not isinstance(g.axis_name, tuple) \
+            and in_traced_context(g.axis_name):
+        out = collective("all_gather_stack", tensor, group)
+        if tensor_list is not None:
+            for i in range(out.shape[0]):
+                tensor_list.append(out[i])
+        return _Task(out)
+    out = collective("all_gather_stack", tensor, group)
+    # stacked eager result: [n_ranks, n_ranks, ...] — every rank sees all
+    if tensor_list is not None:
+        row = out[0]
+        for i in range(row.shape[0]):
+            tensor_list.append(row[i])
+    return _Task(out)
+
+
+def all_gather_object(object_list, obj, group=None):
+    # single-controller: every "rank" sees the object
+    g = get_group(group)
+    object_list.extend([obj] * g.nranks)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    inp = tensor_or_tensor_list
+    if isinstance(inp, (list, tuple)):
+        from ...ops.manipulation import concat
+
+        inp = concat(list(inp), axis=0)
+    out = collective("reduce_scatter", inp, group, extra=(op,))
+    if isinstance(tensor, Tensor):
+        tensor.set_value(out.value if out.ndim == tensor.ndim
+                         else out.value.reshape(tensor.shape))
+    return _Task(out)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    from ...ops.manipulation import concat, split
+
+    if isinstance(in_tensor_list, (list, tuple)):
+        inp = concat(list(in_tensor_list), axis=0)
+        n = len(in_tensor_list)
+    else:
+        inp = in_tensor_list
+        n = get_group(group).nranks
+    out = collective("all_to_all", inp, group)
+    if out_tensor_list is not None:
+        g = get_group(group)
+        axis = g.axis_name
+        if axis is not None and not isinstance(axis, tuple) and in_traced_context(axis):
+            pieces = split(out, n, axis=0)
+        else:
+            pieces = split(out[0], n, axis=0) if out.ndim > inp.ndim else \
+                split(out, n, axis=0)
+        out_tensor_list.extend(pieces)
+    return _Task(out)
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
+                      out_split_sizes=None, group=None, sync_op=True):
+    out = collective("all_to_all", in_tensor, group)
+    if isinstance(out_tensor, Tensor):
+        out_tensor.set_value(out.value)
+    return _Task(out)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = get_group(group)
+    src_local = g.get_group_rank(src) if g.ranks and src in g.ranks else src
+    out = collective("broadcast", tensor, group, extra=(int(src_local),))
+    if isinstance(tensor, Tensor):
+        tensor.set_value(out.value)
+    return _Task(out)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = get_group(group)
+    dst_local = g.get_group_rank(dst) if g.ranks and dst in g.ranks else dst
+    out = collective("reduce", tensor, group, extra=(op, int(dst_local)))
+    if isinstance(tensor, Tensor):
+        tensor.set_value(out.value)
+    return _Task(out)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    from ...ops.manipulation import concat
+
+    if tensor_list:
+        inp = concat(list(tensor_list), axis=0)
+        # stacked convention: every rank slot carries the full src payload
+        g = get_group(group)
+        if not (g.axis_name and not isinstance(g.axis_name, tuple)
+                and in_traced_context(g.axis_name)):
+            inp = Tensor(jnp.broadcast_to(
+                inp.value[None], (g.nranks,) + tuple(inp.shape)))
+    else:
+        inp = tensor
+    out = collective("scatter", inp, group, extra=(int(src),))
+    if isinstance(tensor, Tensor):
+        tensor.set_value(out.value)
+    return _Task(out)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P ≙ ppermute edge (reference send_v2/recv_v2). SPMD has no caller
+    rank, so send/recv express the collective ring pattern the reference's
+    pipeline uses: every rank i forwards its slot to i+1 (send) and the
+    matching recv reads the shifted slot. Pipeline-parallel code uses
+    ppermute directly with explicit edges (meta_parallel/pp_utils)."""
+    g = get_group(group)
+    n = g.nranks
+    perm = tuple((i, (i + 1) % n) for i in range(n))
+    out = collective("ppermute", tensor, group, extra=(perm,))
+    return _Task(out)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = get_group(group)
+    n = g.nranks
+    perm = tuple((i, (i + 1) % n) for i in range(n))
+    out = collective("ppermute", tensor, group, extra=(perm,))
+    if isinstance(tensor, Tensor):
+        tensor.set_value(out.value)
+    return _Task(out)
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group=None):
+    import jax
+
+    (jax.device_put(0) + 0).block_until_ready()
+    return _Task()
+
+
+class stream:
+    """paddle.distributed.stream.* namespace parity — on XLA the async/stream
+    choice (process_group_with_stream.h:32-56 sync_op/use_calc_stream) is the
+    compiler's; these re-export the same ops."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    all_to_all = staticmethod(all_to_all)
+    broadcast = staticmethod(broadcast)
+    scatter = staticmethod(scatter)
+    reduce = staticmethod(reduce)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

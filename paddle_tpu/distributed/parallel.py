@@ -1,0 +1,162 @@
+"""Parallel environment + DataParallel (distributed/parallel.py:190,917 parity).
+
+init_parallel_env ≙ reference's TCPStore+ProcessGroupNCCL bring-up
+(parallel.py:1056-1101): on TPU this is ``jax.distributed.initialize`` (the
+JAX coordinator plays TCPStore's role) plus building the global mesh.
+
+DataParallel ≙ reference DataParallel+EagerReducer (collective/reducer.cc):
+TPU-native form — the model's train step is compiled with batch sharded over
+the ``dp`` axis; gradient allreduce is inserted by XLA from the sharding
+(GSPMD), or taken explicitly via grad hooks in the eager path.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..core.tensor import Tensor
+from .communication import all_reduce
+from .communication.core import ReduceOp
+from .env import get_rank, get_world_size
+from .topology import build_mesh, get_mesh, set_mesh
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "DataParallel",
+           "ParallelEnv"]
+
+_initialized = [False]
+
+
+def init_parallel_env(mesh=None, **mesh_degrees):
+    """Bring up the distributed runtime and the global mesh.
+
+    Multi-host: PADDLE_TRAINER_ENDPOINTS/PADDLE_TRAINER_ID (reference env
+    contract, launch/controllers/collective.py) map to the JAX coordinator.
+    """
+    if _initialized[0]:
+        return ParallelEnv()
+    endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if endpoints and nnodes > 1:
+        coord = endpoints.split(",")[0]
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nnodes,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)),
+        )
+    if mesh is not None:
+        set_mesh(mesh)
+    elif mesh_degrees:
+        set_mesh(build_mesh(**mesh_degrees))
+    else:
+        set_mesh(build_mesh())  # pure-dp default over all devices
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    local_rank = rank
+
+
+class DataParallel:
+    """paddle.DataParallel parity (distributed/parallel.py:190).
+
+    Wraps a Layer; after ``loss.backward()`` call ``apply_collective_grads``
+    (or rely on the hybrid optimizer) to average grads over dp. In the
+    compiled path (to_static / fleet train steps), dp-sharded batches make
+    XLA insert the grad psum automatically, so this wrapper is a passthrough
+    there — matching the reference where DataParallel is a no-op under
+    sharding-parallel modes (fleet/model.py:149).
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    __call__ = forward
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    # -- reference API surface --------------------------------------------
+    def no_sync(self):
+        import contextlib
+
+        parent = self
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = parent._grad_sync_enabled
+            parent._grad_sync_enabled = False
+            try:
+                yield
+            finally:
+                parent._grad_sync_enabled = prev
+
+        return ctx()
+
+    def apply_collective_grads(self):
+        """Average grads across dp (≙ EagerReducer fused allreduce,
+        reducer.cc:938). Grads here are global arrays in single-controller
+        SPMD — when the forward was computed with a dp-sharded batch the
+        grad is already the full-batch gradient, so this is the explicit
+        eager path for per-shard gradients following the stacked convention."""
+        if not self._grad_sync_enabled:
+            return
+        from .topology import axis_size
+
+        n = axis_size("dp")
+        if n <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None and p.grad.shape and p.grad.shape[0] == n:
+                all_reduce(p.grad, op=ReduceOp.AVG,
+                           group=self._group or _dp_group())
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    @property
+    def parameters(self):
+        return self._layers.parameters
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+def _dp_group():
+    from .topology import Group
+
+    return Group("dp", get_mesh())

@@ -1,0 +1,282 @@
+"""Device-mesh topology (fleet/base/topology.py:58,144 parity, TPU-native).
+
+The reference builds a 4-D process topology (dp/pp/sharding/mp) out of
+per-process NCCL groups (CommunicateTopology + HybridCommunicateGroup).
+TPU-native redesign: ONE ``jax.sharding.Mesh`` with named axes carries the
+whole hybrid topology; a "communication group" is a mesh axis (sub-mesh), and
+collectives are XLA collectives over that axis riding ICI. Axes extend the
+reference's set with ``sp`` (sequence/context parallel) and ``ep`` (expert
+parallel) as first-class dims (SURVEY.md §5.7/§5.8).
+
+Single-controller SPMD note: there is no per-process "rank" — rank-shaped
+APIs (get_model_parallel_rank etc.) return the host process's coordinate
+(multi-host) or 0 (single host), while the per-device coordinate is
+``lax.axis_index(axis)`` inside traced code.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "get_mesh",
+           "set_mesh", "build_mesh", "axis_size", "Group"]
+
+# canonical hybrid axis order (reference default order: data/pipe/sharding/model,
+# fleet/fleet.py:393-416; sp+ep appended as capability extensions)
+HYBRID_AXES = ("dp", "pp", "sharding", "mp", "sp", "ep")
+
+_GLOBAL_MESH: Optional[Mesh] = None
+_GROUPS: Dict[int, "Group"] = {}
+_NEXT_GROUP_ID = [0]
+
+
+class Group:
+    """A communication group ≙ one mesh axis (or an explicit rank list for
+    API-parity subgroups). reference: collective.py Group."""
+
+    def __init__(self, axis_name: Optional[str], mesh: Mesh, ranks=None,
+                 gid: Optional[int] = None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+        if gid is None:
+            gid = _NEXT_GROUP_ID[0]
+            _NEXT_GROUP_ID[0] += 1
+        self.id = gid
+        if ranks is None and axis_name is not None:
+            ranks = list(range(mesh.shape[axis_name]))
+        self.ranks = ranks or []
+        _GROUPS[self.id] = self
+
+    @property
+    def nranks(self) -> int:
+        if self.axis_name is not None:
+            return int(self.mesh.shape[self.axis_name])
+        return len(self.ranks)
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        return 0  # single-controller host view; device coord = lax.axis_index
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, nranks={self.nranks})"
+
+
+def build_mesh(dp: int = 1, pp: int = 1, sharding: int = 1, mp: int = 1,
+               sp: int = 1, ep: int = 1, devices=None,
+               order: Sequence[str] = HYBRID_AXES) -> Mesh:
+    """Build the global hybrid mesh. Degrees must multiply to #devices
+    (a trailing dp axis absorbs the remainder if left as default 1)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    degrees = {"dp": dp, "pp": pp, "sharding": sharding, "mp": mp,
+               "sp": sp, "ep": ep}
+    prod = int(np.prod([max(1, d) for d in degrees.values()]))
+    if prod != n:
+        if n % prod == 0 and dp == 1:
+            degrees["dp"] = n // prod
+        else:
+            raise ValueError(
+                f"hybrid degrees {degrees} multiply to {prod}, but there are "
+                f"{n} devices")
+    shape = [degrees[a] for a in order]
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names=tuple(order))
+
+
+def set_mesh(mesh: Mesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> Mesh:
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = build_mesh()
+    return _GLOBAL_MESH
+
+
+def axis_size(axis: str) -> int:
+    m = get_mesh()
+    return int(m.shape[axis]) if axis in m.shape else 1
+
+
+class CommunicateTopology:
+    """fleet/base/topology.py:58 parity — named-dim coordinate math."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = tuple  # type alias
+        self._world_size = int(np.prod(self._dims))
+        ranges = [range(d) for d in self._dims]
+        import itertools
+
+        self._coord2rank = {c: i for i, c in enumerate(itertools.product(*ranges))}
+        self._rank2coord = {v: k for k, v in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on axis_name == index."""
+        axis = self._parallel_names.index(axis_name)
+        return [r for c, r in self._coord2rank.items() if c[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        """Rank lists of each group along axis_name (varying that axis only)."""
+        axis = self._parallel_names.index(axis_name)
+        groups = {}
+        for coord, rank in self._coord2rank.items():
+            key = coord[:axis] + coord[axis + 1:]
+            groups.setdefault(key, []).append(rank)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """fleet/base/topology.py:144 parity over the global Mesh.
+
+    Mesh-axis mapping: data→dp, pipe→pp, sharding→sharding, model→mp
+    (+ sp, ep). check group (dp×pp) has no single mesh axis; it is exposed as
+    an axis tuple for multi-axis collectives.
+    """
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 mesh: Optional[Mesh] = None):
+        self._mesh = mesh if mesh is not None else get_mesh()
+        ms = dict(self._mesh.shape)
+        self._dp_degree = ms.get("dp", 1)
+        self._pp_degree = ms.get("pp", 1)
+        self._sharding_degree = ms.get("sharding", 1)
+        self._mp_degree = ms.get("mp", 1)
+        self._sp_degree = ms.get("sp", 1)
+        self._ep_degree = ms.get("ep", 1)
+        self._topo = topology or CommunicateTopology(
+            ("data", "pipe", "sharding", "model"),
+            (self._dp_degree, self._pp_degree, self._sharding_degree,
+             self._mp_degree))
+        self.global_rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self._dp_group = Group("dp", self._mesh)
+        self._pp_group = Group("pp", self._mesh)
+        self._sharding_group = Group("sharding", self._mesh)
+        self._mp_group = Group("mp", self._mesh)
+        self._sp_group = Group("sp", self._mesh)
+        self._ep_group = Group("ep", self._mesh)
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    # nranks
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sequence_parallel_world_size(self):
+        return self._sp_degree
+
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
+    # ranks (host view — see module docstring)
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    # groups
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sequence_parallel_group(self):
+        return self._sp_group
+
+    def get_expert_parallel_group(self):
+        return self._ep_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return Group(None, self._mesh, ranks=list(range(
+            self._dp_degree * self._pp_degree)))
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline helpers
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id)
+
+    def topology(self):
+        return self._topo

@@ -1,0 +1,154 @@
+"""Collective communication tests on an 8-device virtual CPU mesh.
+
+Mirrors the reference pattern (test/legacy_test/test_collective_base.py):
+numerical parity of each collective against numpy, in both calling contexts
+(eager stacked-ranks and inside shard_map).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.topology import Group, build_mesh, set_mesh
+
+
+def t2n(t):
+    return np.asarray(t.numpy())
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    mesh = build_mesh(dp=4, mp=2)
+    set_mesh(mesh)
+    from paddle_tpu.distributed.communication import core
+
+    core._reset_default_group()
+    yield mesh
+
+
+class TestEagerStacked:
+    def test_all_reduce_sum(self, _mesh):
+        g = Group("dp", _mesh)
+        x = np.random.randn(4, 3, 5).astype(np.float32)
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, group=g)
+        expected = np.broadcast_to(x.sum(0, keepdims=True), x.shape)
+        np.testing.assert_allclose(t2n(t), expected, rtol=1e-5)
+
+    def test_all_reduce_max_avg(self, _mesh):
+        g = Group("dp", _mesh)
+        x = np.random.randn(4, 6).astype(np.float32)
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, op=dist.ReduceOp.MAX, group=g)
+        np.testing.assert_allclose(t2n(t), np.broadcast_to(x.max(0), (4, 6)))
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, op=dist.ReduceOp.AVG, group=g)
+        np.testing.assert_allclose(t2n(t), np.broadcast_to(x.mean(0), (4, 6)),
+                                   rtol=1e-6)
+
+    def test_all_gather(self, _mesh):
+        g = Group("mp", _mesh)
+        x = np.random.randn(2, 3).astype(np.float32)
+        out = []
+        dist.all_gather(out, paddle.to_tensor(x), group=g)
+        assert len(out) == 2
+        np.testing.assert_allclose(t2n(out[0]), x[0])
+        np.testing.assert_allclose(t2n(out[1]), x[1])
+
+    def test_reduce_scatter(self, _mesh):
+        g = Group("dp", _mesh)
+        # each of 4 ranks holds [8] -> each gets sum of its 2-chunk
+        x = np.random.randn(4, 8).astype(np.float32)
+        out = dist.reduce_scatter(None, paddle.to_tensor(x), group=g)
+        res = t2n(out.result)
+        full = x.sum(0)
+        for r in range(4):
+            np.testing.assert_allclose(res[r], full[r * 2:(r + 1) * 2], rtol=1e-5)
+
+    def test_all_to_all(self, _mesh):
+        g = Group("dp", _mesh)
+        x = np.arange(4 * 8, dtype=np.float32).reshape(4, 8)
+        out = dist.all_to_all(None, paddle.to_tensor(x), group=g)
+        res = t2n(out.result)
+        # rank r, chunk j == rank j, chunk r
+        xs = x.reshape(4, 4, 2)
+        expected = np.swapaxes(xs, 0, 1).reshape(4, 8)
+        np.testing.assert_allclose(res, expected)
+
+    def test_broadcast(self, _mesh):
+        g = Group("dp", _mesh)
+        x = np.random.randn(4, 5).astype(np.float32)
+        t = paddle.to_tensor(x)
+        dist.broadcast(t, src=2, group=g)
+        np.testing.assert_allclose(t2n(t), np.broadcast_to(x[2], (4, 5)))
+
+    def test_reduce_to_dst(self, _mesh):
+        g = Group("dp", _mesh)
+        x = np.random.randn(4, 5).astype(np.float32)
+        t = paddle.to_tensor(x)
+        dist.reduce(t, dst=1, group=g)
+        res = t2n(t)
+        np.testing.assert_allclose(res[1], x.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(res[0], x[0])
+
+    def test_scatter(self, _mesh):
+        g = Group("dp", _mesh)
+        parts = [paddle.to_tensor(np.full((3,), i, np.float32)) for i in range(4)]
+        t = paddle.to_tensor(np.zeros((4, 3), np.float32))
+        dist.scatter(t, parts, src=0, group=g)
+        np.testing.assert_allclose(t2n(t), np.repeat(np.arange(4.0)[:, None], 3, 1))
+
+
+class TestTracedContext:
+    def test_psum_inside_shard_map(self, _mesh):
+        def body(x):
+            t = paddle.Tensor(x)
+            dist.all_reduce(t, group=Group("dp", _mesh))
+            return t.value
+
+        f = shard_map(body, mesh=_mesh, in_specs=P("dp"), out_specs=P("dp"),
+                      check_vma=False)
+        x = np.random.randn(4, 6).astype(np.float32)
+        out = jax.jit(f)(jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(out), np.broadcast_to(x.sum(0, keepdims=True), (4, 6)),
+            rtol=1e-5)
+
+    def test_all_gather_inside_shard_map(self, _mesh):
+        def body(x):
+            out = []
+            dist.all_gather(out, paddle.Tensor(x[0]), group=Group("mp", _mesh))
+            return jnp.stack([o.value for o in out])[None]
+
+        f = shard_map(body, mesh=_mesh, in_specs=P("mp"), out_specs=P("mp"),
+                      check_vma=False)
+        x = np.random.randn(2, 3).astype(np.float32)
+        out = np.asarray(jax.jit(f)(jnp.asarray(x)))
+        # each shard sees the full stack
+        np.testing.assert_allclose(out[0], x)
+        np.testing.assert_allclose(out[1], x)
+
+
+class TestTopology:
+    def test_communicate_topology_math(self):
+        topo = dist.CommunicateTopology(("data", "pipe", "model"), (2, 2, 2))
+        assert topo.world_size() == 8
+        assert topo.get_rank(data=1, pipe=0, model=1) == 5
+        assert topo.get_coord(5) == (1, 0, 1)
+        comm = topo.get_comm_list("model")
+        assert [0, 1] in comm and [6, 7] in comm
+
+    def test_hcg_over_mesh(self, _mesh):
+        hcg = dist.HybridCommunicateGroup(mesh=_mesh)
+        assert hcg.get_data_parallel_world_size() == 4
+        assert hcg.get_model_parallel_world_size() == 2
+        assert hcg.get_pipe_parallel_world_size() == 1
+        assert hcg.get_model_parallel_group().nranks == 2
+
+    def test_build_mesh_infers_dp(self):
+        m = build_mesh(mp=2)
+        assert m.shape["dp"] == 4 and m.shape["mp"] == 2
