@@ -33,6 +33,7 @@ class Tensor:
         "_out_idx",
         "_hooks",
         "_retain_grad",
+        "pspec",
         "__weakref__",
     )
 

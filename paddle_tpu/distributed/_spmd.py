@@ -1,0 +1,113 @@
+"""SPMD sharding helpers — the glue between Layer parameters and GSPMD.
+
+TPU-native replacement for the reference's per-process parameter splitting
+(fleet/layers/mpu/mp_layers.py slices each rank's shard at construction
+time). Here a parameter always holds the FULL logical array and carries a
+``PartitionSpec``; under ``jax.jit`` over the global mesh, GSPMD places the
+shards and inserts the collectives (the scaling-book recipe: pick a mesh,
+annotate shardings, let XLA do the rest). Eagerly (no jit) the full array is
+used directly, so single-device math is bit-identical to the parallel run —
+which is exactly the reference's numerical-parity test contract
+(SURVEY.md §4.2).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .topology import get_mesh
+
+__all__ = ["P", "set_pspec", "get_pspec", "constraint", "layer_pspecs",
+           "named_sharding", "shard_params"]
+
+
+def set_pspec(param, spec) -> None:
+    """Attach a PartitionSpec to a parameter/tensor (metadata only)."""
+    try:
+        param.pspec = spec
+    except AttributeError:
+        object.__setattr__(param, "pspec", spec)
+    # reference-parity flags (mp_layers sets is_distributed/split_axis)
+    try:
+        axes = [i for i, a in enumerate(spec) if a is not None]
+        param.is_distributed = bool(axes)
+        param.split_axis = axes[0] if axes else None
+    except (AttributeError, TypeError):
+        pass
+
+
+def get_pspec(param) -> Optional[P]:
+    return getattr(param, "pspec", None)
+
+
+def _filter_spec(spec: P, mesh: Mesh) -> P:
+    """Drop axes absent from (or size-1 in) the mesh so specs written for the
+    full hybrid axis set stay valid on smaller meshes."""
+    def keep(a):
+        if a is None:
+            return None
+        names = a if isinstance(a, (tuple, list)) else (a,)
+        live = tuple(n for n in names if n in mesh.shape and mesh.shape[n] > 1)
+        if not live:
+            return None
+        return live if len(live) > 1 else live[0]
+
+    return P(*(keep(a) for a in spec))
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def constraint(x, spec, mesh: Optional[Mesh] = None):
+    """``with_sharding_constraint`` that is a no-op outside tracing and on
+    axes the current mesh doesn't have. Accepts Tensor or jax array; returns
+    the same kind."""
+    from ..core.tensor import Tensor
+
+    val = x._value if isinstance(x, Tensor) else x
+    if not _is_tracer(val):
+        return x
+    mesh = mesh or get_mesh()
+    fspec = _filter_spec(spec, mesh)
+    if all(a is None for a in fspec):
+        return x
+    out = jax.lax.with_sharding_constraint(val, NamedSharding(mesh, fspec))
+    if isinstance(x, Tensor):
+        t = Tensor(out, stop_gradient=x.stop_gradient)
+        t._node = getattr(x, "_node", None)
+        return t
+    return out
+
+
+def named_sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, _filter_spec(spec, mesh))
+
+
+def layer_pspecs(layer) -> Dict[str, P]:
+    """name → PartitionSpec for every parameter/buffer of a Layer (replicated
+    P() when unannotated). Matches Layer.raw_state keys, so the dict drops
+    straight into jit in_shardings."""
+    specs = {}
+    for name, p in layer.named_parameters():
+        specs[name] = get_pspec(p) or P()
+    for name, b in layer.named_buffers():
+        specs[name] = get_pspec(b) or P()
+    return specs
+
+
+def shard_params(layer, mesh: Optional[Mesh] = None):
+    """Physically place every parameter of `layer` onto the mesh according to
+    its pspec (device_put with NamedSharding). The eager analog of jit
+    in_shardings — call once after building a model on a live mesh."""
+    mesh = mesh or get_mesh()
+    for _, p in list(layer.named_parameters()) + list(layer.named_buffers()):
+        spec = get_pspec(p) or P()
+        sh = NamedSharding(mesh, _filter_spec(spec, mesh))
+        p._inplace_(jax.device_put(p._value, sh))
+    return layer

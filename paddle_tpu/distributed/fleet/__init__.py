@@ -1,0 +1,121 @@
+"""paddle.distributed.fleet parity (fleet/fleet.py:167,1044; fleet/model.py:30).
+
+TPU-native: ``fleet.init`` builds the global hybrid Mesh from
+DistributedStrategy degrees and installs an HybridCommunicateGroup view over
+it; ``distributed_model``/``distributed_optimizer`` pick the same wrapper
+taxonomy as the reference (DP/TP/PP/sharding), each of which maps to mesh
+shardings rather than per-process comm groups.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..topology import (HybridCommunicateGroup, build_mesh, get_mesh,
+                        set_mesh)
+from .base.distributed_strategy import DistributedStrategy
+from . import meta_parallel  # noqa: F401
+from .layers import mpu  # noqa: F401
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_num", "worker_index", "is_first_worker", "barrier_worker",
+           "meta_parallel", "mpu", "utils"]
+
+_fleet_state = {"initialized": False, "hcg": None, "strategy": None}
+
+
+def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStrategy] = None):
+    """fleet/fleet.py:167 parity. Builds the hybrid mesh from strategy
+    degrees (defaults: whole world on dp)."""
+    import jax
+
+    strategy = strategy or DistributedStrategy()
+    h = strategy.hybrid_configs
+    try:
+        mesh = build_mesh(dp=h["dp_degree"], pp=h["pp_degree"],
+                          sharding=h["sharding_degree"], mp=h["mp_degree"],
+                          sp=h.get("sp_degree", 1), ep=h.get("ep_degree", 1))
+    except ValueError:
+        if int(os.environ.get("FLEET_STRICT_MESH", "0")):
+            raise
+        mesh = build_mesh()  # degrees don't fit this host: all-dp fallback
+    set_mesh(mesh)
+    hcg = HybridCommunicateGroup(mesh=mesh)
+    _fleet_state.update(initialized=True, hcg=hcg, strategy=strategy)
+    return
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _fleet_state["hcg"] is None:
+        init()
+    return _fleet_state["hcg"]
+
+
+def distributed_model(model):
+    """fleet/model.py:30 parity — wrap by parallel mode."""
+    from .meta_parallel.tensor_parallel import TensorParallel
+
+    hcg = get_hybrid_communicate_group()
+    strategy = _fleet_state["strategy"] or DistributedStrategy()
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .meta_parallel.pipeline_parallel import PipelineParallel
+
+        return PipelineParallel(model, hcg, strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        from .meta_parallel.sharding_parallel import ShardingParallel
+
+        return ShardingParallel(model, hcg, strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        from ..parallel import DataParallel
+
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    """fleet/fleet.py:1044 parity — HybridParallelOptimizer when any hybrid
+    dim is active; sharding stage-1 optimizer when sharding_degree>1."""
+    from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
+        HybridParallelOptimizer)
+
+    hcg = get_hybrid_communicate_group()
+    strategy = strategy or _fleet_state["strategy"] or DistributedStrategy()
+    return HybridParallelOptimizer(optimizer, hcg, strategy)
+
+
+def worker_num() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def worker_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from .. import barrier
+
+    try:
+        barrier()
+    except Exception:
+        pass
+
+
+def __getattr__(name):
+    if name in ("utils", "recompute"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

@@ -1,0 +1,3 @@
+from .distributed_strategy import DistributedStrategy
+
+__all__ = ["DistributedStrategy"]

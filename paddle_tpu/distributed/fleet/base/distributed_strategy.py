@@ -1,0 +1,95 @@
+"""DistributedStrategy — typed config tree.
+
+Reference: fleet/base/distributed_strategy.py (2.6K LoC protobuf wrapper over
+framework/distributed_strategy.proto; HybridConfig at proto:69-76). TPU-native
+redesign per SURVEY.md §5.6: plain dataclass-style tree + FLAGS_-style env
+override; keeps the hybrid degrees {dp, mp, pp, sharding(+stage), sp, ep}.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+__all__ = ["DistributedStrategy"]
+
+_DEFAULT_HYBRID = {
+    "dp_degree": 1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sp_degree": 1,
+    "ep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sp", "ep", "mp"],
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel degrees (reference HybridConfig, proto:69-76)
+        self.hybrid_configs: Dict[str, Any] = copy.deepcopy(_DEFAULT_HYBRID)
+        self.hybrid_parallel_order = list(_DEFAULT_HYBRID["order"])
+        # AMP (reference amp sub-config)
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 32768.0,
+            "use_dynamic_loss_scaling": True,
+            "custom_white_list": [],
+            "custom_black_list": [],
+            "use_pure_fp16": False,
+            "use_pure_bf16": False,
+        }
+        # recompute
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        # sharding (ZeRO)
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {
+            "stage": 1, "degree": 1, "offload": False,
+            "accumulate_steps": 1,
+        }
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {
+            "accumulate_steps": 1, "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+        }
+        # gradient merge / accumulation
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # misc toggles kept for parity
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.heter_ccl_mode = False
+        self.without_graph_optimization = True
+
+    def _set_hybrid(self, **kw):
+        self.hybrid_configs.update(kw)
+
+    @property
+    def hybrid_configs_degrees(self):
+        h = self.hybrid_configs
+        return (h["dp_degree"], h["pp_degree"], h["sharding_degree"],
+                h["mp_degree"], h.get("sp_degree", 1), h.get("ep_degree", 1))
+
+    def __setattr__(self, k, v):
+        # hybrid_configs accepts partial-dict assignment like the reference
+        if k == "hybrid_configs" and isinstance(v, dict) and hasattr(self, "hybrid_configs"):
+            merged = copy.deepcopy(_DEFAULT_HYBRID)
+            merged.update(self.__dict__.get("hybrid_configs", {}))
+            merged.update(v)
+            self.__dict__[k] = merged
+            return
+        self.__dict__[k] = v
+
+    def __repr__(self):
+        h = self.hybrid_configs
+        return (f"DistributedStrategy(dp={h['dp_degree']}, mp={h['mp_degree']}, "
+                f"pp={h['pp_degree']}, sharding={h['sharding_degree']}, "
+                f"amp={self.amp}, recompute={self.recompute})")

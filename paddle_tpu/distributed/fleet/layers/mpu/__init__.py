@@ -1,0 +1,9 @@
+from . import mp_ops
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .random import (RNGStatesTracker, get_rng_state_tracker,
+                     model_parallel_random_seed)
+
+__all__ = ["mp_ops", "ColumnParallelLinear", "ParallelCrossEntropy",
+           "RowParallelLinear", "VocabParallelEmbedding", "RNGStatesTracker",
+           "get_rng_state_tracker", "model_parallel_random_seed"]

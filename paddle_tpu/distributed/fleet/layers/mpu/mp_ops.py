@@ -1,0 +1,255 @@
+"""Tensor-parallel communication primitives.
+
+Reference: fleet/layers/mpu/mp_ops.py:26,90,152,218,297,374,664
+(_c_identity/_c_concat/_c_split/_mp_allreduce/_c_lookup_table/
+_c_softmax_with_cross_entropy/split).
+
+TPU-native dual-context design (same contract as
+distributed/communication/core.py):
+- **manual context** (inside ``shard_map`` with the mp axis bound): real
+  ``lax`` collectives with custom VJPs giving the Megatron f/g conjugate
+  pairs (identity-fwd/allreduce-bwd and allreduce-fwd/identity-bwd).
+- **auto context** (GSPMD: plain jit over the mesh, or eager): the ops are
+  sharding *constraints* — XLA inserts the collectives, and the VJP pairs
+  fall out of GSPMD's transpose rules automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....core.autograd import apply_op
+from .....core.tensor import Tensor
+from ...._spmd import P, constraint
+from ....communication.core import in_traced_context
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce",
+           "_c_lookup_table", "_c_softmax_with_cross_entropy", "split",
+           "mp_axis_name"]
+
+MP_AXIS = "mp"
+
+
+def mp_axis_name(group=None) -> str:
+    if group is not None and getattr(group, "axis_name", None):
+        return group.axis_name
+    return MP_AXIS
+
+
+def _manual(axis: str) -> bool:
+    """True when the mp axis is bound as a manual (shard_map) axis."""
+    return in_traced_context(axis)
+
+
+# --- f/g conjugate primitives (manual context) -----------------------------
+
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _identity_ar_bwd(x, axis: str):
+    return x
+
+
+def _identity_fwd(x, axis):
+    return x, None
+
+
+def _identity_bwd(axis, res, g):
+    return (lax.psum(g, axis),)
+
+
+_identity_ar_bwd.defvjp(_identity_fwd, _identity_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _allreduce_id_bwd(x, axis: str):
+    return lax.psum(x, axis)
+
+
+def _ar_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _ar_bwd(axis, res, g):
+    return (g,)
+
+
+_allreduce_id_bwd.defvjp(_ar_fwd, _ar_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _split_gather_bwd(x, axis: str):
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    blk = x.shape[-1] // n
+    return lax.dynamic_slice_in_dim(x, idx * blk, blk, axis=x.ndim - 1)
+
+
+def _split_fwd(x, axis):
+    return _split_gather_bwd(x, axis), x.shape[-1]
+
+
+def _split_bwd(axis, full_dim, g):
+    # cotangent of a replicated input: every rank contributes its own block —
+    # zero-pad to the full dim and psum (≡ the reference's c_allgather bwd)
+    idx = lax.axis_index(axis)
+    blk = g.shape[-1]
+    padded = jnp.zeros(g.shape[:-1] + (full_dim,), g.dtype)
+    padded = lax.dynamic_update_slice_in_dim(padded, g, idx * blk,
+                                             axis=g.ndim - 1)
+    return (lax.psum(padded, axis),)
+
+
+_split_gather_bwd.defvjp(_split_fwd, _split_bwd)
+
+
+# --- public ops ------------------------------------------------------------
+
+def _c_identity(tensor, group=None):
+    """Fwd identity / bwd allreduce over mp (Megatron "f").
+    reference mp_ops.py:26. In auto context GSPMD's transpose generates the
+    backward psum from the sharded consumers, so this is a pass-through."""
+    axis = mp_axis_name(group)
+    if _manual(axis):
+        return apply_op(lambda v: _identity_ar_bwd(v, axis), tensor,
+                        op_name="c_identity")
+    return tensor
+
+
+def _mp_allreduce(tensor, group=None, use_calc_stream=True, use_model_parallel=True):
+    """Fwd allreduce / bwd identity over mp (Megatron "g").
+    reference mp_ops.py:218. Auto context: a replicated-sharding constraint —
+    XLA materialises the psum when producers are mp-partial."""
+    axis = mp_axis_name(group)
+    if _manual(axis):
+        return apply_op(lambda v: _allreduce_id_bwd(v, axis), tensor,
+                        op_name="mp_allreduce")
+    # auto/GSPMD: partial-sums are already resolved by the compiler at use
+    # sites; nothing to do eagerly.
+    return tensor
+
+
+def _c_split(tensor, group=None):
+    """Keep this rank's slice of the last dim. reference mp_ops.py:152.
+    Manual: dynamic-slice by axis_index (bwd = all_gather via custom vjp
+    falls out of slice transpose + psum; we use explicit collective).
+    Auto: a sharding constraint putting the last dim on mp."""
+    axis = mp_axis_name(group)
+    if _manual(axis):
+        return apply_op(lambda v: _split_gather_bwd(v, axis), tensor,
+                        op_name="c_split")
+    nd = tensor.ndim if hasattr(tensor, "ndim") else jnp.ndim(tensor)
+    return constraint(tensor, P(*([None] * (nd - 1) + [MP_AXIS])))
+
+
+def _c_concat(tensor, group=None):
+    """All-gather along the last dim. reference mp_ops.py:90.
+    Auto: replicate-constraint on the last dim."""
+    axis = mp_axis_name(group)
+    if _manual(axis):
+        def f(v):
+            return lax.all_gather(v, axis, axis=v.ndim - 1, tiled=True)
+
+        return apply_op(f, tensor, op_name="c_concat")
+    nd = tensor.ndim if hasattr(tensor, "ndim") else jnp.ndim(tensor)
+    return constraint(tensor, P(*([None] * nd)))
+
+
+def _c_lookup_table(table, index, start_index=0, vocab_size=-1, name=None, group=None):
+    """Vocab-parallel embedding lookup (reference mp_ops.py:297 →
+    c_embedding_op.cu). Manual context: mask ids outside the local vocab
+    shard, lookup locally, psum partial rows. Auto: plain take — GSPMD
+    shards the gather along the vocab dim of the table."""
+    axis = mp_axis_name(group)
+    if _manual(axis):
+        def f(tbl, idx):
+            rank = lax.axis_index(axis)
+            per = tbl.shape[0]
+            local = idx - rank * per
+            ok = (local >= 0) & (local < per)
+            safe = jnp.where(ok, local, 0)
+            out = jnp.take(tbl, safe, axis=0)
+            out = jnp.where(ok[..., None], out, 0.0).astype(tbl.dtype)
+            return lax.psum(out, axis)
+
+        return apply_op(f, table, index, op_name="c_lookup_table")
+
+    def f(tbl, idx):
+        return jnp.take(tbl, idx, axis=0)
+
+    return apply_op(f, table, index, op_name="c_lookup_table")
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None, ignore_index=-100,
+                                  return_softmax=False):
+    """Class-parallel softmax cross entropy (reference mp_ops.py:374 →
+    c_softmax_with_cross_entropy_op.cu): logits' class dim is sharded over
+    mp; global max/sum ride the mp axis.
+
+    Manual context: explicit pmax/psum reductions over the local class shard.
+    Auto: numerically-identical global math; GSPMD partitions the reductions.
+    """
+    axis = mp_axis_name(group)
+    if _manual(axis):
+        def f(lg, lb):
+            rank = lax.axis_index(axis)
+            per = lg.shape[-1]
+            ignored = lb == ignore_index
+            gmax = lax.pmax(jnp.max(lg, axis=-1, keepdims=True), axis)
+            ex = jnp.exp(lg - gmax)
+            gsum = lax.psum(jnp.sum(ex, axis=-1, keepdims=True), axis)
+            # local logit of the target class (0 when not on this shard)
+            local = lb - rank * per
+            ok = (local >= 0) & (local < per) & ~ignored
+            safe = jnp.where(ok, local, 0)
+            picked = jnp.take_along_axis(lg, safe[..., None], axis=-1)
+            picked = jnp.where(ok[..., None], picked, 0.0)
+            tgt = lax.psum(picked, axis)
+            loss = (jnp.log(gsum) + gmax - tgt)
+            loss = jnp.where(ignored[..., None], 0.0, loss)
+            soft = ex / gsum
+            return (loss, soft) if return_softmax else loss
+
+        out = apply_op(f, logits, label, op_name="c_softmax_with_cross_entropy")
+        return out
+
+    def f(lg, lb):
+        ignored = lb == ignore_index
+        safe_lb = jnp.where(ignored, 0, lb)
+        gmax = jnp.max(lg, axis=-1, keepdims=True)
+        ex = jnp.exp(lg - gmax)
+        gsum = jnp.sum(ex, axis=-1, keepdims=True)
+        idx = safe_lb[..., None] if safe_lb.ndim < lg.ndim else safe_lb
+        tgt = jnp.take_along_axis(lg, idx, axis=-1)
+        loss = jnp.log(gsum) + gmax - tgt
+        loss = jnp.where(ignored[..., None] if ignored.ndim < loss.ndim else ignored,
+                         0.0, loss)
+        soft = ex / gsum
+        return (loss, soft) if return_softmax else loss
+
+    return apply_op(f, logits, label, op_name="c_softmax_with_cross_entropy")
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference mp_ops.py:664 — builds a parallel linear/embedding layer.
+    Kept for API parity; delegates to the mpu layer classes."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"unsupported operation {operation}")

@@ -1,0 +1,102 @@
+"""TP-correct RNG state tracking.
+
+Reference: fleet/layers/mpu/random.py (RNGStatesTracker:34,
+get_rng_state_tracker:84, model_parallel_random_seed:88).
+
+On TPU the hard problem the reference solves (per-mp-rank curand streams so
+dropout masks differ across shards but replicate across dp) mostly
+disappears: a single functional PRNG key used on a GSPMD-sharded tensor
+already yields one consistent *global* mask, each device computing its
+shard. The tracker is kept for API parity and for the manual/shard_map
+path, where "local" streams fold the mp coordinate into the key.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....core import random as core_random
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "determinate_seed", "dropout"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    """Named RNG streams (reference random.py:34). Each stream is an
+    independent counter-based Generator; ``rng_state(name)`` temporarily
+    swaps the default generator so every op in scope draws from it."""
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states_tracker(self):
+        return {k: g.get_state() for k, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for k, s in states.items():
+            self.states_.setdefault(k, core_random.Generator()).set_state(s)
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = core_random.Generator(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = core_random.default_generator
+        core_random.default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            core_random.default_generator = orig
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """reference random.py:88 — seed global + local streams. The "local"
+    mp-offset stream matters only on the manual path; GSPMD dropout uses one
+    global stream."""
+    import random as pyrandom
+
+    seed = seed if seed is not None else pyrandom.randint(0, 2**31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024  # offset stream for shard-local masks
+    _RNG_STATE_TRACKER.reset()
+    core_random.seed(global_seed)
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+
+
+def determinate_seed(rng_name):
+    g = _RNG_STATE_TRACKER.states_.get(rng_name)
+    return g.initial_seed() if g else core_random.default_generator.initial_seed()
+
+
+def dropout(x, p=0.5, axis=None, rng_name=None, training=True,
+            mode="upscale_in_train", name=None):
+    """Dropout drawing from a named tracker stream (reference random.py
+    exposes the same signature)."""
+    from .....nn import functional as F
+
+    if rng_name is None:
+        return F.dropout(x, p, axis=axis, training=training, mode=mode)
+    with get_rng_state_tracker().rng_state(rng_name):
+        return F.dropout(x, p, axis=axis, training=training, mode=mode)

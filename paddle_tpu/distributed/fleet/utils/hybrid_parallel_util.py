@@ -1,0 +1,49 @@
+"""Hybrid-parallel gradient sync helpers.
+
+Reference: fleet/utils/hybrid_parallel_util.py:227,233
+(fused_allreduce_gradients / sharding_reduce_gradients): bucket all grads
+and allreduce over the dp (or sharding) group after backward.
+
+TPU-native: in auto/GSPMD context gradients of a data-parallel step are
+produced by a psum the compiler already inserted (the batch axis is sharded
+over dp), so the eager call is a no-op there; in the eager stacked-ranks
+convention it delegates to the collective engine's all_reduce with AVG.
+"""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+from ...communication.core import in_traced_context
+
+__all__ = ["fused_allreduce_gradients", "sharding_reduce_gradients"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None, group=None):
+    from ... import all_reduce
+    from ...communication.core import ReduceOp
+
+    axis = "dp"
+    if group is not None and getattr(group, "axis_name", None):
+        axis = group.axis_name
+    if in_traced_context(axis):
+        # manual context: psum each grad over dp
+        from jax import lax
+
+        for p in parameter_list:
+            if p.grad is not None:
+                p.grad._inplace_(lax.pmean(p.grad.value, axis))
+        return
+    # single-controller eager: grads are logically global already (dp batch
+    # dim is a sharding of ONE global batch) — nothing to reduce.
+    return
+
+
+def sharding_reduce_gradients(parameter_list, hcg=None):
+    """Stage-1/2 grad reduction: same dual-context contract over the
+    sharding axis (reference hybrid_parallel_util.py:233)."""
+    if in_traced_context("sharding"):
+        from jax import lax
+
+        for p in parameter_list:
+            if p.grad is not None:
+                p.grad._inplace_(lax.pmean(p.grad.value, "sharding"))
+    return

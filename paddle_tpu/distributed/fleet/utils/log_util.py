@@ -1,0 +1,20 @@
+"""fleet logger (reference fleet/utils/log_util.py)."""
+import logging
+
+logger = logging.getLogger("paddle_tpu.fleet")
+if not logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s [%(name)s] %(message)s"))
+    logger.addHandler(_h)
+logger.setLevel(logging.INFO)
+
+
+def set_log_level(level):
+    logger.setLevel(level)
+
+
+def get_logger(level=logging.INFO, name="paddle_tpu.fleet"):
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    return lg

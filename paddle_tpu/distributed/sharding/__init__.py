@@ -1,0 +1,12 @@
+"""paddle.distributed.sharding parity (distributed/sharding/group_sharded.py).
+
+ZeRO-style sharding on the ``sharding`` mesh axis. TPU-native: sharding a
+state means annotating it with a PartitionSpec over the sharding axis and
+letting GSPMD place/partition it — reduce-scatter of grads and all-gather of
+params fall out of the sharding propagation (scaling-book ZeRO recipe).
+"""
+from .group_sharded import group_sharded_parallel, save_group_sharded_model
+from .sharded_optimizer import shard_optimizer_states
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "shard_optimizer_states"]
