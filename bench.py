@@ -1,0 +1,111 @@
+"""Benchmark driver: one JSON line for the round record.
+
+Measures flagship-model (Llama-family) training throughput on the available
+chip: tokens/sec/chip and MFU (model FLOPs 6·N·tokens / peak). North star
+(BASELINE.md): ≥50% MFU — `vs_baseline` reports MFU/0.50 so 1.0 == target
+(the reference publishes no absolute numbers, BASELINE.json "published": {}).
+
+Run: python bench.py            (real TPU under axon; CPU fallback = tiny cfg)
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_config
+    from paddle_tpu.nn.functional_call import functional_call
+    from paddle_tpu.optimizer.functional import (adamw_init, adamw_update,
+                                                 clip_by_global_norm)
+
+    if on_tpu:
+        cfg = llama_config("350m", dtype="bfloat16",
+                           max_position_embeddings=2048)
+        batch, seq, steps = 8, 2048, 10
+        kind = jax.devices()[0].device_kind.lower()
+        if "lite" in kind or "v5e" in kind:
+            peak = 394e12  # v5e bf16
+        elif "v5" in kind:
+            peak = 459e12  # v5p bf16
+        else:
+            peak = 275e12  # v4
+    else:
+        cfg = llama_config("tiny")
+        batch, seq, steps = 4, 128, 3
+        peak = 1e12  # meaningless on CPU; MFU reported but not comparable
+
+    model = LlamaForCausalLM(cfg)
+    model.eval()  # no dropout; training math is the same here
+    params = {k: p.value for k, p in model.named_parameters()}
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    opt_state = adamw_init(params)
+
+    def loss_fn(pv, ids, labels):
+        return functional_call(model, pv, paddle.Tensor(ids),
+                               paddle.Tensor(labels))
+
+    def train_step(pv, st, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(pv, ids, labels)
+        grads, _ = clip_by_global_norm(grads, 1.0)
+        st, pv = adamw_update(grads, st, pv, lr=1e-4)
+        return pv, st, loss
+
+    # ONE dispatch for the whole timed loop (lax.fori_loop inside jit): the
+    # remote-tunnel dispatch latency would otherwise dominate, and
+    # block_until_ready is not an honest barrier through the tunnel — a
+    # scalar host readback is.
+    def multi_step(pv, st, ids, labels, n):
+        import jax.numpy as jnp
+
+        def body(_, carry):
+            pv, st, _ = carry
+            pv, st, loss = train_step(pv, st, ids, labels)
+            return pv, st, loss.astype(jnp.float32)
+
+        return jax.lax.fori_loop(0, n, body,
+                                 (pv, st, jnp.zeros((), jnp.float32)))
+
+    jitted = jax.jit(multi_step, static_argnums=(4,), donate_argnums=(0, 1))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+
+    # warmup / compile with the SAME static n as the timed call
+    params, opt_state, loss = jitted(params, opt_state, ids, labels, steps)
+    _ = float(loss)  # host readback barrier
+
+    t0 = time.perf_counter()
+    params, opt_state, loss = jitted(params, opt_state, ids, labels, steps)
+    loss_val = float(loss)  # host readback barrier
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tps = tokens / dt
+    model_flops = 6.0 * n_params * tokens  # fwd+bwd ≈ 6·N per token
+    mfu = model_flops / dt / peak
+    rec = {
+        "metric": f"llama_{'350m' if on_tpu else 'tiny'}_train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "mfu": round(mfu, 4),
+        "params": n_params,
+        "platform": platform,
+        "final_loss": loss_val,
+    }
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,7 @@ _LAZY_SUBMODULES = {
     "io",
     "jit",
     "metric",
+    "models",
     "nn",
     "optimizer",
     "profiler",

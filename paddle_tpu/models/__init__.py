@@ -1,0 +1,23 @@
+"""Flagship model families built on the TP layer stack.
+
+Reference analog: PaddleNLP-style model zoo driven by the framework's fleet
+TP/PP layers (the reference repo itself ships the layer stack —
+fleet/layers/mpu — and fused transformer ops; the model graph lives here so
+benchmarks and the driver entry have a first-class citizen to run).
+"""
+from . import llama
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    llama_config)
+
+__all__ = ["llama", "LlamaConfig", "LlamaForCausalLM", "LlamaModel",
+           "llama_config"]
+
+
+def __getattr__(name):
+    if name == "gpt":
+        import importlib
+
+        mod = importlib.import_module(".gpt", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

@@ -1,0 +1,202 @@
+"""GPT-3-family causal LM (BASELINE.json config 2: GPT-3 1.3B tensor-parallel).
+
+Pre-LN transformer: learned position embeddings, LayerNorm, GELU MLP —
+built from the same TP layer stack as the Llama family (fleet/layers/mpu).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..distributed._spmd import P, constraint
+from ..distributed.fleet.layers.mpu import (ColumnParallelLinear,
+                                            ParallelCrossEntropy,
+                                            RowParallelLinear,
+                                            VocabParallelEmbedding)
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_config"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: Optional[int] = None  # None → 4*hidden
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.0
+    dtype: str = "float32"
+    recompute: str = "none"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+_PRESETS = {
+    "tiny": (64, 2, 4, 256, 128),
+    "125m": (768, 12, 12, 50304, 2048),
+    "1b3":  (2048, 24, 16, 50304, 2048),
+    "6b7":  (4096, 32, 32, 50304, 2048),
+}
+
+
+def gpt_config(preset: str = "tiny", **overrides) -> GPTConfig:
+    h, l, a, v, m = _PRESETS[preset]
+    cfg = GPTConfig(hidden_size=h, num_hidden_layers=l, num_attention_heads=a,
+                    vocab_size=v, max_position_embeddings=m)
+    for k, val in overrides.items():
+        setattr(cfg, k, val)
+    return cfg
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        h = config.hidden_size
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, has_bias=True,
+                                          input_is_parallel=True)
+
+    def forward(self, x):
+        cfg = self.config
+        b, s = x.shape[0], x.shape[1]
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        qkv = self.qkv_proj(x)
+
+        def split_heads(t):
+            # [B,S,3H] → 3×[B,S,nh,hd]; qkv packed head-major so the mp shard
+            # of the fused dim stays a contiguous block of heads
+            t = t.reshape(b, s, 3, nh, hd)
+            return t[:, :, 0], t[:, :, 1], t[:, :, 2]
+
+        q, k, v = apply_op(split_heads, qkv, op_name="split_qkv")
+        q = constraint(q, P("dp", None, "mp", None))
+        k = constraint(k, P("dp", None, "mp", None))
+        v = constraint(v, P("dp", None, "mp", None))
+        ctx, _ = F.flash_attention(q, k, v, causal=True,
+                                   dropout=cfg.dropout,
+                                   training=self.training)
+        ctx = apply_op(lambda c: c.reshape(b, s, nh * hd), ctx,
+                       op_name="merge_heads")
+        ctx = constraint(ctx, P("dp", None, "mp"))
+        return self.out_proj(ctx)
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        self.fc_in = ColumnParallelLinear(config.hidden_size, config.ffn_size,
+                                          has_bias=True, gather_output=False)
+        self.fc_out = RowParallelLinear(config.ffn_size, config.hidden_size,
+                                        has_bias=True, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTDecoderLayer(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlp = GPTMLP(config)
+        self.dropout = config.dropout
+
+    def forward(self, x):
+        h = self.attn(self.ln_1(x))
+        if self.dropout:
+            h = F.dropout(h, self.dropout, training=self.training)
+        x = x + h
+        h = self.mlp(self.ln_2(x))
+        if self.dropout:
+            h = F.dropout(h, self.dropout, training=self.training)
+        x = x + h
+        return constraint(x, P("dp", None, None))
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        from ..core.dtype import get_default_dtype, set_default_dtype
+        from ..nn.layer.container import LayerList
+
+        self.config = config
+        prev = get_default_dtype()
+        set_default_dtype(config.dtype)
+        try:
+            self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                       config.hidden_size)
+            self.embed_positions = VocabParallelEmbedding(
+                config.max_position_embeddings, config.hidden_size)
+            self.layers = LayerList([GPTDecoderLayer(config)
+                                     for _ in range(config.num_hidden_layers)])
+            self.ln_f = LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        finally:
+            set_default_dtype(prev)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = apply_op(lambda ids: jnp.arange(s, dtype=jnp.int32)[None, :],
+                       input_ids, op_name="positions")
+        x = self.embed_tokens(input_ids) + self.embed_positions(pos)
+        x = constraint(x, P("dp", None, None))
+        for layer in self.layers:
+            if self.config.recompute == "full" and self.training:
+                from ..distributed.fleet.recompute import recompute
+
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    IGNORE_INDEX = -100
+
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        from ..core.dtype import get_default_dtype, set_default_dtype
+
+        self.model = GPTModel(config)
+        prev = get_default_dtype()
+        set_default_dtype(config.dtype)
+        try:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False)
+        finally:
+            set_default_dtype(prev)
+        self.loss_fn = ParallelCrossEntropy(ignore_index=self.IGNORE_INDEX)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.model(input_ids)
+        logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+
+        def masked_mean(l, lb):
+            n = jnp.maximum(jnp.sum(lb != self.IGNORE_INDEX), 1)
+            return jnp.sum(l) / n.astype(l.dtype)
+
+        return apply_op(masked_mean, self.loss_fn(logits, labels), labels,
+                        op_name="lm_loss_mean")

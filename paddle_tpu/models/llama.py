@@ -1,0 +1,256 @@
+"""Llama-family causal LM, TPU-native.
+
+Architecture: RMSNorm / RoPE / GQA attention / SwiGLU — the Llama-2 recipe,
+built from the tensor-parallel layer stack
+(distributed/fleet/layers/mpu/mp_layers.py) so the SAME module runs
+single-chip, TP-sharded under GSPMD (weights carry PartitionSpecs), or
+inside shard_map. Reference analogs: the reference's fused transformer
+blocks (fluid/operators/fused/fused_multi_transformer_op.cu) define the
+fusion targets; attention runs through nn.functional.flash_attention which
+routes to the Pallas kernel on TPU.
+
+Sharding plan (scaling-book "2D finalized" layout):
+- embed/lm_head:  vocab on mp                       P('mp', None)
+- q/k/v/gate/up:  out-dim on mp (column parallel)   P(None, 'mp')
+- o/down:         in-dim on mp (row parallel)       P('mp', None)
+- activations:    batch on dp(+sharding), heads/ffn on mp via constraints
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..distributed._spmd import P, constraint, set_pspec
+from ..distributed.fleet.layers.mpu import (ColumnParallelLinear,
+                                            ParallelCrossEntropy,
+                                            RowParallelLinear,
+                                            VocabParallelEmbedding)
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_config"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None  # GQA; None → MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+    # remat policy for the decoder stack ("none" | "full")
+    recompute: str = "none"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+
+_PRESETS = {
+    # name: (hidden, inter, layers, heads, kv_heads, vocab)
+    "tiny":  (64, 176, 2, 4, 4, 256),        # CI / dryrun
+    "350m":  (1024, 2816, 24, 16, 16, 32000),
+    "1b3":   (2048, 5504, 24, 16, 16, 32000),
+    "7b":    (4096, 11008, 32, 32, 32, 32000),
+    "13b":   (5120, 13824, 40, 40, 40, 32000),
+    "65b":   (8192, 22016, 80, 64, 8, 32000),
+}
+
+
+def llama_config(preset: str = "tiny", **overrides) -> LlamaConfig:
+    h, i, l, a, kv, v = _PRESETS[preset]
+    cfg = LlamaConfig(hidden_size=h, intermediate_size=i, num_hidden_layers=l,
+                      num_attention_heads=a, num_key_value_heads=kv,
+                      vocab_size=v)
+    for k, val in overrides.items():
+        setattr(cfg, k, val)
+    return cfg
+
+
+def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype):
+    """Precompute RoPE cos/sin tables [seq, head_dim//2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary_emb(x, cos, sin):
+    """x: [B, S, H, D]; rotate-half RoPE (reference analog:
+    fused_rope_kernel.cu:87 fused_rotary_position_embedding)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        h = config.hidden_size
+        hd = config.head_dim
+        self.num_heads = config.num_attention_heads
+        self.kv_heads = config.kv_heads
+        self.q_proj = ColumnParallelLinear(h, self.num_heads * hd,
+                                           has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, self.kv_heads * hd,
+                                           has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, self.kv_heads * hd,
+                                           has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(self.num_heads * hd, h,
+                                        has_bias=False, input_is_parallel=True)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        b = x.shape[0]
+        s = x.shape[1]
+        hd = self.config.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+
+        def prep(qv, kv, vv, cv, sv):
+            qh = apply_rotary_emb(qv.reshape(b, s, self.num_heads, hd), cv, sv)
+            kh = apply_rotary_emb(kv.reshape(b, s, self.kv_heads, hd), cv, sv)
+            vh = vv.reshape(b, s, self.kv_heads, hd)
+            if self.kv_heads != self.num_heads:
+                rep = self.num_heads // self.kv_heads
+                kh = jnp.repeat(kh, rep, axis=2)
+                vh = jnp.repeat(vh, rep, axis=2)
+            return qh, kh, vh
+
+        qh, kh, vh = apply_op(prep, q, k, v, cos, sin, op_name="qkv_rope")
+        qh = constraint(qh, P("dp", None, "mp", None))
+        kh = constraint(kh, P("dp", None, "mp", None))
+        vh = constraint(vh, P("dp", None, "mp", None))
+        if attn_mask is None:
+            ctx, _ = F.flash_attention(qh, kh, vh, causal=True)
+        else:
+            ctx = F.scaled_dot_product_attention(
+                qh, kh, vh, attn_mask=attn_mask, is_causal=True)
+        ctx = apply_op(lambda c: c.reshape(b, s, self.num_heads * hd), ctx,
+                       op_name="merge_heads")
+        ctx = constraint(ctx, P("dp", None, "mp"))
+        return self.o_proj(ctx)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                              gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                            gather_output=False)
+        self.down_proj = RowParallelLinear(i, h, has_bias=False,
+                                           input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return constraint(x, P("dp", None, None))
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        from ..nn.layer.container import LayerList
+
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        cfg = self.config
+        x = self.embed_tokens(input_ids)
+        x = constraint(x, P("dp", None, None))
+        s = x.shape[1]
+        cos, sin = _rope_cos_sin(s, cfg.head_dim, cfg.rope_theta,
+                                 x.value.dtype if isinstance(x, Tensor) else x.dtype)
+        for layer in self.layers:
+            if cfg.recompute == "full" and self.training:
+                from ..distributed.fleet.recompute import recompute
+
+                x = recompute(layer, x, cos, sin, attn_mask)
+            else:
+                x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    IGNORE_INDEX = -100
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        from ..core.dtype import get_default_dtype, set_default_dtype
+
+        prev = get_default_dtype()
+        set_default_dtype(config.dtype)  # params honor the config dtype
+        try:
+            self.model = LlamaModel(config)
+            if config.tie_word_embeddings:
+                self.lm_head = None
+            else:
+                self.lm_head = ColumnParallelLinear(
+                    config.hidden_size, config.vocab_size, has_bias=False,
+                    gather_output=False)
+        finally:
+            set_default_dtype(prev)
+        self.loss_fn = ParallelCrossEntropy(ignore_index=self.IGNORE_INDEX)
+
+    def logits(self, hidden):
+        if self.lm_head is None:
+            w = self.model.embed_tokens.weight
+            return apply_op(lambda hv, wv: hv @ wv.T, hidden, w,
+                            op_name="tied_lm_head")
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.model(input_ids, attn_mask)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        loss = self.loss_fn(logits, labels)
+        # mean over NON-ignored positions only (ignored contribute 0 to the
+        # sum; dividing by the total count would scale loss with pad fraction)
+        def masked_mean(l, lb):
+            n = jnp.maximum(jnp.sum(lb != self.IGNORE_INDEX), 1)
+            return jnp.sum(l) / n.astype(l.dtype)
+
+        return apply_op(masked_mean, loss, labels, op_name="lm_loss_mean")

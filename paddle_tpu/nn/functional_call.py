@@ -1,0 +1,74 @@
+"""functional_call — run a Layer's forward with substituted parameter values.
+
+The bridge between the eager Layer API and jitted/pjit-ed training steps:
+a Layer becomes a pure function of (params, buffers, inputs), so whole
+models drop into ``jax.jit``/``jax.grad`` with donated, mesh-sharded param
+pytrees. (The reference needs dy2static AST rewriting for this,
+jit/dy2static/program_translator.py:305; under tracing it is just value
+substitution.)
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+from ..core.tensor import Tensor
+
+__all__ = ["functional_call", "substituted_state"]
+
+
+@contextlib.contextmanager
+def substituted_state(layer, params: Optional[Dict[str, Any]] = None,
+                      buffers: Optional[Dict[str, Any]] = None):
+    """Temporarily swap the raw values of `layer`'s named parameters/buffers.
+    Values may be jax arrays or tracers; autograd nodes are detached for the
+    scope so the substituted values are true leaves."""
+    named_p = dict(layer.named_parameters())
+    named_b = dict(layer.named_buffers())
+    old_p = {k: (p._value, p._node) for k, p in named_p.items()}
+    old_b = {k: b._value for k, b in named_b.items()}
+    try:
+        if params:
+            unknown = set(params) - set(named_p)
+            if unknown:
+                raise KeyError(
+                    f"params keys not found in layer.named_parameters(): "
+                    f"{sorted(unknown)[:5]}{'...' if len(unknown) > 5 else ''}")
+            for k, v in params.items():
+                p = named_p[k]
+                p._value = v._value if isinstance(v, Tensor) else v
+                p._node = None
+        if buffers:
+            unknown = set(buffers) - set(named_b)
+            if unknown:
+                raise KeyError(
+                    f"buffers keys not found in layer.named_buffers(): "
+                    f"{sorted(unknown)[:5]}")
+            for k, v in buffers.items():
+                named_b[k]._value = v._value if isinstance(v, Tensor) else v
+        yield layer
+    finally:
+        for k, p in named_p.items():
+            p._value, p._node = old_p[k]
+        for k, b in named_b.items():
+            b._value = old_b[k]
+
+
+def functional_call(layer, params: Optional[Dict[str, Any]], *args,
+                    buffers: Optional[Dict[str, Any]] = None, **kwargs):
+    """Run ``layer(*args, **kwargs)`` with parameter values taken from
+    `params` (a dict keyed like ``named_parameters``). Returns raw jax values
+    (Tensor outputs are unwrapped) so the caller composes with jax.grad."""
+    import jax
+
+    from ..core.autograd import no_grad
+
+    # no_grad: suppress the eager per-op tape (jax.vjp) — differentiation is
+    # the OUTER transform's job (jax.grad over this function). Nesting the
+    # tape under jax.grad creates higher-order AD, which kernels with
+    # custom_vjp (pallas flash attention) reject.
+    with substituted_state(layer, params, buffers), no_grad():
+        out = layer(*args, **kwargs)
+    return jax.tree.map(
+        lambda o: o._value if isinstance(o, Tensor) else o, out,
+        is_leaf=lambda o: isinstance(o, Tensor))

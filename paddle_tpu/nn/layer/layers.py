@@ -36,7 +36,9 @@ class HookRemoveHelper:
 
 
 class Layer:
-    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        # dtype=None → paddle.get_default_dtype() (paddle parity: layers honor
+        # set_default_dtype at construction time)
         cls = name_scope or self.__class__.__name__.lower()
         _layer_name_counters[cls] += 1
         object.__setattr__(self, "_full_name", f"{cls}_{_layer_name_counters[cls] - 1}")

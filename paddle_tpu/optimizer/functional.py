@@ -1,0 +1,84 @@
+"""Functional optimizer transforms for jitted/pjit-ed training steps.
+
+The eager ``paddle_tpu.optimizer.*`` classes are imperative (reference
+parity); compiled training wants pure (state, grads) -> (state, updates)
+transforms so the whole step — forward, backward, clip, update — is ONE
+XLA program with donated buffers. These mirror the math of the eager
+classes (reference optimizer semantics: python/paddle/optimizer/adamw.py)
+and are what __graft_entry__/bench use.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["adamw_init", "adamw_update", "sgd_update", "clip_by_global_norm",
+           "AdamWState"]
+
+
+class AdamWState(NamedTuple):
+    step: Any
+    m: Any     # first moment, per-param pytree
+    v: Any     # second moment, per-param pytree
+
+
+def adamw_init(params, master_dtype=jnp.float32) -> AdamWState:
+    # moments live in master_dtype regardless of param dtype (bf16 params →
+    # fp32 moments), matching adamw_update's accumulation dtype so the jit
+    # signature is stable from step 0
+    zeros = lambda t: jax.tree.map(
+        lambda p: jnp.zeros(p.shape, master_dtype), t)
+    return AdamWState(step=jnp.zeros((), jnp.int32), m=zeros(params),
+                      v=zeros(params))
+
+
+def adamw_update(grads, state: AdamWState, params, lr=1e-3, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, weight_decay=0.01,
+                 master_dtype=jnp.float32):
+    """One AdamW step. Moments are kept in master_dtype (fp32) regardless of
+    param dtype — the bf16 master-weight pattern on TPU."""
+    step = state.step + 1
+    c1 = 1.0 - beta1 ** step.astype(jnp.float32)
+    c2 = 1.0 - beta2 ** step.astype(jnp.float32)
+
+    def upd(g, m, v, p):
+        g32 = g.astype(master_dtype)
+        m = beta1 * m + (1 - beta1) * g32
+        v = beta2 * v + (1 - beta2) * (g32 * g32)
+        mhat = m / c1
+        vhat = v / c2
+        delta = mhat / (jnp.sqrt(vhat) + epsilon) + weight_decay * p.astype(master_dtype)
+        return m, v, (p.astype(master_dtype) - lr * delta).astype(p.dtype)
+
+    flat_g, tdef = jax.tree.flatten(grads)
+    flat_m = tdef.flatten_up_to(state.m)
+    flat_v = tdef.flatten_up_to(state.v)
+    flat_p = tdef.flatten_up_to(params)
+    new_m, new_v, new_p = [], [], []
+    for g, m, v, p in zip(flat_g, flat_m, flat_v, flat_p):
+        m2, v2, p2 = upd(g, m, v, p)
+        new_m.append(m2)
+        new_v.append(v2)
+        new_p.append(p2)
+    return (AdamWState(step=step, m=tdef.unflatten(new_m),
+                       v=tdef.unflatten(new_v)),
+            tdef.unflatten(new_p))
+
+
+def sgd_update(grads, params, lr=0.01, weight_decay=0.0):
+    def upd(g, p):
+        d = g + weight_decay * p
+        return (p - lr * d).astype(p.dtype)
+
+    return jax.tree.map(upd, grads, params)
+
+
+def clip_by_global_norm(grads, clip_norm: float):
+    leaves = jax.tree.leaves(grads)
+    total = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    gnorm = jnp.sqrt(total)
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-6))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                        grads), gnorm
