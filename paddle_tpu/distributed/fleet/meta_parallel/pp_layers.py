@@ -1,0 +1,307 @@
+"""Pipeline-parallel layer description & segmentation.
+
+Reference: meta_parallel/parallel_layers/pp_layers.py — ``LayerDesc`` (:56),
+``SharedLayerDesc`` (:76, tied embeddings), ``SegmentLayers`` (:92, uniform /
+param-count / manual segmentation), ``PipelineLayer`` (:239, interleave
+segmentation :417-430).
+
+TPU-native redesign: the reference instantiates ONLY the local stage's layers
+in each process and wires NCCL p2p between ranks. Single-controller SPMD
+instead builds the FULL model once; every parameter is tagged with its stage
+id (``param.pp_stage``) so (a) the eager 1F1B driver knows the stage
+boundaries, and (b) the compiled pipeline (pp_compiled.py) can stack
+homogeneous stages and shard them over the ``pp`` mesh axis. Running the
+PipelineLayer eagerly is bit-identical to the serial model — the reference's
+PP-vs-serial loss-parity test contract (SURVEY.md §4.2,
+hybrid_parallel_pp_transformer.py).
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ....nn.layer.layers import Layer
+from ....nn.layer.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer constructor (reference pp_layers.py:56)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("The input of LayerDesc must be Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose parameters are shared between stages (tied input/output
+    embeddings). Reference pp_layers.py:76: each process in the shared-comm
+    group holds a replica and allreduces the grads; here sharing is literal —
+    one Layer object appears at every use site, so the autograd engine
+    accumulates both contributions into the same ``.grad`` and no comm is
+    needed (the TPU-native collapse of ``allreduce_shared_weight_gradients``).
+    """
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layer descs into num_parts contiguous stages.
+    Reference pp_layers.py:92. Methods: "uniform", "layer:<Name>" (split at
+    layers of the named class, e.g. "layer:TransformerBlock")."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        if num_virtual_pipeline_stage:
+            self.total_parts = num_parts * num_virtual_pipeline_stage
+        else:
+            self.total_parts = num_parts
+        if self.num_items < self.total_parts:
+            raise ValueError("layer number should be greater than number of "
+                             "segments")
+
+    def do_segment(self) -> List[int]:
+        if isinstance(self.method, list):
+            # manual boundaries: num_parts+1 monotonically increasing indices
+            seg = self.method
+            if seg[0] != 0 or seg[-1] != self.num_items or len(seg) != self.total_parts + 1:
+                raise ValueError(f"invalid manual segment {seg}")
+            return list(seg)
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.total_parts)
+        if self.method.startswith("layer:"):
+            name = self.method.split(":", 1)[1]
+            weights = [0] * len(self._layers_desc)
+            for i, d in enumerate(self._layers_desc):
+                cls = d.layer_func if isinstance(d, LayerDesc) else type(d)
+                if getattr(cls, "__name__", "") == name:
+                    weights[i] = 1
+            actual = sum(weights)
+            if actual < self.total_parts:
+                raise ValueError(
+                    f"need at least {self.total_parts} layers named {name}, "
+                    f"found {actual}")
+            return self.segment_by_weights(weights)
+        if self.method == "parameter":
+            weights = []
+            for d in self._layers_desc:
+                # estimate param count without building: build once, count,
+                # discard (descs are cheap relative to training)
+                layer = d.build_layer() if isinstance(d, LayerDesc) else d
+                n = sum(int(np.prod(p.shape)) for _, p in layer.named_parameters())
+                weights.append(max(n, 1))
+            return self.segment_by_weights(weights)
+        raise ValueError(f"unknown seg_method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+    def segment_by_weights(self, weights) -> List[int]:
+        # balance cumulative weight across parts (greedy prefix split)
+        total = sum(weights)
+        target = total / self.total_parts
+        result = [0]
+        acc = 0.0
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= target * len(result) and len(result) < self.total_parts:
+                result.append(i + 1)
+        while len(result) < self.total_parts:
+            result.append(self.num_items)
+        result.append(self.num_items)
+        # ensure monotone non-empty segments
+        for i in range(1, len(result)):
+            if result[i] <= result[i - 1]:
+                result[i] = min(result[i - 1] + 1, self.num_items)
+        result[-1] = self.num_items
+        return result
+
+
+class PipelineLayer(Layer):
+    """The PP model container (reference pp_layers.py:239).
+
+    Accepts a list of ``LayerDesc``/``Layer``/callables; builds the full
+    model; segments it into ``num_stages`` (× virtual chunks); tags each
+    parameter with ``pp_stage``. ``forward`` runs the whole model (optionally
+    rematerialising every ``recompute_interval`` layers), which is the serial
+    parity baseline AND the single-chip path.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        if num_stages is None and topology is None:
+            from ...topology import axis_size
+
+            num_stages = max(axis_size("pp"), 1)
+        if topology is not None and num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = int(num_stages or 1)
+        self._topo = topology
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
+        if self._num_virtual_pipeline_stages > 1:
+            if not isinstance(seg_method, list) and not str(seg_method).startswith("layer:") and seg_method != "uniform":
+                raise ValueError(
+                    "interleave requires uniform/layer/manual segmentation")
+
+        self._layers_desc = list(layers)
+        self.shared_layers: dict = {}
+
+        built: List[Layer] = []
+        for d in self._layers_desc:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self.shared_layers:
+                    self.shared_layers[d.layer_name] = d.build_layer()
+                layer = self.shared_layers[d.layer_name]
+                if d.forward_func is not None:
+                    layer = _SharedForward(layer, d.forward_func)
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FuncLayer(d))
+            else:
+                raise TypeError(f"unsupported pipeline item {d!r}")
+        self.run_function = LayerList(built)
+
+        # segment over the BUILT layers (not descs): the "parameter" method
+        # counts params from the live objects instead of constructing every
+        # layer a second time
+        seg = SegmentLayers(
+            built, self._num_stages, seg_method,
+            num_virtual_pipeline_stage=(self._num_virtual_pipeline_stages
+                                        if self._num_virtual_pipeline_stages > 1
+                                        else None))
+        self.segment_parts = seg.do_segment()
+        # chunk c (total_parts chunks) → stage c % num_stages under interleave
+        # (reference pp_layers.py:417-430 assigns chunks round-robin)
+        self._chunk_of_layer = [0] * len(built)
+        for c in range(len(self.segment_parts) - 1):
+            for i in range(self.segment_parts[c], self.segment_parts[c + 1]):
+                self._chunk_of_layer[i] = c
+        for i, layer in enumerate(built):
+            stage = self._chunk_of_layer[i] % self._num_stages
+            for _, p in layer.named_parameters():
+                p.pp_stage = stage
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def get_num_virtual_stages(self) -> int:
+        return self._num_virtual_pipeline_stages
+
+    def get_stage_from_index(self, index) -> int:
+        return self._chunk_of_layer[index] % self._num_stages
+
+    def stage_layer_indices(self, stage_id, chunk_id=None) -> List[int]:
+        """Indices of layers on `stage_id` (optionally one virtual chunk)."""
+        out = []
+        for i, c in enumerate(self._chunk_of_layer):
+            if c % self._num_stages != stage_id:
+                continue
+            if chunk_id is not None and c // self._num_stages != chunk_id:
+                continue
+            out.append(i)
+        return out
+
+    def chunk_layer_indices(self, chunk) -> List[int]:
+        return [i for i, c in enumerate(self._chunk_of_layer) if c == chunk]
+
+    @property
+    def total_chunks(self) -> int:
+        return len(self.segment_parts) - 1
+
+    def forward_chunk(self, x, chunk):
+        for i in self.chunk_layer_indices(chunk):
+            x = self.run_function[i](x)
+        return x
+
+    # -- serial forward (parity baseline / single chip) ---------------------
+    def forward(self, input):
+        x = input
+        if self._recompute_interval <= 0:
+            for layer in self.run_function:
+                x = layer(x)
+            return x
+        from ..recompute.recompute import recompute
+
+        layers = list(self.run_function)
+        i = 0
+        while i < len(layers):
+            j = min(i + self._recompute_interval, len(layers))
+            seg = layers[i:j]
+
+            def run(seg_x, _seg=seg):
+                for l in _seg:
+                    seg_x = l(seg_x)
+                return seg_x
+
+            # don't remat segments containing shared/embedding heads: cheap
+            x = recompute(run, x) if j - i > 1 else run(x)
+            i = j
+        return x
+
+    def allreduce_shared_weight_gradients(self):
+        """reference pp_layers.py shared-weight grad sync — structural no-op:
+        shared layers are one object, grads already accumulated together."""
+        return None
+
+
+class _FuncLayer(Layer):
+    """Wrap a plain callable (e.g. a lambda reshaping activations) as a Layer
+    so pipelines may mix functions and Layers, as the reference allows."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x):
+        return self._fn(x)
+
+
+class _SharedForward(Layer):
+    """A use-site of a SharedLayerDesc with a custom forward_func (e.g. the
+    output-projection use of a tied embedding)."""
+
+    def __init__(self, shared: Layer, forward_func: Callable):
+        super().__init__()
+        self.shared = shared
+        self._forward_func = forward_func
+
+    def forward(self, x):
+        return self._forward_func(self.shared, x)

@@ -1,0 +1,20 @@
+"""Shared model helpers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+
+IGNORE_INDEX = -100
+
+
+def masked_lm_loss(loss, labels, ignore_index: int = IGNORE_INDEX):
+    """Mean of per-token losses over NON-ignored positions only (ignored
+    positions contribute 0 to the sum; dividing by the total count would
+    scale the loss with the pad fraction)."""
+
+    def masked_mean(l, lb):
+        n = jnp.maximum(jnp.sum(lb != ignore_index), 1)
+        return jnp.sum(l) / n.astype(l.dtype)
+
+    return apply_op(masked_mean, loss, labels, op_name="lm_loss_mean")

@@ -193,10 +193,7 @@ class GPTForCausalLM(Layer):
         logits = self.lm_head(hidden)
         if labels is None:
             return logits
+        from ._utils import masked_lm_loss
 
-        def masked_mean(l, lb):
-            n = jnp.maximum(jnp.sum(lb != self.IGNORE_INDEX), 1)
-            return jnp.sum(l) / n.astype(l.dtype)
-
-        return apply_op(masked_mean, self.loss_fn(logits, labels), labels,
-                        op_name="lm_loss_mean")
+        return masked_lm_loss(self.loss_fn(logits, labels), labels,
+                              self.IGNORE_INDEX)

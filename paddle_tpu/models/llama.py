@@ -247,10 +247,6 @@ class LlamaForCausalLM(Layer):
         if labels is None:
             return logits
         loss = self.loss_fn(logits, labels)
-        # mean over NON-ignored positions only (ignored contribute 0 to the
-        # sum; dividing by the total count would scale loss with pad fraction)
-        def masked_mean(l, lb):
-            n = jnp.maximum(jnp.sum(lb != self.IGNORE_INDEX), 1)
-            return jnp.sum(l) / n.astype(l.dtype)
+        from ._utils import masked_lm_loss
 
-        return apply_op(masked_mean, loss, labels, op_name="lm_loss_mean")
+        return masked_lm_loss(loss, labels, self.IGNORE_INDEX)

@@ -17,7 +17,8 @@ _param_counter = [0]
 
 class Parameter(Tensor):
     __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
-                 "do_model_average", "is_distributed", "split_axis")
+                 "do_model_average", "is_distributed", "split_axis",
+                 "pp_stage")
 
     def __init__(self, value, trainable: bool = True, name=None,
                  learning_rate: float = 1.0, regularizer=None,
@@ -36,6 +37,8 @@ class Parameter(Tensor):
         # None if replicated (reference: param.is_distributed flag on mp layers)
         self.is_distributed = False
         self.split_axis = None
+        # pipeline stage placement (None = not under a PipelineLayer)
+        self.pp_stage = None
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

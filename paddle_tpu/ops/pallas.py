@@ -55,7 +55,11 @@ def _chunked_attention(q, k, v, causal: bool, sm_scale: float,
         kpos = idx * csize + jnp.arange(csize)
         valid = kpos < sk
         if causal:
-            valid = valid[None, :] & (qpos[:, None] >= kpos[None, :])
+            # bottom-right alignment (queries end at the last key): the
+            # decode-with-KV-cache convention, matching _sdpa_ref's
+            # tril(k=sk-sq) — query i attends keys <= i + (sk - sq)
+            valid = valid[None, :] & (
+                qpos[:, None] + (sk - sq) >= kpos[None, :])
         else:
             valid = jnp.broadcast_to(valid[None, :], (sq, csize))
         s = jnp.where(valid[None, None], s, -jnp.inf)

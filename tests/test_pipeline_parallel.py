@@ -1,0 +1,249 @@
+"""Pipeline-parallel tests.
+
+Mirrors the reference PP test contract (SURVEY.md §4.2,
+test/collective/fleet/hybrid_parallel_pp_transformer.py): the pipelined run
+must match the serial baseline numerically, and the schedule must train.
+"""
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+    PipelineParallel, PipelineParallelWithInterleave)
+from paddle_tpu.distributed.fleet.meta_parallel.pp_compiled import (
+    build_pipeline_loss_fn, build_pipeline_train_step)
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+    LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc)
+from paddle_tpu.distributed.topology import build_mesh, set_mesh
+
+V, H, S = 32, 16, 8
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+class Embed(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(V, H)
+
+    def forward(self, ids):
+        return self.emb(ids)
+
+
+class Head(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, V)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def loss_fn(out, y):
+    return nn.functional.cross_entropy(out.reshape([-1, V]), y.reshape([-1]))
+
+
+def make_pipe(num_stages=4, **kw):
+    descs = ([LayerDesc(Embed)] + [LayerDesc(Block) for _ in range(6)]
+             + [LayerDesc(Head)])
+    return PipelineLayer(descs, num_stages=num_stages, loss_fn=loss_fn, **kw)
+
+
+def batch(n=8):
+    rng = np.random.RandomState(7)
+    ids = rng.randint(0, V, (n, S)).astype(np.int32)
+    y = rng.randint(0, V, (n, S)).astype(np.int32)
+    return ids, y
+
+
+class TestSegmentLayers:
+    def test_uniform(self):
+        assert SegmentLayers.uniform(8, 4) == [0, 2, 4, 6, 8]
+        assert SegmentLayers.uniform(10, 4) == [0, 3, 6, 8, 10]
+
+    def test_manual(self):
+        descs = [LayerDesc(Block) for _ in range(8)]
+        seg = SegmentLayers(descs, 2, method=[0, 3, 8])
+        assert seg.do_segment() == [0, 3, 8]
+
+    def test_by_layer_name(self):
+        descs = ([LayerDesc(Embed)] + [LayerDesc(Block) for _ in range(4)]
+                 + [LayerDesc(Head)])
+        seg = SegmentLayers(descs, 2, method="layer:Block")
+        parts = seg.do_segment()
+        assert parts[0] == 0 and parts[-1] == 6 and len(parts) == 3
+
+    def test_too_few_layers(self):
+        with pytest.raises(ValueError):
+            SegmentLayers([LayerDesc(Block)], 2).do_segment()
+
+
+class TestPipelineLayerSerial:
+    def test_stage_tagging(self):
+        pipe = make_pipe(4)
+        assert pipe.segment_parts == [0, 2, 4, 6, 8]
+        stages = {pipe.get_stage_from_index(i) for i in range(8)}
+        assert stages == {0, 1, 2, 3}
+        for _, p in pipe.named_parameters():
+            assert hasattr(p, "pp_stage")
+
+    def test_serial_forward_matches_plain(self):
+        pipe = make_pipe(4)
+        ids, y = batch()
+        out = pipe(paddle.Tensor(ids))
+        # same layers run manually
+        x = paddle.Tensor(ids)
+        for layer in pipe.run_function:
+            x = layer(x)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-6)
+
+    def test_recompute_interval_matches(self):
+        pipe = make_pipe(4)
+        ids, y = batch()
+        ref = pipe(paddle.Tensor(ids))
+        pipe._recompute_interval = 2
+        out = pipe(paddle.Tensor(ids))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_shared_layer_desc(self):
+        def tied_head(shared, x):
+            return paddle.matmul(x, shared.emb.weight, transpose_y=True)
+
+        descs = ([SharedLayerDesc("emb", Embed)]
+                 + [LayerDesc(Block) for _ in range(2)]
+                 + [SharedLayerDesc("emb", Embed, forward_func=tied_head)])
+        pipe = PipelineLayer(descs, num_stages=2, loss_fn=loss_fn)
+        assert "emb" in pipe.shared_layers
+        ids, y = batch()
+        out = pipe(paddle.Tensor(ids))
+        assert tuple(out.shape) == (8, S, V)
+        # tied grads: backward accumulates both uses into ONE weight
+        loss = loss_fn(out, paddle.Tensor(y))
+        loss.backward()
+        emb_w = pipe.shared_layers["emb"].emb.weight
+        assert emb_w.grad is not None
+
+
+class TestEagerSchedule:
+    def test_train_batch_matches_serial_grad_accum(self):
+        from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+            DistributedStrategy)
+        from paddle_tpu.optimizer import SGD
+
+        ids, y = batch()
+        # serial baseline: full-batch loss and one SGD step
+        pipe_ref = make_pipe(1)
+        sd = pipe_ref.state_dict()
+        out = pipe_ref(paddle.Tensor(ids))
+        ref_loss = loss_fn(out, paddle.Tensor(y))
+
+        pipe = make_pipe(4)
+        pipe.set_state_dict(sd)
+        strat = DistributedStrategy()
+        strat.pipeline_configs["accumulate_steps"] = 4
+        pp = PipelineParallel(pipe, strategy=strat)
+        opt = SGD(learning_rate=0.1, parameters=pipe.parameters())
+        loss = pp.train_batch((paddle.Tensor(ids), paddle.Tensor(y)), opt)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+
+        # params actually moved
+        sd2 = pipe.state_dict()
+        moved = any(
+            not np.allclose(sd[k].numpy(), sd2[k].numpy()) for k in sd)
+        assert moved
+
+    def test_eval_batch(self):
+        pipe = make_pipe(4)
+        ids, y = batch()
+        pp = PipelineParallel(pipe)
+        loss = pp.eval_batch((paddle.Tensor(ids), paddle.Tensor(y)))
+        assert np.isfinite(float(loss))
+
+    def test_interleave_requires_chunks(self):
+        pipe = make_pipe(2)
+        with pytest.raises(ValueError):
+            PipelineParallelWithInterleave(pipe)
+
+    def test_interleave_chunk_mapping(self):
+        descs = [LayerDesc(Block) for _ in range(8)]
+        pipe = PipelineLayer(descs, num_stages=2, loss_fn=loss_fn,
+                             num_virtual_pipeline_stages=2)
+        assert pipe.total_chunks == 4
+        pp = PipelineParallelWithInterleave(pipe)
+        # forward chunk order on a stage cycles 0,0,1,1 then back
+        assert pp._get_virtual_pp_rank(0) == 0
+        assert pp._get_virtual_pp_rank(2) == 1
+        assert pp._get_virtual_pp_rank(0, forward=False) == 1
+
+
+class TestCompiledPipeline:
+    def setup_method(self, _):
+        self.mesh = build_mesh(pp=4, dp=2)
+        set_mesh(self.mesh)
+
+    def test_pipelined_loss_matches_serial(self):
+        pipe = make_pipe(4)
+        ids, y = batch()
+        out = pipe(paddle.Tensor(ids))
+        ref = float(loss_fn(out, paddle.Tensor(y)))
+        params = {k: p.value for k, p in pipe.named_parameters()}
+        plf = build_pipeline_loss_fn(pipe, accumulate_steps=4, mesh=self.mesh)
+        got = float(jax.jit(plf)(params, ids, y))
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_train_step_reduces_loss(self):
+        pipe = make_pipe(4)
+        ids, y = batch()
+        params = {k: p.value for k, p in pipe.named_parameters()}
+        step, init = build_pipeline_train_step(
+            pipe, accumulate_steps=4, mesh=self.mesh, lr=1e-2)
+        st = init(params)
+        p, st, l0 = step(params, st, ids, y)
+        for _ in range(3):
+            p, st, l = step(p, st, ids, y)
+        assert float(l) < float(l0)
+
+    def test_remat_matches(self):
+        pipe = make_pipe(4)
+        ids, y = batch()
+        params = {k: p.value for k, p in pipe.named_parameters()}
+        plf = build_pipeline_loss_fn(pipe, accumulate_steps=4,
+                                     mesh=self.mesh, remat=True)
+        plain = build_pipeline_loss_fn(pipe, accumulate_steps=4,
+                                       mesh=self.mesh)
+        np.testing.assert_allclose(
+            float(jax.jit(plf)(params, ids, y)),
+            float(jax.jit(plain)(params, ids, y)), rtol=1e-5)
+
+    def test_grads_match_serial(self):
+        pipe = make_pipe(4)
+        ids, y = batch()
+        params = {k: p.value for k, p in pipe.named_parameters()}
+
+        def serial(params, ids, y):
+            from paddle_tpu.nn.functional_call import functional_call
+
+            out = functional_call(pipe, params, paddle.Tensor(ids))
+            import jax.numpy as jnp
+
+            lbl = y.reshape(-1)
+            logits = out.reshape((-1, V))
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            return -jnp.mean(jnp.take_along_axis(logp, lbl[:, None], 1))
+
+        g_ref = jax.grad(serial)(params, ids, y)
+        plf = build_pipeline_loss_fn(pipe, accumulate_steps=4, mesh=self.mesh)
+        g_pp = jax.jit(jax.grad(plf))(params, ids, y)
+        for k in g_ref:
+            np.testing.assert_allclose(
+                np.asarray(g_pp[k]), np.asarray(g_ref[k]), atol=2e-5,
+                err_msg=k)
