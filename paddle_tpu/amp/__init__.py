@@ -1,0 +1,22 @@
+"""paddle.amp parity surface (reference: python/paddle/amp/__init__.py).
+
+TPU-native AMP: bfloat16 is the default low-precision dtype (native MXU
+input, fp32 exponent range → no loss scaling needed); the fp16 + dynamic
+GradScaler path is kept for API/semantic parity.
+"""
+from . import debugging
+from .amp_lists import BLACK_LIST, WHITE_LIST, black_list, white_list
+from .auto_cast import amp_decorate, amp_guard, auto_cast, decorate
+from .grad_scaler import AmpScaler, GradScaler, OptimizerState
+
+__all__ = ["auto_cast", "decorate", "GradScaler", "AmpScaler", "amp_guard",
+           "amp_decorate", "debugging", "white_list", "black_list",
+           "is_float16_supported", "is_bfloat16_supported"]
+
+
+def is_float16_supported(device=None) -> bool:
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True

@@ -1,0 +1,110 @@
+"""Autocast context + model decoration (reference: amp/auto_cast.py:646
+``auto_cast``, :714 ``decorate``).
+
+TPU-native policy: default low-precision dtype is **bfloat16** — no loss
+scaling needed, the MXU consumes it natively. fp16 is supported for parity.
+O1 casts white-listed op inputs; O2 additionally casts the model's params
+once (master-weight pattern: the optimizer keeps fp32 moments, see
+optimizer/functional.py).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..core.amp_state import amp_state
+from ..core import dtype as dtypes
+from . import amp_lists
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate"]
+
+_NORM_LAYERS = ("LayerNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
+                "BatchNorm3D", "InstanceNorm1D", "InstanceNorm2D",
+                "InstanceNorm3D", "GroupNorm", "SyncBatchNorm", "RMSNorm")
+
+
+def _resolve_dtype(dtype):
+    d = dtypes.convert_dtype(dtype or "bfloat16")
+    if d not in (dtypes.float16, dtypes.bfloat16):
+        raise ValueError(f"amp dtype must be float16/bfloat16, got {dtype}")
+    return d
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list: Optional[Sequence] = None,
+              custom_black_list: Optional[Sequence] = None, level: str = "O1",
+              dtype: str = "bfloat16", use_promote: bool = True):
+    """reference amp/auto_cast.py:646. Usable as context manager."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level should be O0/O1/O2, got {level}")
+    st = amp_state
+    prev = (st.enabled, st.level, st.dtype, st.white, st.black)
+    try:
+        if enable and level != "O0":
+            d = _resolve_dtype(dtype)
+            white = set(amp_lists.white_list(d))
+            black = set(amp_lists.black_list(d))
+            if custom_white_list:
+                white |= set(custom_white_list)
+                black -= set(custom_white_list)
+            if custom_black_list:
+                black |= set(custom_black_list)
+                white -= set(custom_black_list)
+            st.enabled = True
+            st.level = level
+            st.dtype = jnp.dtype(d)
+            st.white = white
+            st.black = black
+        yield
+    finally:
+        (st.enabled, st.level, st.dtype, st.white, st.black) = prev
+
+
+amp_guard = auto_cast  # legacy alias (paddle.fluid.dygraph.amp_guard)
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None,
+             master_grad: bool = False, excluded_layers=None):
+    """reference amp/auto_cast.py:714 — cast model params to the AMP dtype
+    (norm layers stay fp32 for stability, as the reference keeps
+    batch/layer norm in fp32 under O2)."""
+    if level not in ("O1", "O2"):
+        raise ValueError(f"level should be O1 or O2, got {level}")
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        d = _resolve_dtype(dtype)
+        excluded = tuple(excluded_layers or ())
+        for m in model_list:
+            _cast_model(m, d, excluded)
+            m._casted_by_pure_fp16 = True
+    if optimizers is None:
+        return model_list[0] if single else model_list
+    return (model_list[0] if single else model_list), optimizers
+
+
+amp_decorate = decorate
+
+
+def _cast_model(layer, dtype, excluded):
+    name = type(layer).__name__
+    if name in _NORM_LAYERS or (excluded and isinstance(layer, excluded)):
+        keep = True
+    else:
+        keep = False
+    if not keep:
+        for pname, p in layer._parameters.items():
+            if p is None:
+                continue
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p.set_value(p._value.astype(dtype))
+        for bname, b in layer._buffers.items():
+            if b is None:
+                continue
+            if jnp.issubdtype(b._value.dtype, jnp.floating):
+                b.set_value(b._value.astype(dtype))
+    for sub in layer._sub_layers.values():
+        _cast_model(sub, dtype, excluded)

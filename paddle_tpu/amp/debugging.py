@@ -1,0 +1,136 @@
+"""AMP numerical debugging (reference: amp/debugging.py:83 TensorCheckerConfig,
+:265 check_numerics; accuracy_compare.py).
+
+Per-op tensor statistics collected through the apply_op sentry hook
+(core/amp_state.checker) — the same choke point the reference instruments
+with CheckTensorHasNanOrInf after every eager op.
+"""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.amp_state import amp_state
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics", "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats"]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    """reference amp/debugging.py:83."""
+
+    def __init__(self, enable: bool = False,
+                 debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+        self._found: List[str] = []
+
+    def _check(self, op_name: str, leaves):
+        if self.checked_op_list and op_name not in self.checked_op_list:
+            return
+        if op_name in self.skipped_op_list:
+            return
+        for o in leaves:
+            n_nan = int(jnp.sum(jnp.isnan(o)))
+            n_inf = int(jnp.sum(jnp.isinf(o)))
+            if n_nan or n_inf:
+                msg = (f"[nan_inf] op={op_name} shape={tuple(o.shape)} "
+                       f"dtype={o.dtype} num_nan={n_nan} num_inf={n_inf}")
+                self._found.append(msg)
+                if self.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                    raise RuntimeError(msg)
+                print(msg)
+
+
+_active_config: Optional[TensorCheckerConfig] = None
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """reference amp/debugging.py — install the per-op checker."""
+    global _active_config
+    _active_config = checker_config
+    if checker_config.enable:
+        amp_state.checker = checker_config._check
+
+
+def disable_tensor_checker():
+    global _active_config
+    _active_config = None
+    amp_state.checker = None
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """One-shot scan (reference amp/debugging.py:265): returns
+    (num_nan, num_inf, num_zero) as arrays."""
+    from ..core.tensor import Tensor
+
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    n_nan = jnp.sum(jnp.isnan(v))
+    n_inf = jnp.sum(jnp.isinf(v))
+    n_zero = jnp.sum(v == 0)
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT and (
+            int(n_nan) or int(n_inf)):
+        raise RuntimeError(
+            f"check_numerics: {op_type}:{var_name} has nan={int(n_nan)} "
+            f"inf={int(n_inf)}")
+    return n_nan, n_inf, n_zero
+
+
+# -- operator stats (reference enable_operator_stats_collection) ------------
+
+_op_stats: Optional[Dict[str, Dict[str, int]]] = None
+
+
+def enable_operator_stats_collection():
+    """Count per-op calls by output dtype (reference low_precision_op_list)."""
+    global _op_stats
+    _op_stats = {}
+
+    def _collect(op_name, leaves):
+        for o in leaves:
+            key = str(o.dtype)
+            d = _op_stats.setdefault(op_name, {})
+            d[key] = d.get(key, 0) + 1
+
+    amp_state.checker = _collect
+
+
+def disable_operator_stats_collection():
+    global _op_stats
+    amp_state.checker = None
+    stats, _op_stats = _op_stats, None
+    if stats:
+        print("<" + "-" * 20 + " op list " + "-" * 20 + ">")
+        print(f"{'Op Name':<40} {'calls by dtype'}")
+        for op, by_dtype in sorted(stats.items()):
+            print(f"{op:<40} {by_dtype}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()

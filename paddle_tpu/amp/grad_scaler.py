@@ -1,0 +1,177 @@
+"""Dynamic loss scaling (reference: amp/grad_scaler.py:576 ``GradScaler``).
+
+On TPU the default AMP dtype is bf16, whose exponent range matches fp32 —
+scaling is then a no-op passthrough (enable=False). The full dynamic-scale
+state machine is kept for fp16 parity: scale the loss, unscale grads before
+step, skip the step on nan/inf, grow/shrink the scale.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["GradScaler", "AmpScaler", "OptimizerState"]
+
+
+class OptimizerState(Enum):
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 16,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000,
+                 decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = bool(enable)
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
+        self._incr_count = 0
+        self._decr_count = 0
+        self._found_inf = False
+        self._opt_state = OptimizerState.INIT
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._use_dynamic_loss_scaling
+
+    def scale(self, var):
+        """Multiply the loss by the current scale (reference :627)."""
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        """Divide accumulated grads by the scale; detect nan/inf
+        (reference GradScaler._unscale)."""
+        if not self._enable or self._opt_state == OptimizerState.UNSCALED:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p is None or p.grad is None:
+                continue
+            g = p.grad._value * inv
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+            p.grad = Tensor(g, stop_gradient=True)
+        self._found_inf = found
+        self._opt_state = OptimizerState.UNSCALED
+
+    def step(self, optimizer):
+        """unscale (if not already), skip the update on inf (reference :576)."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._opt_state == OptimizerState.STEPPED:
+            raise RuntimeError("step() has already been called since the "
+                               "last update().")
+        if self._opt_state != OptimizerState.UNSCALED:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._opt_state = OptimizerState.STEPPED
+
+    def update(self):
+        """Advance the dynamic-scale state machine."""
+        if not self._enable:
+            return
+        if self._use_dynamic_loss_scaling:
+            if self._found_inf:
+                self._incr_count = 0
+                self._decr_count += 1
+                if self._decr_count >= self._decr_every_n_nan_or_inf:
+                    self._scale = max(self._scale * self._decr_ratio, 1.0)
+                    self._decr_count = 0
+            else:
+                self._decr_count = 0
+                self._incr_count += 1
+                if self._incr_count >= self._incr_every_n_steps:
+                    self._scale *= self._incr_ratio
+                    self._incr_count = 0
+        self._found_inf = False
+        self._opt_state = OptimizerState.INIT
+
+    def minimize(self, optimizer, scaled_loss):
+        """scaled.backward() must have been called; steps + updates."""
+        self.step(optimizer)
+        self.update()
+
+    # -- scale accessors (reference :576 API) -------------------------------
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._init_loss_scaling = float(v)
+        self._scale = float(v)
+
+    def get_init_loss_scaling(self):
+        return self._init_loss_scaling
+
+    def set_incr_ratio(self, v):
+        self._incr_ratio = v
+
+    def get_incr_ratio(self):
+        return self._incr_ratio
+
+    def set_decr_ratio(self, v):
+        self._decr_ratio = v
+
+    def get_decr_ratio(self):
+        return self._decr_ratio
+
+    def set_incr_every_n_steps(self, v):
+        self._incr_every_n_steps = v
+
+    def get_incr_every_n_steps(self):
+        return self._incr_every_n_steps
+
+    def set_decr_every_n_nan_or_inf(self, v):
+        self._decr_every_n_nan_or_inf = v
+
+    def get_decr_every_n_nan_or_inf(self):
+        return self._decr_every_n_nan_or_inf
+
+    def state_dict(self) -> Dict[str, Any]:
+        if not self._enable:
+            return {}
+        return {
+            "scale": np.asarray(self._scale, np.float32),
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "incr_count": self._incr_count,
+            "decr_count": self._decr_count,
+            "use_dynamic_loss_scaling": self._use_dynamic_loss_scaling,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]):
+        if not self._enable or not state:
+            return
+        self._scale = float(state["scale"])
+        self._incr_ratio = state["incr_ratio"]
+        self._decr_ratio = state["decr_ratio"]
+        self._incr_every_n_steps = state["incr_every_n_steps"]
+        self._decr_every_n_nan_or_inf = state["decr_every_n_nan_or_inf"]
+        self._incr_count = state.get("incr_count", 0)
+        self._decr_count = state.get("decr_count", 0)
+        self._use_dynamic_loss_scaling = state.get(
+            "use_dynamic_loss_scaling", True)
+
+
+AmpScaler = GradScaler  # legacy alias

@@ -1,0 +1,54 @@
+"""Autocast state consulted by the eager op dispatcher (apply_op).
+
+Architectural parity with the reference: AMP casting lives INSIDE the
+generated per-op forward functions (eager_gen.py:462 EagerAmpAutoCast,
+imperative/amp_auto_cast.cc AmpLevel state); here the single choke point
+every eager op passes through is ``core.autograd.apply_op``, so the policy
+hook lives there. Under jit the same policy applies while tracing — casts
+become part of the XLA program (bf16 inputs feed the MXU directly).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+__all__ = ["AmpState", "amp_state", "maybe_cast_inputs"]
+
+
+class AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.level = "O0"            # O0 off / O1 white-list / O2 everything
+        self.dtype = jnp.bfloat16    # TPU-native default (fp16 on request)
+        self.white: Set[str] = set()
+        self.black: Set[str] = set()
+        # nan/inf sentry (FLAGS_check_nan_inf / amp.debugging tensor checker)
+        self.check_nan_inf = False
+        self.checker = None          # optional callable(op_name, leaves)
+
+
+amp_state = AmpState()
+
+
+def _cast_leaf(v, dtype):
+    try:
+        dt = v.dtype
+    except AttributeError:
+        return v
+    if dt in (jnp.float32, jnp.float16, jnp.bfloat16) and dt != dtype:
+        return v.astype(dtype)
+    return v
+
+
+def maybe_cast_inputs(op_name: Optional[str], values):
+    """Apply the active autocast policy to a flat list of raw op inputs."""
+    st = amp_state
+    if not st.enabled or op_name is None:
+        return values
+    if op_name in st.black:
+        return [_cast_leaf(v, jnp.float32) for v in values]
+    if st.level == "O2" or op_name in st.white:
+        return [_cast_leaf(v, st.dtype) for v in values]
+    return values

@@ -124,11 +124,18 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     (args, kwargs) are differentiable inputs; raw arrays / python scalars are
     constants. Returns Tensor-wrapped outputs mirroring fn's output pytree.
     """
+    from .amp_state import amp_state, maybe_cast_inputs
     from .tensor import Tensor
 
     leaves, treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)
     t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
     raw = [l._value if isinstance(l, Tensor) else l for l in leaves]
+    if amp_state.enabled:
+        # autocast policy (≙ EagerAmpAutoCast in the generated ad_funcs,
+        # eager_gen.py:462): cast only the Tensor inputs, not python scalars
+        cast = maybe_cast_inputs(op_name, [raw[i] for i in t_idx])
+        for i, v in zip(t_idx, cast):
+            raw[i] = v
 
     grad_wanted = _state.enabled and any(
         not leaves[i].stop_gradient for i in t_idx
@@ -137,6 +144,7 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     if not grad_wanted:
         a, k = tree_unflatten(treedef, raw)
         out = fn(*a, **k)
+        _maybe_check_numerics(op_name, out)
         return _wrap_outputs(out, None)
 
     tvals = [raw[i] for i in t_idx]
@@ -149,6 +157,7 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
         return fn(*a, **k)
 
     out, vjp_fn = jax.vjp(_pure, *tvals)
+    _maybe_check_numerics(op_name, out)
     out_leaves, out_treedef = tree_flatten(out)
     out_avals = [(jnp.shape(o), jnp.result_type(o)) for o in out_leaves]
     node = GradNode(
@@ -159,6 +168,31 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
         name=op_name or getattr(fn, "__name__", "op"),
     )
     return _wrap_outputs(out, node)
+
+
+def _maybe_check_numerics(op_name, out):
+    """Post-op nan/inf sentry (≙ CheckTensorHasNanOrInf after every eager op,
+    eager/nan_inf_utils.cc:83, gated by FLAGS_check_nan_inf). Only scans
+    concrete values — under trace it would force materialisation."""
+    from .amp_state import amp_state
+
+    if not (amp_state.check_nan_inf or amp_state.checker is not None):
+        return
+    leaves = [o for o in tree_flatten(out)[0] if hasattr(o, "dtype")]
+    leaves = [o for o in leaves
+              if not isinstance(o, jax.core.Tracer)
+              and jnp.issubdtype(o.dtype, jnp.inexact)]
+    if not leaves:
+        return
+    if amp_state.checker is not None:
+        amp_state.checker(op_name or "op", leaves)
+    if amp_state.check_nan_inf:
+        for o in leaves:
+            bad = int(jnp.sum(~jnp.isfinite(o)))
+            if bad:
+                raise RuntimeError(
+                    f"Operator {op_name or 'op'} output contains {bad} "
+                    f"Nan/Inf element(s) (FLAGS_check_nan_inf)")
 
 
 def _wrap_outputs(out, node):
@@ -267,6 +301,13 @@ def run_backward(
         nodes[nid] = node
         slots = node_cots.setdefault(nid, [None] * len(node.out_avals))
         idx = t._out_idx
+        # autocast boundaries: a black-list op (fp32) consuming a white-list
+        # output (bf16) sends an fp32 cotangent to a bf16 output — cast to
+        # the primal's dtype, as the reference's AMP grads follow param dtype
+        exp_dtype = node.out_avals[idx][1]
+        if getattr(g, "dtype", exp_dtype) != exp_dtype and jnp.issubdtype(
+                exp_dtype, jnp.inexact):
+            g = g.astype(exp_dtype)
         slots[idx] = g if slots[idx] is None else slots[idx] + g
         if t._retain_grad and accumulate_leaf_grads:
             if t.grad is None:

@@ -67,10 +67,7 @@ class PipelineParallel(MetaParallelBase):
         from ..._spmd import shard_params
         from ...topology import get_mesh
 
-        try:
-            shard_params(self._layers, get_mesh())
-        except Exception:
-            pass
+        shard_params(self._layers, get_mesh())
 
     # -- schedule -----------------------------------------------------------
     def forward_backward_pipeline(self, data, scaler=None, compute_grad=True):

@@ -82,8 +82,14 @@ def build_pipeline_loss_fn(pipe, accumulate_steps: int,
     S = int(mesh.shape.get("pp", 1))
     M = int(accumulate_steps)
     loss_fn = pipe._loss_fn
-
-    stage_fns = [_stage_caller(pipe, s) for s in range(S)]
+    if S > 1 and S != pipe.num_stages:
+        raise ValueError(
+            f"mesh pp axis has {S} devices but PipelineLayer was segmented "
+            f"into {pipe.num_stages} stages — rebuild one of them")
+    # S==1 (no/absent pp axis): run ALL segmented stages serially, not just
+    # stage 0 — the model is the composition of every stage
+    n_exec = pipe.num_stages if S == 1 else S
+    stage_fns = [_stage_caller(pipe, s) for s in range(n_exec)]
 
     def serial_loss(params, inputs, labels):
         # S==1 or no pp axis: plain microbatch accumulation (still scanned
@@ -91,7 +97,7 @@ def build_pipeline_loss_fn(pipe, accumulate_steps: int,
         def micro(carry, xy):
             x, y = xy
             h = x
-            for s in range(S):
+            for s in range(n_exec):
                 h = stage_fns[s](params, h)
             l = _to_val(loss_fn(Tensor(h), Tensor(y)))
             return carry + jnp.mean(l), None

@@ -125,23 +125,24 @@ class SegmentLayers:
         return result
 
     def segment_by_weights(self, weights) -> List[int]:
-        # balance cumulative weight across parts (greedy prefix split)
-        total = sum(weights)
-        target = total / self.total_parts
+        """Balance cumulative weight across parts: boundary k sits where the
+        prefix sum first reaches k/parts of the total, clamped so every part
+        keeps >= 1 layer and enough layers remain for the parts after it."""
+        n = self.num_items
+        parts = self.total_parts
+        cum = [0.0]
+        for w in weights:
+            cum.append(cum[-1] + w)
+        total = cum[-1]
         result = [0]
-        acc = 0.0
-        for i, w in enumerate(weights):
-            acc += w
-            if acc >= target * len(result) and len(result) < self.total_parts:
-                result.append(i + 1)
-        while len(result) < self.total_parts:
-            result.append(self.num_items)
-        result.append(self.num_items)
-        # ensure monotone non-empty segments
-        for i in range(1, len(result)):
-            if result[i] <= result[i - 1]:
-                result[i] = min(result[i - 1] + 1, self.num_items)
-        result[-1] = self.num_items
+        for part in range(1, parts):
+            target = total * part / parts
+            j = result[-1] + 1             # part gets at least one layer
+            hi = n - (parts - part)        # leave >=1 layer per later part
+            while j < hi and cum[j] < target:
+                j += 1
+            result.append(min(max(j, result[-1] + 1), hi))
+        result.append(n)
         return result
 
 
@@ -271,8 +272,7 @@ class PipelineLayer(Layer):
                     seg_x = l(seg_x)
                 return seg_x
 
-            # don't remat segments containing shared/embedding heads: cheap
-            x = recompute(run, x) if j - i > 1 else run(x)
+            x = recompute(run, x)
             i = j
         return x
 

@@ -17,7 +17,7 @@ class TensorParallel(MetaParallelBase):
         from ..._spmd import shard_params
         from ...topology import get_mesh
 
-        try:
-            shard_params(layers, get_mesh())
-        except Exception:
-            pass  # no live mesh (pure eager single device) — placement at jit time
+        # get_mesh() falls back to a 1-device mesh when none is configured,
+        # so placement is a no-op in pure eager single-device runs; real
+        # placement errors (bad pspec vs mesh) must surface, not be swallowed
+        shard_params(layers, get_mesh())

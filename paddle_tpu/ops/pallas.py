@@ -92,7 +92,11 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: float = None):
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    if _on_tpu():
+    # The TPU Pallas kernel's causal mask is TOP-LEFT aligned (col <= row);
+    # our convention (matching _sdpa_ref and the chunked fallback) is
+    # BOTTOM-RIGHT (decode-with-KV-cache). They agree iff sq == sk, so only
+    # route the square case to the kernel.
+    if _on_tpu() and (not causal or q.shape[1] == k.shape[1]):
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _fa)
 

@@ -1,0 +1,219 @@
+"""AMP tests: autocast dtype policy, O2 decorate, GradScaler state machine,
+nan/inf sentry, operator stats (reference test analogs:
+test/amp/test_amp_api.py, test_grad_scaler.py)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn
+from paddle_tpu.amp.debugging import (DebugMode, TensorCheckerConfig,
+                                      collect_operator_stats,
+                                      disable_tensor_checker,
+                                      enable_tensor_checker)
+
+
+class TestAutoCast:
+    def test_o1_white_op_casts(self):
+        x = paddle.ones([4, 4])
+        y = paddle.ones([4, 4])
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.matmul(x, y)
+        assert out.dtype == jnp.bfloat16
+        # outside the scope: fp32 again
+        out2 = paddle.matmul(x, y)
+        assert out2.dtype == jnp.float32
+
+    def test_o1_black_op_stays_fp32(self):
+        x = paddle.ones([4, 4], dtype="bfloat16")
+        with amp.auto_cast(level="O1"):
+            out = paddle.exp(x)
+        assert out.dtype == jnp.float32
+
+    def test_o1_gray_op_keeps_dtype(self):
+        x = paddle.ones([4])
+        with amp.auto_cast(level="O1"):
+            out = x + 1.0
+        assert out.dtype == jnp.float32
+
+    def test_custom_lists(self):
+        x = paddle.ones([4, 4])
+        with amp.auto_cast(level="O1", custom_black_list={"matmul"}):
+            out = paddle.matmul(x, x)
+        assert out.dtype == jnp.float32
+
+    def test_o2_casts_gray_ops(self):
+        x = paddle.ones([4])
+        with amp.auto_cast(level="O2"):
+            out = paddle.tanh(x)
+        assert out.dtype == jnp.bfloat16
+
+    def test_disabled(self):
+        x = paddle.ones([4, 4])
+        with amp.auto_cast(enable=False):
+            out = paddle.matmul(x, x)
+        assert out.dtype == jnp.float32
+
+    def test_fp16_dtype(self):
+        x = paddle.ones([4, 4])
+        with amp.auto_cast(level="O1", dtype="float16"):
+            out = paddle.matmul(x, x)
+        assert out.dtype == jnp.float16
+
+    def test_bad_level(self):
+        with pytest.raises(ValueError):
+            with amp.auto_cast(level="O3"):
+                pass
+
+
+class TestDecorate:
+    def test_o2_casts_params_not_norms(self):
+        model = nn.Sequential(nn.Linear(8, 8), nn.LayerNorm(8))
+        model = amp.decorate(model, level="O2", dtype="bfloat16")
+        assert model[0].weight.dtype == jnp.bfloat16
+        assert model[1].weight.dtype == jnp.float32
+
+    def test_o1_no_cast(self):
+        model = nn.Linear(8, 8)
+        model = amp.decorate(model, level="O1")
+        assert model.weight.dtype == jnp.float32
+
+    def test_with_optimizer(self):
+        from paddle_tpu.optimizer import SGD
+
+        model = nn.Linear(8, 8)
+        opt = SGD(learning_rate=0.1, parameters=model.parameters())
+        model, opt = amp.decorate(model, opt, level="O2")
+        assert model.weight.dtype == jnp.bfloat16
+
+
+class TestGradScaler:
+    def _train_once(self, scaler, poison=False):
+        from paddle_tpu.optimizer import SGD
+
+        model = nn.Linear(4, 4)
+        opt = SGD(learning_rate=0.1, parameters=model.parameters())
+        w0 = model.weight.numpy().copy()
+        x = paddle.ones([2, 4])
+        loss = model(x).mean()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        if poison:
+            model.weight.grad = paddle.Tensor(
+                np.full((4, 4), np.nan, np.float32))
+        scaler.step(opt)
+        scaler.update()
+        return w0, model.weight.numpy()
+
+    def test_scale_value(self):
+        s = amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.ones([2])
+        assert float(s.scale(x).sum()) == 256.0
+
+    def test_step_updates(self):
+        s = amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        w0, w1 = self._train_once(s)
+        assert not np.allclose(w0, w1)
+
+    def test_inf_skips_step_and_shrinks_scale(self):
+        s = amp.GradScaler(init_loss_scaling=1024.0,
+                           decr_every_n_nan_or_inf=1)
+        w0, w1 = self._train_once(s, poison=True)
+        np.testing.assert_array_equal(w0, w1)  # step skipped
+        assert s.get_loss_scaling() == 512.0
+
+    def test_growth(self):
+        s = amp.GradScaler(init_loss_scaling=64.0, incr_every_n_steps=2,
+                           incr_ratio=2.0)
+        self._train_once(s)
+        assert s.get_loss_scaling() == 64.0
+        self._train_once(s)
+        assert s.get_loss_scaling() == 128.0
+
+    def test_double_step_raises(self):
+        from paddle_tpu.optimizer import SGD
+
+        s = amp.GradScaler()
+        model = nn.Linear(2, 2)
+        opt = SGD(learning_rate=0.1, parameters=model.parameters())
+        loss = model(paddle.ones([1, 2])).mean()
+        s.scale(loss).backward()
+        s.step(opt)
+        with pytest.raises(RuntimeError):
+            s.step(opt)
+
+    def test_disabled_passthrough(self):
+        s = amp.GradScaler(enable=False)
+        x = paddle.ones([2])
+        assert s.scale(x) is x
+        assert s.state_dict() == {}
+
+    def test_state_dict_roundtrip(self):
+        s = amp.GradScaler(init_loss_scaling=777.0)
+        st = s.state_dict()
+        s2 = amp.GradScaler()
+        s2.load_state_dict(st)
+        assert s2.get_loss_scaling() == 777.0
+
+
+class TestDebugging:
+    def test_check_nan_inf_flag(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        from paddle_tpu.core.amp_state import amp_state
+
+        amp_state.check_nan_inf = True
+        try:
+            x = paddle.to_tensor([1.0, 0.0])
+            with pytest.raises(RuntimeError, match="Nan/Inf"):
+                paddle.log(x - 2.0)
+        finally:
+            amp_state.check_nan_inf = False
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_tensor_checker(self):
+        cfg = TensorCheckerConfig(enable=True,
+                                  debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT)
+        enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor([-1.0])
+            with pytest.raises(RuntimeError, match="nan_inf"):
+                paddle.sqrt(x)
+        finally:
+            disable_tensor_checker()
+
+    def test_check_numerics(self):
+        from paddle_tpu.amp.debugging import check_numerics
+
+        n_nan, n_inf, n_zero = check_numerics(
+            paddle.to_tensor([1.0, 0.0, 2.0]), "t", "x")
+        assert (int(n_nan), int(n_inf), int(n_zero)) == (0, 0, 1)
+
+    def test_operator_stats(self):
+        with collect_operator_stats():
+            paddle.matmul(paddle.ones([2, 2]), paddle.ones([2, 2]))
+        # stats printed; main contract: no crash and checker uninstalled
+        from paddle_tpu.core.amp_state import amp_state
+
+        assert amp_state.checker is None
+
+
+class TestAmpWithModel:
+    def test_training_loop_o1(self):
+        from paddle_tpu.optimizer import AdamW
+
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=1024.0)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        losses = []
+        for _ in range(5):
+            with amp.auto_cast(level="O1"):
+                out = model(x)
+                loss = (out ** 2).mean()
+            scaled = scaler.scale(loss)
+            scaled.backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]

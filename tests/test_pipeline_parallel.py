@@ -224,6 +224,24 @@ class TestCompiledPipeline:
             float(jax.jit(plf)(params, ids, y)),
             float(jax.jit(plain)(params, ids, y)), rtol=1e-5)
 
+    def test_no_pp_axis_runs_all_stages(self):
+        # mesh without pp: serial path must still compose every stage
+        mesh = build_mesh(dp=8)
+        pipe = make_pipe(4)
+        ids, y = batch()
+        out = pipe(paddle.Tensor(ids))
+        ref = float(loss_fn(out, paddle.Tensor(y)))
+        params = {k: p.value for k, p in pipe.named_parameters()}
+        plf = build_pipeline_loss_fn(pipe, accumulate_steps=4, mesh=mesh)
+        got = float(jax.jit(plf)(params, ids, y))
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_stage_mesh_mismatch_raises(self):
+        mesh = build_mesh(pp=2, dp=4)
+        pipe = make_pipe(4)
+        with pytest.raises(ValueError, match="segmented"):
+            build_pipeline_loss_fn(pipe, accumulate_steps=4, mesh=mesh)
+
     def test_grads_match_serial(self):
         pipe = make_pipe(4)
         ids, y = batch()
