@@ -42,7 +42,12 @@ def auto_cast(enable: bool = True, custom_white_list: Optional[Sequence] = None,
     st = amp_state
     prev = (st.enabled, st.level, st.dtype, st.white, st.black)
     try:
-        if enable and level != "O0":
+        if not enable or level == "O0":
+            # nested disable: an inner auto_cast(enable=False) must turn AMP
+            # OFF for its scope even inside an enabled outer region
+            st.enabled = False
+            st.level = "O0"
+        else:
             d = _resolve_dtype(dtype)
             white = set(amp_lists.white_list(d))
             black = set(amp_lists.black_list(d))
@@ -77,9 +82,14 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
     model_list = [models] if single else list(models)
     if level == "O2":
         d = _resolve_dtype(dtype)
-        excluded = tuple(excluded_layers or ())
+        # excluded_layers accepts Layer classes AND instances (paddle API)
+        ex = excluded_layers or ()
+        if not isinstance(ex, (list, tuple)):
+            ex = (ex,)
+        ex_types = tuple(e for e in ex if isinstance(e, type))
+        ex_ids = {id(e) for e in ex if not isinstance(e, type)}
         for m in model_list:
-            _cast_model(m, d, excluded)
+            _cast_model(m, d, ex_types, ex_ids)
             m._casted_by_pure_fp16 = True
     if optimizers is None:
         return model_list[0] if single else model_list
@@ -89,12 +99,11 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
 amp_decorate = decorate
 
 
-def _cast_model(layer, dtype, excluded):
+def _cast_model(layer, dtype, excluded_types=(), excluded_ids=frozenset()):
     name = type(layer).__name__
-    if name in _NORM_LAYERS or (excluded and isinstance(layer, excluded)):
-        keep = True
-    else:
-        keep = False
+    keep = (name in _NORM_LAYERS
+            or (excluded_types and isinstance(layer, excluded_types))
+            or id(layer) in excluded_ids)
     if not keep:
         for pname, p in layer._parameters.items():
             if p is None:
@@ -107,4 +116,4 @@ def _cast_model(layer, dtype, excluded):
             if jnp.issubdtype(b._value.dtype, jnp.floating):
                 b.set_value(b._value.astype(dtype))
     for sub in layer._sub_layers.values():
-        _cast_model(sub, dtype, excluded)
+        _cast_model(sub, dtype, excluded_types, excluded_ids)

@@ -99,25 +99,32 @@ def check_numerics(tensor, op_type: str = "", var_name: str = "",
 # -- operator stats (reference enable_operator_stats_collection) ------------
 
 _op_stats: Optional[Dict[str, Dict[str, int]]] = None
+_prev_checker = None
 
 
 def enable_operator_stats_collection():
-    """Count per-op calls by output dtype (reference low_precision_op_list)."""
-    global _op_stats
+    """Count per-op calls by output dtype (reference low_precision_op_list).
+    Chains with (and restores) any checker installed by
+    enable_tensor_checker."""
+    global _op_stats, _prev_checker
     _op_stats = {}
+    _prev_checker = amp_state.checker
 
     def _collect(op_name, leaves):
         for o in leaves:
             key = str(o.dtype)
             d = _op_stats.setdefault(op_name, {})
             d[key] = d.get(key, 0) + 1
+        if _prev_checker is not None:
+            _prev_checker(op_name, leaves)
 
     amp_state.checker = _collect
 
 
 def disable_operator_stats_collection():
-    global _op_stats
-    amp_state.checker = None
+    global _op_stats, _prev_checker
+    amp_state.checker = _prev_checker  # restore, don't uninstall, a live
+    _prev_checker = None               # tensor checker
     stats, _op_stats = _op_stats, None
     if stats:
         print("<" + "-" * 20 + " op list " + "-" * 20 + ">")

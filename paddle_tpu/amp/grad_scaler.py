@@ -60,6 +60,10 @@ class GradScaler:
         (reference GradScaler._unscale)."""
         if not self._enable or self._opt_state == OptimizerState.UNSCALED:
             return
+        if self._opt_state == OptimizerState.STEPPED:
+            raise RuntimeError(
+                "unscale_() is being called after step(); call update() "
+                "first (grads were already unscaled for this iteration)")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:

@@ -42,13 +42,21 @@ def _cast_leaf(v, dtype):
     return v
 
 
-def maybe_cast_inputs(op_name: Optional[str], values):
-    """Apply the active autocast policy to a flat list of raw op inputs."""
+def cast_dtype_for(op_name: Optional[str]):
+    """The dtype the active policy casts `op_name` inputs to, or None."""
     st = amp_state
     if not st.enabled or op_name is None:
-        return values
+        return None
     if op_name in st.black:
-        return [_cast_leaf(v, jnp.float32) for v in values]
+        return jnp.float32
     if st.level == "O2" or op_name in st.white:
-        return [_cast_leaf(v, st.dtype) for v in values]
-    return values
+        return st.dtype
+    return None
+
+
+def maybe_cast_inputs(op_name: Optional[str], values):
+    """Apply the active autocast policy to a flat list of raw op inputs."""
+    dt = cast_dtype_for(op_name)
+    if dt is None:
+        return values
+    return [_cast_leaf(v, dt) for v in values]

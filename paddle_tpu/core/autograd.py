@@ -124,24 +124,24 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     (args, kwargs) are differentiable inputs; raw arrays / python scalars are
     constants. Returns Tensor-wrapped outputs mirroring fn's output pytree.
     """
-    from .amp_state import amp_state, maybe_cast_inputs
+    from .amp_state import _cast_leaf, cast_dtype_for
     from .tensor import Tensor
 
     leaves, treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)
     t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
     raw = [l._value if isinstance(l, Tensor) else l for l in leaves]
-    if amp_state.enabled:
-        # autocast policy (≙ EagerAmpAutoCast in the generated ad_funcs,
-        # eager_gen.py:462): cast only the Tensor inputs, not python scalars
-        cast = maybe_cast_inputs(op_name, [raw[i] for i in t_idx])
-        for i, v in zip(t_idx, cast):
-            raw[i] = v
+    # autocast policy (≙ EagerAmpAutoCast in the generated ad_funcs,
+    # eager_gen.py:462); only Tensor inputs are cast, not python scalars
+    amp_dtype = cast_dtype_for(op_name)
 
     grad_wanted = _state.enabled and any(
         not leaves[i].stop_gradient for i in t_idx
     )
 
     if not grad_wanted:
+        if amp_dtype is not None:
+            for i in t_idx:
+                raw[i] = _cast_leaf(raw[i], amp_dtype)
         a, k = tree_unflatten(treedef, raw)
         out = fn(*a, **k)
         _maybe_check_numerics(op_name, out)
@@ -150,9 +150,13 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     tvals = [raw[i] for i in t_idx]
 
     def _pure(*tv):
+        # the cast happens INSIDE the differentiated function so the vjp
+        # includes the cast-back edge: leaf grads arrive in the LEAF's dtype
+        # (fp32 master grads for fp32 params under bf16/fp16 autocast),
+        # matching the reference where the cast is itself a recorded op
         buf = list(raw)
         for i, v in zip(t_idx, tv):
-            buf[i] = v
+            buf[i] = _cast_leaf(v, amp_dtype) if amp_dtype is not None else v
         a, k = tree_unflatten(treedef, buf)
         return fn(*a, **k)
 
@@ -217,6 +221,23 @@ def _wrap_outputs(out, node):
 
 def _ones_like(value):
     return jnp.ones(jnp.shape(value), jnp.result_type(value))
+
+
+def _place_leaf_grad(t, g):
+    """ZeRO-2: a param tagged with ``grad_pspec`` (GroupShardedStage2) gets
+    its eager .grad placed SHARDED over the sharding axis at accumulation
+    time — the eager analog of reduce-scatter-into-the-owner-shard. No-op
+    for untagged params and under trace (jit grads are placed by
+    in_shardings)."""
+    spec = getattr(t, "grad_pspec", None)
+    if spec is None or isinstance(g, jax.core.Tracer):
+        return g
+    from ..distributed._spmd import named_sharding
+
+    try:
+        return jax.device_put(g, named_sharding(spec))
+    except (RuntimeError, ValueError):
+        return g  # spec/mesh mismatch (e.g. mesh rebuilt smaller): keep global
 
 
 def _zero_cotangent(shape, dtype):
@@ -292,6 +313,7 @@ def run_backward(
             )
         if node is None or node.consumed:
             if accumulate_leaf_grads and not t.stop_gradient and node is None:
+                g = _place_leaf_grad(t, g)
                 if t.grad is None:
                     t.grad = Tensor(g, stop_gradient=True)
                 else:

@@ -49,8 +49,13 @@ def __getattr__(name):
     import importlib
 
     if name in ("fleet", "sharding", "checkpoint", "utils", "meta_parallel",
-                "auto_parallel", "launch"):
+                "auto_parallel", "launch", "sequence_parallel"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in ("ring_attention", "ulysses_attention", "split_sequence",
+                "gather_sequence"):
+        from . import sequence_parallel as sp_mod
+
+        return getattr(sp_mod, name)
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

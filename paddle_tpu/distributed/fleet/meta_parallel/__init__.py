@@ -21,4 +21,9 @@ def __getattr__(name):
         from .sharding_parallel import ShardingParallel
 
         return ShardingParallel
+    if name in ("GroupShardedOptimizerStage2", "GroupShardedStage2",
+                "GroupShardedStage3"):
+        from . import sharding
+
+        return getattr(sharding, name)
     raise AttributeError(name)

@@ -272,7 +272,14 @@ class PipelineLayer(Layer):
                     seg_x = l(seg_x)
                 return seg_x
 
-            x = recompute(run, x)
+            # remat every full segment; a SHORT tail segment (a lone
+            # embedding/head when interval > 1) keeps its activation — a
+            # one-layer activation is cheap and rerunning it buys nothing.
+            # interval == 1 means the user asked for per-layer remat: honor it.
+            if j - i > 1 or self._recompute_interval == 1:
+                x = recompute(run, x)
+            else:
+                x = run(x)
             i = j
         return x
 

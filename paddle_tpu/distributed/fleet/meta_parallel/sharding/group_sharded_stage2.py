@@ -1,0 +1,100 @@
+"""ZeRO stage-2: sharded optimizer states + sharded gradients.
+
+Reference: GroupShardedOptimizerStage2
+(meta_parallel/sharding/group_sharded_optimizer_stage2.py:53 — per-rank param
+partition, post-step broadcast :592) and GroupShardedStage2
+(group_sharded_stage2.py:372,409 — per-param grad hooks ``dist.reduce`` to
+the owner rank, comm/compute overlap :353).
+
+TPU-native redesign: no hook machinery. Gradients are *annotated* with the
+same sharding-axis PartitionSpec as the optimizer state that consumes them;
+inside a jitted step XLA then materialises the DP grad sync as
+**reduce-scatter** (instead of all-reduce) straight into the shard the
+state update reads — which is exactly stage-2's halving of grad traffic
+memory. Eagerly (no jit) arrays are global and the wrapper only places
+state shards; numerics are identical to DP (the reference's
+sharding-vs-DP parity test, hybrid_parallel_sharding_model.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ...._spmd import get_pspec, named_sharding
+from ....topology import get_mesh
+from ..meta_parallel_base import MetaParallelBase
+from ....sharding.sharded_optimizer import shard_optimizer_states, state_pspec
+
+__all__ = ["GroupShardedOptimizerStage2", "GroupShardedStage2"]
+
+
+class GroupShardedOptimizerStage2:
+    """Optimizer wrapper: inner optimizer runs on sharded states.
+
+    reference group_sharded_optimizer_stage2.py:53. ``offload`` keeps states
+    on host memory (device_put to CPU), trading step latency for HBM."""
+
+    def __init__(self, params, optim, group=None, offload=False,
+                 device="tpu", **kw):
+        self._optim = optim
+        self._group = group
+        self.offload = offload
+        mesh = get_mesh()
+        shard_optimizer_states(optim, mesh)
+        if offload:
+            self._host = jax.devices("cpu")[0]
+
+            orig_acc = optim._acc
+
+            def host_acc(name, p, init=None):
+                v = orig_acc(name, p, init)
+                try:
+                    v._value = jax.device_put(v._value, self._host)
+                except (RuntimeError, ValueError):
+                    pass
+                return v
+
+            optim._acc = host_acc
+
+    # delegate the full optimizer surface
+    def __getattr__(self, item):
+        return getattr(self._optim, item)
+
+    def step(self):
+        self._optim.step()
+
+    def clear_grad(self, *a, **kw):
+        self._optim.clear_grad(*a, **kw)
+
+    def state_dict(self):
+        return self._optim.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._optim.set_state_dict(sd)
+
+
+class GroupShardedStage2(MetaParallelBase):
+    """Model wrapper: annotate every param's GRADIENT placement with the
+    sharding axis (reference installs per-param reduce hooks; here the
+    annotation makes XLA emit reduce-scatter in jitted steps)."""
+
+    def __init__(self, layer, sharding_optimizer=None, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23,
+                 auto_refresh_trainable=True, device="tpu", dp_group=None):
+        self._sharding_optimizer = sharding_optimizer
+        super().__init__(layer, None, None)
+
+    def _prepare_for_model(self):
+        from ...._spmd import shard_params
+
+        mesh = get_mesh()
+        shard_params(self._layers, mesh)
+        for p in self._layers.parameters():
+            # grads follow the state spec (sharding axis added)
+            p.grad_pspec = state_pspec(p, mesh)
+
+    def grad_specs(self):
+        """name → grad PartitionSpec — drop into jit in_shardings for the
+        grads pytree of a functional train step."""
+        return {name: state_pspec(p, get_mesh())
+                for name, p in self._layers.named_parameters()}

@@ -1,0 +1,19 @@
+"""ShardingParallel model wrapper (reference meta_parallel/sharding_parallel.py:22).
+
+The reference broadcasts params inside the sharding group at wrap time so
+ranks agree; single-controller SPMD has one logical copy, so the wrapper's
+job is placement: put every param on the mesh per its PartitionSpec."""
+from __future__ import annotations
+
+from .meta_parallel_base import MetaParallelBase
+
+
+class ShardingParallel(MetaParallelBase):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers, hcg, strategy)
+
+    def _prepare_for_model(self):
+        from ..._spmd import shard_params
+        from ...topology import get_mesh
+
+        shard_params(self._layers, get_mesh())

@@ -1,0 +1,199 @@
+"""Sequence/context parallelism — ring attention + Ulysses (all-to-all).
+
+The reference snapshot has NO sequence parallelism (SURVEY.md §5.7: no ring
+attention, no Ulysses, no context-parallel utilities); long sequences lean
+on FlashAttention + recompute. This module designs SP fresh as a first-class
+mesh axis ``sp``, the capability extension the TPU build requires:
+
+- **ring attention**: Q stays put; K/V blocks rotate around the sp ring via
+  ``ppermute`` while each device accumulates its queries' attention with an
+  online softmax (flash-attention recurrence across devices). Peak memory
+  per device is O(S/R · S/R) scores; the K/V rotation rides ICI and XLA
+  overlaps it with the block compute. Causality is enforced with global
+  position masks, so results are bit-comparable to single-device attention.
+- **Ulysses**: all-to-all swaps the sharded axis seq↔heads, runs ordinary
+  (flash) attention with full sequence per head group, and swaps back.
+  Cheaper than ring for moderate S (two all-to-alls), requires H % sp == 0.
+
+Both are pure jax functions over GLOBAL arrays in paddle layout
+[B, S, H, D] — under jit on an sp mesh the arrays are sharded on S (ring) or
+re-sharded via all-to-all (Ulysses); eagerly (1 device) they reduce to exact
+attention, which is the parity test contract.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from .topology import get_mesh
+
+__all__ = ["ring_attention", "ulysses_attention", "split_sequence",
+           "gather_sequence", "RingFlashAttention"]
+
+
+def _online_block(q, k, v, acc, m, l, qpos, kpos, causal, scale):
+    """One flash-attention block accumulation step (fp32 state)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = s.astype(jnp.float32)
+    if causal:
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+    l = l * alpha + jnp.sum(p, axis=-1)
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return acc, m_new, l
+
+
+def ring_attention(q, k, v, causal: bool = False,
+                   sm_scale: Optional[float] = None,
+                   mesh: Optional[Mesh] = None, axis: str = "sp"):
+    """Ring attention over the ``axis`` mesh dim. q/k/v: [B, S, H, D] global.
+
+    Use under jit with S sharded over ``axis``; on a 1-wide axis it computes
+    plain exact attention.
+    """
+    mesh = mesh or get_mesh()
+    R = int(mesh.shape.get(axis, 1))
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if R == 1:
+        # single block: one online step == exact attention
+        b, s_, h, _ = q.shape
+        pos = jnp.arange(s_)
+        acc = jnp.zeros((b, h, s_, d), jnp.float32)
+        m = jnp.full((b, h, s_), -jnp.inf, jnp.float32)
+        l = jnp.zeros((b, h, s_), jnp.float32)
+        acc, m, l = _online_block(q, k, v, acc, m, l, pos, pos, causal, scale)
+        out = acc / jnp.maximum(l[..., None], 1e-30)
+        return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+    def worker(q, k, v):
+        r = lax.axis_index(axis)
+        b, sq, h, _ = q.shape  # local seq block
+        qpos = r * sq + jnp.arange(sq)
+        perm = [(i, (i + 1) % R) for i in range(R)]  # rotate kv around ring
+
+        acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+        m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((b, h, sq), jnp.float32)
+
+        def step(carry, i):
+            acc, m, l, kb, vb = carry
+            # block i holds rank (r - i) mod R's kv
+            src = (r - i) % R
+            kpos = src * sq + jnp.arange(sq)
+            acc, m, l = _online_block(q, kb, vb, acc, m, l, qpos, kpos,
+                                      causal, scale)
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
+            return (acc, m, l, kb, vb), None
+
+        (acc, m, l, _, _), _ = lax.scan(
+            step, (acc0, m0, l0, k, v), jnp.arange(R))
+        out = acc / jnp.maximum(l[..., None], 1e-30)
+        return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+    from jax import shard_map
+
+    spec = P(None, axis, None, None)
+    return shard_map(worker, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, axis_names={axis},
+                     check_vma=False)(q, k, v)
+
+
+def ulysses_attention(q, k, v, causal: bool = False,
+                      sm_scale: Optional[float] = None,
+                      mesh: Optional[Mesh] = None, axis: str = "sp",
+                      attn_fn=None):
+    """Ulysses (DeepSpeed) SP: all-to-all seq→heads, full-seq attention on
+    H/R heads, all-to-all back. q/k/v: [B, S, H, D] global."""
+    mesh = mesh or get_mesh()
+    R = int(mesh.shape.get(axis, 1))
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    def full_attn(q, k, v):
+        b, s_, h, _ = q.shape
+        pos = jnp.arange(s_)
+        acc = jnp.zeros((b, h, s_, d), jnp.float32)
+        m = jnp.full((b, h, s_), -jnp.inf, jnp.float32)
+        l = jnp.zeros((b, h, s_), jnp.float32)
+        acc, m, l = _online_block(q, k, v, acc, m, l, pos, pos, causal, scale)
+        out = acc / jnp.maximum(l[..., None], 1e-30)
+        return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+    if attn_fn is None:
+        attn_fn = full_attn
+    if R == 1:
+        return attn_fn(q, k, v)
+    if q.shape[2] % R != 0:
+        raise ValueError(
+            f"ulysses needs num_heads {q.shape[2]} divisible by sp={R}")
+
+    def worker(q, k, v):
+        # local: [B, S/R, H, D] → all_to_all → [B, S, H/R, D]
+        def a2a_fwd(x):
+            x = lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                               tiled=True)
+            return x
+
+        def a2a_bwd(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        out = attn_fn(a2a_fwd(q), a2a_fwd(k), a2a_fwd(v))
+        return a2a_bwd(out)
+
+    from jax import shard_map
+
+    spec = P(None, axis, None, None)
+    return shard_map(worker, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, axis_names={axis},
+                     check_vma=False)(q, k, v)
+
+
+def split_sequence(x, mesh: Optional[Mesh] = None, axis: str = "sp",
+                   seq_dim: int = 1):
+    """Annotate x as sequence-sharded (GSPMD scatters on first use)."""
+    from ._spmd import constraint
+
+    nd = x.ndim
+    spec = [None] * nd
+    spec[seq_dim] = axis
+    return constraint(x, P(*spec), mesh)
+
+
+def gather_sequence(x, mesh: Optional[Mesh] = None, axis: str = "sp",
+                    seq_dim: int = 1):
+    """Annotate x replicated on the sp axis (all-gather on use)."""
+    from ._spmd import constraint
+
+    return constraint(x, P(*([None] * x.ndim)), mesh)
+
+
+class RingFlashAttention:
+    """Layer-ish callable holding (causal, scale, axis) config; drops into
+    transformer blocks where a flash_attention callable is expected."""
+
+    def __init__(self, causal: bool = True, sm_scale=None, axis: str = "sp"):
+        self.causal = causal
+        self.sm_scale = sm_scale
+        self.axis = axis
+
+    def __call__(self, q, k, v):
+        return ring_attention(q, k, v, causal=self.causal,
+                              sm_scale=self.sm_scale, axis=self.axis)

@@ -13,9 +13,8 @@ TPU-native: all three levels are sharding-annotation policies over the
 """
 from __future__ import annotations
 
-from .._spmd import get_pspec, set_pspec
 from ..topology import get_mesh
-from .sharded_optimizer import shard_optimizer_states, state_pspec
+from .sharded_optimizer import shard_optimizer_states
 
 __all__ = ["group_sharded_parallel", "save_group_sharded_model"]
 
@@ -29,16 +28,27 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"level must be os | os_g | p_g_os, got {level}")
     mesh = get_mesh()
-    shard_optimizer_states(optimizer, mesh)
-    if level == "p_g_os":
-        # stage 3: params themselves carry a sharding-axis spec so they live
-        # scattered between uses (ZeRO-3); grads inherit it by transposition
-        deg = int(mesh.shape.get("sharding", 1))
-        if deg > 1:
-            for p in model.parameters():
-                set_pspec(p, state_pspec(p, mesh))
-    if scaler is not None:
-        return model, optimizer, scaler
+    if level == "os":
+        shard_optimizer_states(optimizer, mesh)
+    elif level == "os_g":
+        from ..fleet.meta_parallel.sharding import (
+            GroupShardedOptimizerStage2, GroupShardedStage2)
+
+        optimizer = GroupShardedOptimizerStage2(
+            params=model.parameters(), optim=optimizer, group=group,
+            offload=offload)
+        model = GroupShardedStage2(model, sharding_optimizer=optimizer,
+                                   group=group, sync_buffers=sync_buffers,
+                                   buffer_max_size=buffer_max_size,
+                                   dp_group=dp_group)
+    else:  # p_g_os
+        from ..fleet.meta_parallel.sharding import GroupShardedStage3
+
+        model = GroupShardedStage3(model, optimizer=optimizer, group=group,
+                                   sync_buffers=sync_buffers,
+                                   segment_size=segment_size, offload=offload,
+                                   sync_comm=sync_comm, dp_group=dp_group,
+                                   exclude_layer=exclude_layer)
     return model, optimizer, scaler
 
 

@@ -35,7 +35,13 @@ def state_pspec(param, mesh=None) -> P:
     base = get_pspec(param) or P()
     shape = tuple(param.shape) if hasattr(param, "shape") else ()
     spec = list(base) + [None] * (len(shape) - len(base))
-    if deg > 1:
+
+    def _has_sharding(entry):
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        return "sharding" in names
+
+    already = any(e is not None and _has_sharding(e) for e in spec)
+    if deg > 1 and not already:
         for i, dim in enumerate(shape):
             if spec[i] is None and dim % deg == 0:
                 spec[i] = "sharding"

@@ -50,3 +50,9 @@ def get_flags(flags: Union[str, List[str]]):
 def set_flags(flags: Dict[str, Any]):
     for k, v in flags.items():
         _REGISTRY[k] = v
+    # live toggles: flags that runtime components read per-op are pushed to
+    # their owners here (the pybind global_value_getter_setter analog)
+    if "FLAGS_check_nan_inf" in flags:
+        from ..core.amp_state import amp_state
+
+        amp_state.check_nan_inf = bool(flags["FLAGS_check_nan_inf"])

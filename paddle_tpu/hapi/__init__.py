@@ -1,0 +1,7 @@
+"""paddle.hapi parity (reference: python/paddle/hapi/)."""
+from . import callbacks
+from .dynamic_flops import flops
+from .model import Model
+from .model_summary import summary
+
+__all__ = ["Model", "summary", "flops", "callbacks"]

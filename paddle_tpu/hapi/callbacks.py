@@ -1,0 +1,254 @@
+"""High-level API callbacks (reference: python/paddle/hapi/callbacks.py —
+Callback/CallbackList, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+LRScheduler; VisualDL omitted: no visualdl on TPU hosts, kept as stub)."""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler", "VisualDL", "config_callbacks"]
+
+
+class Callback:
+    """reference callbacks.py Callback — every hook is optional."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    # train/eval/predict lifecycle hooks
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a: self._call(name, *a)
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """reference callbacks.py ProgBarLogger: periodic loss/metric lines."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self._start = time.time()
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+
+    def _fmt(self, logs):
+        out = []
+        for k, v in (logs or {}).items():
+            if isinstance(v, (numbers.Number, np.floating)):
+                out.append(f"{k}: {float(v):.4f}")
+            elif isinstance(v, (list, tuple)) and v and isinstance(
+                    v[0], numbers.Number):
+                out.append(f"{k}: " + "/".join(f"{float(x):.4f}" for x in v))
+        return " - ".join(out)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose == 2 and (step + 1) % self.log_freq == 0:
+            print(f"step {step + 1}/{self.steps or '?'} - {self._fmt(logs)}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._start
+            print(f"epoch {epoch + 1} done ({dt:.1f}s) - {self._fmt(logs)}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print(f"Eval - {self._fmt(logs)}")
+
+
+class ModelCheckpoint(Callback):
+    """reference callbacks.py ModelCheckpoint: save every N epochs + final."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """reference callbacks.py EarlyStopping (monitor/patience/min_delta)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.stopped_epoch = 0
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and "acc" not in monitor):
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = np.greater
+        self.best_value = np.inf if self.monitor_op == np.less else -np.inf
+        self.wait_epoch = 0
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        current = float(current)
+        if self.monitor_op(current - self.min_delta, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            if self.save_best_model and self.params.get("save_dir"):
+                self.model.save(
+                    os.path.join(self.params["save_dir"], "best_model"))
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.model.stop_training = True
+            if self.verbose:
+                print(f"Early stopping: {self.monitor} did not improve for "
+                      f"{self.patience} evals")
+
+
+class LRScheduler(Callback):
+    """reference callbacks.py LRScheduler: steps the optimizer's LR scheduler."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s:
+                s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s:
+                s.step()
+
+
+class VisualDL(Callback):
+    """Stub: visualdl is GPU-stack tooling; scalars are appended to a jsonl
+    file instead so training curves remain recoverable."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        import json
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            rec = {"step": self._step}
+            for k, v in (logs or {}).items():
+                if isinstance(v, (int, float, np.floating)):
+                    rec[k] = float(v)
+            f.write(json.dumps(rec) + "\n")
+        self._step += 1
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=10, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    """reference callbacks.py config_callbacks: install defaults."""
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks = cbks + [LRScheduler()]
+    cb_list = CallbackList(cbks)
+    cb_list.set_model(model)
+    params = {
+        "batch_size": batch_size, "epochs": epochs, "steps": steps,
+        "log_freq": log_freq, "verbose": verbose, "metrics": metrics or [],
+        "save_dir": save_dir,
+    }
+    cb_list.set_params(params)
+    return cb_list

@@ -1,0 +1,70 @@
+"""FLOPs estimation (reference: python/paddle/hapi/dynamic_flops.py — per-op
+handlers over forward hooks). Counts multiply-accumulates as 2 FLOPs/MAC for
+matmul/conv (the MFU convention bench.py uses)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["flops"]
+
+
+def _out_shape(out):
+    o = out[0] if isinstance(out, (list, tuple)) else out
+    return tuple(o.shape) if isinstance(o, Tensor) else ()
+
+
+def _count(layer, inp, out) -> int:
+    name = type(layer).__name__
+    oshape = _out_shape(out)
+    if not oshape:
+        return 0
+    n_out = int(np.prod(oshape))
+    if name == "Linear":
+        return 2 * n_out * int(layer.weight.shape[0])
+    if name.startswith("Conv"):
+        w = layer.weight  # [out_c, in_c/groups, *k]
+        per_out = 2 * int(np.prod(w.shape[1:]))
+        return n_out * per_out
+    if name in ("ReLU", "GELU", "Sigmoid", "Tanh", "Softmax", "SiLU"):
+        return n_out
+    if "Norm" in name:
+        return 5 * n_out
+    if name in ("AvgPool2D", "MaxPool2D", "AdaptiveAvgPool2D"):
+        return n_out
+    return 0
+
+
+def flops(net: Layer, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False) -> int:
+    total = [0]
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def attach(layer):
+        for sub in layer._sub_layers.values():
+            if sub._sub_layers:
+                attach(sub)
+            else:
+                def hook(l, i, o):
+                    fn = custom_ops.get(type(l))
+                    total[0] += int(fn(l, i, o)) if fn else _count(l, i, o)
+
+                hooks.append(sub.register_forward_post_hook(hook))
+
+    attach(net)
+    try:
+        if inputs is not None:
+            xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            net(*xs)
+        else:
+            shape = tuple(1 if d in (None, -1) else d for d in input_size)
+            net(Tensor(np.zeros(shape, np.float32)))
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

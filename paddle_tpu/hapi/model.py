@@ -1,0 +1,279 @@
+"""paddle.Model — the Keras-like high-level API.
+
+Reference: python/paddle/hapi/model.py:1050 (Model), fit :1741,
+DynamicGraphAdapter.train_batch :817. The reference carries two adapters
+(dygraph vs static graph); under a tracing runtime only the imperative
+adapter exists, with paddle_tpu.jit.to_static available for compiled serving.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+class Model:
+    """reference hapi/model.py:1050 parity."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- configuration -----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer) or callable(loss)):
+            raise TypeError(
+                "'loss' must be sub classes of `paddle.nn.Layer` or any "
+                "callable function.")
+        self._loss = loss
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError(
+                    f"{type(m).__name__} is not a valid paddle.metric.Metric")
+        self._metrics = _to_list(metrics)
+        self._amp_level = None
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level")
+
+    # -- single-batch ops ---------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        labs = _to_list(labels)
+        if self._loss is None:
+            raise RuntimeError("loss not set; call prepare(loss=...)")
+        loss = self._loss(*(outs + labs))
+        if isinstance(loss, (list, tuple)):
+            loss = sum(l.sum() for l in loss)
+        if loss.ndim > 0:
+            loss = loss.mean()
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """reference model.py DynamicGraphAdapter.train_batch:817."""
+        self.network.train()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(y) for y in _to_list(labels)]
+
+        if self._amp_level in ("O1", "O2"):
+            from .. import amp as amp_mod
+
+            with amp_mod.auto_cast(level=self._amp_level):
+                outputs = self.network(*inputs)
+                loss = self._compute_loss(outputs, labels)
+        else:
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        if metrics:
+            return [float(loss)], metrics
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(y) for y in _to_list(labels)]
+        with no_grad():
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels) if self._loss else None
+        metrics = self._update_metrics(outputs, labels)
+        if loss is None:
+            return metrics
+        return ([float(loss)], metrics) if metrics else [float(loss)]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        with no_grad():
+            out = self.network(*inputs)
+        return [o.numpy() for o in _to_list(out)]
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        outs = _to_list(outputs)
+        for m in self._metrics:
+            stats = m.compute(*(outs + labels))
+            r = m.update(*_to_list(stats))
+            res.append(r)
+        return res
+
+    # -- loops --------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # assume iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """reference model.py fit:1741."""
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir, metrics=self._metrics)
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                out = self.train_batch(ins, labs, update=update)
+                logs = self._pack_logs(out)
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks,
+                              _inner=True)
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _inner=False):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks if _inner else config_callbacks(
+            callbacks, model=self, batch_size=batch_size, verbose=verbose,
+            metrics=self._metrics, mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            out = self.eval_batch(ins, labs)
+            logs = self._pack_logs(out)
+            cbks.on_eval_batch_end(step, logs)
+        # final accumulated metric values
+        for m in self._metrics:
+            logs[m.name()[0] if isinstance(m.name(), list) else m.name()] = (
+                m.accumulate())
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_label=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    def _split_batch(self, batch, has_label=True):
+        if isinstance(batch, (list, tuple)):
+            if has_label and len(batch) >= 2:
+                return batch[:-1] if len(batch) > 2 else [batch[0]], [batch[-1]]
+            return list(batch), []
+        return [batch], []
+
+    def _pack_logs(self, out):
+        logs = {}
+        if isinstance(out, tuple):
+            losses, metrics = out
+            logs["loss"] = losses[0]
+            for m, r in zip(self._metrics, metrics):
+                name = m.name()
+                logs[name[0] if isinstance(name, list) else name] = r
+        elif isinstance(out, list):
+            logs["loss"] = out[0]
+        return logs
+
+    # -- io ------------------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        """reference model.py save: params + optimizer state (training=True)
+        or inference artifact via jit (training=False)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework import io as fio
+
+        if training:
+            fio.save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                fio.save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from .. import jit
+
+            jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
+        from ..framework import io as fio
+
+        sd = fio.load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(fio.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

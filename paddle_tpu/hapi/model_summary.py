@@ -1,0 +1,70 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py — layer
+table with output shapes and param counts via forward hooks)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["summary"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Prints the per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def register(layer, prefix=""):
+        for name, sub in layer._sub_layers.items():
+            full = f"{prefix}{name}"
+            if sub._sub_layers:
+                register(sub, full + ".")
+            else:
+                def hook(l, inp, out, _full=full):
+                    shape = None
+                    o = out[0] if isinstance(out, (list, tuple)) else out
+                    if isinstance(o, Tensor):
+                        shape = tuple(o.shape)
+                    n = sum(int(np.prod(p.shape))
+                            for _, p in l.named_parameters())
+                    rows.append((_full, type(l).__name__, shape, n))
+
+                hooks.append(sub.register_forward_post_hook(hook))
+
+    register(net)
+    try:
+        if input is not None:
+            x = input if isinstance(input, (list, tuple)) else [input]
+            net(*x)
+        elif input_size is not None:
+            sizes = (input_size if isinstance(input_size, list)
+                     else [input_size])
+            dts = dtypes if isinstance(dtypes, (list, tuple)) else [
+                dtypes] * len(sizes)
+            args = []
+            for s, dt in zip(sizes, dts):
+                s = tuple(1 if d in (None, -1) else d for d in s)
+                args.append(Tensor(np.zeros(s, dtype=np.dtype(dt or "float32"))))
+            net(*args)
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for _, p in net.named_parameters())
+    trainable = sum(int(np.prod(p.shape)) for _, p in net.named_parameters()
+                    if p.trainable)
+    w = 76
+    print("-" * w)
+    print(f"{'Layer (type)':<36}{'Output Shape':<24}{'Param #':>14}")
+    print("=" * w)
+    for name, cls, shape, n in rows:
+        print(f"{name + ' (' + cls + ')':<36}{str(shape):<24}{n:>14,}")
+    print("=" * w)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * w)
+    return {"total_params": total, "trainable_params": trainable}

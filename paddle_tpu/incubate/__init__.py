@@ -1,0 +1,12 @@
+"""paddle.incubate parity namespace (reference: python/paddle/incubate/)."""
+import importlib
+
+_LAZY = {"distributed", "nn"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu.incubate' has no attribute {name!r}")

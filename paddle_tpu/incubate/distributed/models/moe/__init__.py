@@ -1,0 +1,4 @@
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .moe_layer import MoELayer
+
+__all__ = ["MoELayer", "BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]

@@ -1,0 +1,27 @@
+"""Gate base class (reference: incubate/distributed/models/moe/gate/base_gate.py)."""
+from __future__ import annotations
+
+from ......nn.layer.layers import Layer
+
+__all__ = ["BaseGate"]
+
+
+class BaseGate(Layer):
+    def __init__(self, num_expert: int, world_size: int):
+        super().__init__()
+        self.world_size = max(int(world_size), 1)
+        self.num_expert = int(num_expert)
+        self.tot_expert = self.world_size * self.num_expert
+        self.loss = None
+
+    def forward(self, x):
+        raise NotImplementedError("Base gate cannot be called")
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss

@@ -1,0 +1,54 @@
+"""GShard top-2 gate with load-balancing auxiliary loss.
+
+Reference: moe/gate/gshard_gate.py (top-2, random second-expert dampening,
+aux loss = mean(ce * me) * num_experts² as in the GShard paper)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ......core.autograd import apply_op
+from ......core.random import default_generator
+from .naive_gate import NaiveGate
+
+__all__ = ["GShardGate"]
+
+
+class GShardGate(NaiveGate):
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2, capacity=(1.2, 2.4), random_routing=True,
+                 group=None):
+        if topk != 2:
+            raise ValueError("topk should be 2 in GShardGate")
+        super().__init__(d_model, num_expert, world_size, topk=2)
+        self.capacity = capacity
+        self.random_routing = random_routing
+
+    def forward(self, x):
+        gate_score = self.gate(x)
+        key = default_generator.next_key() if self.random_routing else None
+
+        def route(s):
+            probs = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+            top_val, top_idx = jax.lax.top_k(probs, 2)
+            # aux loss: fraction of tokens per expert × mean gate prob
+            ce = jnp.mean(
+                jax.nn.one_hot(top_idx[..., 0], self.tot_expert), axis=0)
+            me = jnp.mean(probs, axis=0)
+            aux = jnp.sum(ce * me) * (self.tot_expert ** 2)
+            if key is not None:
+                # randomly drop the 2nd expert when its weight is small
+                # (reference: topk_val[1] < rand * topk_val[0] → mask)
+                r = jax.random.uniform(key, top_val[..., 1].shape)
+                keep2 = top_val[..., 1] > r * top_val[..., 0] / 2.0
+                top_idx = jnp.stack(
+                    [top_idx[..., 0],
+                     jnp.where(keep2, top_idx[..., 1], -1)], axis=-1)
+            return top_val, top_idx, aux
+
+        val = apply_op(lambda s: route(s)[0], gate_score, op_name="gshard_v")
+        det = gate_score.detach()
+        idx = apply_op(lambda s: route(s)[1], det, op_name="gshard_i")
+        aux = apply_op(lambda s: route(s)[2], gate_score, op_name="gshard_aux")
+        self.set_loss(aux)
+        return val, idx

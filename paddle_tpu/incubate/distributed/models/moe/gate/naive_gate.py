@@ -1,0 +1,34 @@
+"""Naive top-k gate (reference: moe/gate/naive_gate.py — a Linear scorer
+with top-k selection, no auxiliary loss)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ......core.autograd import apply_op
+from ......nn.layer.common import Linear
+from .base_gate import BaseGate
+
+__all__ = ["NaiveGate"]
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2):
+        super().__init__(num_expert, world_size)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores=False):
+        gate_score = self.gate(inp)
+        # routing weights are the softmax over the selected k (probability-
+        # like, as _build_dispatch's kept-expert renormalisation expects)
+        val = apply_op(
+            lambda s: jax.nn.softmax(
+                jax.lax.top_k(s, self.top_k)[0].astype(jnp.float32), axis=-1),
+            gate_score, op_name="gate_topk_v")
+        idx = apply_op(lambda s: jax.lax.top_k(s, self.top_k)[1],
+                       gate_score.detach(), op_name="gate_topk_i")
+        if return_all_scores:
+            return val, idx, gate_score
+        return val, idx

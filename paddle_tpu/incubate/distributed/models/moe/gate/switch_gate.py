@@ -1,0 +1,50 @@
+"""Switch-Transformer top-1 gate with load-balance loss.
+
+Reference: moe/gate/switch_gate.py (top-1 routing, aux loss from the Switch
+paper: num_experts * sum(fraction_tokens * mean_prob))."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ......core.autograd import apply_op
+from ......core.random import default_generator
+from .naive_gate import NaiveGate
+
+__all__ = ["SwitchGate"]
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 1, switch_eps: float = 0.1, capacity=(1.2, 2.4),
+                 group=None):
+        if topk != 1:
+            raise ValueError("topk should be 1 in SwitchGate")
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+
+    def forward(self, inp):
+        score = self.gate(inp)
+        key = default_generator.next_key() if self.training else None
+
+        def route(s):
+            if key is not None:  # training: multiplicative jitter
+                noise = jax.random.uniform(
+                    key, s.shape, minval=1.0 - self.switch_eps,
+                    maxval=1.0 + self.switch_eps)
+                s = s * noise
+            probs = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+            top_val, top_idx = jax.lax.top_k(probs, 1)
+            ce = jnp.mean(
+                jax.nn.one_hot(top_idx[..., 0], self.tot_expert), axis=0)
+            me = jnp.mean(probs, axis=0)
+            aux = jnp.sum(ce * me) * self.tot_expert
+            return top_val, top_idx, aux
+
+        val = apply_op(lambda s: route(s)[0], score, op_name="switch_v")
+        idx = apply_op(lambda s: route(s)[1], score.detach(),
+                       op_name="switch_i")
+        aux = apply_op(lambda s: route(s)[2], score, op_name="switch_aux")
+        self.set_loss(aux)
+        return val, idx

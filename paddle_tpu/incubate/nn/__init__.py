@@ -1,0 +1,4 @@
+"""paddle.incubate.nn (fused layers land with the Pallas kernel milestone)."""
+from . import functional
+
+__all__ = ["functional"]

@@ -18,7 +18,7 @@ _param_counter = [0]
 class Parameter(Tensor):
     __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
                  "do_model_average", "is_distributed", "split_axis",
-                 "pp_stage")
+                 "pp_stage", "grad_pspec")
 
     def __init__(self, value, trainable: bool = True, name=None,
                  learning_rate: float = 1.0, regularizer=None,
@@ -39,6 +39,8 @@ class Parameter(Tensor):
         self.split_axis = None
         # pipeline stage placement (None = not under a PipelineLayer)
         self.pp_stage = None
+        # gradient placement (ZeRO-2: sharding-axis spec; None = follow param)
+        self.grad_pspec = None
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

@@ -54,6 +54,17 @@ class TestAutoCast:
             out = paddle.matmul(x, x)
         assert out.dtype == jnp.float32
 
+    def test_leaf_grads_keep_param_dtype(self):
+        # autocast cast is part of the differentiated function: fp32 params
+        # get fp32 grads (master grads) even when compute ran in bf16/fp16
+        model = nn.Linear(4, 4)
+        x = paddle.ones([2, 4])
+        with amp.auto_cast(level="O1", dtype="float16"):
+            loss = model(x).mean()
+        loss.backward()
+        assert model.weight.dtype == jnp.float32
+        assert model.weight.grad.dtype == jnp.float32
+
     def test_fp16_dtype(self):
         x = paddle.ones([4, 4])
         with amp.auto_cast(level="O1", dtype="float16"):
@@ -64,6 +75,15 @@ class TestAutoCast:
         with pytest.raises(ValueError):
             with amp.auto_cast(level="O3"):
                 pass
+
+    def test_nested_disable(self):
+        x = paddle.ones([4, 4])
+        with amp.auto_cast(level="O1"):
+            with amp.auto_cast(enable=False):
+                out = paddle.matmul(x, x)
+            out2 = paddle.matmul(x, x)
+        assert out.dtype == jnp.float32   # inner region: AMP off
+        assert out2.dtype == jnp.bfloat16  # outer region restored
 
 
 class TestDecorate:
@@ -77,6 +97,18 @@ class TestDecorate:
         model = nn.Linear(8, 8)
         model = amp.decorate(model, level="O1")
         assert model.weight.dtype == jnp.float32
+
+    def test_excluded_layer_instance(self):
+        model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        model = amp.decorate(model, level="O2",
+                             excluded_layers=[model[1]])
+        assert model[0].weight.dtype == jnp.bfloat16
+        assert model[1].weight.dtype == jnp.float32
+
+    def test_excluded_layer_class(self):
+        model = nn.Sequential(nn.Linear(8, 8), nn.Embedding(4, 8))
+        model = amp.decorate(model, level="O2", excluded_layers=[nn.Embedding])
+        assert model[1].weight.dtype == jnp.float32
 
     def test_with_optimizer(self):
         from paddle_tpu.optimizer import SGD
@@ -142,6 +174,17 @@ class TestGradScaler:
         with pytest.raises(RuntimeError):
             s.step(opt)
 
+    def test_unscale_after_step_raises(self):
+        from paddle_tpu.optimizer import SGD
+
+        s = amp.GradScaler()
+        model = nn.Linear(2, 2)
+        opt = SGD(learning_rate=0.1, parameters=model.parameters())
+        s.scale(model(paddle.ones([1, 2])).mean()).backward()
+        s.step(opt)
+        with pytest.raises(RuntimeError, match="unscale_"):
+            s.unscale_(opt)
+
     def test_disabled_passthrough(self):
         s = amp.GradScaler(enable=False)
         x = paddle.ones([2])
@@ -158,16 +201,13 @@ class TestGradScaler:
 
 class TestDebugging:
     def test_check_nan_inf_flag(self):
+        # the PUBLIC flag path alone must arm the sentry
         paddle.set_flags({"FLAGS_check_nan_inf": True})
-        from paddle_tpu.core.amp_state import amp_state
-
-        amp_state.check_nan_inf = True
         try:
             x = paddle.to_tensor([1.0, 0.0])
             with pytest.raises(RuntimeError, match="Nan/Inf"):
                 paddle.log(x - 2.0)
         finally:
-            amp_state.check_nan_inf = False
             paddle.set_flags({"FLAGS_check_nan_inf": False})
 
     def test_tensor_checker(self):
@@ -195,6 +235,20 @@ class TestDebugging:
         from paddle_tpu.core.amp_state import amp_state
 
         assert amp_state.checker is None
+
+    def test_operator_stats_preserves_tensor_checker(self):
+        from paddle_tpu.core.amp_state import amp_state
+
+        cfg = TensorCheckerConfig(enable=True,
+                                  debug_mode=DebugMode.CHECK_NAN_INF)
+        enable_tensor_checker(cfg)
+        try:
+            with collect_operator_stats():
+                paddle.sqrt(paddle.to_tensor([-1.0]))  # checker still fires
+            assert amp_state.checker == cfg._check  # restored, not cleared
+            assert cfg._found  # chained checker saw the nan
+        finally:
+            disable_tensor_checker()
 
 
 class TestAmpWithModel:

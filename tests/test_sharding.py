@@ -1,0 +1,148 @@
+"""ZeRO sharding tests (reference contract: sharding-vs-DP parity,
+test/collective/fleet/hybrid_parallel_sharding_model.py; plus placement
+checks that states/params are actually scattered over the sharding axis)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.distributed.topology import build_mesh, set_mesh
+from paddle_tpu.optimizer import AdamW
+
+
+def make_model():
+    return nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 8))
+
+
+def train_steps(model, opt, x, n=3):
+    losses = []
+    for _ in range(n):
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+class TestGroupSharded:
+    def setup_method(self, _):
+        set_mesh(build_mesh(sharding=8))
+
+    def test_bad_level(self):
+        m = make_model()
+        opt = AdamW(parameters=m.parameters())
+        with pytest.raises(ValueError):
+            group_sharded_parallel(m, opt, level="zz")
+
+    def test_os_states_sharded(self):
+        m = make_model()
+        opt = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        m, opt, _ = group_sharded_parallel(m, opt, level="os")
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        train_steps(m, opt, x, 1)
+        # moment accumulators for the [16,32] weight must be sharded
+        from paddle_tpu.core.tensor import Tensor
+
+        sharded = False
+        for accs in opt._accumulators.values():
+            for v in accs.values():
+                val = v._value if isinstance(v, Tensor) else v
+                spec = getattr(val, "sharding", None)
+                if spec is not None and "sharding" in str(
+                        getattr(spec, "spec", "")):
+                    sharded = True
+        assert sharded
+
+    def test_os_g_wrappers(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.sharding import (
+            GroupShardedOptimizerStage2, GroupShardedStage2)
+
+        m = make_model()
+        opt = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        m2, opt2, _ = group_sharded_parallel(m, opt, level="os_g")
+        assert isinstance(opt2, GroupShardedOptimizerStage2)
+        assert isinstance(m2, GroupShardedStage2)
+        specs = m2.grad_specs()
+        assert any("sharding" in str(s) for s in specs.values())
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        losses = train_steps(m2, opt2, x)
+        assert losses[-1] < losses[0]
+
+    def test_os_g_grads_placed_sharded(self):
+        """grad_pspec must be CONSUMED: eager .grad lands sharded."""
+        m = make_model()
+        opt = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        m2, opt2, _ = group_sharded_parallel(m, opt, level="os_g")
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        loss = (m2(x) ** 2).mean()
+        loss.backward()
+        w = m2._layers[0].weight
+        assert w.grad is not None
+        assert "sharding" in str(w.grad._value.sharding.spec)
+        opt2.clear_grad()
+
+    def test_p_g_os_params_scattered(self):
+        m = make_model()
+        opt = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        m3, opt3, _ = group_sharded_parallel(m, opt, level="p_g_os")
+        w = m3._layers[0].weight
+        sh = w._value.sharding
+        assert "sharding" in str(getattr(sh, "spec", ""))
+        # logical value is still the full array
+        assert tuple(w.shape) == (16, 32)
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        losses = train_steps(m3, opt3, x)
+        assert losses[-1] < losses[0]
+        # gather API returns host copies
+        full = m3.get_all_parameters(convert2cpu=True)
+        assert full[0].shape == (16, 32)
+
+    def test_sharding_parity_vs_plain(self):
+        """The ZeRO memory layout must not change the math (reference
+        hybrid_parallel_sharding_model.py contract)."""
+        x = np.random.randn(4, 16).astype(np.float32)
+
+        m_ref = make_model()
+        m = make_model()
+        m.set_state_dict(m_ref.state_dict())  # sync BEFORE training
+
+        opt_ref = AdamW(learning_rate=1e-2, parameters=m_ref.parameters())
+        ref_losses = train_steps(m_ref, opt_ref, paddle.to_tensor(x))
+
+        opt = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        m, opt, _ = group_sharded_parallel(m, opt, level="p_g_os")
+        zero_losses = train_steps(m, opt, paddle.to_tensor(x))
+        np.testing.assert_allclose(ref_losses, zero_losses, rtol=2e-5)
+
+    def test_save_group_sharded_model(self, tmp_path):
+        from paddle_tpu.distributed.sharding import save_group_sharded_model
+
+        m = make_model()
+        opt = AdamW(parameters=m.parameters())
+        m, opt, _ = group_sharded_parallel(m, opt, level="p_g_os")
+        save_group_sharded_model(m._layers, str(tmp_path), opt)
+        assert (tmp_path / "model.pdparams").exists()
+
+
+class TestDygraphShardingOptimizer:
+    def setup_method(self, _):
+        set_mesh(build_mesh(sharding=8))
+
+    def test_partition_and_step(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer\
+            .dygraph_sharding_optimizer import DygraphShardingOptimizer
+
+        m = make_model()
+        opt = DygraphShardingOptimizer(
+            AdamW(learning_rate=1e-2, parameters=m.parameters()))
+        parts = opt._partition_parameters()
+        assert len(parts) == 8
+        total = sum(len(v) for v in parts.values())
+        assert total == len(list(m.parameters()))
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        losses = train_steps(m, opt, x)
+        assert losses[-1] < losses[0]
