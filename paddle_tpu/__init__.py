@@ -95,9 +95,20 @@ def __getattr__(name):
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
-def disable_static(place=None):  # dygraph is the only mode; API-parity no-op
-    return None
+def enable_static():
+    """Enter static (record-then-jit) mode — see paddle_tpu.static."""
+    from .static import enable_static as _e
+
+    return _e()
+
+
+def disable_static(place=None):
+    from .static import disable_static as _d
+
+    return _d()
 
 
 def in_dynamic_mode() -> bool:
-    return True
+    from .static.program import in_static_mode
+
+    return not in_static_mode()

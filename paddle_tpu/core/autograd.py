@@ -124,8 +124,32 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     (args, kwargs) are differentiable inputs; raw arrays / python scalars are
     constants. Returns Tensor-wrapped outputs mirroring fn's output pytree.
     """
+    from . import op_hooks
     from .amp_state import _cast_leaf, cast_dtype_for
     from .tensor import Tensor
+
+    if op_hooks.op_span_hook is not None:
+        import time as _time
+
+        _t0 = _time.perf_counter_ns()
+        try:
+            return _apply_op_inner(fn, args, kwargs, op_name)
+        finally:
+            op_hooks.op_span_hook(op_name or getattr(fn, "__name__", "op"),
+                                  _t0, _time.perf_counter_ns())
+    return _apply_op_inner(fn, args, kwargs, op_name)
+
+
+def _apply_op_inner(fn, args, kwargs, op_name):
+    from .amp_state import _cast_leaf, cast_dtype_for
+    from .tensor import Tensor
+
+    from ..static.program import static_state
+
+    if static_state.enabled:
+        from ..static.record import record_op
+
+        return record_op(fn, args, kwargs, op_name)
 
     leaves, treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)
     t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]

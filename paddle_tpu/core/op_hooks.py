@@ -1,0 +1,12 @@
+"""Op-dispatch instrumentation point.
+
+The reference emits RecordEvent spans inside every generated ad_func
+(eager_gen.py:1097-1098); here the single choke point is apply_op, which
+calls ``op_span_hook(name, start_ns, end_ns)`` when one is installed (the
+profiler does). None = zero overhead.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+op_span_hook: Optional[Callable[[str, int, int], None]] = None

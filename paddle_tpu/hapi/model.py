@@ -111,9 +111,12 @@ class Model:
             outputs = self.network(*inputs)
             loss = self._compute_loss(outputs, labels) if self._loss else None
         metrics = self._update_metrics(outputs, labels)
-        if loss is None:
-            return metrics
-        return ([float(loss)], metrics) if metrics else [float(loss)]
+        losses = [] if loss is None else [float(loss)]
+        # always (losses, metrics) when metrics exist so _pack_logs can't
+        # mislabel a metric value as the loss
+        if metrics:
+            return (losses, metrics)
+        return losses
 
     def predict_batch(self, inputs):
         self.network.eval()
@@ -234,11 +237,12 @@ class Model:
         logs = {}
         if isinstance(out, tuple):
             losses, metrics = out
-            logs["loss"] = losses[0]
+            if losses:
+                logs["loss"] = losses[0]
             for m, r in zip(self._metrics, metrics):
                 name = m.name()
                 logs[name[0] if isinstance(name, list) else name] = r
-        elif isinstance(out, list):
+        elif isinstance(out, list) and out:
             logs["loss"] = out[0]
         return logs
 

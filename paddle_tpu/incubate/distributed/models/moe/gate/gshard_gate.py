@@ -31,11 +31,11 @@ class GShardGate(NaiveGate):
         def route(s):
             probs = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
             top_val, top_idx = jax.lax.top_k(probs, 2)
-            # aux loss: fraction of tokens per expert × mean gate prob
+            # aux loss (GShard): mean(fraction-per-expert × mean-prob) × E²
             ce = jnp.mean(
                 jax.nn.one_hot(top_idx[..., 0], self.tot_expert), axis=0)
             me = jnp.mean(probs, axis=0)
-            aux = jnp.sum(ce * me) * (self.tot_expert ** 2)
+            aux = jnp.mean(ce * me) * (self.tot_expert ** 2)
             if key is not None:
                 # randomly drop the 2nd expert when its weight is small
                 # (reference: topk_val[1] < rand * topk_val[0] → mask)
@@ -46,9 +46,8 @@ class GShardGate(NaiveGate):
                      jnp.where(keep2, top_idx[..., 1], -1)], axis=-1)
             return top_val, top_idx, aux
 
-        val = apply_op(lambda s: route(s)[0], gate_score, op_name="gshard_v")
-        det = gate_score.detach()
-        idx = apply_op(lambda s: route(s)[1], det, op_name="gshard_i")
-        aux = apply_op(lambda s: route(s)[2], gate_score, op_name="gshard_aux")
+        # ONE recorded op: (val, idx, aux); the int idx output takes the
+        # float0 cotangent path, val/aux carry gradient to the gate weights
+        val, idx, aux = apply_op(route, gate_score, op_name="gshard_route")
         self.set_loss(aux)
         return val, idx

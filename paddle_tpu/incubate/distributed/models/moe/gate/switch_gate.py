@@ -42,9 +42,6 @@ class SwitchGate(NaiveGate):
             aux = jnp.sum(ce * me) * self.tot_expert
             return top_val, top_idx, aux
 
-        val = apply_op(lambda s: route(s)[0], score, op_name="switch_v")
-        idx = apply_op(lambda s: route(s)[1], score.detach(),
-                       op_name="switch_i")
-        aux = apply_op(lambda s: route(s)[2], score, op_name="switch_aux")
+        val, idx, aux = apply_op(route, score, op_name="switch_route")
         self.set_loss(aux)
         return val, idx

@@ -1,4 +1,8 @@
-"""paddle.incubate.nn (fused layers land with the Pallas kernel milestone)."""
+"""paddle.incubate.nn parity (reference: python/paddle/incubate/nn/)."""
 from . import functional
+from .layer.fused_transformer import (FusedBiasDropoutResidualLayerNorm,
+                                      FusedMultiTransformer)
+from .memory_efficient_attention import memory_efficient_attention
 
-__all__ = ["functional"]
+__all__ = ["functional", "FusedMultiTransformer",
+           "FusedBiasDropoutResidualLayerNorm", "memory_efficient_attention"]

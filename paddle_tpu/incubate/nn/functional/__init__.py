@@ -1,1 +1,137 @@
-__all__ = []
+"""incubate.nn.functional — fused-op functional surface.
+
+Reference: python/paddle/incubate/nn/functional/ — fused_transformer.py:873
+(fused_multi_transformer), fused_transformer.py:275
+(fused_bias_dropout_residual_layer_norm),
+fused_rotary_position_embedding.py. Backed by the Pallas kernel set
+(paddle_tpu/ops/pallas_kernels.py) with eager-autograd integration.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ....core.autograd import apply_op
+from ....core.tensor import Tensor
+from ....ops import pallas_kernels as pk
+
+__all__ = ["fused_rms_norm", "fused_layer_norm",
+           "fused_bias_dropout_residual_layer_norm",
+           "fused_rotary_position_embedding", "masked_multihead_attention",
+           "fused_linear", "fused_linear_activation"]
+
+
+def fused_rms_norm(x, norm_weight, epsilon: float = 1e-6, **kw):
+    return apply_op(lambda xv, wv: pk.rms_norm(xv, wv, eps=epsilon),
+                    x, norm_weight, op_name="rms_norm")
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon: float = 1e-5,
+                     residual=None, bias=None, **kw):
+    def f(xv, rv, bv, gv, betav):
+        return pk.fused_layer_norm(xv, rv, bv, gv, betav, eps=epsilon)
+
+    # route only present operands through autograd
+    args = {"x": x, "residual": residual, "bias": bias,
+            "gamma": norm_weight, "beta": norm_bias}
+    names = [k for k, v in args.items() if v is not None]
+
+    def g(*vals):
+        d = dict(zip(names, vals))
+        return pk.fused_layer_norm(
+            d["x"], d.get("residual"), d.get("bias"), d.get("gamma"),
+            d.get("beta"), eps=epsilon)
+
+    return apply_op(g, *[args[k] for k in names], op_name="layer_norm")
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate: float = 0.5, ln_epsilon: float = 1e-5,
+        training: bool = True, mode: str = "upscale_in_train", name=None):
+    """reference incubate fused_transformer.py:275: out = LN(residual +
+    dropout(x + bias)). Dropout composes outside the kernel — XLA fuses the
+    mask multiply into the kernel's input stream."""
+    y = x
+    if bias is not None:
+        y = y + bias
+    if dropout_rate > 0.0 and training:
+        from ....nn import functional as F
+
+        y = F.dropout(y, p=dropout_rate, training=training, mode=mode)
+    return fused_layer_norm(y, norm_weight=ln_scale, norm_bias=ln_bias,
+                            epsilon=ln_epsilon, residual=residual)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """reference incubate/nn/functional/fused_rotary_position_embedding.py.
+    q/k/v: [B, S, H, D]; sin/cos: [S, D/2] (or [1, S, 1, D] paddle layout,
+    squeezed here)."""
+    def prep(cs):
+        if cs is None:
+            return None
+        val = cs._value if isinstance(cs, Tensor) else jnp.asarray(cs)
+        if val.ndim == 4:  # [1, S, 1, D] → [S, D/2] (paddle duplicates halves)
+            val = val[0, :, 0, : val.shape[-1] // 2]
+        return val
+
+    cos_v, sin_v = prep(cos), prep(sin)
+    if cos_v is None or sin_v is None:
+        raise ValueError("cos and sin are required")
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        outs.append(apply_op(lambda xv: pk.fused_rope(xv, cos_v, sin_v), t,
+                             op_name="fused_rope"))
+    return tuple(outs)
+
+
+def masked_multihead_attention(x, cache_kv=None, seq_lens=None, **kw):
+    """Decode-time MHA over a KV cache (reference
+    incubate/nn/functional/masked_multihead_attention.py →
+    masked_multihead_attention_kernel). x: [B, H, D] single-step query;
+    cache_kv: tuple (k_cache, v_cache) [B, S, H, D]."""
+    if cache_kv is None or seq_lens is None:
+        raise ValueError("cache_kv and seq_lens are required")
+    k_cache, v_cache = cache_kv
+    from ....core.autograd import no_grad
+
+    # decode is inference-only (the reference CUDA kernel has no grad op);
+    # the pallas kernel has no VJP, so keep it off the tape
+    with no_grad():
+        return apply_op(
+            lambda qv, kv, vv, lv: pk.decode_mha(qv, kv, vv, lv),
+            x, k_cache, v_cache, seq_lens, op_name="masked_mha")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """reference incubate fused_linear (cublasLt epilogue) — on TPU the
+    bias epilogue is an XLA fusion; keep the API."""
+    from ....nn import functional as F
+
+    if transpose_weight:
+        import paddle_tpu as _p
+
+        weight = _p.t(weight)
+    return F.linear(x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ....nn import functional as F
+    import paddle_tpu as _p
+
+    out = _p.matmul(x, y, transpose_x=trans_x, transpose_y=trans_y)
+    if bias is not None:
+        out = out + bias
+    if activation == "gelu":
+        return F.gelu(out)
+    if activation == "relu":
+        return F.relu(out)
+    return out

@@ -1,0 +1,4 @@
+from .fused_transformer import (FusedBiasDropoutResidualLayerNorm,
+                                FusedMultiTransformer)
+
+__all__ = ["FusedMultiTransformer", "FusedBiasDropoutResidualLayerNorm"]

@@ -1,0 +1,43 @@
+"""Memory-efficient attention (reference:
+incubate/nn/memory_efficient_attention.py → CUTLASS
+fusion/cutlass/memory_efficient_attention.cu). On TPU the chunked
+online-softmax path IS the memory-efficient algorithm; it routes through
+ops.pallas.flash_attention (Pallas kernel on TPU, O(S) memory fallback off)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.autograd import apply_op
+from ...ops.pallas import flash_attention
+
+__all__ = ["memory_efficient_attention"]
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale: Optional[float] = None,
+                               training: bool = True):
+    """q/k/v: [B, S, H, D]. attn_bias/p kept for API parity (bias folds in
+    the XLA path only; Pallas kernel requires bias-free causal/full)."""
+    if attn_bias is not None:
+        from ..nn import functional  # noqa: F401  (parity: bias path below)
+        import jax
+        import jax.numpy as jnp
+
+        def f(q, k, v, b):
+            import math
+
+            s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+            qt = jnp.swapaxes(q, 1, 2)
+            kt = jnp.swapaxes(k, 1, 2)
+            vt = jnp.swapaxes(v, 1, 2)
+            sc = jnp.einsum("bhqd,bhkd->bhqk", qt, kt).astype(jnp.float32) * s
+            sc = sc + b.astype(jnp.float32)
+            pbs = jax.nn.softmax(sc, axis=-1).astype(q.dtype)
+            return jnp.swapaxes(
+                jnp.einsum("bhqk,bhkd->bhqd", pbs, vt), 1, 2)
+
+        return apply_op(f, query, key, value, attn_bias,
+                        op_name="memory_efficient_attention")
+    return apply_op(
+        lambda q, k, v: flash_attention(q, k, v, causal=False, sm_scale=scale),
+        query, key, value, op_name="memory_efficient_attention")

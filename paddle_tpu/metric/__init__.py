@@ -213,6 +213,9 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     lab = _to_np(label)
     idx = np.argsort(-pred, axis=-1)[..., :k]
     if lab.ndim == pred.ndim:
-        lab = np.argmax(lab, axis=-1)
+        if lab.shape[-1] == pred.shape[-1] and lab.shape[-1] > 1:
+            lab = np.argmax(lab, axis=-1)  # one-hot
+        else:
+            lab = lab[..., 0]              # paddle [N,1] index convention
     corr = np.any(idx == lab[..., None], axis=-1)
     return Tensor(np.asarray(corr.mean(), np.float32))

@@ -166,6 +166,21 @@ class RMSNorm(Layer):
 
     def forward(self, x):
         from ...core.autograd import apply_op
+        from ...framework.flags import get_flags
+
+        from ...ops.pallas import _on_tpu
+
+        # pallas only on real TPU here: off-TPU the model path must stay
+        # plain XLA so multi-device (GSPMD) dryruns don't trace interpret-
+        # mode pallas_call inside pjit. The kernel itself is still covered
+        # off-TPU through the incubate functional surface (interpret mode).
+        if (_on_tpu()
+                and get_flags("FLAGS_use_pallas_kernels")["FLAGS_use_pallas_kernels"]):
+            from ...ops import pallas_kernels as pk
+
+            return apply_op(
+                lambda v, w: pk.rms_norm(v, w, eps=self._epsilon),
+                x, self.weight, op_name="rms_norm")
         import jax
 
         def f(v, w):

@@ -159,9 +159,25 @@ class Optimizer:
         raise NotImplementedError
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.program import default_main_program, in_static_mode
+
+        if in_static_mode():
+            # static path: mark the program for training; the Executor
+            # composes jax.grad + _static_update into the jitted step
+            # (≙ append_backward + optimizer ops appended to the ProgramDesc)
+            default_main_program().train_config = (self, id(loss))
+            return None, None
         loss.backward()
         self.step()
         return None, None
+
+    # -- static-graph functional update (used by static.Executor) ----------
+    def _static_update(self, params, grads, opt_state):
+        """(params, grads, opt_state) → (new_params, opt_state). Default:
+        plain SGD with this optimizer's lr; stateful subclasses override."""
+        from .functional import sgd_update
+
+        return sgd_update(grads, params, lr=self.get_lr()), opt_state
 
     def clear_grad(self, set_to_zero: bool = False):
         if self._parameter_list:
@@ -278,6 +294,10 @@ class Adam(Optimizer):
     def _beta(self, b):
         return float(b) if not isinstance(b, Tensor) else float(b)
 
+    def _static_update(self, params, grads, opt_state):
+        return _adam_static_update(self, params, grads, opt_state,
+                                   weight_decay=0.0)
+
     def _update_param(self, p, g, lr):
         b1, b2 = self._beta(self._beta1), self._beta(self._beta2)
         m = self._acc("moment1", p)
@@ -300,6 +320,18 @@ class Adam(Optimizer):
         return new_p.astype(p.value.dtype)
 
 
+def _adam_static_update(opt, params, grads, opt_state, weight_decay=0.0):
+    from .functional import adamw_init, adamw_update
+
+    if opt_state is None:
+        opt_state = adamw_init(params)
+    new_state, new_params = adamw_update(
+        grads, opt_state, params, lr=opt.get_lr(), beta1=opt._beta(opt._beta1),
+        beta2=opt._beta(opt._beta2), epsilon=opt._epsilon,
+        weight_decay=weight_decay)
+    return new_params, new_state
+
+
 class AdamW(Adam):
     """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
 
@@ -316,6 +348,10 @@ class AdamW(Adam):
 
     def _decoupled_wd(self):
         return True
+
+    def _static_update(self, params, grads, opt_state):
+        return _adam_static_update(self, params, grads, opt_state,
+                                   weight_decay=self._wd_coeff)
 
     def _update_param(self, p, g, lr):
         if self._lr_ratio is not None:

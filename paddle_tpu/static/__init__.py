@@ -1,0 +1,196 @@
+"""paddle.static parity surface (reference: python/paddle/static/).
+
+Static mode here is record-then-jit: ops recorded at the apply_op choke
+point (record.py), composed and compiled by Executor (executor.py). See
+program.py for the design note.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .executor import Executor
+from .program import (Program, Scope, default_main_program,
+                      default_startup_program, disable_static, enable_static,
+                      global_scope, in_static_mode, program_guard,
+                      static_state)
+from .record import make_symbolic
+
+__all__ = ["data", "Executor", "Program", "program_guard",
+           "default_main_program", "default_startup_program", "scope_guard",
+           "global_scope", "enable_static", "disable_static",
+           "in_static_mode", "append_backward", "gradients", "InputSpec",
+           "name_scope", "save", "load", "save_inference_model",
+           "load_inference_model", "cpu_places", "cuda_places", "nn"]
+
+
+class InputSpec:
+    """reference paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level: int = 0) -> Tensor:
+    """Declare a feed placeholder (reference paddle.static.data). Dynamic
+    dims (None/-1) compile as size 1 unless the first feed fixes them — XLA
+    needs static shapes, so the executor re-jits per concrete feed shape."""
+    prog = default_main_program()
+    dt = dtypes.convert_dtype(dtype)
+    aval_shape = tuple(1 if (s is None or s == -1) else int(s) for s in shape)
+    t = make_symbolic(jax.ShapeDtypeStruct(aval_shape, dt), name=name,
+                      stop_gradient=True)
+    prog.feed_vars[name] = id(t)
+    prog.add_var(id(t), name, t._value)
+    return t
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference fluid/backward.py:1885 — returns [(param, grad_var)];
+    grad values materialize when the grad var is fetched (computed via
+    jax.grad in the composed step)."""
+    prog = default_main_program()
+    if not hasattr(prog, "grad_vars"):
+        prog.grad_vars = {}
+    out = []
+    params = parameter_list or list(prog.param_objs.values())
+    for p in params:
+        name = getattr(p, "name", None)
+        if name is None or name not in prog.param_vars:
+            continue
+        aval = jax.ShapeDtypeStruct(tuple(int(s) for s in p.shape), p.dtype)
+        g = make_symbolic(aval, name=f"{name}@GRAD")
+        prog.add_var(id(g), g.name, aval)
+        prog.grad_vars[id(g)] = name
+        out.append((p, g))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference paddle.static.gradients — grads of (summed) targets wrt
+    feed inputs are not tracked per-var here; parameter grads via
+    append_backward cover the training use."""
+    raise NotImplementedError(
+        "use append_backward for parameter gradients; input-gradients in "
+        "static mode land with the autodiff milestone")
+
+
+class scope_guard:
+    """Route Executor state through `scope` for the duration of the block
+    (reference paddle.static.scope_guard)."""
+
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def __enter__(self):
+        from .program import _push_scope
+
+        _push_scope(self.scope)
+        return self
+
+    def __exit__(self, *exc):
+        from .program import _pop_scope
+
+        _pop_scope()
+        return False
+
+
+def name_scope(prefix: str):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ns():
+        yield
+
+    return _ns()
+
+
+def save(program: Program, model_path: str, protocol: int = 4):
+    """Persist program params (reference paddle.static.save → .pdparams)."""
+    from ..framework import io as fio
+
+    sd = {name: Tensor(global_scope().var(name)
+                       if global_scope().var(name) is not None else p._value)
+          for name, p in program.param_objs.items()
+          if not name.startswith("__const_")}
+    fio.save(sd, model_path + ".pdparams")
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    from ..framework import io as fio
+
+    sd = fio.load(model_path + ".pdparams")
+    for name, val in sd.items():
+        if name in program.param_objs:
+            v = val._value if isinstance(val, Tensor) else val
+            global_scope().set(name, v)
+            program.param_objs[name]._value = v
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kw):
+    program = program or default_main_program()
+    save(program, path_prefix)
+
+
+def load_inference_model(path_prefix, executor, **kw):
+    raise NotImplementedError(
+        "serving path: use paddle_tpu.jit.save/load (AOT-compiled artifact)")
+
+
+def cpu_places(device_count=None):
+    import jax as _j
+
+    return list(range(device_count or len(_j.devices("cpu"))))
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+class _StaticNN:
+    """paddle.static.nn facade — layers over the record mechanism."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+           activation=None, name=None):
+        from ..nn.layer.common import Linear
+
+        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = Linear(in_dim, size)
+        out = layer(x)
+        if activation == "relu":
+            from ..nn import functional as F
+
+            out = F.relu(out)
+        elif activation == "tanh":
+            import paddle_tpu as _p
+
+            out = _p.tanh(out)
+        return out
+
+    @staticmethod
+    def batch_norm(input, **kw):
+        from ..nn.layer.norm import BatchNorm1D
+
+        return BatchNorm1D(int(input.shape[-1]))(input)
+
+
+nn = _StaticNN()

@@ -1,0 +1,158 @@
+"""Static Executor — compose the recorded Program into ONE jitted function.
+
+Reference: python/paddle/fluid/executor.py:895 (Executor, run:1277) →
+StandaloneExecutor/ProgramInterpreter (SURVEY.md §3.5). Here composition +
+``jax.jit`` replaces BuildOpFuncList + instruction scheduling: XLA performs
+the dependency analysis, fusion, and stream assignment the interpreter
+hand-rolls. The jitted step is cached per (program, feeds, fetch) signature;
+training programs (minimize()) also return updated params/opt-state, which
+the executor writes back to the scope — the state round-trip of
+Scope/Variable."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .program import Program, default_main_program, global_scope
+
+__all__ = ["Executor"]
+
+
+def _walk(prog: Program, env: Dict[int, Any]):
+    for node in prog.nodes:
+        flat = []
+        for kind, v in node.in_ids:
+            flat.append(env[v] if kind == "var" else v)
+        out = node.fn(*flat)
+        leaves = jax.tree.leaves(out)
+        for vid, val in zip(node.out_ids, leaves):
+            env[vid] = val
+    return env
+
+
+class Executor:
+    """reference executor.py:895."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, Any] = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, scope=None, return_numpy: bool = True):
+        prog = program if program is not None else default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        # startup program (or any program with no nodes): initialize scope
+        # params from their eager initial values
+        if not prog.nodes:
+            for name, p in prog.param_objs.items():
+                scope.set(name, p._value)
+            return []
+
+        # ensure params present in scope
+        for name, p in prog.param_objs.items():
+            if scope.var(name) is None:
+                scope.set(name, p._value)
+
+        fetch_ids = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                fetch_ids.append(id(f))
+            else:
+                raise TypeError("fetch_list entries must be program outputs")
+
+        feed_names = tuple(sorted(feed))
+        key = (prog.id, len(prog.nodes), tuple(fetch_ids), feed_names,
+               prog.train_config is not None)
+        step = self._cache.get(key)
+        if step is None:
+            step = self._build(prog, fetch_ids, feed_names)
+            self._cache[key] = step
+
+        param_names = tuple(sorted(prog.param_vars))
+        params = {n: scope.var(n) for n in param_names}
+        feeds = {n: jnp.asarray(np.asarray(
+            feed[n]._value if isinstance(feed[n], Tensor) else feed[n]))
+            for n in feed_names}
+        opt_state = scope.var(f"__opt_state_{prog.id}")
+
+        if prog.train_config is not None:
+            fetches, new_params, opt_state = step(feeds, params, opt_state)
+            for n, v in new_params.items():
+                scope.set(n, v)
+                prog.param_objs[n]._value = v  # keep eager view in sync
+            scope.set(f"__opt_state_{prog.id}", opt_state)
+        else:
+            fetches = step(feeds, params)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    # -- composition --------------------------------------------------------
+    def _build(self, prog: Program, fetch_ids, feed_names):
+        param_names = tuple(sorted(prog.param_vars))
+        grad_vars = getattr(prog, "grad_vars", {})  # vid → param name
+
+        def base_env(feeds, params):
+            env: Dict[int, Any] = {}
+            for n in feed_names:
+                env[prog.feed_vars[n]] = feeds[n]
+            for n in param_names:
+                env[prog.param_vars[n]] = params[n]
+            return env
+
+        def forward(feeds, params):
+            return _walk(prog, base_env(feeds, params))
+
+        if prog.train_config is None and not any(
+                fid in grad_vars for fid in fetch_ids):
+
+            @jax.jit
+            def infer_step(feeds, params):
+                env = forward(feeds, params)
+                return [env[fid] for fid in fetch_ids]
+
+            return infer_step
+
+        # training / gradient path
+        tc = prog.train_config
+        loss_id = tc[1] if tc else next(
+            fid for fid in fetch_ids if fid not in grad_vars)
+
+        def loss_of(params, feeds):
+            env = forward(feeds, params)
+            l = env[loss_id]
+            return jnp.sum(l), env
+
+        if tc is not None:
+            optimizer = tc[0]
+
+            @jax.jit
+            def train_step(feeds, params, opt_state):
+                (loss, env), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params, feeds)
+                new_params, opt_state = optimizer._static_update(
+                    params, grads, opt_state)
+                fetches = [env.get(fid) if fid not in grad_vars
+                           else grads[grad_vars[fid]] for fid in fetch_ids]
+                return fetches, new_params, opt_state
+
+            return train_step
+
+        @jax.jit
+        def grad_step(feeds, params):
+            (loss, env), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, feeds)
+            return [env.get(fid) if fid not in grad_vars
+                    else grads[grad_vars[fid]] for fid in fetch_ids]
+
+        return grad_step

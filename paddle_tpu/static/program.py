@@ -1,0 +1,200 @@
+"""Static graph core: Program / record-mode tracing.
+
+Reference: python/paddle/fluid/framework.py (Program:5206, Block:3540,
+Variable:1238) + ProgramDesc/StandaloneExecutor (SURVEY.md §3.5).
+
+TPU-native redesign: a Program is NOT an op-desc protobuf — it is a recorded
+op list captured at the apply_op choke point while ``enable_static()`` is
+on. ``Executor.run`` composes the recorded ops into one pure function of
+(feeds, state) and ``jax.jit``s it — compilation IS the executor
+(BuildOpFuncList/StreamAnalyzer ≙ XLA). Parameters encountered during
+recording become state vars updated in the scope across runs, which gives
+static training (append_backward/minimize) the reference semantics.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Program", "StaticNode", "static_state", "in_static_mode",
+           "default_main_program", "default_startup_program",
+           "program_guard", "enable_static", "disable_static", "Scope",
+           "global_scope"]
+
+
+class Scope:
+    """Name → concrete value store (reference framework/scope.h)."""
+
+    def __init__(self):
+        self.vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        return self.vars.get(name)
+
+    def set(self, name, value):
+        self.vars[name] = value
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_SCOPE_STACK: List[Scope] = [Scope()]
+
+
+def global_scope() -> Scope:
+    """The ACTIVE scope (top of the scope_guard stack)."""
+    return _SCOPE_STACK[-1]
+
+
+def _push_scope(scope: Scope):
+    _SCOPE_STACK.append(scope)
+
+
+def _pop_scope():
+    if len(_SCOPE_STACK) > 1:
+        _SCOPE_STACK.pop()
+
+
+class StaticNode:
+    __slots__ = ("fn", "in_ids", "const_args", "out_ids", "name")
+
+    def __init__(self, fn, in_ids, const_args, out_ids, name):
+        self.fn = fn
+        self.in_ids = in_ids        # var-id per tensor input position
+        self.const_args = const_args  # flat raw leaves with None at tensor slots
+        self.out_ids = out_ids
+        self.name = name
+
+
+class Program:
+    """reference Program:5206 — records ops; run via Executor."""
+
+    _counter = [0]
+
+    def __init__(self):
+        Program._counter[0] += 1
+        self.id = Program._counter[0]
+        self.nodes: List[StaticNode] = []
+        self.var_meta: Dict[int, Tuple[str, Any]] = {}   # id → (name, aval)
+        self.feed_vars: Dict[str, int] = {}              # data() name → id
+        self.param_vars: Dict[str, int] = {}             # param name → id
+        self.param_objs: Dict[str, Any] = {}
+        self.train_config = None  # (optimizer, loss_var_id, grad_map)
+        self._var_names: Dict[int, str] = {}
+        self.random_seed = None
+
+    # -- recording helpers (called from apply_op) ---------------------------
+    def add_var(self, vid: int, name: str, aval):
+        self.var_meta[vid] = (name, aval)
+
+    def add_node(self, node: StaticNode):
+        self.nodes.append(node)
+
+    def register_param(self, param):
+        name = param.name
+        vid = id(param)
+        if name not in self.param_vars:
+            self.param_vars[name] = vid
+            self.param_objs[name] = param
+            self.add_var(vid, name, jax.ShapeDtypeStruct(
+                tuple(int(s) for s in param.shape), param.dtype))
+        return self.param_vars[name]
+
+    def list_vars(self):
+        return list(self.var_meta.values())
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program.__new__(Program)
+        p.id = self.id
+        p.nodes = list(self.nodes)
+        p.var_meta = dict(self.var_meta)
+        p.feed_vars = dict(self.feed_vars)
+        p.param_vars = dict(self.param_vars)
+        p.param_objs = dict(self.param_objs)
+        p.train_config = None if for_test else self.train_config
+        p._var_names = dict(self._var_names)
+        p.random_seed = self.random_seed
+        return p
+
+    def __repr__(self):
+        return (f"Program(id={self.id}, ops={len(self.nodes)}, "
+                f"feeds={list(self.feed_vars)}, params={list(self.param_vars)})")
+
+    global_block = lambda self: _BlockView(self)
+
+
+class _BlockView:
+    """Minimal Block facade (reference Block:3540) over a Program."""
+
+    def __init__(self, program):
+        self.program = program
+
+    @property
+    def ops(self):
+        return self.program.nodes
+
+    def var(self, name):
+        for vid, (n, aval) in self.program.var_meta.items():
+            if n == name:
+                return aval
+        raise KeyError(name)
+
+
+class _StaticState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.main_program: Optional[Program] = None
+        self.startup_program: Optional[Program] = None
+
+
+static_state = _StaticState()
+
+
+def in_static_mode() -> bool:
+    return static_state.enabled
+
+
+def enable_static():
+    static_state.enabled = True
+    if static_state.main_program is None:
+        static_state.main_program = Program()
+        static_state.startup_program = Program()
+
+
+def disable_static():
+    static_state.enabled = False
+
+
+def default_main_program() -> Program:
+    if static_state.main_program is None:
+        static_state.main_program = Program()
+        static_state.startup_program = Program()
+    return static_state.main_program
+
+
+def default_startup_program() -> Program:
+    if static_state.startup_program is None:
+        static_state.startup_program = Program()
+    return static_state.startup_program
+
+
+class program_guard:
+    """reference fluid/framework.py:7228."""
+
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        self._prev = (static_state.main_program, static_state.startup_program)
+        static_state.main_program = self.main
+        static_state.startup_program = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        static_state.main_program, static_state.startup_program = self._prev
+        return False

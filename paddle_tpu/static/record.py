@@ -1,0 +1,86 @@
+"""Record-mode op capture — the static-graph analog of OpDesc appending.
+
+When ``enable_static()`` is on, every op that reaches the apply_op choke
+point lands here instead of executing: output avals come from
+``jax.eval_shape`` (the InferShape/InferMeta analog, phi/infermeta/), and a
+StaticNode is appended to the default main Program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from ..core.tensor import Tensor
+from .program import StaticNode, default_main_program
+
+__all__ = ["record_op", "make_symbolic", "is_symbolic"]
+
+
+def is_symbolic(t) -> bool:
+    return isinstance(t, Tensor) and isinstance(
+        t._value, jax.ShapeDtypeStruct)
+
+
+def make_symbolic(aval: jax.ShapeDtypeStruct, name=None,
+                  stop_gradient=True) -> Tensor:
+    t = Tensor(aval, stop_gradient=stop_gradient, name=name)
+    return t
+
+
+def _aval_of(value):
+    if isinstance(value, jax.ShapeDtypeStruct):
+        return value
+    return jax.ShapeDtypeStruct(jnp.shape(value), jnp.result_type(value))
+
+
+def record_op(fn, args, kwargs, op_name):
+    from ..nn.parameter import Parameter
+
+    prog = default_main_program()
+    leaves, treedef = tree_flatten((args, kwargs),
+                                   is_leaf=lambda x: isinstance(x, Tensor))
+    in_slots = []       # ("var", vid) | ("const", value)
+    in_avals = []
+    for l in leaves:
+        if isinstance(l, Parameter):
+            vid = prog.register_param(l)
+            in_slots.append(("var", vid))
+            in_avals.append(_aval_of(l._value))
+        elif isinstance(l, Tensor):
+            vid = id(l)
+            if vid not in prog.var_meta:
+                # concrete non-param tensor first seen: captured constant,
+                # but register so later writes could address it
+                prog.add_var(vid, l.name or f"tmp_{vid}", _aval_of(l._value))
+                if not isinstance(l._value, jax.ShapeDtypeStruct):
+                    prog.param_objs.setdefault(f"__const_{vid}", l)
+            in_slots.append(("var", vid) if isinstance(
+                l._value, jax.ShapeDtypeStruct) else ("const", l._value))
+            in_avals.append(_aval_of(l._value))
+        else:
+            in_slots.append(("const", l))
+            in_avals.append(l)
+
+    def abstract(*avals):
+        buf = list(avals)
+        a, k = tree_unflatten(treedef, buf)
+        return fn(*a, **k)
+
+    out_avals = jax.eval_shape(abstract, *in_avals)
+    out_leaves, out_treedef = tree_flatten(out_avals)
+    outs = []
+    out_ids = []
+    for i, av in enumerate(out_leaves):
+        t = make_symbolic(av, name=f"{op_name or 'op'}_{len(prog.nodes)}_{i}")
+        prog.add_var(id(t), t.name, av)
+        out_ids.append(id(t))
+        outs.append(t)
+
+    prog.add_node(StaticNode(
+        fn=lambda *flat, _treedef=treedef, _fn=fn: _fn(
+            *tree_unflatten(_treedef, list(flat))[0],
+            **tree_unflatten(_treedef, list(flat))[1]),
+        in_ids=in_slots, const_args=None, out_ids=out_ids,
+        name=op_name or getattr(fn, "__name__", "op")))
+    return tree_unflatten(out_treedef, outs)

@@ -67,6 +67,20 @@ class TestMetrics:
         assert abs(p.accumulate() - 2 / 3) < 1e-6
         assert abs(r.accumulate() - 2 / 3) < 1e-6
 
+    def test_functional_accuracy_index_labels(self):
+        from paddle_tpu.metric import accuracy
+
+        pred = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+        label = np.array([[1], [0]], np.int64)  # [N,1] index convention
+        assert float(accuracy(pred, label)) == 1.0
+
+    def test_evaluate_without_loss(self):
+        net = nn.Sequential(nn.Linear(8, 4))
+        m = Model(net)
+        m.prepare(metrics=Accuracy())
+        logs = m.evaluate(ToyDataset(n=8), batch_size=8, verbose=0)
+        assert "acc" in logs and "loss" not in logs
+
     def test_auc(self):
         auc = Auc()
         preds = np.array([[0.2, 0.8], [0.7, 0.3], [0.4, 0.6], [0.9, 0.1]],

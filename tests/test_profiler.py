@@ -1,0 +1,112 @@
+"""Profiler tests (reference analog: test/legacy_test/test_profiler.py,
+test_newprofiler.py): scheduler state machine, span capture via RecordEvent
+and the op hook, chrome-trace export shape, summary stats."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+from paddle_tpu.profiler import (Profiler, ProfilerState, ProfilerTarget,
+                                 RecordEvent, export_chrome_tracing,
+                                 make_scheduler)
+
+
+class TestScheduler:
+    def test_make_scheduler_cycle(self):
+        s = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        assert s(0) == ProfilerState.CLOSED
+        assert s(1) == ProfilerState.READY
+        assert s(2) == ProfilerState.RECORD
+        assert s(3) == ProfilerState.RECORD_AND_RETURN
+        assert s(4) == ProfilerState.CLOSED  # repeat=1 exhausted
+
+    def test_skip_first(self):
+        s = make_scheduler(closed=0, ready=0, record=1, skip_first=2)
+        assert s(0) == ProfilerState.CLOSED
+        assert s(1) == ProfilerState.CLOSED
+        assert s(2) == ProfilerState.RECORD_AND_RETURN
+
+    def test_tuple_scheduler(self):
+        p = Profiler(scheduler=(1, 3))
+        assert p.scheduler(0) == ProfilerState.CLOSED
+        assert p.scheduler(1) in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN)
+
+
+class TestCapture:
+    def test_record_event_spans(self):
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        p.start()
+        with RecordEvent("my_span"):
+            paddle.matmul(paddle.ones([8, 8]), paddle.ones([8, 8]))
+        p.stop()
+        names = [e[0] for e in p._events]
+        assert "my_span" in names
+        assert any(n == "op::matmul" for n in names)
+
+    def test_hook_removed_after_stop(self):
+        from paddle_tpu.core import op_hooks
+
+        p = Profiler()
+        p.start()
+        p.stop()
+        assert op_hooks.op_span_hook is None
+
+    def test_step_schedule_arms_and_disarms(self):
+        fired = []
+        p = Profiler(scheduler=make_scheduler(closed=1, ready=0, record=1,
+                                              repeat=1),
+                     on_trace_ready=lambda pr: fired.append(True))
+        p.start()               # step 0: CLOSED
+        paddle.tanh(paddle.ones([4]))
+        p.step()                # → step 1: RECORD_AND_RETURN (armed)
+        paddle.tanh(paddle.ones([4]))
+        p.step()                # → step 2: CLOSED (disarm + callback)
+        p.stop()
+        assert fired
+        assert any(e[0] == "op::tanh" for e in p._events)
+
+    def test_closed_state_records_nothing(self):
+        p = Profiler(scheduler=lambda s: ProfilerState.CLOSED)
+        p.start()
+        with RecordEvent("ghost"):
+            pass
+        p.stop()
+        assert not p._events
+
+
+class TestExport:
+    def test_chrome_trace_format(self, tmp_path):
+        p = Profiler()
+        p.start()
+        with RecordEvent("outer"):
+            paddle.exp(paddle.ones([4]))
+        p.stop()
+        path = p.export(str(tmp_path / "trace.json"))
+        data = json.load(open(path))
+        assert "traceEvents" in data
+        ev = data["traceEvents"][0]
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(ev)
+        assert ev["ph"] == "X"
+
+    def test_on_trace_ready_exporter(self, tmp_path):
+        p = Profiler(on_trace_ready=export_chrome_tracing(str(tmp_path)))
+        p.start()
+        with RecordEvent("x"):
+            pass
+        p.stop()
+        files = list(tmp_path.glob("*.paddle_trace.json"))
+        assert files
+
+    def test_summary(self, capsys):
+        p = Profiler()
+        p.start()
+        for _ in range(3):
+            paddle.matmul(paddle.ones([4, 4]), paddle.ones([4, 4]))
+        p.stop()
+        stats = p.summary()
+        assert stats["op::matmul"]["calls"] == 3
+        assert "op::matmul" in capsys.readouterr().out
