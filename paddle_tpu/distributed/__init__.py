@@ -58,4 +58,8 @@ def __getattr__(name):
         from . import sequence_parallel as sp_mod
 
         return getattr(sp_mod, name)
+    if name == "TCPStore":
+        from ..native import TCPStore
+
+        return TCPStore
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

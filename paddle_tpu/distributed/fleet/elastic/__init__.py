@@ -1,0 +1,74 @@
+"""Elastic training manager (reference: fleet/elastic/manager.py:124).
+
+The reference registers nodes in etcd, heartbeats, and relaunches with a
+regenerated rank map when membership changes. TPU-native slot: membership
+rides the native TCPStore (no etcd in image); scale events surface as the
+dedicated exit code the launcher's --elastic_level loop honors, and state
+recovery is the sharded-checkpoint restore (distributed/checkpoint).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+ELASTIC_EXIT_CODE = 101            # manager.py:30
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+__all__ = ["ElasticManager", "ElasticStatus", "ELASTIC_EXIT_CODE"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Heartbeat + membership watch over TCPStore (etcd stand-in)."""
+
+    def __init__(self, args=None, store=None, np: Optional[int] = None,
+                 heartbeat_interval: float = 3.0):
+        self.np = np or int(os.environ.get("PADDLE_ELASTIC_NP", "1") or 1)
+        self.host = os.environ.get("POD_IP", "127.0.0.1")
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.heartbeat_interval = heartbeat_interval
+        self._store = store
+        self._stop = threading.Event()
+        self._thread = None
+        self.enabled = self._store is not None
+        self.need_restart = False
+
+    def register(self):
+        if not self.enabled:
+            return
+        self._store.set(f"elastic/node/{self.rank}", self.host.encode())
+        self._store.add("elastic/alive", 1)
+        self._thread = threading.Thread(target=self._heartbeat, daemon=True)
+        self._thread.start()
+
+    def _heartbeat(self):
+        while not self._stop.is_set():
+            self._store.set(f"elastic/hb/{self.rank}",
+                            str(time.time()).encode())
+            self._stop.wait(self.heartbeat_interval)
+
+    def watch(self) -> str:
+        """One membership check (the reference's watch loop body :120)."""
+        if not self.enabled:
+            return ElasticStatus.COMPLETED
+        if self.need_restart:
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def signal_restart(self):
+        self.need_restart = True
+
+    def exit(self, completed: bool = True):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        return 0 if completed else ELASTIC_EXIT_CODE

@@ -1,0 +1,3 @@
+from .main import launch, main
+
+__all__ = ["launch", "main"]

@@ -26,6 +26,31 @@ __all__ = ["init_parallel_env", "get_rank", "get_world_size", "DataParallel",
            "ParallelEnv"]
 
 _initialized = [False]
+_global_store = [None]
+
+
+def _create_store():
+    """Out-of-band rendezvous store (reference parallel.py:1077 creates
+    core.TCPStore from MASTER_ADDR/PORT before group bring-up). Backed by
+    the native C++ TCPStore; returns None when no master env is set or the
+    native lib is unavailable."""
+    master = os.environ.get("MASTER_ADDR")
+    port = os.environ.get("MASTER_PORT")
+    if not master or not port:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    try:
+        from ..native import TCPStore
+
+        return TCPStore(master, int(port), is_master=(rank == 0),
+                        world_size=world)
+    except (RuntimeError, OSError, ConnectionError):
+        return None
+
+
+def get_store():
+    return _global_store[0]
 
 
 def init_parallel_env(mesh=None, **mesh_degrees):
@@ -36,6 +61,7 @@ def init_parallel_env(mesh=None, **mesh_degrees):
     """
     if _initialized[0]:
         return ParallelEnv()
+    _global_store[0] = _create_store()
     endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
     nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
     if endpoints and nnodes > 1:

@@ -118,7 +118,10 @@ class FusedMultiTransformer(Layer):
             # column-parallel qkv/ffn1, row-parallel out-proj/ffn2)
             set_pspec(qkv_w, P("mp", None))
             set_pspec(qkv_b, P("mp"))
-            set_pspec(lin_w, P("mp", None))
+            # lin_w is applied TRANSPOSED (F.linear(ctx, lin_w.t())): the
+            # contracted dim of the effective weight is lin_w dim 1, so
+            # row-parallel shards dim 1
+            set_pspec(lin_w, P(None, "mp"))
             set_pspec(ff1_w, P(None, "mp"))
             set_pspec(ff1_b, P("mp"))
             set_pspec(ff2_w, P("mp", None))

@@ -1,0 +1,324 @@
+"""Native (C++) runtime components, ctypes-bound.
+
+The reference implements its runtime substrate in C++ (SURVEY.md §2
+``[native]`` rows); this package provides the TPU build's equivalents where
+Python would be the wrong tool:
+
+- BlockingQueue  — bounded MPMC queue (data-pipeline backpressure,
+  ≙ operators/reader/blocking_queue.h)
+- HostTracer     — fixed-record span ring buffer
+  (≙ platform/profiler/host_event_recorder.h)
+- TCPStore       — TCP rendezvous KV server/client
+  (≙ phi/core/distributed/store/tcp_store.cc)
+
+Built on first import with g++ (no pybind11 in this image — plain C ABI via
+ctypes). If the toolchain or build fails, ``AVAILABLE`` is False and pure-
+Python fallbacks in the consumers take over.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["AVAILABLE", "BlockingQueue", "HostTracer", "TCPStore",
+           "TCPStoreServer", "lib_path"]
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB = None
+AVAILABLE = False
+
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cc"))
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    out = os.path.join(_BUILD_DIR, "libpaddle_tpu_native.so")
+    srcs = _sources()
+    stamp = os.path.join(_BUILD_DIR, "stamp")
+    sig = str([(s, os.path.getmtime(s)) for s in srcs])
+    if os.path.exists(out) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read() == sig:
+                return out
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           "-o", out] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return None
+    with open(stamp, "w") as f:
+        f.write(sig)
+    return out
+
+
+def lib_path() -> Optional[str]:
+    return _build()
+
+
+def _load():
+    global _LIB, AVAILABLE
+    if _LIB is not None:
+        return _LIB
+    path = _build()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    # blocking queue
+    lib.bq_create.restype = ctypes.c_void_p
+    lib.bq_create.argtypes = [ctypes.c_uint64]
+    lib.bq_destroy.argtypes = [ctypes.c_void_p]
+    lib.bq_push.restype = ctypes.c_int
+    lib.bq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.bq_pop.restype = ctypes.c_int64
+    lib.bq_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+    lib.bq_peek_size.restype = ctypes.c_int64
+    lib.bq_peek_size.argtypes = [ctypes.c_void_p]
+    lib.bq_close.argtypes = [ctypes.c_void_p]
+    lib.bq_size.restype = ctypes.c_uint64
+    lib.bq_size.argtypes = [ctypes.c_void_p]
+    # host tracer
+    lib.ht_create.restype = ctypes.c_void_p
+    lib.ht_create.argtypes = [ctypes.c_uint64]
+    lib.ht_destroy.argtypes = [ctypes.c_void_p]
+    lib.ht_record.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                              ctypes.c_uint32, ctypes.c_uint64,
+                              ctypes.c_uint64]
+    lib.ht_count.restype = ctypes.c_uint64
+    lib.ht_count.argtypes = [ctypes.c_void_p]
+    lib.ht_drain.restype = ctypes.c_uint64
+    lib.ht_drain.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                             ctypes.c_uint64]
+    lib.ht_dropped.restype = ctypes.c_uint64
+    lib.ht_dropped.argtypes = [ctypes.c_void_p]
+    # tcp store
+    lib.ts_server_start.restype = ctypes.c_void_p
+    lib.ts_server_start.argtypes = [ctypes.c_uint16]
+    lib.ts_port.restype = ctypes.c_uint16
+    lib.ts_port.argtypes = [ctypes.c_void_p]
+    lib.ts_server_stop.argtypes = [ctypes.c_void_p]
+    lib.ts_client_connect.restype = ctypes.c_void_p
+    lib.ts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+    lib.ts_client_close.argtypes = [ctypes.c_void_p]
+    lib.ts_set.restype = ctypes.c_int64
+    lib.ts_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                           ctypes.c_uint64]
+    lib.ts_get.restype = ctypes.c_int64
+    lib.ts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)]
+    lib.ts_add.restype = ctypes.c_int64
+    lib.ts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.ts_wait.restype = ctypes.c_int64
+    lib.ts_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    _LIB = lib
+    AVAILABLE = True
+    return lib
+
+
+class BlockingQueue:
+    """Bounded queue of picklable items over the native blob queue."""
+
+    def __init__(self, capacity: int = 8):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._q = lib.bq_create(capacity)
+        # peek_size + pop must be one unit per consumer: two threads
+        # interleaving them would size the buffer off a DIFFERENT blob
+        self._pop_mu = threading.Lock()
+
+    def push(self, item) -> bool:
+        blob = pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
+        return self._lib.bq_push(self._q, blob, len(blob)) == 0
+
+    def pop(self):
+        with self._pop_mu:
+            size = self._lib.bq_peek_size(self._q)
+            if size < 0:
+                raise EOFError("queue closed")
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.bq_pop(self._q, buf, size)
+        if n < 0:
+            raise EOFError("queue closed")
+        return pickle.loads(buf.raw[:n])
+
+    def close(self):
+        self._lib.bq_close(self._q)
+
+    def __len__(self):
+        return int(self._lib.bq_size(self._q))
+
+    def __del__(self):
+        try:
+            self._lib.bq_destroy(self._q)
+        except Exception:
+            pass
+
+
+class HostTracer:
+    """Interned-name span recorder over the native ring buffer."""
+
+    _RECORD = 24  # u32 name_id + u32 tid + u64 start + u64 end
+
+    def __init__(self, capacity: int = 1_000_000):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._t = lib.ht_create(capacity)
+        self._names = {}
+        self._rev = []
+        self._lock = threading.Lock()
+
+    def _intern(self, name: str) -> int:
+        with self._lock:
+            i = self._names.get(name)
+            if i is None:
+                i = len(self._rev)
+                self._names[name] = i
+                self._rev.append(name)
+            return i
+
+    def record(self, name: str, start_ns: int, end_ns: int, tid: int = 0):
+        self._lib.ht_record(self._t, self._intern(name), tid & 0xFFFFFFFF,
+                            start_ns, end_ns)
+
+    def drain(self):
+        import struct
+
+        n = int(self._lib.ht_count(self._t))
+        if not n:
+            return []
+        buf = ctypes.create_string_buffer(n * self._RECORD)
+        got = int(self._lib.ht_drain(self._t, buf, n))
+        out = []
+        for i in range(got):
+            name_id, tid, s, e = struct.unpack_from("<IIQQ", buf,
+                                                    i * self._RECORD)
+            out.append((self._rev[name_id], s, e, tid))
+        return out
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.ht_dropped(self._t))
+
+    def __del__(self):
+        try:
+            self._lib.ht_destroy(self._t)
+        except Exception:
+            pass
+
+
+class TCPStoreServer:
+    def __init__(self, port: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._s = lib.ts_server_start(port)
+        if not self._s:
+            raise OSError(f"TCPStore bind failed on port {port}")
+        self.port = int(lib.ts_port(self._s))
+
+    def stop(self):
+        if self._s:
+            self._lib.ts_server_stop(self._s)
+            self._s = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class TCPStore:
+    """Client mirroring the reference core.TCPStore API (set/get/add/wait).
+    is_master=True also starts the server in-process (rank-0 pattern,
+    parallel.py:1077)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: int = 900):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._server = None
+        if is_master:
+            self._server = TCPStoreServer(port)
+            port = self._server.port
+        self.host, self.port = host, port
+        # non-master ranks usually race the master's bind: retry within
+        # `timeout` (reference TCPStore connect loop, tcp_utils.cc)
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        delay = 0.05
+        self._c = None
+        while True:
+            self._c = lib.ts_client_connect(host.encode(), port)
+            if self._c:
+                break
+            if is_master or _time.monotonic() >= deadline:
+                raise ConnectionError(
+                    f"TCPStore connect to {host}:{port} failed")
+            _time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+        # one socket per client: serialize requests (a heartbeat thread and
+        # the main thread interleaving writes would corrupt the protocol)
+        self._mu = threading.Lock()
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        with self._mu:
+            rc = self._lib.ts_set(self._c, key.encode(), bytes(value),
+                                  len(value))
+        if rc != 0:
+            raise IOError("TCPStore set failed")
+
+    def get(self, key: str) -> bytes:
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_uint64(0)
+        with self._mu:
+            rc = self._lib.ts_get(self._c, key.encode(), buf, cap,
+                                  ctypes.byref(out_len))
+        if rc != 0:
+            raise KeyError(key)
+        return buf.raw[: out_len.value]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        with self._mu:
+            return int(self._lib.ts_add(self._c, key.encode(), amount))
+
+    def wait(self, key: str) -> None:
+        # NOTE: wait blocks server-side; holding the lock would starve other
+        # threads of this client, so waiters should use their own client.
+        with self._mu:
+            if self._lib.ts_wait(self._c, key.encode()) != 0:
+                raise TimeoutError(f"wait({key}) failed")
+
+    def close(self):
+        if self._c:
+            self._lib.ts_client_close(self._c)
+            self._c = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,100 @@
+// Bounded MPMC blocking queue of byte blobs.
+//
+// TPU-native analog of the reference's C++ data-pipeline queue
+// (paddle/fluid/operators/reader/blocking_queue.h; the DataLoader's
+// multiprocess workers feed shared-memory tensors into it,
+// python/paddle/io/dataloader/dataloader_iter.py:358). The C ABI keeps
+// Python binding at ctypes level — no pybind11 (not in this image).
+//
+// Semantics: push blocks when full, pop blocks when empty; close() wakes
+// all waiters; pop on a closed empty queue returns -1.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Blob {
+  std::vector<uint8_t> data;
+};
+
+struct BlockingQueue {
+  explicit BlockingQueue(size_t cap) : capacity(cap) {}
+  size_t capacity;
+  std::deque<Blob> items;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+  bool closed = false;
+  uint64_t pushed = 0;
+  uint64_t popped = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bq_create(uint64_t capacity) {
+  return new BlockingQueue(capacity ? capacity : 1);
+}
+
+void bq_destroy(void* q) { delete static_cast<BlockingQueue*>(q); }
+
+// 0 on success, -1 if closed.
+int bq_push(void* qp, const uint8_t* data, uint64_t size) {
+  auto* q = static_cast<BlockingQueue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_full.wait(lk, [&] { return q->items.size() < q->capacity || q->closed; });
+  if (q->closed) return -1;
+  Blob b;
+  b.data.assign(data, data + size);
+  q->items.push_back(std::move(b));
+  ++q->pushed;
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// Returns blob size (>=0) with contents copied into out (caller sized it via
+// bq_peek_size), or -1 if closed-and-drained.
+int64_t bq_peek_size(void* qp) {
+  auto* q = static_cast<BlockingQueue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_empty.wait(lk, [&] { return !q->items.empty() || q->closed; });
+  if (q->items.empty()) return -1;
+  return static_cast<int64_t>(q->items.front().data.size());
+}
+
+int64_t bq_pop(void* qp, uint8_t* out, uint64_t out_cap) {
+  auto* q = static_cast<BlockingQueue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_empty.wait(lk, [&] { return !q->items.empty() || q->closed; });
+  if (q->items.empty()) return -1;
+  Blob b = std::move(q->items.front());
+  q->items.pop_front();
+  ++q->popped;
+  q->not_full.notify_one();
+  uint64_t n = b.data.size();
+  if (n > out_cap) n = out_cap;
+  std::memcpy(out, b.data.data(), n);
+  return static_cast<int64_t>(b.data.size());
+}
+
+void bq_close(void* qp) {
+  auto* q = static_cast<BlockingQueue*>(qp);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->closed = true;
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+uint64_t bq_size(void* qp) {
+  auto* q = static_cast<BlockingQueue*>(qp);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+// Lock-light host span recorder.
+//
+// TPU-native analog of the reference HostEventRecorder ring buffer
+// (paddle/fluid/platform/profiler/host_event_recorder.h): per-thread local
+// chunks appended under a short lock, drained once at profiler stop. Span
+// names are interned to uint32 ids on the Python side; records are fixed
+// 24-byte structs so draining is one memcpy.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Span {
+  uint32_t name_id;
+  uint32_t tid;
+  uint64_t start_ns;
+  uint64_t end_ns;
+};
+
+struct Tracer {
+  explicit Tracer(size_t cap) : capacity(cap) { spans.reserve(1024); }
+  size_t capacity;
+  std::vector<Span> spans;
+  std::mutex mu;
+  uint64_t dropped = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ht_create(uint64_t capacity) { return new Tracer(capacity); }
+
+void ht_destroy(void* t) { delete static_cast<Tracer*>(t); }
+
+void ht_record(void* tp, uint32_t name_id, uint32_t tid, uint64_t start_ns,
+               uint64_t end_ns) {
+  auto* t = static_cast<Tracer*>(tp);
+  std::lock_guard<std::mutex> lk(t->mu);
+  if (t->spans.size() >= t->capacity) {
+    ++t->dropped;
+    return;
+  }
+  t->spans.push_back(Span{name_id, tid, start_ns, end_ns});
+}
+
+uint64_t ht_count(void* tp) {
+  auto* t = static_cast<Tracer*>(tp);
+  std::lock_guard<std::mutex> lk(t->mu);
+  return t->spans.size();
+}
+
+// Drain up to max_spans into out (layout: 4+4+8+8 bytes per span, packed).
+uint64_t ht_drain(void* tp, uint8_t* out, uint64_t max_spans) {
+  auto* t = static_cast<Tracer*>(tp);
+  std::lock_guard<std::mutex> lk(t->mu);
+  uint64_t n = t->spans.size() < max_spans ? t->spans.size() : max_spans;
+  std::memcpy(out, t->spans.data(), n * sizeof(Span));
+  t->spans.erase(t->spans.begin(), t->spans.begin() + n);
+  return n;
+}
+
+uint64_t ht_dropped(void* tp) {
+  return static_cast<Tracer*>(tp)->dropped;
+}
+
+}  // extern "C"

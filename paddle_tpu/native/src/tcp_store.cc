@@ -1,0 +1,259 @@
+// TCP key-value store for multi-host rendezvous.
+//
+// TPU-native analog of the reference TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:120, tcp_utils.cc): rank 0
+// runs the server; clients set/get/wait/add keys to bootstrap process
+// groups. On TPU the jax.distributed coordinator normally plays this role —
+// this store covers the reference API surface (core.TCPStore) and any
+// out-of-band bootstrap (elastic manager, launch controller).
+//
+// Protocol (all little-endian):
+//   request:  u8 op | u32 klen | k bytes | u64 arg/vlen | v bytes
+//     op: 0=SET 1=GET 2=ADD 3=WAIT 4=PING
+//   response: i64 status/value | u64 vlen | v bytes
+// GET on a missing key blocks server-side until set (like reference wait).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::thread thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> kv;
+  std::map<std::string, int64_t> counters;
+  std::vector<std::thread> workers;
+  std::mutex fds_mu;
+  std::vector<int> client_fds;  // open connections, shut down on stop
+};
+
+bool read_n(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+void serve_client(Server* s, int fd) {
+  for (;;) {
+    uint8_t op;
+    uint32_t klen;
+    if (!read_n(fd, &op, 1) || !read_n(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_n(fd, key.data(), klen)) break;
+    uint64_t arg;
+    if (!read_n(fd, &arg, 8)) break;
+    std::vector<uint8_t> val(arg && op == 0 ? arg : 0);
+    if (op == 0 && arg && !read_n(fd, val.data(), arg)) break;
+
+    int64_t status = 0;
+    std::vector<uint8_t> out;
+    if (op == 0) {  // SET
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->kv[key] = std::move(val);
+      s->cv.notify_all();
+    } else if (op == 1 || op == 3) {  // GET / WAIT
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->cv.wait(lk, [&] { return s->kv.count(key) || s->stop.load(); });
+      if (s->stop.load() && !s->kv.count(key)) {
+        status = -1;
+      } else if (op == 1) {
+        out = s->kv[key];
+      }
+    } else if (op == 2) {  // ADD (returns new counter value)
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->counters[key] += static_cast<int64_t>(arg);
+      status = s->counters[key];
+    }  // op 4 PING: status 0
+
+    uint64_t vlen = out.size();
+    if (!write_n(fd, &status, 8) || !write_n(fd, &vlen, 8)) break;
+    if (vlen && !write_n(fd, out.data(), vlen)) break;
+  }
+  ::close(fd);
+  std::lock_guard<std::mutex> lk(s->fds_mu);
+  for (auto it = s->client_fds.begin(); it != s->client_fds.end(); ++it) {
+    if (*it == fd) {
+      s->client_fds.erase(it);
+      break;
+    }
+  }
+}
+
+void accept_loop(Server* s) {
+  for (;;) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stop.load()) return;
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    {
+      std::lock_guard<std::mutex> lk(s->fds_mu);
+      s->client_fds.push_back(fd);
+    }
+    s->workers.emplace_back(serve_client, s, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns server handle, or null on bind failure. port=0 picks a free port;
+// ts_port() reports it.
+void* ts_server_start(uint16_t port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->thread = std::thread(accept_loop, s);
+  return s;
+}
+
+uint16_t ts_port(void* sp) {
+  auto* s = static_cast<Server*>(sp);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  return ntohs(addr.sin_port);
+}
+
+void ts_server_stop(void* sp) {
+  auto* s = static_cast<Server*>(sp);
+  s->stop.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  // wake worker threads parked in recv() on live client connections —
+  // without this, join() below deadlocks while any client stays connected
+  {
+    std::lock_guard<std::mutex> lk(s->fds_mu);
+    for (int fd : s->client_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (s->thread.joinable()) s->thread.join();
+  for (auto& w : s->workers)
+    if (w.joinable()) w.join();
+  delete s;
+}
+
+// ---- client ----
+
+void* ts_client_connect(const char* host, uint16_t port) {
+  // hostname OR dotted-quad (MASTER_ADDR is usually a hostname in clusters)
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portstr[8];
+  std::snprintf(portstr, sizeof(portstr), "%u", static_cast<unsigned>(port));
+  if (::getaddrinfo(host, portstr, &hints, &res) != 0 || res == nullptr) {
+    return nullptr;
+  }
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    if (fd >= 0) ::close(fd);
+    ::freeaddrinfo(res);
+    return nullptr;
+  }
+  ::freeaddrinfo(res);
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* h = new int(fd);
+  return h;
+}
+
+void ts_client_close(void* cp) {
+  int* fd = static_cast<int*>(cp);
+  ::close(*fd);
+  delete fd;
+}
+
+static int64_t request(int fd, uint8_t op, const char* key, uint32_t klen,
+                       const uint8_t* val, uint64_t vlen, uint8_t* out,
+                       uint64_t out_cap, uint64_t* out_len) {
+  if (!write_n(fd, &op, 1) || !write_n(fd, &klen, 4)) return -2;
+  if (klen && !write_n(fd, key, klen)) return -2;
+  if (!write_n(fd, &vlen, 8)) return -2;
+  if (op == 0 && vlen && !write_n(fd, val, vlen)) return -2;
+  int64_t status;
+  uint64_t rlen;
+  if (!read_n(fd, &status, 8) || !read_n(fd, &rlen, 8)) return -2;
+  if (out_len) *out_len = rlen;
+  if (rlen) {
+    std::vector<uint8_t> buf(rlen);
+    if (!read_n(fd, buf.data(), rlen)) return -2;
+    uint64_t n = rlen < out_cap ? rlen : out_cap;
+    if (out && n) std::memcpy(out, buf.data(), n);
+  }
+  return status;
+}
+
+int64_t ts_set(void* cp, const char* key, const uint8_t* val, uint64_t vlen) {
+  return request(*static_cast<int*>(cp), 0, key, std::strlen(key), val, vlen,
+                 nullptr, 0, nullptr);
+}
+
+int64_t ts_get(void* cp, const char* key, uint8_t* out, uint64_t out_cap,
+               uint64_t* out_len) {
+  return request(*static_cast<int*>(cp), 1, key, std::strlen(key), nullptr, 0,
+                 out, out_cap, out_len);
+}
+
+int64_t ts_add(void* cp, const char* key, int64_t amount) {
+  return request(*static_cast<int*>(cp), 2, key, std::strlen(key), nullptr,
+                 static_cast<uint64_t>(amount), nullptr, 0, nullptr);
+}
+
+int64_t ts_wait(void* cp, const char* key) {
+  return request(*static_cast<int*>(cp), 3, key, std::strlen(key), nullptr, 0,
+                 nullptr, 0, nullptr);
+}
+
+}  // extern "C"

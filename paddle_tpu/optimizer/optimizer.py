@@ -172,12 +172,14 @@ class Optimizer:
         return None, None
 
     # -- static-graph functional update (used by static.Executor) ----------
-    def _static_update(self, params, grads, opt_state):
-        """(params, grads, opt_state) → (new_params, opt_state). Default:
-        plain SGD with this optimizer's lr; stateful subclasses override."""
+    def _static_update(self, params, grads, opt_state, lr=None):
+        """(params, grads, opt_state, lr) → (new_params, opt_state). `lr`
+        is a traced value supplied per run (schedulers stay live across the
+        cached jit). Default: plain SGD; stateful subclasses override."""
         from .functional import sgd_update
 
-        return sgd_update(grads, params, lr=self.get_lr()), opt_state
+        lr = self.get_lr() if lr is None else lr
+        return sgd_update(grads, params, lr=lr), opt_state
 
     def clear_grad(self, set_to_zero: bool = False):
         if self._parameter_list:
@@ -294,8 +296,8 @@ class Adam(Optimizer):
     def _beta(self, b):
         return float(b) if not isinstance(b, Tensor) else float(b)
 
-    def _static_update(self, params, grads, opt_state):
-        return _adam_static_update(self, params, grads, opt_state,
+    def _static_update(self, params, grads, opt_state, lr=None):
+        return _adam_static_update(self, params, grads, opt_state, lr=lr,
                                    weight_decay=0.0)
 
     def _update_param(self, p, g, lr):
@@ -320,13 +322,15 @@ class Adam(Optimizer):
         return new_p.astype(p.value.dtype)
 
 
-def _adam_static_update(opt, params, grads, opt_state, weight_decay=0.0):
+def _adam_static_update(opt, params, grads, opt_state, lr=None,
+                        weight_decay=0.0):
     from .functional import adamw_init, adamw_update
 
     if opt_state is None:
         opt_state = adamw_init(params)
+    lr = opt.get_lr() if lr is None else lr
     new_state, new_params = adamw_update(
-        grads, opt_state, params, lr=opt.get_lr(), beta1=opt._beta(opt._beta1),
+        grads, opt_state, params, lr=lr, beta1=opt._beta(opt._beta1),
         beta2=opt._beta(opt._beta2), epsilon=opt._epsilon,
         weight_decay=weight_decay)
     return new_params, new_state
@@ -349,8 +353,8 @@ class AdamW(Adam):
     def _decoupled_wd(self):
         return True
 
-    def _static_update(self, params, grads, opt_state):
-        return _adam_static_update(self, params, grads, opt_state,
+    def _static_update(self, params, grads, opt_state, lr=None):
+        return _adam_static_update(self, params, grads, opt_state, lr=lr,
                                    weight_decay=self._wd_coeff)
 
     def _update_param(self, p, g, lr):

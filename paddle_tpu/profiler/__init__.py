@@ -60,19 +60,37 @@ class SummaryView(Enum):
     MemoryView = 6
 
 
-class _HostEventRecorder(threading.local):
-    """Ring buffer of (name, start_ns, end_ns, tid) — the
-    HostEventRecorder analog."""
+class _HostEventRecorder:
+    """Span recorder — native C++ ring buffer (paddle_tpu.native.HostTracer,
+    the host_event_recorder.h equivalent) when available, Python list
+    fallback otherwise."""
 
     def __init__(self, capacity: int = 1_000_000):
         self.events: List[Tuple[str, int, int, int]] = []
         self.capacity = capacity
         self.active = False
+        self._native = None
+        try:
+            from ..native import HostTracer
+
+            self._native = HostTracer(capacity)
+        except Exception:
+            self._native = None
 
     def record(self, name: str, start_ns: int, end_ns: int):
-        if len(self.events) < self.capacity:
+        if self._native is not None:
+            self._native.record(name, start_ns, end_ns,
+                                threading.get_ident())
+        elif len(self.events) < self.capacity:
             self.events.append(
                 (name, start_ns, end_ns, threading.get_ident()))
+
+    def drain(self) -> List[Tuple[str, int, int, int]]:
+        if self._native is not None:
+            out = [(n, s, e, t) for n, s, e, t in self._native.drain()]
+        else:
+            out, self.events = self.events, []
+        return out
 
 
 _recorder = _HostEventRecorder()
@@ -101,12 +119,6 @@ class RecordEvent:
     def __exit__(self, *exc):
         self.end()
         return False
-
-
-def _op_hook(op_name: str, leaves):
-    # installed via amp_state.checker? No — separate op-span hook: this fn is
-    # wired by Profiler into core.autograd via the profiler hook point.
-    pass
 
 
 def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
@@ -196,8 +208,7 @@ class Profiler:
     def _disarm(self):
         _recorder.active = False
         self._remove_op_hook()
-        self._events.extend(_recorder.events)
-        _recorder.events.clear()
+        self._events.extend(_recorder.drain())
         if self._jax_trace_dir is not None:
             import jax
 

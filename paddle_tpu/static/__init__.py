@@ -69,6 +69,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     prog = default_main_program()
     if not hasattr(prog, "grad_vars"):
         prog.grad_vars = {}
+    prog.loss_id = id(loss)  # the scalar the executor differentiates
     out = []
     params = parameter_list or list(prog.param_objs.values())
     for p in params:

@@ -86,7 +86,8 @@ class Executor:
         opt_state = scope.var(f"__opt_state_{prog.id}")
 
         if prog.train_config is not None:
-            fetches, new_params, opt_state = step(feeds, params, opt_state)
+            lr = jnp.asarray(prog.train_config[0].get_lr(), jnp.float32)
+            fetches, new_params, opt_state = step(feeds, params, opt_state, lr)
             for n, v in new_params.items():
                 scope.set(n, v)
                 prog.param_objs[n]._value = v  # keep eager view in sync
@@ -125,8 +126,11 @@ class Executor:
 
         # training / gradient path
         tc = prog.train_config
-        loss_id = tc[1] if tc else next(
-            fid for fid in fetch_ids if fid not in grad_vars)
+        loss_id = tc[1] if tc else getattr(prog, "loss_id", None)
+        if loss_id is None:
+            raise ValueError(
+                "gradient fetch requires append_backward(loss) to have "
+                "marked the loss on this program")
 
         def loss_of(params, feeds):
             env = forward(feeds, params)
@@ -137,11 +141,13 @@ class Executor:
             optimizer = tc[0]
 
             @jax.jit
-            def train_step(feeds, params, opt_state):
+            def train_step(feeds, params, opt_state, lr):
+                # lr enters as a traced ARGUMENT so schedulers/set_lr take
+                # effect without re-tracing the cached step
                 (loss, env), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(params, feeds)
                 new_params, opt_state = optimizer._static_update(
-                    params, grads, opt_state)
+                    params, grads, opt_state, lr=lr)
                 fetches = [env.get(fid) if fid not in grad_vars
                            else grads[grad_vars[fid]] for fid in fetch_ids]
                 return fetches, new_params, opt_state

@@ -1,0 +1,145 @@
+"""Native C++ component tests (blocking queue, host tracer, TCP store) and
+their wiring into profiler/distributed (reference analogs:
+test/cpp/fluid/framework/blocking_queue_test, tcp_store tests)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _need_native():
+    if native.lib_path() is None:
+        pytest.skip("native toolchain unavailable")
+
+
+class TestBlockingQueue:
+    def test_fifo_roundtrip(self):
+        q = native.BlockingQueue(capacity=4)
+        for i in range(3):
+            q.push({"i": i, "x": np.full(4, i)})
+        assert len(q) == 3
+        for i in range(3):
+            item = q.pop()
+            assert item["i"] == i
+            np.testing.assert_array_equal(item["x"], np.full(4, i))
+        q.close()
+
+    def test_backpressure_and_close(self):
+        q = native.BlockingQueue(capacity=1)
+        q.push(1)
+        blocked = []
+
+        def producer():
+            blocked.append("start")
+            q.push(2)  # blocks: queue full
+            blocked.append("done")
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.05)
+        assert blocked == ["start"]
+        assert q.pop() == 1  # frees a slot → producer completes
+        t.join(timeout=5)
+        assert "done" in blocked
+        assert q.pop() == 2
+        q.close()
+        with pytest.raises(EOFError):
+            q.pop()  # closed and drained
+
+    def test_close_drains_remaining(self):
+        q = native.BlockingQueue(capacity=4)
+        q.push("a")
+        q.close()
+        assert q.pop() == "a"
+        with pytest.raises(EOFError):
+            q.pop()
+
+
+class TestHostTracer:
+    def test_record_drain(self):
+        t = native.HostTracer(capacity=100)
+        t.record("matmul", 10, 20)
+        t.record("relu", 20, 25, tid=7)
+        assert t.drain() == [("matmul", 10, 20, 0), ("relu", 20, 25, 7)]
+        assert t.drain() == []
+
+    def test_capacity_drops(self):
+        t = native.HostTracer(capacity=2)
+        for i in range(5):
+            t.record("x", i, i + 1)
+        assert len(t.drain()) == 2
+        assert t.dropped == 3
+
+
+class TestTCPStore:
+    def test_set_get_add_wait(self):
+        master = native.TCPStore(is_master=True)
+        client = native.TCPStore(port=master.port)
+        client.set("k", b"v1")
+        assert master.get("k") == b"v1"
+        assert master.add("ctr", 5) == 5
+        assert client.add("ctr", 2) == 7
+        done = []
+
+        def waiter():
+            client.wait("flag")
+            done.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        assert not done
+        master.set("flag", b"1")
+        t.join(timeout=5)
+        assert done
+        client.close()
+        master.close()
+
+    def test_barrier_pattern(self):
+        """The reference's init_parallel_env barrier (parallel.py:1101):
+        every rank add()s then wait()s for the count key."""
+        master = native.TCPStore(is_master=True)
+        clients = [native.TCPStore(port=master.port) for _ in range(3)]
+        world = 3
+
+        def rank(i):
+            n = clients[i].add("barrier/counter", 1)
+            if n == world:
+                clients[i].set("barrier/release", b"1")
+            clients[i].wait("barrier/release")
+
+        ts = [threading.Thread(target=rank, args=(i,)) for i in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert master.get("barrier/release") == b"1"
+        for c in clients:
+            c.close()
+        master.close()
+
+
+class TestWiring:
+    def test_profiler_uses_native_recorder(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import profiler as prof
+        from paddle_tpu.profiler import _recorder
+
+        assert _recorder._native is not None
+        p = prof.Profiler()
+        p.start()
+        paddle.tanh(paddle.ones([4]))
+        p.stop()
+        assert any(e[0] == "op::tanh" for e in p._events)
+
+    def test_distributed_tcpstore_export(self):
+        import paddle_tpu.distributed as dist
+
+        s = dist.TCPStore(is_master=True)
+        s.set("x", b"y")
+        assert s.get("x") == b"y"
+        s.close()

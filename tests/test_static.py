@@ -104,6 +104,30 @@ class TestStaticTraining:
         losses = self._train(self._build(AdamW, learning_rate=0.05))
         assert losses[-1] < losses[0] * 0.5
 
+    def test_lr_change_takes_effect(self, static_mode):
+        """LR is a traced argument: set_lr between runs must change the
+        update magnitude without re-tracing."""
+        from paddle_tpu import nn
+        from paddle_tpu.optimizer import SGD
+
+        x = paddle.static.data("x", [None, 4])
+        lin = nn.Linear(4, 1, bias_attr=False)
+        loss = (lin(x) ** 2).mean()
+        opt = SGD(learning_rate=0.1)
+        opt.minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(paddle.static.default_startup_program())
+        X = np.ones((2, 4), np.float32)
+        w0 = np.array(paddle.static.global_scope().vars.get(
+            lin.weight.name, lin.weight.numpy()))
+        exe.run(feed={"x": X}, fetch_list=[loss])
+        step1 = np.abs(lin.weight.numpy() - w0).max()
+        opt.set_lr(0.0)  # freeze
+        w1 = lin.weight.numpy().copy()
+        exe.run(feed={"x": X}, fetch_list=[loss])
+        assert step1 > 0
+        np.testing.assert_array_equal(lin.weight.numpy(), w1)
+
     def test_param_objs_stay_synced(self, static_mode):
         from paddle_tpu import nn
         from paddle_tpu.optimizer import SGD
