@@ -51,6 +51,8 @@ _LAZY_SUBMODULES = {
     "autograd",
     "distributed",
     "distribution",
+    "fft",
+    "quantization",
     "framework",
     "hapi",
     "incubate",

@@ -1,0 +1,530 @@
+"""paddle.distribution parity (reference: python/paddle/distribution/ —
+Distribution base, Normal/Uniform/Categorical/Bernoulli/Beta/Dirichlet/
+Laplace/Gumbel/Multinomial/..., kl_divergence with a (p,q)-type registry).
+
+TPU-native: samples draw explicit PRNG keys from the framework generator
+(randomness is data, jit-compatible); log_prob/entropy are jnp math."""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random import default_generator
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Dirichlet", "Laplace", "Gumbel", "Exponential",
+           "Geometric", "Cauchy", "LogNormal", "Multinomial",
+           "kl_divergence", "register_kl"]
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(
+        x, jnp.ndarray) else x
+
+
+def _shape(sample_shape) -> Tuple[int, ...]:
+    if sample_shape is None:
+        return ()
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    """reference distribution/distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_raw(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.normal(k, s))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _raw(low)
+        self.high = _raw(high)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.low), jnp.shape(self.high)))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.uniform(
+            k, s, minval=self.low, maxval=self.high))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _raw(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(
+            k, self.probs, s).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, name=None):
+        if isinstance(logits, Tensor):
+            logits = logits._value
+        self.logits = jnp.asarray(logits)
+        super().__init__(jnp.shape(self.logits)[:-1])
+
+    @property
+    def probs_(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(k, self.logits, shape=s))
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(jnp.take_along_axis(
+            logp, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_raw(self.log_prob(value))))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _raw(alpha)
+        self.beta = _raw(beta)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.alpha), jnp.shape(self.beta)))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        t = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (t * t * (t + 1)))
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.beta(k, self.alpha, self.beta, s))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        from jax.scipy.special import betaln
+
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
+                      - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _raw(concentration)
+        super().__init__(jnp.shape(self.concentration)[:-1],
+                         jnp.shape(self.concentration)[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / jnp.sum(self.concentration, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(k, self.concentration, s))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _raw(value)
+        c = self.concentration
+        return Tensor(jnp.sum((c - 1) * jnp.log(v), -1)
+                      + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.laplace(k, s))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                       self.batch_shape))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * np.euler_gamma)
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            (math.pi ** 2 / 6) * self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.gumbel(k, s))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_raw(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.log(self.scale) + 1 + np.euler_gamma, self.batch_shape))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _raw(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.exponential(k, s) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _raw(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.probs)
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(k, s, minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return Tensor(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.cauchy(k, s))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_raw(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.log(4 * math.pi * self.scale), self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(_raw(self._normal.sample(shape))))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return Tensor(_raw(self._normal.log_prob(jnp.log(v))) - jnp.log(v))
+
+    def entropy(self):
+        return Tensor(_raw(self._normal.entropy()) + self.loc)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _raw(probs)
+        super().__init__(jnp.shape(self.probs)[:-1],
+                         jnp.shape(self.probs)[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    def sample(self, shape=()):
+        k = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        draws = jax.random.categorical(
+            k, logits, shape=(self.total_count,) + s)
+        n_cat = self.probs.shape[-1]
+        onehot = jax.nn.one_hot(draws, n_cat)
+        return Tensor(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _raw(value)
+        logp = jnp.log(jnp.clip(self.probs, 1e-12))
+        return Tensor(gammaln(self.total_count + 1.0)
+                      - jnp.sum(gammaln(v + 1.0), -1)
+                      + jnp.sum(v * logp, -1))
+
+
+# -- KL registry (reference distribution/kl.py) ------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], Callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(a * (jnp.log(a) - jnp.log(b))
+                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    t = (betaln(a2, b2) - betaln(a1, b1)
+         + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+         + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+    return Tensor(t)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)

@@ -1,0 +1,267 @@
+"""paddle.quantization parity (reference: python/paddle/quantization/ —
+QuantConfig, PTQ observers, QAT fake-quant, quanted layer swap).
+
+TPU-native notes: int8 inference on TPU rides XLA's int8 matmul; training-
+time quantization here is simulated (fake-quant in fp) exactly like the
+reference's QAT — scale observation + round-to-nearest with straight-
+through gradients (custom_vjp identity)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["quant", "dequant", "fake_quant", "AbsmaxObserver",
+           "BaseObserver", "FakeQuanterWithAbsMax", "QuantConfig", "QAT",
+           "PTQ", "QuantedLinear"]
+
+
+# -- functional core ---------------------------------------------------------
+
+
+def quant(x, scale, bits: int = 8):
+    """Real quantize: fp → int (reference quant kernels)."""
+    qmax = 2 ** (bits - 1) - 1
+    v = x._value if isinstance(x, Tensor) else x
+    s = scale._value if isinstance(scale, Tensor) else scale
+    return Tensor(jnp.clip(jnp.round(v / s * qmax), -qmax - 1, qmax)
+                  .astype(jnp.int8 if bits == 8 else jnp.int32))
+
+
+def dequant(x, scale, bits: int = 8):
+    qmax = 2 ** (bits - 1) - 1
+    v = x._value if isinstance(x, Tensor) else x
+    s = scale._value if isinstance(scale, Tensor) else scale
+    return Tensor(v.astype(jnp.float32) * s / qmax)
+
+
+@jax.custom_vjp
+def _fake_quant(v, scale, qmax):
+    q = jnp.clip(jnp.round(v / scale * qmax), -qmax - 1, qmax)
+    return q * scale / qmax
+
+
+def _fq_fwd(v, scale, qmax):
+    return _fake_quant(v, scale, qmax), (v, scale)
+
+
+def _fq_bwd(res, g):
+    # straight-through estimator: pass gradient where |v| <= scale
+    v, scale = res
+    mask = (jnp.abs(v) <= scale).astype(g.dtype)
+    return g * mask, jnp.zeros_like(scale), None
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quant(x, scale, bits: int = 8):
+    """Simulated quantization with STE gradient (QAT core)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = scale._value if isinstance(scale, Tensor) else jnp.asarray(scale)
+    return apply_op(lambda v: _fake_quant(v, s, qmax), x,
+                    op_name="fake_quant")
+
+
+# -- observers ---------------------------------------------------------------
+
+
+class BaseObserver(Layer):
+    """reference quantization/observer.py BaseObserver."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def scales(self):
+        raise NotImplementedError
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running abs-max scale observer (reference AbsmaxObserver)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self._max = 1e-9
+
+    def forward(self, x):
+        v = x._value if isinstance(x, Tensor) else x
+        if not isinstance(v, jax.core.Tracer):  # calibration is eager-only
+            self._max = max(self._max, float(jnp.max(jnp.abs(v))))
+        return x
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._max, jnp.float32))
+
+
+class FakeQuanterWithAbsMax(BaseObserver):
+    """QAT fake-quanter (reference FakeQuanterWithAbsMaxObserver): observes
+    abs-max and applies STE fake-quant in forward."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9,
+                 name=None):
+        super().__init__(quant_bits)
+        self._moving_rate = moving_rate
+        self._scale = None  # set from the FIRST batch's absmax (reference
+        # seeds the state with the first observation; ramping from ~0 would
+        # mask every STE gradient early in training)
+
+    def forward(self, x):
+        v = x._value if isinstance(x, Tensor) else x
+        # observation is a host-side statistic: skip under trace (jit sees a
+        # tracer; the frozen scale is used) and when not training
+        if self.training and not isinstance(v, jax.core.Tracer):
+            cur = float(jnp.max(jnp.abs(v)))
+            if self._scale is None:
+                self._scale = max(cur, 1e-9)
+            else:
+                r = self._moving_rate
+                self._scale = max(r * self._scale + (1 - r) * cur, 1e-9)
+        return fake_quant(x, self._scale if self._scale is not None else 1.0,
+                          self._quant_bits)
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._scale or 1e-9, jnp.float32))
+
+
+# -- quanted layers ----------------------------------------------------------
+
+
+class QuantedLinear(Layer):
+    """Linear with weight+activation fake-quant (reference
+    nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, linear, q_config=None):
+        super().__init__()
+        self.linear = linear
+        bits = (q_config.weight_bits if q_config else 8)
+        self.weight_quanter = FakeQuanterWithAbsMax(bits)
+        self.activation_quanter = FakeQuanterWithAbsMax(
+            q_config.activation_bits if q_config else 8)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        x = self.activation_quanter(x)
+        w = self.weight_quanter(self.linear.weight)
+        return F.linear(x, w, self.linear.bias)
+
+
+class QuantConfig:
+    """reference quantization/config.py QuantConfig."""
+
+    def __init__(self, activation=None, weight=None, weight_bits: int = 8,
+                 activation_bits: int = 8):
+        self.activation = activation
+        self.weight = weight
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._layer_map: Dict[Type, Type] = {}
+        from ..nn.layer.common import Linear
+
+        self._layer_map[Linear] = QuantedLinear
+
+    def add_layer_config(self, layer_types, activation=None, weight=None):
+        return self
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        return self
+
+
+def _swap_layers(model: Layer, cfg: QuantConfig):
+    for name, sub in list(model._sub_layers.items()):
+        swapped = cfg._layer_map.get(type(sub))
+        if swapped is not None:
+            model._sub_layers[name] = swapped(sub, cfg)
+        else:
+            _swap_layers(sub, cfg)
+    return model
+
+
+def _maybe_copy(model: Layer, inplace: bool) -> Layer:
+    if inplace:
+        return model
+    import copy
+
+    return copy.deepcopy(model)
+
+
+class QAT:
+    """Quantization-aware training driver (reference quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return _swap_layers(_maybe_copy(model, inplace), self._config)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        return model  # fake-quant layers already carry final scales
+
+
+class PTQ:
+    """Post-training quantization driver (reference quantization/ptq.py):
+    insert observers, run calibration data through, convert freezes the
+    observed scales into the quanted layers."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self._config = config or QuantConfig()
+        self._observers: List[AbsmaxObserver] = []
+        self._obs_by_layer: Dict[int, AbsmaxObserver] = {}
+        self._hooks = []
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        cfg = self._config
+        model = _maybe_copy(model, inplace)
+
+        def attach(m):
+            for name, sub in list(m._sub_layers.items()):
+                from ..nn.layer.common import Linear
+
+                if isinstance(sub, Linear):
+                    obs = AbsmaxObserver(cfg.activation_bits)
+                    self._observers.append(obs)
+                    self._obs_by_layer[id(sub)] = obs
+                    self._hooks.append(sub.register_forward_pre_hook(
+                        lambda l, inputs, _o=obs: (_o(inputs[0]),)))
+                else:
+                    attach(sub)
+
+        attach(model)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Swap to quanted layers and FREEZE the calibrated scales
+        (the reference's scale-transfer step)."""
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+
+        def swap(m):
+            for name, sub in list(m._sub_layers.items()):
+                from ..nn.layer.common import Linear
+
+                if isinstance(sub, Linear):
+                    ql = QuantedLinear(sub, self._config)
+                    obs = self._obs_by_layer.get(id(sub))
+                    if obs is not None:
+                        ql.activation_quanter._scale = float(
+                            obs.scales()._value)
+                    ql.weight_quanter._scale = float(
+                        jnp.max(jnp.abs(sub.weight._value)))
+                    ql.eval()
+                    m._sub_layers[name] = ql
+                else:
+                    swap(sub)
+
+        swap(model)
+        return model

@@ -1,0 +1,157 @@
+"""paddle.sparse parity (reference: python/paddle/sparse/ — COO/CSR tensor
+API over phi/kernels/sparse). TPU-native: jax.experimental.sparse BCOO/BCSR
+is the storage; XLA lowers sparse ops to gather/scatter-matmul on TPU."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseTensor",
+           "is_same_shape", "add", "matmul", "masked_matmul", "relu",
+           "nn"]
+
+
+class SparseTensor(Tensor):
+    """Tensor holding a BCOO/BCSR value (reference SparseCooTensor /
+    SparseCsrTensor, phi/core/sparse_coo_tensor.h)."""
+
+    __slots__ = ()
+
+    @property
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return isinstance(self._value, jsparse.BCOO)
+
+    def is_sparse_csr(self):
+        return isinstance(self._value, jsparse.BCSR)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._value.todense())
+
+    def indices(self) -> Tensor:
+        return Tensor(self._value.indices.T)  # paddle layout [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._value.data)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._value.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._value.indices)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._value.nse)
+
+    def numpy(self):
+        return np.asarray(self._value.todense())
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference paddle.sparse.sparse_coo_tensor: indices [ndim, nnz]."""
+    idx = np.asarray(indices._value if isinstance(indices, Tensor)
+                     else indices)
+    val = jnp.asarray(values._value if isinstance(values, Tensor) else values,
+                      dtype=dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    coo = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseTensor(coo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    val = jnp.asarray(values._value if isinstance(values, Tensor) else values,
+                      dtype=dtype)
+    indptr = jnp.asarray(crows._value if isinstance(crows, Tensor) else crows)
+    idx = jnp.asarray(cols._value if isinstance(cols, Tensor) else cols)
+    csr = jsparse.BCSR((val, idx, indptr), shape=tuple(shape))
+    return SparseTensor(csr, stop_gradient=stop_gradient)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def add(x, y, name=None):
+    """Sparse+sparse add. Support pattern is the UNION of operands;
+    computed via densify (fine for the API-parity sizes; a fused
+    union-merge kernel is the optimization path for large nnz)."""
+    xv, yv = _raw(x), _raw(y)
+    if isinstance(xv, (jsparse.BCOO, jsparse.BCSR)) and isinstance(
+            yv, (jsparse.BCOO, jsparse.BCSR)):
+        return SparseTensor(jsparse.BCOO.fromdense(
+            xv.todense() + yv.todense()))
+    return Tensor(_dense(xv) + _dense(yv))
+
+
+def _dense(v):
+    return v.todense() if isinstance(v, (jsparse.BCOO, jsparse.BCSR)) else v
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (reference paddle.sparse.matmul)."""
+    xv, yv = _raw(x), _raw(y)
+    if isinstance(xv, jsparse.BCSR):
+        xv = jsparse.BCOO.from_bcsr(xv)
+    if isinstance(xv, jsparse.BCOO):
+        return Tensor(xv @ yv)
+    return Tensor(xv @ _dense(yv))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense with sparse output pattern (reference
+    paddle.sparse.masked_matmul; SDDMM). The output carries EXACTLY the
+    mask's index set — values are gathered at the mask's coordinates, so
+    output.values() aligns 1:1 with the mask (even where the product is 0)."""
+    out = _dense(_raw(x)) @ _dense(_raw(y))
+    mv = _raw(mask)
+    if isinstance(mv, jsparse.BCSR):
+        mv = jsparse.BCOO.from_bcsr(mv)
+    if not isinstance(mv, jsparse.BCOO):
+        mv = jsparse.BCOO.fromdense(jnp.asarray(mv) != 0)
+    rows = mv.indices[:, 0]
+    cols = mv.indices[:, 1]
+    vals = out[rows, cols]
+    return SparseTensor(jsparse.BCOO((vals, mv.indices), shape=out.shape))
+
+
+def relu(x, name=None):
+    v = _raw(x)
+    if isinstance(v, (jsparse.BCOO, jsparse.BCSR)):
+        out = jsparse.BCOO(
+            (jnp.maximum(v.data if hasattr(v, "data") else v.values, 0),
+             v.indices), shape=v.shape) if isinstance(v, jsparse.BCOO) else \
+            jsparse.BCSR((jnp.maximum(v.data, 0), v.indices, v.indptr),
+                         shape=v.shape)
+        return SparseTensor(out)
+    return Tensor(jnp.maximum(v, 0))
+
+
+class _SparseNN:
+    """paddle.sparse.nn facade (ReLU / functional softmax on values)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    @staticmethod
+    def functional_relu(x):
+        return relu(x)
+
+
+nn = _SparseNN()

@@ -1,0 +1,260 @@
+"""Long-tail package tests: fft, sparse, distribution, quantization
+(reference analogs: test/fft/, test/legacy_test/test_sparse_*,
+test/distribution/, test/quantization/)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = paddle.to_tensor(np.random.randn(8).astype(np.float32))
+        y = paddle.fft.ifft(paddle.fft.fft(x))
+        np.testing.assert_allclose(np.real(y.numpy()), x.numpy(), atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        x = np.random.randn(16).astype(np.float32)
+        y = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(y.numpy(), np.fft.rfft(x), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fft2_and_shift(self):
+        x = np.random.randn(4, 4).astype(np.float32)
+        y = paddle.fft.fftshift(paddle.fft.fft2(paddle.to_tensor(x)))
+        ref = np.fft.fftshift(np.fft.fft2(x))
+        np.testing.assert_allclose(y.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8).numpy(),
+                                   np.fft.fftfreq(8).astype(np.float32))
+
+    def test_grad_flows(self):
+        x = paddle.to_tensor(np.random.randn(8).astype(np.float32))
+        x.stop_gradient = False
+        y = paddle.fft.rfft(x)
+        loss = (y.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        indices = [[0, 1, 2], [1, 2, 0]]
+        values = [1.0, 2.0, 3.0]
+        s = paddle.sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+        assert s.is_sparse_coo()
+        assert s.nnz == 3
+        dense = s.to_dense().numpy()
+        assert dense[0, 1] == 1.0 and dense[1, 2] == 2.0 and dense[2, 0] == 3.0
+
+    def test_csr(self):
+        s = paddle.sparse.sparse_csr_tensor(
+            [0, 1, 2], [1, 0], [5.0, 6.0], shape=[2, 2])
+        assert s.is_sparse_csr()
+        d = s.to_dense().numpy()
+        assert d[0, 1] == 5.0 and d[1, 0] == 6.0
+
+    def test_matmul(self):
+        s = paddle.sparse.sparse_coo_tensor([[0, 1], [0, 1]], [2.0, 3.0],
+                                            shape=[2, 2])
+        y = np.random.randn(2, 4).astype(np.float32)
+        out = paddle.sparse.matmul(s, jnp.asarray(y))
+        ref = np.diag([2.0, 3.0]).astype(np.float32) @ y
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_relu_and_add(self):
+        s = paddle.sparse.sparse_coo_tensor([[0, 1], [0, 1]], [-1.0, 2.0],
+                                            shape=[2, 2])
+        r = paddle.sparse.relu(s)
+        assert r.to_dense().numpy()[0, 0] == 0.0
+        out = paddle.sparse.add(s, s)
+        assert out.to_dense().numpy()[1, 1] == 4.0
+
+    def test_masked_matmul(self):
+        x = np.ones((2, 3), np.float32)
+        y = np.ones((3, 2), np.float32)
+        mask = paddle.sparse.sparse_coo_tensor([[0], [1]], [1.0], shape=[2, 2])
+        out = paddle.sparse.masked_matmul(jnp.asarray(x), jnp.asarray(y), mask)
+        d = out.to_dense().numpy()
+        assert d[0, 1] == 3.0 and d[0, 0] == 0.0
+
+
+class TestDistribution:
+    def test_normal_logprob_entropy_kl(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+
+        p = Normal(0.0, 1.0)
+        np.testing.assert_allclose(float(p.log_prob(0.0)._value),
+                                   -0.5 * np.log(2 * np.pi), rtol=1e-6)
+        np.testing.assert_allclose(float(p.entropy()._value),
+                                   0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-6)
+        q = Normal(1.0, 2.0)
+        kl = float(kl_divergence(p, q)._value)
+        ref = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, ref, rtol=1e-5)
+
+    def test_sampling_moments(self):
+        from paddle_tpu.distribution import Gumbel, Laplace, Normal, Uniform
+
+        paddle.seed(0)
+        for dist, mean, tol in [
+                (Normal(2.0, 0.5), 2.0, 0.05),
+                (Uniform(0.0, 4.0), 2.0, 0.1),
+                (Laplace(1.0, 1.0), 1.0, 0.1),
+                (Gumbel(0.0, 1.0), float(np.euler_gamma), 0.1)]:
+            s = dist.sample([20000])
+            assert abs(float(jnp.mean(s._value)) - mean) < tol, type(dist)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+
+        c = Categorical(logits=np.log([0.2, 0.8]).astype(np.float32))
+        lp = c.log_prob(paddle.to_tensor(np.array(1)))
+        np.testing.assert_allclose(float(lp._value), np.log(0.8), rtol=1e-5)
+        ent = float(c.entropy()._value)
+        ref = -(0.2 * np.log(0.2) + 0.8 * np.log(0.8))
+        np.testing.assert_allclose(ent, ref, rtol=1e-5)
+
+    def test_beta_dirichlet(self):
+        from paddle_tpu.distribution import Beta, Dirichlet
+
+        b = Beta(2.0, 3.0)
+        np.testing.assert_allclose(float(b.mean._value), 0.4, rtol=1e-6)
+        d = Dirichlet(np.array([1.0, 2.0, 2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(d.mean._value),
+                                   [0.2, 0.4, 0.4], rtol=1e-5)
+        s = d.sample()
+        np.testing.assert_allclose(float(jnp.sum(s._value)), 1.0, rtol=1e-5)
+
+    def test_beta_logprob_closed_form(self):
+        from paddle_tpu.distribution import Beta
+
+        b = Beta(2.0, 2.0)
+        # pdf(x; 2,2) = 6x(1-x) → log pdf(0.5) = log(1.5)
+        np.testing.assert_allclose(float(b.log_prob(0.5)._value),
+                                   np.log(1.5), rtol=1e-5)
+
+    def test_multinomial(self):
+        from paddle_tpu.distribution import Multinomial
+
+        m = Multinomial(10, np.array([0.3, 0.7], np.float32))
+        s = m.sample()
+        assert float(jnp.sum(s._value)) == 10.0
+        lp = m.log_prob(paddle.to_tensor(np.array([3.0, 7.0])))
+        assert np.isfinite(float(lp._value))
+
+    def test_kl_unregistered_raises(self):
+        from paddle_tpu.distribution import Gumbel, Normal, kl_divergence
+
+        with pytest.raises(NotImplementedError):
+            kl_divergence(Normal(0.0, 1.0), Gumbel(0.0, 1.0))
+
+
+class TestQuantization:
+    def test_quant_dequant_roundtrip(self):
+        from paddle_tpu.quantization import dequant, quant
+
+        x = paddle.to_tensor(np.array([0.5, -1.0, 0.25], np.float32))
+        q = quant(x, scale=1.0)
+        assert q._value.dtype == jnp.int8
+        back = dequant(q, scale=1.0)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1e-2)
+
+    def test_fake_quant_ste_grad(self):
+        from paddle_tpu.quantization import fake_quant
+
+        x = paddle.to_tensor(np.array([0.3, 2.0], np.float32))
+        x.stop_gradient = False
+        y = fake_quant(x, scale=1.0)
+        y.sum().backward()
+        # STE: grad 1 inside [-scale, scale], 0 outside
+        np.testing.assert_array_equal(x.grad.numpy(), [1.0, 0.0])
+
+    def test_absmax_observer(self):
+        from paddle_tpu.quantization import AbsmaxObserver
+
+        obs = AbsmaxObserver()
+        obs(paddle.to_tensor(np.array([0.5, -3.0], np.float32)))
+        obs(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert float(obs.scales()._value) == 3.0
+
+    def test_qat_swaps_and_trains(self):
+        from paddle_tpu.optimizer import SGD
+        from paddle_tpu.quantization import QAT, QuantConfig, QuantedLinear
+
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        qat = QAT(QuantConfig())
+        model = qat.quantize(model)
+        assert isinstance(model[0], QuantedLinear)
+        opt = SGD(learning_rate=0.1, parameters=model.parameters())
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        losses = []
+        for _ in range(5):
+            loss = (model(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_ptq_observes(self):
+        from paddle_tpu.quantization import PTQ
+
+        model = nn.Sequential(nn.Linear(4, 2))
+        ptq = PTQ()
+        model = ptq.quantize(model, inplace=True)
+        model(paddle.to_tensor(np.random.randn(8, 4).astype(np.float32)))
+        assert ptq._observers and float(ptq._observers[0].scales()._value) > 0
+
+    def test_ptq_convert_freezes_calibrated_scale(self):
+        from paddle_tpu.quantization import PTQ, QuantedLinear
+
+        model = nn.Sequential(nn.Linear(4, 2))
+        ptq = PTQ()
+        model = ptq.quantize(model, inplace=True)
+        calib = np.zeros((4, 4), np.float32)
+        calib[0, 0] = 7.0  # absmax = 7
+        model(paddle.to_tensor(calib))
+        model = ptq.convert(model)
+        ql = model[0]
+        assert isinstance(ql, QuantedLinear)
+        assert abs(ql.activation_quanter._scale - 7.0) < 1e-6
+        assert ql.weight_quanter._scale is not None
+
+    def test_quantize_not_inplace_preserves_original(self):
+        from paddle_tpu.quantization import QAT, QuantConfig, QuantedLinear
+
+        model = nn.Sequential(nn.Linear(4, 2))
+        q = QAT(QuantConfig()).quantize(model, inplace=False)
+        assert isinstance(q[0], QuantedLinear)
+        assert not isinstance(model[0], QuantedLinear)  # original untouched
+
+    def test_fake_quanter_under_jit(self):
+        from paddle_tpu.quantization import QAT, QuantConfig
+
+        model = QAT(QuantConfig()).quantize(nn.Sequential(nn.Linear(4, 2)))
+        # observe once eagerly, then trace: tracer-guard must not crash
+        x = np.random.randn(2, 4).astype(np.float32)
+        model(paddle.to_tensor(x))
+        from paddle_tpu.nn.functional_call import functional_call
+
+        params = {k: p.value for k, p in model.named_parameters()}
+        out = jax.jit(
+            lambda p, v: functional_call(model, p, paddle.Tensor(v)))(
+                params, x)
+        assert out.shape == (2, 2)
+
+    def test_masked_matmul_keeps_mask_pattern(self):
+        # product is exactly 0 at a masked position: entry must survive
+        x = np.array([[1.0, -1.0]], np.float32)
+        y = np.array([[1.0], [1.0]], np.float32)  # x @ y == 0
+        mask = paddle.sparse.sparse_coo_tensor([[0], [0]], [1.0],
+                                               shape=[1, 1])
+        out = paddle.sparse.masked_matmul(jnp.asarray(x), jnp.asarray(y),
+                                          mask)
+        assert out.nnz == 1  # pattern preserved despite 0 value
+        assert float(out.values().numpy()[0]) == 0.0
