@@ -1,0 +1,312 @@
+"""Auto-parallel (semi-automatic SPMD) — reference:
+python/paddle/distributed/auto_parallel/ (ProcessMesh, shard_tensor
+interface.py, Engine static/engine.py:854, Strategy strategy.py).
+
+TPU-native collapse (SURVEY.md §3.6): the reference's
+Completer→Partitioner→Resharder pipeline IS XLA's GSPMD propagation —
+the user marks a few placements (shard_tensor), jit compiles ONE program
+over the mesh, and the compiler completes/partitions/reshards. The Engine
+keeps the reference's fit/evaluate/predict surface on top of a donated,
+fully-jitted train step.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .._spmd import get_pspec, set_pspec
+from ..topology import get_mesh, set_mesh
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "shard_op", "reshard", "dtensor_from_fn", "Strategy", "Engine",
+           "to_static"]
+
+
+class ProcessMesh:
+    """reference auto_parallel/process_mesh.py — an N-D logical device mesh
+    with named dims; backed by a jax.sharding.Mesh."""
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.flatten().tolist()
+        devices = np.asarray(jax.devices())
+        if devices.size < arr.size:
+            raise ValueError(
+                f"ProcessMesh needs {arr.size} devices, have {devices.size}")
+        picked = devices[np.asarray(self._process_ids)]
+        self._jax_mesh = Mesh(picked.reshape(arr.shape),
+                              axis_names=tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self._dim_names})"
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard tensor dim `dim` across the corresponding mesh dim."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard({self.dim})"
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending-reduction placement; jit materialises the psum on use."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return "Partial()"
+
+
+def _placements_to_spec(placements: Sequence[Placement], pm: ProcessMesh,
+                        ndim: int) -> P:
+    spec: List[Optional[str]] = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            if spec[pl.dim] is not None:
+                raise ValueError(f"tensor dim {pl.dim} sharded twice")
+            spec[pl.dim] = pm.dim_names[mesh_dim]
+    return P(*spec)
+
+
+def shard_tensor(x, process_mesh: ProcessMesh, placements,
+                 dtype=None, stop_gradient=None):
+    """reference interface.py shard_tensor: place x on the mesh per
+    `placements` (one per MESH dim)."""
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    spec = _placements_to_spec(placements, process_mesh, t.ndim)
+    set_pspec(t, spec)
+    sh = NamedSharding(process_mesh.mesh, spec)
+    try:
+        t._value = jax.device_put(t._value, sh)
+    except (RuntimeError, ValueError):
+        pass  # abstract/tracer values keep the annotation only
+    return t
+
+
+def shard_op(op, process_mesh: ProcessMesh, in_placements=None,
+             out_placements=None):
+    """reference interface.py shard_op — returns a wrapped op whose outputs
+    get sharding constraints."""
+
+    def wrapped(*args, **kwargs):
+        out = op(*args, **kwargs)
+        if out_placements:
+            from .._spmd import constraint
+
+            spec = _placements_to_spec(out_placements, process_mesh,
+                                       out.ndim)
+            out = constraint(out, spec, process_mesh.mesh)
+        return out
+
+    return wrapped
+
+
+def reshard(x, process_mesh: ProcessMesh, placements):
+    """Explicit placement change (reference reshard API): device_put with
+    the new sharding — XLA emits the collective."""
+    return shard_tensor(x, process_mesh, placements)
+
+
+def dtensor_from_fn(fn, process_mesh: ProcessMesh, placements, *args,
+                    **kwargs):
+    return shard_tensor(fn(*args, **kwargs), process_mesh, placements)
+
+
+class Strategy:
+    """reference auto_parallel/strategy.py — typed config tree."""
+
+    class _Cfg(dict):
+        __getattr__ = dict.get
+
+        def __setattr__(self, k, v):
+            self[k] = v
+
+    def __init__(self, config=None):
+        self.auto_mode = "semi"
+        self.amp = Strategy._Cfg(enable=False, dtype="bfloat16", level="O1")
+        self.recompute = Strategy._Cfg(enable=False)
+        self.sharding = Strategy._Cfg(enable=False, degree=1, stage=1)
+        self.pipeline = Strategy._Cfg(enable=False, schedule_mode="1F1B",
+                                      accumulate_steps=1)
+        self.gradient_merge = Strategy._Cfg(enable=False, k_steps=1)
+        if config:
+            for k, v in dict(config).items():
+                setattr(self, k, v)
+
+
+class Engine:
+    """reference static/engine.py:854 — fit/evaluate/predict over ONE jitted
+    SPMD step."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy: Optional[Strategy] = None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics
+        self._strategy = strategy or Strategy()
+        self._step_fn = None
+        self._eval_fn = None
+        self._params = None
+        self._opt_state = None
+        self.history: List[float] = []
+
+    # -- build --------------------------------------------------------------
+    def _build(self):
+        from ...nn.functional_call import functional_call
+
+        model, loss_fn = self._model, self._loss
+        mesh = get_mesh()
+        self._params = {k: p.value for k, p in model.named_parameters()}
+        # place params per their annotations (shard_tensor/set_pspec marks)
+        from .._spmd import named_sharding
+
+        for k, p in model.named_parameters():
+            spec = get_pspec(p)
+            if spec is not None:
+                self._params[k] = jax.device_put(
+                    self._params[k], named_sharding(spec, mesh))
+
+        remat = self._strategy.recompute.enable
+        accum = int(self._strategy.pipeline.accumulate_steps or 1)
+
+        def loss_of(params, x, y):
+            def fwd(x, y):
+                out = functional_call(model, params, Tensor(x))
+                l = loss_fn(Tensor(out), Tensor(y))
+                lv = l._value if isinstance(l, Tensor) else l
+                return jnp.mean(lv)
+
+            f = jax.checkpoint(fwd) if remat else fwd
+            if accum > 1:
+                xs = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+                ys = y.reshape((accum, y.shape[0] // accum) + y.shape[1:])
+                tot, _ = jax.lax.scan(
+                    lambda c, xy: (c + f(xy[0], xy[1]), None),
+                    jnp.zeros((), jnp.float32), (xs, ys))
+                return tot / accum
+            return f(x, y)
+
+        opt = self._optimizer
+
+        def step(params, opt_state, x, y, lr):
+            loss, grads = jax.value_and_grad(loss_of)(params, x, y)
+            new_params, opt_state = opt._static_update(
+                params, grads, opt_state, lr=lr)
+            return new_params, opt_state, loss
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+        self._eval_fn = jax.jit(loss_of)
+
+    def prepare(self, *a, **kw):
+        if self._step_fn is None:
+            self._build()
+        return self
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data, epochs: int = 1, batch_size: int = 32,
+            steps_per_epoch=None, valid_data=None, log_freq: int = 10,
+            verbose: int = 1, **kw):
+        from ...io import DataLoader, Dataset
+
+        if self._step_fn is None:
+            self._build()
+        loader = (train_data if not isinstance(train_data, Dataset)
+                  else DataLoader(train_data, batch_size=batch_size,
+                                  shuffle=True))
+        for epoch in range(epochs):
+            for step_i, batch in enumerate(loader):
+                if steps_per_epoch and step_i >= steps_per_epoch:
+                    break
+                x, y = batch
+                xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+                lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+                self._params, self._opt_state, loss = self._step_fn(
+                    self._params, self._opt_state, xv, yv, lr)
+                self.history.append(float(loss))
+                if verbose and step_i % log_freq == 0:
+                    print(f"[AutoParallel] epoch {epoch} step {step_i} "
+                          f"loss {float(loss):.4f}")
+        # write trained params back into the model (eager view)
+        for k, p in self._model.named_parameters():
+            p._value = self._params[k]
+        return self.history
+
+    def evaluate(self, eval_data, batch_size: int = 32, **kw):
+        from ...io import DataLoader, Dataset
+
+        if self._eval_fn is None:
+            self._build()
+        loader = (eval_data if not isinstance(eval_data, Dataset)
+                  else DataLoader(eval_data, batch_size=batch_size))
+        losses = []
+        for batch in loader:
+            x, y = batch
+            xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+            yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+            losses.append(float(self._eval_fn(self._params, xv, yv)))
+        return {"loss": float(np.mean(losses))}
+
+    def predict(self, test_data, batch_size: int = 32, **kw):
+        from ...io import DataLoader, Dataset
+        from ...nn.functional_call import functional_call
+
+        loader = (test_data if not isinstance(test_data, Dataset)
+                  else DataLoader(test_data, batch_size=batch_size))
+        params = self._params or {
+            k: p.value for k, p in self._model.named_parameters()}
+        fn = jax.jit(lambda p, x: functional_call(
+            self._model, p, Tensor(x)))
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+            outs.append(np.asarray(fn(params, xv)))
+        return outs
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """reference auto_parallel to_static helper — returns a prepared Engine."""
+    e = Engine(model=layer, loss=loss, optimizer=optimizer, strategy=strategy)
+    return e.prepare()

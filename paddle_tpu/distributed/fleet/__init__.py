@@ -59,8 +59,12 @@ def distributed_model(model):
     hcg = get_hybrid_communicate_group()
     strategy = _fleet_state["strategy"] or DistributedStrategy()
     if hcg.get_pipe_parallel_world_size() > 1:
-        from .meta_parallel.pipeline_parallel import PipelineParallel
+        from .meta_parallel.pipeline_parallel import (
+            PipelineParallel, PipelineParallelWithInterleave)
 
+        # reference model.py:162-169: interleave when virtual stages > 1
+        if getattr(model, "get_num_virtual_stages", lambda: 1)() > 1:
+            return PipelineParallelWithInterleave(model, hcg, strategy)
         return PipelineParallel(model, hcg, strategy)
     if hcg.get_model_parallel_world_size() > 1:
         return TensorParallel(model, hcg, strategy)
@@ -83,6 +87,13 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
 
     hcg = get_hybrid_communicate_group()
     strategy = strategy or _fleet_state["strategy"] or DistributedStrategy()
+    if hcg.get_sharding_parallel_world_size() > 1:
+        # stage-1 state sharding under the hybrid wrapper (reference
+        # fleet.py:1044 composes DygraphShardingOptimizer the same way)
+        from .meta_optimizers.dygraph_optimizer.dygraph_sharding_optimizer \
+            import DygraphShardingOptimizer
+
+        optimizer = DygraphShardingOptimizer(optimizer, hcg)
     return HybridParallelOptimizer(optimizer, hcg, strategy)
 
 

@@ -1,2 +1,2 @@
 """paddle.vision parity (python/paddle/vision/__init__.py)."""
-from . import models  # noqa: F401
+from . import datasets, models, transforms  # noqa: F401

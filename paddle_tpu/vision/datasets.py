@@ -1,0 +1,148 @@
+"""paddle.vision.datasets parity (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: datasets load from LOCAL files (the reference's
+download=True path needs network); `mode="random"` generates deterministic
+synthetic data with the right shapes for pipeline tests."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder"]
+
+
+class MNIST(Dataset):
+    """reference datasets/mnist.py — idx-format loader + synthetic mode."""
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 backend: str = "cv2"):
+        self.transform = transform
+        self.mode = mode
+        if image_path and os.path.exists(image_path):
+            self.images = self._read_images(image_path)
+            self.labels = self._read_labels(label_path)
+        else:
+            if download:
+                raise RuntimeError(
+                    "no network egress in this environment; place idx files "
+                    "locally and pass image_path/label_path")
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 1024 if mode == "train" else 256
+            self.images = rng.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+            self.labels = rng.randint(0, 10, (n, 1)).astype(np.int64)
+
+    @staticmethod
+    def _read_images(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            _, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype(
+                np.int64).reshape(n, 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """reference datasets/cifar.py — python-pickle batches + synthetic mode."""
+
+    N_CLASSES = 10
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 backend: str = "cv2"):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            self.data, self.labels = self._load(data_file, mode)
+        else:
+            if download:
+                raise RuntimeError("no network egress; pass data_file")
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 1024 if mode == "train" else 256
+            self.data = rng.randint(0, 256, (n, 3, 32, 32), dtype=np.uint8)
+            self.labels = rng.randint(0, self.N_CLASSES, (n,)).astype(np.int64)
+
+    def _load(self, path, mode):
+        imgs, labels = [], []
+        with tarfile.open(path) as tf:
+            names = [m for m in tf.getmembers()
+                     if ("data_batch" in m.name if mode == "train"
+                         else "test_batch" in m.name)]
+            for m in names:
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                imgs.append(np.asarray(d[b"data"]).reshape(-1, 3, 32, 32))
+                key = b"labels" if b"labels" in d else b"fine_labels"
+                labels.append(np.asarray(d[key], np.int64))
+        return np.concatenate(imgs), np.concatenate(labels)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    N_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    """reference datasets/folder.py — class-per-subdir image tree (numpy .npy
+    files in this no-PIL environment)."""
+
+    def __init__(self, root: str, loader=None, extensions=(".npy",),
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or np.load
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)

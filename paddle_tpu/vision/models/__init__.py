@@ -1,2 +1,6 @@
-"""paddle.vision.models parity (LeNet/VGG/MobileNet land with the vision widening)."""
+"""paddle.vision.models parity (reference: python/paddle/vision/models/)."""
+from .classic import (AlexNet, LeNet, MobileNetV1, MobileNetV2, SqueezeNet,
+                      VGG, alexnet, mobilenet_v1, mobilenet_v2,
+                      squeezenet1_0, squeezenet1_1, vgg11, vgg13, vgg16,
+                      vgg19)
 from .resnet import *  # noqa: F401,F403

@@ -1,0 +1,279 @@
+"""paddle.vision.transforms parity (reference:
+python/paddle/vision/transforms/transforms.py + functional.py).
+
+Host-side numpy transforms (the input pipeline runs on CPU; the single
+host→device transfer happens at the jit boundary). HWC uint8/float numpy in,
+like the reference's 'backend=cv2' path; ToTensor produces CHW float."""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad", "BrightnessTransform", "Grayscale",
+           "to_tensor", "normalize", "resize", "center_crop", "hflip",
+           "vflip", "pad", "crop"]
+
+
+def _np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._value)
+    return np.asarray(img)
+
+
+# -- functional ---------------------------------------------------------------
+
+
+def to_tensor(img, data_format: str = "CHW") -> Tensor:
+    arr = _np(img)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    arr = arr.astype(np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format: str = "CHW", to_rgb=False):
+    arr = _np(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    """Nearest/bilinear resize via numpy (no cv2/PIL dependency)."""
+    arr = _np(img)
+    if isinstance(size, numbers.Number):
+        h, w = arr.shape[:2]
+        short = min(h, w)
+        scale = size / short
+        size = (int(round(h * scale)), int(round(w * scale)))
+    oh, ow = size
+    h, w = arr.shape[:2]
+    if interpolation == "nearest":
+        ys = np.clip((np.arange(oh) + 0.5) * h / oh, 0, h - 1).astype(int)
+        xs = np.clip((np.arange(ow) + 0.5) * w / ow, 0, w - 1).astype(int)
+        return arr[ys][:, xs]
+    # bilinear
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = (ys - y0).reshape(-1, 1)
+    wx = (xs - x0).reshape(1, -1)
+    if arr.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    a = arr[y0][:, x0]
+    b = arr[y0][:, x1]
+    c = arr[y1][:, x0]
+    d = arr[y1][:, x1]
+    out = (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+           + c * wy * (1 - wx) + d * wy * wx)
+    return out.astype(arr.dtype if arr.dtype != np.uint8 else np.float32)
+
+
+def crop(img, top, left, height, width):
+    return _np(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _np(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return arr[top:top + th, left:left + tw]
+
+
+def hflip(img):
+    return _np(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _np(img)[::-1].copy()
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant"):
+    arr = _np(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4  # left, top, right, bottom
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    width = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, width, mode=mode, **kw)
+
+
+# -- transform classes --------------------------------------------------------
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _np(img)
+        if self.padding is not None:
+            arr = pad(arr, self.padding, self.fill, self.padding_mode)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            arr = pad(arr, (max(tw - w, 0), max(th - h, 0)), self.fill,
+                      self.padding_mode)
+            h, w = arr.shape[:2]
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return arr[top:top + th, left:left + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return hflip(img)
+        return _np(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return vflip(img)
+        return _np(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _np(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _np(img)
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        arr = _np(img).astype(np.float32) * factor
+        return np.clip(arr, 0, 255 if arr.max() > 1 else 1.0)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels: int = 1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _np(img).astype(np.float32)
+        gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                + 0.114 * arr[..., 2])
+        out = gray[..., None]
+        if self.num_output_channels == 3:
+            out = np.repeat(out, 3, axis=-1)
+        return out
