@@ -29,8 +29,13 @@ def main():
                                                  clip_by_global_norm)
 
     if on_tpu:
+        # 350M-param Llama with head_dim 128 (8 heads x 128 instead of
+        # 16 x 64): same parameter count, full-width MXU lanes on the
+        # attention contractions. Full activation recompute bounds live
+        # activations to one layer's worth (round-1 bench OOMed without it).
         cfg = llama_config("350m", dtype="bfloat16",
-                           max_position_embeddings=2048)
+                           num_attention_heads=8, num_key_value_heads=8,
+                           max_position_embeddings=2048, recompute="full")
         batch, seq, steps = 8, 2048, 10
         kind = jax.devices()[0].device_kind.lower()
         if "lite" in kind or "v5e" in kind:
@@ -45,7 +50,8 @@ def main():
         peak = 1e12  # meaningless on CPU; MFU reported but not comparable
 
     model = LlamaForCausalLM(cfg)
-    model.eval()  # no dropout; training math is the same here
+    # keep training=True so cfg.recompute applies; the model has no dropout,
+    # so train/eval forward math is identical
     params = {k: p.value for k, p in model.named_parameters()}
     n_params = sum(int(np.prod(v.shape)) for v in params.values())
     opt_state = adamw_init(params)

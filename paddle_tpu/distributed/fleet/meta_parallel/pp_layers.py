@@ -262,24 +262,26 @@ class PipelineLayer(Layer):
         from ..recompute.recompute import recompute
 
         layers = list(self.run_function)
+        from ....nn.layer.container import Sequential
+
         i = 0
         while i < len(layers):
             j = min(i + self._recompute_interval, len(layers))
             seg = layers[i:j]
-
-            def run(seg_x, _seg=seg):
-                for l in _seg:
-                    seg_x = l(seg_x)
-                return seg_x
 
             # remat every full segment; a SHORT tail segment (a lone
             # embedding/head when interval > 1) keeps its activation — a
             # one-layer activation is cheap and rerunning it buys nothing.
             # interval == 1 means the user asked for per-layer remat: honor it.
             if j - i > 1 or self._recompute_interval == 1:
-                x = recompute(run, x)
+                # a Sequential VIEW (not a closure) so recompute() threads
+                # the segment's parameters through the autograd tape —
+                # closure-captured weights are remat constants and would get
+                # no grad under eager backward()
+                x = recompute(Sequential(*seg), x)
             else:
-                x = run(x)
+                for l in seg:
+                    x = l(x)
             i = j
         return x
 

@@ -25,28 +25,46 @@ __all__ = ["recompute", "recompute_sequential"]
 def recompute(function, *args, preserve_rng_state: bool = True,
               use_reentrant: bool = True, **kwargs):
     """reference recompute.py:334 parity. Wraps `function(*args)` so its
-    activations are rematerialised during backward."""
-    from ....core import random as core_random
+    activations are rematerialised during backward.
 
-    # Draw one key now: the traced function folds it for any dropout inside,
-    # and remat replays the identical fold (keys are data, not state).
-    def fn(*tensor_args):
-        return function(*tensor_args, **kwargs)
+    When `function` is a Layer, its parameters are threaded through the
+    autograd tape explicitly (via functional_call substitution) — closure-
+    captured weights would otherwise be constants of the remat trace and
+    receive NO gradient under eager ``backward()``.
+    """
+    is_layer = hasattr(function, "named_parameters")
+    if is_layer:
+        param_items = list(function.named_parameters())
+    else:
+        param_items = []
+    names = [k for k, _ in param_items]
+    ptensors = [p for _, p in param_items]
+    np_ = len(ptensors)
+
+    def _wrap(v):
+        # Only array-likes become Tensor views: None / python scalars /
+        # flags must keep their identity, or `arg is None` branches inside
+        # `function` flip (a Tensor(None) attn_mask silently rerouted llama
+        # attention off the flash kernel onto the S²-materialising SDPA
+        # path under remat).
+        if not isinstance(v, Tensor) and hasattr(v, "shape"):
+            return Tensor(v, stop_gradient=False)
+        return v
 
     def pure(*vals):
-        # rebuild Tensor views so user `function` (written against the eager
-        # API) runs under the remat trace
-        wrapped = [Tensor(v, stop_gradient=False) if not isinstance(v, Tensor)
-                   else v for v in vals]
-        out = fn(*wrapped)
-        if isinstance(out, Tensor):
-            return out.value
-        if isinstance(out, (tuple, list)):
-            return type(out)(o.value if isinstance(o, Tensor) else o for o in out)
-        return out
+        pvals, rest = vals[:np_], [_wrap(v) for v in vals[np_:]]
+        if is_layer:
+            from ....nn.functional_call import functional_call
+
+            return functional_call(function, dict(zip(names, pvals)),
+                                   *rest, **kwargs)
+        out = function(*rest, **kwargs)
+        return jax.tree.map(
+            lambda o: o.value if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
 
     remat_fn = jax.checkpoint(pure)
-    return apply_op(remat_fn, *args, op_name="recompute")
+    return apply_op(remat_fn, *ptensors, *args, op_name="recompute")
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):
@@ -61,18 +79,15 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         segments = 1
     seg_size = max(1, len(layers) // segments)
 
-    def run_segment(seg):
-        def f(x):
-            for l in seg:
-                x = l(x)
-            return x
-
-        return f
+    from ....nn.layer.container import Sequential
 
     x = args[0]
     i = 0
     while i < len(layers):
-        seg = layers[i:i + seg_size]
-        x = recompute(run_segment(seg), x, **kwargs)
+        # a Sequential view over the segment so recompute() sees a Layer and
+        # threads the segment's parameters through the tape (a plain closure
+        # would capture them as remat constants → no grads under backward())
+        seg = Sequential(*layers[i:i + seg_size])
+        x = recompute(seg, x, **kwargs)
         i += seg_size
     return x

@@ -174,7 +174,12 @@ class Model:
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
-                update = (step + 1) % accumulate_grad_batches == 0
+                # always step on the epoch's last batch (reference
+                # model.py:2320): with accumulation and an epoch length not
+                # divisible by accumulate_grad_batches, tail-batch grads
+                # would otherwise leak into the next epoch
+                update = ((step + 1) % accumulate_grad_batches == 0
+                          or (steps is not None and step + 1 == steps))
                 out = self.train_batch(ins, labs, update=update)
                 logs = self._pack_logs(out)
                 cbks.on_train_batch_end(step, logs)

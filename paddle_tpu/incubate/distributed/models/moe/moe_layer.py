@@ -128,7 +128,14 @@ class MoELayer(Layer):
         x = inp.reshape([-1, d])
         T = x.shape[0]
         E = self.num_expert
-        capacity = max(1, int(math.ceil(self.capacity_factor * T / E)))
+        # GShard convention: expected assignments per expert under balanced
+        # top-k routing are k*T/E, so capacity must scale with the gate's
+        # top-k (reference gshard_gate.py:68 limit_by_capacity) — a plain
+        # ceil(cf*T/E) with top-2 would silently drop ~40% of routed tokens
+        topk = getattr(self.gate, "top_k",
+                       getattr(self.gate, "topk", 1)) or 1
+        capacity = max(1, int(math.ceil(
+            self.capacity_factor * topk * T / E)))
 
         val, idx = self.gate(x)
 

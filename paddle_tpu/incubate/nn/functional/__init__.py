@@ -82,13 +82,45 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     if cos_v is None or sin_v is None:
         raise ValueError("cos and sin are required")
 
+    if position_ids is not None:
+        # packed/shifted sequences: gather per-token cos/sin rows →
+        # [B, S, D/2]; the rotation runs as an XLA composition (the Pallas
+        # kernel's block layout assumes position == sequence index)
+        pid = (position_ids._value if isinstance(position_ids, Tensor)
+               else jnp.asarray(position_ids))
+        cos_v = jnp.take(cos_v, pid, axis=0)    # [B, S, D/2]
+        sin_v = jnp.take(sin_v, pid, axis=0)
+
+    def rot(xv):
+        c, s = cos_v, sin_v
+        if c.ndim == 3:                          # batched (position_ids)
+            c = c[:, :, None, :]                 # [B, S, 1, D/2]
+            s = s[:, :, None, :]
+        else:
+            c = c[None, :, None, :]              # [1, S, 1, D/2]
+            s = s[None, :, None, :]
+        if use_neox_rotary_style:
+            d2 = xv.shape[-1] // 2
+            x1, x2 = xv[..., :d2], xv[..., d2:]  # rotate-half layout
+            return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1)
+        # GPT-J interleaved layout: pairs are (x[2i], x[2i+1])
+        xp = xv.reshape(*xv.shape[:-1], xv.shape[-1] // 2, 2)
+        x1, x2 = xp[..., 0], xp[..., 1]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        return jnp.stack([o1, o2], axis=-1).reshape(xv.shape)
+
+    use_kernel = position_ids is None and use_neox_rotary_style
     outs = []
     for t in (q, k, v):
         if t is None:
             outs.append(None)
             continue
-        outs.append(apply_op(lambda xv: pk.fused_rope(xv, cos_v, sin_v), t,
-                             op_name="fused_rope"))
+        if use_kernel:
+            outs.append(apply_op(lambda xv: pk.fused_rope(xv, cos_v, sin_v),
+                                 t, op_name="fused_rope"))
+        else:
+            outs.append(apply_op(rot, t, op_name="fused_rope"))
     return tuple(outs)
 
 

@@ -138,7 +138,13 @@ class FusedMultiTransformer(Layer):
     def _act(self, x):
         return F.gelu(x) if self.activation == "gelu" else F.relu(x)
 
-    def _attn_context(self, q, k, v):
+    def _attn_context(self, q, k, v, attn_mask=None):
+        if attn_mask is not None:
+            # padded/variable-length batches: masked SDPA (mask composes
+            # with the causal structure, matching the reference kernel's
+            # attn_mask semantics, fused_multi_transformer_op.cu:220)
+            return F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=True)
         from ....ops.pallas import flash_attention
 
         return apply_op(
@@ -147,6 +153,10 @@ class FusedMultiTransformer(Layer):
 
     def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
                 seq_lens=None, time_step=None):
+        if pre_caches is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer: pre_caches (prefix caches) are not "
+                "supported yet — pass None")
         b, s, e = src.shape
         h, hd = self.num_heads, self.head_dim
         decode = time_step is not None
@@ -159,24 +169,48 @@ class FusedMultiTransformer(Layer):
             qkv = F.linear(xn, self.qkv_weights[i].t(), self.qkv_biases[i])
             q, k, v = (t.reshape([b, s, h, hd]) for t in qkv.chunk(3, axis=-1))
             if decode:
+                if attn_mask is not None:
+                    raise NotImplementedError(
+                        "FusedMultiTransformer decode supports ragged "
+                        "batches via seq_lens (prefix masking), not "
+                        "arbitrary attn_mask — pass seq_lens instead")
                 k_cache, v_cache = caches[i]
                 t = int(time_step)
-                # write this step's k/v at position t, attend over [0, t]
-                def upd(c, new):
-                    return apply_op(
-                        lambda cv, nv: cv.at[:, t].set(nv[:, 0]), c, new,
-                        op_name="kv_cache_write")
+                if seq_lens is not None:
+                    # reference convention (fused_multi_transformer decode):
+                    # seq_lens[i] is sequence i's CURRENT length; the cache
+                    # holds its tokens compacted at [0, len). This step's
+                    # k/v lands at position len (per sequence — ragged
+                    # batches don't share a write offset) and attention
+                    # spans the new prefix [0, len+1).
+                    from ....ops._helpers import unwrap as _unwrap
 
+                    pos = jnp.asarray(_unwrap(seq_lens), jnp.int32)
+
+                    def upd(c, new):
+                        return apply_op(
+                            lambda cv, nv: cv.at[jnp.arange(b), pos].set(
+                                nv[:, 0]), c, new, op_name="kv_cache_write")
+
+                    lens = pos + 1
+                else:
+                    # uniform batch: write at position t, attend [0, t]
+                    def upd(c, new):
+                        return apply_op(
+                            lambda cv, nv: cv.at[:, t].set(nv[:, 0]), c, new,
+                            op_name="kv_cache_write")
+
+                    lens = jnp.full((b,), t + 1, jnp.int32)
                 k_cache = upd(k_cache, k)
                 v_cache = upd(v_cache, v)
-                lens = jnp.full((b,), t + 1, jnp.int32)
                 ctx = incubate_F.masked_multihead_attention(
                     q.reshape([b, h, hd]), cache_kv=(k_cache, v_cache),
                     seq_lens=lens)
                 ctx = ctx.reshape([b, 1, e])
                 out_caches.append((k_cache, v_cache))
             else:
-                ctx = self._attn_context(q, k, v).reshape([b, s, e])
+                ctx = self._attn_context(q, k, v,
+                                         attn_mask=attn_mask).reshape([b, s, e])
                 if caches is not None:
                     k_cache, v_cache = caches[i]
                     def fill(c, new):

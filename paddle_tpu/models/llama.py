@@ -128,13 +128,12 @@ class LlamaAttention(Layer):
         v = self.v_proj(x)
 
         def prep(qv, kv, vv, cv, sv):
+            # GQA stays grouped: the flash kernel selects shared KV heads in
+            # its index maps (no jnp.repeat — a 65B config with 64 q-heads /
+            # 8 kv-heads would otherwise pay 8x KV activation memory)
             qh = apply_rotary_emb(qv.reshape(b, s, self.num_heads, hd), cv, sv)
             kh = apply_rotary_emb(kv.reshape(b, s, self.kv_heads, hd), cv, sv)
             vh = vv.reshape(b, s, self.kv_heads, hd)
-            if self.kv_heads != self.num_heads:
-                rep = self.num_heads // self.kv_heads
-                kh = jnp.repeat(kh, rep, axis=2)
-                vh = jnp.repeat(vh, rep, axis=2)
             return qh, kh, vh
 
         qh, kh, vh = apply_op(prep, q, k, v, cos, sin, op_name="qkv_rope")

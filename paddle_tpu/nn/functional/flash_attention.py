@@ -35,9 +35,14 @@ def _use_pallas() -> bool:
 
 def _sdpa_ref(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
               dropout_key=None):
-    """[B, S, H, D] reference composition; f32 softmax accumulation."""
+    """[B, S, H, D] reference composition; f32 softmax accumulation.
+    GQA allowed: K/V with fewer heads are repeated up to Q's head count."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     # [B, H, S, D]
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
@@ -64,30 +69,38 @@ def _sdpa_ref(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
 def flash_attention(query, key, value, dropout: float = 0.0, causal: bool = False,
                     return_softmax: bool = False, fixed_seed_offset=None,
                     rng_name: str = "", training: bool = True, name=None):
-    """paddle.nn.functional.flash_attention parity. Returns (out, softmax)."""
-    dk = default_generator.next_key() if (dropout > 0.0 and training) else None
+    """paddle.nn.functional.flash_attention parity. Returns (out, softmax).
 
-    if _use_pallas():
-        from ...ops.pallas import flash_attention as pallas_flash
+    GQA allowed (key/value with fewer heads). Routing:
+    - TPU, block-divisible seq lens → own Pallas flash kernel
+      (ops/flash_attention_kernel.py), dropout applied IN-KERNEL via a
+      counter-based RNG (no second attention pass, no S² buffer);
+    - CPU with dropout → the same kernel in interpret mode (so tests
+      exercise the real dropout code path);
+    - otherwise → XLA reference composition.
+    """
+    p = dropout if training else 0.0
+    from ...ops.flash_attention_kernel import supports
+    from ...ops.pallas import flash_attention as pallas_flash
 
-        def f(q, k, v):
-            return pallas_flash(q, k, v, causal=causal)
-
-        out = apply_op(f, query, key, value, op_name="flash_attention")
-        if dropout > 0.0 and training:
-            # dropout applied on output path is not equivalent; fall through ref
-            out = apply_op(
-                lambda q, k, v: _sdpa_ref(q, k, v, causal=causal,
-                                          dropout_p=dropout, dropout_key=dk),
-                query, key, value, op_name="flash_attention")
-    else:
+    sq, sk = query.shape[1], key.shape[1]
+    use_kernel = supports(sq, sk) and (_use_pallas() or p > 0.0)
+    if use_kernel:
+        if p > 0.0:
+            seed = jax.random.randint(default_generator.next_key(), (1,),
+                                      0, 2**31 - 1, dtype=jnp.int32)
+        else:
+            seed = None
         out = apply_op(
-            lambda q, k, v: _sdpa_ref(q, k, v, causal=causal,
-                                      dropout_p=dropout if training else 0.0,
+            lambda q, k, v: pallas_flash(q, k, v, causal=causal,
+                                         dropout_p=p, seed=seed),
+            query, key, value, op_name="flash_attention")
+    else:
+        dk = default_generator.next_key() if p > 0.0 else None
+        out = apply_op(
+            lambda q, k, v: _sdpa_ref(q, k, v, causal=causal, dropout_p=p,
                                       dropout_key=dk),
             query, key, value, op_name="flash_attention")
-    if return_softmax:
-        return out, None
     return out, None
 
 

@@ -1,0 +1,472 @@
+"""GQA-native flash attention, forward + backward, as Pallas TPU kernels.
+
+This is the framework's own flash kernel (replacing the stock
+``jax.experimental.pallas.ops.tpu.flash_attention`` routing of round 1).
+Reference analog: ``phi/kernels/gpu/flash_attn_kernel.cu:213`` (fwd) and
+``flash_attn_grad_kernel.cu`` (bwd) which dynload libflashattn; here the
+same online-softmax tiling is expressed for the MXU/VMEM machine model.
+
+Design points (and why they differ from the stock JAX kernel):
+
+- **Compact residuals.** The only saved values are the output and a
+  log-sum-exp per row stored as ``[B, H, S]`` fp32.  The stock kernel keeps
+  separate ``m``/``l`` tensors padded to a 128-lane trailing dim —
+  ``f32[B, H, S, 128]`` each — which is exactly the HLO-temp blow-up that
+  OOMed round 1's benchmark.
+- **GQA in the index maps.** Q may have ``Hq = G * Hkv`` heads; K/V blocks
+  are selected with ``h // G`` so grouped heads share KV *without*
+  materialising ``jnp.repeat``-ed keys (the reference handles GQA inside
+  libflashattn the same way).
+- **In-kernel dropout.** A counter-based hash RNG (murmur3 finalizer over
+  ``(seed, batch, head, q, k)``) generates the keep-mask inside the kernel,
+  identically in forward and both backward kernels, so dropout costs no
+  extra memory and no second attention pass.  (``pltpu.prng_*`` is not used
+  because it has no interpret-mode lowering — the hash runs everywhere.)
+- **Bottom-right causal alignment**: query ``i`` attends keys
+  ``<= i + (Sk - Sq)`` — the decode-with-KV-cache convention used across
+  this repo (see ``ops/pallas.py::_chunked_attention``).  Fully-masked
+  blocks are skipped via ``pl.when``.
+
+Layout: ``[B, H, S, D]`` (callers transpose from paddle's ``[B, S, H, D]``).
+fp32 accumulation throughout; bf16 in/out supported.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu memory spaces; interpret mode needs pl only
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+    _SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+    _SMEM_SPEC = None
+
+__all__ = ["flash_attention_bhsd", "supports"]
+
+_NEG_INF = -1e30  # large-negative mask value; avoids inf-inf NaNs
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _pick_block(s: int, target: int, interpret: bool) -> Optional[int]:
+    """Largest divisor of s that is <= target and (on real TPU) a multiple
+    of 128 sublanes; None if no usable block exists."""
+    if interpret:
+        b = min(s, target)
+        while s % b:
+            b -= 1
+        return b
+    for b in (target, 512, 256, 128):
+        if b <= target and s % b == 0:
+            return b
+    return None
+
+
+def supports(sq: int, sk: int, interpret: Optional[bool] = None) -> bool:
+    """Whether the Pallas kernel can handle these sequence lengths."""
+    it = _interpret() if interpret is None else interpret
+    return (_pick_block(sq, 512, it) is not None
+            and _pick_block(sk, 512, it) is not None)
+
+
+# ---------------------------------------------------------------------------
+# Counter-based RNG for dropout (murmur3 finalizer)
+# ---------------------------------------------------------------------------
+
+
+def _mix(x):
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _keep_mask(seed, b, h, q0, k0, bq, bk, dropout_p):
+    """Boolean keep-mask for the (bq, bk) score block whose top-left element
+    is global (q0, k0). Deterministic in (seed, b, h, global q, global k)."""
+    s0 = _mix(seed.astype(jnp.uint32)
+              ^ (b.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+              ^ (h.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)))
+    qi = (q0.astype(jnp.uint32)
+          + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0))
+    ki = (k0.astype(jnp.uint32)
+          + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1))
+    bits = _mix(_mix(qi + s0) ^ ki)
+    thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return bits >= thresh  # P(keep) = 1 - dropout_p
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, sm_scale, causal, dropout_p,
+                offset, block_q, block_k):
+    b, h, iq, ik = (pl.program_id(i) for i in range(4))
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0, 0]                      # (bq, D)
+        k = k_ref[0, 0]                      # (bk, D)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = (iq * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0))
+            kpos = (ik * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1))
+            valid = kpos <= qpos + offset
+            s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_ref[...]                  # (bq, 128), cols identical
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)      # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)              # (bq, 128)
+        p = jnp.exp(s - m_new[:, 0:1])
+        if causal:
+            p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)                 # (bq, 128)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0], b, h, iq * block_q, ik * block_k,
+                              block_q, block_k, dropout_p)
+            # l accumulates UNdropped p (softmax normalizer is exact); only
+            # the value contraction sees the mask, pre-scaled by 1/(1-p)
+            pv = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
+        else:
+            pv = p
+        acc_ref[...] = (acc_ref[...] * alpha[:, 0:1]
+                        + jax.lax.dot_general(
+                            pv.astype(v.dtype), v,
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    if causal:
+        needed = ik * block_k <= iq * block_q + block_q - 1 + offset
+        pl.when(needed)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0:1] + jnp.log(l_safe)  # (bq, 1)
+
+
+def _fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p, block_q, block_k,
+              interpret):
+    bsz, hq, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    group = hq // hkv
+    bq = _pick_block(sq, block_q, interpret)
+    bk = _pick_block(sk, block_k, interpret)
+    nq, nk = sq // bq, sk // bk
+    offset = sk - sq
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          dropout_p=dropout_p, offset=offset,
+                          block_q=bq, block_k=bk),
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((bsz, hq, sq, 1), jnp.float32)],
+        grid=(bsz, hq, nq, nk),
+        in_specs=[
+            _SMEM_SPEC,
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        scratch_shapes=[
+            _VMEM((bq, d), jnp.float32),
+            _VMEM((bq, 128), jnp.float32),
+            _VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed, q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, sm_scale, causal, dropout_p, offset,
+                   block_q, block_k):
+    b, h, iq, ik = (pl.program_id(i) for i in range(4))
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                             # (bq, 1)
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = (iq * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0))
+            kpos = (ik * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1))
+            valid = kpos <= qpos + offset
+            s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse)                            # normalized probs
+        if causal:
+            p = jnp.where(valid, p, 0.0)
+        dpd = jax.lax.dot_general(                      # dO @ V^T
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0], b, h, iq * block_q, ik * block_k,
+                              block_q, block_k, dropout_p)
+            pd = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
+            ds = pd * dpd - p * delta
+        else:
+            ds = p * (dpd - delta)
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    if causal:
+        needed = ik * block_k <= iq * block_q + block_q - 1 + offset
+        pl.when(needed)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                    dropout_p, offset, block_q, block_k, group):
+    b, hkv, ik, g, iq = (pl.program_id(i) for i in range(5))
+    nq = pl.num_programs(4)
+    h = hkv * group + g
+
+    @pl.when((g == 0) & (iq == 0))
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                             # (bq, 1)
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = (iq * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0))
+            kpos = (ik * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1))
+            valid = kpos <= qpos + offset
+            s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        if causal:
+            p = jnp.where(valid, p, 0.0)
+        dpd = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0], b, h, iq * block_q, ik * block_k,
+                              block_q, block_k, dropout_p)
+            pd = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
+            ds = pd * dpd - p * delta
+        else:
+            pd = p
+            ds = p * (dpd - delta)
+        dv_acc[...] += jax.lax.dot_general(             # P_drop^T @ dO
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[...] += jax.lax.dot_general(             # dS^T @ Q
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    if causal:
+        needed = ik * block_k <= iq * block_q + block_q - 1 + offset
+        pl.when(needed)(_compute)
+    else:
+        _compute()
+
+    @pl.when((g == group - 1) & (iq == nq - 1))
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, seed, out, lse, do, causal, sm_scale, dropout_p,
+              block_q, block_k, interpret):
+    bsz, hq, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    group = hq // hkv
+    bq = _pick_block(sq, block_q, interpret)
+    bk = _pick_block(sk, block_k, interpret)
+    nq, nk = sq // bq, sk // bk
+    offset = sk - sq
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)             # [B, Hq, Sq, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          dropout_p=dropout_p, offset=offset,
+                          block_q=bq, block_k=bk),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bsz, hq, nq, nk),
+        in_specs=[
+            _SMEM_SPEC,
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+        scratch_shapes=[_VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(seed, q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          dropout_p=dropout_p, offset=offset,
+                          block_q=bq, block_k=bk, group=group),
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        grid=(bsz, hkv, nk, group, nq),
+        in_specs=[
+            _SMEM_SPEC,
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda b, hk, j, g, i, G=group: (b, hk * G + g, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, hk, j, g, i: (b, hk, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, hk, j, g, i: (b, hk, j, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda b, hk, j, g, i, G=group: (b, hk * G + g, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda b, hk, j, g, i, G=group: (b, hk * G + g, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda b, hk, j, g, i, G=group: (b, hk * G + g, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b, hk, j, g, i: (b, hk, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, hk, j, g, i: (b, hk, j, 0)),
+        ],
+        scratch_shapes=[_VMEM((bk, d), jnp.float32),
+                        _VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(seed, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, seed, causal, sm_scale, dropout_p, block_q, block_k,
+           interpret):
+    out, _ = _fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p,
+                       block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, seed, causal, sm_scale, dropout_p, block_q, block_k,
+               interpret):
+    out, lse = _fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p,
+                         block_q, block_k, interpret)
+    return out, (q, k, v, seed, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, dropout_p, block_q, block_k, interpret,
+               res, do):
+    q, k, v, seed, out, lse = res
+    dq, dk, dv = _bwd_impl(q, k, v, seed, out, lse, do, causal, sm_scale,
+                           dropout_p, block_q, block_k, interpret)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_bhsd(q, k, v, *, causal: bool = False,
+                         sm_scale: Optional[float] = None,
+                         dropout_p: float = 0.0, seed=None,
+                         block_q: int = 512, block_k: int = 512,
+                         interpret: Optional[bool] = None):
+    """Flash attention over ``[B, H, S, D]`` tensors (GQA allowed: K/V may
+    have ``Hq / G`` heads). Differentiable; bwd recomputes attention from
+    the saved ``[B, H, S]`` fp32 log-sum-exp.
+
+    ``dropout_p`` applies attention-probability dropout inside the kernel,
+    seeded by ``seed`` (int32 scalar/array); the same mask is regenerated in
+    the backward kernels.
+    """
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq % hkv:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    it = _interpret() if interpret is None else interpret
+    if not supports(q.shape[2], k.shape[2], it):
+        raise ValueError(
+            f"unsupported seq lens ({q.shape[2]}, {k.shape[2]}) — caller "
+            "should fall back to the chunked XLA path")
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    return _flash(q, k, v, seed, causal, float(sm_scale), float(dropout_p),
+                  block_q, block_k, it)

@@ -83,25 +83,39 @@ def _chunked_attention(q, k, v, causal: bool, sm_scale: float,
     return out.astype(q.dtype)
 
 
-def flash_attention(q, k, v, causal: bool = False, sm_scale: float = None):
-    """[B, S, H, D] paddle layout. TPU: JAX's Pallas flash-attention kernel
-    (reference analog: phi/kernels/gpu/flash_attn_kernel.cu:213).
-    Elsewhere: chunked online-softmax XLA fallback."""
+def flash_attention(q, k, v, causal: bool = False, sm_scale: float = None,
+                    dropout_p: float = 0.0, seed=None):
+    """[B, S, H, D] paddle layout; GQA allowed (K/V may carry fewer heads).
+
+    TPU: this framework's own Pallas flash kernel
+    (ops/flash_attention_kernel.py — reference analog:
+    phi/kernels/gpu/flash_attn_kernel.cu:213) with bottom-right causal
+    alignment, grouped KV in the index maps, and in-kernel dropout.
+    Unsupported shapes / non-TPU: chunked online-softmax XLA fallback
+    (dropout not available there — callers route dropout elsewhere).
+    """
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    # The TPU Pallas kernel's causal mask is TOP-LEFT aligned (col <= row);
-    # our convention (matching _sdpa_ref and the chunked fallback) is
-    # BOTTOM-RIGHT (decode-with-KV-cache). They agree iff sq == sk, so only
-    # route the square case to the kernel.
-    if _on_tpu() and (not causal or q.shape[1] == k.shape[1]):
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention as _fa)
+    from .flash_attention_kernel import flash_attention_bhsd, supports
 
-        out = _fa(qt, kt, vt, causal=causal, sm_scale=scale)
+    # off-TPU the kernel runs in interpret mode (~17x slower than the XLA
+    # fallback) — only worth it when in-kernel dropout semantics are needed
+    use_kernel = supports(qt.shape[2], kt.shape[2]) and (
+        _on_tpu() or dropout_p > 0.0)
+    if use_kernel:
+        out = flash_attention_bhsd(qt, kt, vt, causal=causal, sm_scale=scale,
+                                   dropout_p=dropout_p, seed=seed)
     else:
+        if dropout_p > 0.0:
+            raise ValueError("dropout requires the Pallas kernel path "
+                             "(seq lens must be block-divisible)")
+        if kt.shape[1] != qt.shape[1]:  # GQA fallback: materialize groups
+            rep = qt.shape[1] // kt.shape[1]
+            kt = jnp.repeat(kt, rep, axis=1)
+            vt = jnp.repeat(vt, rep, axis=1)
         out = _chunked_attention(qt, kt, vt, causal, scale)
     return jnp.swapaxes(out, 1, 2)
 

@@ -25,13 +25,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # pltpu only resolves fully on TPU builds; interpret mode needs pl only
-    from jax.experimental.pallas import tpu as pltpu
+# pltpu ships with every jax build (memory-space enums and scratch shapes
+# work under interpret mode too) — import unconditionally so kernels can use
+# SMEM operands and VMEM scratch without per-call-site fallbacks
+from jax.experimental.pallas import tpu as pltpu
 
-    _VMEM = pltpu.VMEM
-except Exception:  # pragma: no cover
-    pltpu = None
-    _VMEM = None
+_VMEM = pltpu.VMEM
 
 __all__ = ["rms_norm", "fused_layer_norm", "fused_rope", "decode_mha",
            "fused_linear_param_grad_add"]
@@ -286,22 +285,54 @@ def fused_rope(x, cos, sin):
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, scale, s_max):
-    # blocks: q [1, H, D], k/v [1, S, H, D], len [1]
-    q = q_ref[0].astype(jnp.float32)            # [H, D]
-    k = k_ref[0].astype(jnp.float32)            # [S, H, D]
-    v = v_ref[0].astype(jnp.float32)
-    ln = len_ref[0]
-    s = jnp.einsum("hd,shd->hs", q, k) * scale  # [H, S]
-    pos = jax.lax.broadcasted_iota(jnp.int32, (1, s_max), 1)
-    mask = pos < ln
-    s = jnp.where(mask, s, -jnp.inf)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    p = jnp.where(mask, p, 0.0)
-    p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
-    o = jnp.einsum("hs,shd->hd", p, v)
-    o_ref[0] = o.astype(o_ref.dtype)
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, scale, block_s):
+    """Online-softmax decode step over one S-block of the KV cache.
+
+    Grid (B, nS) — S innermost, accumulated in VMEM scratch so arbitrarily
+    long caches stream through a bounded working set (round-1 version loaded
+    the whole [S, H, D] slab per batch row and spilled at 7B+ shapes).
+    """
+    ib, js = pl.program_id(0), pl.program_id(1)
+    ns = pl.num_programs(1)
+
+    @pl.when(js == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ln = len_ref[ib]
+
+    # skip blocks entirely past the valid length
+    @pl.when(js * block_s < ln)
+    def _compute():
+        # decode is HBM-bandwidth-bound: all math is VPU-shaped (no batched
+        # dots), keeping the cache streaming at full rate. Layout (bs, H):
+        # per-head softmax reduces over sublanes, heads stay in lanes.
+        q = q_ref[0].astype(jnp.float32)            # [H, D]
+        k = k_ref[0].astype(jnp.float32)            # [bs, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.sum(q[None] * k, axis=-1) * scale   # [bs, H]
+        pos = js * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (block_s, 1), 0)
+        mask = pos < ln                             # [bs, 1]
+        s = jnp.where(mask, s, -1e30)
+        m_prev = m_ref[...]                         # [1, H]
+        m_cur = jnp.max(s, axis=0, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                      # [bs, H]
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)             # [1, H]
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=0, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = (acc_ref[...] * jnp.transpose(alpha)
+                        + jnp.sum(p[:, :, None] * v, axis=0))  # [H, D]
+
+    @pl.when(js == ns - 1)
+    def _finalize():
+        l_safe = jnp.maximum(jnp.transpose(l_ref[...]), 1e-30)  # [H, 1]
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
 @jax.jit
@@ -311,24 +342,32 @@ def decode_mha(q, k_cache, v_cache, seq_lens):
 
     q: [B, H, D] (this step's query) — k/v_cache: [B, S, H, D] — seq_lens:
     [B] valid lengths (the new token's k/v must already be written at
-    position seq_lens-1). Returns [B, H, D].
+    position seq_lens-1). Returns [B, H, D]. The cache streams through VMEM
+    in S-blocks with online-softmax accumulation (flash recurrence), so
+    S is bounded by HBM, not VMEM.
     """
     b_, h_, d_ = q.shape
     s_max = k_cache.shape[1]
     scale = 1.0 / math.sqrt(d_)
+    bs = _row_block(s_max, 512)
     return pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, s_max=s_max),
+        functools.partial(_decode_kernel, scale=scale, block_s=bs),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        grid=(b_,),
+        grid=(b_, s_max // bs),
         in_specs=[
-            pl.BlockSpec((1, h_, d_), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, s_max, h_, d_), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, s_max, h_, d_), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1,), lambda i: (i,)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, h_, d_), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bs, h_, d_), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, bs, h_, d_), lambda i, j: (i, j, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, h_, d_), lambda i: (i, 0, 0)),
+        out_specs=pl.BlockSpec((1, h_, d_), lambda i, j: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h_, d_), jnp.float32),
+            pltpu.VMEM((1, h_), jnp.float32),
+            pltpu.VMEM((1, h_), jnp.float32),
+        ],
         interpret=_interpret(),
-    )(q, k_cache, v_cache, seq_lens)
+    )(seq_lens, q, k_cache, v_cache)
 
 
 # ---------------------------------------------------------------------------
@@ -336,29 +375,56 @@ def decode_mha(q, k_cache, v_cache, seq_lens):
 # ---------------------------------------------------------------------------
 
 
-def _grad_add_kernel(x_ref, dy_ref, acc_ref, o_ref):
-    x = x_ref[...].astype(jnp.float32)
-    dy = dy_ref[...].astype(jnp.float32)
-    o_ref[...] = acc_ref[...] + jax.lax.dot_general(
+def _grad_add_kernel(x_ref, dy_ref, dw_ref, o_ref, acc_ref):
+    """One (K-block, N-block) output tile accumulated over T-blocks.
+
+    Grid (nK, nN, nT) — T innermost; the fp32 accumulator lives in VMEM
+    scratch, the prior dweight value is folded in at the first T step, and
+    the tile is written once at the last (round-1 version mapped whole
+    operands into VMEM with no grid and spilled at 4096x11008 fp32)."""
+    it = pl.program_id(2)
+
+    @pl.when(it == 0)
+    def _init():
+        acc_ref[...] = dw_ref[...]
+
+    x = x_ref[...]
+    dy = dy_ref[...]
+    acc_ref[...] += jax.lax.dot_general(
         x, dy, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
+
+    @pl.when(it == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[...] = acc_ref[...]
 
 
 @jax.jit
 def fused_linear_param_grad_add(x, dy, dweight):
     """dweight(fp32) += xᵀ @ dy — the reference's main-grad accumulation
     kernel (fused_linear_param_grad_add_kernel.cu): bf16 activations/grad,
-    fp32 accumulator, single fused pass, aliased in-place output."""
-    t = int(jnp.shape(x)[0]) if x.ndim == 2 else -1
+    fp32 accumulator, single fused pass, aliased in-place output. Tiled
+    over (K, N, T) so 7B-scale weights (e.g. 4096x11008) accumulate through
+    a bounded VMEM working set."""
     x2 = x.reshape(-1, x.shape[-1])
     dy2 = dy.reshape(-1, dy.shape[-1])
+    kdim, ndim = dweight.shape
+    tdim = x2.shape[0]
+    bk = _row_block(kdim, 512)
+    bn = _row_block(ndim, 512)
+    bt = _row_block(tdim, 512)
+    dw32 = dweight.astype(jnp.float32)
     return pl.pallas_call(
         _grad_add_kernel,
         out_shape=jax.ShapeDtypeStruct(dweight.shape, jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=_VMEM) if _VMEM else None,
-                  pl.BlockSpec(memory_space=_VMEM) if _VMEM else None,
-                  pl.BlockSpec(memory_space=_VMEM) if _VMEM else None],
-        out_specs=pl.BlockSpec(memory_space=_VMEM) if _VMEM else None,
+        grid=(kdim // bk, ndim // bn, tdim // bt),
+        in_specs=[
+            pl.BlockSpec((bt, bk), lambda i, j, t: (t, i)),
+            pl.BlockSpec((bt, bn), lambda i, j, t: (t, j)),
+            pl.BlockSpec((bk, bn), lambda i, j, t: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((bk, bn), lambda i, j, t: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
         input_output_aliases={2: 0},
         interpret=_interpret(),
-    )(x2, dy2, dweight.astype(jnp.float32))
+    )(x2, dy2, dw32)
