@@ -46,7 +46,16 @@ def _stage_caller(pipe, stage_idx):
     """Pure fn: (params_dict, x) -> stage output, running the stage's layers
     eagerly under trace via substituted_state (the functional_call pattern)."""
     idxs = pipe.stage_layer_indices(stage_idx)
+    return _layers_caller(pipe, idxs)
 
+
+def _chunk_caller(pipe, chunk):
+    """Pure fn for ONE virtual-stage chunk (global chunk index = virtual
+    position p; chunk p lives on device p % num_stages)."""
+    return _layers_caller(pipe, pipe.chunk_layer_indices(chunk))
+
+
+def _layers_caller(pipe, idxs):
     def run(params, x):
         from ....core.autograd import no_grad
 
@@ -71,34 +80,32 @@ def build_pipeline_loss_fn(pipe, accumulate_steps: int,
     """
     if pipe._loss_fn is None:
         raise ValueError("PipelineLayer needs loss_fn for the pipeline step")
-    if pipe.get_num_virtual_stages() > 1:
-        # interleaved virtual chunks need a chunk-hopping schedule (stage s
-        # runs chunk c, activations revisit stages); _stage_caller's
-        # contiguous per-stage composition would compute the WRONG function
-        raise NotImplementedError(
-            "compiled pipeline does not support interleaved virtual stages "
-            "yet — use num_virtual_pipeline_stages=1 or the eager schedule")
     mesh = mesh or get_mesh()
     S = int(mesh.shape.get("pp", 1))
     M = int(accumulate_steps)
+    V = int(pipe.get_num_virtual_stages())
     loss_fn = pipe._loss_fn
     if S > 1 and S != pipe.num_stages:
         raise ValueError(
             f"mesh pp axis has {S} devices but PipelineLayer was segmented "
             f"into {pipe.num_stages} stages — rebuild one of them")
-    # S==1 (no/absent pp axis): run ALL segmented stages serially, not just
-    # stage 0 — the model is the composition of every stage
-    n_exec = pipe.num_stages if S == 1 else S
-    stage_fns = [_stage_caller(pipe, s) for s in range(n_exec)]
+    if S > 1 and V > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs accumulate_steps ({M}) divisible "
+            f"by the number of stages ({S})")
 
     def serial_loss(params, inputs, labels):
-        # S==1 or no pp axis: plain microbatch accumulation (still scanned
-        # so grad-accum memory matches the pipelined path)
+        # S==1 (no/absent pp axis): run every chunk serially — the model is
+        # the composition of all virtual stages. Scanned so grad-accum
+        # memory matches the pipelined path.
+        n_chunks = pipe.total_chunks
+        fns = [_chunk_caller(pipe, c) for c in range(n_chunks)]
+
         def micro(carry, xy):
             x, y = xy
             h = x
-            for s in range(n_exec):
-                h = stage_fns[s](params, h)
+            for c in range(n_chunks):
+                h = fns[c](params, h)
             l = _to_val(loss_fn(Tensor(h), Tensor(y)))
             return carry + jnp.mean(l), None
 
@@ -110,53 +117,60 @@ def build_pipeline_loss_fn(pipe, accumulate_steps: int,
     if S == 1:
         return serial_loss
 
+    # Virtual stage p = k·S + s (chunk k of device s); micro-step i runs at
+    # tick t = i + s with k = (i mod L)//S, m = (i//L)·S + (i mod S). The
+    # modular ring ppermute delivers device S-1's chunk-k output to device
+    # 0's chunk-k+1 exactly one tick before consumption (see the 1F1B
+    # docstring for the algebra); V == 1 degenerates to the classic
+    # wavefront with m = i.
+    L = S * V
+    NF = M * V
+    chunk_fns = [_chunk_caller(pipe, p) for p in range(L)]
+
     def pipelined_loss(params, inputs, labels):
         mb = inputs.shape[0] // M
         xs = jnp.reshape(inputs, (M, mb) + inputs.shape[1:])
         ys = jnp.reshape(labels, (M, mb) + labels.shape[1:])
-
-        # static activation shape: output aval of stage 0 on one microbatch
         h_aval = jax.eval_shape(
-            lambda p, x: stage_fns[0](p, x), params,
+            lambda p, x: chunk_fns[0](p, x), params,
             jax.ShapeDtypeStruct((mb,) + inputs.shape[1:], inputs.dtype))
 
         def worker(params, xs, ys):
             s = lax.axis_index("pp")
-            T = M + S - 1  # wavefront ticks
-            perm = [(i, i + 1) for i in range(S - 1)]
+            perm = [(i, (i + 1) % S) for i in range(S)]
 
-            def branch(b):
-                fn = stage_fns[b]
-                is_last = b == S - 1
+            def branch(p):
+                fn = chunk_fns[p]
+                first = p == 0
+                last = p == L - 1
 
-                def go(x_in, h_recv, y_t):
-                    inp = x_in if b == 0 else h_recv
-                    out = fn(params, inp)
-                    if is_last:
+                def go(x_raw, h_recv, y_t):
+                    out = fn(params, x_raw if first else h_recv)
+                    if last:
                         l = _to_val(loss_fn(Tensor(out), Tensor(y_t)))
-                        return jnp.zeros(h_aval.shape, h_aval.dtype), jnp.mean(l).astype(jnp.float32)
+                        return (jnp.zeros(h_aval.shape, h_aval.dtype),
+                                jnp.mean(l).astype(jnp.float32))
                     return out.astype(h_aval.dtype), jnp.zeros((), jnp.float32)
 
                 return go if not remat else jax.checkpoint(go)
 
-            branches = [branch(b) for b in range(S)]
+            branches = [branch(p) for p in range(L)]
 
             def tick(carry, t):
                 h_recv, acc = carry
-                # stage s works on microbatch m = t - s when 0 <= m < M
-                m = t - s
-                valid = jnp.logical_and(m >= 0, m < M)
-                mi = jnp.clip(m, 0, M - 1)
-                x_t = xs[mi]
-                y_t = ys[mi]
-                h_out, l = lax.switch(s, branches, x_t, h_recv, y_t)
+                i = t - s
+                valid = jnp.logical_and(i >= 0, i < NF)
+                ic = jnp.clip(i, 0, NF - 1)
+                p = ((ic % L) // S) * S + s
+                m = (ic // L) * S + ic % S
+                h_out, l = lax.switch(p, branches, xs[m], h_recv, ys[m])
                 acc = acc + jnp.where(valid, l, 0.0)
                 h_next = lax.ppermute(h_out, "pp", perm)
                 return (h_next, acc), None
 
             carry0 = (jnp.zeros(h_aval.shape, h_aval.dtype),
                       jnp.zeros((), jnp.float32))
-            (_, acc), _ = lax.scan(tick, carry0, jnp.arange(M + S - 1))
+            (_, acc), _ = lax.scan(tick, carry0, jnp.arange(NF + S - 1))
             # only the last stage accumulated loss; psum broadcasts it
             return lax.psum(acc, "pp")
 
@@ -175,23 +189,205 @@ def build_pipeline_loss_fn(pipe, accumulate_steps: int,
     return pipelined_loss
 
 
+def build_pipeline_1f1b_grad_fn(pipe, accumulate_steps: int,
+                                mesh: Optional[Mesh] = None) -> Callable:
+    """Returns ``grad_fn(params, inputs, labels) -> (loss, grads)`` running a
+    TRUE 1F1B schedule — with interleaved virtual stages when the
+    PipelineLayer was built with ``num_virtual_pipeline_stages > 1``.
+
+    Reference: 1F1B steady state at pipeline_parallel.py:430-480; interleave
+    at :804 with the micro-step→chunk mapping of ``_get_virtual_pp_rank``
+    (:890). Unlike :func:`build_pipeline_loss_fn` (whose ``jax.grad``
+    transpose replays ALL forward ticks before any backward — the GPipe
+    memory profile, activations for all M microbatches live at the peak),
+    this schedule interleaves one backward per forward tick and keeps only a
+    stash of stage-INPUT activations bounded by the pipeline depth
+    (``2·S + 4`` slots per chunk, independent of M); stage interiors are
+    rematerialised by per-tick ``jax.vjp``.
+
+    Schedule algebra (V chunks per device, L = S·V virtual stages, chunk k
+    of device s is virtual stage p = k·S + s):
+    - forward micro-step i runs at tick t = i + s with chunk
+      k = (i mod L)//S and microbatch m = (i//L)·S + (i mod S); the
+      ``ppermute`` ring (i → i+1 mod S) delivers each activation exactly one
+      tick before its consumer reaches it (device S-1's chunk-k output IS
+      device 0's chunk-k+1 input) — no deep buffering.
+    - backward micro-step j runs at tick t = j + L + S − 2 − s with chunk
+      k_b = V−1−(j mod L)//S, mirrored over the reverse ring; its cotangent
+      seed for the last virtual stage comes from the loss VJP in the same
+      tick, so backward ticks start the moment microbatch 0 finishes.
+    """
+    if pipe._loss_fn is None:
+        raise ValueError("PipelineLayer needs loss_fn for the pipeline step")
+    mesh = mesh or get_mesh()
+    S = int(mesh.shape.get("pp", 1))
+    M = int(accumulate_steps)
+    V = int(pipe.get_num_virtual_stages())
+    loss_fn = pipe._loss_fn
+
+    if S == 1:
+        serial = build_pipeline_loss_fn(pipe, M, mesh)
+        return jax.value_and_grad(serial)
+
+    if S != pipe.num_stages:
+        raise ValueError(
+            f"mesh pp axis has {S} devices but PipelineLayer was segmented "
+            f"into {pipe.num_stages} stages — rebuild one of them")
+    if V > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs accumulate_steps ({M}) divisible "
+            f"by the number of stages ({S})")
+    L = S * V
+    NF = M * V                # fwd micro-steps per device
+    G = 2 * S + 4             # stash slots per chunk (≥ max in-flight ≈ 2S)
+    chunk_fns = [_chunk_caller(pipe, p) for p in range(L)]
+
+    def grad_fn(params, inputs, labels):
+        mb = inputs.shape[0] // M
+        xs = jnp.reshape(inputs, (M, mb) + inputs.shape[1:])
+        ys = jnp.reshape(labels, (M, mb) + labels.shape[1:])
+        h_aval = jax.eval_shape(
+            lambda p, x: chunk_fns[0](p, x), params,
+            jax.ShapeDtypeStruct((mb,) + inputs.shape[1:], inputs.dtype))
+        # Per-microbatch RNG base: the forward lax.switch trace and the
+        # backward jax.vjp re-trace each run under trace_key_scope(fold_in
+        # (base, m)), so trace-time draws (F.dropout, flash-attn seeds) land
+        # on IDENTICAL keys for the same microbatch — without this the remat
+        # would apply different dropout masks in forward and backward.
+        # (base is concrete at trace time: under a jitted train step masks
+        # repeat across steps; the grads stay exactly consistent with the
+        # loss either way.)
+        from ....core.random import default_generator
+
+        base_key = default_generator.next_key()
+
+        def worker(params, xs, ys):
+            s = lax.axis_index("pp")
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+            T = NF + L + S - 2
+
+            def branch(p):
+                fn = chunk_fns[p]
+                first = p == 0
+                last = p == L - 1
+
+                def go(prm, x_raw, h_in, y):
+                    out = fn(prm, x_raw if first else h_in)
+                    if last:
+                        l = jnp.mean(_to_val(loss_fn(Tensor(out), Tensor(y))))
+                        return (jnp.zeros(h_aval.shape, h_aval.dtype),
+                                l.astype(jnp.float32))
+                    return out.astype(h_aval.dtype), jnp.zeros((), jnp.float32)
+
+                return go
+
+            branches = [branch(p) for p in range(L)]
+
+            def tick(carry, t):
+                h_recv, g_recv, stash, grads, lacc = carry
+                # ---------- forward part ----------
+                from ....core.random import trace_key_scope
+
+                i = t - s
+                fvalid = jnp.logical_and(i >= 0, i < NF)
+                ic = jnp.clip(i, 0, NF - 1)
+                k = (ic % L) // S
+                p = k * S + s
+                m = (ic // L) * S + ic % S
+                with trace_key_scope(jax.random.fold_in(base_key, m)):
+                    h_out, _ = lax.switch(p, branches, params, xs[m],
+                                          h_recv, ys[m])
+                # stash this micro-step's INPUT for its backward remat (the
+                # p==0 branch reads xs directly, so its slot is dead weight)
+                stash = lax.cond(
+                    fvalid,
+                    lambda st: st.at[k, m % G].set(
+                        h_recv.astype(h_aval.dtype)),
+                    lambda st: st, stash)
+
+                # ---------- backward part ----------
+                j = t - (L + S - 2 - s)
+                bvalid = jnp.logical_and(j >= 0, j < NF)
+                jc = jnp.clip(j, 0, NF - 1)
+                kb = V - 1 - (jc % L) // S
+                pb = kb * S + s
+                m_b = (jc // L) * S + jc % S
+                x_b = stash[kb, m_b % G]
+
+                def f(prm, h_in):
+                    with trace_key_scope(jax.random.fold_in(base_key, m_b)):
+                        return lax.switch(pb, branches, prm, xs[m_b], h_in,
+                                          ys[m_b])
+
+                (_, l_b), vjp = jax.vjp(f, params, x_b)
+                bmask = bvalid.astype(jnp.float32)
+                seed = (g_recv * bmask.astype(h_aval.dtype), bmask)
+                gp, gx = vjp(seed)          # linear in seed → zero when invalid
+                grads2 = jax.tree.map(jnp.add, grads, gp)
+                lacc = lacc + jnp.where(bvalid, l_b, 0.0)
+
+                h_next = lax.ppermute(h_out, "pp", fwd_perm)
+                g_next = lax.ppermute(gx, "pp", bwd_perm)
+                return (h_next, g_next, stash, grads2, lacc), None
+
+            carry0 = (
+                jnp.zeros(h_aval.shape, h_aval.dtype),
+                jnp.zeros(h_aval.shape, h_aval.dtype),
+                jnp.zeros((V, G) + h_aval.shape, h_aval.dtype),
+                jax.tree.map(lambda x: jnp.zeros(x.shape, x.dtype), params),
+                jnp.zeros((), jnp.float32),
+            )
+            (_, _, _, grads, lacc), _ = lax.scan(tick, carry0, jnp.arange(T))
+            # loss lives on the last device; per-stage param grads are zero
+            # elsewhere — psum assembles both (replicated-param contract)
+            grads = jax.tree.map(lambda g: lax.psum(g, "pp"), grads)
+            return lax.psum(lacc, "pp") / M, jax.tree.map(
+                lambda g: g / M, grads)
+
+        from jax import shard_map
+
+        fn = shard_map(
+            worker, mesh=mesh,
+            in_specs=(P(), P(), P()),
+            out_specs=(P(), P()),
+            axis_names={"pp"},
+            check_vma=False)
+        return fn(params, xs, ys)
+
+    return grad_fn
+
+
 def build_pipeline_train_step(pipe, accumulate_steps: int,
                               mesh: Optional[Mesh] = None,
                               lr: float = 1e-3,
                               optimizer: str = "adamw",
                               remat: bool = False,
-                              donate: bool = True):
-    """Full jitted PP train step: pipelined forward, backward (the reverse
-    wavefront, via grad-of-ppermute), optimizer update. Returns
-    ``(step, init)``:
+                              donate: bool = True,
+                              schedule: str = "1f1b"):
+    """Full jitted PP train step: pipelined forward + backward + optimizer
+    update. ``schedule``:
+
+    - ``"1f1b"`` (default): true one-forward-one-backward interleaving —
+      live activations bounded by pipeline depth, not microbatch count;
+      supports interleaved virtual stages.
+    - ``"gpipe"``: forward wavefront then ``jax.grad`` transpose (simpler
+      program; all-microbatch activation live range, tame with ``remat``).
+
+    Returns ``(step, init)``:
 
     - ``init(params) -> opt_state``
     - ``step(params, opt_state, inputs, labels) -> (params, opt_state, loss)``
     """
     from ....optimizer.functional import adamw_init, adamw_update, sgd_update
 
-    loss_fn = build_pipeline_loss_fn(pipe, accumulate_steps, mesh, remat)
-    grad_fn = jax.value_and_grad(loss_fn)
+    if schedule == "1f1b":
+        grad_fn = build_pipeline_1f1b_grad_fn(pipe, accumulate_steps, mesh)
+    elif schedule == "gpipe":
+        loss_fn = build_pipeline_loss_fn(pipe, accumulate_steps, mesh, remat)
+        grad_fn = jax.value_and_grad(loss_fn)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
 
     def init(params):
         if optimizer == "adamw":

@@ -171,6 +171,7 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
+            update = True
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
@@ -187,6 +188,11 @@ class Model:
                 if num_iters is not None and it >= num_iters:
                     self.stop_training = True
                     break
+            if not update:
+                # iterable loaders (no __len__) can end mid-accumulation:
+                # flush the pending grads so they don't leak into next epoch
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_loader, batch_size=batch_size,

@@ -140,11 +140,12 @@ class FusedMultiTransformer(Layer):
 
     def _attn_context(self, q, k, v, attn_mask=None):
         if attn_mask is not None:
-            # padded/variable-length batches: masked SDPA (mask composes
-            # with the causal structure, matching the reference kernel's
-            # attn_mask semantics, fused_multi_transformer_op.cu:220)
+            # the provided mask is authoritative (reference
+            # fused_multi_transformer_op.cu:220 applies only attn_mask —
+            # callers encode causality in the mask themselves; forcing
+            # causal here would break padding-only/bidirectional masks)
             return F.scaled_dot_product_attention(
-                q, k, v, attn_mask=attn_mask, is_causal=True)
+                q, k, v, attn_mask=attn_mask, is_causal=False)
         from ....ops.pallas import flash_attention
 
         return apply_op(

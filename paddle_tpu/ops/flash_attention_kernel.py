@@ -63,10 +63,9 @@ def _pick_block(s: int, target: int, interpret: bool) -> Optional[int]:
     """Largest divisor of s that is <= target and (on real TPU) a multiple
     of 128 sublanes; None if no usable block exists."""
     if interpret:
-        b = min(s, target)
-        while s % b:
-            b -= 1
-        return b
+        from .pallas_kernels import _row_block
+
+        return _row_block(s, target)
     for b in (target, 512, 256, 128):
         if b <= target and s % b == 0:
             return b
@@ -109,6 +108,37 @@ def _keep_mask(seed, b, h, q0, k0, bq, bk, dropout_p):
     return bits >= thresh  # P(keep) = 1 - dropout_p
 
 
+def _causal_valid(iq, ik, block_q, block_k, offset):
+    """Bottom-right-aligned validity for the (iq, ik) score block: query i
+    attends keys <= i + offset. Shared by fwd and both bwd kernels so the
+    alignment convention can never diverge between them."""
+    qpos = (iq * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+    kpos = (ik * block_k
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+    return kpos <= qpos + offset
+
+
+def _block_scores(q, k, sm_scale, causal, iq, ik, block_q, block_k, offset):
+    """Masked fp32 score block; returns (s, valid) with valid=None when not
+    causal."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    if not causal:
+        return s, None
+    valid = _causal_valid(iq, ik, block_q, block_k, offset)
+    return jnp.where(valid, s, _NEG_INF), valid
+
+
+def _dropped(p, seed, b, h, iq, ik, block_q, block_k, dropout_p):
+    """p with the dropout keep-mask applied and 1/(1-p) upscaling — the
+    SAME mask in fwd and both bwd kernels (hash of global coordinates)."""
+    keep = _keep_mask(seed, b, h, iq * block_q, ik * block_k,
+                      block_q, block_k, dropout_p)
+    return jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -130,18 +160,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         q = q_ref[0, 0]                      # (bq, D)
         k = k_ref[0, 0]                      # (bk, D)
         v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            qpos = (iq * block_q
-                    + jax.lax.broadcasted_iota(jnp.int32,
-                                               (block_q, block_k), 0))
-            kpos = (ik * block_k
-                    + jax.lax.broadcasted_iota(jnp.int32,
-                                               (block_q, block_k), 1))
-            valid = kpos <= qpos + offset
-            s = jnp.where(valid, s, _NEG_INF)
+        s, valid = _block_scores(q, k, sm_scale, causal, iq, ik,
+                                 block_q, block_k, offset)
         m_prev = m_ref[...]                  # (bq, 128), cols identical
         l_prev = l_ref[...]
         m_cur = jnp.max(s, axis=-1, keepdims=True)      # (bq, 1)
@@ -152,11 +172,10 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         alpha = jnp.exp(m_prev - m_new)                 # (bq, 128)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_p > 0.0:
-            keep = _keep_mask(seed_ref[0], b, h, iq * block_q, ik * block_k,
-                              block_q, block_k, dropout_p)
             # l accumulates UNdropped p (softmax normalizer is exact); only
             # the value contraction sees the mask, pre-scaled by 1/(1-p)
-            pv = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
+            pv = _dropped(p, seed_ref[0], b, h, iq, ik, block_q, block_k,
+                          dropout_p)
         else:
             pv = p
         acc_ref[...] = (acc_ref[...] * alpha[:, 0:1]
@@ -241,18 +260,8 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]                             # (bq, 1)
         delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            qpos = (iq * block_q
-                    + jax.lax.broadcasted_iota(jnp.int32,
-                                               (block_q, block_k), 0))
-            kpos = (ik * block_k
-                    + jax.lax.broadcasted_iota(jnp.int32,
-                                               (block_q, block_k), 1))
-            valid = kpos <= qpos + offset
-            s = jnp.where(valid, s, _NEG_INF)
+        s, valid = _block_scores(q, k, sm_scale, causal, iq, ik,
+                                 block_q, block_k, offset)
         p = jnp.exp(s - lse)                            # normalized probs
         if causal:
             p = jnp.where(valid, p, 0.0)
@@ -260,9 +269,8 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
-            keep = _keep_mask(seed_ref[0], b, h, iq * block_q, ik * block_k,
-                              block_q, block_k, dropout_p)
-            pd = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
+            pd = _dropped(p, seed_ref[0], b, h, iq, ik, block_q, block_k,
+                          dropout_p)
             ds = pd * dpd - p * delta
         else:
             ds = p * (dpd - delta)
@@ -300,18 +308,8 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]                             # (bq, 1)
         delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            qpos = (iq * block_q
-                    + jax.lax.broadcasted_iota(jnp.int32,
-                                               (block_q, block_k), 0))
-            kpos = (ik * block_k
-                    + jax.lax.broadcasted_iota(jnp.int32,
-                                               (block_q, block_k), 1))
-            valid = kpos <= qpos + offset
-            s = jnp.where(valid, s, _NEG_INF)
+        s, valid = _block_scores(q, k, sm_scale, causal, iq, ik,
+                                 block_q, block_k, offset)
         p = jnp.exp(s - lse)
         if causal:
             p = jnp.where(valid, p, 0.0)
@@ -319,9 +317,8 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
-            keep = _keep_mask(seed_ref[0], b, h, iq * block_q, ik * block_k,
-                              block_q, block_k, dropout_p)
-            pd = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
+            pd = _dropped(p, seed_ref[0], b, h, iq, ik, block_q, block_k,
+                          dropout_p)
             ds = pd * dpd - p * delta
         else:
             pd = p
