@@ -17,7 +17,19 @@ import numpy as np
 
 
 def main():
+    import os
+
     import jax
+
+    # persistent compile cache: bench iterations recompile a ~20-min XLA
+    # program otherwise (remote-compile helper has no cross-run cache)
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"

@@ -47,6 +47,8 @@ __version__ = "0.1.0"
 
 # Subpackages load lazily (PEP 562): paddle_tpu.nn, .optimizer, .distributed...
 _LAZY_SUBMODULES = {
+    "signal",
+    "geometric",
     "amp",
     "autograd",
     "distributed",
@@ -81,6 +83,25 @@ _LAZY_ATTRS = {
     "ParamAttr": ("paddle_tpu.nn.param_attr", "ParamAttr"),
     "get_flags": ("paddle_tpu.framework.flags", "get_flags"),
     "set_flags": ("paddle_tpu.framework.flags", "set_flags"),
+    "finfo": ("paddle_tpu.core.dtype", "finfo"),
+    "dtype": ("paddle_tpu.framework.compat", "dtype"),
+    "iinfo": ("paddle_tpu.core.dtype", "iinfo"),
+    "bool": ("paddle_tpu.core.dtype", "bool_"),
+    "CUDAPinnedPlace": ("paddle_tpu.core.place", "CUDAPinnedPlace"),
+    "batch": ("paddle_tpu.framework.compat", "batch"),
+    "LazyGuard": ("paddle_tpu.framework.compat", "LazyGuard"),
+    "check_shape": ("paddle_tpu.framework.compat", "check_shape"),
+    "disable_signal_handler": ("paddle_tpu.framework.compat",
+                               "disable_signal_handler"),
+    "set_printoptions": ("paddle_tpu.framework.compat", "set_printoptions"),
+    "tolist": ("paddle_tpu.framework.compat", "tolist"),
+    "get_cuda_rng_state": ("paddle_tpu.core.random", "get_rng_state"),
+    "set_cuda_rng_state": ("paddle_tpu.core.random", "set_rng_state"),
+    "pow_": ("paddle_tpu.framework.compat", "pow_"),
+    "scatter_": ("paddle_tpu.framework.compat", "scatter_"),
+    "squeeze_": ("paddle_tpu.framework.compat", "squeeze_"),
+    "tanh_": ("paddle_tpu.framework.compat", "tanh_"),
+    "unsqueeze_": ("paddle_tpu.framework.compat", "unsqueeze_"),
 }
 
 

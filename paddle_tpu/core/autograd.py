@@ -82,12 +82,31 @@ no_grad = _NoGrad
 enable_grad = _EnableGrad
 
 
+class _Edge:
+    """Snapshot of one producer edge at RECORD time.
+
+    Edges must capture (node, out_idx) when the op is recorded, not
+    dereference ``tensor._node`` during backward: in-place ops (``reshape_``,
+    ``tanh_`` …) REBIND the python Tensor object to the new op's node, and a
+    backward-time dereference would then see a self-edge and starve the
+    traversal. The tensor ref is kept for hooks / capture / leaf-grad
+    accumulation (identity semantics).
+    """
+
+    __slots__ = ("tensor", "node", "out_idx")
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+        self.node = tensor._node
+        self.out_idx = tensor._out_idx
+
+
 class GradNode:
     """One recorded op in the tape (≙ reference GradNodeBase, grad_node_info.h:168).
 
-    Holds the vjp pullback, strong refs to parent Tensors (keeps the graph
-    alive the way TensorWrapper does), and the output structure needed to
-    assemble cotangents.
+    Holds the vjp pullback, strong refs to parent Tensors via edge snapshots
+    (keeps the graph alive the way TensorWrapper does), and the output
+    structure needed to assemble cotangents.
     """
 
     __slots__ = (
@@ -101,7 +120,10 @@ class GradNode:
 
     def __init__(self, vjp_fn, parents, out_treedef, out_avals, name=""):
         self.vjp_fn = vjp_fn
-        self.parents = parents  # list[Tensor], order matches vjp cotangent outputs
+        # list[_Edge], order matches vjp cotangent outputs; producer node and
+        # slot are frozen here (record time)
+        self.parents = [p if isinstance(p, _Edge) else _Edge(p)
+                        for p in parents]
         self.out_treedef = out_treedef
         self.out_avals = out_avals  # list[(shape, dtype)] per output leaf
         self.name = name
@@ -316,9 +338,11 @@ def run_backward(
             g = _ones_like(t._value)
         elif isinstance(g, Tensor):
             g = g._value
-        _route(t, g)
+        # seeds route to the tensor's CURRENT producer (the user backwards
+        # from the value as it stands now); recorded edges use snapshots
+        _route(t, g, t._node, t._out_idx)
 
-    def _route(t: Tensor, g):
+    def _route(t: Tensor, g, node, out_idx):
         """Deliver cotangent g to tensor t: hooks, capture, leaf accum, node slot."""
         if getattr(g, "dtype", None) == jax.dtypes.float0:
             return  # integer/bool primal path — no gradient flows
@@ -329,7 +353,6 @@ def run_backward(
         if capture_ids is not None and id(t) in capture_ids:
             prev = captured.get(id(t))
             captured[id(t)] = g if prev is None else prev + g
-        node = t._node
         if node is not None and node.consumed and id(node) not in nodes:
             raise RuntimeError(
                 "Trying to backward through a graph that was already freed; "
@@ -346,7 +369,7 @@ def run_backward(
         nid = id(node)
         nodes[nid] = node
         slots = node_cots.setdefault(nid, [None] * len(node.out_avals))
-        idx = t._out_idx
+        idx = out_idx
         # autocast boundaries: a black-list op (fp32) consuming a white-list
         # output (bf16) sends an fp32 cotangent to a bf16 output — cast to
         # the primal's dtype, as the reference's AMP grads follow param dtype
@@ -373,8 +396,8 @@ def run_backward(
         if id(n) in seen:
             continue
         seen.add(id(n))
-        for p in n.parents:
-            pn = p._node
+        for e in n.parents:
+            pn = e.node
             if pn is not None and not pn.consumed:
                 pending[id(pn)] = pending.get(id(pn), 0) + 1
                 nodes[id(pn)] = pn
@@ -400,8 +423,8 @@ def run_backward(
         while stack_:
             n = stack_.pop()
             n.consumed = n.consumed or not retain_graph
-            for p in n.parents:
-                pn = p._node
+            for e in n.parents:
+                pn = e.node
                 if pn is None:
                     continue
                 pid = id(pn)
@@ -443,9 +466,9 @@ def run_backward(
         if not retain_graph:
             node.vjp_fn = None
             node.consumed = True
-        for p, pg in zip(node.parents, parent_grads):
-            _route(p, pg)
-            pn = p._node
+        for e, pg in zip(node.parents, parent_grads):
+            _route(e.tensor, pg, e.node, e.out_idx)
+            pn = e.node
             if pn is not None:
                 pid = id(pn)
                 if pid in pending:

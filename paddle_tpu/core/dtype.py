@@ -118,3 +118,36 @@ def to_numpy_dtype(dtype):
         # numpy has no native bfloat16; ml_dtypes provides it via jnp
         return np.dtype(jnp.bfloat16)
     return np.dtype(d)
+
+
+class finfo:
+    """paddle.finfo parity (reference exposes numpy-finfo-shaped records)."""
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        if d == bfloat16:
+            import ml_dtypes
+
+            info = ml_dtypes.finfo(ml_dtypes.bfloat16)
+        else:
+            info = np.finfo(to_numpy_dtype(d))
+        self.dtype = dtype_name(d)
+        self.bits = info.bits
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+
+
+class iinfo:
+    """paddle.iinfo parity."""
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        info = np.iinfo(to_numpy_dtype(d))
+        self.dtype = dtype_name(d)
+        self.bits = info.bits
+        self.min = int(info.min)
+        self.max = int(info.max)

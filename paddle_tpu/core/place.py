@@ -103,3 +103,14 @@ def is_compiled_with_tpu() -> bool:
 
 def device_count() -> int:
     return len(jax.devices())
+
+
+class CUDAPinnedPlace(Place):
+    """API-compat alias (reference pinned-host memory place); host memory is
+    uniformly managed by JAX on TPU, so this is a tagged CPUPlace."""
+
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"

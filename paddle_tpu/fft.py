@@ -78,3 +78,63 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), x,
                     op_name="ifftshift")
+
+
+# hfft2/hfftn and inverses: jnp.fft lacks them; compose from the hermitian
+# 1-D pair the same way the reference builds them from C2R/R2C kernels.
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    def f(v):
+        a0, a1 = axes
+        # C2C on the leading axis FIRST, Hermitian C2R last (reference
+        # fftn_c2r order) — the reversed order mixes the axes' symmetries
+        # and the trailing .real would discard real information
+        n0 = s[0] if s is not None else None
+        v0 = jnp.fft.fft(v, n=n0, axis=a0, norm=_norm(norm))
+        n1 = s[1] if s is not None else None
+        return jnp.fft.hfft(v0, n=n1, axis=a1, norm=_norm(norm))
+
+    return apply_op(f, x, op_name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    def f(v):
+        a0, a1 = axes
+        # ihfft needs the REAL input: hermitian axis first, then ifft
+        n1 = s[1] if s is not None else None
+        v1 = jnp.fft.ihfft(v, n=n1, axis=a1, norm=_norm(norm))
+        n0 = s[0] if s is not None else None
+        return jnp.fft.ifft(v1, n=n0, axis=a0, norm=_norm(norm))
+
+    return apply_op(f, x, op_name="ihfft2")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    def f(v):
+        ax = list(axes) if axes is not None else list(range(v.ndim))
+        out = v
+        if len(ax) > 1:
+            # complex C2C on leading axes first (reference fftn_c2r order)
+            rest_s = list(s[:-1]) if s is not None else None
+            out = jnp.fft.fftn(out, s=rest_s, axes=ax[:-1], norm=_norm(norm))
+        n_last = s[-1] if s is not None else None
+        return jnp.fft.hfft(out, n=n_last, axis=ax[-1], norm=_norm(norm))
+
+    return apply_op(f, x, op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    def f(v):
+        ax = list(axes) if axes is not None else list(range(v.ndim))
+        last = ax[-1]
+        n_last = s[-1] if s is not None else None
+        # hermitian (real-input) axis first, then complex ifft on the rest
+        out = jnp.fft.ihfft(v, n=n_last, axis=last, norm=_norm(norm))
+        if len(ax) > 1:
+            rest_s = list(s[:-1]) if s is not None else None
+            out = jnp.fft.ifftn(out, s=rest_s, axes=ax[:-1], norm=_norm(norm))
+        return out
+
+    return apply_op(f, x, op_name="ihfftn")
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

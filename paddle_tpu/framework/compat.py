@@ -1,0 +1,100 @@
+"""Small top-level compatibility APIs (reference python/paddle/__init__.py
+long tail: batch, LazyGuard, check_shape, set_printoptions, tolist,
+function-form in-place ops, signal handling)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["batch", "LazyGuard", "check_shape", "disable_signal_handler",
+           "set_printoptions", "tolist", "dtype", "pow_", "scatter_",
+           "squeeze_", "tanh_", "unsqueeze_"]
+
+# paddle.dtype is the type of dtype objects; here dtypes are jnp.dtype
+dtype = jnp.dtype
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy batched-reader decorator (python/paddle/batch.py)."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+class LazyGuard:
+    """reference LazyGuard defers parameter initialization until first use;
+    initialization here is cheap host-side numpy/jax — eager init inside the
+    scope keeps semantics (params exist after construction) with no cost
+    worth deferring, so the guard is a no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def check_shape(shape):
+    """Validate a shape argument (static-graph helper)."""
+    for s in list(shape):
+        if not isinstance(s, (int, np.integer)) and not hasattr(s, "dtype"):
+            raise TypeError(f"shape entries must be int, got {type(s)}")
+
+
+def disable_signal_handler():
+    """The reference unhooks its C++ SIGSEGV handlers; this runtime installs
+    none, so there is nothing to disable."""
+    return None
+
+
+_PRINT_OPTS = {}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor print formatting (python/paddle/tensor/to_string.py)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _PRINT_OPTS.update(kw)
+    np.set_printoptions(**kw)
+
+
+def tolist(x):
+    """paddle.tolist parity."""
+    return np.asarray(x._value if isinstance(x, Tensor) else x).tolist()
+
+
+def _fn_inplace(name):
+    def f(x, *args, **kwargs):
+        return getattr(x, name)(*args, **kwargs)
+
+    f.__name__ = name
+    return f
+
+
+pow_ = _fn_inplace("pow_")
+scatter_ = _fn_inplace("scatter_")
+squeeze_ = _fn_inplace("squeeze_")
+tanh_ = _fn_inplace("tanh_")
+unsqueeze_ = _fn_inplace("unsqueeze_")

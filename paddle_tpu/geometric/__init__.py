@@ -1,0 +1,245 @@
+"""paddle.geometric parity — graph-NN message passing and sampling
+(reference: python/paddle/geometric/: message_passing/send_recv.py,
+sampling/neighbors.py, reindex.py).
+
+TPU-native design: all message passing lowers to ``jax.ops.segment_*``
+(XLA scatter-reduce — the MXU-free path the TPU handles well); neighbor
+sampling is host-side numpy (the reference runs it on CPU threads too — it
+is a data-pipeline step, not a device kernel).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..ops._helpers import nondiff_op, unwrap
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv", "sample_neighbors",
+           "weighted_sample_neighbors", "reindex_graph",
+           "reindex_heter_graph"]
+
+
+def _nseg(ids, count):
+    if count is not None:
+        return int(count)
+    return int(np.asarray(unwrap(ids)).max()) + 1 if np.asarray(
+        unwrap(ids)).size else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _nseg(segment_ids, None)
+    return apply_op(
+        lambda d, i: jax.ops.segment_sum(d, i, num_segments=n),
+        data, segment_ids, op_name="segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _nseg(segment_ids, None)
+
+    def f(d, i):
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones_like(d[..., :1]), i, num_segments=n)
+        return s / jnp.maximum(c, 1)
+
+    return apply_op(f, data, segment_ids, op_name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _nseg(segment_ids, None)
+    return apply_op(
+        lambda d, i: jax.ops.segment_max(d, i, num_segments=n),
+        data, segment_ids, op_name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _nseg(segment_ids, None)
+    return apply_op(
+        lambda d, i: jax.ops.segment_min(d, i, num_segments=n),
+        data, segment_ids, op_name="segment_min")
+
+
+_POOLS = {
+    "sum": jax.ops.segment_sum,
+    "add": jax.ops.segment_sum,
+    "mean": None,  # composed
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], scatter-reduce onto dst (send_recv.py send_u_recv)."""
+    n = out_size or (unwrap(x).shape[0])
+
+    def f(xv, si, di):
+        msg = xv[si]
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msg, di, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones((msg.shape[0], 1), xv.dtype),
+                                    di, num_segments=n)
+            return s / jnp.maximum(c, 1)
+        return _POOLS[reduce_op](msg, di, num_segments=n)
+
+    return apply_op(f, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features with edge features, then scatter-reduce."""
+    n = out_size or (unwrap(x).shape[0])
+
+    def f(xv, yv, si, di):
+        msg = xv[si]
+        if message_op == "add":
+            msg = msg + yv
+        elif message_op == "sub":
+            msg = msg - yv
+        elif message_op == "mul":
+            msg = msg * yv
+        elif message_op == "div":
+            msg = msg / yv
+        else:
+            raise ValueError(f"unknown message_op {message_op}")
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msg, di, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones((msg.shape[0], 1), msg.dtype),
+                                    di, num_segments=n)
+            return s / jnp.maximum(c, 1)
+        return _POOLS[reduce_op](msg, di, num_segments=n)
+
+    return apply_op(f, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (send_recv.py send_uv)."""
+
+    def f(xv, yv, si, di):
+        a, b = xv[si], yv[di]
+        if message_op == "add":
+            return a + b
+        if message_op == "sub":
+            return a - b
+        if message_op == "mul":
+            return a * b
+        if message_op == "div":
+            return a / b
+        raise ValueError(f"unknown message_op {message_op}")
+
+    return apply_op(f, x, y, src_index, dst_index, op_name="send_uv")
+
+
+def _csr_neighbors(row, colptr, nodes):
+    row = np.asarray(unwrap(row))
+    colptr = np.asarray(unwrap(colptr))
+    nodes = np.asarray(unwrap(nodes))
+    return row, colptr, nodes
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over CSC graph (sampling/neighbors.py).
+    Host-side (data pipeline step). Returns (out_neighbors, out_count[, eids])."""
+    r, cp, nodes = _csr_neighbors(row, colptr, input_nodes)
+    rng = np.random.RandomState()
+    outs, counts, out_eids = [], [], []
+    ev = np.asarray(unwrap(eids)) if eids is not None else None
+    for nd in nodes:
+        beg, end = int(cp[nd]), int(cp[nd + 1])
+        neigh = r[beg:end]
+        ids = np.arange(beg, end)
+        if 0 <= sample_size < len(neigh):
+            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh = neigh[pick]
+            ids = ids[pick]
+        outs.append(neigh)
+        counts.append(len(neigh))
+        if return_eids and ev is not None:
+            out_eids.append(ev[ids])
+    out = Tensor(jnp.asarray(np.concatenate(outs) if outs else
+                             np.zeros((0,), r.dtype)))
+    cnt = Tensor(jnp.asarray(np.asarray(counts, np.int32)))
+    if return_eids:
+        return out, cnt, Tensor(jnp.asarray(
+            np.concatenate(out_eids) if out_eids else np.zeros((0,), r.dtype)))
+    return out, cnt
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional sampling (sampling/neighbors.py weighted variant)."""
+    r, cp, nodes = _csr_neighbors(row, colptr, input_nodes)
+    w = np.asarray(unwrap(edge_weight), np.float64)
+    rng = np.random.RandomState()
+    outs, counts, out_eids = [], [], []
+    ev = np.asarray(unwrap(eids)) if eids is not None else None
+    for nd in nodes:
+        beg, end = int(cp[nd]), int(cp[nd + 1])
+        neigh = r[beg:end]
+        ids = np.arange(beg, end)
+        if 0 <= sample_size < len(neigh):
+            p = w[beg:end]
+            p = p / p.sum() if p.sum() > 0 else None
+            pick = rng.choice(len(neigh), size=sample_size, replace=False,
+                              p=p)
+            neigh = neigh[pick]
+            ids = ids[pick]
+        outs.append(neigh)
+        counts.append(len(neigh))
+        if return_eids and ev is not None:
+            out_eids.append(ev[ids])
+    out = Tensor(jnp.asarray(np.concatenate(outs) if outs else
+                             np.zeros((0,), r.dtype)))
+    cnt = Tensor(jnp.asarray(np.asarray(counts, np.int32)))
+    if return_eids:
+        return out, cnt, Tensor(jnp.asarray(
+            np.concatenate(out_eids) if out_eids else np.zeros((0,), r.dtype)))
+    return out, cnt
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact subgraph node ids to 0..n (reindex.py reindex_graph).
+    Returns (reindexed_src, reindexed_dst, out_nodes)."""
+    xv = np.asarray(unwrap(x))
+    nb = np.asarray(unwrap(neighbors))
+    ct = np.asarray(unwrap(count))
+    seen = {int(v): i for i, v in enumerate(xv)}
+    order = list(xv)
+    for v in nb:
+        vi = int(v)
+        if vi not in seen:
+            seen[vi] = len(order)
+            order.append(vi)
+    src = np.asarray([seen[int(v)] for v in nb], np.int64)
+    dst = np.repeat(np.arange(len(xv)), ct).astype(np.int64)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(np.asarray(order, xv.dtype))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are per-edge-type lists."""
+    xv = np.asarray(unwrap(x))
+    seen = {int(v): i for i, v in enumerate(xv)}
+    order = list(xv)
+    srcs, dsts = [], []
+    for nb_t, ct_t in zip(neighbors, count):
+        nb = np.asarray(unwrap(nb_t))
+        ct = np.asarray(unwrap(ct_t))
+        for v in nb:
+            vi = int(v)
+            if vi not in seen:
+                seen[vi] = len(order)
+                order.append(vi)
+        srcs.append(np.asarray([seen[int(v)] for v in nb], np.int64))
+        dsts.append(np.repeat(np.arange(len(xv)), ct).astype(np.int64))
+    return ([Tensor(jnp.asarray(s)) for s in srcs],
+            [Tensor(jnp.asarray(d)) for d in dsts],
+            Tensor(jnp.asarray(np.asarray(order, xv.dtype))))

@@ -113,3 +113,35 @@ Tensor.T = property(lambda self: linalg.t(self))
 Tensor.mT = property(
     lambda self: apply_op(lambda v: jnp.swapaxes(v, -1, -2), self, op_name="mT")
 )
+
+
+# -- in-place variants (reference: inplace_apis_in_dygraph registered per op;
+# semantics here follow reshape_: the python object is rebound to the new
+# value AND its grad node, so autograd flows through subsequent uses) -------
+
+
+def _make_inplace(name, fn):
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._value = out._value
+        self._node = out._node
+        self._out_idx = out._out_idx
+        self.stop_gradient = out.stop_gradient
+        return self
+
+    method.__name__ = name
+    return method
+
+
+_INPLACE_SOURCES = [
+    (math_ops, "add subtract multiply ceil clip erfinv exp floor lerp pow "
+               "reciprocal remainder round rsqrt scale sigmoid sqrt tanh"),
+    (manipulation, "squeeze unsqueeze scatter index_put put_along_axis "
+                   "flatten"),
+]
+
+for _mod, _names in _INPLACE_SOURCES:
+    for _n in _names.split():
+        _iname = _n + "_"
+        if not hasattr(Tensor, _iname):
+            setattr(Tensor, _iname, _make_inplace(_iname, getattr(_mod, _n)))

@@ -172,3 +172,52 @@ def one_hot(x, num_classes, name=None):
     return Tensor(
         jax.nn.one_hot(unwrap(x), num_classes, dtype=dtypes.get_default_dtype())
     )
+
+
+# ---- round-2 long tail (reference python/paddle/tensor/creation.py) --------
+
+
+def complex(real, imag, name=None):
+    import jax
+
+    from ..core.autograd import apply_op
+
+    return apply_op(lambda r, i: jax.lax.complex(r, i), real, imag,
+                    op_name="complex")
+
+
+def polar(abs, angle, name=None):
+    """abs·e^{i·angle} (creation.py polar)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.autograd import apply_op
+
+    return apply_op(
+        lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+        abs, angle, op_name="polar")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """paddle.create_parameter parity (creation.py create_parameter):
+    a free-standing Parameter built through the same attr/initializer
+    resolution as Layer.create_parameter."""
+    from ..nn.layer.layers import Layer
+
+    host = Layer(dtype=dtype)
+    return host.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                 is_bias=is_bias,
+                                 default_initializer=default_initializer)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    import jax.numpy as jnp
+
+    from ..core.dtype import convert_dtype
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.zeros((0,), convert_dtype(dtype)))
+
+
+__all__ += ["complex", "polar", "create_parameter", "create_tensor"]

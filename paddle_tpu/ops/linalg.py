@@ -286,3 +286,106 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
         x,
         op_name="cov",
     )
+
+
+# ---- round-2 long tail -----------------------------------------------------
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-distance between row batches (linalg.py cdist)."""
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            # exact 0 for identical rows; grad-safe via the where trick
+            # (sqrt'(0) = inf would poison the vjp otherwise)
+            d2 = jnp.sum(d * d, -1)
+            return jnp.where(d2 == 0, 0.0,
+                             jnp.sqrt(jnp.where(d2 == 0, 1.0, d2)))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), -1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype), -1)
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+    return apply_op(f, x, y, op_name="cdist")
+
+
+def tensordot(x, y, axes=2, name=None):
+    def norm_axes(ax):
+        if isinstance(ax, Tensor):
+            ax = ax.tolist()
+        return ax
+
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=norm_axes(axes)),
+                    x, y, op_name="tensordot")
+
+
+def inv(x, name=None):
+    """paddle.linalg.inv alias of inverse."""
+    return inverse(x, name=name)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu() results into P, L, U (linalg.py lu_unpack)."""
+    lu_v = unwrap(x)
+    piv = unwrap(y)
+    m, n = lu_v.shape[-2], lu_v.shape[-1]
+    k = min(m, n)
+
+    def f(lu_a):
+        l = jnp.tril(lu_a[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_a.dtype)
+        u = jnp.triu(lu_a[..., :k, :])
+        return l, u
+
+    def perm(piv_a):
+        # pivots (1-based row swaps) → permutation matrix
+        def one(pv):
+            perm_idx = jnp.arange(m)
+
+            def body(i, pi):
+                j = pv[i] - 1
+                a, b = pi[i], pi[j]
+                return pi.at[i].set(b).at[j].set(a)
+
+            pi = jax.lax.fori_loop(0, pv.shape[0], body, perm_idx)
+            return jnp.eye(m, dtype=lu_v.dtype)[pi].T
+
+        flat = piv_a.reshape((-1, piv_a.shape[-1]))
+        mats = jax.vmap(one)(flat)
+        return mats.reshape(piv_a.shape[:-1] + (m, m))
+
+    p_t = Tensor(perm(piv)) if unpack_pivots else None
+    if unpack_ludata:
+        l_t, u_t = apply_op(f, x, op_name="lu_unpack")
+    else:
+        l_t = u_t = None
+    return p_t, l_t, u_t
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (linalg.py pca_lowrank): returns (U, S, V)."""
+    v = unwrap(x)
+    m, n = v.shape[-2], v.shape[-1]
+    q_ = q if q is not None else min(6, m, n)
+
+    def f(a):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        key = jax.random.PRNGKey(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, q_), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (a.swapaxes(-1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = qmat.swapaxes(-1, -2) @ a
+        u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, vt.swapaxes(-1, -2)
+
+    from ._helpers import nondiff_op as _nd
+
+    return _nd(f, "pca_lowrank")(x)
+
+
+for _n in ("cdist", "tensordot", "inv", "lu_unpack", "pca_lowrank"):
+    __all__.append(_n)

@@ -83,3 +83,32 @@ def is_empty(x, name=None):
 
 def isin(x, test_x, assume_unique=False, invert=False, name=None):
     return Tensor(jnp.isin(unwrap(x), unwrap(test_x), invert=invert))
+
+
+def is_complex(x, name=None):
+    import jax.numpy as jnp
+
+    from ._helpers import unwrap
+
+    return jnp.iscomplexobj(unwrap(x))
+
+
+def is_floating_point(x, name=None):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ._helpers import unwrap
+
+    return bool(np.issubdtype(np.dtype(unwrap(x).dtype), np.floating)
+                or unwrap(x).dtype == jnp.bfloat16)
+
+
+def is_integer(x, name=None):
+    import numpy as np
+
+    from ._helpers import unwrap
+
+    return bool(np.issubdtype(np.dtype(unwrap(x).dtype), np.integer))
+
+
+__all__ += ["is_complex", "is_floating_point", "is_integer"]

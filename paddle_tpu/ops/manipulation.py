@@ -534,3 +534,72 @@ def cast(x, dtype):
 
     d = dtypes.convert_dtype(dtype)
     return apply_op(lambda v: v.astype(d), x, op_name="cast")
+
+
+# ---- round-2 long tail (reference python/paddle/tensor/manipulation.py) ----
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (manipulation.py take): treats x as 1-D."""
+    def f(v, i):
+        n = jnp.size(v)
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        return jnp.take(v.reshape(-1), i)
+
+    return apply_op(f, x, index, op_name="take")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+        x, op_name="diagonal")
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (manipulation.py reverse)."""
+    return flip(x, axis)
+
+
+def vsplit(x, num_or_sections, name=None):
+    """Split along axis 0 for >=2-D tensors (manipulation.py vsplit)."""
+    return split(x, num_or_sections, axis=0)
+
+
+def as_complex(x, name=None):
+    """[..., 2] real → complex (manipulation.py as_complex)."""
+    return apply_op(
+        lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x,
+        op_name="as_complex")
+
+
+def as_real(x, name=None):
+    """complex → [..., 2] real (manipulation.py as_real)."""
+    return apply_op(
+        lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x,
+        op_name="as_real")
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Pure shape computation (manipulation.py broadcast_shape)."""
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def rank(input, name=None):
+    """0-D int tensor holding ndim (manipulation.py rank)."""
+    return Tensor(jnp.asarray(unwrap(input).ndim, _i64))
+
+
+def shape(input, name=None):
+    """1-D int tensor holding the shape (the reference returns a tensor so
+    shapes compose into graphs; under tracing these are static anyway)."""
+    return Tensor(jnp.asarray(unwrap(input).shape, _i64))
+
+
+for _n in ("take", "diagonal", "reverse", "vsplit", "as_complex", "as_real",
+           "broadcast_shape", "rank", "shape"):
+    __all__.append(_n)

@@ -395,3 +395,137 @@ for _n in (
     "divide_no_nan",
 ):
     _export(_n, globals()[_n])
+
+
+# ---- round-2 long tail (reference python/paddle/tensor/math.py) ------------
+
+
+def logit(x, eps=None, name=None):
+    """log(p/(1-p)); eps clamps inputs into [eps, 1-eps] (math.py logit)."""
+    def f(v):
+        p = jnp.clip(v, eps, 1.0 - eps) if eps is not None else v
+        return jnp.log(p) - jnp.log1p(-p)
+
+    return apply_op(f, x, op_name="logit")
+
+
+def frexp(x, name=None):
+    """Mantissa/exponent decomposition (math.py frexp): x = m * 2**e with
+    0.5 <= |m| < 1."""
+    from ._helpers import nondiff_op as _nd
+
+    def f(v):
+        e = jnp.where(v == 0, 0, jnp.floor(jnp.log2(jnp.abs(
+            jnp.where(v == 0, 1.0, v)))) + 1)
+        m = v / jnp.exp2(e)
+        # float log2 can round up at power-of-two boundaries, leaving
+        # |m| < 0.5 — renormalize so the 0.5 <= |m| < 1 contract holds
+        fix = (jnp.abs(m) < 0.5) & (v != 0)
+        m = jnp.where(fix, m * 2, m)
+        e = jnp.where(fix, e - 1, e)
+        return m, e.astype(v.dtype)
+
+    return _nd(f, "frexp")(x)
+
+
+def i0e(x, name=None):
+    return apply_op(lambda v: jax.scipy.special.i0e(v), x, op_name="i0e")
+
+
+def i1e(x, name=None):
+    return apply_op(lambda v: jax.scipy.special.i1e(v), x, op_name="i1e")
+
+
+def polygamma(x, n, name=None):
+    return apply_op(lambda v: jax.scipy.special.polygamma(n, v), x,
+                    op_name="polygamma")
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex (math.py sgn)."""
+    def f(v):
+        if jnp.iscomplexobj(v):
+            m = jnp.abs(v)
+            return jnp.where(m == 0, 0, v / jnp.where(m == 0, 1, m))
+        return jnp.sign(v)
+
+    return apply_op(f, x, op_name="sgn")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal integration (math.py trapezoid)."""
+    if x is not None:
+        return apply_op(lambda yv, xv: jnp.trapezoid(yv, xv, axis=axis),
+                        y, x, op_name="trapezoid")
+    return apply_op(
+        lambda yv: jnp.trapezoid(yv, dx=(dx if dx is not None else 1.0),
+                                 axis=axis), y, op_name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoid (math.py cumulative_trapezoid)."""
+    def f(yv, xv=None):
+        y1 = jnp.moveaxis(yv, axis, -1)
+        avg = (y1[..., 1:] + y1[..., :-1]) * 0.5
+        if xv is not None:
+            x1 = jnp.moveaxis(jnp.broadcast_to(xv, yv.shape), axis, -1) \
+                if xv.ndim > 1 else xv
+            d = jnp.diff(x1, axis=-1)
+        else:
+            d = dx if dx is not None else 1.0
+        return jnp.moveaxis(jnp.cumsum(avg * d, axis=-1), -1, axis)
+
+    if x is not None:
+        return apply_op(f, y, x, op_name="cumulative_trapezoid")
+    return apply_op(f, y, op_name="cumulative_trapezoid")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along `axis` to at most max_norm in p-norm
+    (math.py renorm)."""
+    def f(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * factor[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return apply_op(f, x, op_name="renorm")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim), x,
+        op_name="nanmedian")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jnp.nanquantile(v, q, axis=axis, keepdims=keepdim).astype(
+            jnp.float32 if v.dtype != jnp.float64 else v.dtype),
+        x, op_name="nanquantile")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op(
+        lambda v: jnp.vander(v, N=n, increasing=increasing), x,
+        op_name="vander")
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (math.py add_n / legacy sum op)."""
+    if isinstance(inputs, (list, tuple)):
+        import functools as _ft
+
+        # NB: builtin sum is shadowed by this module's reduction op
+        return apply_op(lambda *vs: _ft.reduce(jnp.add, vs), *inputs,
+                        op_name="add_n")
+    return apply_op(lambda v: v, inputs, op_name="add_n")
+
+
+for _n in ("logit", "frexp", "i0e", "i1e", "polygamma", "sgn", "trapezoid",
+           "cumulative_trapezoid", "renorm", "nanmedian", "nanquantile",
+           "vander", "add_n"):
+    _export(_n, globals()[_n])

@@ -183,3 +183,20 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         counts = np.diff(np.append(idx, len(keep)))
         outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
     return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """Bucket indices into a 1-D sorted sequence (search.py bucketize)."""
+    import jax.numpy as jnp
+
+    from ._helpers import nondiff_op
+
+    def f(v, seq):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(seq, v, side=side)
+        return out.astype(jnp.int32) if out_int32 else out
+
+    return nondiff_op(f, "bucketize")(x, sorted_sequence)
+
+
+__all__.append("bucketize")

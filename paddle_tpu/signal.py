@@ -1,0 +1,93 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — stft/istft
+built on frame/overlap_add ops)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.autograd import apply_op
+from .ops._helpers import unwrap
+
+__all__ = ["stft", "istft"]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (signal.py stft). x: [B, T] or [T].
+    Returns [B, n_fft(/2+1), num_frames] complex."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    wv = unwrap(window) if window is not None else None
+
+    def f(v, *maybe_w):
+        w = maybe_w[0] if maybe_w else None
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if w is None:
+            w = jnp.ones((wl,), v.dtype)
+        if wl < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        if center:
+            v = jnp.pad(v, ((0, 0), (n_fft // 2, n_fft // 2)),
+                        mode=pad_mode)
+        t = v.shape[-1]
+        n_frames = 1 + (t - n_fft) // hop
+        idx = (jnp.arange(n_frames)[:, None] * hop
+               + jnp.arange(n_fft)[None, :])
+        frames = v[:, idx] * w[None, None, :]          # [B, F, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))     # [B, F, bins]
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)               # [B, bins, F]
+        return out[0] if squeeze else out
+
+    args = (x, window) if window is not None else (x,)
+    return apply_op(f, *args, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT via overlap-add (signal.py istft)."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+
+    def f(v, *maybe_w):
+        w = maybe_w[0] if maybe_w else None
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        if w is None:
+            w = jnp.ones((wl,), jnp.float32)
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        spec = jnp.swapaxes(v, -1, -2)                 # [B, F, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)  # [B, F, n_fft]
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w[None, None, :]
+        b, nf, _ = frames.shape
+        t_len = n_fft + hop * (nf - 1)
+        out = jnp.zeros((b, t_len), frames.dtype)
+        wsum = jnp.zeros((t_len,), frames.dtype)
+        idx = (jnp.arange(nf)[:, None] * hop + jnp.arange(n_fft)[None, :])
+        out = out.at[:, idx].add(frames)
+        wsum = wsum.at[idx].add((w * w)[None, :].repeat(nf, 0))
+        out = out / jnp.maximum(wsum, 1e-11)[None]
+        if center:
+            out = out[:, n_fft // 2: t_len - n_fft // 2]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    args = (x, window) if window is not None else (x,)
+    return apply_op(f, *args, op_name="istft")

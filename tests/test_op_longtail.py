@@ -1,0 +1,337 @@
+"""Long-tail op tests via the OpTest harness (reference pattern:
+test/legacy_test/eager_op_test.py — numpy-reference check_output + finite-
+difference check_grad for every op)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from op_test import check, check_grad, check_output
+
+
+def r(*shape, seed=0, dtype=np.float32, lo=None, hi=None):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(*shape).astype(dtype)
+    if lo is not None:
+        x = (lo + (hi - lo) * rng.rand(*shape)).astype(dtype)
+    return x
+
+
+class TestMathLongTail:
+    def test_logit(self):
+        check(paddle.logit, lambda x: np.log(x / (1 - x)),
+              [r(3, 4, lo=0.1, hi=0.9)], name="logit")
+
+    def test_logit_eps(self):
+        x = r(8, lo=0.0, hi=1.0)
+        got = paddle.logit(paddle.to_tensor(x), eps=0.2)
+        xc = np.clip(x, 0.2, 0.8)
+        np.testing.assert_allclose(np.asarray(got.value),
+                                   np.log(xc / (1 - xc)), rtol=1e-5)
+
+    def test_frexp(self):
+        x = r(10, seed=3) * 100
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        mm, ee = np.frexp(x)
+        np.testing.assert_allclose(np.asarray(m.value), mm, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(e.value), ee)
+
+    def test_i0e_i1e(self):
+        import scipy.special as sp
+
+        x = r(8, lo=0.1, hi=4.0)
+        check_output(paddle.i0e, lambda v: sp.i0e(v), [x], rtol=1e-5,
+                     name="i0e")
+        check_output(paddle.i1e, lambda v: sp.i1e(v), [x], rtol=1e-5,
+                     name="i1e")
+
+    def test_sgn(self):
+        check(paddle.sgn, np.sign, [r(3, 3, seed=5)], grad=False)
+
+    def test_trapezoid(self):
+        y = r(4, 8, seed=6)
+        check(paddle.trapezoid, lambda v: np.trapezoid(v, axis=-1), [y],
+              name="trapezoid")
+        x = np.sort(r(8, seed=7, lo=0.0, hi=5.0))
+        got = paddle.trapezoid(paddle.to_tensor(y), paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(got.value),
+                                   np.trapezoid(y, x, axis=-1), rtol=1e-5)
+
+    def test_cumulative_trapezoid(self):
+        import scipy.integrate as si
+
+        y = r(3, 6, seed=8)
+        got = paddle.cumulative_trapezoid(paddle.to_tensor(y), dx=0.5)
+        np.testing.assert_allclose(np.asarray(got.value),
+                                   si.cumulative_trapezoid(y, dx=0.5,
+                                                           axis=-1),
+                                   rtol=1e-5)
+        check_grad(paddle.cumulative_trapezoid, [y], name="cumtrap")
+
+    def test_renorm(self):
+        x = r(4, 5, seed=9) * 3
+        got = paddle.renorm(paddle.to_tensor(x), p=2.0, axis=0, max_norm=1.0)
+        norms = np.linalg.norm(np.asarray(got.value).reshape(4, -1), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        check_grad(paddle.renorm, [x * 0.01],
+                   kwargs=dict(p=2.0, axis=0, max_norm=1.0), name="renorm")
+
+    def test_nanmedian_nanquantile(self):
+        x = r(4, 6, seed=10)
+        x[1, 2] = np.nan
+        got = paddle.nanmedian(paddle.to_tensor(x), axis=1)
+        np.testing.assert_allclose(np.asarray(got.value),
+                                   np.nanmedian(x, axis=1), rtol=1e-6)
+        gq = paddle.nanquantile(paddle.to_tensor(x), 0.3, axis=1)
+        np.testing.assert_allclose(np.asarray(gq.value),
+                                   np.nanquantile(x, 0.3, axis=1).astype(
+                                       np.float32), rtol=1e-5)
+
+    def test_vander(self):
+        x = r(5, seed=11)
+        check_output(paddle.vander, lambda v: np.vander(v), [x], name="vander")
+
+    def test_add_n(self):
+        xs = [r(3, 3, seed=s) for s in (1, 2, 3)]
+        got = paddle.add_n([paddle.to_tensor(x) for x in xs])
+        np.testing.assert_allclose(np.asarray(got.value), sum(xs), rtol=1e-6)
+
+    def test_polygamma(self):
+        import scipy.special as sp
+
+        x = r(6, lo=0.5, hi=3.0)
+        check_output(paddle.polygamma, lambda v, n: sp.polygamma(n, v), [x],
+                     kwargs=dict(n=1), rtol=1e-4, name="polygamma")
+
+
+class TestManipLongTail:
+    def test_take(self):
+        x = r(3, 4, seed=12)
+        idx = np.array([0, 5, 11, 3], np.int32)
+        check_output(paddle.take, lambda v, i: np.take(v, i), [x, idx],
+                     name="take")
+        # wrap / clip modes
+        idx2 = np.array([-1, 14], np.int32)
+        got = paddle.take(paddle.to_tensor(x), paddle.to_tensor(idx2),
+                          mode="wrap")
+        np.testing.assert_allclose(np.asarray(got.value),
+                                   np.take(x, idx2, mode="wrap"))
+
+    def test_diagonal(self):
+        x = r(4, 5, seed=13)
+        check(paddle.diagonal, lambda v: np.diagonal(v), [x], name="diagonal")
+        check_output(paddle.diagonal,
+                     lambda v, offset: np.diagonal(v, offset=offset),
+                     [x], kwargs=dict(offset=1))
+
+    def test_reverse_vsplit(self):
+        x = r(4, 6, seed=14)
+        got = paddle.reverse(paddle.to_tensor(x), axis=[0])
+        np.testing.assert_allclose(np.asarray(got.value), x[::-1])
+        parts = paddle.vsplit(paddle.to_tensor(x), 2)
+        assert len(parts) == 2 and tuple(parts[0].shape) == (2, 6)
+
+    def test_as_complex_real_roundtrip(self):
+        x = r(3, 4, 2, seed=15)
+        c = paddle.as_complex(paddle.to_tensor(x))
+        back = paddle.as_real(c)
+        np.testing.assert_allclose(np.asarray(back.value), x, rtol=1e-6)
+        check_grad(lambda t: paddle.as_real(paddle.as_complex(t)), [x],
+                   name="as_complex_real")
+
+    def test_shape_rank_broadcast_shape(self):
+        x = paddle.to_tensor(r(2, 3, 4))
+        assert list(np.asarray(paddle.shape(x).value)) == [2, 3, 4]
+        assert int(paddle.rank(x).value) == 3
+        assert paddle.broadcast_shape([2, 1, 4], [3, 4]) == [2, 3, 4]
+
+
+class TestLinalgLongTail:
+    def test_cdist(self):
+        import scipy.spatial.distance as sd
+
+        a, b = r(5, 3, seed=16), r(4, 3, seed=17)
+        check_output(paddle.cdist, lambda x, y: sd.cdist(x, y), [a, b],
+                     rtol=1e-4, atol=1e-5, name="cdist")
+        check_grad(paddle.cdist, [a, b], name="cdist")
+
+    def test_tensordot(self):
+        a, b = r(3, 4, 5, seed=18), r(4, 5, 6, seed=19)
+        check(paddle.tensordot, lambda x, y: np.tensordot(x, y, axes=2),
+              [a, b], rtol=1e-4, atol=1e-4, name="tensordot")
+
+    def test_inv(self):
+        x = r(3, 3, seed=20) + 3 * np.eye(3, dtype=np.float32)
+        check(paddle.linalg.inv, np.linalg.inv, [x], rtol=1e-4, atol=1e-4,
+              name="inv")
+
+    def test_lu_unpack(self):
+        x = r(4, 4, seed=21) + 4 * np.eye(4, dtype=np.float32)
+        lu_t, piv, _ = paddle.linalg.lu(paddle.to_tensor(x), get_infos=True)
+        p, l, u = paddle.linalg.lu_unpack(lu_t, piv)
+        rec = (np.asarray(p.value) @ np.asarray(l.value)
+               @ np.asarray(u.value))
+        np.testing.assert_allclose(rec, x, rtol=1e-4, atol=1e-4)
+
+    def test_pca_lowrank(self):
+        x = r(20, 5, seed=22)
+        u, s, v = paddle.linalg.pca_lowrank(paddle.to_tensor(x), q=3)
+        assert tuple(u.shape) == (20, 3) and tuple(v.shape) == (5, 3)
+        # principal directions capture more variance than random ones
+        xc = x - x.mean(0)
+        var = np.linalg.norm(xc @ np.asarray(v.value), axis=0).sum()
+        rngdir = np.linalg.qr(r(5, 3, seed=23))[0]
+        var_r = np.linalg.norm(xc @ rngdir, axis=0).sum()
+        assert var >= var_r * 0.99
+
+
+class TestInplaceAndPredicates:
+    def test_inplace_math(self):
+        x = paddle.to_tensor(np.array([1.0, 4.0, 9.0], np.float32))
+        y = x.sqrt_()
+        assert y is x
+        np.testing.assert_allclose(np.asarray(x.value), [1, 2, 3])
+        x.add_(paddle.to_tensor(np.ones(3, np.float32)))
+        np.testing.assert_allclose(np.asarray(x.value), [2, 3, 4])
+
+    def test_inplace_grad_flow(self):
+        x = paddle.to_tensor(r(4, seed=24))
+        x.stop_gradient = False
+        z = x * 3.0
+        z.exp_()
+        z.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value),
+                                   3.0 * np.exp(3.0 * r(4, seed=24)),
+                                   rtol=1e-5)
+
+    def test_function_form(self):
+        x = paddle.to_tensor(np.array([0.5], np.float32))
+        paddle.tanh_(x)
+        np.testing.assert_allclose(np.asarray(x.value), np.tanh(0.5),
+                                   rtol=1e-6)
+
+    def test_predicates(self):
+        assert paddle.is_floating_point(paddle.to_tensor(r(2)))
+        assert not paddle.is_integer(paddle.to_tensor(r(2)))
+        assert paddle.is_integer(paddle.to_tensor(np.arange(3)))
+        assert not paddle.is_complex(paddle.to_tensor(r(2)))
+        c = paddle.complex(paddle.to_tensor(r(2)), paddle.to_tensor(r(2)))
+        assert paddle.is_complex(c)
+
+    def test_bucketize(self):
+        seq = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+        x = np.array([0.5, 3.0, 6.2], np.float32)
+        got = paddle.bucketize(paddle.to_tensor(x), paddle.to_tensor(seq))
+        np.testing.assert_array_equal(np.asarray(got.value),
+                                      np.searchsorted(seq, x))
+
+    def test_polar_complex(self):
+        a, t = r(4, lo=0.5, hi=2.0), r(4, seed=25)
+        got = paddle.polar(paddle.to_tensor(a), paddle.to_tensor(t))
+        np.testing.assert_allclose(np.asarray(got.value),
+                                   a * np.exp(1j * t), rtol=1e-5)
+
+    def test_finfo_iinfo(self):
+        assert paddle.finfo(paddle.float32).bits == 32
+        assert paddle.finfo("bfloat16").max > 1e38
+        assert paddle.iinfo(paddle.int32).max == 2**31 - 1
+
+    def test_create_parameter_tolist(self):
+        p = paddle.create_parameter([2, 3], "float32")
+        assert tuple(p.shape) == (2, 3)
+        assert paddle.tolist(paddle.to_tensor(np.arange(3))) == [0, 1, 2]
+
+
+class TestSignalAndFFT:
+    def test_stft_istft_roundtrip(self):
+        sig = np.sin(np.arange(1024) * 0.05).astype(np.float32)
+        S = paddle.signal.stft(paddle.to_tensor(sig), n_fft=128)
+        rec = paddle.signal.istft(S, n_fft=128, length=1024)
+        np.testing.assert_allclose(np.asarray(rec.value)[64:-64],
+                                   sig[64:-64], atol=1e-4)
+
+    def test_stft_matches_scipy(self):
+        sig = r(512, seed=26)
+        S = paddle.signal.stft(paddle.to_tensor(sig), n_fft=64,
+                               hop_length=32, center=False)
+        import numpy.fft as nf
+
+        frames = np.stack([sig[i * 32:i * 32 + 64]
+                           for i in range((512 - 64) // 32 + 1)])
+        want = nf.rfft(frames, axis=-1).T
+        np.testing.assert_allclose(np.asarray(S.value), want, atol=1e-3)
+
+    def test_hfft2_ihfft2(self):
+        x = r(4, 5, seed=27) + 1j * r(4, 5, seed=28)
+        x = x.astype(np.complex64)
+        out = paddle.fft.hfft2(paddle.to_tensor(x))
+        back = paddle.fft.ihfft2(out)
+        # roundtrip consistency on the hermitian part
+        assert tuple(out.shape) == (4, 8)
+        assert tuple(back.shape) == (4, 5)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        ids = np.array([0, 0, 1, 1], np.int32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_sum(
+                paddle.to_tensor(x), paddle.to_tensor(ids)).value),
+            np.stack([x[:2].sum(0), x[2:].sum(0)]))
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_mean(
+                paddle.to_tensor(x), paddle.to_tensor(ids)).value),
+            np.stack([x[:2].mean(0), x[2:].mean(0)]))
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_max(
+                paddle.to_tensor(x), paddle.to_tensor(ids)).value),
+            np.stack([x[:2].max(0), x[2:].max(0)]))
+
+    def test_send_u_recv_grad(self):
+        x = r(4, 3, seed=29)
+        src = np.array([0, 1, 2, 3], np.int32)
+        dst = np.array([1, 1, 0, 0], np.int32)
+        t = paddle.to_tensor(x)
+        t.stop_gradient = False
+        out = paddle.geometric.send_u_recv(
+            t, paddle.to_tensor(src), paddle.to_tensor(dst))
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(t.grad.value),
+                                   np.ones_like(x))
+
+    def test_send_ue_recv_and_uv(self):
+        x = r(3, 2, seed=30)
+        e = r(4, 2, seed=31)
+        src = np.array([0, 1, 2, 0], np.int32)
+        dst = np.array([1, 2, 0, 2], np.int32)
+        out = paddle.geometric.send_ue_recv(
+            paddle.to_tensor(x), paddle.to_tensor(e),
+            paddle.to_tensor(src), paddle.to_tensor(dst),
+            message_op="mul", reduce_op="sum", out_size=3)
+        want = np.zeros((3, 2), np.float32)
+        for k in range(4):
+            want[dst[k]] += x[src[k]] * e[k]
+        np.testing.assert_allclose(np.asarray(out.value), want, rtol=1e-5)
+        uv = paddle.geometric.send_uv(
+            paddle.to_tensor(x), paddle.to_tensor(x),
+            paddle.to_tensor(src), paddle.to_tensor(dst), message_op="add")
+        np.testing.assert_allclose(np.asarray(uv.value), x[src] + x[dst],
+                                   rtol=1e-6)
+
+    def test_sample_and_reindex(self):
+        # CSC graph: 3 nodes; node0 <- {1,2}, node1 <- {2}, node2 <- {0,1}
+        colptr = np.array([0, 2, 3, 5], np.int64)
+        row = np.array([1, 2, 2, 0, 1], np.int64)
+        nodes = np.array([0, 2], np.int64)
+        nb, cnt = paddle.geometric.sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(nodes), sample_size=-1)
+        np.testing.assert_array_equal(np.asarray(cnt.value), [2, 2])
+        src, dst, out_nodes = paddle.geometric.reindex_graph(
+            paddle.to_tensor(nodes), nb, cnt)
+        on = np.asarray(out_nodes.value)
+        assert set(on[:2]) == {0, 2}
+        assert int(np.asarray(dst.value).max()) <= 1
