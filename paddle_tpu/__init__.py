@@ -47,6 +47,7 @@ __version__ = "0.1.0"
 
 # Subpackages load lazily (PEP 562): paddle_tpu.nn, .optimizer, .distributed...
 _LAZY_SUBMODULES = {
+    "inference",
     "signal",
     "geometric",
     "amp",

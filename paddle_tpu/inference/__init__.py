@@ -1,0 +1,262 @@
+"""Serving / inference engine.
+
+TPU-native re-design of the reference's AnalysisPredictor stack
+(``paddle/fluid/inference/api/analysis_predictor.h:94`` Run at ``:148``,
+AnalysisConfig, pass pipeline): the IR-pass pipeline + TensorRT subgraph
+capture collapse into one AOT XLA compile (``jax.jit(...).lower().
+compile()``); the serialized artifact is StableHLO via ``jax.export``
+(``*.pdmodel`` analog), weights ride the ``state_dict`` pickle
+(``*.pdiparams``). See DESIGN.md for the TensorRT descope rationale.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "convert_to_mixed_precision",
+           "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "tpu"   # reference GPU place maps onto the accelerator
+    XPU = "tpu"
+
+
+class Config:
+    """≙ AnalysisConfig (inference/api/paddle_analysis_config.h).
+
+    Knobs that steer CUDA/TRT/MKLDNN pass pipelines in the reference are
+    accepted for compatibility and recorded; on TPU the optimization
+    pipeline IS the XLA compile, so most are no-ops by design.
+    """
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        self.model_path = model_path
+        self.params_path = params_path or (
+            model_path + ".pdiparams" if model_path else None)
+        self._device = "tpu" if any(
+            d.platform == "tpu" for d in jax.devices()) else "cpu"
+        self._precision = PrecisionType.Float32
+        self._memory_optim = True
+        self._ir_optim = True
+        self._flags: Dict[str, Any] = {}
+
+    # -- device selection ---------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0,
+                       precision=PrecisionType.Float32):
+        self._device = "tpu"
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device != "cpu"
+
+    # -- compat no-ops (XLA owns fusion/memory planning) ---------------------
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        raise NotImplementedError(
+            "TensorRT is NVIDIA-specific; the TPU serving path is AOT XLA "
+            "compilation (see DESIGN.md descope table)")
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._flags["cpu_threads"] = n
+
+    def summary(self) -> str:
+        return (f"Config(model={self.model_path}, device={self._device}, "
+                f"precision={self._precision})")
+
+
+class Predictor:
+    """≙ AnalysisPredictor (analysis_predictor.h:94).
+
+    Two construction modes:
+    - from a ``Config`` pointing at a ``paddle_tpu.jit.save`` artifact
+      (state_dict + exported StableHLO when present);
+    - directly from a Layer + example inputs (``Predictor.from_layer``) —
+      AOT-compiles the forward.
+    """
+
+    def __init__(self, config: Config):
+        self.config = config
+        self._fn = None
+        self._params = None
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._input_names: List[str] = []
+        self._outputs: List[Any] = []
+        if config.model_path:
+            self._load(config.model_path)
+
+    # -- loading -------------------------------------------------------------
+    def _load(self, path: str):
+        from .aot import load_exported
+
+        exported = None
+        if os.path.exists(path + ".stablehlo"):
+            exported = load_exported(path + ".stablehlo")
+        params = None
+        if os.path.exists(self.config.params_path or ""):
+            from ..framework.io import load as fload
+
+            params = fload(self.config.params_path)
+        if exported is None and params is None:
+            raise FileNotFoundError(
+                f"no serving artifact at {path} (.stablehlo/.pdiparams)")
+        self._exported = exported
+        if params is not None:
+            from ..core.tensor import Tensor as _T
+
+            params = {k: (v.value if isinstance(v, _T) else jnp.asarray(v))
+                      for k, v in params.items()}
+        self._params = params
+        if exported is not None:
+            # jit.save exports fwd(params, *inputs): weights stay in the
+            # .pdiparams pickle instead of being baked into the StableHLO
+            if params is None:
+                raise FileNotFoundError(
+                    f"{self.config.params_path}: exported program needs its "
+                    "weights file")
+            self._fn = lambda *xs: exported.call(params, *xs)
+            self._input_names = [f"x{i}"
+                                 for i in range(len(exported.in_avals) - 1)]
+
+    @classmethod
+    def from_layer(cls, layer, example_inputs: Sequence[Any],
+                   precision: Optional[str] = None):
+        """AOT-compile ``layer(*example_inputs)``; the predictor then runs
+        the compiled executable (no retracing at serve time)."""
+        from ..nn.functional_call import functional_call
+
+        self = cls.__new__(cls)
+        self.config = Config()
+        self._inputs = {}
+        self._outputs = []
+        params = {k: p.value for k, p in layer.named_parameters()}
+        if precision is not None:
+            dt = jnp.dtype(precision)
+            params = {k: (v.astype(dt)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                      for k, v in params.items()}
+
+        def fwd(params, *xs):
+            return functional_call(layer, params,
+                                   *[Tensor(x) for x in xs])
+
+        exam = [np.asarray(x.value if isinstance(x, Tensor) else x)
+                for x in example_inputs]
+        jitted = jax.jit(fwd)
+        self._compiled = jitted.lower(params, *exam).compile()
+        self._params = params
+        self._fn = lambda *xs: self._compiled(params, *xs)
+        self._input_names = [f"x{i}" for i in range(len(exam))]
+        return self
+
+    # -- AnalysisPredictor-shaped API -----------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str):
+        return _Handle(self._inputs, name)
+
+    def get_output_names(self) -> List[str]:
+        return [f"out{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name: str):
+        idx = int(name.replace("out", "") or 0)
+        return _OutHandle(self, idx)
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute the compiled program. Either pass inputs directly
+        (functional style, returns numpy outputs) or stage them via input
+        handles (reference style, returns True; read output handles)."""
+        explicit = inputs is not None
+        if not explicit:
+            inputs = [self._inputs[n] for n in self._input_names]
+        out = self._fn(*inputs)
+        self._outputs = list(out) if isinstance(out, (tuple, list)) else [out]
+        if explicit:
+            return [np.asarray(o) for o in self._outputs]
+        return True
+
+    # -- introspection ---------------------------------------------------------
+    def get_serialized_program(self) -> bytes:
+        if getattr(self, "_exported", None) is not None:
+            from .aot import serialize_exported
+
+            return serialize_exported(self._exported)
+        return b""
+
+
+class _Handle:
+    def __init__(self, store, name):
+        self._store = store
+        self._name = name
+
+    def reshape(self, shape):
+        pass  # shapes are taken from copy_from_cpu
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._store[self._name] = np.asarray(arr)
+
+
+class _OutHandle:
+    def __init__(self, pred, idx):
+        self._pred = pred
+        self._idx = idx
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._pred._outputs[self._idx])
+
+    def shape(self):
+        return list(np.asarray(self._pred._outputs[self._idx]).shape)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """≙ paddle_infer::CreatePredictor."""
+    return Predictor(config)
+
+
+def convert_to_mixed_precision(src_model, src_params, dst_model, dst_params,
+                               mixed_precision=PrecisionType.Bfloat16,
+                               backend=None, keep_io_types=True,
+                               black_list=None):
+    """Offline weight conversion (reference convert_to_mixed_precision):
+    floating-point params cast to the target dtype, artifact re-saved."""
+    from ..framework.io import load as fload
+    from ..framework.io import save as fsave
+
+    params = fload(src_params)
+    dt = jnp.dtype(mixed_precision)
+    out = {}
+    for k, v in params.items():
+        arr = v.value if isinstance(v, Tensor) else jnp.asarray(v)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(dt)
+        out[k] = arr
+    fsave(out, dst_params)

@@ -1,0 +1,47 @@
+"""AOT export/import of compiled programs (the ``*.pdmodel`` analog).
+
+``jax.export`` serializes a lowered jitted function as StableHLO bytes —
+portable across processes and (within compatibility windows) jax versions.
+This is the deployable-artifact half of the serving story; the other half
+(weights) is the ``state_dict`` pickle written by ``paddle_tpu.jit.save``.
+Reference analog: AnalysisPredictor loading a ProgramDesc + params
+(inference/api/analysis_predictor.h:148); here the "program" is already
+compiled IR, not an op list to re-optimize.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+
+__all__ = ["export_fn", "save_exported", "load_exported",
+           "serialize_exported"]
+
+
+def export_fn(fn: Callable, *example_args, **jit_kwargs):
+    """Export ``jax.jit(fn)`` at the example-argument shapes. Returns a
+    jax.export.Exported (call via ``.call``)."""
+    from jax import export as jexport
+
+    jitted = jax.jit(fn, **jit_kwargs)
+    shapes = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jax.numpy.shape(x),
+                                       jax.numpy.result_type(x)),
+        example_args)
+    return jexport.export(jitted)(*shapes)
+
+
+def serialize_exported(exported) -> bytes:
+    return exported.serialize()
+
+
+def save_exported(exported, path: str):
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+
+
+def load_exported(path: str):
+    from jax import export as jexport
+
+    with open(path, "rb") as f:
+        return jexport.deserialize(f.read())

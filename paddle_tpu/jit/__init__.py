@@ -221,15 +221,49 @@ class TracedLayer:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """jit.save: persist weights + a callable-spec manifest.
+    """jit.save: persist weights + (with input_spec) an AOT artifact.
 
     The reference emits *.pdmodel (ProgramDesc) + *.pdiparams. TPU-native
-    artifact: state_dict pickle + jax-exported StableHLO when input_spec is
-    concrete (deferred to the serving milestone); weights round-trip now.
+    artifact: state_dict pickle (``.pdiparams``) + jax-exported StableHLO
+    (``.stablehlo``) when ``input_spec`` gives concrete shapes — the
+    serving half loaded by ``paddle_tpu.inference.Predictor``.
     """
     from ..framework.io import save as fsave
 
     fsave(layer.state_dict(), path + ".pdiparams")
+    if input_spec:
+        import jax as _jax
+        from jax import export as _jexport
+
+        from ..core.tensor import Tensor as _T
+        from ..inference.aot import save_exported
+        from ..nn.functional_call import functional_call
+
+        params = {k: p.value for k, p in layer.named_parameters()}
+
+        # export fwd(params, *inputs): weights stay in the .pdiparams pickle
+        # instead of being baked into the StableHLO as constants (a 350M
+        # model would otherwise ship its 700MB twice)
+        def fwd(pv, *xs):
+            return functional_call(layer, pv, *[_T(x) for x in xs])
+
+        # None/-1 dims become jax.export symbolic dimensions so the artifact
+        # serves any batch size, matching the reference InputSpec contract
+        shapes = []
+        for i, spec in enumerate(input_spec):
+            dims = []
+            for j, s in enumerate(getattr(spec, "shape", spec)):
+                if s is None or (isinstance(s, int) and s < 0):
+                    dims.append(f"d{i}_{j}")
+                else:
+                    dims.append(str(int(s)))
+            dt = str(getattr(spec, "dtype", "float32")).replace("paddle.", "")
+            shapes.append(_jax.ShapeDtypeStruct(
+                _jexport.symbolic_shape(",".join(dims)), dt))
+        param_shapes = _jax.tree.map(
+            lambda v: _jax.ShapeDtypeStruct(v.shape, v.dtype), params)
+        exported = _jexport.export(_jax.jit(fwd))(param_shapes, *shapes)
+        save_exported(exported, path + ".stablehlo")
 
 
 def load(path, **configs):

@@ -119,6 +119,63 @@ class LlamaAttention(Layer):
         self.o_proj = RowParallelLinear(self.num_heads * hd, h,
                                         has_bias=False, input_is_parallel=True)
 
+    def forward_with_cache(self, x, cos_full, sin_full, cache, pos):
+        """Serving path: attend over a preallocated KV cache.
+
+        x: [B, S, h] (S>1 = prefill, S==1 = decode); cache: (k, v) jnp
+        arrays [B, S_max, Hkv, hd]; pos: int32 scalar — tokens already in
+        the cache. Returns (out, new_cache). The decode step is the
+        masked_multihead_attention analog (reference
+        fused_multi_transformer_op.cu.h:745); prefill uses the flash path.
+        """
+        b, s = x.shape[0], x.shape[1]
+        hd = self.config.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        k_cache, v_cache = cache
+
+        def attend(qv, kv, vv, kc, vc):
+            # rope at absolute positions [pos, pos+s)
+            cs = jax.lax.dynamic_slice_in_dim(cos_full, pos, s, axis=0)
+            sn = jax.lax.dynamic_slice_in_dim(sin_full, pos, s, axis=0)
+            qh = apply_rotary_emb(qv.reshape(b, s, self.num_heads, hd), cs, sn)
+            kh = apply_rotary_emb(kv.reshape(b, s, self.kv_heads, hd), cs, sn)
+            vh = vv.reshape(b, s, self.kv_heads, hd)
+            kc = jax.lax.dynamic_update_slice_in_dim(
+                kc, kh.astype(kc.dtype), pos, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(
+                vc, vh.astype(vc.dtype), pos, axis=1)
+            lens = jnp.full((b,), pos + s, jnp.int32)
+            if s == 1:
+                from ..ops._decode import gqa_decode_attention
+
+                ctx = gqa_decode_attention(
+                    qh[:, 0], kc, vc, lens)[:, None]      # [B, 1, Hq, hd]
+            elif isinstance(pos, int) and pos == 0:
+                # fresh prefill (the generation engine's case): plain causal
+                # flash over just the prompt — attending the full
+                # preallocated cache width would cost max_len/s extra work
+                from ..ops.pallas import flash_attention as _flash
+
+                ctx = _flash(qh, kh, vh, causal=True)
+            else:
+                # chunked prefill at a traced offset: masked SDPA over the
+                # written prefix of the cache
+                from ..nn.functional.flash_attention import _sdpa_ref
+
+                sq_pos = pos + jnp.arange(s)
+                kv_pos = jnp.arange(kc.shape[1])
+                mask = (kv_pos[None, :] <= sq_pos[:, None])
+                ctx = _sdpa_ref(qh, kc, vc,
+                                mask=mask[None, None], causal=False)
+            return ctx.reshape(b, s, self.num_heads * hd), kc, vc
+
+        ctx, kc, vc = apply_op(attend, q, k, v, k_cache, v_cache,
+                               op_name="cached_attention")
+        val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
+        return self.o_proj(ctx), (val(kc), val(vc))
+
     def forward(self, x, cos, sin, attn_mask=None):
         b = x.shape[0]
         s = x.shape[1]
@@ -181,6 +238,13 @@ class LlamaDecoderLayer(Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return constraint(x, P("dp", None, None))
 
+    def forward_with_cache(self, x, cos_full, sin_full, cache, pos):
+        attn, cache = self.self_attn.forward_with_cache(
+            self.input_layernorm(x), cos_full, sin_full, cache, pos)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
 
 class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
@@ -209,6 +273,31 @@ class LlamaModel(Layer):
             else:
                 x = layer(x, cos, sin, attn_mask)
         return self.norm(x)
+
+    def init_cache(self, batch_size: int, max_len: int):
+        """Preallocated per-layer KV caches (≙ the reference's
+        CacheKV tensors fed to fused_multi_transformer)."""
+        import numpy as _np
+
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype) if cfg.dtype != "float32" else jnp.float32
+        shape = (batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def forward_with_cache(self, input_ids, caches, pos):
+        cfg = self.config
+        x = self.embed_tokens(input_ids)
+        max_len = caches[0][0].shape[1]
+        cos_full, sin_full = _rope_cos_sin(
+            max_len, cfg.head_dim, cfg.rope_theta,
+            x.value.dtype if isinstance(x, Tensor) else x.dtype)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            x, cache = layer.forward_with_cache(x, cos_full, sin_full,
+                                                cache, pos)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
 
 
 class LlamaForCausalLM(Layer):
@@ -249,3 +338,11 @@ class LlamaForCausalLM(Layer):
         from ._utils import masked_lm_loss
 
         return masked_lm_loss(loss, labels, self.IGNORE_INDEX)
+
+    def init_cache(self, batch_size: int, max_len: int):
+        return self.model.init_cache(batch_size, max_len)
+
+    def forward_with_cache(self, input_ids, caches, pos):
+        """(logits_of_last_positions, new_caches) — the serving forward."""
+        hidden, caches = self.model.forward_with_cache(input_ids, caches, pos)
+        return self.logits(hidden), caches

@@ -1,0 +1,148 @@
+"""Scan-over-layers functional Llama — the TPU compile-time architecture.
+
+A 24-80 layer decoder inlined per-layer produces an HLO linear in depth;
+with every layer structurally identical, the TPU-idiomatic form stacks the
+per-layer parameters into leading-[L] arrays and runs ONE ``lax.scan`` over
+the decoder body, so the layer compiles once regardless of depth (and remat
+is a single ``jax.checkpoint`` on the scan body — exactly 1F1B-style
+activation memory: one layer's interior live at a time plus L carried
+boundaries).
+
+This is the functional counterpart of ``models/llama.py`` (same math, same
+parameter names — ``stack_params``/``unstack_params`` convert); the Layer
+API stays the eager/TP-annotated source of truth, this module is the
+high-performance jit target used by ``bench.py`` and large-scale training.
+Reference analog: the reference reaches the same goal with a static graph +
+while-op over fused_multi_transformer layers.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, _rope_cos_sin, apply_rotary_emb
+
+__all__ = ["stack_params", "unstack_params", "build_loss_fn",
+           "build_train_step"]
+
+_LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
+
+
+def stack_params(params: Dict[str, Any], cfg: LlamaConfig):
+    """Split a named-parameter dict into (stacked_layer_pytree, rest):
+    ``model.layers.i.K`` entries become ``stacked[K]`` with leading dim L."""
+    per_layer: Dict[str, list] = {}
+    rest: Dict[str, Any] = {}
+    for k, v in params.items():
+        m = _LAYER_RE.match(k)
+        if m:
+            per_layer.setdefault(m.group(2), []).append((int(m.group(1)), v))
+        else:
+            rest[k] = v
+    stacked = {}
+    for k, items in per_layer.items():
+        items.sort(key=lambda t: t[0])
+        assert len(items) == cfg.num_hidden_layers, (k, len(items))
+        stacked[k] = jnp.stack([v for _, v in items])
+    return stacked, rest
+
+
+def unstack_params(stacked: Dict[str, Any], rest: Dict[str, Any]):
+    """Inverse of stack_params (for checkpoint interop with the Layer API)."""
+    out = dict(rest)
+    for k, v in stacked.items():
+        for i in range(v.shape[0]):
+            out[f"model.layers.{i}.{k}"] = v[i]
+    return out
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    return (y * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _layer_fwd(lp: Dict[str, Any], x, cos, sin, cfg: LlamaConfig):
+    """One decoder layer, pure jax. Weight layout matches mp_layers Linear
+    (weight [in, out]); attention via the GQA flash kernel on TPU."""
+    b, s, h = x.shape
+    hd = cfg.head_dim
+    xn = _rms(x, lp["input_layernorm.weight"], cfg.rms_norm_eps)
+    q = xn @ lp["self_attn.q_proj.weight"]
+    k = xn @ lp["self_attn.k_proj.weight"]
+    v = xn @ lp["self_attn.v_proj.weight"]
+    qh = apply_rotary_emb(q.reshape(b, s, cfg.num_attention_heads, hd),
+                          cos, sin)
+    kh = apply_rotary_emb(k.reshape(b, s, cfg.kv_heads, hd), cos, sin)
+    vh = v.reshape(b, s, cfg.kv_heads, hd)
+    from ..ops.pallas import flash_attention
+
+    ctx = flash_attention(qh, kh, vh, causal=True)
+    ctx = ctx.reshape(b, s, cfg.num_attention_heads * hd)
+    x = x + ctx @ lp["self_attn.o_proj.weight"]
+    xn = _rms(x, lp["post_attention_layernorm.weight"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(xn @ lp["mlp.gate_proj.weight"])
+    up = xn @ lp["mlp.up_proj.weight"]
+    return x + (gate * up) @ lp["mlp.down_proj.weight"]
+
+
+def forward(stacked, rest, ids, cfg: LlamaConfig, remat: bool = True):
+    """Logits for [B, S] ids. Decoder runs as scan-over-layers."""
+    x = jnp.take(rest["model.embed_tokens.weight"], ids, axis=0)
+    cos, sin = _rope_cos_sin(ids.shape[1], cfg.head_dim, cfg.rope_theta,
+                             x.dtype)
+
+    def body(x, lp):
+        return _layer_fwd(lp, x, cos, sin, cfg), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, stacked)
+    x = _rms(x, rest["model.norm.weight"], cfg.rms_norm_eps)
+    if "lm_head.weight" in rest:
+        return x @ rest["lm_head.weight"]
+    return x @ rest["model.embed_tokens.weight"].T
+
+
+def build_loss_fn(cfg: LlamaConfig, remat: bool = True,
+                  ignore_index: int = -100):
+    """Pure (stacked, rest, ids, labels) -> mean CE loss."""
+
+    def loss_fn(stacked, rest, ids, labels):
+        logits = forward(stacked, rest, ids, cfg, remat)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lbl = jnp.clip(labels, 0, cfg.vocab_size - 1)
+        nll = -jnp.take_along_axis(logp, lbl[..., None], -1)[..., 0]
+        mask = (labels != ignore_index).astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    return loss_fn
+
+
+def build_train_step(cfg: LlamaConfig, lr: float = 1e-4,
+                     clip_norm: float = 1.0, remat: bool = True):
+    """Jittable AdamW train step over (stacked, rest) param pytrees.
+    Optimizer state is stacked too — the update compiles once per tensor
+    kind, not once per layer."""
+    from ..optimizer.functional import (adamw_init, adamw_update,
+                                        clip_by_global_norm)
+
+    loss_fn = build_loss_fn(cfg, remat)
+
+    def init(stacked, rest):
+        return adamw_init({"stacked": stacked, "rest": rest})
+
+    def step(stacked, rest, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p["stacked"], p["rest"], ids, labels))(
+                {"stacked": stacked, "rest": rest})
+        grads, _ = clip_by_global_norm(grads, clip_norm)
+        opt_state, params = adamw_update(
+            grads, opt_state, {"stacked": stacked, "rest": rest}, lr=lr)
+        return params["stacked"], params["rest"], opt_state, loss
+
+    return step, init

@@ -85,12 +85,34 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """reference paddle.static.gradients — grads of (summed) targets wrt
-    feed inputs are not tracked per-var here; parameter grads via
-    append_backward cover the training use."""
-    raise NotImplementedError(
-        "use append_backward for parameter gradients; input-gradients in "
-        "static mode land with the autodiff milestone")
+    """reference paddle.static.gradients: symbolic grads of (summed)
+    targets wrt feed inputs. Each returned var is fetchable; the executor
+    computes it with ``jax.grad`` of the recorded program wrt the feeds
+    (the reference appends grad ops into the ProgramDesc instead)."""
+    prog = default_main_program()
+    targets = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    ng = set(id(v) for v in (no_grad_set or []))
+    target_ids = tuple(id(t) for t in targets)
+    vid_to_feed = {vid: name for name, vid in prog.feed_vars.items()}
+    out = []
+    for inp in inputs:
+        if id(inp) in ng:
+            out.append(None)
+            continue
+        feed_name = vid_to_feed.get(id(inp))
+        if feed_name is None:
+            raise ValueError(
+                "static.gradients supports gradients wrt feed (data()) "
+                "variables; for parameter gradients use append_backward")
+        aval = inp._value if hasattr(inp, "_value") else inp
+        g = make_symbolic(aval, name=f"{feed_name}@GRAD")
+        prog.add_var(id(g), g.name, aval)
+        if not hasattr(prog, "input_grad_vars"):
+            prog.input_grad_vars = {}
+        prog.input_grad_vars[id(g)] = (target_ids, feed_name)
+        out.append(g)
+    return out
 
 
 class scope_guard:
@@ -152,8 +174,25 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
 
 
 def load_inference_model(path_prefix, executor, **kw):
-    raise NotImplementedError(
-        "serving path: use paddle_tpu.jit.save/load (AOT-compiled artifact)")
+    """Load a serving artifact saved by ``jit.save``/``save_inference_model``.
+
+    Returns ``(program, feed_names, fetch_names)`` shaped like the reference
+    API. ``program`` is an AOT-compiled Predictor
+    (inference/api/analysis_predictor.h:148 Run analog): run it with
+    ``program.run([input_arrays])`` (returns numpy outputs) — it is a
+    compiled executable, not an op-list for ``Executor.run``.
+    """
+    import os as _os
+
+    from ..inference import Config, create_predictor
+
+    if _os.path.exists(path_prefix + ".stablehlo") or _os.path.exists(
+            path_prefix + ".pdiparams"):
+        pred = create_predictor(Config(path_prefix))
+        exported = getattr(pred, "_exported", None)
+        n_out = len(exported.out_avals) if exported is not None else 1
+        return pred, pred.get_input_names(), [f"out{i}" for i in range(n_out)]
+    raise FileNotFoundError(f"no inference artifact at {path_prefix}")
 
 
 def cpu_places(device_count=None):

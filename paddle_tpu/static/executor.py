@@ -114,13 +114,28 @@ class Executor:
         def forward(feeds, params):
             return _walk(prog, base_env(feeds, params))
 
+        input_grad_vars = getattr(prog, "input_grad_vars", {})
+
+        def _input_grads_for(fid, feeds, params):
+            target_ids, feed_name = input_grad_vars[fid]
+
+            def f(fv):
+                # differentiate ONLY the requested feed — grad over the whole
+                # feeds dict would reject integer feeds (token ids)
+                env = forward({**feeds, feed_name: fv}, params)
+                return sum(jnp.sum(env[t]) for t in target_ids)
+
+            return jax.grad(f)(feeds[feed_name])
+
         if prog.train_config is None and not any(
                 fid in grad_vars for fid in fetch_ids):
 
             @jax.jit
             def infer_step(feeds, params):
                 env = forward(feeds, params)
-                return [env[fid] for fid in fetch_ids]
+                return [env[fid] if fid not in input_grad_vars
+                        else _input_grads_for(fid, feeds, params)
+                        for fid in fetch_ids]
 
             return infer_step
 
@@ -148,8 +163,11 @@ class Executor:
                     loss_of, has_aux=True)(params, feeds)
                 new_params, opt_state = optimizer._static_update(
                     params, grads, opt_state, lr=lr)
-                fetches = [env.get(fid) if fid not in grad_vars
-                           else grads[grad_vars[fid]] for fid in fetch_ids]
+                fetches = [
+                    grads[grad_vars[fid]] if fid in grad_vars
+                    else _input_grads_for(fid, feeds, params)
+                    if fid in input_grad_vars else env.get(fid)
+                    for fid in fetch_ids]
                 return fetches, new_params, opt_state
 
             return train_step
@@ -158,7 +176,10 @@ class Executor:
         def grad_step(feeds, params):
             (loss, env), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params, feeds)
-            return [env.get(fid) if fid not in grad_vars
-                    else grads[grad_vars[fid]] for fid in fetch_ids]
+            return [
+                grads[grad_vars[fid]] if fid in grad_vars
+                else _input_grads_for(fid, feeds, params)
+                if fid in input_grad_vars else env.get(fid)
+                for fid in fetch_ids]
 
         return grad_step

@@ -230,3 +230,24 @@ class TestStaticIO:
                                          np.zeros_like(w0))
         paddle.static.load(prog, path)
         np.testing.assert_array_equal(lin.weight.numpy(), w0)
+
+
+def test_static_input_gradients(static_mode):
+    """paddle.static.gradients wrt feed vars (reference static autodiff)."""
+    import numpy as np
+
+    from paddle_tpu import nn
+
+    if True:
+        x = paddle.static.data("xg", [4, 3], "float32")
+        lin = nn.Linear(3, 2, bias_attr=False)
+        y = lin(x)
+        out = paddle.sum(y * y)
+        (gx,) = paddle.static.gradients([out], [x])
+        exe = paddle.static.Executor()
+        exe.run(paddle.static.default_startup_program())
+        xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        res = exe.run(feed={"xg": xv}, fetch_list=[out, gx])
+        wv = np.asarray(lin.weight.value)
+        want = 2 * (xv @ wv) @ wv.T
+        np.testing.assert_allclose(res[1], want, rtol=1e-4, atol=1e-5)
