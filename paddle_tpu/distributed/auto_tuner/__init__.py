@@ -1,0 +1,23 @@
+"""Auto-tuner: automatic search over hybrid-parallel launch configs.
+
+Reference analog: python/paddle/distributed/auto_tuner/ (tuner.py:19).
+Searches {dp, mp, pp, sharding(+stage), micro_batch_size, recompute} with
+grid search + prune rules, runs each surviving candidate as a real trial
+(subprocess over a virtual or real device mesh), records tokens/sec per
+config and returns the best.
+
+    from paddle_tpu.distributed.auto_tuner import tune
+    best = tune({"num_devices": 8,
+                 "model_cfg": {"preset": "tiny", "global_batch_size": 8,
+                               "seq_len": 64}})
+"""
+from .prune import register_prune, same_cfgs_beside
+from .recorder import History_recorder, HistoryRecorder
+from .runner import run_trial
+from .search import GridSearch, SearchAlgo
+from .tuner import AutoTuner, tune
+from .utils import default_candidates, search_all
+
+__all__ = ["AutoTuner", "tune", "run_trial", "GridSearch", "SearchAlgo",
+           "HistoryRecorder", "History_recorder", "default_candidates",
+           "search_all", "register_prune", "same_cfgs_beside"]

@@ -1,0 +1,128 @@
+"""Prune rules for the auto-tuner search space.
+
+Reference analog: python/paddle/distributed/auto_tuner/prune.py
+(_PRUNE_FUNC registry, prune_by_mp:47, prune_by_pp:84, prune_by_mbs:117).
+Each rule returns True when the candidate config should be skipped. The
+history-based rule prunes configs dominated by an already-observed OOM
+(same parallelism, smaller or equal memory footprint succeeded/failed).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+_PRUNE_FUNC = []
+
+__all__ = ["register_prune", "prune_by_mp", "prune_by_pp", "prune_by_mbs",
+           "prune_by_sharding", "prune_by_degree_product",
+           "prune_by_memory_history", "same_cfgs_beside", "_PRUNE_FUNC"]
+
+
+def register_prune(func):
+    _PRUNE_FUNC.append(func)
+    return func
+
+
+def same_cfgs_beside(attr: str, cur_cfg: Dict,
+                     history_cfgs: List[Dict]) -> List[Dict]:
+    """History configs identical to cur_cfg except for `attr`."""
+    results = []
+    for cfg in history_cfgs:
+        if all(cfg.get(k) == v for k, v in cur_cfg.items() if k != attr):
+            results.append(cfg)
+    return results
+
+
+@register_prune
+def prune_by_degree_product(tuner_cfg, cur_cfg, history_cfgs=None):
+    """dp*mp*pp*sharding must exactly factor the device count."""
+    from .utils import num_devices
+
+    n = num_devices(tuner_cfg)
+    prod = (cur_cfg["dp_degree"] * cur_cfg["mp_degree"]
+            * cur_cfg["pp_degree"] * cur_cfg["sharding_degree"])
+    return prod != n
+
+
+@register_prune
+def prune_by_mp(tuner_cfg, cur_cfg, history_cfgs=None):
+    """hidden/vocab/num_heads must split evenly over mp; mp <= 8 default."""
+    mp = cur_cfg.get("mp_degree")
+    if not mp:
+        return False
+    model_cfg = tuner_cfg.get("model_cfg", {})
+    hidden = model_cfg.get("hidden_size")
+    vocab = model_cfg.get("vocab_size")
+    heads = model_cfg.get("num_attention_heads")
+    if hidden and hidden % mp != 0:
+        return True
+    if vocab and vocab % mp != 0:
+        return True
+    if heads and heads % mp != 0:
+        return True
+    return mp > 8
+
+
+@register_prune
+def prune_by_pp(tuner_cfg, cur_cfg, history_cfgs=None):
+    """layers must split evenly over pp stages; microbatch count must cover
+    the pipeline (acc_steps >= pp for a full 1F1B schedule)."""
+    pp = cur_cfg.get("pp_degree")
+    if not pp:
+        return False
+    model_cfg = tuner_cfg.get("model_cfg", {})
+    layers = model_cfg.get("num_layers")
+    if layers and layers % pp != 0:
+        return True
+    gbs = model_cfg.get("global_batch_size")
+    mbs = cur_cfg.get("micro_batch_size")
+    dp = cur_cfg.get("dp_degree", 1) * cur_cfg.get("sharding_degree", 1)
+    if gbs and mbs and pp > 1:
+        acc = gbs // (mbs * dp)
+        if acc < pp:
+            return True
+    return False
+
+
+@register_prune
+def prune_by_mbs(tuner_cfg, cur_cfg, history_cfgs=None):
+    """micro_batch_size must divide the per-replica batch."""
+    gbs = tuner_cfg.get("model_cfg", {}).get("global_batch_size")
+    mbs = cur_cfg.get("micro_batch_size")
+    if not (gbs and mbs):
+        return False
+    dp = cur_cfg.get("dp_degree", 1) * cur_cfg.get("sharding_degree", 1)
+    if gbs % dp != 0:
+        return True
+    local = gbs // dp
+    return local % mbs != 0
+
+
+@register_prune
+def prune_by_sharding(tuner_cfg, cur_cfg, history_cfgs=None):
+    """stage>1 needs an actual sharding axis; stage must be 1/2/3."""
+    stage = cur_cfg.get("sharding_stage", 1)
+    deg = cur_cfg.get("sharding_degree", 1)
+    if stage not in (1, 2, 3):
+        return True
+    if deg == 1 and stage != 1:
+        return True
+    return False
+
+
+@register_prune
+def prune_by_memory_history(tuner_cfg, cur_cfg, history_cfgs=None):
+    """If an identical config except a SMALLER micro_batch_size (or lighter
+    recompute) already OOMed, this one will too — skip without running."""
+    if not history_cfgs:
+        return False
+    rc_rank = {"none": 0, "dots": 1, "full": 2}
+    for prev in same_cfgs_beside("micro_batch_size", cur_cfg, history_cfgs):
+        if prev.get("error") == "oom" and \
+                prev["micro_batch_size"] <= cur_cfg["micro_batch_size"]:
+            return True
+    for prev in same_cfgs_beside("use_recompute", cur_cfg, history_cfgs):
+        if prev.get("error") == "oom" and \
+                rc_rank.get(prev.get("use_recompute"), 0) >= \
+                rc_rank.get(cur_cfg.get("use_recompute"), 0):
+            return True
+    return False

@@ -1,0 +1,78 @@
+"""Trial-history recorder with CSV persistence.
+
+Reference analog: python/paddle/distributed/auto_tuner/recorder.py
+(History_recorder:22) — csv module instead of pandas.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Optional, Tuple
+
+__all__ = ["HistoryRecorder", "History_recorder"]
+
+
+def _from_csv(v):
+    """CSV stores strings; metrics must come back numeric or sort_metric
+    would compare lexicographically ("9.0" > "100.0")."""
+    if v is None or v == "":
+        return None
+    for conv in (int, float):
+        try:
+            return conv(v)
+        except (TypeError, ValueError):
+            continue
+    return v
+
+
+class HistoryRecorder:
+    def __init__(self) -> None:
+        self.history = []
+        self.store_path: Optional[str] = None
+
+    def add_cfg(self, **kwargs):
+        self.history.append(dict(kwargs))
+
+    def sort_metric(self, direction, metric_name) -> None:
+        reverse = direction == "Maximize"
+        bad = float("-inf") if reverse else float("inf")
+        self.history.sort(
+            key=lambda x: x.get(metric_name) if x.get(metric_name) is not None
+            else bad,
+            reverse=reverse)
+
+    def get_best(self, metric, direction) -> Tuple[Optional[dict], bool]:
+        """Returns (best_cfg, err). err=True when there is nothing usable."""
+        self.sort_metric(direction=direction, metric_name=metric)
+        if not self.history or self.history[0].get(metric) is None:
+            return None, True
+        return self.history[0], False
+
+    def store_history(self, path="./history.csv"):
+        self.store_path = path
+        keys = []
+        for rec in self.history:
+            for k in rec:
+                if k not in keys:
+                    keys.append(k)
+        if "job_id" in keys:  # reference puts job_id first
+            keys.insert(0, keys.pop(keys.index("job_id")))
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for rec in self.history:
+                w.writerow(rec)
+
+    def load_history(self, path="./history.csv") -> Tuple[list, bool]:
+        if self.store_path is None:
+            self.store_path = path
+        if not os.path.exists(self.store_path):
+            return self.history, True
+        with open(self.store_path, newline="") as f:
+            self.history = [
+                {k: _from_csv(v) for k, v in r.items()}
+                for r in csv.DictReader(f)]
+        return self.history, False
+
+
+History_recorder = HistoryRecorder  # reference-compatible alias

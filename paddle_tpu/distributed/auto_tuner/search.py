@@ -1,0 +1,43 @@
+"""Search algorithms over the pruned candidate grid.
+
+Reference analog: python/paddle/distributed/auto_tuner/search.py
+(SearchAlgo:22, GridSearch:38).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from .prune import _PRUNE_FUNC
+from .utils import search_all
+
+__all__ = ["SearchAlgo", "GridSearch"]
+
+
+class SearchAlgo(ABC):
+    def __init__(self, tuner_cfg):
+        self.tuner_cfg = tuner_cfg
+
+    @abstractmethod
+    def search_once(self, history_cfgs):
+        ...
+
+    def prune(self, tuner_cfg, cur_cfg, history_cfgs):
+        return any(f(tuner_cfg, cur_cfg, history_cfgs)
+                   for f in _PRUNE_FUNC)
+
+
+class GridSearch(SearchAlgo):
+    """Exhaustive walk over the promise-ordered grid, skipping pruned."""
+
+    def __init__(self, tuner_cfg):
+        super().__init__(tuner_cfg)
+        self.idx = 0
+        self.all_tasks = search_all(tuner_cfg)
+
+    def search_once(self, history_cfgs):
+        while self.idx < len(self.all_tasks):
+            cfg = self.all_tasks[self.idx]
+            self.idx += 1
+            if not self.prune(self.tuner_cfg, cfg, history_cfgs):
+                return dict(cfg)
+        return None

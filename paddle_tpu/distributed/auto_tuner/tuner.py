@@ -1,0 +1,81 @@
+"""AutoTuner: grid/prune search over hybrid-parallel configs.
+
+Reference analog: python/paddle/distributed/auto_tuner/tuner.py:19
+(AutoTuner.search_once loop driven by the launcher). TPU-native: `tune()`
+closes the whole loop in-process — search_once → run_trial (subprocess on a
+virtual or real mesh) → record — and returns the best config by the target
+metric.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .recorder import HistoryRecorder
+from .search import GridSearch
+from .utils import default_candidates
+
+__all__ = ["AutoTuner", "tune"]
+
+
+class AutoTuner:
+    def __init__(self, tuner_cfg: Dict):
+        self.cur_task_id = 1
+        self.task_limit = tuner_cfg.get("task_limit", 100)
+        tuner_cfg = dict(tuner_cfg)
+        tuner_cfg["candidates"] = default_candidates(tuner_cfg)
+        search_algo = tuner_cfg.get("search_algo", "grid")
+        if search_algo == "grid":
+            self.algo = GridSearch(tuner_cfg)
+        else:
+            raise NotImplementedError(
+                f"search_algo {search_algo!r} (only 'grid')")
+        self.tuner_cfg = tuner_cfg
+        self.history_cfgs = []
+
+    def search_once(self) -> Optional[Dict]:
+        """Next un-pruned candidate, or None when the space is exhausted."""
+        if self.cur_task_id > self.task_limit:
+            return None
+        new_cfg = self.algo.search_once(self.history_cfgs)
+        if new_cfg is None:
+            return None
+        self.cur_task_id += 1
+        self.history_cfgs.append(new_cfg)
+        return new_cfg
+
+    def add_cfg(self, cfg: Dict):
+        """Feed a trial result back so history-based prunes see it."""
+        for h in self.history_cfgs:
+            if all(h.get(k) == cfg.get(k) for k in h):
+                h.update(cfg)
+                return
+        self.history_cfgs.append(cfg)
+
+
+def tune(tuner_cfg: Dict,
+         run_fn: Optional[Callable[[Dict], Dict]] = None,
+         history_csv: Optional[str] = None) -> Optional[Dict]:
+    """Full search loop. ``run_fn(cfg) -> metrics`` overrides the built-in
+    subprocess runner (useful for tests / custom models). Returns the best
+    record by ``metric`` (default tokens_per_sec, maximized)."""
+    from .runner import run_trial
+
+    tuner = AutoTuner(tuner_cfg)
+    recorder = HistoryRecorder()
+    metric = tuner_cfg.get("metric", "tokens_per_sec")
+    direction = tuner_cfg.get("direction", "Maximize")
+    job_id = 0
+    while True:
+        cfg = tuner.search_once()
+        if cfg is None:
+            break
+        job_id += 1
+        rec = (run_fn(cfg) if run_fn is not None
+               else run_trial(cfg, tuner.tuner_cfg))
+        rec = {**cfg, **rec, "job_id": job_id}
+        tuner.add_cfg(rec)
+        recorder.add_cfg(**rec)
+    if history_csv:
+        recorder.store_history(history_csv)
+    best, err = recorder.get_best(metric, direction)
+    return None if err else best

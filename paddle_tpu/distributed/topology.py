@@ -63,7 +63,21 @@ class Group:
 
     @property
     def rank(self) -> int:
-        return 0  # single-controller host view; device coord = lax.axis_index
+        """Group-local coordinate of THIS process (reference Group.rank).
+
+        Single-host single-controller: 0 (device coord = lax.axis_index
+        inside traced code). Multi-host: the axis coordinate of the first
+        mesh device owned by this process — e.g. on a 2-host dp=2 mesh,
+        host 1 sees dp rank 1."""
+        if self.axis_name is not None:
+            return process_axis_coord(self.mesh, self.axis_name)
+        if self.ranks:
+            from .env import get_rank
+
+            # -1 for non-members (reference Group.rank contract): leader
+            # checks like `if group.rank == 0` must not fire on outsiders
+            return self.get_group_rank(get_rank())
+        return 0
 
     def get_group_rank(self, rank):
         return self.ranks.index(rank) if rank in self.ranks else -1
@@ -74,6 +88,22 @@ class Group:
 
     def __repr__(self):
         return f"Group(id={self.id}, axis={self.axis_name}, nranks={self.nranks})"
+
+
+def process_axis_coord(mesh: Mesh, axis_name: str) -> int:
+    """Axis coordinate of the current process's first owned device in the
+    mesh (0 when this process owns none / single-process)."""
+    try:
+        pid = jax.process_index()
+    except Exception:
+        return 0
+    if pid == 0 and jax.process_count() == 1:
+        return 0
+    axis = list(mesh.axis_names).index(axis_name)
+    for coord, dev in np.ndenumerate(mesh.devices):
+        if getattr(dev, "process_index", 0) == pid:
+            return int(coord[axis])
+    return 0
 
 
 def build_mesh(dp: int = 1, pp: int = 1, sharding: int = 1, mp: int = 1,

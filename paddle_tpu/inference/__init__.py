@@ -145,6 +145,13 @@ class Predictor:
             self._fn = lambda *xs: exported.call(params, *xs)
             self._input_names = [f"x{i}"
                                  for i in range(len(exported.in_avals) - 1)]
+        else:
+            # params-only artifact (jit.save without input_spec exports no
+            # program): fail here, not with a TypeError at first run()
+            raise FileNotFoundError(
+                f"{path}.stablehlo missing: the artifact has weights but no "
+                "exported program — re-save with jit.save(layer, path, "
+                "input_spec=[...]) to emit one")
 
     @classmethod
     def from_layer(cls, layer, example_inputs: Sequence[Any],

@@ -73,7 +73,16 @@ class CausalLMEngine:
         self.max_batch = max_batch
         self.max_len = max_len
         self.params = {k: p.value for k, p in model.named_parameters()}
-        self._prefill_cache = {}
+
+        def prefill(params, ids, caches):
+            logits, caches = self._fwd(params, ids, caches, 0)
+            return logits[:, -1], caches
+
+        # one jitted prefill: jax.jit's own cache already specializes per
+        # prompt-length/batch shape. decode stays keyed by GenerationConfig
+        # because the config is *trace-static* (branching on do_sample/eos),
+        # not shape-derived.
+        self._prefill = jax.jit(prefill, donate_argnums=(2,))
         self._decode_cache = {}
 
     # -- pure functions -------------------------------------------------------
@@ -87,14 +96,7 @@ class CausalLMEngine:
                 caches)
 
     def _prefill_fn(self, prompt_len: int):
-        if prompt_len not in self._prefill_cache:
-            def prefill(params, ids, caches):
-                logits, caches = self._fwd(params, ids, caches, 0)
-                return logits[:, -1], caches
-
-            self._prefill_cache[prompt_len] = jax.jit(
-                prefill, donate_argnums=(2,))
-        return self._prefill_cache[prompt_len]
+        return self._prefill
 
     def _decode_fn(self, n_steps: int, cfg: GenerationConfig):
         key_cfg = (n_steps, cfg.do_sample, cfg.temperature, cfg.top_k,
@@ -136,6 +138,10 @@ class CausalLMEngine:
         ids = np.asarray(input_ids.value if isinstance(input_ids, Tensor)
                          else input_ids).astype(np.int32)
         b, plen = ids.shape
+        if b > self.max_batch:
+            raise ValueError(
+                f"batch {b} exceeds max_batch={self.max_batch} the engine "
+                f"was built for")
         if plen + cfg.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt({plen}) + max_new_tokens({cfg.max_new_tokens}) "

@@ -90,7 +90,20 @@ def _layer_fwd(lp: Dict[str, Any], x, cos, sin, cfg: LlamaConfig):
     return x + (gate * up) @ lp["mlp.down_proj.weight"]
 
 
-def forward(stacked, rest, ids, cfg: LlamaConfig, remat: bool = True):
+def _remat_policy(remat):
+    """Map a remat spec to a jax.checkpoint policy. True/"full" = save
+    nothing (recompute everything, ~1.33x FLOPs); "dots" = save matmul
+    outputs (recompute only elementwise, near-zero FLOP overhead at the
+    cost of per-layer dot residuals); False/"none" = no checkpoint."""
+    if remat in (True, "full"):
+        return {}
+    if remat == "dots":
+        return {"policy":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable}
+    raise ValueError(f"unknown remat spec {remat!r}")
+
+
+def forward(stacked, rest, ids, cfg: LlamaConfig, remat=True):
     """Logits for [B, S] ids. Decoder runs as scan-over-layers."""
     x = jnp.take(rest["model.embed_tokens.weight"], ids, axis=0)
     cos, sin = _rope_cos_sin(ids.shape[1], cfg.head_dim, cfg.rope_theta,
@@ -99,8 +112,8 @@ def forward(stacked, rest, ids, cfg: LlamaConfig, remat: bool = True):
     def body(x, lp):
         return _layer_fwd(lp, x, cos, sin, cfg), None
 
-    if remat:
-        body = jax.checkpoint(body)
+    if remat not in (False, "none"):
+        body = jax.checkpoint(body, **_remat_policy(remat))
     x, _ = jax.lax.scan(body, x, stacked)
     x = _rms(x, rest["model.norm.weight"], cfg.rms_norm_eps)
     if "lm_head.weight" in rest:
@@ -108,15 +121,19 @@ def forward(stacked, rest, ids, cfg: LlamaConfig, remat: bool = True):
     return x @ rest["model.embed_tokens.weight"].T
 
 
-def build_loss_fn(cfg: LlamaConfig, remat: bool = True,
+def build_loss_fn(cfg: LlamaConfig, remat=True,
                   ignore_index: int = -100):
     """Pure (stacked, rest, ids, labels) -> mean CE loss."""
 
     def loss_fn(stacked, rest, ids, labels):
         logits = forward(stacked, rest, ids, cfg, remat)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # lse − logit[label] form: never materializes a [B,S,V] fp32
+        # log-softmax (the convert fuses into the reduction; the direct
+        # form wrote+read an extra ~3x vocab-sized fp32 temp)
         lbl = jnp.clip(labels, 0, cfg.vocab_size - 1)
-        nll = -jnp.take_along_axis(logp, lbl[..., None], -1)[..., 0]
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        tgt = jnp.take_along_axis(logits, lbl[..., None], -1)[..., 0]
+        nll = lse - tgt.astype(jnp.float32)
         mask = (labels != ignore_index).astype(jnp.float32)
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
@@ -124,7 +141,7 @@ def build_loss_fn(cfg: LlamaConfig, remat: bool = True,
 
 
 def build_train_step(cfg: LlamaConfig, lr: float = 1e-4,
-                     clip_norm: float = 1.0, remat: bool = True):
+                     clip_norm: float = 1.0, remat=True):
     """Jittable AdamW train step over (stacked, rest) param pytrees.
     Optimizer state is stacked too — the update compiles once per tensor
     kind, not once per layer."""
