@@ -87,6 +87,64 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
 
     hcg = get_hybrid_communicate_group()
     strategy = strategy or _fleet_state["strategy"] or DistributedStrategy()
+    # strategy-driven meta-optimizer transforms (reference meta_optimizers/
+    # passes; innermost closest to the raw optimizer)
+    if getattr(strategy, "lamb", False):
+        from ...optimizer import Lamb
+
+        cfg = getattr(strategy, "lamb_configs", {}) or {}
+        exclude = cfg.get("exclude_from_weight_decay") or []
+        optimizer = Lamb(
+            learning_rate=optimizer._lr,  # keeps an LRScheduler live
+            lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+            parameters=optimizer._parameter_list,
+            grad_clip=getattr(optimizer, "_grad_clip", None),
+            exclude_from_weight_decay_fn=(
+                (lambda p: any(tok in (p.name or "") for tok in exclude))
+                if exclude else None))
+    if getattr(strategy, "lars", False):
+        from .meta_optimizers import LarsMomentumOptimizer
+
+        cfg = getattr(strategy, "lars_configs", {}) or {}
+        optimizer = LarsMomentumOptimizer(
+            learning_rate=optimizer._lr,
+            momentum=cfg.get("momentum", 0.9),
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            epsilon=cfg.get("epsilon", 0.0),
+            parameters=optimizer._parameter_list,
+            grad_clip=getattr(optimizer, "_grad_clip", None),
+            exclude_from_weight_decay=cfg.get(
+                "exclude_from_weight_decay", None))
+    if getattr(strategy, "dgc", False):
+        from .meta_optimizers import DGCMomentumOptimizer
+
+        cfg = getattr(strategy, "dgc_configs", {}) or {}
+        sp = cfg.get("sparsity", [0.999])
+        optimizer = DGCMomentumOptimizer.from_momentum(
+            optimizer,
+            sparsity=sp[-1] if isinstance(sp, (list, tuple)) else sp,
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            rampup_step=cfg.get("rampup_step", 1))
+    if getattr(strategy, "fp16_allreduce", False):
+        from .meta_optimizers import FP16AllReduceOptimizer
+
+        optimizer = FP16AllReduceOptimizer(optimizer)
+    if getattr(strategy, "localsgd", False):
+        from .meta_optimizers import LocalSGDOptimizer
+
+        cfg = getattr(strategy, "localsgd_configs", {}) or {}
+        optimizer = LocalSGDOptimizer(optimizer,
+                                      k_steps=cfg.get("k_steps", 1),
+                                      begin_step=cfg.get("begin_step", 1),
+                                      hcg=hcg)
+    if getattr(strategy, "gradient_merge", False):
+        from .meta_optimizers import GradientMergeOptimizer
+
+        cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
+        optimizer = GradientMergeOptimizer(optimizer,
+                                           k_steps=cfg.get("k_steps", 1),
+                                           avg=cfg.get("avg", True))
     if hcg.get_sharding_parallel_world_size() > 1:
         # stage-1 state sharding under the hybrid wrapper (reference
         # fleet.py:1044 composes DygraphShardingOptimizer the same way)

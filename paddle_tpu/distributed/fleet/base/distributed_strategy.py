@@ -63,9 +63,19 @@ class DistributedStrategy:
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1
         self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005,
+                             "epsilon": 0.0,
+                             "exclude_from_weight_decay": []}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.fp16_allreduce = False
         self.heter_ccl_mode = False
         self.without_graph_optimization = True
 

@@ -1,1 +1,19 @@
+"""Meta-optimizers: strategy-driven optimizer transforms.
+
+Reference analog: fleet/meta_optimizers/ — static-graph passes that rewrite
+the program per DistributedStrategy flag. TPU-native: the same transforms
+wrap the eager optimizer (fleet.distributed_optimizer composes them from
+the strategy), and the compiled path gets the equivalent semantics from
+jit-level machinery (grad accumulation in the train step, bf16 arrays on
+the wire).
+"""
 from . import dygraph_optimizer
+from .dgc_optimizer import DGCMomentumOptimizer
+from .fp16_allreduce_optimizer import FP16AllReduceOptimizer
+from .gradient_merge_optimizer import GradientMergeOptimizer
+from .lars_optimizer import LarsMomentumOptimizer
+from .localsgd_optimizer import LocalSGDOptimizer
+
+__all__ = ["dygraph_optimizer", "GradientMergeOptimizer",
+           "LocalSGDOptimizer", "DGCMomentumOptimizer",
+           "LarsMomentumOptimizer", "FP16AllReduceOptimizer"]

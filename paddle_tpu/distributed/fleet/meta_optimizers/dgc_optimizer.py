@@ -1,0 +1,87 @@
+"""DGC (Deep Gradient Compression) momentum with error feedback.
+
+Reference analog: fleet/meta_optimizers/dgc_optimizer.py +
+paddle/fluid/operators/dgc_op.* (top-k gradient sparsification, momentum
+correction, error accumulation; Lin et al. 2017). Like the reference's
+DGCMomentumOptimizer, this IS the momentum optimizer — the DGC velocity u
+replaces the plain momentum accumulator (wrapping a second momentum stage
+would apply momentum twice).
+
+TPU-native note: DGC exists to compress the dp gradient *exchange*; under
+single-controller SPMD the exchange is an XLA collective, so the transform
+preserves the NUMERICAL semantics (momentum correction + top-k masking +
+error feedback) with a dense masked tensor — sparsity is a wire format,
+and the wire belongs to XLA.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....optimizer.optimizer import Optimizer
+
+__all__ = ["DGCMomentumOptimizer"]
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """``sparsity`` is the DROP ratio (0.999 → keep the top 0.1% of
+    gradient entries by magnitude). Before ``rampup_begin_step`` no
+    compression is applied; over the following ``rampup_step`` updates the
+    sparsity ramps linearly from 0 to its target (reference rampup
+    semantics, dgc_optimizer.py)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 sparsity=0.999, rampup_begin_step=0, rampup_step=1,
+                 use_nesterov=False, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._sparsity = float(sparsity)
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._count = 0
+        self._e = {}  # error feedback (what masking dropped)
+
+    @classmethod
+    def from_momentum(cls, inner, sparsity=0.999, rampup_begin_step=0,
+                      rampup_step=1):
+        """Build from an existing Momentum optimizer's settings (the
+        strategy path: dgc REPLACES the momentum optimizer)."""
+        return cls(learning_rate=inner._lr,
+                   momentum=getattr(inner, "_momentum", 0.9),
+                   parameters=inner._parameter_list,
+                   sparsity=sparsity, rampup_begin_step=rampup_begin_step,
+                   rampup_step=rampup_step,
+                   use_nesterov=getattr(inner, "_use_nesterov", False),
+                   grad_clip=getattr(inner, "_grad_clip", None))
+
+    def _cur_sparsity(self):
+        past = self._count - self._rampup_begin_step
+        if past <= 0:
+            return 0.0
+        frac = min(1.0, past / self._rampup_step)
+        return self._sparsity * frac
+
+    def step(self):
+        self._count += 1
+        super().step()
+
+    def _update_param(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        u = self._acc("velocity", p)
+        u = self._momentum * u + g32
+        sparsity = self._cur_sparsity()
+        if sparsity > 0.0:
+            k = self._key(p)
+            c = u + self._e.get(k, jnp.zeros_like(u))
+            thresh = jnp.quantile(jnp.abs(c).reshape(-1).astype(jnp.float32),
+                                  sparsity)
+            mask = (jnp.abs(c) >= thresh).astype(jnp.float32)
+            self._e[k] = c * (1.0 - mask)
+            u = u * (1.0 - mask)
+            upd = c * mask
+        else:
+            upd = u
+        self._set_acc("velocity", p, u)
+        if self._use_nesterov and sparsity == 0.0:
+            upd = g32 + self._momentum * u
+        return (p.value.astype(jnp.float32) - lr * upd).astype(p.value.dtype)

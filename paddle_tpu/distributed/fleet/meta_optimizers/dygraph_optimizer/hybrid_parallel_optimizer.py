@@ -57,10 +57,16 @@ class HybridParallelOptimizer:
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
-        clip = getattr(optimizer, "_grad_clip", None)
+        # walk wrapper meta-optimizers (gradient-merge, fp16-allreduce, …)
+        # to the raw Optimizer: its step() reads its OWN _grad_clip, so the
+        # swap must land there, not on a delegating wrapper
+        raw = optimizer
+        while hasattr(raw, "_inner_opt"):
+            raw = raw._inner_opt
+        clip = getattr(raw, "_grad_clip", None)
         if isinstance(clip, ClipGradByGlobalNorm) and not isinstance(
                 clip, HybridParallelClipGrad):
-            optimizer._grad_clip = HybridParallelClipGrad(clip, hcg)
+            raw._grad_clip = HybridParallelClipGrad(clip, hcg)
         # sharding stage-1: shard optimizer states over the sharding axis
         sharding_degree = (hcg.get_sharding_parallel_world_size()
                            if hcg is not None else 1)

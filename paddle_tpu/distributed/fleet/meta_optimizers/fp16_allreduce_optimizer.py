@@ -1,0 +1,35 @@
+"""FP16AllReduce — exchange gradients in half precision.
+
+Reference analog: fleet/meta_optimizers/fp16_allreduce_optimizer.py (casts
+grads fp32→fp16 before c_allreduce, back after). TPU-native: the dp
+exchange is an XLA collective whose wire dtype IS the array dtype, so the
+transform rounds the gradient through bf16 (the TPU half format) at step
+time — same bandwidth halving, same quantization semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["FP16AllReduceOptimizer"]
+
+
+class FP16AllReduceOptimizer:
+    def __init__(self, inner_optimizer, dtype=jnp.bfloat16):
+        self._inner_opt = inner_optimizer
+        self._dtype = dtype
+
+    def step(self):
+        from ....core.tensor import Tensor
+
+        for p, g in self._inner_opt._collect_params_grads():
+            if g is None:
+                continue
+            p.grad = Tensor(
+                g.value.astype(self._dtype).astype(g.value.dtype))
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

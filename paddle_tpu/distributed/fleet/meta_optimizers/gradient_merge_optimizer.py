@@ -1,0 +1,59 @@
+"""GradientMergeOptimizer — accumulate k steps of gradients, update once.
+
+Reference analog: fleet/meta_optimizers/gradient_merge_optimizer.py (the
+static-graph pass rewrites the program with gradient-merge vars + a cond;
+here the same semantics wrap the eager optimizer: fp32 accumulation
+buffers, an update every ``k_steps``-th call, optional averaging).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["GradientMergeOptimizer"]
+
+
+class GradientMergeOptimizer:
+    def __init__(self, inner_optimizer, k_steps: int = 1, avg: bool = True):
+        self._inner_opt = inner_optimizer
+        self._k_steps = max(1, int(k_steps))
+        self._avg = avg
+        self._count = 0
+        self._acc = {}  # param key -> fp32 accumulator
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def _key(self, p):
+        return self._inner_opt._key(p)
+
+    def step(self):
+        self._count += 1
+        do_update = self._count % self._k_steps == 0
+        pgs = self._inner_opt._collect_params_grads()
+        for p, g in pgs:
+            if g is None:
+                continue
+            k = self._key(p)
+            a = self._acc.get(k)
+            g32 = g.value.astype(jnp.float32)
+            self._acc[k] = g32 if a is None else a + g32
+        if not do_update:
+            # swallow this step: grads are banked, inner never sees them
+            self._inner_opt.clear_grad()
+            return
+        from ....core.tensor import Tensor
+
+        scale = 1.0 / self._k_steps if self._avg else 1.0
+        for p, g in pgs:
+            k = self._key(p)
+            if k in self._acc:
+                p.grad = Tensor((self._acc[k] * scale).astype(p.value.dtype))
+        self._acc.clear()
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

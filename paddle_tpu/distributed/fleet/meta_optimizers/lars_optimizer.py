@@ -1,0 +1,45 @@
+"""LARS momentum (You et al. 2017) — layerwise trust-ratio LR scaling.
+
+Reference analog: fleet/meta_optimizers/lars_optimizer.py +
+python/paddle/fluid optimizer LarsMomentumOptimizer (lars_op kernel):
+local_lr = lr * coeff * ||w|| / (||g|| + lambda*||w||), then momentum.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....optimizer.optimizer import Optimizer
+
+__all__ = ["LarsMomentumOptimizer"]
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-9, name=None,
+                 exclude_from_weight_decay=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _update_param(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        w32 = p.value.astype(jnp.float32)
+        wd = self._lars_wd
+        if any(tok in (p.name or "") for tok in self._exclude):
+            wd = 0.0
+        w_norm = jnp.sqrt(jnp.sum(w32 * w32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + wd * w_norm
+                                         + self._epsilon),
+            1.0)
+        local_lr = lr * trust
+        v = self._acc("velocity", p)
+        v_new = self._momentum * v + local_lr * (g32 + wd * w32)
+        self._set_acc("velocity", p, v_new)
+        return (w32 - v_new).astype(p.value.dtype)

@@ -1,0 +1,66 @@
+"""LocalSGDOptimizer — k local updates, then average params over dp.
+
+Reference analog: fleet/meta_optimizers/localsgd_optimizer.py (inserts
+c_allreduce on the params every k_steps). TPU-native: the averaging is an
+eager all_reduce over the data-parallel group (XLA collective / stacked
+ranks), params divided by dp world size.
+"""
+from __future__ import annotations
+
+__all__ = ["LocalSGDOptimizer"]
+
+
+class LocalSGDOptimizer:
+    def __init__(self, inner_optimizer, k_steps: int = 1,
+                 begin_step: int = 1, hcg=None):
+        self._inner_opt = inner_optimizer
+        self._k_steps = max(1, int(k_steps))
+        self._begin_step = int(begin_step)
+        self._count = 0
+        self._hcg = hcg
+
+    def _hybrid_spans_processes(self):
+        if self._hcg is None:
+            from ... import fleet
+
+            try:
+                self._hcg = fleet.get_hybrid_communicate_group()
+            except Exception:
+                return False
+        h = self._hcg
+        return (h.get_model_parallel_world_size() > 1
+                or h.get_pipe_parallel_world_size() > 1
+                or h.get_sharding_parallel_world_size() > 1)
+
+    def step(self):
+        self._inner_opt.step()
+        self._count += 1
+        if self._count < self._begin_step or self._count % self._k_steps:
+            return
+        import jax
+
+        if jax.process_count() == 1:
+            # single-controller SPMD: params are logically global arrays —
+            # every "replica" already sees the same values, the dp average
+            # is the identity. The sync only has content across processes.
+            return
+        if self._hybrid_spans_processes():
+            # processes hold different mp/pp/sharding shards — a flat
+            # all-process mean would average unrelated tensors together
+            raise NotImplementedError(
+                "multi-process localsgd is only supported for pure-dp "
+                "meshes (mp/pp/sharding degree 1)")
+        from jax.experimental import multihost_utils
+
+        import jax.numpy as jnp
+
+        for p in self._inner_opt._parameter_list or []:
+            gathered = multihost_utils.process_allgather(p.value)
+            p.set_value(jnp.mean(
+                gathered.astype(jnp.float32), axis=0).astype(p.value.dtype))
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

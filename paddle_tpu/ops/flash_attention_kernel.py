@@ -66,7 +66,7 @@ def _pick_block(s: int, target: int, interpret: bool) -> Optional[int]:
         from .pallas_kernels import _row_block
 
         return _row_block(s, target)
-    for b in (target, 512, 256, 128):
+    for b in (target, 1024, 512, 256, 128):
         if b <= target and s % b == 0:
             return b
     return None
@@ -75,8 +75,8 @@ def _pick_block(s: int, target: int, interpret: bool) -> Optional[int]:
 def supports(sq: int, sk: int, interpret: Optional[bool] = None) -> bool:
     """Whether the Pallas kernel can handle these sequence lengths."""
     it = _interpret() if interpret is None else interpret
-    return (_pick_block(sq, 512, it) is not None
-            and _pick_block(sk, 512, it) is not None)
+    return (_pick_block(sq, 1024, it) is not None
+            and _pick_block(sk, 1024, it) is not None)
 
 
 # ---------------------------------------------------------------------------
@@ -162,14 +162,16 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         v = v_ref[0, 0]
         s, valid = _block_scores(q, k, sm_scale, causal, iq, ik,
                                  block_q, block_k, offset)
-        m_prev = m_ref[...]                  # (bq, 128), cols identical
-        l_prev = l_ref[...]
+        # single-column running stats: alpha's exp runs on (bq, 1), not the
+        # (bq, 128) replicated buffer — transcendentals are the VPU cost
+        m_prev = m_ref[:, 0:1]               # (bq, 1)
+        l_prev = l_ref[:, 0:1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)      # (bq, 1)
-        m_new = jnp.maximum(m_prev, m_cur)              # (bq, 128)
-        p = jnp.exp(s - m_new[:, 0:1])
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
         if causal:
             p = jnp.where(valid, p, 0.0)
-        alpha = jnp.exp(m_prev - m_new)                 # (bq, 128)
+        alpha = jnp.exp(m_prev - m_new)                 # (bq, 1)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_p > 0.0:
             # l accumulates UNdropped p (softmax normalizer is exact); only
@@ -178,13 +180,13 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                           dropout_p)
         else:
             pv = p
-        acc_ref[...] = (acc_ref[...] * alpha[:, 0:1]
+        acc_ref[...] = (acc_ref[...] * alpha
                         + jax.lax.dot_general(
                             pv.astype(v.dtype), v,
                             (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32))
-        m_ref[...] = m_new
-        l_ref[...] = l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
         needed = ik * block_k <= iq * block_q + block_q - 1 + offset
@@ -263,7 +265,11 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s, valid = _block_scores(q, k, sm_scale, causal, iq, ik,
                                  block_q, block_k, offset)
         p = jnp.exp(s - lse)                            # normalized probs
-        if causal:
+        if causal and offset < 0:
+            # offset >= 0 guarantees every row saw >= 1 valid key, so lse
+            # is finite and masked scores give exp(-1e30 - lse) == 0 with
+            # no re-mask; offset < 0 has all-masked rows (lse ~ -1e30,
+            # exp(~0) = 1) that must be zeroed explicitly
             p = jnp.where(valid, p, 0.0)
         dpd = jax.lax.dot_general(                      # dO @ V^T
             do, v, (((1,), (1,)), ((), ())),
@@ -310,9 +316,9 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0]
         s, valid = _block_scores(q, k, sm_scale, causal, iq, ik,
                                  block_q, block_k, offset)
-        p = jnp.exp(s - lse)
-        if causal:
-            p = jnp.where(valid, p, 0.0)
+        p = jnp.exp(s - lse)  # masked s → exp(-1e30 - lse) == 0 (offset>=0)
+        if causal and offset < 0:
+            p = jnp.where(valid, p, 0.0)  # all-masked rows: lse ~ -1e30
         dpd = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -441,7 +447,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention_bhsd(q, k, v, *, causal: bool = False,
                          sm_scale: Optional[float] = None,
                          dropout_p: float = 0.0, seed=None,
-                         block_q: int = 512, block_k: int = 512,
+                         block_q: int = 1024, block_k: int = 1024,
                          interpret: Optional[bool] = None):
     """Flash attention over ``[B, H, S, D]`` tensors (GQA allowed: K/V may
     have ``Hq / G`` heads). Differentiable; bwd recomputes attention from

@@ -221,23 +221,26 @@ def fused_layer_norm(x, residual=None, bias=None, gamma=None, beta=None,
 
 
 def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
+    """Roll-form rotation: out = x·C + roll(x, D/2)·S with C = [cos|cos],
+    S = [-sin|sin] — one multiply-add pass, no lane-dim split/concat (the
+    half-slice forms relayout the 128-lane head_dim twice)."""
     x = x_ref[...].astype(jnp.float32)          # [1, bs_rows, H, D]
-    cos = cos_ref[...].astype(jnp.float32)      # [1, bs_rows, D/2]
-    sin = sin_ref[...].astype(jnp.float32)
+    c = cos_ref[...].astype(jnp.float32)[..., None, :]  # [1, bs, 1, D]
+    s = sin_ref[...].astype(jnp.float32)[..., None, :]
     d2 = x.shape[-1] // 2
-    x1 = x[..., :d2]
-    x2 = x[..., d2:]
-    c = cos[..., None, :]  # broadcast over heads
-    s = sin[..., None, :]
-    o1 = x1 * c - x2 * s
-    o2 = x2 * c + x1 * s
-    o_ref[...] = jnp.concatenate([o1, o2], axis=-1).astype(o_ref.dtype)
+    xr = pltpu.roll(x, d2, 3) if pltpu is not None and not _interpret() \
+        else jnp.roll(x, d2, axis=-1)
+    o_ref[...] = (x * c + xr * s).astype(o_ref.dtype)
 
 
 def _rope_impl(x, cos, sin):
     b_, s_, h_, d_ = x.shape
-    cos_b = jnp.broadcast_to(cos[None], (b_, s_, d_ // 2))
-    sin_b = jnp.broadcast_to(sin[None], (b_, s_, d_ // 2))
+    # full-width tables: C = [cos|cos], S = [-sin|sin]; with roll(x, d2)
+    # this reproduces (x1·c − x2·s | x2·c + x1·s)
+    cos_f = jnp.concatenate([cos, cos], axis=-1)
+    sin_f = jnp.concatenate([-sin, sin], axis=-1)
+    cos_b = jnp.broadcast_to(cos_f[None], (b_, s_, d_))
+    sin_b = jnp.broadcast_to(sin_f[None], (b_, s_, d_))
     sb = _row_block(s_, 512)
     out = pl.pallas_call(
         _rope_kernel,
@@ -245,8 +248,8 @@ def _rope_impl(x, cos, sin):
         grid=(b_, s_ // sb),
         in_specs=[
             pl.BlockSpec((1, sb, h_, d_), lambda i, j: (i, j, 0, 0)),
-            pl.BlockSpec((1, sb, d_ // 2), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sb, d_ // 2), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sb, d_), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sb, d_), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, sb, h_, d_), lambda i, j: (i, j, 0, 0)),
         interpret=_interpret(),

@@ -55,6 +55,38 @@ def test_forward_parity(shape, causal):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_causal_sq_gt_sk_empty_rows_grads_zero_and_finite():
+    """offset < 0: the first sq-sk query rows attend NO keys. fwd must
+    return zeros there; bwd must produce exactly-zero (not garbage) dq for
+    those rows and finite dk/dv (regression: the bwd kernels' re-mask is
+    load-bearing only in this case)."""
+    b, h, sq, sk, d = 1, 2, 128, 64, 32
+    q = rand(b, h, sq, d, seed=1)
+    k = rand(b, h, sk, d, seed=2)
+    v = rand(b, h, sk, d, seed=3)
+    out = flash_attention_bhsd(q, k, v, causal=True)
+    empty = sq - sk  # rows with no valid keys under bottom-right alignment
+    np.testing.assert_array_equal(np.asarray(out[:, :, :empty]), 0.0)
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention_bhsd(q, k, v, causal=True) ** 2)
+
+    dq, dk, dv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    assert np.all(np.isfinite(np.asarray(dq)))
+    assert np.all(np.isfinite(np.asarray(dk)))
+    assert np.all(np.isfinite(np.asarray(dv)))
+    np.testing.assert_array_equal(np.asarray(dq[:, :, :empty]), 0.0)
+    # valid region matches the naive reference
+    ref_dq = jax.grad(
+        lambda q: jnp.sum(sdpa(q, k, v, causal=True)[:, :, empty:] ** 2))(q)
+    got_dq = jax.grad(
+        lambda q: jnp.sum(
+            flash_attention_bhsd(q, k, v, causal=True)[:, :, empty:] ** 2))(q)
+    np.testing.assert_allclose(np.asarray(got_dq[:, :, empty:]),
+                               np.asarray(ref_dq[:, :, empty:]),
+                               rtol=2e-4, atol=2e-4)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_grad_parity(causal):
     b, hq, hkv, s, d = 2, 4, 2, 128, 32
