@@ -50,6 +50,10 @@ _LAZY_SUBMODULES = {
     "inference",
     "signal",
     "geometric",
+    "audio",
+    "text",
+    "hub",
+    "onnx",
     "amp",
     "autograd",
     "distributed",

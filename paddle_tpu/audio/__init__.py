@@ -1,0 +1,8 @@
+"""paddle.audio parity (reference: python/paddle/audio/__init__.py):
+features, functional, datasets, backends (stdlib-wave default), load/save.
+"""
+from . import backends, datasets, features, functional
+from .backends import info, load, save
+
+__all__ = ["functional", "features", "datasets", "backends", "load", "save",
+           "info"]

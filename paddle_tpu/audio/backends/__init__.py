@@ -1,0 +1,14 @@
+"""Audio I/O backends (reference: python/paddle/audio/backends/ —
+wave_backend.py default + pluggable soundfile backend).
+
+TPU-native/zero-dep: the default backend reads and writes PCM WAV via the
+stdlib ``wave`` module (exactly the reference's fallback wave_backend).
+"""
+from . import wave_backend
+from .backend import (AudioInfo, get_current_backend,
+                      list_available_backends, load, save, set_backend)
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend",
+           "load", "save", "AudioInfo", "info", "wave_backend"]
+
+info = wave_backend.info

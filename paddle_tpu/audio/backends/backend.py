@@ -1,0 +1,44 @@
+"""Backend registry (reference: python/paddle/audio/backends/backend.py —
+get_current_backend/list_available_backends/set_backend dispatch).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from . import wave_backend as _wave
+from .wave_backend import AudioInfo
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend",
+           "load", "save", "AudioInfo"]
+
+_BACKENDS = {"wave_backend": _wave}
+_current = ["wave_backend"]
+
+
+def list_available_backends() -> List[str]:
+    return sorted(_BACKENDS)
+
+
+def get_current_backend() -> str:
+    return _current[0]
+
+
+def set_backend(backend_name: str):
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} not available (have "
+            f"{list_available_backends()}; soundfile is not bundled in the "
+            "TPU image)")
+    _current[0] = backend_name
+
+
+def load(*args, **kwargs):
+    return _BACKENDS[_current[0]].load(*args, **kwargs)
+
+
+def save(*args, **kwargs):
+    return _BACKENDS[_current[0]].save(*args, **kwargs)
+
+
+def info(*args, **kwargs):
+    return _BACKENDS[_current[0]].info(*args, **kwargs)

@@ -1,0 +1,78 @@
+"""PCM WAV read/write over the stdlib wave module (reference:
+python/paddle/audio/backends/wave_backend.py).
+"""
+from __future__ import annotations
+
+import wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["load", "save", "info"]
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """Returns (waveform [C, T] (or [T, C]), sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = _WIDTH_DTYPE.get(width)
+    if dtype is None:
+        raise ValueError(f"unsupported sample width {width}")
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if width == 1:  # uint8 is offset-binary
+        data = data.astype(np.int16) - 128
+        scale = 128.0
+    else:
+        scale = float(2 ** (width * 8 - 1))
+    if normalize:
+        data = data.astype(np.float32) / scale
+    if channels_first:
+        data = data.T
+    return Tensor(np.ascontiguousarray(data)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_S", bits_per_sample: int = 16):
+    """Write PCM WAV. src: Tensor/ndarray [C, T] (or [T, C])."""
+    data = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if data.ndim == 1:
+        data = data[None] if channels_first else data[:, None]
+    if channels_first:
+        data = data.T                                   # [T, C]
+    if bits_per_sample != 16:
+        raise ValueError("only 16-bit PCM save is supported")
+    if np.issubdtype(data.dtype, np.floating):
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(data.astype("<i2").tobytes())

@@ -1,0 +1,129 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/{tess,esc50}.py).
+
+Zero-egress build: the download step is gated — point ``data_dir`` at a
+local copy laid out like the published archive and everything works; with
+no local data a clear error explains how to provide it.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+class AudioClassificationDataset(Dataset):
+    """Common base (reference datasets/dataset.py): wav files + labels,
+    feature_type raw/spectrogram/melspectrogram/logmelspectrogram/mfcc."""
+
+    _feat_layers = {
+        "raw": None,
+        "spectrogram": "Spectrogram",
+        "melspectrogram": "MelSpectrogram",
+        "logmelspectrogram": "LogMelSpectrogram",
+        "mfcc": "MFCC",
+    }
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feature_type: str = "raw", sample_rate: int = 22050,
+                 **kwargs):
+        if feature_type not in self._feat_layers:
+            raise ValueError(
+                f"unknown feature_type {feature_type!r}; choose from "
+                f"{sorted(self._feat_layers)}")
+        self.files = files
+        self.labels = labels
+        self.feature_type = feature_type
+        self.sample_rate = sample_rate
+        if feature_type == "raw":
+            self._feat = None
+        else:
+            from .. import features
+
+            cls = getattr(features, self._feat_layers[feature_type])
+            self._feat = cls(sr=sample_rate, **kwargs) \
+                if feature_type != "spectrogram" else cls(**kwargs)
+
+    def __getitem__(self, idx):
+        from ..backends import load
+
+        wav, _sr = load(self.files[idx])
+        x = wav.numpy()
+        x = np.asarray(x)[0] if x.ndim == 2 else np.asarray(x)
+        if self._feat is not None:
+            x = np.asarray(self._feat(x[None]).numpy())[0]
+        return x, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _require_dir(data_dir: Optional[str], name: str, url_hint: str) -> str:
+    if data_dir and os.path.isdir(data_dir):
+        return data_dir
+    raise RuntimeError(
+        f"{name}: no local data. This build has no network egress; download "
+        f"the archive ({url_hint}) on a connected machine, extract it, and "
+        f"pass data_dir=<path>.")
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto Emotional Speech Set (reference datasets/tess.py). Layout:
+    ``<data_dir>/**/<speaker>_<word>_<emotion>.wav``."""
+
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feature_type: str = "raw",
+                 data_dir: Optional[str] = None, **kwargs):
+        root = _require_dir(data_dir, "TESS",
+                            "https://doi.org/10.5683/SP2/E8H2MF")
+        files, labels = [], []
+        for dirpath, _, names in os.walk(root):
+            for fn in sorted(names):
+                if not fn.lower().endswith(".wav"):
+                    continue
+                emotion = fn.rsplit("_", 1)[-1][:-4].lower()
+                if emotion in self.emotions:
+                    files.append(os.path.join(dirpath, fn))
+                    labels.append(self.emotions.index(emotion))
+        files, labels = self._split(files, labels, mode, n_folds, split)
+        super().__init__(files, labels, feature_type, **kwargs)
+
+    @staticmethod
+    def _split(files, labels, mode, n_folds, split):
+        rng = np.random.RandomState(0)
+        order = rng.permutation(len(files))
+        folds = [int(i * n_folds / len(files)) + 1 for i in range(len(files))]
+        keep = [(f, l) for i, (f, l) in enumerate(
+            zip([files[o] for o in order], [labels[o] for o in order]))
+            if (folds[i] != split) == (mode == "train")]
+        return [f for f, _ in keep], [l for _, l in keep]
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference datasets/esc50.py). Layout:
+    ``<data_dir>/audio/*.wav`` named ``<fold>-<src>-<take>-<target>.wav``."""
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feature_type: str = "raw", data_dir: Optional[str] = None,
+                 **kwargs):
+        root = _require_dir(data_dir, "ESC50",
+                            "https://github.com/karolpiczak/ESC-50")
+        audio_dir = os.path.join(root, "audio")
+        if not os.path.isdir(audio_dir):
+            audio_dir = root
+        files, labels = [], []
+        for fn in sorted(os.listdir(audio_dir)):
+            if not fn.endswith(".wav"):
+                continue
+            parts = fn[:-4].split("-")
+            fold, target = int(parts[0]), int(parts[-1])
+            if (fold != split) == (mode == "train"):
+                files.append(os.path.join(audio_dir, fn))
+                labels.append(target)
+        super().__init__(files, labels, feature_type, **kwargs)

@@ -1,0 +1,141 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py
+— Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC as nn.Layers over the
+framework stft).
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn import Layer
+from ...ops._helpers import unwrap
+from ..functional import (compute_fbank_matrix, create_dct, power_to_db)
+from ..functional.window import get_window
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """|STFT|^power (reference layers.py:31)."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        if power <= 0:
+            raise ValueError("power must be positive")
+        self.power = power
+        if win_length is None:
+            win_length = n_fft
+        self.n_fft = n_fft
+        self.hop_length = hop_length or win_length // 4
+        self.win_length = win_length
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = jnp.asarray(
+            unwrap(get_window(window, win_length, fftbins=True,
+                              dtype="float64"))).astype(dtype)
+
+    def forward(self, x):
+        from ... import signal
+
+        stft = signal.stft(x, n_fft=self.n_fft, hop_length=self.hop_length,
+                           win_length=self.win_length,
+                           window=Tensor(self.fft_window),
+                           center=self.center, pad_mode=self.pad_mode)
+        spect = jnp.abs(unwrap(stft)) ** self.power
+        return Tensor(spect)
+
+
+class MelSpectrogram(Layer):
+    """Mel-scaled spectrogram (reference layers.py:124)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: Union[str, float] = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.f_min = f_min
+        self.f_max = f_max
+        self.htk = htk
+        self.norm = norm
+        if f_max is None:
+            f_max = sr // 2
+        self.fbank_matrix = unwrap(compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype))
+
+    def forward(self, x):
+        spect = unwrap(self._spectrogram(x))
+        mel = jnp.matmul(self.fbank_matrix, spect)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    """log-dB mel spectrogram (reference layers.py:243)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: Union[str, float] = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, ref_value=self.ref_value, amin=self.amin,
+                           top_db=self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients (reference layers.py:385)."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 2048,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: Union[str, float] = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError("n_mfcc cannot be larger than n_mels")
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = unwrap(create_dct(n_mfcc=n_mfcc, n_mels=n_mels,
+                                            dtype=dtype))
+
+    def forward(self, x):
+        logmel = unwrap(self._log_melspectrogram(x))
+        mfcc = jnp.matmul(jnp.swapaxes(logmel, -1, -2),
+                          self.dct_matrix)
+        return Tensor(jnp.swapaxes(mfcc, -1, -2))

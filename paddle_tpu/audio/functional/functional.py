@@ -1,0 +1,132 @@
+"""Audio DSP functional surface (reference:
+python/paddle/audio/functional/functional.py — librosa-style formulas).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import unwrap
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz → mel (reference functional.py:29; Slaney by default)."""
+    scalar = not isinstance(freq, (Tensor, jnp.ndarray))
+    f = jnp.asarray(unwrap(freq), jnp.float32) if not scalar else float(freq)
+    if htk:
+        out = 2595.0 * (jnp.log10(1.0 + f / 700.0) if not scalar
+                        else math.log10(1.0 + f / 700.0))
+        return float(out) if scalar else Tensor(out)
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if scalar:
+        mels = (f - f_min) / f_sp
+        if f >= min_log_hz:
+            mels = min_log_mel + math.log(f / min_log_hz) / logstep
+        return mels
+    mels = (f - f_min) / f_sp
+    mels = jnp.where(f >= min_log_hz,
+                     min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                           / min_log_hz) / logstep, mels)
+    return Tensor(mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """mel → Hz (reference functional.py:77)."""
+    scalar = not isinstance(mel, (Tensor, jnp.ndarray))
+    m = jnp.asarray(unwrap(mel), jnp.float32) if not scalar else float(mel)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        return out if scalar else Tensor(out)
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if scalar:
+        if m >= min_log_mel:
+            return min_log_hz * math.exp(logstep * (m - min_log_mel))
+        return f_min + f_sp * m
+    freqs = f_min + f_sp * m
+    freqs = jnp.where(m >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                      freqs)
+    return Tensor(freqs)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """n_mels mel-spaced frequencies (reference functional.py:117)."""
+    min_mel = hz_to_mel(f_min, htk=htk)
+    max_mel = hz_to_mel(f_max, htk=htk)
+    mels = jnp.linspace(min_mel, max_mel, n_mels, dtype=dtype)
+    return Tensor(unwrap(mel_to_hz(mels, htk=htk)).astype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """FFT bin center frequencies (reference functional.py:145)."""
+    return Tensor(jnp.linspace(0, sr / 2.0, 1 + n_fft // 2, dtype=dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype: str = "float32"):
+    """Mel filterbank [n_mels, 1 + n_fft//2] (reference functional.py:163)."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = unwrap(fft_frequencies(sr, n_fft, dtype))
+    mel_f = unwrap(mel_frequencies(n_mels + 2, f_min, f_max, htk, dtype))
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]        # [n_mels+2, bins]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        norms = jnp.linalg.norm(weights, ord=norm, axis=-1, keepdims=True)
+        weights = weights / jnp.maximum(norms, 1e-10)
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """Power → dB with clamping (reference functional.py:232)."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    x = jnp.asarray(unwrap(spect))
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference functional.py:286)."""
+    n = jnp.arange(float(n_mels))
+    k = jnp.arange(float(n_mfcc))[:, None]
+    dct = jnp.cos(math.pi / float(n_mels) * (n + 0.5) * k)
+    if norm is None:
+        dct = dct * 2.0
+    else:
+        if norm != "ortho":
+            raise ValueError(f"norm must be 'ortho' or None, got {norm}")
+        dct = dct.at[0].multiply(1.0 / math.sqrt(2.0))
+        dct = dct * math.sqrt(2.0 / float(n_mels))
+    return Tensor(dct.T.astype(dtype))

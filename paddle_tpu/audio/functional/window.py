@@ -1,0 +1,197 @@
+"""Window functions (reference: python/paddle/audio/functional/window.py,
+scipy-derived formulas — the formulas are public specs).
+
+All windows return a jnp array; ``get_window`` is the registry entry point.
+"""
+from __future__ import annotations
+
+import math
+from typing import Union
+
+import jax.numpy as jnp
+
+__all__ = ["get_window"]
+
+_REGISTER = {}
+
+
+def _window(func):
+    _REGISTER[func.__name__.lstrip("_")] = func
+    return func
+
+
+def _len_guards(M: int) -> bool:
+    if int(M) != M or M < 0:
+        raise ValueError("Window length M must be a non-negative integer")
+    return M <= 1
+
+
+def _extend(M: int, sym: bool):
+    return (M, False) if sym else (M + 1, True)
+
+
+def _truncate(w, needed_trunc: bool):
+    return w[:-1] if needed_trunc else w
+
+
+def _general_cosine(M, a, sym=True, dtype="float64"):
+    if _len_guards(M):
+        return jnp.ones(M, dtype)
+    M, trunc = _extend(M, sym)
+    fac = jnp.linspace(-math.pi, math.pi, M, dtype=dtype)
+    w = jnp.zeros(M, dtype)
+    for k, coef in enumerate(a):
+        w = w + coef * jnp.cos(k * fac)
+    return _truncate(w, trunc)
+
+
+def _general_hamming(M, alpha, sym=True, dtype="float64"):
+    return _general_cosine(M, [alpha, 1.0 - alpha], sym, dtype)
+
+
+@_window
+def _hamming(M, sym=True, dtype="float64"):
+    return _general_hamming(M, 0.54, sym, dtype)
+
+
+@_window
+def _hann(M, sym=True, dtype="float64"):
+    return _general_hamming(M, 0.5, sym, dtype)
+
+
+@_window
+def _blackman(M, sym=True, dtype="float64"):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym, dtype)
+
+
+@_window
+def _bohman(M, sym=True, dtype="float64"):
+    if _len_guards(M):
+        return jnp.ones(M, dtype)
+    M, trunc = _extend(M, sym)
+    fac = jnp.abs(jnp.linspace(-1, 1, M, dtype=dtype)[1:-1])
+    w = (1 - fac) * jnp.cos(math.pi * fac) + \
+        1.0 / math.pi * jnp.sin(math.pi * fac)
+    w = jnp.concatenate([jnp.zeros(1, dtype), w, jnp.zeros(1, dtype)])
+    return _truncate(w, trunc)
+
+
+@_window
+def _cosine(M, sym=True, dtype="float64"):
+    if _len_guards(M):
+        return jnp.ones(M, dtype)
+    M, trunc = _extend(M, sym)
+    w = jnp.sin(math.pi / M * (jnp.arange(M, dtype=dtype) + 0.5))
+    return _truncate(w, trunc)
+
+
+@_window
+def _triang(M, sym=True, dtype="float64"):
+    if _len_guards(M):
+        return jnp.ones(M, dtype)
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(1, (M + 1) // 2 + 1, dtype=dtype)
+    if M % 2 == 0:
+        w = (2 * n - 1.0) / M
+        w = jnp.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (M + 1.0)
+        w = jnp.concatenate([w, w[-2::-1]])
+    return _truncate(w, trunc)
+
+
+@_window
+def _gaussian(M, std=7, sym=True, dtype="float64"):
+    if _len_guards(M):
+        return jnp.ones(M, dtype)
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(0, M, dtype=dtype) - (M - 1.0) / 2.0
+    w = jnp.exp(-(n ** 2) / (2 * std * std))
+    return _truncate(w, trunc)
+
+
+@_window
+def _exponential(M, center=None, tau=1.0, sym=True, dtype="float64"):
+    if sym and center is not None:
+        raise ValueError("If sym==True, center must be None.")
+    if _len_guards(M):
+        return jnp.ones(M, dtype)
+    M, trunc = _extend(M, sym)
+    if center is None:
+        center = (M - 1) / 2
+    n = jnp.arange(0, M, dtype=dtype)
+    w = jnp.exp(-jnp.abs(n - center) / tau)
+    return _truncate(w, trunc)
+
+
+@_window
+def _tukey(M, alpha=0.5, sym=True, dtype="float64"):
+    if _len_guards(M):
+        return jnp.ones(M, dtype)
+    if alpha <= 0:
+        return jnp.ones(M, dtype)
+    if alpha >= 1.0:
+        return _hann(M, sym=sym, dtype=dtype)
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(0, M, dtype=dtype)
+    width = int(alpha * (M - 1) / 2.0)
+    n1 = n[0:width + 1]
+    n2 = n[width + 1:M - width - 1]
+    n3 = n[M - width - 1:]
+    w1 = 0.5 * (1 + jnp.cos(math.pi * (-1 + 2.0 * n1 / alpha / (M - 1))))
+    w2 = jnp.ones(n2.shape[0], dtype)
+    w3 = 0.5 * (1 + jnp.cos(math.pi * (-2.0 / alpha + 1 +
+                                       2.0 * n3 / alpha / (M - 1))))
+    return _truncate(jnp.concatenate([w1, w2, w3]), trunc)
+
+
+@_window
+def _taylor(M, nbar=4, sll=30, norm=True, sym=True, dtype="float64"):
+    if _len_guards(M):
+        return jnp.ones(M, dtype)
+    M, trunc = _extend(M, sym)
+    B = 10 ** (sll / 20)
+    A = float(jnp.arccosh(jnp.asarray(B, jnp.float64))) / math.pi
+    s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+    ma = jnp.arange(1, nbar, dtype=dtype)
+    Fm = []
+    signs = jnp.empty_like(ma)
+    signs = signs.at[::2].set(-1)
+    signs = signs.at[1::2].set(1)
+    m2 = ma * ma
+    for mi in range(len(ma)):
+        numer = signs[mi] * jnp.prod(
+            1 - m2[mi] / s2 / (A ** 2 + (ma - 0.5) ** 2))
+        denom = 2 * jnp.prod(1 - m2[mi] / m2[:mi]) * jnp.prod(
+            1 - m2[mi] / m2[mi + 1:])
+        Fm.append(numer / denom)
+    Fm = jnp.stack(Fm)
+
+    def W(n):
+        return 1 + 2 * jnp.dot(
+            Fm, jnp.cos(2 * math.pi * ma[:, None]
+                        * (n - M / 2.0 + 0.5) / M))
+
+    w = W(jnp.arange(0, M, dtype=dtype))
+    if norm:
+        w = w / W((M - 1) / 2)
+    return _truncate(w.astype(dtype), trunc)
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float64"):
+    """Window by name or (name, param) tuple (reference get_window:327)."""
+    sym = not fftbins
+    if isinstance(window, tuple):
+        winstr = window[0]
+        args = window[1:]
+    elif isinstance(window, str):
+        winstr = window
+        args = ()
+    else:
+        raise ValueError(f"The window type {type(window)} is not supported")
+    try:
+        winfunc = _REGISTER[winstr]
+    except KeyError as e:
+        raise ValueError(f"Unknown window type: {winstr}") from e
+    return winfunc(win_length, *args, sym=sym, dtype=dtype)

@@ -117,8 +117,16 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
             exclude_from_weight_decay=cfg.get(
                 "exclude_from_weight_decay", None))
     if getattr(strategy, "dgc", False):
+        from ...optimizer.optimizer import Momentum, SGD
         from .meta_optimizers import DGCMomentumOptimizer
 
+        if not isinstance(optimizer, (Momentum, SGD)):
+            # DGC REPLACES the momentum rule; silently discarding Adam's
+            # adaptive moments would train a different optimizer
+            raise TypeError(
+                "strategy.dgc requires a Momentum/SGD optimizer (got "
+                f"{type(optimizer).__name__}); the reference DGC optimizer "
+                "has the same constraint")
         cfg = getattr(strategy, "dgc_configs", {}) or {}
         sp = cfg.get("sparsity", [0.999])
         optimizer = DGCMomentumOptimizer.from_momentum(

@@ -1,0 +1,66 @@
+"""Shared delegation shell for wrapper-style meta-optimizers.
+
+One forwarding surface for all wrappers (the reference's MetaOptimizerBase
+plays the same role for static passes): step() is the wrapper's own hook,
+minimize() routes through SELF.step (a bound inner minimize would silently
+skip the wrapper), and state_dict carries the wrapper's auxiliary state
+(merge banks, counters, error feedback) so checkpoint/resume replays the
+same trajectory.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+__all__ = ["MetaOptimizerWrapper"]
+
+
+class MetaOptimizerWrapper:
+    _META_KEY = "__meta_optimizer__"
+
+    def __init__(self, inner_optimizer):
+        self._inner_opt = inner_optimizer
+
+    # wrappers override step(); everything else forwards
+    def step(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    # -- wrapper aux state (counters, banks) -------------------------------
+    def _extra_state(self) -> Dict[str, Any]:
+        return {}
+
+    def _load_extra_state(self, state: Dict[str, Any]):
+        pass
+
+    def state_dict(self):
+        sd = dict(self._inner_opt.state_dict())
+        extra = self._extra_state()
+        if extra:
+            sd.setdefault(self._META_KEY, {})[type(self).__name__] = extra
+        return sd
+
+    def set_state_dict(self, state_dict):
+        meta = state_dict.get(self._META_KEY, {})
+        mine = meta.get(type(self).__name__)
+        if mine is not None:
+            self._load_extra_state(mine)
+        self._inner_opt.set_state_dict(state_dict)
+
+    set_dict = set_state_dict
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+
+def to_numpy_tree(d):
+    return {k: np.asarray(v) for k, v in d.items()}

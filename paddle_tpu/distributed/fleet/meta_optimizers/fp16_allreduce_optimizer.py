@@ -10,12 +10,14 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from .base import MetaOptimizerWrapper
+
 __all__ = ["FP16AllReduceOptimizer"]
 
 
-class FP16AllReduceOptimizer:
+class FP16AllReduceOptimizer(MetaOptimizerWrapper):
     def __init__(self, inner_optimizer, dtype=jnp.bfloat16):
-        self._inner_opt = inner_optimizer
+        super().__init__(inner_optimizer)
         self._dtype = dtype
 
     def step(self):
@@ -27,9 +29,3 @@ class FP16AllReduceOptimizer:
             p.grad = Tensor(
                 g.value.astype(self._dtype).astype(g.value.dtype))
         self._inner_opt.step()
-
-    def clear_grad(self, set_to_zero: bool = False):
-        self._inner_opt.clear_grad(set_to_zero)
-
-    def __getattr__(self, item):
-        return getattr(self._inner_opt, item)

@@ -9,12 +9,14 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from .base import MetaOptimizerWrapper
+
 __all__ = ["GradientMergeOptimizer"]
 
 
-class GradientMergeOptimizer:
+class GradientMergeOptimizer(MetaOptimizerWrapper):
     def __init__(self, inner_optimizer, k_steps: int = 1, avg: bool = True):
-        self._inner_opt = inner_optimizer
+        super().__init__(inner_optimizer)
         self._k_steps = max(1, int(k_steps))
         self._avg = avg
         self._count = 0
@@ -26,6 +28,15 @@ class GradientMergeOptimizer:
 
     def _key(self, p):
         return self._inner_opt._key(p)
+
+    def _extra_state(self):
+        return {"count": self._count,
+                "acc": {k: jnp.asarray(v) for k, v in self._acc.items()}}
+
+    def _load_extra_state(self, state):
+        self._count = int(state.get("count", 0))
+        self._acc = {k: jnp.asarray(v)
+                     for k, v in state.get("acc", {}).items()}
 
     def step(self):
         self._count += 1
@@ -51,9 +62,3 @@ class GradientMergeOptimizer:
                 p.grad = Tensor((self._acc[k] * scale).astype(p.value.dtype))
         self._acc.clear()
         self._inner_opt.step()
-
-    def clear_grad(self, set_to_zero: bool = False):
-        self._inner_opt.clear_grad(set_to_zero)
-
-    def __getattr__(self, item):
-        return getattr(self._inner_opt, item)

@@ -7,17 +7,25 @@ ranks), params divided by dp world size.
 """
 from __future__ import annotations
 
+from .base import MetaOptimizerWrapper
+
 __all__ = ["LocalSGDOptimizer"]
 
 
-class LocalSGDOptimizer:
+class LocalSGDOptimizer(MetaOptimizerWrapper):
     def __init__(self, inner_optimizer, k_steps: int = 1,
                  begin_step: int = 1, hcg=None):
-        self._inner_opt = inner_optimizer
+        super().__init__(inner_optimizer)
         self._k_steps = max(1, int(k_steps))
         self._begin_step = int(begin_step)
         self._count = 0
         self._hcg = hcg
+
+    def _extra_state(self):
+        return {"count": self._count}
+
+    def _load_extra_state(self, state):
+        self._count = int(state.get("count", 0))
 
     def _hybrid_spans_processes(self):
         if self._hcg is None:
@@ -59,8 +67,3 @@ class LocalSGDOptimizer:
             p.set_value(jnp.mean(
                 gathered.astype(jnp.float32), axis=0).astype(p.value.dtype))
 
-    def clear_grad(self, set_to_zero: bool = False):
-        self._inner_opt.clear_grad(set_to_zero)
-
-    def __getattr__(self, item):
-        return getattr(self._inner_opt, item)

@@ -1,0 +1,81 @@
+"""Model hub (reference: python/paddle/hapi/hub.py — hubconf.py protocol
+over a local dir / github / gitee repo).
+
+Zero-egress build: ``source='local'`` is fully supported (import
+``hubconf.py`` from the directory, expose its callables); the remote
+sources raise a clear error instead of silently failing mid-download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+
+def _import_module(name: str, repo_dir: str):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    return module
+
+
+def _resolve_dir(repo_dir: str, source: str, force_reload: bool) -> str:
+    if source == "local":
+        return repo_dir
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"hub source {source!r} needs network egress, which this build "
+            "does not have. Clone the repo on a connected machine and use "
+            "source='local' with its path.")
+    raise ValueError(
+        f"Unknown source: \"{source}\". Allowed values: \"github\", "
+        "\"gitee\", \"local\".")
+
+
+def _load_entry_from_hubconf(m, name: str):
+    if not isinstance(name, str):
+        raise ValueError("Invalid input: model should be a str of function "
+                         "name")
+    func = getattr(m, name, None)
+    if func is None or not callable(func):
+        raise RuntimeError(f"Cannot find callable {name} in hubconf")
+    return func
+
+
+def list(repo_dir: str, source: str = "github",
+         force_reload: bool = False) -> List[str]:
+    """Entrypoint names exported by the repo's hubconf.py (reference
+    hub.py:175)."""
+    repo_dir = _resolve_dir(repo_dir, source, force_reload)
+    module = _import_module(MODULE_HUBCONF.split(".")[0], repo_dir)
+    return [f for f in dir(module)
+            if callable(getattr(module, f)) and not f.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False) -> str:
+    """Docstring of one entrypoint (reference hub.py:223)."""
+    repo_dir = _resolve_dir(repo_dir, source, force_reload)
+    module = _import_module(MODULE_HUBCONF.split(".")[0], repo_dir)
+    return _load_entry_from_hubconf(module, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Instantiate an entrypoint (reference hub.py:269)."""
+    repo_dir = _resolve_dir(repo_dir, source, force_reload)
+    module = _import_module(MODULE_HUBCONF.split(".")[0], repo_dir)
+    return _load_entry_from_hubconf(module, model)(**kwargs)

@@ -1,0 +1,5 @@
+"""paddle.hub parity (reference: python/paddle/hub.py — re-exports the
+hapi.hub entrypoints)."""
+from .hapi.hub import help, list, load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

@@ -1,0 +1,38 @@
+"""paddle.onnx parity (reference: python/paddle/onnx/export.py — delegates
+to paddle2onnx).
+
+TPU-native: the portable AOT serving format of this framework is StableHLO
+(`jax.export`, see `paddle_tpu.inference`); ``export`` emits that artifact
+(``<path>.stablehlo`` + ``<path>.pdiparams``) so the call site keeps
+working, and notes that true .onnx emission needs the (unbundled)
+paddle2onnx/onnx toolchain.
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Reference export.py:24. Emits the framework's AOT artifact; raises
+    only if the model cannot be traced/exported at all."""
+    if input_spec is None:
+        raise ValueError(
+            "export requires input_spec (a list of paddle_tpu.static."
+            "InputSpec) to trace the model")
+    try:
+        import onnx  # noqa: F401
+        has_onnx = True
+    except ImportError:
+        has_onnx = False
+    if not has_onnx:
+        warnings.warn(
+            "onnx/paddle2onnx are not bundled in this TPU image; exporting "
+            "the StableHLO AOT artifact instead (loadable via "
+            "paddle_tpu.inference.create_predictor). Convert to .onnx on a "
+            "machine with paddle2onnx installed.", stacklevel=2)
+    from .. import jit
+
+    jit.save(layer, path, input_spec=input_spec)
+    return path

@@ -1,0 +1,90 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py +
+phi viterbi_decode kernel). TPU-native: one lax.scan forward pass carrying
+(alpha, backpointers), one reverse scan for the path — fully jittable,
+static shapes, no per-step python.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..nn import Layer
+from ..ops._helpers import unwrap
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(potentials, transitions, lengths, include_bos_eos_tag):
+    b, seq_len, n = potentials.shape
+    lengths = lengths.astype(jnp.int32)
+    if include_bos_eos_tag:
+        # last tag = BOS, second-to-last = EOS (reference docstring)
+        start_idx, stop_idx = n - 1, n - 2
+        alpha = potentials[:, 0] + transitions[start_idx][None, :]
+    else:
+        alpha = potentials[:, 0]
+
+    def step(carry, t):
+        alpha = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, t, j]
+        scores = alpha[:, :, None] + transitions[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+        best_score = jnp.max(scores, axis=1) + potentials[:, t]
+        live = (t < lengths)[:, None]
+        new_alpha = jnp.where(live, best_score, alpha)
+        bp = jnp.where(live, best_prev,
+                       jnp.arange(n, dtype=best_prev.dtype)[None, :])
+        return new_alpha, bp
+
+    alpha, bps = jax.lax.scan(step, alpha, jnp.arange(1, seq_len))
+    # bps: [seq_len-1, B, N]
+    if include_bos_eos_tag:
+        alpha = alpha + transitions[:, stop_idx][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)   # [B]
+
+    def back(carry, bp):
+        # carry = tag at position j+1; bp[b, carry] = tag at position j,
+        # which is what the reverse scan must EMIT for index j
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        prev = prev.astype(jnp.int32)
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+    path = jnp.concatenate([path_rev, last_tag[None]], axis=0)  # [T, B]
+    path = jnp.swapaxes(path, 0, 1)                              # [B, T]
+    # zero-pad beyond each sequence's length; trim to max length
+    tpos = jnp.arange(seq_len)[None, :]
+    path = jnp.where(tpos < lengths[:, None], path, 0)
+    max_len = jnp.max(lengths)
+    return scores, path.astype(jnp.int64), max_len
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag sequence. potentials [B, L, N], transitions
+    [N, N], lengths [B] → (scores [B], paths [B, max(lengths)])."""
+    pot = unwrap(potentials)
+    trans = unwrap(transition_params)
+    lens = unwrap(lengths)
+    scores, path, max_len = jax.jit(
+        _viterbi, static_argnums=(3,))(pot, trans, lens,
+                                       bool(include_bos_eos_tag))
+    path = path[:, :int(max_len)]
+    return Tensor(scores), Tensor(path)
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference viterbi_decode.py:95)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -192,6 +192,69 @@ class TestLocalSGD:
         assert np.all(np.isfinite(w_hist[-1]))
 
 
+class TestWrapperDelegation:
+    def test_minimize_routes_through_wrapper_step(self):
+        # regression: a bound inner minimize would call the RAW step and
+        # silently skip gradient merging
+        rng = np.random.RandomState(9)
+        m = nn.Linear(4, 3)
+        gm = GradientMergeOptimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()),
+            k_steps=2, avg=True)
+        w0 = np.asarray(m.weight.numpy()).copy()
+        x, y = _data(rng)
+        gm.minimize(_loss(m, x, y))
+        gm.clear_grad()
+        # first minimize banked the grads — weights must be untouched
+        np.testing.assert_array_equal(np.asarray(m.weight.numpy()), w0)
+        gm.minimize(_loss(m, x, y))
+        assert not np.allclose(np.asarray(m.weight.numpy()), w0)
+
+    def test_state_dict_roundtrip_restores_bank_and_count(self):
+        rng = np.random.RandomState(10)
+        m = nn.Linear(4, 3)
+        gm = GradientMergeOptimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()),
+            k_steps=3, avg=True)
+        x, y = _data(rng)
+        _loss(m, x, y).backward()
+        gm.step()  # banked, count=1
+        sd = gm.state_dict()
+        gm2 = GradientMergeOptimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()),
+            k_steps=3, avg=True)
+        gm2.set_state_dict(sd)
+        assert gm2._count == 1
+        assert set(gm2._acc) == set(gm._acc)
+
+    def test_dgc_state_dict_keeps_rampup_and_error_feedback(self):
+        rng = np.random.RandomState(11)
+        m = nn.Linear(4, 3)
+        dgc = DGCMomentumOptimizer(learning_rate=0.05, momentum=0.9,
+                                   parameters=m.parameters(), sparsity=0.5)
+        x, y = _data(rng)
+        for _ in range(3):
+            _loss(m, x, y).backward()
+            dgc.step()
+            dgc.clear_grad()
+        sd = dgc.state_dict()
+        dgc2 = DGCMomentumOptimizer(learning_rate=0.05, momentum=0.9,
+                                    parameters=m.parameters(), sparsity=0.5)
+        dgc2.set_state_dict(sd)
+        assert dgc2._count == 3
+        assert set(dgc2._e) == set(dgc._e)
+
+    def test_dgc_rejects_adaptive_optimizers(self):
+        fleet.init(is_collective=True)
+        m = nn.Linear(4, 3)
+        strategy = DistributedStrategy()
+        strategy.dgc = True
+        with pytest.raises(TypeError, match="Momentum"):
+            fleet.distributed_optimizer(
+                opt.AdamW(learning_rate=1e-3, parameters=m.parameters()),
+                strategy=strategy)
+
+
 class TestStrategyComposition:
     def test_distributed_optimizer_applies_strategy_transforms(self):
         fleet.init(is_collective=True)

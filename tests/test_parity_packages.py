@@ -1,0 +1,262 @@
+"""Tests for the audio / text / hub / onnx parity packages.
+
+Reference test analogs: test/legacy_test/test_audio_functions.py,
+test_audio_logmel_feature.py, test_viterbi_decode_op.py, test_hub.py.
+"""
+import math
+import os
+import wave
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestAudioFunctional:
+    def test_hz_mel_roundtrip(self):
+        from paddle_tpu.audio.functional import hz_to_mel, mel_to_hz
+
+        for htk in (False, True):
+            for hz in (60.0, 440.0, 8000.0):
+                mel = hz_to_mel(hz, htk=htk)
+                back = mel_to_hz(mel, htk=htk)
+                assert abs(back - hz) < 1e-2 * hz
+
+    def test_mel_frequencies_monotone(self):
+        from paddle_tpu.audio.functional import mel_frequencies
+
+        f = np.asarray(mel_frequencies(40, 0.0, 8000.0).numpy())
+        assert f.shape == (40,)
+        assert np.all(np.diff(f) > 0)
+        assert abs(f[0]) < 1e-3 and abs(f[-1] - 8000.0) < 1.0
+
+    def test_fbank_matrix_shape_and_rowsum(self):
+        from paddle_tpu.audio.functional import compute_fbank_matrix
+
+        fb = np.asarray(compute_fbank_matrix(16000, 512, n_mels=26).numpy())
+        assert fb.shape == (26, 257)
+        assert np.all(fb >= 0)
+        assert np.all(fb.sum(axis=1) > 0)  # every filter is nonempty
+
+    def test_power_to_db_matches_formula(self):
+        from paddle_tpu.audio.functional import power_to_db
+
+        x = np.asarray([1.0, 0.1, 0.01], np.float32)
+        db = np.asarray(power_to_db(x, top_db=None).numpy())
+        np.testing.assert_allclose(db, 10 * np.log10(x), rtol=1e-5)
+        db2 = np.asarray(power_to_db(x, top_db=10.0).numpy())
+        assert db2.min() >= db2.max() - 10.0
+
+    def test_create_dct_orthonormal(self):
+        from paddle_tpu.audio.functional import create_dct
+
+        d = np.asarray(create_dct(13, 40).numpy())
+        assert d.shape == (40, 13)
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-4)
+
+    @pytest.mark.parametrize("name", ["hann", "hamming", "blackman",
+                                      "triang", "bohman", "cosine"])
+    def test_windows_match_scipy_shapes(self, name):
+        from paddle_tpu.audio.functional import get_window
+
+        w = np.asarray(get_window(name, 64))
+        assert w.shape == (64,)
+        assert w.max() <= 1.0 + 1e-9
+        # symmetry of the periodic window: w[1:] mirrors around center
+        # (fp32 atol — x64 is disabled, float64 canonicalizes to float32)
+        np.testing.assert_allclose(w[1:], w[1:][::-1], atol=1e-6)
+
+    def test_gaussian_tuple_window(self):
+        from paddle_tpu.audio.functional import get_window
+
+        w = np.asarray(get_window(("gaussian", 7), 64))
+        assert w.shape == (64,)
+        assert w.argmax() in (31, 32)
+
+
+class TestAudioFeatures:
+    def _sine(self, sr=8000, secs=0.5, freq=440.0):
+        t = np.arange(int(sr * secs)) / sr
+        return np.sin(2 * math.pi * freq * t).astype(np.float32)
+
+    def test_spectrogram_peak_at_tone(self):
+        from paddle_tpu.audio.features import Spectrogram
+
+        sr, freq = 8000, 1000.0
+        x = self._sine(sr=sr, freq=freq)
+        spec = Spectrogram(n_fft=512, hop_length=160)
+        out = np.asarray(spec(paddle.to_tensor(x[None])).numpy())[0]
+        assert out.shape[0] == 257
+        peak_bin = out.mean(axis=1).argmax()
+        expect = round(freq / (sr / 2) * 256)
+        assert abs(int(peak_bin) - expect) <= 1
+
+    def test_mel_and_logmel_and_mfcc_shapes(self):
+        from paddle_tpu.audio.features import (LogMelSpectrogram, MFCC,
+                                               MelSpectrogram)
+
+        x = self._sine()
+        mel = MelSpectrogram(sr=8000, n_fft=512, n_mels=40, f_max=4000.0)
+        m = np.asarray(mel(paddle.to_tensor(x[None])).numpy())
+        assert m.shape[1] == 40
+        logmel = LogMelSpectrogram(sr=8000, n_fft=512, n_mels=40,
+                                   f_max=4000.0)
+        lm = np.asarray(logmel(paddle.to_tensor(x[None])).numpy())
+        assert lm.shape == m.shape
+        mfcc = MFCC(sr=8000, n_mfcc=13, n_fft=512, n_mels=40, f_max=4000.0)
+        c = np.asarray(mfcc(paddle.to_tensor(x[None])).numpy())
+        assert c.shape[1] == 13
+
+
+class TestAudioBackend:
+    def test_wav_save_load_roundtrip(self, tmp_path):
+        from paddle_tpu.audio import info, load, save
+
+        sr = 8000
+        x = (0.5 * np.sin(np.linspace(0, 100, 1600))).astype(np.float32)
+        path = str(tmp_path / "t.wav")
+        save(path, x[None], sr)
+        meta = info(path)
+        assert meta.sample_rate == sr and meta.num_channels == 1
+        back, sr2 = load(path)
+        assert sr2 == sr
+        np.testing.assert_allclose(np.asarray(back.numpy())[0], x,
+                                   atol=1e-3)
+
+    def test_backend_registry(self):
+        from paddle_tpu.audio import backends
+
+        assert backends.get_current_backend() == "wave_backend"
+        assert "wave_backend" in backends.list_available_backends()
+        with pytest.raises(NotImplementedError):
+            backends.set_backend("soundfile")
+
+
+class TestViterbi:
+    def _brute(self, emis, trans, length, include):
+        n = trans.shape[0]
+        best, best_path = -1e30, None
+        import itertools
+
+        for path in itertools.product(range(n), repeat=length):
+            s = emis[0, path[0]]
+            if include:
+                s += trans[n - 1, path[0]]
+            for t in range(1, length):
+                s += trans[path[t - 1], path[t]] + emis[t, path[t]]
+            if include:
+                s += trans[path[-1], n - 2]
+            if s > best:
+                best, best_path = s, path
+        return best, best_path
+
+    @pytest.mark.parametrize("include", [False, True])
+    def test_matches_bruteforce(self, include):
+        from paddle_tpu.text import viterbi_decode
+
+        rng = np.random.RandomState(0)
+        b, L, n = 3, 5, 4
+        emis = rng.randn(b, L, n).astype(np.float32)
+        trans = rng.randn(n, n).astype(np.float32)
+        lens = np.asarray([5, 3, 1], np.int64)
+        scores, paths = viterbi_decode(paddle.to_tensor(emis),
+                                       paddle.to_tensor(trans),
+                                       paddle.to_tensor(lens),
+                                       include_bos_eos_tag=include)
+        scores = np.asarray(scores.numpy())
+        paths = np.asarray(paths.numpy())
+        assert paths.shape == (b, 5)
+        for i in range(b):
+            ref_s, ref_p = self._brute(emis[i], trans, int(lens[i]), include)
+            np.testing.assert_allclose(scores[i], ref_s, rtol=1e-5)
+            assert tuple(paths[i, :int(lens[i])]) == ref_p
+            assert np.all(paths[i, int(lens[i]):] == 0)
+
+    def test_layer_wrapper(self):
+        from paddle_tpu.text import ViterbiDecoder
+
+        rng = np.random.RandomState(1)
+        emis = rng.randn(2, 4, 3).astype(np.float32)
+        trans = rng.randn(3, 3).astype(np.float32)
+        dec = ViterbiDecoder(paddle.to_tensor(trans),
+                             include_bos_eos_tag=False)
+        s, p = dec(paddle.to_tensor(emis),
+                   paddle.to_tensor(np.asarray([4, 4], np.int64)))
+        assert np.asarray(p.numpy()).shape == (2, 4)
+
+
+class TestTextDatasets:
+    def test_uci_housing_local(self, tmp_path):
+        from paddle_tpu.text import UCIHousing
+
+        rng = np.random.RandomState(0)
+        raw = rng.rand(50, 14).astype(np.float32)
+        path = str(tmp_path / "housing.data")
+        np.savetxt(path, raw)
+        train = UCIHousing(data_file=path, mode="train")
+        test = UCIHousing(data_file=path, mode="test")
+        assert len(train) == 40 and len(test) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_gated_without_data(self):
+        from paddle_tpu.text import WMT14
+
+        with pytest.raises(RuntimeError, match="no network egress"):
+            WMT14()
+
+    def test_imikolov_local(self, tmp_path):
+        from paddle_tpu.text import Imikolov
+
+        d = tmp_path / "ptb"
+        d.mkdir()
+        text = "the quick fox " * 30
+        (d / "ptb.train.txt").write_text(text + "\n" + text)
+        (d / "ptb.valid.txt").write_text(text)
+        ds = Imikolov(data_file=str(d), mode="train", window_size=3,
+                      min_word_freq=2)
+        assert len(ds) > 0
+        assert ds[0].shape == (4,)
+
+
+class TestHub:
+    def test_local_hub_list_help_load(self, tmp_path):
+        hubconf = tmp_path / "hubconf.py"
+        hubconf.write_text(
+            "dependencies = []\n"
+            "def toy_model(scale=2):\n"
+            "    'Builds a toy model.'\n"
+            "    return {'scale': scale}\n")
+        import paddle_tpu.hub as hub
+
+        names = hub.list(str(tmp_path), source="local")
+        assert "toy_model" in names
+        assert "toy" in hub.help(str(tmp_path), "toy_model", source="local")
+        out = hub.load(str(tmp_path), "toy_model", source="local", scale=5)
+        assert out == {"scale": 5}
+
+    def test_remote_sources_gated(self, tmp_path):
+        import paddle_tpu.hub as hub
+
+        with pytest.raises(RuntimeError, match="egress"):
+            hub.list("owner/repo", source="github")
+
+
+class TestOnnxExport:
+    def test_export_emits_aot_artifact(self, tmp_path):
+        import warnings
+
+        from paddle_tpu import nn, onnx, static
+
+        lay = nn.Linear(4, 2)
+        path = str(tmp_path / "model")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            onnx.export(lay, path,
+                        input_spec=[static.InputSpec([None, 4], "float32")])
+        assert os.path.exists(path + ".pdiparams")
+        assert os.path.exists(path + ".stablehlo")
+        with pytest.raises(ValueError):
+            onnx.export(lay, path)  # input_spec required
