@@ -54,6 +54,7 @@ _LAZY_SUBMODULES = {
     "text",
     "hub",
     "onnx",
+    "cost_model",
     "amp",
     "autograd",
     "distributed",

@@ -1,0 +1,58 @@
+"""Cost model (reference: python/paddle/cost_model/cost_model.py —
+profile_measure runs the program under the profiler and reports per-op
+cost).
+
+TPU-native: a static ``Program`` compiles to ONE XLA module, so the two
+cost sources are (a) XLA's own static analysis (flops/bytes accessed via
+``Compiled.cost_analysis``) and (b) measured wall time per program run.
+Both are exposed; there is no per-op replay because XLA fuses across op
+boundaries (that fusion is the point).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def profile_measure(self, startup_program=None, main_program=None,
+                        device: str = "tpu",
+                        fetch_cost_list: Optional[List[str]] = None,
+                        fetch_list=None, feed: Optional[Dict] = None,
+                        iters: int = 3) -> Dict:
+        """Measure the program: wall time per run + XLA cost analysis
+        (reference cost_model.py:profile_measure)."""
+        from ..static.executor import Executor
+
+        exe = Executor()
+        if startup_program is not None:
+            exe.run(startup_program)
+        feed = feed or {}
+        exe.run(main_program, feed=feed, fetch_list=fetch_list)  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = exe.run(main_program, feed=feed, fetch_list=fetch_list)
+        wall = (time.perf_counter() - t0) / iters
+        rec: Dict = {"time_ms": wall * 1e3, "device": device}
+        rec.update(self.static_cost(main_program, feed=feed,
+                                    fetch_list=fetch_list))
+        return rec
+
+    def static_cost(self, main_program, feed=None, fetch_list=None) -> Dict:
+        """XLA static analysis: flops + bytes accessed for the compiled
+        program (the Executor records its last jitted step + args)."""
+        rec = getattr(main_program, "_last_step_args", None)
+        if rec is None:
+            return {}
+        step, args = rec
+        try:
+            analysis = step.lower(*args).compile().cost_analysis()
+            if isinstance(analysis, list):
+                analysis = analysis[0] if analysis else {}
+            return {"flops": float(analysis.get("flops", -1.0)),
+                    "bytes_accessed":
+                        float(analysis.get("bytes accessed", -1.0))}
+        except Exception:
+            return {}

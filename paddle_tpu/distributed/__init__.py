@@ -49,7 +49,8 @@ def __getattr__(name):
     import importlib
 
     if name in ("fleet", "sharding", "checkpoint", "utils", "meta_parallel",
-                "auto_parallel", "launch", "sequence_parallel"):
+                "auto_parallel", "launch", "sequence_parallel", "rpc",
+                "auto_tuner"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

@@ -11,8 +11,6 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-import numpy as np
-
 __all__ = ["MetaOptimizerWrapper"]
 
 
@@ -60,7 +58,3 @@ class MetaOptimizerWrapper:
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
-
-
-def to_numpy_tree(d):
-    return {k: np.asarray(v) for k, v in d.items()}

@@ -1,4 +1,5 @@
-from . import hybrid_parallel_util, log_util  # noqa: F401
+from . import fs, hybrid_parallel_util, log_util  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401
 from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401
 from .log_util import logger  # noqa: F401
 

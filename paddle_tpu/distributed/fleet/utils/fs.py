@@ -1,0 +1,243 @@
+"""Filesystem abstraction (reference: python/paddle/distributed/fleet/
+utils/fs.py — FS base, LocalFS, HDFSClient over the hadoop CLI).
+
+LocalFS is fully implemented; HDFSClient shells out to ``hadoop fs`` when a
+hadoop binary is available (same mechanism as the reference) and raises a
+clear error otherwise.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError", "FSTimeOut"]
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Reference fs.py LocalFS — local-disk implementation."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for entry in sorted(os.listdir(fs_path)):
+            full = os.path.join(fs_path, entry)
+            (dirs if os.path.isdir(full) else files).append(entry)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def _rmr(self, fs_path):
+        shutil.rmtree(fs_path)
+
+    def _rm(self, fs_path):
+        os.remove(fs_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            self._rm(fs_path)
+        else:
+            self._rmr(fs_path)
+
+    def need_upload_download(self) -> bool:
+        return False
+
+    def is_file(self, fs_path) -> bool:
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path) -> bool:
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path) -> bool:
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if not overwrite and self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path) -> List[str]:
+        return self.ls_dir(fs_path)[0]
+
+    def cat(self, fs_path=None) -> str:
+        with open(fs_path) as f:
+            return f.read()
+
+    def upload(self, local_path, fs_path):  # local: a copy
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+
+class HDFSClient(FS):
+    """Reference fs.py HDFSClient — shells out to the hadoop CLI. Every
+    operation raises a clear error when no hadoop binary is present (the
+    TPU image bundles none)."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out: int = 5 * 60 * 1000, sleep_inter: int = 1000):
+        self._hadoop = None
+        if hadoop_home:
+            cand = os.path.join(hadoop_home, "bin", "hadoop")
+            if os.path.exists(cand):
+                self._hadoop = cand
+        elif shutil.which("hadoop"):
+            self._hadoop = shutil.which("hadoop")
+        self._configs = configs or {}
+        self._time_out = time_out
+
+    def _run(self, *args) -> str:
+        if self._hadoop is None:
+            raise RuntimeError(
+                "HDFSClient needs a hadoop CLI (hadoop_home/bin/hadoop); "
+                "none found in this image. Use LocalFS, or install hadoop "
+                "on the host.")
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=self._time_out / 1000)
+        if proc.returncode != 0:
+            raise RuntimeError(f"hadoop {' '.join(args)} failed: "
+                               f"{proc.stderr[-500:]}")
+        return proc.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except RuntimeError:
+            return False
+
+    def is_dir(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except RuntimeError:
+            return False
+
+    def is_file(self, fs_path) -> bool:
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=True):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(fs_src_path)
+        if self.is_exist(fs_dst_path):
+            if not overwrite:
+                raise FSFileExistsError(fs_dst_path)
+            self.delete(fs_dst_path)
+        self.rename(fs_src_path, fs_dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path=None) -> str:
+        return self._run("-cat", fs_path)
+
+    def need_upload_download(self) -> bool:
+        return True

@@ -1,0 +1,219 @@
+"""RPC runtime (reference: python/paddle/distributed/rpc/rpc.py — init_rpc
+over a master TCP store, rpc_sync/rpc_async by worker name, shutdown with a
+never-timeout barrier).
+
+TPU-native/zero-dep: the reference delegates transport to brpc; here each
+worker runs a small threaded TCP server executing pickled (fn, args,
+kwargs) requests, and the rendezvous (name -> ip:port registry + barriers)
+rides the framework's native TCPStore — the same store the collective
+bring-up uses. Single-host multiprocess and multi-host work identically.
+
+Security note (same contract as the reference): RPC endpoints execute
+pickled callables from registered peers — run it only on trusted networks,
+never exposed publicly.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = 30.0
+
+_state = {
+    "store": None,
+    "self": None,          # WorkerInfo
+    "workers": {},         # name -> WorkerInfo
+    "server": None,
+    "server_thread": None,
+    "pool": None,
+    "world_size": 0,
+}
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-message")
+        buf += chunk
+    return bytes(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            req = pickle.loads(_recv_msg(self.request))
+            fn, args, kwargs = req
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except Exception as e:  # noqa: BLE001 — error travels back
+                result = ("err", e)
+            _send_msg(self.request, pickle.dumps(result))
+        except (ConnectionError, EOFError):
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def _host_ip(world_size: int) -> str:
+    """The address peers should dial. Loopback only works single-host;
+    multi-host advertises the interface that routes externally."""
+    if world_size <= 1:
+        return "127.0.0.1"
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))  # no packet sent; picks the route
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start this worker's RPC service and exchange worker infos
+    (reference rpc.py:73)."""
+    import os
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:29401")
+
+    server = _Server(("0.0.0.0", 0), _Handler)
+    ip = _host_ip(world_size)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    me = WorkerInfo(name, rank, ip, port)
+    workers: Dict[str, WorkerInfo] = {}
+    if world_size > 1:
+        from ...native import TCPStore
+
+        host, sport = master_endpoint.rsplit(":", 1)
+        store = TCPStore(host, int(sport), is_master=(rank == 0),
+                         world_size=world_size)
+        _state["store"] = store
+        store.set(f"rpc/{rank}", pickle.dumps(tuple(me)).hex())
+        for r in range(world_size):
+            raw = store.get(f"rpc/{r}")  # blocks until the key appears
+            raw = raw.decode() if isinstance(raw, bytes) else raw
+            info = WorkerInfo(*pickle.loads(bytes.fromhex(raw)))
+            workers[info.name] = info
+    else:
+        workers[name] = me
+
+    _state.update(self=me, workers=workers, server=server,
+                  server_thread=thread, world_size=world_size,
+                  pool=ThreadPoolExecutor(max_workers=8))
+
+
+def _invoke(to: str, fn, args, kwargs, timeout):
+    info = get_worker_info(to)
+    if info is None:
+        raise ValueError(f"unknown rpc worker {to!r}")
+    sock = socket.create_connection((info.ip, info.port),
+                                    timeout=timeout if timeout > 0 else None)
+    try:
+        _send_msg(sock, pickle.dumps((fn, args or (), kwargs or {})))
+        status, payload = pickle.loads(_recv_msg(sock))
+    finally:
+        sock.close()
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking remote call (reference rpc.py:141)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout=_DEFAULT_RPC_TIMEOUT) -> Future:
+    """Non-blocking remote call; returns a Future with .wait()/.result()
+    (reference rpc.py:179 returns a FutureWrapper with wait())."""
+    if _state["pool"] is None:
+        raise RuntimeError("init_rpc must be called first")
+    fut = _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # reference API compatibility
+    return fut
+
+
+def _barrier(tag: str):
+    store = _state["store"]
+    if store is None:
+        return
+    me = _state["self"]
+    world = _state["world_size"]
+    store.set(f"rpc/barrier/{tag}/{me.rank}", "1")
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        if all(_try_get(store, f"rpc/barrier/{tag}/{r}") for r in
+               range(world)):
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"rpc barrier {tag} timed out")
+
+
+def _try_get(store, key):
+    try:
+        return store.get(key)
+    except Exception:
+        return None
+
+
+def shutdown():
+    """Block until every worker reaches shutdown, then stop serving
+    (reference rpc.py:270 '_barrier_never_timeout then stop')."""
+    if _state["server"] is None:
+        return
+    _barrier("shutdown")
+    _state["server"].shutdown()
+    _state["server"].server_close()
+    if _state["pool"] is not None:
+        _state["pool"].shutdown(wait=False)
+    _state.update(server=None, server_thread=None, pool=None, workers={},
+                  self=None, store=None, world_size=0)
+
+
+def get_worker_info(name: str) -> Optional[WorkerInfo]:
+    return _state["workers"].get(name)
+
+
+def get_all_worker_infos():
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> Optional[WorkerInfo]:
+    return _state["self"]

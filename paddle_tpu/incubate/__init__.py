@@ -1,7 +1,7 @@
 """paddle.incubate parity namespace (reference: python/paddle/incubate/)."""
 import importlib
 
-_LAZY = {"distributed", "nn"}
+_LAZY = {"distributed", "nn", "asp"}
 
 
 def __getattr__(name):

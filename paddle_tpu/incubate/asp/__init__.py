@@ -1,0 +1,250 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: python/paddle/incubate/asp/ (utils.py mask algorithms at
+get_mask_1d:179 / get_mask_2d_greedy:313 / get_mask_2d_best:426, asp.py
+ASPHelper prune_model/decorate). TPU note: the reference's end goal is
+NVIDIA sparse-tensor-core kernels; on TPU the value of n:m pruning is the
+model-compression semantics, so ``prune_model`` applies real masks,
+``decorate`` re-applies them after每 optimizer step (sparsity invariant
+under training), and the MXU runs the (dense-stored) masked weights.
+"""
+from __future__ import annotations
+
+import itertools
+from enum import Enum
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers"]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo: MaskAlgo):
+        return (CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D
+                else CheckMethod.CHECK_2D)
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference utils.py:81)."""
+    a = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float(np.count_nonzero(a)) / a.size
+
+
+def _reshape_1d(mat: np.ndarray, m: int):
+    pad = (m - mat.shape[1] % m) % m
+    padded = np.zeros((mat.shape[0], mat.shape[1] + pad), mat.dtype)
+    padded[:, :mat.shape[1]] = mat
+    return padded.reshape(-1, m), padded.shape
+
+
+def get_mask_1d(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|.| of every m consecutive elements per row
+    (reference utils.py:179)."""
+    rows, shape = _reshape_1d(mat, m)
+    mask = np.zeros_like(rows)
+    idx = np.argsort(np.abs(rows), axis=1)[:, -n:]
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    return mask.reshape(shape)[:, :mat.shape[1]]
+
+
+def check_mask_1d(mat: np.ndarray, n: int, m: int) -> bool:
+    rows, _ = _reshape_1d(np.asarray(mat), m)
+    return bool(np.all(np.count_nonzero(rows, axis=1) <= n))
+
+
+def _reshape_2d(mat: np.ndarray, m: int):
+    pad_r = (m - mat.shape[0] % m) % m
+    pad_c = (m - mat.shape[1] % m) % m
+    padded = np.zeros((mat.shape[0] + pad_r, mat.shape[1] + pad_c),
+                      mat.dtype)
+    padded[:mat.shape[0], :mat.shape[1]] = mat
+    h, w = padded.shape
+    blocks = padded.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3)
+    return blocks.reshape(-1, m, m), padded.shape
+
+
+def _unreshape_2d(blocks: np.ndarray, padded_shape, orig_shape, m: int):
+    h, w = padded_shape
+    out = blocks.reshape(h // m, w // m, m, m).transpose(0, 2, 1, 3)
+    return out.reshape(h, w)[:orig_shape[0], :orig_shape[1]]
+
+
+def get_mask_2d_greedy(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Greedy n:m along rows AND columns of each m x m block (reference
+    utils.py:313)."""
+    blocks, pshape = _reshape_2d(mat, m)
+    masks = np.zeros_like(blocks)
+    for bi, block in enumerate(np.abs(blocks)):
+        order = np.argsort(block.ravel())[::-1]
+        row_counts = np.zeros(m, np.int64)
+        col_counts = np.zeros(m, np.int64)
+        for flat in order:
+            r, c = divmod(int(flat), m)
+            if row_counts[r] < n and col_counts[c] < n:
+                masks[bi, r, c] = 1.0
+                row_counts[r] += 1
+                col_counts[c] += 1
+    return _unreshape_2d(masks, pshape, mat.shape, m)
+
+
+_PATTERN_CACHE: Dict = {}
+
+
+def _compute_valid_2d_patterns(n: int, m: int) -> np.ndarray:
+    """All m x m 0/1 patterns with exactly n per row and per column
+    (reference utils.py:385)."""
+    key = (n, m)
+    if key in _PATTERN_CACHE:
+        return _PATTERN_CACHE[key]
+    row_patterns = [p for p in itertools.product([0, 1], repeat=m)
+                    if sum(p) == n]
+    valid = []
+    for combo in itertools.product(row_patterns, repeat=m):
+        arr = np.asarray(combo)
+        if np.all(arr.sum(axis=0) == n):
+            valid.append(arr)
+    pats = np.asarray(valid, np.float64)
+    _PATTERN_CACHE[key] = pats
+    return pats
+
+
+def get_mask_2d_best(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Exhaustive best n:m 2-D pattern per block (reference utils.py:426)."""
+    blocks, pshape = _reshape_2d(mat, m)
+    pats = _compute_valid_2d_patterns(n, m)          # [P, m, m]
+    scores = np.einsum("bij,pij->bp", np.abs(blocks), pats)
+    best = pats[np.argmax(scores, axis=1)]
+    return _unreshape_2d(best.astype(mat.dtype), pshape, mat.shape, m)
+
+
+def check_mask_2d(mat: np.ndarray, n: int, m: int) -> bool:
+    blocks, _ = _reshape_2d(np.asarray(mat), m)
+    nz = blocks != 0
+    return bool(np.all(nz.sum(axis=1) <= n) and np.all(nz.sum(axis=2) <= n))
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n: int = 2, m: int = 4):
+    """Mask for a (possibly >2-D) tensor (reference utils.py:480): shaped
+    over the last two dims, others folded into rows."""
+    a = np.asarray(tensor.numpy() if hasattr(tensor, "numpy") else tensor)
+    dtype = a.dtype
+    shape = a.shape
+    if a.ndim == 1:
+        mat = a.reshape(1, -1)
+    elif a.ndim == 2:
+        mat = a
+    else:
+        mat = a.reshape(-1, shape[-1])
+    fn = globals()[func_name.value if isinstance(func_name, MaskAlgo)
+                   else func_name]
+    mask = fn(mat.astype(np.float64), n, m)
+    return mask.reshape(shape).astype(dtype)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n: int = 2,
+                   m: int = 4) -> bool:
+    a = np.asarray(tensor.numpy() if hasattr(tensor, "numpy") else tensor)
+    mat = a.reshape(-1, a.shape[-1]) if a.ndim != 2 else a
+    fn = globals()[func_name.value if isinstance(func_name, CheckMethod)
+                   else func_name]
+    return fn(mat, n, m)
+
+
+# ---------------------------------------------------------------------------
+# ASPHelper — model-level pruning + optimizer decoration (asp.py parity)
+# ---------------------------------------------------------------------------
+
+_EXCLUDED: Dict[int, set] = {}
+_MASKS: Dict[int, Dict[str, np.ndarray]] = {}
+
+
+def _supported(name: str, param) -> bool:
+    # reference supported_layer_list: fc/linear/conv weights; biases and
+    # norms are never pruned
+    v = param.value if hasattr(param, "value") else param
+    if getattr(v, "ndim", 0) < 2:
+        return False
+    return "weight" in name.split(".")[-1]
+
+
+def set_excluded_layers(model, param_names):
+    """Exclude sublayer/param names from pruning (reference asp.py:121)."""
+    _EXCLUDED.setdefault(id(model), set()).update(param_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        _EXCLUDED.clear()
+    else:
+        _EXCLUDED.pop(id(model), None)
+
+
+def prune_model(model, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Apply n:m masks to every supported weight (reference asp.py:204).
+    Returns {param_name: mask}."""
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    excluded = _EXCLUDED.get(id(model), set())
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _supported(name, p) or any(e in name for e in excluded):
+            continue
+        mask = create_mask(p, func_name=algo, n=n, m=m)
+        import jax.numpy as jnp
+
+        p.set_value(jnp.asarray(np.asarray(p.numpy()) * mask))
+        masks[name] = mask
+    if with_mask:
+        _MASKS[id(model)] = masks
+    return masks
+
+
+from ...distributed.fleet.meta_optimizers.base import MetaOptimizerWrapper
+
+
+class OptimizerWithSparsityGuarantee(MetaOptimizerWrapper):
+    """Re-applies the pruning masks after every step so training keeps the
+    n:m structure (reference asp.py ASPHelper._decorate). Shares the
+    wrapper delegation shell (minimize→self.step, state_dict forwarding)
+    with the fleet meta-optimizers."""
+
+    def __init__(self, optimizer, model):
+        super().__init__(optimizer)
+        self._model = model
+
+    def step(self):
+        self._inner_opt.step()
+        masks = _MASKS.get(id(self._model), {})
+        if not masks:
+            return
+        import jax.numpy as jnp
+
+        for name, p in self._model.named_parameters():
+            mask = masks.get(name)
+            if mask is not None:
+                p.set_value(jnp.asarray(np.asarray(p.numpy()) * mask))
+
+
+def decorate(optimizer, model=None):
+    """Wrap the optimizer with the sparsity guarantee (reference
+    asp.py:160). ``model`` binds the mask set (the eager API needs it
+    explicitly — there is no global program to look it up from)."""
+    if model is None:
+        raise ValueError(
+            "decorate() needs the model the masks were created for: "
+            "asp.decorate(optimizer, model)")
+    return OptimizerWithSparsityGuarantee(optimizer, model)

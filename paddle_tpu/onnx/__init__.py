@@ -21,17 +21,15 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
         raise ValueError(
             "export requires input_spec (a list of paddle_tpu.static."
             "InputSpec) to trace the model")
-    try:
-        import onnx  # noqa: F401
-        has_onnx = True
-    except ImportError:
-        has_onnx = False
-    if not has_onnx:
-        warnings.warn(
-            "onnx/paddle2onnx are not bundled in this TPU image; exporting "
-            "the StableHLO AOT artifact instead (loadable via "
-            "paddle_tpu.inference.create_predictor). Convert to .onnx on a "
-            "machine with paddle2onnx installed.", stacklevel=2)
+    # this build NEVER emits .onnx (paddle2onnx operates on Paddle program
+    # protos, which this framework does not produce) — warn every time so
+    # nobody ships a .stablehlo thinking it's ONNX
+    warnings.warn(
+        "paddle_tpu.onnx.export emits the StableHLO AOT artifact "
+        "(<path>.stablehlo + <path>.pdiparams, loadable via paddle_tpu."
+        "inference.create_predictor), NOT a .onnx file; ONNX conversion "
+        "requires the paddle2onnx toolchain operating on reference program "
+        "protos.", stacklevel=2)
     from .. import jit
 
     jit.save(layer, path, input_spec=input_spec)

@@ -1,0 +1,200 @@
+"""Kernel autotune cache (reference analog: paddle/phi/kernels/autotune/
+— cache.h AlgorithmsCache + auto_tune_base.h tuner that times candidate
+kernels and caches the winner per input signature).
+
+TPU-native shape: Pallas kernels are compiled per block config, so the
+tunable is the BLOCK SIZE tuple, not a cuDNN algo id. Because kernels are
+normally called inside ``jit`` traces (where timing is impossible), tuning
+runs eagerly and out-of-band — ``tune(...)`` benchmarks candidates on the
+real device once, and the winning config is consulted at trace time from a
+process-wide (optionally persisted) cache.
+
+    from paddle_tpu.ops import autotune
+    autotune.tune("flash_attention", (8, 8, 2048, 128), candidates=...,
+                  runner=...)         # or autotune.tune_flash(...)
+    # subsequent flash_attention calls pick up the tuned blocks
+
+``FLAGS_use_autotune`` (framework.flags) gates lookup; the cache file
+defaults to ``~/.paddle_tpu_autotune.json``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+__all__ = ["AutoTuneCache", "get_cache", "lookup", "record", "tune",
+           "tune_flash", "set_cache_path"]
+
+_CACHE_ENV = "PADDLE_TPU_AUTOTUNE_CACHE"
+
+
+def _default_path() -> str:
+    return os.environ.get(
+        _CACHE_ENV, os.path.join(os.path.expanduser("~"),
+                                 ".paddle_tpu_autotune.json"))
+
+
+class AutoTuneCache:
+    """(op, signature) -> winning config dict, with hit/miss counters
+    (reference cache.h keeps the same stats)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._table: Dict[str, dict] = {}
+        self._hits = 0
+        self._misses = 0
+        self._path = path
+
+    @staticmethod
+    def _key(op: str, signature: Sequence) -> str:
+        return f"{op}:{','.join(str(s) for s in signature)}"
+
+    def lookup(self, op: str, signature: Sequence) -> Optional[dict]:
+        rec = self._table.get(self._key(op, signature))
+        if rec is None:
+            self._misses += 1
+            return None
+        self._hits += 1
+        return rec
+
+    def record(self, op: str, signature: Sequence, config: dict):
+        self._table[self._key(op, signature)] = dict(config)
+
+    @property
+    def stats(self):
+        return {"hits": self._hits, "misses": self._misses,
+                "size": len(self._table)}
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: Optional[str] = None):
+        path = path or self._path or _default_path()
+        with open(path, "w") as f:
+            json.dump(self._table, f, indent=1, sort_keys=True)
+
+    def load(self, path: Optional[str] = None) -> bool:
+        path = path or self._path or _default_path()
+        if not os.path.exists(path):
+            return False
+        with open(path) as f:
+            self._table.update(json.load(f))
+        return True
+
+
+_GLOBAL = AutoTuneCache()
+_loaded = [False]
+
+
+def get_cache() -> AutoTuneCache:
+    if not _loaded[0]:
+        _loaded[0] = True
+        try:
+            _GLOBAL.load()
+        except (OSError, ValueError):
+            pass
+    return _GLOBAL
+
+
+def set_cache_path(path: str):
+    _GLOBAL._path = path
+
+
+def _enabled() -> bool:
+    from ..framework.flags import get_flags
+
+    return bool(get_flags("FLAGS_use_autotune").get("FLAGS_use_autotune",
+                                                    True))
+
+
+def lookup(op: str, signature: Sequence) -> Optional[dict]:
+    if not _enabled():
+        return None
+    return get_cache().lookup(op, signature)
+
+
+def record(op: str, signature: Sequence, config: dict):
+    get_cache().record(op, signature, config)
+
+
+def tune(op: str, signature: Sequence, candidates: Iterable[dict],
+         runner: Callable[[dict], None], warmup: int = 1, iters: int = 3,
+         save: bool = True) -> dict:
+    """Time ``runner(config)`` for every candidate, record the winner.
+
+    ``runner`` must execute the kernel to completion (block on a host
+    readback — through a remote-dispatch tunnel ``block_until_ready`` can
+    return before the device finishes).
+    """
+    best_cfg, best_t = None, float("inf")
+    results = []
+    for cfg in candidates:
+        try:
+            for _ in range(warmup):
+                runner(cfg)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                runner(cfg)
+            dt = (time.perf_counter() - t0) / iters
+        except Exception as e:  # candidate doesn't compile/fit — skip
+            results.append({**cfg, "error": str(e)[:120]})
+            continue
+        results.append({**cfg, "ms": dt * 1e3})
+        if dt < best_t:
+            best_cfg, best_t = dict(cfg), dt
+    if best_cfg is None:
+        raise RuntimeError(f"autotune: no candidate for {op} worked: "
+                           f"{results}")
+    best_cfg["ms"] = best_t * 1e3
+    record(op, signature, best_cfg)
+    if save:
+        try:
+            get_cache().save()
+        except OSError:
+            pass
+    return best_cfg
+
+
+# -- flash attention ------------------------------------------------------
+
+FLASH_BLOCK_CANDIDATES = ((1024, 1024), (512, 1024), (1024, 512),
+                          (512, 512), (256, 1024), (512, 2048))
+
+
+def flash_signature(sq: int, sk: int, d: int, causal: bool) -> Tuple:
+    return ("sq", sq, "sk", sk, "d", d, "causal", int(causal))
+
+
+def tune_flash(b: int, h: int, s: int, d: int, causal: bool = True,
+               dtype="bfloat16", candidates=FLASH_BLOCK_CANDIDATES,
+               grad: bool = True) -> dict:
+    """Benchmark flash block sizes at [b, h, s, d] and cache the winner
+    (keyed by sequence/head-dim — batch/head count only scale the grid)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .flash_attention_kernel import flash_attention_bhsd
+
+    key = jax.random.PRNGKey(0)
+    dt = jnp.dtype(dtype)
+    q = jax.random.normal(key, (b, h, s, d), dt)
+    k = jax.random.normal(key, (b, h, s, d), dt)
+    v = jax.random.normal(key, (b, h, s, d), dt)
+
+    def runner(cfg):
+        bq, bk = cfg["block_q"], cfg["block_k"]
+        if grad:
+            def f(q, k, v):
+                return jnp.sum(flash_attention_bhsd(
+                    q, k, v, causal=causal, block_q=bq,
+                    block_k=bk).astype(jnp.float32))
+            out = jax.grad(f)(q, k, v)
+            float(jnp.sum(out))  # host readback barrier
+        else:
+            out = flash_attention_bhsd(q, k, v, causal=causal,
+                                       block_q=bq, block_k=bk)
+            float(jnp.sum(out.astype(jnp.float32)))
+
+    cands = [{"block_q": bq, "block_k": bk} for bq, bk in candidates
+             if bq <= s and bk <= s]
+    return tune("flash_attention", flash_signature(s, s, d, causal), cands,
+                runner)

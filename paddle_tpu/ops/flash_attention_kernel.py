@@ -447,7 +447,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention_bhsd(q, k, v, *, causal: bool = False,
                          sm_scale: Optional[float] = None,
                          dropout_p: float = 0.0, seed=None,
-                         block_q: int = 1024, block_k: int = 1024,
+                         block_q: Optional[int] = None,
+                         block_k: Optional[int] = None,
                          interpret: Optional[bool] = None):
     """Flash attention over ``[B, H, S, D]`` tensors (GQA allowed: K/V may
     have ``Hq / G`` heads). Differentiable; bwd recomputes attention from
@@ -471,5 +472,15 @@ def flash_attention_bhsd(q, k, v, *, causal: bool = False,
         seed = jnp.zeros((1,), jnp.int32)
     else:
         seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    if block_q is None or block_k is None:
+        # consult the autotune cache (ops/autotune.py); 1024x1024 is the
+        # measured default at llama shapes on v5e
+        from .autotune import flash_signature, lookup
+
+        tuned = lookup("flash_attention",
+                       flash_signature(q.shape[2], k.shape[2], q.shape[-1],
+                                       causal)) or {}
+        block_q = block_q or tuned.get("block_q", 1024)
+        block_k = block_k or tuned.get("block_k", 1024)
     return _flash(q, k, v, seed, causal, float(sm_scale), float(dropout_p),
                   block_q, block_k, it)

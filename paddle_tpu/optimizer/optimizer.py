@@ -110,12 +110,29 @@ class Optimizer:
             self._step_count = int(v.item() if hasattr(v, "item") else v)
         if "LR_Scheduler" in state_dict and isinstance(self._lr, LRScheduler):
             self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        # restore into existing accumulator slots
+        restored = set()
         for acc_name, d in self._accumulators.items():
             for pkey in list(d.keys()):
                 full = f"{pkey}_{acc_name}"
                 if full in state_dict:
                     v = state_dict[full]
                     d[pkey] = jnp.asarray(v.value if isinstance(v, Tensor) else v)
+                    restored.add(full)
+        # a FRESH optimizer has no accumulators yet — match remaining state
+        # keys against param names so resume does not silently drop moments
+        pkeys = sorted((self._key(p) for p in self._parameter_list or []),
+                       key=len, reverse=True)
+        for full, v in state_dict.items():
+            if full in restored or full in ("global_step", "LR_Scheduler") \
+                    or full.startswith("__"):
+                continue
+            for pkey in pkeys:
+                if full.startswith(pkey + "_"):
+                    acc_name = full[len(pkey) + 1:]
+                    self._accumulators.setdefault(acc_name, {})[pkey] = \
+                        jnp.asarray(v.value if isinstance(v, Tensor) else v)
+                    break
 
     set_dict = set_state_dict
 

@@ -22,6 +22,15 @@ from .program import Program, default_main_program, global_scope
 __all__ = ["Executor"]
 
 
+def _avals(tree):
+    """Shape/dtype skeleton of a pytree — kept (instead of the live arrays)
+    on the Program for CostModel.static_cost re-lowering, so no stale
+    generation of params/opt state stays pinned in device memory."""
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a)),
+        tree)
+
+
 def _walk(prog: Program, env: Dict[int, Any]):
     for node in prog.nodes:
         flat = []
@@ -87,12 +96,15 @@ class Executor:
 
         if prog.train_config is not None:
             lr = jnp.asarray(prog.train_config[0].get_lr(), jnp.float32)
+            prog._last_step_args = (step, _avals((feeds, params, opt_state,
+                                                  lr)))
             fetches, new_params, opt_state = step(feeds, params, opt_state, lr)
             for n, v in new_params.items():
                 scope.set(n, v)
                 prog.param_objs[n]._value = v  # keep eager view in sync
             scope.set(f"__opt_state_{prog.id}", opt_state)
         else:
+            prog._last_step_args = (step, _avals((feeds, params)))
             fetches = step(feeds, params)
         if return_numpy:
             return [np.asarray(f) for f in fetches]

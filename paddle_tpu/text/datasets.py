@@ -145,6 +145,10 @@ class Imikolov(Dataset):
         for ln in train_lines:
             for w in ln.split():
                 freq[w] = freq.get(w, 0) + 1
+            # sentence boundary markers count once per line (reference
+            # imikolov.py build_dict) so BOS/EOS get real vocab ids
+            freq["<s>"] = freq.get("<s>", 0) + 1
+            freq["<e>"] = freq.get("<e>", 0) + 1
         freq = {w: c for w, c in freq.items() if c >= min_word_freq}
         words = sorted(freq, key=lambda w: (-freq[w], w))
         self.word_idx = {w: i for i, w in enumerate(words)}

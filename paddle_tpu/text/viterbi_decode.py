@@ -63,6 +63,9 @@ def _viterbi(potentials, transitions, lengths, include_bos_eos_tag):
     return scores, path.astype(jnp.int64), max_len
 
 
+_viterbi_jit = jax.jit(_viterbi, static_argnums=(3,))
+
+
 def viterbi_decode(potentials, transition_params, lengths,
                    include_bos_eos_tag=True, name=None):
     """Highest-scoring tag sequence. potentials [B, L, N], transitions
@@ -70,9 +73,8 @@ def viterbi_decode(potentials, transition_params, lengths,
     pot = unwrap(potentials)
     trans = unwrap(transition_params)
     lens = unwrap(lengths)
-    scores, path, max_len = jax.jit(
-        _viterbi, static_argnums=(3,))(pot, trans, lens,
-                                       bool(include_bos_eos_tag))
+    scores, path, max_len = _viterbi_jit(pot, trans, lens,
+                                         bool(include_bos_eos_tag))
     path = path[:, :int(max_len)]
     return Tensor(scores), Tensor(path)
 

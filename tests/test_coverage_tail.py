@@ -1,0 +1,233 @@
+"""Tests for ASP, RPC, fleet fs, and the cost model.
+
+Reference analogs: test/asp/test_asp_pruning_dynamic.py,
+test/rpc/test_rpc_basic.py, test/collective/fleet/test_fs.py,
+test/legacy_test/test_cost_model.py.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+
+
+class TestAspUtils:
+    def test_get_mask_1d(self):
+        from paddle_tpu.incubate.asp import check_mask_1d, get_mask_1d
+
+        rng = np.random.RandomState(0)
+        mat = rng.randn(8, 16)
+        mask = get_mask_1d(mat, 2, 4)
+        assert mask.shape == mat.shape
+        assert check_mask_1d(mat * mask, 2, 4)
+        # keeps exactly the 2 largest |.| of each group of 4
+        groups = (np.abs(mat) * mask).reshape(-1, 4)
+        raw = np.abs(mat).reshape(-1, 4)
+        for g, r in zip(groups, raw):
+            np.testing.assert_allclose(sorted(g[g > 0]), sorted(r)[-2:])
+
+    def test_get_mask_2d_variants(self):
+        from paddle_tpu.incubate.asp import (check_mask_2d,
+                                             get_mask_2d_best,
+                                             get_mask_2d_greedy)
+
+        rng = np.random.RandomState(1)
+        mat = rng.randn(8, 8)
+        for fn in (get_mask_2d_greedy, get_mask_2d_best):
+            mask = fn(mat, 2, 4)
+            assert check_mask_2d(mat * mask, 2, 4), fn.__name__
+        # best >= greedy in kept magnitude
+        g = np.abs(mat * get_mask_2d_greedy(mat, 2, 4)).sum()
+        b = np.abs(mat * get_mask_2d_best(mat, 2, 4)).sum()
+        assert b >= g - 1e-9
+
+    def test_calculate_density(self):
+        from paddle_tpu.incubate.asp import calculate_density
+
+        x = np.zeros((4, 4))
+        x[0, 0] = 1.0
+        assert calculate_density(x) == 1 / 16
+
+    def test_nonmultiple_shapes_pad(self):
+        from paddle_tpu.incubate.asp import check_mask_1d, get_mask_1d
+
+        mat = np.random.RandomState(3).randn(3, 10)
+        mask = get_mask_1d(mat, 2, 4)
+        assert mask.shape == mat.shape
+        assert check_mask_1d(mat * mask, 2, 4)
+
+
+class TestAspModel:
+    def test_prune_and_training_keeps_sparsity(self):
+        from paddle_tpu.incubate import asp
+
+        m = nn.Linear(16, 8)
+        masks = asp.prune_model(m, n=2, m=4)
+        assert "weight" in masks and "bias" not in masks
+        w = np.asarray(m.weight.numpy())
+        assert asp.check_sparsity(w, n=2, m=4)
+        o = asp.decorate(opt.SGD(learning_rate=0.1,
+                                 parameters=m.parameters()), m)
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            x = rng.randn(4, 16).astype(np.float32)
+            y = rng.randn(4, 8).astype(np.float32)
+            loss = paddle.mean((m(paddle.to_tensor(x))
+                                - paddle.to_tensor(y)) ** 2)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        w2 = np.asarray(m.weight.numpy())
+        assert not np.allclose(w, w2)          # trained
+        assert asp.check_sparsity(w2, n=2, m=4)  # still 2:4
+
+    def test_excluded_layers(self):
+        from paddle_tpu.incubate import asp
+
+        m = nn.Linear(8, 8)
+        asp.set_excluded_layers(m, ["weight"])
+        try:
+            masks = asp.prune_model(m)
+            assert masks == {}
+        finally:
+            asp.reset_excluded_layers(m)
+
+    def test_decorate_requires_model(self):
+        from paddle_tpu.incubate import asp
+
+        m = nn.Linear(4, 4)
+        with pytest.raises(ValueError, match="model"):
+            asp.decorate(opt.SGD(learning_rate=0.1,
+                                 parameters=m.parameters()))
+
+
+def _double(x):
+    return x * 2
+
+
+def _boom():
+    raise ValueError("remote failure")
+
+
+class TestRpc:
+    def test_single_worker_rpc_roundtrip(self):
+        from paddle_tpu.distributed import rpc
+
+        rpc.init_rpc("worker0", rank=0, world_size=1)
+        try:
+            info = rpc.get_current_worker_info()
+            assert info.name == "worker0" and info.rank == 0
+            assert rpc.get_worker_info("worker0") == info
+            assert rpc.get_all_worker_infos() == [info]
+            out = rpc.rpc_sync("worker0", _double, args=(21,))
+            assert out == 42
+            fut = rpc.rpc_async("worker0", _double, args=(5,))
+            assert fut.wait() == 10
+        finally:
+            rpc.shutdown()
+
+    def test_remote_exception_propagates(self):
+        from paddle_tpu.distributed import rpc
+
+        rpc.init_rpc("w", rank=0, world_size=1)
+        try:
+            with pytest.raises(ValueError, match="remote failure"):
+                rpc.rpc_sync("w", _boom)
+        finally:
+            rpc.shutdown()
+
+    @pytest.mark.slow
+    def test_two_process_rpc(self, tmp_path):
+        import socket
+        import subprocess
+        import sys
+        import textwrap
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {repo!r})
+            rank = int(sys.argv[1])
+            from paddle_tpu.distributed import rpc
+            rpc.init_rpc(f"worker{{rank}}", rank=rank, world_size=2,
+                         master_endpoint="127.0.0.1:{port}")
+            import operator
+            if rank == 0:
+                out = rpc.rpc_sync("worker1", operator.add, args=(2, 3))
+                assert out == 5, out
+                print("RPC_OK", out)
+            rpc.shutdown()
+        """)
+        p = tmp_path / "w.py"
+        p.write_text(script)
+        procs = [subprocess.Popen([sys.executable, str(p), str(r)],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, text=True)
+                 for r in range(2)]
+        outs = [pr.communicate(timeout=120) for pr in procs]
+        for pr, (out, err) in zip(procs, outs):
+            assert pr.returncode == 0, err[-1500:]
+        assert "RPC_OK 5" in outs[0][0]
+
+
+class TestFs:
+    def test_localfs_surface(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+
+        fs = LocalFS()
+        d = str(tmp_path / "dir")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = os.path.join(d, "a.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        with open(f, "w") as fh:
+            fh.write("hello")
+        assert fs.cat(f) == "hello"
+        dirs, files = fs.ls_dir(d)
+        assert files == ["a.txt"] and dirs == []
+        f2 = os.path.join(d, "b.txt")
+        fs.mv(f, f2)
+        assert fs.is_file(f2) and not fs.is_exist(f)
+        assert not fs.need_upload_download()
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_without_hadoop_raises(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+
+        client = HDFSClient(hadoop_home="/nonexistent")
+        with pytest.raises(RuntimeError, match="hadoop"):
+            client.mkdirs("/tmp/x")
+
+
+class TestCostModel:
+    def test_profile_measure_static_program(self):
+        import paddle_tpu.static as static
+        from paddle_tpu.cost_model import CostModel
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data(name="X", shape=[4, 8], dtype="float32")
+                w = paddle.create_parameter([8, 2], "float32")
+                out = paddle.matmul(x, w)
+                loss = paddle.mean(out)
+            cm = CostModel()
+            rec = cm.profile_measure(
+                startup, main, device="cpu", fetch_list=[loss],
+                feed={"X": np.random.rand(4, 8).astype(np.float32)})
+            assert rec["time_ms"] > 0
+            assert "flops" in rec and rec["flops"] >= 0
+        finally:
+            paddle.disable_static()

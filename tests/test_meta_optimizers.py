@@ -243,6 +243,13 @@ class TestWrapperDelegation:
         dgc2.set_state_dict(sd)
         assert dgc2._count == 3
         assert set(dgc2._e) == set(dgc._e)
+        # momentum velocity must be restored on a FRESH instance too —
+        # a resume that restarts velocity from zero is a different optimizer
+        assert "velocity" in dgc2._accumulators
+        for pkey, v in dgc._accumulators["velocity"].items():
+            np.testing.assert_array_equal(
+                np.asarray(dgc2._accumulators["velocity"][pkey]),
+                np.asarray(v))
 
     def test_dgc_rejects_adaptive_optimizers(self):
         fleet.init(is_collective=True)
