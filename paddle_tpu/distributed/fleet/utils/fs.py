@@ -171,8 +171,13 @@ class HDFSClient(FS):
         for k, v in self._configs.items():
             cmd += ["-D", f"{k}={v}"]
         cmd += list(args)
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=self._time_out / 1000)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self._time_out / 1000)
+        except subprocess.TimeoutExpired as e:
+            raise FSTimeOut(
+                f"hadoop {' '.join(args)} timed out after "
+                f"{self._time_out}ms") from e
         if proc.returncode != 0:
             raise RuntimeError(f"hadoop {' '.join(args)} failed: "
                                f"{proc.stderr[-500:]}")

@@ -170,27 +170,23 @@ def rpc_async(to: str, fn, args=None, kwargs=None,
     return fut
 
 
-def _barrier(tag: str):
+def _barrier(tag: str, timeout: float = 600.0):
+    """Counter-based barrier over the store. ``store.get`` blocks
+    server-side on missing keys, so the polls use ``add(key, 0)`` — a
+    non-blocking read that also creates the key — keeping the deadline
+    live even when a peer never arrives."""
     store = _state["store"]
     if store is None:
         return
-    me = _state["self"]
     world = _state["world_size"]
-    store.set(f"rpc/barrier/{tag}/{me.rank}", "1")
-    deadline = time.time() + 600
+    key = f"rpc/barrier/{tag}"
+    store.add(key, 1)
+    deadline = time.time() + timeout
     while time.time() < deadline:
-        if all(_try_get(store, f"rpc/barrier/{tag}/{r}") for r in
-               range(world)):
+        if store.add(key, 0) >= world:
             return
         time.sleep(0.01)
     raise TimeoutError(f"rpc barrier {tag} timed out")
-
-
-def _try_get(store, key):
-    try:
-        return store.get(key)
-    except Exception:
-        return None
 
 
 def shutdown():
@@ -198,7 +194,28 @@ def shutdown():
     (reference rpc.py:270 '_barrier_never_timeout then stop')."""
     if _state["server"] is None:
         return
+    store = _state["store"]
+    me = _state["self"]
+    world = _state["world_size"]
     _barrier("shutdown")
+    if store is not None:
+        # ordered teardown: rank 0 owns the in-process store server and
+        # must outlive every peer's final barrier poll — non-masters ack
+        # departure, the master waits for all acks before closing
+        if me.rank != 0:
+            try:
+                store.add("rpc/barrier/departed", 1)
+            except Exception:
+                pass
+        else:
+            deadline = time.time() + 600
+            while time.time() < deadline:
+                try:
+                    if store.add("rpc/barrier/departed", 0) >= world - 1:
+                        break
+                except Exception:
+                    break
+                time.sleep(0.01)
     _state["server"].shutdown()
     _state["server"].server_close()
     if _state["pool"] is not None:

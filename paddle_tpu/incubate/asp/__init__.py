@@ -5,8 +5,9 @@ get_mask_1d:179 / get_mask_2d_greedy:313 / get_mask_2d_best:426, asp.py
 ASPHelper prune_model/decorate). TPU note: the reference's end goal is
 NVIDIA sparse-tensor-core kernels; on TPU the value of n:m pruning is the
 model-compression semantics, so ``prune_model`` applies real masks,
-``decorate`` re-applies them after每 optimizer step (sparsity invariant
-under training), and the MXU runs the (dense-stored) masked weights.
+``decorate`` re-applies them after every optimizer step (sparsity
+invariant under training), and the MXU runs the (dense-stored) masked
+weights.
 """
 from __future__ import annotations
 
@@ -166,8 +167,11 @@ def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n: int = 2,
 # ASPHelper — model-level pruning + optimizer decoration (asp.py parity)
 # ---------------------------------------------------------------------------
 
-_EXCLUDED: Dict[int, set] = {}
-_MASKS: Dict[int, Dict[str, np.ndarray]] = {}
+# mask/exclusion state lives ON the model object (attributes below) — an
+# id()-keyed registry would leak masks for the process lifetime and could
+# hand a recycled id the previous model's masks
+_MASK_ATTR = "_asp_masks"
+_EXCL_ATTR = "_asp_excluded"
 
 
 def _supported(name: str, param) -> bool:
@@ -181,35 +185,42 @@ def _supported(name: str, param) -> bool:
 
 def set_excluded_layers(model, param_names):
     """Exclude sublayer/param names from pruning (reference asp.py:121)."""
-    _EXCLUDED.setdefault(id(model), set()).update(param_names)
+    excl = getattr(model, _EXCL_ATTR, None)
+    if excl is None:
+        excl = set()
+        object.__setattr__(model, _EXCL_ATTR, excl)
+    excl.update(param_names)
 
 
 def reset_excluded_layers(model=None):
-    if model is None:
-        _EXCLUDED.clear()
-    else:
-        _EXCLUDED.pop(id(model), None)
+    if model is not None and hasattr(model, _EXCL_ATTR):
+        getattr(model, _EXCL_ATTR).clear()
 
 
 def prune_model(model, n: int = 2, m: int = 4,
                 mask_algo: str = "mask_1d", with_mask: bool = True):
     """Apply n:m masks to every supported weight (reference asp.py:204).
     Returns {param_name: mask}."""
+    import jax.numpy as jnp
+
     algo = {"mask_1d": MaskAlgo.MASK_1D,
             "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
             "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
-    excluded = _EXCLUDED.get(id(model), set())
+    excluded = getattr(model, _EXCL_ATTR, set())
     masks = {}
+    device_masks = {}
     for name, p in model.named_parameters():
         if not _supported(name, p) or any(e in name for e in excluded):
             continue
         mask = create_mask(p, func_name=algo, n=n, m=m)
-        import jax.numpy as jnp
-
-        p.set_value(jnp.asarray(np.asarray(p.numpy()) * mask))
+        # masks stay resident on device: the per-step re-masking in
+        # decorate() must be value * mask with no host round-trip
+        mask_dev = jnp.asarray(mask, p.value.dtype)
+        p.set_value(p.value * mask_dev)
         masks[name] = mask
+        device_masks[name] = mask_dev
     if with_mask:
-        _MASKS[id(model)] = masks
+        object.__setattr__(model, _MASK_ATTR, device_masks)
     return masks
 
 
@@ -228,15 +239,14 @@ class OptimizerWithSparsityGuarantee(MetaOptimizerWrapper):
 
     def step(self):
         self._inner_opt.step()
-        masks = _MASKS.get(id(self._model), {})
+        masks = getattr(self._model, _MASK_ATTR, {})
         if not masks:
             return
-        import jax.numpy as jnp
-
         for name, p in self._model.named_parameters():
             mask = masks.get(name)
             if mask is not None:
-                p.set_value(jnp.asarray(np.asarray(p.numpy()) * mask))
+                # device-resident multiply; no host sync per step
+                p.set_value(p.value * mask)
 
 
 def decorate(optimizer, model=None):

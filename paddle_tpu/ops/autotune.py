@@ -160,8 +160,12 @@ FLASH_BLOCK_CANDIDATES = ((1024, 1024), (512, 1024), (1024, 512),
                           (512, 512), (256, 1024), (512, 2048))
 
 
-def flash_signature(sq: int, sk: int, d: int, causal: bool) -> Tuple:
-    return ("sq", sq, "sk", sk, "d", d, "causal", int(causal))
+def flash_signature(sq: int, sk: int, d: int, causal: bool,
+                    dtype="bfloat16") -> Tuple:
+    # dtype is part of the key: a block config tuned for bf16 has half the
+    # VMEM footprint of the same config at fp32
+    return ("sq", sq, "sk", sk, "d", d, "causal", int(causal),
+            "dtype", str(dtype))
 
 
 def tune_flash(b: int, h: int, s: int, d: int, causal: bool = True,
@@ -196,5 +200,5 @@ def tune_flash(b: int, h: int, s: int, d: int, causal: bool = True,
 
     cands = [{"block_q": bq, "block_k": bk} for bq, bk in candidates
              if bq <= s and bk <= s]
-    return tune("flash_attention", flash_signature(s, s, d, causal), cands,
-                runner)
+    return tune("flash_attention", flash_signature(s, s, d, causal, dtype),
+                cands, runner)

@@ -479,7 +479,8 @@ def flash_attention_bhsd(q, k, v, *, causal: bool = False,
 
         tuned = lookup("flash_attention",
                        flash_signature(q.shape[2], k.shape[2], q.shape[-1],
-                                       causal)) or {}
+                                       causal, jnp.dtype(q.dtype).name)) \
+            or {}
         block_q = block_q or tuned.get("block_q", 1024)
         block_k = block_k or tuned.get("block_k", 1024)
     return _flash(q, k, v, seed, causal, float(sm_scale), float(dropout_p),

@@ -49,9 +49,11 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[tuple, Any] = {}
+        self._aval_cache: Dict[tuple, Any] = {}
 
     def close(self):
         self._cache.clear()
+        self._aval_cache.clear()
 
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list=None, scope=None, return_numpy: bool = True):
@@ -96,15 +98,19 @@ class Executor:
 
         if prog.train_config is not None:
             lr = jnp.asarray(prog.train_config[0].get_lr(), jnp.float32)
-            prog._last_step_args = (step, _avals((feeds, params, opt_state,
-                                                  lr)))
+            if key not in self._aval_cache:  # shapes invariant per step fn
+                self._aval_cache[key] = _avals((feeds, params, opt_state,
+                                                lr))
+            prog._last_step_args = (step, self._aval_cache[key])
             fetches, new_params, opt_state = step(feeds, params, opt_state, lr)
             for n, v in new_params.items():
                 scope.set(n, v)
                 prog.param_objs[n]._value = v  # keep eager view in sync
             scope.set(f"__opt_state_{prog.id}", opt_state)
         else:
-            prog._last_step_args = (step, _avals((feeds, params)))
+            if key not in self._aval_cache:
+                self._aval_cache[key] = _avals((feeds, params))
+            prog._last_step_args = (step, self._aval_cache[key])
             fetches = step(feeds, params)
         if return_numpy:
             return [np.asarray(f) for f in fetches]

@@ -87,7 +87,7 @@ class TestFlashIntegration:
         from paddle_tpu.ops.flash_attention_kernel import flash_attention_bhsd
 
         # record a signature-matching config with a recognizable block size
-        sig = autotune.flash_signature(128, 128, 32, True)
+        sig = autotune.flash_signature(128, 128, 32, True, "float32")
         autotune.record("flash_attention", sig,
                         {"block_q": 64, "block_k": 64, "ms": 0.1})
         rng = np.random.RandomState(0)
@@ -107,4 +107,5 @@ class TestFlashIntegration:
         assert "block_q" in best and "ms" in best
         assert autotune.lookup(
             "flash_attention",
-            autotune.flash_signature(128, 128, 16, True)) is not None
+            autotune.flash_signature(128, 128, 16, True,
+                                     "float32")) is not None
