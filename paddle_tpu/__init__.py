@@ -124,6 +124,12 @@ def __getattr__(name):
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
+def __dir__():
+    # PEP 562 lazy names are invisible to dir() unless listed here —
+    # discoverability matters for API-surface parity checks and tooling
+    return sorted(set(globals()) | _LAZY_SUBMODULES | set(_LAZY_ATTRS))
+
+
 def enable_static():
     """Enter static (record-then-jit) mode — see paddle_tpu.static."""
     from .static import enable_static as _e

@@ -1,7 +1,7 @@
 """paddle.incubate parity namespace (reference: python/paddle/incubate/)."""
 import importlib
 
-_LAZY = {"distributed", "nn", "asp"}
+_LAZY = {"distributed", "nn", "asp", "optimizer"}
 
 
 def __getattr__(name):
@@ -10,3 +10,7 @@ def __getattr__(name):
         globals()[name] = mod
         return mod
     raise AttributeError(f"module 'paddle_tpu.incubate' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | _LAZY)

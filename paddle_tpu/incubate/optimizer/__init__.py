@@ -1,0 +1,4 @@
+"""paddle.incubate.optimizer parity."""
+from .distributed_fused_lamb import DistributedFusedLamb
+
+__all__ = ["DistributedFusedLamb"]
