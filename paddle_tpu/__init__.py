@@ -55,6 +55,9 @@ _LAZY_SUBMODULES = {
     "hub",
     "onnx",
     "cost_model",
+    "device",
+    "reader",
+    "dataset",
     "amp",
     "autograd",
     "distributed",
@@ -108,6 +111,8 @@ _LAZY_ATTRS = {
     "squeeze_": ("paddle_tpu.framework.compat", "squeeze_"),
     "tanh_": ("paddle_tpu.framework.compat", "tanh_"),
     "unsqueeze_": ("paddle_tpu.framework.compat", "unsqueeze_"),
+    "callbacks": ("paddle_tpu.hapi", "callbacks"),
+    "synchronize": ("paddle_tpu.device", "synchronize"),
 }
 
 

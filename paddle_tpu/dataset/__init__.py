@@ -1,0 +1,55 @@
+"""paddle.dataset parity (reference: python/paddle/dataset/ — the legacy
+reader-style dataset loaders, superseded in 2.x by paddle.vision.datasets
+and paddle.text).
+
+This build keeps the module shape: `common` utilities are real; the
+per-dataset loaders delegate to the maintained vision/text dataset classes
+(download gated — zero-egress build, pass local paths).
+"""
+from . import common
+
+__all__ = ["common", "uci_housing", "imdb", "imikolov", "movielens"]
+
+
+class _DelegatingLoader:
+    """reader-style wrapper over a Dataset class: train()/test() return
+    zero-arg reader callables (the paddle.dataset contract)."""
+
+    def __init__(self, cls, name):
+        self._cls = cls
+        self.__name__ = name
+
+    def _reader(self, mode, **kwargs):
+        def reader():
+            ds = self._cls(mode=mode, **kwargs)
+            for i in range(len(ds)):
+                yield ds[i]
+
+        return reader
+
+    def train(self, **kwargs):
+        return self._reader("train", **kwargs)
+
+    def test(self, **kwargs):
+        return self._reader("test", **kwargs)
+
+
+def __getattr__(name):
+    if name == "uci_housing":
+        from ..text.datasets import UCIHousing
+
+        return _DelegatingLoader(UCIHousing, name)
+    if name == "imdb":
+        from ..text.datasets import Imdb
+
+        return _DelegatingLoader(Imdb, name)
+    if name == "imikolov":
+        from ..text.datasets import Imikolov
+
+        return _DelegatingLoader(Imikolov, name)
+    if name == "movielens":
+        from ..text.datasets import Movielens
+
+        return _DelegatingLoader(Movielens, name)
+    raise AttributeError(f"module 'paddle_tpu.dataset' has no attribute "
+                         f"{name!r}")

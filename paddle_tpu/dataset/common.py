@@ -1,0 +1,74 @@
+"""paddle.dataset.common parity (reference: python/paddle/dataset/
+common.py — md5file, DATA_HOME, download (gated), split/cluster readers).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Callable
+
+__all__ = ["DATA_HOME", "md5file", "download", "split",
+           "cluster_files_reader"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str,
+             save_name: str = None) -> str:
+    """Zero-egress build: resolves to an existing local file or raises
+    with instructions (reference common.py:download fetches over HTTP)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name or url.split("/")[-1])
+    if os.path.exists(filename) and (not md5sum
+                                     or md5file(filename) == md5sum):
+        return filename
+    raise RuntimeError(
+        f"no local copy of {url}: this build has no network egress. "
+        f"Download it on a connected machine and place it at {filename}.")
+
+
+def split(reader: Callable, line_count: int, suffix: str = "%05d.pickle",
+          dumper=pickle.dump):
+    """Split a reader's samples into pickled chunk files (reference
+    common.py:split)."""
+    indx_f = 0
+    lines = []
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= (indx_f + 1) * line_count - 1:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern: str, trainer_count: int,
+                         trainer_id: int, loader=pickle.load):
+    """Round-robin chunk-file reader for one trainer (reference
+    common.py:cluster_files_reader)."""
+    import glob
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        my_files = [f for i, f in enumerate(file_list)
+                    if i % trainer_count == trainer_id]
+        for fn in my_files:
+            with open(fn, "rb") as f:
+                for line in loader(f):
+                    yield line
+
+    return reader

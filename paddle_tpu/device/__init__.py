@@ -1,0 +1,75 @@
+"""paddle.device parity (reference: python/paddle/device/__init__.py).
+
+Device management over the JAX runtime: the reference's cuda/xpu split
+maps to TPU-first with CPU fallback; CUDA-only knobs exist as honest
+no-ops/gates so reference scripts run unmodified.
+"""
+from __future__ import annotations
+
+from ..core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace,
+                          device_count, get_device, is_compiled_with_cuda,
+                          is_compiled_with_tpu, set_device)
+from . import cuda, xpu
+
+__all__ = ["get_device", "set_device", "get_all_device_type",
+           "get_all_custom_device_type", "get_available_device",
+           "get_available_custom_device", "is_compiled_with_cuda",
+           "is_compiled_with_tpu", "is_compiled_with_xpu",
+           "is_compiled_with_cinn", "is_compiled_with_rocm", "cuda", "xpu",
+           "synchronize", "XPUPlace", "IPUPlace"]
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role (DESIGN.md); the reference flag answers "is
+    # the optional tensor-compiler path built in" — here it always is
+    return True
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def synchronize(device=None):
+    """Block until all queued device work finishes (reference
+    device.synchronize / cuda.synchronize)."""
+    import jax
+
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class XPUPlace:  # API-parity placeholder
+    def __init__(self, dev_id=0):
+        raise RuntimeError("XPU is not available in a TPU-native build")
+
+
+class IPUPlace:
+    def __init__(self, dev_id=0):
+        raise RuntimeError("IPU is not available in a TPU-native build")

@@ -1,0 +1,133 @@
+"""paddle.device.cuda parity (reference: python/paddle/device/cuda/).
+
+On TPU these resolve against the JAX runtime where meaningful and are
+honest no-ops where the concept is CUDA-specific (streams and caching
+allocator belong to XLA here).
+"""
+from __future__ import annotations
+
+__all__ = ["Stream", "Event", "current_stream", "synchronize",
+           "device_count", "empty_cache", "max_memory_allocated",
+           "max_memory_reserved", "memory_allocated", "memory_reserved",
+           "stream_guard", "get_device_properties", "get_device_name",
+           "get_device_capability"]
+
+
+def device_count() -> int:
+    import jax
+
+    return sum(1 for d in jax.devices() if d.platform != "cpu") or 0
+
+
+def synchronize(device=None):
+    from .. import synchronize as _sync
+
+    _sync(device)
+
+
+def empty_cache():
+    """XLA owns the allocator; nothing to flush eagerly."""
+
+
+def _mem_stat(key: str, device=None) -> int:
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        return 0
+    stats = devs[0].memory_stats() or {}
+    return int(stats.get(key, 0))
+
+
+def memory_allocated(device=None) -> int:
+    return _mem_stat("bytes_in_use", device)
+
+
+def max_memory_allocated(device=None) -> int:
+    return _mem_stat("peak_bytes_in_use", device)
+
+
+def memory_reserved(device=None) -> int:
+    return _mem_stat("bytes_reserved", device) or _mem_stat(
+        "bytes_in_use", device)
+
+
+def max_memory_reserved(device=None) -> int:
+    return max_memory_allocated(device)
+
+
+def get_device_name(device=None) -> str:
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    return devs[0].device_kind if devs else "cpu"
+
+
+def get_device_capability(device=None):
+    return (0, 0)  # CUDA compute capability has no TPU analog
+
+
+def get_device_properties(device=None):
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        raise RuntimeError("no accelerator device present")
+    d = devs[0]
+
+    class _Props:
+        name = d.device_kind
+        major, minor = 0, 0
+        total_memory = (d.memory_stats() or {}).get("bytes_limit", 0)
+        multi_processor_count = 1
+
+    return _Props()
+
+
+class Stream:
+    """CUDA-stream shim: XLA orders work per device; the API exists so
+    reference code constructs/queries it without branching."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        synchronize()
+
+    def wait_stream(self, stream):
+        synchronize()
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None) -> Stream:
+    return Stream(device)
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,7 @@
+"""paddle.device.xpu parity — gated (no XPU in a TPU-native build)."""
+
+__all__ = ["synchronize"]
+
+
+def synchronize(device=None):
+    raise RuntimeError("XPU is not available in a TPU-native build")

@@ -52,13 +52,14 @@ class DistributedFusedLamb(Lamb):
     def _shard_states(self):
         if self._states_sharded:
             return
-        self._states_sharded = True
         from ...distributed.topology import get_mesh
 
         mesh = get_mesh()
         if mesh is None or "sharding" not in mesh.axis_names \
                 or mesh.shape.get("sharding", 1) <= 1:
+            # keep retrying: the mesh may come up after the first step
             return
+        self._states_sharded = True
         from jax.sharding import NamedSharding
 
         from ...distributed._spmd import _filter_spec

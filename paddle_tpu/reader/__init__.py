@@ -1,0 +1,224 @@
+"""paddle.reader parity — classic reader decorators (reference:
+python/paddle/reader/decorator.py).
+
+A "reader" is a zero-arg callable returning an iterable of samples. The
+decorators compose readers: caching, mapping, shuffling, chaining,
+buffering, parallel mapping. xmap_readers/multiprocess_reader use threads
+(the natural form here — samples flow into jit-side pipelines, the GIL is
+released in numpy/IO).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+from typing import Callable
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader: Callable):
+    """Cache the reader's full output in memory on first pass (reference
+    decorator.py:45)."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        yield from all_data
+
+    return cached_reader
+
+
+def map_readers(func: Callable, *readers):
+    """Sample-wise map over zipped readers (reference decorator.py:84)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader: Callable, buf_size: int):
+    """Buffered shuffle (reference decorator.py:125)."""
+
+    def shuffled_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return shuffled_reader
+
+
+def chain(*readers):
+    """Concatenate readers (reference decorator.py:174)."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples (reference decorator.py:238)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader: Callable, size: int):
+    """Producer-thread read-ahead buffer (reference decorator.py:296)."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return buffered_reader
+
+
+def firstn(reader: Callable, n: int):
+    """First n samples (reference decorator.py:358)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
+                 buffer_size: int, order: bool = False):
+    """Parallel sample mapping with worker threads (reference
+    decorator.py:403; thread-based — mappers are numpy/IO bound)."""
+
+    class _End:
+        pass
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending = {}
+            want = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                i, mapped = item
+                pending[i] = mapped
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                yield item[1]
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe: bool = True,
+                        queue_size: int = 1000):
+    """Merge readers with one worker thread each (reference
+    decorator.py:499 uses processes; the thread form has the same
+    interleaving semantics without fork hazards in a JAX process)."""
+
+    class _End:
+        pass
+
+    def reader():
+        q: queue.Queue = queue.Queue(queue_size)
+
+        def run(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+            finally:
+                q.put(_End)
+
+        for r in readers:
+            threading.Thread(target=run, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            e = q.get()
+            if e is _End:
+                finished += 1
+                continue
+            yield e
+
+    return reader

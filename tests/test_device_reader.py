@@ -1,0 +1,117 @@
+"""Tests for paddle.device / paddle.reader / paddle.dataset parity.
+
+Reference analogs: test/legacy_test/test_device.py, test_reader_*.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestDevice:
+    def test_surface(self):
+        from paddle_tpu import device
+
+        assert isinstance(device.get_all_device_type(), list)
+        assert device.get_available_device()
+        assert device.is_compiled_with_cinn() is True
+        assert device.is_compiled_with_rocm() is False
+        device.synchronize()  # must not raise
+
+    def test_cuda_shims(self):
+        from paddle_tpu.device import cuda
+
+        cuda.empty_cache()
+        s = cuda.current_stream()
+        s.synchronize()
+        e = s.record_event()
+        assert e.query() is True
+        with cuda.stream_guard(s):
+            pass
+        assert isinstance(cuda.memory_allocated(), int)
+        assert isinstance(cuda.get_device_name(), str)
+
+    def test_xpu_gated(self):
+        from paddle_tpu.device import xpu
+
+        with pytest.raises(RuntimeError):
+            xpu.synchronize()
+
+
+class TestReader:
+    @staticmethod
+    def _r(n=10):
+        def reader():
+            yield from range(n)
+
+        return reader
+
+    def test_cache_and_firstn(self):
+        from paddle_tpu import reader as R
+
+        c = R.cache(self._r(5))
+        assert list(c()) == list(range(5)) == list(c())
+        assert list(R.firstn(self._r(10), 3)()) == [0, 1, 2]
+
+    def test_map_and_chain_and_compose(self):
+        from paddle_tpu import reader as R
+
+        m = R.map_readers(lambda a, b: a + b, self._r(3), self._r(3))
+        assert list(m()) == [0, 2, 4]
+        ch = R.chain(self._r(2), self._r(2))
+        assert list(ch()) == [0, 1, 0, 1]
+        co = R.compose(self._r(3), self._r(3))
+        assert list(co()) == [(0, 0), (1, 1), (2, 2)]
+
+    def test_compose_misaligned_raises(self):
+        from paddle_tpu import reader as R
+
+        co = R.compose(self._r(2), self._r(3))
+        with pytest.raises(R.ComposeNotAligned):
+            list(co())
+
+    def test_shuffle_preserves_multiset(self):
+        from paddle_tpu import reader as R
+
+        out = list(R.shuffle(self._r(20), 5)())
+        assert sorted(out) == list(range(20))
+
+    def test_buffered_and_xmap(self):
+        from paddle_tpu import reader as R
+
+        assert sorted(R.buffered(self._r(10), 3)()) == list(range(10))
+        xm = R.xmap_readers(lambda x: x * 2, self._r(10), 3, 4, order=True)
+        assert list(xm()) == [2 * i for i in range(10)]
+        xm2 = R.xmap_readers(lambda x: x * 2, self._r(10), 3, 4, order=False)
+        assert sorted(xm2()) == [2 * i for i in range(10)]
+
+    def test_multiprocess_reader_merges(self):
+        from paddle_tpu import reader as R
+
+        out = list(R.multiprocess_reader([self._r(5), self._r(5)])())
+        assert sorted(out) == sorted(list(range(5)) * 2)
+
+
+class TestDataset:
+    def test_common_md5_and_split(self, tmp_path):
+        from paddle_tpu.dataset import common
+
+        p = tmp_path / "x.bin"
+        p.write_bytes(b"hello")
+        assert common.md5file(str(p)) == "5d41402abc4b2a76b9719d911017c592"
+        with pytest.raises(RuntimeError, match="egress"):
+            common.download("http://x/y.tgz", "m", "0")
+
+    def test_uci_housing_reader_contract(self, tmp_path):
+        import numpy as np
+
+        from paddle_tpu import dataset
+
+        raw = np.random.RandomState(0).rand(20, 14).astype(np.float32)
+        path = str(tmp_path / "housing.data")
+        np.savetxt(path, raw)
+        r = dataset.uci_housing.train(data_file=path)
+        samples = list(r())
+        assert len(samples) == 16
+        x, y = samples[0]
+        assert x.shape == (13,) and y.shape == (1,)

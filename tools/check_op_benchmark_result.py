@@ -1,0 +1,65 @@
+"""Op-perf regression gate (reference analog:
+tools/check_op_benchmark_result.py — compares a PR's op benchmark log
+against the develop baseline and fails on regressions).
+
+    python tools/check_op_benchmark_result.py \
+        --baseline ops_base.json --new ops_now.json [--threshold 0.10]
+
+Exit code 1 when any op slowed down by more than ``threshold`` (relative).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def compare(baseline: dict, new: dict, threshold: float):
+    failures, report = [], []
+    base_ops = baseline.get("ops", baseline)
+    new_ops = new.get("ops", new)
+    for name, base in sorted(base_ops.items()):
+        cur = new_ops.get(name)
+        if cur is None:
+            report.append(f"  {name:20s} MISSING from new run")
+            failures.append(name)
+            continue
+        if "error" in base or "error" in cur:
+            report.append(f"  {name:20s} error "
+                          f"({cur.get('error', base.get('error'))[:60]})")
+            if "error" in cur and "error" not in base:
+                failures.append(name)
+            continue
+        b, c = base["ms"], cur["ms"]
+        rel = (c - b) / b if b else 0.0
+        flag = "REGRESSION" if rel > threshold else \
+            ("improved" if rel < -threshold else "ok")
+        report.append(f"  {name:20s} {b:9.3f}ms -> {c:9.3f}ms "
+                      f"({rel * 100:+6.1f}%) {flag}")
+        if rel > threshold:
+            failures.append(name)
+    return failures, report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baseline", required=True)
+    ap.add_argument("--new", required=True)
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="max allowed relative slowdown (default 10%%)")
+    args = ap.parse_args()
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    failures, report = compare(baseline, new, args.threshold)
+    print("\n".join(report))
+    if failures:
+        print(f"FAILED: {len(failures)} op(s) regressed beyond "
+              f"{args.threshold * 100:.0f}%: {', '.join(failures)}")
+        sys.exit(1)
+    print("PASSED: no op regressions")
+
+
+if __name__ == "__main__":
+    main()
