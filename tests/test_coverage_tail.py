@@ -310,3 +310,59 @@ class TestDistributedFusedLamb:
             assert sharded, "at least the weight moment should shard"
         finally:
             set_mesh(None)
+
+
+class TestWeightOnlyLinear:
+    def test_quant_dequant_roundtrip_error_bounded(self):
+        from paddle_tpu.incubate.nn.functional import (weight_dequantize,
+                                                       weight_quantize)
+
+        rng = np.random.RandomState(20)
+        w = rng.randn(64, 32).astype(np.float32)
+        qw, scale = weight_quantize(paddle.to_tensor(w))
+        assert np.asarray(qw.numpy()).dtype == np.int8
+        back = np.asarray(weight_dequantize(qw, scale).numpy())
+        # per-channel int8: max error bounded by scale/2 per channel
+        err = np.abs(back - w)
+        bound = np.asarray(scale.numpy())[None, :] * 0.5 + 1e-6
+        assert np.all(err <= bound)
+
+    def test_weight_only_linear_matches_fp(self):
+        from paddle_tpu.incubate.nn.functional import (weight_only_linear,
+                                                       weight_quantize)
+
+        rng = np.random.RandomState(21)
+        x = rng.randn(4, 64).astype(np.float32)
+        w = rng.randn(64, 32).astype(np.float32)
+        b = rng.randn(32).astype(np.float32)
+        qw, scale = weight_quantize(paddle.to_tensor(w))
+        out = weight_only_linear(paddle.to_tensor(x), qw,
+                                 bias=paddle.to_tensor(b),
+                                 weight_scale=scale)
+        ref = x @ w + b
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=0.05, atol=0.05 * np.abs(ref).max())
+
+    def test_int4_grid(self):
+        from paddle_tpu.incubate.nn.functional import weight_quantize
+
+        rng = np.random.RandomState(22)
+        w = rng.randn(16, 8).astype(np.float32)
+        qw, scale = weight_quantize(paddle.to_tensor(w),
+                                    algo="weight_only_int4")
+        q = np.asarray(qw.numpy())
+        assert q.min() >= -7 and q.max() <= 7
+
+    def test_grad_flows_to_activation(self):
+        from paddle_tpu.incubate.nn.functional import (weight_only_linear,
+                                                       weight_quantize)
+
+        rng = np.random.RandomState(23)
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        x.stop_gradient = False
+        qw, scale = weight_quantize(
+            paddle.to_tensor(rng.randn(8, 4).astype(np.float32)))
+        out = weight_only_linear(x, qw, weight_scale=scale)
+        paddle.sum(out).backward()
+        assert x.grad is not None
+        assert np.all(np.isfinite(np.asarray(x.grad.numpy())))
