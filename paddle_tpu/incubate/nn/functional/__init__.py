@@ -28,12 +28,14 @@ def weight_quantize(weight, algo: str = "weight_only_int8"):
     (reference analog: nn/functional/common.py:1879 quant_for_compress +
     weight_quantize op). Returns (quantized int8 weights, fp scales).
 
-    int4 packs two nibbles per int8 byte in the reference CUDA kernel; on
-    TPU the storage win is the HBM footprint, so int4 here quantizes to
-    the [-7, 7] grid but stores one value per int8 byte (XLA has no
-    packed-nibble dot) — scales carry the same semantics."""
-    import jax.numpy as jnp
-
+    Layout note (deviation from the reference op): weights stay in this
+    framework's ``nn.Linear`` convention ``[in, out]`` UNtransposed — the
+    reference returns a kernel-tiled/transposed layout bound to its CUDA
+    dot; quantized checkpoints are therefore not byte-interchangeable
+    across the two (dequantize + requantize to convert). int4 packs two
+    nibbles per byte in the reference kernel; on TPU XLA has no
+    packed-nibble dot, so int4 here quantizes to the [-7, 7] grid stored
+    one value per int8 byte."""
     w = weight.value if isinstance(weight, Tensor) else jnp.asarray(weight)
     if algo not in ("weight_only_int8", "weight_only_int4"):
         raise ValueError(f"unsupported algo {algo!r}")
@@ -48,8 +50,6 @@ def weight_quantize(weight, algo: str = "weight_only_int8"):
 def weight_dequantize(qweight, scale, algo: str = "weight_only_int8",
                       out_dtype=None):
     """Inverse of weight_quantize."""
-    import jax.numpy as jnp
-
     qw = qweight.value if isinstance(qweight, Tensor) else jnp.asarray(qweight)
     sc = scale.value if isinstance(scale, Tensor) else jnp.asarray(scale)
     out = qw.astype(jnp.float32) * sc
@@ -65,10 +65,14 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     nn/functional/common.py:1899). The dequant multiply fuses into the
     XLA dot; weights stay int8 in HBM — the point of weight-only quant is
     the halved weight bandwidth at decode time."""
-    import jax.numpy as jnp
-
     if weight_scale is None:
         raise ValueError("weight_scale is required (from weight_quantize)")
+    if group_size not in (-1, None):
+        raise NotImplementedError(
+            "group-wise scales are not supported; quantize with "
+            "weight_quantize (per-output-channel scales, group_size=-1)")
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"unsupported weight_dtype {weight_dtype!r}")
 
     def f(xv, qw, sc, *b):
         w = qw.astype(xv.dtype) * sc.astype(xv.dtype)
