@@ -13,11 +13,20 @@ __all__ = ["llama", "LlamaConfig", "LlamaForCausalLM", "LlamaModel",
            "llama_config"]
 
 
+_GPT_NAMES = ("GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_config")
+
+
 def __getattr__(name):
-    if name == "gpt":
+    if name == "gpt" or name in _GPT_NAMES:
         import importlib
 
         mod = importlib.import_module(".gpt", __name__)
-        globals()[name] = mod
-        return mod
+        globals()["gpt"] = mod
+        for n in _GPT_NAMES:
+            globals()[n] = getattr(mod, n)
+        return globals()[name]
     raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(set(globals()) | {"gpt"} | set(_GPT_NAMES))

@@ -1,0 +1,59 @@
+"""Model-family tests: GPT, vision zoo beyond ResNet.
+
+Reference analogs: test/legacy_test/test_vision_models.py,
+gpt model coverage in the fleet/hybrid tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestGPT:
+    def _model(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_config
+
+        cfg = gpt_config("tiny")
+        return GPTForCausalLM(cfg), cfg
+
+    def test_forward_shape_and_loss(self):
+        m, cfg = self._model()
+        ids = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (2, 16)).astype(np.int32)
+        logits = m(paddle.to_tensor(ids))
+        assert tuple(logits.shape) == (2, 16, cfg.vocab_size)
+        labels = np.random.RandomState(1).randint(
+            0, cfg.vocab_size, (2, 16)).astype(np.int32)
+        loss = m(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        assert np.isfinite(float(loss.numpy()))
+
+    def test_train_step_reduces_loss(self):
+        from paddle_tpu import optimizer as opt
+
+        m, cfg = self._model()
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+        labels = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+        losses = []
+        for _ in range(8):
+            loss = m(paddle.to_tensor(ids), paddle.to_tensor(labels))
+            losses.append(float(loss.numpy()))
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert losses[-1] < losses[0]
+
+
+class TestVisionZoo:
+    @pytest.mark.parametrize("name", ["mobilenet_v2", "squeezenet1_0",
+                                      "vgg11", "alexnet"])
+    def test_forward_shapes(self, name):
+        import paddle_tpu.vision.models as vm
+
+        model = getattr(vm, name)(num_classes=10)
+        model.eval()
+        x = np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32)
+        out = model(paddle.to_tensor(x))
+        assert tuple(out.shape) == (2, 10)
+        assert np.all(np.isfinite(np.asarray(out.numpy())))
