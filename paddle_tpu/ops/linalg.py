@@ -389,3 +389,34 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 
 for _n in ("cdist", "tensordot", "inv", "lu_unpack", "pca_lowrank"):
     __all__.append(_n)
+
+
+def householder_product(x, tau, name=None):
+    """Assemble Q from Householder reflectors (reference
+    tensor/linalg.py householder_product / LAPACK orgqr): columns of x
+    hold the reflector vectors v_i (unit lower-triangular part), tau the
+    coefficients; Q = H_1 H_2 ... H_k restricted to the first k columns."""
+    from ._helpers import nondiff_op as _nd
+
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        eye = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+
+        def body(q, i):
+            v = a[..., :, i]
+            # reflector vector: v[j<i] = 0, v[i] = 1, v[j>i] from x
+            idx = jnp.arange(m)
+            v = jnp.where(idx < i, 0.0, v)
+            v = jnp.where(idx == i, 1.0, v)
+            h = (t[..., i][..., None, None]
+                 * v[..., :, None] * v[..., None, :])
+            return q - q @ h.astype(q.dtype), None
+
+        q, _ = jax.lax.scan(body, eye, jnp.arange(n))
+        return q[..., :, :n]
+
+    return _nd(f, "householder_product")(x, tau)
+
+
+__all__.append("householder_product")

@@ -603,3 +603,30 @@ def shape(input, name=None):
 for _n in ("take", "diagonal", "reverse", "vsplit", "as_complex", "as_real",
            "broadcast_shape", "rank", "shape"):
     __all__.append(_n)
+
+
+def index_fill(x, index, axis, value, name=None):
+    """Fill entries along ``axis`` at positions ``index`` with the scalar
+    ``value`` (reference tensor/manipulation.py index_fill)."""
+    def impl(v, i, *maybe_val):
+        val = maybe_val[0] if maybe_val else value
+        moved = jnp.moveaxis(v, int(axis), 0)
+        fill = jnp.broadcast_to(jnp.asarray(val, v.dtype),
+                                (i.shape[0],) + moved.shape[1:])
+        out = moved.at[i].set(fill)
+        return jnp.moveaxis(out, 0, int(axis))
+
+    from ..core.tensor import Tensor as _T
+
+    if isinstance(value, _T):
+        return apply_op(impl, x, index, value, op_name="index_fill")
+    return apply_op(impl, x, index, op_name="index_fill")
+
+
+def index_fill_(x, index, axis, value, name=None):
+    out = index_fill(x, index, axis, value)
+    x.set_value(out.value if hasattr(out, "value") else out)
+    return x
+
+
+__all__.extend(["index_fill", "index_fill_"])

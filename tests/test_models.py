@@ -57,3 +57,37 @@ class TestVisionZoo:
         out = model(paddle.to_tensor(x))
         assert tuple(out.shape) == (2, 10)
         assert np.all(np.isfinite(np.asarray(out.numpy())))
+
+
+class TestOpTail2:
+    def test_index_fill(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(5, 4).astype(np.float32)
+        out = paddle.index_fill(paddle.to_tensor(x),
+                                paddle.to_tensor(np.asarray([0, 2])), 0, -1.0)
+        o = np.asarray(out.numpy())
+        assert np.all(o[[0, 2]] == -1.0)
+        np.testing.assert_allclose(o[[1, 3, 4]], x[[1, 3, 4]])
+        # axis=1
+        out = paddle.index_fill(paddle.to_tensor(x),
+                                paddle.to_tensor(np.asarray([1])), 1, 7.0)
+        assert np.all(np.asarray(out.numpy())[:, 1] == 7.0)
+
+    def test_index_fill_inplace(self):
+        x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        r = paddle.index_fill_(x, paddle.to_tensor(np.asarray([2])), 0, 5.0)
+        assert np.all(np.asarray(x.numpy())[2] == 5.0)
+        assert r is x
+
+    def test_householder_product_matches_qr(self):
+        import scipy.linalg
+
+        rng = np.random.RandomState(1)
+        a = rng.rand(6, 4).astype(np.float64)
+        (h64, tau), _r = scipy.linalg.qr(a, mode="raw")
+        h = np.asarray(h64, np.float32)
+        q = paddle.linalg.householder_product(
+            paddle.to_tensor(h), paddle.to_tensor(tau.astype(np.float32)))
+        q_ref = np.linalg.qr(a)[0]
+        np.testing.assert_allclose(np.asarray(q.numpy()), q_ref.astype(
+            np.float32), atol=1e-4)
