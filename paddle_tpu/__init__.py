@@ -107,6 +107,8 @@ _LAZY_ATTRS = {
     "get_cuda_rng_state": ("paddle_tpu.core.random", "get_rng_state"),
     "set_cuda_rng_state": ("paddle_tpu.core.random", "set_rng_state"),
     "pow_": ("paddle_tpu.framework.compat", "pow_"),
+    "index_add_": ("paddle_tpu.framework.compat", "index_add_"),
+    "index_put_": ("paddle_tpu.framework.compat", "index_put_"),
     "scatter_": ("paddle_tpu.framework.compat", "scatter_"),
     "squeeze_": ("paddle_tpu.framework.compat", "squeeze_"),
     "tanh_": ("paddle_tpu.framework.compat", "tanh_"),

@@ -1,11 +1,15 @@
 """User-facing autograd API (python/paddle/autograd/ parity)."""
-from .functional import backward, grad
+from .functional import (backward, grad, hessian, jacobian,
+                         saved_tensors_hooks)
 from .py_layer import PyLayer, PyLayerContext
 from ..core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
 
 __all__ = [
     "backward",
     "grad",
+    "jacobian",
+    "hessian",
+    "saved_tensors_hooks",
     "PyLayer",
     "PyLayerContext",
     "no_grad",

@@ -54,3 +54,71 @@ def grad(
         allow_unused=allow_unused,
     )
     return res
+
+
+def jacobian(func, xs, create_graph=False, batch_axis=None):
+    """Jacobian of func at xs (reference autograd/functional.py jacobian /
+    autograd.jacobian). TPU-native: jax.jacrev on the unwrapped arrays —
+    one traced program, no per-row python loops."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    vals = [x.value if isinstance(x, Tensor) else x for x in xs_t]
+
+    def f(*args):
+        outs = func(*[Tensor(a) for a in args]) if single is False else \
+            func(Tensor(args[0]))
+        return outs.value if isinstance(outs, Tensor) else outs
+
+    jac = jax.jacrev(f, argnums=tuple(range(len(vals))))(*vals)
+    jac = [Tensor(j) for j in (jac if isinstance(jac, tuple) else (jac,))]
+    return jac[0] if single else jac
+
+
+def hessian(func, xs, create_graph=False, batch_axis=None):
+    """Hessian of a scalar func at xs (reference autograd.hessian)."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    vals = [x.value if isinstance(x, Tensor) else x for x in xs_t]
+
+    def f(*args):
+        out = func(*[Tensor(a) for a in args])
+        out = out.value if isinstance(out, Tensor) else out
+        return out.reshape(())
+
+    hes = jax.hessian(f, argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        h = hes[0][0] if isinstance(hes, tuple) else hes
+        return Tensor(h)
+    return [[Tensor(hes[i][j]) for j in range(len(vals))]
+            for i in range(len(vals))]
+
+
+class saved_tensors_hooks:
+    """Context manager installing pack/unpack hooks on autograd-saved
+    tensors (reference autograd/saved_tensors_hooks.py). The eager tape
+    consults these when stashing forward values for backward."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..core import autograd as _ag
+
+        self._prev = getattr(_ag, "_saved_tensor_hooks", None)
+        _ag._saved_tensor_hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import autograd as _ag
+
+        _ag._saved_tensor_hooks = self._prev
+        return False

@@ -23,15 +23,29 @@ class PyLayerContext:
         self._non_diff = set()
 
     def save_for_backward(self, *tensors):
+        from ..core import autograd as _ag
+
+        hooks = getattr(_ag, "_saved_tensor_hooks", None)
+        if hooks is not None:
+            tensors = tuple(hooks[0](t) for t in tensors)  # pack
         self._saved = tuple(tensors)
+        self._saved_packed = hooks is not None
 
     def saved_tensor(self):
         """Returns the saved tuple — METHOD, matching paddle's documented
-        ``ctx.saved_tensor()`` (python/paddle/autograd/py_layer.py)."""
+        ``ctx.saved_tensor()`` (python/paddle/autograd/py_layer.py).
+        Unpacks through autograd.saved_tensors_hooks when one was active
+        at save time."""
+        if getattr(self, "_saved_packed", False):
+            from ..core import autograd as _ag
+
+            hooks = getattr(_ag, "_saved_tensor_hooks", None)
+            unpack = hooks[1] if hooks else (lambda v: v)
+            return tuple(unpack(t) for t in self._saved)
         return self._saved
 
     def saved_tensors(self):
-        return self._saved
+        return self.saved_tensor()
 
     def mark_non_differentiable(self, *tensors):
         self._non_diff.update(id(t) for t in tensors)

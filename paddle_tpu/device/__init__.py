@@ -10,13 +10,28 @@ from ..core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace,
                           device_count, get_device, is_compiled_with_cuda,
                           is_compiled_with_tpu, set_device)
 from . import cuda, xpu
+from .cuda import Event, Stream, current_stream, stream_guard
 
 __all__ = ["get_device", "set_device", "get_all_device_type",
            "get_all_custom_device_type", "get_available_device",
            "get_available_custom_device", "is_compiled_with_cuda",
            "is_compiled_with_tpu", "is_compiled_with_xpu",
            "is_compiled_with_cinn", "is_compiled_with_rocm", "cuda", "xpu",
-           "synchronize", "XPUPlace", "IPUPlace"]
+           "synchronize", "XPUPlace", "IPUPlace", "Stream", "Event",
+           "current_stream", "stream_guard", "set_stream",
+           "get_cudnn_version"]
+
+
+def get_cudnn_version():
+    """None — no cuDNN in a TPU build (reference returns the version int
+    or None when CUDA is absent)."""
+    return None
+
+
+def set_stream(stream=None):
+    """Reference device.set_stream: XLA owns stream scheduling; accepts
+    and returns the stream for API compatibility."""
+    return stream or current_stream()
 
 
 def is_compiled_with_xpu() -> bool:

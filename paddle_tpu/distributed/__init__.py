@@ -45,15 +45,33 @@ def get_backend() -> str:
     return "xla"
 
 
+_EXTRAS = ("alltoall", "alltoall_single", "gather", "split", "wait",
+           "broadcast_object_list", "scatter_object_list",
+           "destroy_process_group", "is_available", "ParallelMode",
+           "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+           "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+           "ProbabilityEntry", "ShowClickEntry")
+
+
 def __getattr__(name):
     import importlib
 
     if name in ("fleet", "sharding", "checkpoint", "utils", "meta_parallel",
                 "auto_parallel", "launch", "sequence_parallel", "rpc",
-                "auto_tuner"):
+                "auto_tuner", "io"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name == "spawn":
+        from .spawn import spawn as _spawn
+
+        globals()[name] = _spawn
+        return _spawn
+    if name in _EXTRAS:
+        mod = importlib.import_module("._extras", __name__)
+        for n in _EXTRAS:
+            globals()[n] = getattr(mod, n)
+        return globals()[name]
     if name in ("ring_attention", "ulysses_attention", "split_sequence",
                 "gather_sequence"):
         from . import sequence_parallel as sp_mod
@@ -64,3 +82,10 @@ def __getattr__(name):
 
         return TCPStore
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
+
+
+def __dir__():
+    lazy = {"fleet", "sharding", "checkpoint", "utils", "meta_parallel",
+            "auto_parallel", "launch", "sequence_parallel", "rpc",
+            "auto_tuner", "io", "spawn", "TCPStore"}
+    return sorted(set(globals()) | lazy | set(_EXTRAS))

@@ -1,0 +1,246 @@
+"""Remaining paddle.distributed public names (reference:
+python/paddle/distributed/__init__.py __all__): aliases, object
+collectives, lifecycle helpers, gloo shims, and the parameter-server
+dataset/entry surface (gated per DESIGN.md's PS descope).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+from .communication import (all_gather, all_to_all,  # noqa: F401
+                            all_to_all_single)
+
+__all__ = ["alltoall", "alltoall_single", "gather", "split", "wait",
+           "broadcast_object_list", "scatter_object_list",
+           "destroy_process_group", "is_available", "ParallelMode",
+           "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+           "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+           "ProbabilityEntry", "ShowClickEntry"]
+
+
+# reference keeps both spellings; alltoall* are the documented public ones
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    return all_to_all(out_tensor_list, in_tensor_list, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    return all_to_all_single(out_tensor, in_tensor,
+                             in_split_sizes=in_split_sizes,
+                             out_split_sizes=out_split_sizes, group=group,
+                             sync_op=sync_op)
+
+
+class ParallelMode:
+    """reference distributed/parallel.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def is_available() -> bool:
+    """Whether the distributed package can be used (reference
+    distributed/parallel.py is_available)."""
+    return True
+
+
+def destroy_process_group(group=None):
+    """Tear down group state (reference communication/group.py
+    destroy_process_group). Groups here are mesh views with no OS
+    resources; the registry entry (and the store, for the global group)
+    is dropped."""
+    from .communication.core import _GROUPS as _maybe_groups  # type: ignore
+    from .parallel import _global_store, _initialized
+
+    if group is None:
+        _initialized[0] = False
+        _global_store[0] = None
+        try:
+            from .topology import _GROUPS
+
+            _GROUPS.clear()
+        except Exception:
+            pass
+    else:
+        try:
+            from .topology import _GROUPS
+
+            _GROUPS.pop(getattr(group, "id", None), None)
+        except Exception:
+            pass
+
+
+def broadcast_object_list(object_list: List, src: int = 0, group=None):
+    """Broadcast picklable python objects (reference
+    communication/broadcast.py broadcast_object_list). Single-controller:
+    every process in this runtime already holds src's objects; multi-host
+    uses the TCP store."""
+    import jax
+
+    if jax.process_count() == 1:
+        return object_list
+    from .env import get_rank
+    from .parallel import get_store
+
+    store = get_store()
+    if store is None:
+        raise RuntimeError("broadcast_object_list needs init_parallel_env")
+    key = f"bcast_obj/{src}"
+    if get_rank() == src:
+        store.set(key, pickle.dumps(object_list).hex())
+    raw = store.get(key)
+    raw = raw.decode() if isinstance(raw, bytes) else raw
+    got = pickle.loads(bytes.fromhex(raw))
+    object_list[:] = got
+    return object_list
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src: int = 0, group=None):
+    """Scatter python objects (reference scatter_object_list)."""
+    import jax
+
+    from .env import get_rank, get_world_size
+
+    if jax.process_count() == 1:
+        world = max(1, get_world_size())
+        objs = in_object_list or []
+        per = max(1, len(objs) // world) if objs else 0
+        out_object_list[:] = objs[:per] if objs else []
+        return out_object_list
+    from .parallel import get_store
+
+    store = get_store()
+    if store is None:
+        raise RuntimeError("scatter_object_list needs init_parallel_env")
+    if get_rank() == src:
+        store.set(f"scatter_obj/{src}",
+                  pickle.dumps(in_object_list).hex())
+    raw = store.get(f"scatter_obj/{src}")
+    raw = raw.decode() if isinstance(raw, bytes) else raw
+    objs = pickle.loads(bytes.fromhex(raw))
+    world = max(1, get_world_size())
+    per = len(objs) // world
+    r = get_rank()
+    out_object_list[:] = objs[r * per:(r + 1) * per]
+    return out_object_list
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None,
+           sync_op=True):
+    """Gather tensors to dst (reference communication/gather.py). The
+    single-controller form is all_gather with only dst consuming the
+    list — data already lives in one logical address space."""
+    out: List = []
+    all_gather(out, tensor, group=group, sync_op=sync_op)
+    from .env import get_rank
+
+    if gather_list is not None and get_rank() == dst:
+        gather_list[:] = out
+    return gather_list
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until the tensor's producing work completes (reference
+    communication/wait.py). XLA orders work per device; the honest barrier
+    is a block_until_ready on the value."""
+    v = tensor.value if hasattr(tensor, "value") else tensor
+    try:
+        v.block_until_ready()
+    except AttributeError:
+        pass
+    return tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style split of an embedding/linear layer across the model
+    -parallel group (reference distributed/collective.py split:39). Routes
+    to the mpu layers — the mesh owns placement."""
+    from .fleet.layers.mpu import mp_layers as mpu
+
+    if operation == "embedding":
+        layer = mpu.VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = mpu.RowParallelLinear(size[0], size[1],
+                                          has_bias=bias_attr is not False,
+                                          input_is_parallel=False)
+        else:
+            layer = mpu.ColumnParallelLinear(size[0], size[1],
+                                             has_bias=bias_attr is not False,
+                                             gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"unknown operation {operation!r}")
+
+
+# -- gloo host-rendezvous shims (reference gloo wrappers exist to give CPU
+#    processes a barrier; the TCP store plays that role here) --------------
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int, server_endpoint):
+    import os
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    host, port = str(server_endpoint).rsplit(":", 1)
+    os.environ.setdefault("MASTER_ADDR", host)
+    os.environ.setdefault("MASTER_PORT", port)
+    from .parallel import init_parallel_env
+
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .parallel import get_store
+
+    store = get_store()
+    if store is None:
+        return  # single process: nothing to wait for
+    store.add("gloo/barrier", 1)
+
+
+def gloo_release():
+    from .parallel import _global_store
+
+    _global_store[0] = None
+
+
+# -- parameter-server surface (descoped subsystem — DESIGN.md): the names
+#    exist and explain themselves instead of AttributeError-ing ------------
+
+_PS_MSG = ("the brpc parameter-server stack is deliberately out of scope "
+           "for this TPU-native build (synchronous SPMD + sharded "
+           "embeddings replace async PS; see DESIGN.md 'Descoped "
+           "subsystems')")
+
+
+class _PSGated:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(f"{type(self).__name__}: {_PS_MSG}")
+
+
+class InMemoryDataset(_PSGated):
+    pass
+
+
+class QueueDataset(_PSGated):
+    pass
+
+
+class CountFilterEntry(_PSGated):
+    pass
+
+
+class ProbabilityEntry(_PSGated):
+    pass
+
+
+class ShowClickEntry(_PSGated):
+    pass

@@ -11,8 +11,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from ..topology import (HybridCommunicateGroup, build_mesh, get_mesh,
-                        set_mesh)
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        build_mesh, get_mesh, set_mesh)
 from .base.distributed_strategy import DistributedStrategy
 from . import meta_parallel  # noqa: F401
 from .layers import mpu  # noqa: F401
@@ -196,3 +196,186 @@ def __getattr__(name):
         globals()[name] = mod
         return mod
     raise AttributeError(name)
+
+
+# -- role makers + Fleet class surface (reference fleet/base/role_maker.py,
+#    fleet/fleet.py) --------------------------------------------------------
+
+
+class Role:
+    """reference role_maker.Role constants."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Env-driven role maker (reference role_maker.PaddleCloudRoleMaker).
+    Collective mode only — PS server roles are descoped (DESIGN.md)."""
+
+    def __init__(self, is_collective: bool = True, **kwargs):
+        if not is_collective:
+            raise NotImplementedError(
+                "parameter-server roles are descoped in this TPU-native "
+                "build (DESIGN.md); use is_collective=True")
+        self._is_collective = True
+
+    def _worker_num(self):
+        import jax
+
+        return jax.process_count()
+
+    worker_num = _worker_num
+
+    def _worker_index(self):
+        import jax
+
+        return jax.process_index()
+
+    worker_index = _worker_index
+
+    def _role(self):
+        return Role.WORKER
+
+    def _is_worker(self):
+        return True
+
+    is_worker = _is_worker
+
+    def _is_server(self):
+        return False
+
+    is_server = _is_server
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+    is_first_worker = _is_first_worker
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference role_maker.UserDefinedRoleMaker — explicit rank/world."""
+
+    def __init__(self, is_collective: bool = True, init_gloo: bool = False,
+                 current_id: int = 0, worker_num: int = 1, role=None,
+                 **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._id = int(current_id)
+        self._num = int(worker_num)
+
+    def _worker_index(self):
+        return self._id
+
+    worker_index = _worker_index
+
+    def _worker_num(self):
+        return self._num
+
+    worker_num = _worker_num
+
+
+class UtilBase:
+    """reference fleet/base/util_factory.UtilBase — small cross-worker
+    utilities over the collective backend."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ... import distributed as dist
+        from ...core.tensor import Tensor
+
+        t = Tensor(np.asarray(input))
+        dist.all_reduce(t)
+        out = np.asarray(t.numpy())
+        if mode == "mean":
+            import jax
+
+            out = out / max(1, jax.process_count())
+        return out
+
+    def barrier(self, comm_world="worker"):
+        from ... import distributed as dist
+
+        dist.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        out = []
+        from ... import distributed as dist
+        from ...core.tensor import Tensor
+
+        import numpy as np
+
+        dist.all_gather(out, Tensor(np.asarray(input)))
+        return [np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+                for o in out]
+
+    def get_file_shard(self, files):
+        import jax
+
+        n, i = jax.process_count(), jax.process_index()
+        return [f for j, f in enumerate(sorted(files)) if j % n == i]
+
+    def print_on_rank(self, message, rank_id=0):
+        import jax
+
+        if jax.process_index() == rank_id:
+            print(message)
+
+
+class Fleet:
+    """Instantiable Fleet facade (reference fleet/fleet.py Fleet class —
+    the module-level fleet.* functions are the bound methods of a
+    singleton; this class gives the constructor surface)."""
+
+    def __init__(self):
+        self._util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        return init(role_maker=role_maker, is_collective=is_collective,
+                    strategy=strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    @property
+    def util(self):
+        return self._util
+
+    def worker_num(self):
+        return worker_num()
+
+    def worker_index(self):
+        return worker_index()
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def barrier_worker(self):
+        from ... import distributed as dist
+
+        dist.barrier()
+
+
+class MultiSlotDataGenerator:
+    """reference distributed/fleet/data_generator — PS-pipeline data
+    format; descoped with the PS stack (DESIGN.md)."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "MultiSlotDataGenerator belongs to the descoped parameter-"
+            "server pipeline (DESIGN.md 'Descoped subsystems')")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass
+
+
+__all__ += ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+            "UtilBase", "Fleet", "CommunicateTopology",
+            "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]

@@ -1,0 +1,55 @@
+"""paddle.distributed.io parity (reference: python/paddle/distributed/
+io.py — persistable save/load for static programs; the PS-table branches
+of the reference collapse per DESIGN.md's descope).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var) -> bool:
+    """Parameters and long-lived buffers are persistable (reference
+    io.py:355 checks the var's persistable flag)."""
+    from ..nn.parameter import Parameter
+
+    return isinstance(var, Parameter) or bool(
+        getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save a static program's parameters (reference io.py:386). The
+    distributed-PS branch (_save_distributed_persistables) is descoped;
+    the dense path maps to framework.io.save of the program params."""
+    from ..framework.io import save
+    from ..static.program import default_main_program
+
+    prog = main_program or default_main_program()
+    state = {name: p for name, p in prog.param_objs.items()}
+    os.makedirs(dirname, exist_ok=True)
+    save(state, os.path.join(dirname, filename or "__params__.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """Inverse of save_persistables (reference io.py:131)."""
+    from ..framework.io import load
+    from ..static.program import default_main_program, global_scope
+
+    prog = main_program or default_main_program()
+    state = load(os.path.join(dirname, filename or "__params__.pdparams"))
+    scope = global_scope()
+    for name, v in state.items():
+        if name in prog.param_objs:
+            val = v.value if hasattr(v, "value") else v
+            prog.param_objs[name].set_value(val)
+            scope.set(name, prog.param_objs[name]._value)
+
+
+def load_inference_model_distributed(dirname, executor, model_filename=None,
+                                     params_filename=None):
+    """reference io.py:458 — non-PS path == static.load_inference_model."""
+    from ..static import load_inference_model
+
+    return load_inference_model(dirname, executor)

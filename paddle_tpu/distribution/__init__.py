@@ -528,3 +528,116 @@ def _kl_beta(p, q):
 def _kl_exponential(p, q):
     r = q.rate / p.rate
     return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    distribution/exponential_family.py): entropy via the Bregman identity
+    over the log-normalizer when subclasses provide natural parameters."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        import jax
+
+        nat = self._natural_parameters
+        lognorm = self._log_normalizer(*nat)
+        result = lognorm - sum(
+            (n * g).sum() if hasattr(n, "sum") else n * g
+            for n, g in zip(nat, jax.grad(
+                lambda *p: self._log_normalizer(*p).sum()
+                if hasattr(self._log_normalizer(*p), "sum")
+                else self._log_normalizer(*p), argnums=tuple(
+                    range(len(nat))))(*nat)))
+        return result - self._mean_carrier_measure
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference
+    distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+        super().__init__()
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def _sum_rightmost(self, x):
+        import jax.numpy as jnp
+
+        v = x.value if hasattr(x, "value") else jnp.asarray(x)
+        for _ in range(self._rank):
+            v = v.sum(-1)
+        from ..core.tensor import Tensor
+
+        return Tensor(v)
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self._base.log_prob(value))
+
+    def entropy(self):
+        return self._sum_rightmost(self._base.entropy())
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms (reference
+    distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        self._base = base
+        self._transforms = list(transforms)
+        super().__init__()
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = getattr(self._base, "rsample", self._base.sample)(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        lp = None
+        y = value
+        for t in reversed(self._transforms):
+            x = t.inverse(y)
+            ladj = t.forward_log_det_jacobian(x)
+            ladj_v = ladj.value if hasattr(ladj, "value") else ladj
+            lp = (-ladj_v) if lp is None else lp - ladj_v
+            y = x
+        base_lp = self._base.log_prob(y)
+        base_v = base_lp.value if hasattr(base_lp, "value") else base_lp
+        return Tensor(base_v + (0 if lp is None else lp))
+
+
+__all__ += ["ExponentialFamily", "Independent", "TransformedDistribution"]

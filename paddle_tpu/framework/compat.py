@@ -11,6 +11,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["batch", "LazyGuard", "check_shape", "disable_signal_handler",
            "set_printoptions", "tolist", "dtype", "pow_", "scatter_",
+           "index_add_", "index_put_",
            "squeeze_", "tanh_", "unsqueeze_"]
 
 # paddle.dtype is the type of dtype objects; here dtypes are jnp.dtype
@@ -94,6 +95,8 @@ def _fn_inplace(name):
 
 
 pow_ = _fn_inplace("pow_")
+index_add_ = _fn_inplace("index_add_")
+index_put_ = _fn_inplace("index_put_")
 scatter_ = _fn_inplace("scatter_")
 squeeze_ = _fn_inplace("squeeze_")
 tanh_ = _fn_inplace("tanh_")

@@ -252,3 +252,85 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
     }
     cb_list.set_params(params)
     return cb_list
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a monitored metric plateaus (reference
+    hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "min" or (mode == "auto" and "acc" not in monitor):
+            self._is_better = lambda cur, best: cur < best - self.min_delta
+            self.best = float("inf")
+        else:
+            self._is_better = lambda cur, best: cur > best + self.min_delta
+            self.best = -float("inf")
+        self.cooldown_counter = 0
+        self.wait = 0
+
+    def _get_value(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if isinstance(v, (list, tuple)):
+            v = v[0]
+        return v
+
+    def on_eval_end(self, logs=None):
+        self._step(self._get_value(logs))
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._step(self._get_value(logs))
+
+    def _step(self, current):
+        if current is None:
+            return
+        current = float(current)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._is_better(current, self.best):
+            self.best = current
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait < self.patience or self.cooldown_counter > 0:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        old = float(opt.get_lr())
+        new = max(old * self.factor, self.min_lr)
+        if old - new > 1e-12:
+            opt.set_lr(new)
+            if self.verbose:
+                print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+        self.cooldown_counter = self.cooldown
+        self.wait = 0
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging callback (reference hapi/callbacks.py
+    WandbCallback). wandb is not bundled (zero-egress image) — the
+    constructor raises with instructions rather than failing at first
+    log."""
+
+    def __init__(self, *args, **kwargs):
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ModuleNotFoundError(
+                "WandbCallback requires the `wandb` package, which is not "
+                "bundled in this image (no network egress); install it on "
+                "a connected machine.") from e
+
+
+__all__ += ["ReduceLROnPlateau", "WandbCallback"]

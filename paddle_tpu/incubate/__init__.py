@@ -2,6 +2,11 @@
 import importlib
 
 _LAZY = {"distributed", "nn", "asp", "optimizer"}
+_API = ("segment_sum", "segment_mean", "segment_min", "segment_max",
+        "graph_send_recv", "graph_reindex", "graph_sample_neighbors",
+        "graph_khop_sampler", "softmax_mask_fuse",
+        "softmax_mask_fuse_upper_triangle", "identity_loss",
+        "LookAhead", "ModelAverage")
 
 
 def __getattr__(name):
@@ -9,8 +14,13 @@ def __getattr__(name):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in _API:
+        mod = importlib.import_module("._api", __name__)
+        for n in _API:
+            globals()[n] = getattr(mod, n)
+        return globals()[name]
     raise AttributeError(f"module 'paddle_tpu.incubate' has no attribute {name!r}")
 
 
 def __dir__():
-    return sorted(set(globals()) | _LAZY)
+    return sorted(set(globals()) | _LAZY | set(_API))

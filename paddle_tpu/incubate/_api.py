@@ -1,0 +1,194 @@
+"""paddle.incubate top-level functions (reference: python/paddle/incubate/
+__init__.py — segment ops, graph ops, fused softmax-mask, identity_loss,
+LookAhead/ModelAverage optimizer wrappers).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..geometric import (reindex_graph as graph_reindex,
+                         sample_neighbors as graph_sample_neighbors,
+                         segment_max, segment_mean, segment_min, segment_sum,
+                         send_u_recv as graph_send_recv)
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "graph_send_recv", "graph_reindex", "graph_sample_neighbors",
+           "graph_khop_sampler", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle", "identity_loss",
+           "LookAhead", "ModelAverage"]
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference incubate/operators/
+    graph_khop_sampler.py): chains per-hop sample_neighbors and reindexes
+    the union. Host-side like the per-hop sampler (data pipeline work)."""
+    from ..geometric import sample_neighbors
+    from ..ops._helpers import unwrap
+
+    all_src, all_dst = [], []
+    frontier = input_nodes
+    for k in sample_sizes:
+        neigh, counts = sample_neighbors(row, colptr, frontier,
+                                         sample_size=int(k))
+        cnp = np.asarray(unwrap(counts))
+        fnp = np.asarray(unwrap(frontier))
+        all_src.append(np.asarray(unwrap(neigh)))
+        all_dst.append(np.repeat(fnp, cnp))
+        frontier = Tensor(jnp.asarray(np.unique(np.asarray(unwrap(neigh)))))
+    src = np.concatenate(all_src) if all_src else np.zeros((0,), np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros((0,), np.int64)
+    # compact ids: input nodes first (reference out_nodes ordering), then
+    # new nodes in order of first appearance in the sampled edges
+    inp = np.asarray(unwrap(input_nodes)).ravel()
+    mapping = {int(n): i for i, n in enumerate(inp)}
+    out_nodes = list(inp)
+    for n in np.concatenate([src, dst]):
+        n = int(n)
+        if n not in mapping:
+            mapping[n] = len(out_nodes)
+            out_nodes.append(n)
+    r_src = np.asarray([mapping[int(n)] for n in src], np.int64)
+    r_dst = np.asarray([mapping[int(n)] for n in dst], np.int64)
+    return (Tensor(jnp.asarray(r_src)), Tensor(jnp.asarray(r_dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) (reference incubate/operators/softmax_mask_fuse.py
+    — the CUDA fusion exists to avoid materializing x+mask; XLA fuses the
+    add into the softmax on its own)."""
+    return apply_op(
+        lambda v, m: jnp.asarray(
+            jnp.exp(v + m - jnp.max(v + m, -1, keepdims=True))
+            / jnp.sum(jnp.exp(v + m - jnp.max(v + m, -1, keepdims=True)),
+                      -1, keepdims=True)),
+        x, mask, op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the upper-triangle (future) positions masked
+    (reference softmax_mask_fuse_upper_triangle — causal attention
+    softmax)."""
+
+    def f(v):
+        s = v.shape[-1]
+        mask = jnp.tril(jnp.ones((v.shape[-2], s), bool))
+        z = jnp.where(mask, v, -1e30)
+        z = z - jnp.max(z, -1, keepdims=True)
+        e = jnp.exp(z) * mask
+        return e / jnp.maximum(jnp.sum(e, -1, keepdims=True), 1e-30)
+
+    return apply_op(f, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss (reference incubate identity_loss — IPU
+    pipeline marker). Applies the requested reduction."""
+    red = {"none": 0, "sum": 1, "mean": 2}.get(reduction, reduction)
+    if red == 0:
+        return apply_op(lambda v: v, x, op_name="identity_loss")
+    if red == 1:
+        return apply_op(lambda v: jnp.sum(v), x, op_name="identity_loss")
+    return apply_op(lambda v: jnp.mean(v), x, op_name="identity_loss")
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference incubate/optimizer/lookahead
+    .py; Zhang et al. 2019): every k steps, slow weights interpolate
+    toward fast weights and fast weights reset to slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self._inner_opt = inner_optimizer
+        self.alpha = alpha
+        self.k = max(1, int(k))
+        self._count = 0
+        self._slow = {}
+
+    def step(self):
+        self._inner_opt.step()
+        self._count += 1
+        params = self._inner_opt._parameter_list or []
+        if self._count == 1:
+            for p in params:
+                self._slow[id(p)] = p.value
+        if self._count % self.k:
+            return
+        for p in params:
+            slow = self._slow.get(id(p), p.value)
+            slow = slow + self.alpha * (p.value - slow)
+            self._slow[id(p)] = slow
+            p.set_value(slow)
+
+    def minimize(self, loss, *a, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+
+class ModelAverage:
+    """Running average of parameters for evaluation (reference incubate/
+    optimizer/modelaverage.py): accumulates sums, apply()/restore() swap
+    the averaged weights in and out."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p.value) for p in self._params}
+        self._n = 0
+        self._backup = None
+
+    def step(self):
+        self._n += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p.value
+        if self._n > self._max_w:
+            # restart window (reference resets accumulators past max)
+            for p in self._params:
+                self._sum[id(p)] = p.value.astype(self._sum[id(p)].dtype)
+            self._n = 1
+
+    class _Guard:
+        def __init__(self, outer, need_restore):
+            self.outer = outer
+            self.need_restore = need_restore
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            if self.need_restore:
+                self.outer.restore()
+            return False
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p.value for p in self._params}
+        n = max(self._n, 1)
+        for p in self._params:
+            p.set_value((self._sum[id(p)] / n).astype(p.value.dtype))
+        return self._Guard(self, need_restore)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p.set_value(self._backup[id(p)])
+        self._backup = None
+
+    def minimize(self, loss, *a, **kw):
+        loss.backward()
+        self.step()
+        return None, None

@@ -270,3 +270,69 @@ def load(path, **configs):
     from ..framework.io import load as fload
 
     return fload(path + ".pdiparams")
+
+
+class TranslatedLayer:
+    """Layer-shaped wrapper over a jit.load artifact (reference
+    jit/translated_layer.py TranslatedLayer — what jit.load returns for a
+    saved static model). jit.load here already returns a callable with
+    parameters; this class names the contract and adds program()/train()/
+    eval() for API parity."""
+
+    def __init__(self, loaded):
+        self._loaded = loaded
+        self.training = False
+
+    def __call__(self, *args, **kwargs):
+        return self._loaded(*args, **kwargs)
+
+    forward = __call__
+
+    def program(self, method_name: str = "forward"):
+        return getattr(self._loaded, "_exported", None)
+
+    def train(self):
+        self.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def __getattr__(self, item):
+        return getattr(self._loaded, item)
+
+
+_ignored_modules = set()
+
+
+def ignore_module(modules):
+    """Register modules dy2static must not transcribe (reference
+    jit/api.py ignore_module). The JAX tracer never rewrites module
+    source, so registration is bookkeeping that not_to_static consults."""
+    if not isinstance(modules, (list, tuple, set)):
+        modules = [modules]
+    _ignored_modules.update(getattr(m, "__name__", str(m)) for m in modules)
+    return sorted(_ignored_modules)
+
+
+_verbosity = [0]
+_code_level = [0]
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """Dy2static transcription log verbosity (reference jit/dy2static/
+    logging_utils.py). The tracer here is jax.jit, so this only gates the
+    to_static debug prints."""
+    _verbosity[0] = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Code-dump level for transformed functions (reference analog). With
+    jax tracing there is no transformed python source; when >0,
+    to_static logs the jaxpr instead."""
+    _code_level[0] = int(level)
+
+
+__all__ += ["TranslatedLayer", "ignore_module", "set_verbosity",
+            "set_code_level"]

@@ -227,3 +227,27 @@ def set_global_initializer(weight_init, bias_init=None):
 
 def get_global_initializer():
     return _global_weight_init, _global_bias_init
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel initializer for transposed conv
+    (reference nn/initializer/Bilinear: the classic FCN upsample filter).
+    Weight layout [in_c, out_c/groups, kH, kW]; each spatial kernel gets
+    the separable triangle filter."""
+
+    def _generate(self, key, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects a 4-D conv weight")
+        kh, kw = shape[2], shape[3]
+
+        def tri(k):
+            f = (k + 1) // 2
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            x = jnp.arange(k, dtype=jnp.float32)
+            return 1.0 - jnp.abs(x / f - c)
+
+        kern = tri(kh)[:, None] * tri(kw)[None, :]
+        return jnp.broadcast_to(kern, shape).astype(dtype)
+
+
+__all__.append("Bilinear")

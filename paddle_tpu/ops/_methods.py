@@ -137,7 +137,7 @@ _INPLACE_SOURCES = [
     (math_ops, "add subtract multiply ceil clip erfinv exp floor lerp pow "
                "reciprocal remainder round rsqrt scale sigmoid sqrt tanh"),
     (manipulation, "squeeze unsqueeze scatter index_put put_along_axis "
-                   "flatten"),
+                   "flatten index_fill index_add"),
 ]
 
 for _mod, _names in _INPLACE_SOURCES:

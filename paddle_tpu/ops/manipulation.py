@@ -334,7 +334,10 @@ def index_sample(x, index, name=None):
 
 def index_add(x, index, axis, value, name=None):
     def impl(v, i, u):
-        idx = [slice(None)] * v.ndim
+        # builtins.slice — this module's own `slice` op shadows it
+        import builtins
+
+        idx = [builtins.slice(None)] * v.ndim
         idx[axis] = i
         return v.at[tuple(idx)].add(u)
 

@@ -310,3 +310,24 @@ class Profiler:
 def load_profiler_result(filename: str):
     with open(filename) as f:
         return json.load(f)
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory exporting the reference's protobuf format
+    slot (reference profiler/profiler.py export_protobuf). The TPU-native
+    trace artifact is the chrome-trace JSON (same data, open format) — the
+    XLA/xprof .xplane.pb protobuf sits next to it when jax.profiler tracing
+    is active; this export writes the chrome-trace with a .pb.json suffix
+    so downstream tooling can distinguish the source."""
+    import os
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        prof.export(os.path.join(dir_name, name + ".pb.json"),
+                    format="json")
+
+    return handler
+
+
+__all__.append("export_protobuf")

@@ -265,3 +265,38 @@ class PTQ:
 
         swap(model)
         return model
+
+
+class BaseQuanter(BaseObserver):
+    """Base class for trainable quanters (reference quantization/base_quanter
+    .py) — same contract as observers plus scales()/zero_points()."""
+
+    def scales(self):
+        return getattr(self, "_scale", None)
+
+    def zero_points(self):
+        return getattr(self, "_zero_point", 0)
+
+
+def quanter(class_name: str):
+    """Class decorator registering a quanter under a factory name
+    (reference quantization/factory.py:quanter): creates a ``<name>``
+    factory whose __call__ instantiates the decorated class."""
+    def wrapper(cls):
+        class _Factory:
+            def __init__(self, *args, **kwargs):
+                self._args, self._kwargs = args, kwargs
+
+            def _instance(self, layer=None):
+                return cls(*self._args, **self._kwargs)
+
+            __call__ = _instance
+
+        _Factory.__name__ = class_name
+        globals()[class_name] = _Factory
+        return cls
+
+    return wrapper
+
+
+__all__ += ["BaseQuanter", "quanter"]

@@ -155,3 +155,163 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+# -- elementwise unary over the stored values (zero-preserving fns keep the
+#    sparsity pattern; reference phi/kernels/sparse/unary_kernel.cc) -------
+
+
+def _unary_on_values(fn, opname):
+    def op(x, name=None):
+        v = _raw(x)
+        if isinstance(v, jsparse.BCOO):
+            return SparseTensor(jsparse.BCOO((fn(v.data), v.indices),
+                                             shape=v.shape))
+        if isinstance(v, jsparse.BCSR):
+            return SparseTensor(jsparse.BCSR((fn(v.data), v.indices,
+                                              v.indptr), shape=v.shape))
+        return Tensor(fn(jnp.asarray(_dense(v))))
+
+    op.__name__ = opname
+    return op
+
+
+abs = _unary_on_values(jnp.abs, "abs")                     # noqa: A001
+sin = _unary_on_values(jnp.sin, "sin")
+sinh = _unary_on_values(jnp.sinh, "sinh")
+asin = _unary_on_values(jnp.arcsin, "asin")
+asinh = _unary_on_values(jnp.arcsinh, "asinh")
+tan = _unary_on_values(jnp.tan, "tan")
+tanh = _unary_on_values(jnp.tanh, "tanh")
+atan = _unary_on_values(jnp.arctan, "atan")
+atanh = _unary_on_values(jnp.arctanh, "atanh")
+sqrt = _unary_on_values(jnp.sqrt, "sqrt")
+square = _unary_on_values(jnp.square, "square")
+log1p = _unary_on_values(jnp.log1p, "log1p")
+expm1 = _unary_on_values(jnp.expm1, "expm1")
+neg = _unary_on_values(jnp.negative, "neg")
+deg2rad = _unary_on_values(jnp.deg2rad, "deg2rad")
+rad2deg = _unary_on_values(jnp.rad2deg, "rad2deg")
+isnan = _unary_on_values(jnp.isnan, "isnan")
+
+
+def pow(x, factor, name=None):                              # noqa: A001
+    return _unary_on_values(lambda v: jnp.power(v, factor), "pow")(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """Cast indices and/or values (reference sparse/unary cast)."""
+    v = _raw(x)
+    if isinstance(v, jsparse.BCSR):
+        v = jsparse.BCOO.from_bcsr(v)
+    if isinstance(v, jsparse.BCOO):
+        data = v.data.astype(value_dtype) if value_dtype else v.data
+        idx = v.indices.astype(index_dtype) if index_dtype else v.indices
+        return SparseTensor(jsparse.BCOO((data, idx), shape=v.shape))
+    return Tensor(jnp.asarray(v).astype(value_dtype or v.dtype))
+
+
+def coalesce(x, name=None):
+    """Merge duplicate coordinates (reference sparse coalesce)."""
+    v = _raw(x)
+    if isinstance(v, jsparse.BCOO):
+        return SparseTensor(v.sum_duplicates())
+    return x
+
+
+# -- binary (pattern union via densify, same policy as add) ----------------
+
+
+def _binary(fn, opname):
+    def op(x, y, name=None):
+        xv, yv = _raw(x), _raw(y)
+        both_sparse = isinstance(xv, (jsparse.BCOO, jsparse.BCSR)) and \
+            isinstance(yv, (jsparse.BCOO, jsparse.BCSR))
+        out = fn(jnp.asarray(_dense(xv)), jnp.asarray(_dense(yv)))
+        if both_sparse:
+            return SparseTensor(jsparse.BCOO.fromdense(out))
+        return Tensor(out)
+
+    op.__name__ = opname
+    return op
+
+
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(lambda a, b: jnp.where(b != 0, a / jnp.where(b == 0, 1, b),
+                                        jnp.nan * a), "divide")
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector (reference sparse/matmul mv)."""
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) (reference sparse addmm)."""
+    prod = matmul(x, y)
+    pv = jnp.asarray(_dense(_raw(prod)))
+    iv = jnp.asarray(_dense(_raw(input)))
+    out = beta * iv + alpha * pv
+    if isinstance(_raw(input), (jsparse.BCOO, jsparse.BCSR)):
+        return SparseTensor(jsparse.BCOO.fromdense(out))
+    return Tensor(out)
+
+
+# -- shape ops -------------------------------------------------------------
+
+
+def reshape(x, shape, name=None):
+    v = _raw(x)
+    if isinstance(v, jsparse.BCOO):
+        return SparseTensor(jsparse.BCOO.fromdense(
+            v.todense().reshape(shape)))
+    return Tensor(jnp.reshape(jnp.asarray(_dense(v)), shape))
+
+
+def transpose(x, perm, name=None):
+    v = _raw(x)
+    if isinstance(v, jsparse.BCOO):
+        from jax.experimental.sparse import bcoo_transpose
+
+        return SparseTensor(bcoo_transpose(v, permutation=tuple(perm)))
+    return Tensor(jnp.transpose(jnp.asarray(_dense(v)), perm))
+
+
+def slice(x, axes, starts, ends, name=None):                # noqa: A001
+    import builtins
+
+    v = _raw(x)
+    dense = jnp.asarray(_dense(v))
+    idx = [builtins.slice(None)] * dense.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[int(ax)] = builtins.slice(int(s), int(e))
+    out = dense[tuple(idx)]
+    if isinstance(v, (jsparse.BCOO, jsparse.BCSR)):
+        return SparseTensor(jsparse.BCOO.fromdense(out))
+    return Tensor(out)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    v = _raw(x)
+    dense = jnp.asarray(_dense(v))
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim, dtype=dtype)
+    if isinstance(v, (jsparse.BCOO, jsparse.BCSR)) and out.ndim > 0:
+        return SparseTensor(jsparse.BCOO.fromdense(out))
+    return Tensor(out)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA over a (densified) sparse matrix (reference
+    sparse pca_lowrank)."""
+    from ..ops.linalg import pca_lowrank as _dense_pca
+
+    return _dense_pca(Tensor(jnp.asarray(_dense(_raw(x)))), q=q,
+                      center=center, niter=niter)
+
+
+__all__ += ["abs", "sin", "sinh", "asin", "asinh", "tan", "tanh", "atan",
+            "atanh", "sqrt", "square", "log1p", "expm1", "neg", "deg2rad",
+            "rad2deg", "isnan", "pow", "cast", "coalesce", "subtract",
+            "multiply", "divide", "mv", "addmm", "reshape", "transpose",
+            "slice", "sum", "pca_lowrank"]

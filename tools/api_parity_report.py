@@ -1,0 +1,134 @@
+"""API-parity report: paddle_tpu surface vs the reference's public
+`__all__` lists, module by module.
+
+The reference tree is not importable here (CUDA deps), so its surface is
+parsed textually from each module's ``__all__``. Ours is imported live.
+
+    python tools/api_parity_report.py [--ref /root/reference] [--out X.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (reference module path relative to python/paddle, our attribute path)
+MODULES = [
+    ("__init__.py", ""),
+    ("tensor/__init__.py", None),          # folded into top-level
+    ("nn/__init__.py", "nn"),
+    ("nn/functional/__init__.py", "nn.functional"),
+    ("nn/initializer/__init__.py", "nn.initializer"),
+    ("optimizer/__init__.py", "optimizer"),
+    ("optimizer/lr.py", "optimizer.lr"),
+    ("amp/__init__.py", "amp"),
+    ("autograd/__init__.py", "autograd"),
+    ("distributed/__init__.py", "distributed"),
+    ("distributed/fleet/__init__.py", "distributed.fleet"),
+    ("io/__init__.py", "io"),
+    ("jit/__init__.py", "jit"),
+    ("static/__init__.py", "static"),
+    ("vision/__init__.py", "vision"),
+    ("vision/models/__init__.py", "vision.models"),
+    ("vision/transforms/__init__.py", "vision.transforms"),
+    ("vision/datasets/__init__.py", "vision.datasets"),
+    ("vision/ops.py", "vision.ops"),
+    ("audio/__init__.py", "audio"),
+    ("audio/functional/__init__.py", "audio.functional"),
+    ("audio/features/__init__.py", "audio.features"),
+    ("text/__init__.py", "text"),
+    ("metric/__init__.py", "metric"),
+    ("linalg.py", "linalg"),
+    ("fft.py", "fft"),
+    ("signal.py", "signal"),
+    ("sparse/__init__.py", "sparse"),
+    ("distribution/__init__.py", "distribution"),
+    ("quantization/__init__.py", "quantization"),
+    ("geometric/__init__.py", "geometric"),
+    ("incubate/__init__.py", "incubate"),
+    ("profiler/__init__.py", "profiler"),
+    ("device/__init__.py", "device"),
+    ("onnx/__init__.py", "onnx"),
+    ("hub.py", "hub"),
+    ("regularizer.py", "regularizer"),
+    ("callbacks.py", "callbacks"),
+]
+
+_SKIP = {
+    # names meaningless off-GPU/XPU or tied to reference internals
+    "is_compiled_with_rocm", "is_compiled_with_xpu", "is_compiled_with_ipu",
+    "is_compiled_with_custom_device", "IPUPlace", "XPUPlace",
+    "CustomPlace", "set_ipu_shard", "IpuStrategy", "IpuCompiledProgram",
+}
+
+
+def parse_all(path: str):
+    try:
+        src = open(path, encoding="utf-8").read()
+    except OSError:
+        return None
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+    if not m:
+        return []
+    names = re.findall(r"['\"]([A-Za-z_][\w.]*)['\"]", m.group(1))
+    return [n for n in names if n not in _SKIP]
+
+
+def our_surface(attr_path: str):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+
+    obj = paddle
+    if attr_path:
+        for part in attr_path.split("."):
+            obj = getattr(obj, part)
+    return set(dir(obj))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default="/root/reference")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    base = os.path.join(args.ref, "python", "paddle")
+
+    report = {}
+    total_ref = total_have = 0
+    top_extra = parse_all(os.path.join(base, "tensor/__init__.py")) or []
+    for rel, ours in MODULES:
+        if ours is None:
+            continue
+        ref_names = parse_all(os.path.join(base, rel))
+        if ref_names is None:
+            continue
+        if rel == "__init__.py":
+            ref_names = sorted(set(ref_names) | set(top_extra))
+        try:
+            have = our_surface(ours)
+        except AttributeError:
+            have = set()
+        missing = sorted(n for n in ref_names if n.split(".")[0] not in have)
+        total_ref += len(ref_names)
+        total_have += len(ref_names) - len(missing)
+        report["paddle." + ours if ours else "paddle"] = {
+            "ref": len(ref_names), "missing": missing}
+        tag = "OK " if not missing else f"{len(missing):3d} missing"
+        print(f"{('paddle.' + ours).rstrip('.'):34s} "
+              f"{len(ref_names) - len(missing):4d}/{len(ref_names):4d} {tag}")
+    pct = 100.0 * total_have / max(total_ref, 1)
+    print(f"\nTOTAL {total_have}/{total_ref} ({pct:.1f}%)")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"total_ref": total_ref, "total_have": total_have,
+                       "pct": round(pct, 2), "modules": report}, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
