@@ -19,6 +19,7 @@ from jax.tree_util import tree_flatten, tree_unflatten
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
+        self._unpack_hook = None
         self.materialize_grads = True
         self._non_diff = set()
 
@@ -29,19 +30,17 @@ class PyLayerContext:
         if hooks is not None:
             tensors = tuple(hooks[0](t) for t in tensors)  # pack
         self._saved = tuple(tensors)
-        self._saved_packed = hooks is not None
+        # capture the UNPACK hook at save time: the canonical usage wraps
+        # only the forward in the hooks context, and backward runs after
+        # the context has exited
+        self._unpack_hook = hooks[1] if hooks is not None else None
 
     def saved_tensor(self):
         """Returns the saved tuple — METHOD, matching paddle's documented
         ``ctx.saved_tensor()`` (python/paddle/autograd/py_layer.py).
-        Unpacks through autograd.saved_tensors_hooks when one was active
-        at save time."""
-        if getattr(self, "_saved_packed", False):
-            from ..core import autograd as _ag
-
-            hooks = getattr(_ag, "_saved_tensor_hooks", None)
-            unpack = hooks[1] if hooks else (lambda v: v)
-            return tuple(unpack(t) for t in self._saved)
+        Unpacks through the hooks that were active at save time."""
+        if self._unpack_hook is not None:
+            return tuple(self._unpack_hook(t) for t in self._saved)
         return self._saved
 
     def saved_tensors(self):

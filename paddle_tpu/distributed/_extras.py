@@ -19,6 +19,9 @@ __all__ = ["alltoall", "alltoall_single", "gather", "split", "wait",
            "ProbabilityEntry", "ShowClickEntry"]
 
 
+_obj_gen = [0]  # per-process round counter for object-collective keys
+
+
 # reference keeps both spellings; alltoall* are the documented public ones
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     return all_to_all(out_tensor_list, in_tensor_list, group=group,
@@ -53,7 +56,6 @@ def destroy_process_group(group=None):
     destroy_process_group). Groups here are mesh views with no OS
     resources; the registry entry (and the store, for the global group)
     is dropped."""
-    from .communication.core import _GROUPS as _maybe_groups  # type: ignore
     from .parallel import _global_store, _initialized
 
     if group is None:
@@ -89,9 +91,13 @@ def broadcast_object_list(object_list: List, src: int = 0, group=None):
     store = get_store()
     if store is None:
         raise RuntimeError("broadcast_object_list needs init_parallel_env")
-    key = f"bcast_obj/{src}"
+    # versioned key: the same (src) pair broadcasting twice must not let a
+    # fast rank read the previous round's payload
+    _obj_gen[0] += 1
+    key = f"bcast_obj/{src}/{_obj_gen[0]}"
     if get_rank() == src:
         store.set(key, pickle.dumps(object_list).hex())
+    store.wait(key)  # blocks until src publishes
     raw = store.get(key)
     raw = raw.decode() if isinstance(raw, bytes) else raw
     got = pickle.loads(bytes.fromhex(raw))
@@ -117,10 +123,12 @@ def scatter_object_list(out_object_list: List, in_object_list=None,
     store = get_store()
     if store is None:
         raise RuntimeError("scatter_object_list needs init_parallel_env")
+    _obj_gen[0] += 1
+    key = f"scatter_obj/{src}/{_obj_gen[0]}"
     if get_rank() == src:
-        store.set(f"scatter_obj/{src}",
-                  pickle.dumps(in_object_list).hex())
-    raw = store.get(f"scatter_obj/{src}")
+        store.set(key, pickle.dumps(in_object_list).hex())
+    store.wait(key)
+    raw = store.get(key)
     raw = raw.decode() if isinstance(raw, bytes) else raw
     objs = pickle.loads(bytes.fromhex(raw))
     world = max(1, get_world_size())
@@ -197,13 +205,28 @@ def gloo_init_parallel_env(rank_id: int, rank_num: int, server_endpoint):
     init_parallel_env()
 
 
-def gloo_barrier():
+_gloo_barrier_gen = [0]
+
+
+def gloo_barrier(timeout: float = 600.0):
+    import os
+    import time
+
     from .parallel import get_store
 
     store = get_store()
     if store is None:
         return  # single process: nothing to wait for
-    store.add("gloo/barrier", 1)
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    _gloo_barrier_gen[0] += 1
+    key = f"gloo/barrier/{_gloo_barrier_gen[0]}"
+    store.add(key, 1)
+    deadline = time.time() + timeout
+    while time.time() < deadline:  # add(key, 0) = non-blocking read
+        if store.add(key, 0) >= world:
+            return
+        time.sleep(0.005)
+    raise TimeoutError("gloo_barrier timed out")
 
 
 def gloo_release():

