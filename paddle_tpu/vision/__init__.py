@@ -1,2 +1,7 @@
 """paddle.vision parity (python/paddle/vision/__init__.py)."""
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
+from .image import (get_image_backend, image_load,  # noqa: F401
+                    set_image_backend)
+
+__all__ = ["datasets", "models", "transforms", "ops",
+           "get_image_backend", "set_image_backend", "image_load"]

@@ -146,3 +146,132 @@ class DatasetFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat-folder image dataset (reference datasets/folder.py
+    ImageFolder): every image under root, no labels."""
+
+    _EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        exts = tuple(e.lower() for e in (extensions or self._EXTS))
+        self.root = root
+        self.transform = transform
+        self.loader = loader
+        samples = []
+        for dirpath, _, names in sorted(os.walk(root)):
+            for fn in sorted(names):
+                path = os.path.join(dirpath, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(f"Found 0 files in {root}")
+        self.samples = samples
+
+    def _load(self, path):
+        if self.loader is not None:
+            return self.loader(path)
+        from .image import image_load
+
+        img = image_load(path)
+        return np.asarray(img)
+
+    def __getitem__(self, idx):
+        img = self._load(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers (reference datasets/flowers.py). Zero-egress:
+    pass data_file (102flowers.tgz extracted dir with jpg/) + label_file
+    (imagelabels.mat) + setid_file (setid.mat)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        import os
+
+        if not (data_file and os.path.exists(data_file)):
+            raise RuntimeError(
+                "Flowers: no local data. Fetch 102flowers.tgz / "
+                "imagelabels.mat / setid.mat on a connected machine and "
+                "pass their paths (this build has no network egress).")
+        import scipy.io
+
+        labels = scipy.io.loadmat(label_file)["labels"].ravel()
+        setid = scipy.io.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key].ravel()
+        self.labels = labels
+        self.data_dir = data_file
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        import os
+
+        img_idx = int(self.indexes[idx])
+        path = os.path.join(self.data_dir, f"image_{img_idx:05d}.jpg")
+        from .image import image_load
+
+        img = np.asarray(image_load(path))
+        if self.transform is not None:
+            img = self.transform(img)
+        label = np.asarray(self.labels[img_idx - 1] - 1, np.int64)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference datasets/voc2012.py).
+    Zero-egress: data_file = extracted VOCdevkit/VOC2012 directory."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        import os
+
+        if not (data_file and os.path.isdir(data_file)):
+            raise RuntimeError(
+                "VOC2012: no local data. Extract VOCtrainval_11-May-2012 "
+                "on a connected machine and pass VOCdevkit/VOC2012 as "
+                "data_file (this build has no network egress).")
+        name = {"train": "train", "valid": "val", "test": "val",
+                "trainval": "trainval"}[mode]
+        list_file = os.path.join(data_file, "ImageSets", "Segmentation",
+                                 name + ".txt")
+        with open(list_file) as f:
+            self.ids = [ln.strip() for ln in f if ln.strip()]
+        self.root = data_file
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        import os
+
+        from .image import image_load
+
+        name = self.ids[idx]
+        img = np.asarray(image_load(
+            os.path.join(self.root, "JPEGImages", name + ".jpg")))
+        lbl = np.asarray(image_load(
+            os.path.join(self.root, "SegmentationClass", name + ".png")))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.ids)
+
+
+__all__ += ["ImageFolder", "Flowers", "VOC2012"]

@@ -4,3 +4,14 @@ from .classic import (AlexNet, LeNet, MobileNetV1, MobileNetV2, SqueezeNet,
                       squeezenet1_0, squeezenet1_1, vgg11, vgg13, vgg16,
                       vgg19)
 from .resnet import *  # noqa: F401,F403
+from .classic2 import (DenseNet, GoogLeNet, InceptionV3,  # noqa: F401
+                       MobileNetV3Large, MobileNetV3Small, ShuffleNetV2,
+                       densenet121, densenet161, densenet169, densenet201,
+                       densenet264, googlenet, inception_v3,
+                       mobilenet_v3_large, mobilenet_v3_small,
+                       shufflenet_v2_swish, shufflenet_v2_x0_25,
+                       shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+                       shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                       shufflenet_v2_x2_0)
+from .resnet import (resnext50_64x4d, resnext101_32x4d,  # noqa: F401
+                     resnext101_64x4d, resnext152_32x4d)

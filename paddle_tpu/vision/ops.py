@@ -1,0 +1,758 @@
+"""paddle.vision.ops parity — the detection operator set (reference:
+python/paddle/vision/ops.py).
+
+TPU-native notes: RoI pooling/alignment are expressed as dense gather +
+bilinear interpolation (static shapes, MXU-friendly batched einsums);
+NMS-family ops are host-side numpy like the reference's CPU kernels —
+selection with data-dependent output sizes belongs off-device; deformable
+conv composes the offset-gather with a dense conv.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..nn import Layer
+from ..ops._helpers import nondiff_op, unwrap
+
+__all__ = ["yolo_loss", "yolo_box", "prior_box", "box_coder",
+           "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+           "generate_proposals", "read_file", "decode_jpeg", "psroi_pool",
+           "PSRoIPool", "roi_pool", "RoIPool", "roi_align", "RoIAlign",
+           "nms", "matrix_nms"]
+
+
+# ---------------------------------------------------------------------------
+# RoI family
+# ---------------------------------------------------------------------------
+
+
+def _roi_align_one(feat, box, out_h, out_w, spatial_scale, sampling_ratio,
+                   aligned):
+    """feat [C, H, W]; box [4] (x1, y1, x2, y2) in input coords."""
+    off = 0.5 if aligned else 0.0
+    x1 = box[0] * spatial_scale - off
+    y1 = box[1] * spatial_scale - off
+    x2 = box[2] * spatial_scale - off
+    y2 = box[3] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1e-4 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-4 if aligned else 1.0)
+    bin_h = rh / out_h
+    bin_w = rw / out_w
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [out_h, ratio] x [out_w, ratio]
+    iy = (jnp.arange(out_h)[:, None] * bin_h + y1
+          + (jnp.arange(ratio)[None, :] + 0.5) * bin_h / ratio)
+    ix = (jnp.arange(out_w)[:, None] * bin_w + x1
+          + (jnp.arange(ratio)[None, :] + 0.5) * bin_w / ratio)
+    H, W = feat.shape[1], feat.shape[2]
+
+    def bilinear(y, x):
+        y = jnp.clip(y, 0.0, H - 1.0)
+        x = jnp.clip(x, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        fy = y - y0
+        fx = x - x0
+        v00 = feat[:, y0, x0]
+        v01 = feat[:, y0, x1i]
+        v10 = feat[:, y1i, x0]
+        v11 = feat[:, y1i, x1i]
+        return (v00 * (1 - fy) * (1 - fx) + v01 * (1 - fy) * fx
+                + v10 * fy * (1 - fx) + v11 * fy * fx)
+
+    # all sample points at once: [out_h*ratio] x [out_w*ratio]
+    ys = iy.reshape(-1)
+    xs = ix.reshape(-1)
+    grid_y, grid_x = jnp.meshgrid(ys, xs, indexing="ij")
+    vals = bilinear(grid_y.reshape(-1), grid_x.reshape(-1))  # [C, P]
+    C = feat.shape[0]
+    vals = vals.reshape(C, out_h, ratio, out_w, ratio)
+    return vals.mean(axis=(2, 4))                            # [C, oh, ow]
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference vision/ops.py roi_align / phi roi_align
+    kernel). x [N, C, H, W]; boxes [R, 4]; boxes_num [N]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(xv, bv, bn):
+        # map each roi to its batch image via boxes_num prefix sums
+        starts = jnp.cumsum(bn) - bn
+        roi_batch = jnp.sum(
+            (jnp.arange(bv.shape[0])[:, None]
+             >= starts[None, :]).astype(jnp.int32), axis=1) - 1
+
+        def one(box, bidx):
+            return _roi_align_one(xv[bidx], box, oh, ow, spatial_scale,
+                                  sampling_ratio, aligned)
+
+        return jax.vmap(one)(bv, roi_batch)
+
+    return apply_op(f, x, boxes, boxes_num, op_name="roi_align")
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool — max over quantized bins (reference roi_pool)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(xv, bv, bn):
+        H, W = xv.shape[2], xv.shape[3]
+        starts = jnp.cumsum(bn) - bn
+        roi_batch = jnp.sum(
+            (jnp.arange(bv.shape[0])[:, None]
+             >= starts[None, :]).astype(jnp.int32), axis=1) - 1
+
+        def one(box, bidx):
+            feat = xv[bidx]
+            x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y2 - y1 + 1, 1)
+            rw = jnp.maximum(x2 - x1 + 1, 1)
+            # dense mask-based max per bin (static shapes for jit)
+            ys = jnp.arange(H)
+            xs = jnp.arange(W)
+            bin_y = jnp.clip(((ys - y1) * oh) // rh, 0, oh - 1)
+            bin_x = jnp.clip(((xs - x1) * ow) // rw, 0, ow - 1)
+            in_y = (ys >= y1) & (ys <= y2)
+            in_x = (xs >= x1) & (xs <= x2)
+            onehot_y = (bin_y[:, None] == jnp.arange(oh)[None, :]) \
+                & in_y[:, None]                           # [H, oh]
+            onehot_x = (bin_x[:, None] == jnp.arange(ow)[None, :]) \
+                & in_x[:, None]                           # [W, ow]
+            # [C,H,W] -> [C,oh,ow] via masked max over H then W
+            tmp = jnp.where(onehot_y[None, :, :, None],
+                            feat[:, :, None, :], -jnp.inf).max(axis=1)
+            out = jnp.where(onehot_x[None, None, :, :],
+                            tmp[:, :, :, None], -jnp.inf).max(axis=2)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(one)(bv, roi_batch)
+
+    return apply_op(f, x, boxes, boxes_num, op_name="roi_pool")
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference psroi_pool): input
+    channels C = out_c * oh * ow; bin (i, j) averages its OWN channel
+    group within the spatial bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(xv, bv, bn):
+        N, C, H, W = xv.shape
+        out_c = C // (oh * ow)
+        starts = jnp.cumsum(bn) - bn
+        roi_batch = jnp.sum(
+            (jnp.arange(bv.shape[0])[:, None]
+             >= starts[None, :]).astype(jnp.int32), axis=1) - 1
+
+        def one(box, bidx):
+            feat = xv[bidx].reshape(out_c, oh, ow, H, W)
+            x1 = box[0] * spatial_scale
+            y1 = box[1] * spatial_scale
+            rw = jnp.maximum((box[2] - box[0]) * spatial_scale, 0.1)
+            rh = jnp.maximum((box[3] - box[1]) * spatial_scale, 0.1)
+            bh, bw = rh / oh, rw / ow
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+            # bin masks per (i, j)
+            iy = jnp.clip(jnp.floor((ys - y1) / bh), 0, oh - 1)
+            ix = jnp.clip(jnp.floor((xs - x1) / bw), 0, ow - 1)
+            my = ((iy[:, None] == jnp.arange(oh)[None, :])
+                  & (ys[:, None] >= y1) & (ys[:, None] <= y1 + rh))
+            mx = ((ix[:, None] == jnp.arange(ow)[None, :])
+                  & (xs[:, None] >= x1) & (xs[:, None] <= x1 + rw))
+            mask = my.T[:, None, :, None] * mx.T[None, :, None, :]
+            # [oh, ow, H, W]; select diag channel groups
+            num = jnp.einsum("cijhw,ijhw->cij", feat, mask.astype(
+                feat.dtype))
+            den = jnp.maximum(mask.sum((-1, -2)), 1.0)
+            return num / den[None]
+
+        return jax.vmap(one)(bv, roi_batch)
+
+    return apply_op(f, x, boxes, boxes_num, op_name="psroi_pool")
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# NMS family (host-side numpy — data-dependent output length)
+# ---------------------------------------------------------------------------
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    xx1 = np.maximum(x1[:, None], x1[None, :])
+    yy1 = np.maximum(y1[:, None], y1[None, :])
+    xx2 = np.minimum(x2[:, None], x2[None, :])
+    yy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / np.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (reference vision/ops.py nms). Returns kept indices,
+    score-ordered."""
+    b = np.asarray(unwrap(boxes), np.float32)
+    s = np.arange(len(b))[::-1].astype(np.float32) if scores is None \
+        else np.asarray(unwrap(scores), np.float32)
+    cats = None if category_idxs is None else np.asarray(
+        unwrap(category_idxs))
+    order = np.argsort(-s)
+    iou = _iou_matrix(b)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        over = iou[i] > iou_threshold
+        if cats is not None:
+            over = over & (cats == cats[i])
+        suppressed |= over
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference matrix_nms; SOLOv2): decay every box's score
+    by its worst overlap with a higher-scored same-class box."""
+    b = np.asarray(unwrap(bboxes), np.float32)
+    sc = np.asarray(unwrap(scores), np.float32)
+    N = b.shape[0]
+    outs, idxs, nums = [], [], []
+    for n in range(N):
+        per_img = []
+        per_idx = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np.argsort(-s[sel])][:nms_top_k]
+            boxes_c = b[n, order]
+            scores_c = s[order]
+            iou = _iou_matrix(boxes_c)
+            iou = np.triu(iou, k=1)
+            max_iou = iou.max(axis=0, initial=0.0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - max_iou[None, :] ** 2)
+                               / gaussian_sigma).min(axis=0, initial=1.0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - max_iou[None, :],
+                                                1e-10)).min(axis=0,
+                                                            initial=1.0)
+            dec_scores = scores_c * decay
+            ok = dec_scores > post_threshold
+            for i, flag in enumerate(ok):
+                if flag:
+                    per_img.append([c, dec_scores[i], *boxes_c[i]])
+                    per_idx.append(order[i])
+        per_img.sort(key=lambda r: -r[1])
+        per_img = per_img[:keep_top_k]
+        per_idx = per_idx[:keep_top_k]
+        nums.append(len(per_img))
+        outs.extend(per_img)
+        idxs.extend(per_idx)
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(idxs, np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+# ---------------------------------------------------------------------------
+# Anchors / box coding / YOLO
+# ---------------------------------------------------------------------------
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes (reference prior_box)."""
+    iv = unwrap(input)
+    imv = unwrap(image)
+    H, W = iv.shape[2], iv.shape[3]
+    img_h, img_w = imv.shape[2], imv.shape[3]
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    sizes = []
+    for k, ms in enumerate(min_sizes):
+        for ar in ars:
+            sizes.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        if max_sizes:
+            bs = math.sqrt(ms * max_sizes[k])
+            sizes.append((bs, bs))
+    num_priors = len(sizes)
+    cx = (np.arange(W) + offset) * step_w
+    cy = (np.arange(H) + offset) * step_h
+    boxes = np.zeros((H, W, num_priors, 4), np.float32)
+    for p, (bw, bh) in enumerate(sizes):
+        boxes[:, :, p, 0] = (cx[None, :] - bw / 2) / img_w
+        boxes[:, :, p, 1] = (cy[:, None] - bh / 2) / img_h
+        boxes[:, :, p, 2] = (cx[None, :] + bw / 2) / img_w
+        boxes[:, :, p, 3] = (cy[:, None] + bh / 2) / img_h
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference box_coder)."""
+    def f(pb, pv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[..., 2] - pb[..., 0] + norm
+        ph = pb[..., 3] - pb[..., 1] + norm
+        pcx = pb[..., 0] + pw * 0.5
+        pcy = pb[..., 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[..., 2] - tb[..., 0] + norm
+            th = tb[..., 3] - tb[..., 1] + norm
+            tcx = tb[..., 0] + tw * 0.5
+            tcy = tb[..., 1] + th * 0.5
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / ph[None, :])], axis=-1)
+            return out / pv[None, :, :]
+        # decode: target [R, P, 4] deltas against priors broadcast on axis
+        d = tb * pv[None, :, :] if pv.ndim == 2 else tb * pv
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (v[None, :] for v in (pw, ph, pcx, pcy))
+        else:
+            pw_, ph_, pcx_, pcy_ = (v[:, None] for v in (pw, ph, pcx, pcy))
+        cx = d[..., 0] * pw_ + pcx_
+        cy = d[..., 1] * ph_ + pcy_
+        w = jnp.exp(d[..., 2]) * pw_
+        h = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm], -1)
+
+    return apply_op(f, prior_box, prior_box_var, target_box,
+                    op_name="box_coder")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (reference yolo_box)."""
+    def f(xv, imgv):
+        N, C, H, W = xv.shape
+        na = len(anchors) // 2
+        an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+        pred = xv.reshape(N, na, 5 + class_num, H, W)
+        gx = (jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + jnp.arange(W)[None, None, None, :])
+        gy = (jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + jnp.arange(H)[None, None, :, None])
+        in_w, in_h = W * downsample_ratio, H * downsample_ratio
+        bw = jnp.exp(pred[:, :, 2]) * an[None, :, 0, None, None] / in_w
+        bh = jnp.exp(pred[:, :, 3]) * an[None, :, 1, None, None] / in_h
+        cx = gx / W
+        cy = gy / H
+        conf = jax.nn.sigmoid(pred[:, :, 4])
+        probs = jax.nn.sigmoid(pred[:, :, 5:]) * conf[:, :, None]
+        mask = (conf > conf_thresh).astype(xv.dtype)
+        imw = imgv[:, 1].astype(jnp.float32)
+        imh = imgv[:, 0].astype(jnp.float32)
+        x1 = (cx - bw / 2) * imw[:, None, None, None]
+        y1 = (cy - bh / 2) * imh[:, None, None, None]
+        x2 = (cx + bw / 2) * imw[:, None, None, None]
+        y2 = (cy + bh / 2) * imh[:, None, None, None]
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw[:, None, None, None] - 1)
+            y1 = jnp.clip(y1, 0, imh[:, None, None, None] - 1)
+            x2 = jnp.clip(x2, 0, imw[:, None, None, None] - 1)
+            y2 = jnp.clip(y2, 0, imh[:, None, None, None] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1) * mask[..., None]
+        boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(N, -1, 4)
+        scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2)
+        scores = scores.reshape(N, -1, class_num)
+        return boxes, scores
+
+    return apply_op(f, x, img_size, op_name="yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference yolo_loss): coordinate + objectness
+    + classification terms over anchor-matched ground truths."""
+    def f(xv, gb, gl, *maybe_gs):
+        N, C, H, W = xv.shape
+        na = len(anchor_mask)
+        an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+        an = an_all[jnp.asarray(anchor_mask)]
+        pred = xv.reshape(N, na, 5 + class_num, H, W)
+        in_w, in_h = W * downsample_ratio, H * downsample_ratio
+
+        px = jax.nn.sigmoid(pred[:, :, 0])
+        py = jax.nn.sigmoid(pred[:, :, 1])
+        pw = pred[:, :, 2]
+        ph = pred[:, :, 3]
+        pobj = pred[:, :, 4]
+        pcls = pred[:, :, 5:]
+
+        B = gb.shape[1]
+        # gt in [0,1] cx cy w h
+        gcx, gcy = gb[..., 0], gb[..., 1]
+        gw, gh = gb[..., 2], gb[..., 3]
+        gi = jnp.clip((gcx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gcy * H).astype(jnp.int32), 0, H - 1)
+        # best anchor per gt by wh IoU against the FULL anchor set
+        gwh = jnp.stack([gw * in_w, gh * in_h], -1)   # [N, B, 2]
+        inter = jnp.minimum(gwh[:, :, None, :], an_all[None, None]) \
+            .prod(-1)
+        union = (gwh.prod(-1)[:, :, None] + an_all.prod(-1)[None, None]
+                 - inter)
+        iou_a = inter / jnp.maximum(union, 1e-10)
+        best = jnp.argmax(iou_a, axis=-1)             # [N, B]
+        mask_vec = jnp.asarray(anchor_mask)
+        # local anchor index or -1 when the best anchor isn't in this head
+        local = jnp.argmax(
+            (best[..., None] == mask_vec[None, None]), -1)
+        in_head = jnp.any(best[..., None] == mask_vec[None, None], -1)
+        valid = in_head & (gw > 0)
+
+        tx = gcx * W - gi
+        ty = gcy * H - gj
+        tw = jnp.log(jnp.maximum(gwh[..., 0], 1e-4)
+                     / an[local][..., 0])
+        th = jnp.log(jnp.maximum(gwh[..., 1], 1e-4)
+                     / an[local][..., 1])
+
+        nidx = jnp.arange(N)[:, None].repeat(B, 1)
+
+        def gather(p):
+            return p[nidx, local, gj, gi]
+
+        lw = (2.0 - gw * gh)
+        vz = valid.astype(jnp.float32)
+        loss_xy = (vz * lw * ((gather(px) - tx) ** 2
+                              + (gather(py) - ty) ** 2)).sum(-1)
+        loss_wh = (vz * lw * ((gather(pw) - tw) ** 2
+                              + (gather(ph) - th) ** 2)).sum(-1)
+        obj_target = jnp.zeros((N, na, H, W))
+        obj_target = obj_target.at[nidx, local, gj, gi].max(vz)
+        bce = lambda lg, t: jnp.maximum(lg, 0) - lg * t + jnp.log1p(
+            jnp.exp(-jnp.abs(lg)))
+        loss_obj = (bce(pobj, obj_target)).sum((1, 2, 3))
+        smooth = 1.0 / class_num if use_label_smooth else 0.0
+        cls_t = jax.nn.one_hot(gl, class_num) * (1 - smooth) + \
+            smooth / class_num
+        pc = pcls[nidx, local, :, gj, gi]
+        loss_cls = (vz[..., None] * bce(pc, cls_t)).sum((-1, -2))
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    args = (x, gt_box, gt_label) + (() if gt_score is None else (gt_score,))
+    return apply_op(f, *args, op_name="yolo_loss")
+
+
+# ---------------------------------------------------------------------------
+# Deformable conv
+# ---------------------------------------------------------------------------
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference deform_conv2d / phi
+    deformable_conv kernel): bilinear-sample the input at offset-shifted
+    taps, then a dense 1x1-style contraction with the kernel."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(
+        dilation)
+
+    def f(xv, ov, wv, *rest):
+        bias_v = mask_v = None
+        rest = list(rest)
+        if bias is not None:
+            bias_v = rest.pop(0)
+        if mask is not None:
+            mask_v = rest.pop(0)
+        N, C, H, W = xv.shape
+        OC, ICg, KH, KW = wv.shape
+        OH = (H + 2 * pd[0] - dl[0] * (KH - 1) - 1) // st[0] + 1
+        OW = (W + 2 * pd[1] - dl[1] * (KW - 1) - 1) // st[1] + 1
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        Hp, Wp = xp.shape[2], xp.shape[3]
+        oy = jnp.arange(OH) * st[0]
+        ox = jnp.arange(OW) * st[1]
+        ky = jnp.arange(KH) * dl[0]
+        kx = jnp.arange(KW) * dl[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        off = ov.reshape(N, deformable_groups, KH * KW, 2, OH, OW)
+        off_y = off[:, :, :, 0].reshape(N, deformable_groups, KH, KW, OH,
+                                        OW).transpose(0, 1, 4, 5, 2, 3)
+        off_x = off[:, :, :, 1].reshape(N, deformable_groups, KH, KW, OH,
+                                        OW).transpose(0, 1, 4, 5, 2, 3)
+        sy = base_y[None, None] + off_y   # [N, dg, OH, OW, KH, KW]
+        sx = base_x[None, None] + off_x
+
+        def bilinear(img, y, xq):
+            y = jnp.clip(y, 0.0, Hp - 1.0)
+            xq = jnp.clip(xq, 0.0, Wp - 1.0)
+            y0 = jnp.floor(y).astype(jnp.int32)
+            x0 = jnp.floor(xq).astype(jnp.int32)
+            y1 = jnp.minimum(y0 + 1, Hp - 1)
+            x1 = jnp.minimum(x0 + 1, Wp - 1)
+            fy, fx = y - y0, xq - x0
+            g = lambda yy, xx: img[:, yy, xx]
+            return (g(y0, x0) * (1 - fy) * (1 - fx)
+                    + g(y0, x1) * (1 - fy) * fx
+                    + g(y1, x0) * fy * (1 - fx)
+                    + g(y1, x1) * fy * fx)
+
+        cpg = C // deformable_groups
+
+        def per_image(img, syi, sxi, mi):
+            cols = []
+            for dg in range(deformable_groups):
+                sub = img[dg * cpg:(dg + 1) * cpg]
+                v = bilinear(sub, syi[dg], sxi[dg])  # [cpg, OH, OW, KH, KW]
+                if mi is not None:
+                    v = v * mi[dg][None]
+                cols.append(v)
+            return jnp.concatenate(cols, axis=0)      # [C, OH, OW, KH, KW]
+
+        if mask_v is not None:
+            mk = mask_v.reshape(N, deformable_groups, KH, KW, OH, OW) \
+                .transpose(0, 1, 4, 5, 2, 3)
+        else:
+            mk = [None] * N
+        cols = jax.vmap(per_image)(xp, sy, sx,
+                                   mk if mask_v is not None else None) \
+            if mask_v is not None else jax.vmap(
+                lambda img, a, b: per_image(img, a, b, None))(xp, sy, sx)
+        # contraction: groups split over channels
+        cols = cols.reshape(N, groups, C // groups, OH, OW, KH, KW)
+        wv_g = wv.reshape(groups, OC // groups, ICg, KH, KW)
+        out = jnp.einsum("ngcxykl,gockl->ngoxy", cols, wv_g)
+        out = out.reshape(N, OC, OH, OW)
+        if bias_v is not None:
+            out = out + bias_v[None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    if bias is not None:
+        args.append(bias)
+    if mask is not None:
+        args.append(mask)
+    return apply_op(f, *args, op_name="deform_conv2d")
+
+
+class DeformConv2D(Layer):
+    """reference vision/ops.py DeformConv2D layer."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        bound = 1.0 / math.sqrt(in_channels * ks[0] * ks[1])
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation,
+                             deformable_groups=self._deformable_groups,
+                             groups=self._groups, mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# FPN / proposals / files
+# ---------------------------------------------------------------------------
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals)."""
+    rois = np.asarray(unwrap(fpn_rois), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    h = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs, nums = [], [], []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.extend(sel.tolist())
+        nums.append(Tensor(jnp.asarray(np.asarray([len(sel)], np.int32))))
+    restore = np.argsort(np.asarray(idxs, np.int64))
+    res = [outs, Tensor(jnp.asarray(restore))]
+    if rois_num is not None:
+        res.append(nums)
+    return tuple(res)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference generate_proposals): decode
+    deltas at anchors, clip, filter small, NMS."""
+    sc = np.asarray(unwrap(scores), np.float32)
+    bd = np.asarray(unwrap(bbox_deltas), np.float32)
+    im = np.asarray(unwrap(img_size), np.float32)
+    an = np.asarray(unwrap(anchors), np.float32).reshape(-1, 4)
+    var = np.asarray(unwrap(variances), np.float32).reshape(-1, 4)
+    N = sc.shape[0]
+    all_rois, all_scores, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], var[order]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                         -1)
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, im[n, 1] - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, im[n, 0] - 1)
+        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+              & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[ok], s[ok]
+        keep = np.asarray(unwrap(nms(Tensor(jnp.asarray(boxes)),
+                                     iou_threshold=nms_thresh,
+                                     scores=Tensor(jnp.asarray(s)))))
+        keep = keep[:post_nms_top_n]
+        all_rois.append(boxes[keep])
+        all_scores.append(s[keep])
+        nums.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores, 0)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(np.asarray(nums,
+                                                            np.int32)))
+    return rois, rscores
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode JPEG bytes to CHW uint8 (reference decode_jpeg — nvjpeg on
+    GPU; PIL is the host decoder here)."""
+    try:
+        import io
+
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise ModuleNotFoundError(
+            "decode_jpeg needs Pillow for host-side decoding") from e
+    raw = bytes(np.asarray(unwrap(x), np.uint8).tobytes())
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "unchanged"):
+        img = img.convert("RGB") if mode == "rgb" else img
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
