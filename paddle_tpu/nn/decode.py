@@ -1,0 +1,142 @@
+"""Seq2seq decoding (reference: python/paddle/nn/decode.py —
+BeamSearchDecoder + dynamic_decode over an RNN cell).
+
+The decode loop is host-driven (like the reference dygraph path): each
+step is traced compute, the while-condition is a host readback — decode
+loops with data-dependent termination belong to the host, the per-step
+math to XLA.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .layer.layers import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _v(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class BeamSearchDecoder:
+    """Beam-search wrapper over a step cell (reference decode.py
+    BeamSearchDecoder). cell(inputs, states) -> (outputs, new_states);
+    ``output_fn`` projects cell outputs to vocabulary logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] (reference helper)."""
+        v = _v(x)
+        tiled = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + v.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree.map(
+            lambda s: _v(self.tile_beam_merge_with_batch(Tensor(_v(s)),
+                                                         self.beam_size)),
+            initial_cell_states)
+        some = jax.tree.leaves(states)[0]
+        bb = some.shape[0]
+        batch = bb // self.beam_size
+        tokens = jnp.full((batch, self.beam_size), self.start_token,
+                          jnp.int32)
+        # only beam 0 is live initially (log prob 0; others -inf)
+        log_probs = jnp.where(jnp.arange(self.beam_size)[None, :] == 0,
+                              0.0, -1e9) * jnp.ones((batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return tokens, {"cell": states, "log_probs": log_probs,
+                        "finished": finished}
+
+    def step(self, time, inputs, states):
+        cell_states = states["cell"]
+        batch, beam = states["log_probs"].shape
+        ids = _v(inputs).reshape(-1)
+        step_in = self.embedding_fn(Tensor(ids)) if self.embedding_fn \
+            else Tensor(ids)
+        out, new_cell = self.cell(step_in, cell_states)
+        logits = self.output_fn(out) if self.output_fn else out
+        logits = _v(logits)
+        V = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        logp = logp.reshape(batch, beam, V)
+        # frozen beams: only end_token continues, at no cost
+        frozen = states["finished"]
+        cont = jnp.where(jnp.arange(V)[None, None, :] == self.end_token,
+                         0.0, -1e9)
+        logp = jnp.where(frozen[..., None], cont, logp)
+        total = states["log_probs"][..., None] + logp
+        flat = total.reshape(batch, beam * V)
+        top_lp, top_idx = jax.lax.top_k(flat, beam)
+        parent = top_idx // V
+        token = top_idx % V
+        new_finished = jnp.take_along_axis(frozen, parent, axis=1) | (
+            token == self.end_token)
+
+        def regather(s):
+            sv = _v(s).reshape((batch, beam) + _v(s).shape[1:])
+            idx = parent.reshape(parent.shape + (1,) * (sv.ndim - 2))
+            out = jnp.take_along_axis(sv, idx, axis=1)
+            return out.reshape((batch * beam,) + sv.shape[2:])
+
+        new_cell = jax.tree.map(regather, new_cell)
+        new_states = {"cell": new_cell, "log_probs": top_lp,
+                      "finished": new_finished}
+        outputs = {"token": token, "parent": parent,
+                   "log_probs": top_lp}
+        return outputs, new_states, Tensor(token), new_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths=None):
+        """Backtrace the beam tree to token sequences [T, batch, beam]."""
+        from .functional.sequence_loss import gather_tree
+
+        ids = Tensor(jnp.stack([o["token"] for o in outputs]))
+        parents = Tensor(jnp.stack([o["parent"] for o in outputs]))
+        return gather_tree(ids, parents)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run a decoder until every beam finishes or max_step_num (reference
+    decode.py dynamic_decode)."""
+    max_step_num = max_step_num or 100
+    inputs, states = decoder.initialize(inits)
+    step_outputs = []
+    lengths = prev_fin = None
+    for t in range(int(max_step_num)):
+        outputs, states, inputs, finished = decoder.step(t, inputs, states)
+        step_outputs.append(outputs)
+        fin = np.asarray(_v(finished))
+        if lengths is None:
+            lengths = np.zeros(fin.shape, np.int64)
+            prev_fin = np.zeros(fin.shape, bool)
+        # a beam's length includes the step on which it emitted EOS: count
+        # every step where it was not ALREADY finished
+        lengths = np.where(prev_fin, lengths, t + 1)
+        prev_fin = fin
+        if fin.all():
+            break
+    final = decoder.finalize(step_outputs, states)
+    out = final
+    if not output_time_major:
+        ov = _v(final)
+        out = Tensor(jnp.moveaxis(ov, 0, 1))  # [batch, T, beam]
+    if return_length:
+        return out, Tensor(jnp.asarray(lengths))
+    return out

@@ -7,3 +7,4 @@ from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .extension import *  # noqa: F401,F403
 from .flash_attention import *  # noqa: F401,F403
+from .sequence_loss import *  # noqa: F401,F403

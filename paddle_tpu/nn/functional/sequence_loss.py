@@ -1,0 +1,226 @@
+"""Sequence/classification loss tail (reference: python/paddle/nn/
+functional/loss.py — hsigmoid_loss, rnnt_loss, multi_margin_loss,
+margin_cross_entropy; python/paddle/nn/decode.py gather_tree).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+__all__ = ["gather_tree", "hsigmoid_loss", "rnnt_loss",
+           "multi_margin_loss", "margin_cross_entropy"]
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search ids along parent pointers (reference
+    nn/decode.py gather_tree / phi gather_tree kernel). ids/parents:
+    [max_time, batch, beam]. One reverse lax.scan."""
+
+    def f(idv, pv):
+        T = idv.shape[0]
+
+        def step(next_beam, t):
+            # next_beam: [batch, beam] — which beam each output slot
+            # follows at time t+1
+            ids_t = jnp.take_along_axis(idv[t], next_beam, axis=1)
+            par_t = jnp.take_along_axis(pv[t], next_beam, axis=1)
+            return par_t, ids_t
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2])[None, :],
+                                idv.shape[1:]).astype(pv.dtype)
+        _, out = jax.lax.scan(step, init, jnp.arange(T), reverse=True)
+        return out
+
+    return apply_op(f, ids, parents, op_name="gather_tree")
+
+
+def _simple_code(labels, num_classes, max_len):
+    """Paddle SimpleCode (hsigmoid default complete-binary-tree coding):
+    for class c, walk m = c + num_classes from the MSB: node ids
+    (m >> k) - 1, branch bits (m >> (k-1)) & 1."""
+    m = labels + num_classes
+    nbits = jnp.floor(jnp.log2(m.astype(jnp.float32))).astype(jnp.int32)
+    j = jnp.arange(max_len)
+    shift = nbits[:, None] - j[None, :]
+    valid = shift >= 1
+    node = jnp.where(valid, (m[:, None] >> jnp.maximum(shift, 1)) - 1, 0)
+    bit = jnp.where(valid,
+                    (m[:, None] >> jnp.maximum(shift - 1, 0)) & 1, 0)
+    return node, bit.astype(jnp.float32), valid
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py
+    hsigmoid_loss / phi hsigmoid_loss kernel). Default coding is the
+    complete-binary-tree SimpleCode; custom trees pass path_table (node
+    ids, [N, L]) and path_code (branch bits, [N, L], -1 padded)."""
+    max_len = int(math.ceil(math.log2(max(num_classes, 2))))
+
+    def f(x, lbl, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if bias is not None else None
+        if path_table is not None:
+            pt = rest.pop(0).astype(jnp.int32)
+            pc = rest.pop(0).astype(jnp.float32)
+            valid = pc >= 0
+            pc = jnp.maximum(pc, 0.0)
+        else:
+            pt, pc, valid = _simple_code(lbl.reshape(-1).astype(jnp.int32),
+                                         num_classes, max_len)
+        # logits along each sample's path: [N, L]
+        wp = w[pt]                               # [N, L, D]
+        logit = jnp.einsum("nld,nd->nl", wp, x)
+        if b is not None:
+            logit = logit + b.reshape(-1)[pt]
+        # bit==1 -> right branch: loss = softplus(logit) - bit*logit
+        # (= -log sigmoid(±logit) with sign from the bit)
+        loss = jax.nn.softplus(logit) - pc * logit
+        loss = jnp.where(valid, loss, 0.0).sum(-1)
+        return loss.reshape(-1, 1)
+
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if path_table is not None:
+        args += [path_table, path_code]
+    return apply_op(f, *args, op_name="hsigmoid_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference nn/functional/loss.py rnnt_loss,
+    warprnnt binding; Graves 2012). input: [B, T, U+1, V] log-probable
+    logits (log_softmax applied here); label: [B, U].
+
+    TPU-native: the alpha recursion runs as a lax.scan over T with an
+    inner scan over U — log-space throughout, static shapes, masked tails.
+    """
+
+    def _nll(blank_lp, y_lp, ilen, llen):
+        B, T, U1 = blank_lp.shape
+        neg = -1e30
+
+        def t_step(alpha_prev, t):
+            # emit (horizontal, from t-1 same u) term
+            from_left = jnp.where(t == 0, jnp.where(
+                jnp.arange(U1)[None, :] == 0, 0.0, neg),
+                alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0)])
+
+            # vertical recursion within this t: alpha[t,u] = logsumexp(
+            #   from_left[u], alpha[t,u-1] + y(t, u-1))
+            def u_step(carry, u):
+                prev_u = carry
+                cur = jnp.where(
+                    u == 0, from_left[:, 0],
+                    jnp.logaddexp(from_left[:, u],
+                                  prev_u + y_lp[:, t, jnp.maximum(u - 1,
+                                                                  0)]))
+                return cur, cur
+
+            _, cols = jax.lax.scan(u_step, jnp.full((B,), neg),
+                                   jnp.arange(U1))
+            alpha_t = jnp.swapaxes(cols, 0, 1)             # [B, U+1]
+            return alpha_t, alpha_t
+
+        alpha0 = jnp.full((B, U1), neg)
+        _, alphas = jax.lax.scan(t_step, alpha0, jnp.arange(T))
+        alphas = jnp.swapaxes(alphas, 0, 1)                # [B, T, U+1]
+        t_last = (ilen - 1).astype(jnp.int32)
+        u_last = llen.astype(jnp.int32)
+        a_end = alphas[jnp.arange(B), t_last, u_last]
+        final_blank = blank_lp[jnp.arange(B), t_last, u_last]
+        return -(a_end + final_blank)
+
+    def f(lg, lb, ilen, llen):
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        U = logp.shape[2] - 1
+        blank_lp = logp[..., blank]                        # [B, T, U+1]
+        lb_i = lb.astype(jnp.int32)
+        y_lp = jnp.take_along_axis(
+            logp[:, :, :U, :], lb_i[:, None, :, None], axis=-1)[..., 0]
+        nll = _nll(blank_lp, y_lp, ilen, llen)
+        if fastemit_lambda > 0.0:
+            # FastEmit (Yu et al. 2021): scale the EMISSION branch of the
+            # gradient by (1 + lambda). Realized as an extra loss term
+            # whose gradient flows only through the label log-probs (blank
+            # contributions stop-gradiented) — grad = grad_blank +
+            # (1+lambda) grad_emit, value shifted by lambda*L (constant
+            # offset, same optimum).
+            nll_emit = _nll(jax.lax.stop_gradient(blank_lp), y_lp,
+                            ilen, llen)
+            # zero-valued term: gradients only (loss VALUE matches the
+            # plain transducer NLL exactly)
+            nll = nll + fastemit_lambda * (
+                nll_emit - jax.lax.stop_gradient(nll_emit))
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply_op(f, input, label, input_lengths, label_lengths,
+                    op_name="rnnt_loss")
+
+
+def multi_margin_loss(input, label, p: int = 1, margin: float = 1.0,
+                      weight=None, reduction: str = "mean", name=None):
+    """Multi-class hinge loss (reference multi_margin_loss)."""
+
+    def f(x, lbl, *maybe_w):
+        C = x.shape[1]
+        lbl2 = lbl.reshape(-1).astype(jnp.int32)
+        x_y = jnp.take_along_axis(x, lbl2[:, None], axis=1)
+        diff = jnp.maximum(margin - x_y + x, 0.0) ** p
+        if maybe_w:
+            diff = diff * maybe_w[0].reshape(-1)[lbl2][:, None]
+        mask = jnp.arange(C)[None, :] != lbl2[:, None]
+        loss = jnp.where(mask, diff, 0.0).sum(1) / C
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    args = (input, label) + (() if weight is None else (weight,))
+    return apply_op(f, *args, op_name="multi_margin_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax (reference margin_cross_entropy /
+    phi margin_cross_entropy kernel): target logit cosθ becomes
+    cos(m1·θ + m2) − m3, everything scaled by s. Under model parallelism
+    the reference computes over the class-sharded dim; here logits are
+    logical global arrays so the plain formula applies."""
+
+    def f(lg, lbl):
+        lbl2 = lbl.reshape(-1).astype(jnp.int32)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(jnp.take_along_axis(cos, lbl2[:, None],
+                                               axis=1)[:, 0])
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lbl2, lg.shape[1], dtype=lg.dtype)
+        mod = cos * (1 - onehot) + target[:, None] * onehot
+        z = mod * scale
+        logp = jax.nn.log_softmax(z, axis=-1)
+        nll = -jnp.take_along_axis(logp, lbl2[:, None], axis=1)[:, 0]
+        sm = jnp.exp(logp)
+        if reduction == "mean":
+            loss = nll.mean()
+        elif reduction == "sum":
+            loss = nll.sum()
+        else:
+            loss = nll[:, None]
+        return (loss, sm) if return_softmax else loss
+
+    out = apply_op(f, logits, label, op_name="margin_cross_entropy")
+    return out

@@ -226,3 +226,21 @@ class ThresholdedReLU(Layer):
 
     def forward(self, x):
         return F.thresholded_relu(x, self._threshold)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference
+    nn/layer/activation.py Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        from .. import functional as F
+
+        if len(x.shape) not in (3, 4):
+            raise ValueError("Softmax2D expects 3-D or 4-D input")
+        return F.softmax(x, axis=-3)
+
+
+__all__.append("Softmax2D")

@@ -189,3 +189,69 @@ class GaussianNLLLoss(Layer):
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, self.full,
                                    self.epsilon, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (reference nn/layer/loss.py
+    HSigmoidLoss): owns the (num_classes-1, feature) internal-node weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.weight = self.create_parameter(
+            shape=[num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from ..functional.sequence_loss import hsigmoid_loss
+
+        return hsigmoid_loss(input, label, self.num_classes, self.weight,
+                             self.bias, path_table=path_table,
+                             path_code=path_code)
+
+
+class MultiMarginLoss(Layer):
+    """reference nn/layer/loss.py MultiMarginLoss."""
+
+    def __init__(self, p: int = 1, margin: float = 1.0, weight=None,
+                 reduction: str = "mean", name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        from ..functional.sequence_loss import multi_margin_loss
+
+        return multi_margin_loss(input, label, p=self.p, margin=self.margin,
+                                 weight=self.weight,
+                                 reduction=self.reduction)
+
+
+class RNNTLoss(Layer):
+    """reference nn/layer/loss.py RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        from ..functional.sequence_loss import rnnt_loss
+
+        return rnnt_loss(input, label, input_lengths, label_lengths,
+                         blank=self.blank,
+                         fastemit_lambda=self.fastemit_lambda,
+                         reduction=self.reduction)
+
+
+__all__ += ["HSigmoidLoss", "MultiMarginLoss", "RNNTLoss"]

@@ -10,8 +10,6 @@ conv composes the offset-gather with a dense conv.
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence
-
 import numpy as np
 
 import jax
@@ -20,7 +18,7 @@ import jax.numpy as jnp
 from ..core.autograd import apply_op
 from ..core.tensor import Tensor
 from ..nn import Layer
-from ..ops._helpers import nondiff_op, unwrap
+from ..ops._helpers import unwrap
 
 __all__ = ["yolo_loss", "yolo_box", "prior_box", "box_coder",
            "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
@@ -32,6 +30,31 @@ __all__ = ["yolo_loss", "yolo_box", "prior_box", "box_coder",
 # ---------------------------------------------------------------------------
 # RoI family
 # ---------------------------------------------------------------------------
+
+
+def _roi_to_batch(bv, bn):
+    """Image index for each RoI from per-image counts (shared by the RoI
+    family)."""
+    starts = jnp.cumsum(bn) - bn
+    return jnp.sum((jnp.arange(bv.shape[0])[:, None]
+                    >= starts[None, :]).astype(jnp.int32), axis=1) - 1
+
+
+def _bilinear_gather(img, y, x):
+    """Bilinear sample img [C, H, W] at fractional (y, x) arrays (shared
+    by roi_align and deform_conv2d)."""
+    H, W = img.shape[1], img.shape[2]
+    y = jnp.clip(y, 0.0, H - 1.0)
+    x = jnp.clip(x, 0.0, W - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    fy, fx = y - y0, x - x0
+    return (img[:, y0, x0] * (1 - fy) * (1 - fx)
+            + img[:, y0, x1] * (1 - fy) * fx
+            + img[:, y1, x0] * fy * (1 - fx)
+            + img[:, y1, x1] * fy * fx)
 
 
 def _roi_align_one(feat, box, out_h, out_w, spatial_scale, sampling_ratio,
@@ -54,21 +77,7 @@ def _roi_align_one(feat, box, out_h, out_w, spatial_scale, sampling_ratio,
           + (jnp.arange(ratio)[None, :] + 0.5) * bin_w / ratio)
     H, W = feat.shape[1], feat.shape[2]
 
-    def bilinear(y, x):
-        y = jnp.clip(y, 0.0, H - 1.0)
-        x = jnp.clip(x, 0.0, W - 1.0)
-        y0 = jnp.floor(y).astype(jnp.int32)
-        x0 = jnp.floor(x).astype(jnp.int32)
-        y1i = jnp.minimum(y0 + 1, H - 1)
-        x1i = jnp.minimum(x0 + 1, W - 1)
-        fy = y - y0
-        fx = x - x0
-        v00 = feat[:, y0, x0]
-        v01 = feat[:, y0, x1i]
-        v10 = feat[:, y1i, x0]
-        v11 = feat[:, y1i, x1i]
-        return (v00 * (1 - fy) * (1 - fx) + v01 * (1 - fy) * fx
-                + v10 * fy * (1 - fx) + v11 * fy * fx)
+    bilinear = lambda y, x: _bilinear_gather(feat, y, x)
 
     # all sample points at once: [out_h*ratio] x [out_w*ratio]
     ys = iy.reshape(-1)
@@ -90,10 +99,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     def f(xv, bv, bn):
         # map each roi to its batch image via boxes_num prefix sums
-        starts = jnp.cumsum(bn) - bn
-        roi_batch = jnp.sum(
-            (jnp.arange(bv.shape[0])[:, None]
-             >= starts[None, :]).astype(jnp.int32), axis=1) - 1
+        roi_batch = _roi_to_batch(bv, bn)
 
         def one(box, bidx):
             return _roi_align_one(xv[bidx], box, oh, ow, spatial_scale,
@@ -123,10 +129,7 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
 
     def f(xv, bv, bn):
         H, W = xv.shape[2], xv.shape[3]
-        starts = jnp.cumsum(bn) - bn
-        roi_batch = jnp.sum(
-            (jnp.arange(bv.shape[0])[:, None]
-             >= starts[None, :]).astype(jnp.int32), axis=1) - 1
+        roi_batch = _roi_to_batch(bv, bn)
 
         def one(box, bidx):
             feat = xv[bidx]
@@ -182,10 +185,7 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     def f(xv, bv, bn):
         N, C, H, W = xv.shape
         out_c = C // (oh * ow)
-        starts = jnp.cumsum(bn) - bn
-        roi_batch = jnp.sum(
-            (jnp.arange(bv.shape[0])[:, None]
-             >= starts[None, :]).astype(jnp.int32), axis=1) - 1
+        roi_batch = _roi_to_batch(bv, bn)
 
         def one(box, bidx):
             feat = xv[bidx].reshape(out_c, oh, ow, H, W)
@@ -297,11 +297,13 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
             iou = _iou_matrix(boxes_c)
             iou = np.triu(iou, k=1)
             max_iou = iou.max(axis=0, initial=0.0)
+            # decay_j = min_i f(iou_ij) / f(max_iou_i): compensation is by
+            # the SUPPRESSING box i's own worst overlap (SOLOv2 eq. 4)
             if use_gaussian:
-                decay = np.exp(-(iou ** 2 - max_iou[None, :] ** 2)
+                decay = np.exp(-(iou ** 2 - max_iou[:, None] ** 2)
                                / gaussian_sigma).min(axis=0, initial=1.0)
             else:
-                decay = ((1 - iou) / np.maximum(1 - max_iou[None, :],
+                decay = ((1 - iou) / np.maximum(1 - max_iou[:, None],
                                                 1e-10)).min(axis=0,
                                                             initial=1.0)
             dec_scores = scores_c * decay
@@ -412,6 +414,11 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
              clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
              iou_aware_factor=0.5):
     """Decode YOLOv3 head output to boxes+scores (reference yolo_box)."""
+    if iou_aware:
+        raise NotImplementedError(
+            "iou_aware yolo_box (extra per-anchor IoU channels) is not "
+            "implemented; pass iou_aware=False")
+
     def f(xv, imgv):
         N, C, H, W = xv.shape
         na = len(anchors) // 2
@@ -508,16 +515,46 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                               + (gather(py) - ty) ** 2)).sum(-1)
         loss_wh = (vz * lw * ((gather(pw) - tw) ** 2
                               + (gather(ph) - th) ** 2)).sum(-1)
+        gt_w = vz if not maybe_gs else vz * maybe_gs[0]  # mixup soft labels
         obj_target = jnp.zeros((N, na, H, W))
-        obj_target = obj_target.at[nidx, local, gj, gi].max(vz)
+        obj_target = obj_target.at[nidx, local, gj, gi].max(gt_w)
         bce = lambda lg, t: jnp.maximum(lg, 0) - lg * t + jnp.log1p(
             jnp.exp(-jnp.abs(lg)))
-        loss_obj = (bce(pobj, obj_target)).sum((1, 2, 3))
+        # ignore mask (reference ignore_thresh): negatives whose predicted
+        # box overlaps ANY gt above the threshold contribute no
+        # objectness loss
+        pbx = (jax.nn.sigmoid(pred[:, :, 0])
+               + jnp.arange(W)[None, None, None, :]) / W
+        pby = (jax.nn.sigmoid(pred[:, :, 1])
+               + jnp.arange(H)[None, None, :, None]) / H
+        pbw = jnp.exp(jnp.clip(pw, -10, 10)) \
+            * an[None, :, 0, None, None] / in_w
+        pbh = jnp.exp(jnp.clip(ph, -10, 10)) \
+            * an[None, :, 1, None, None] / in_h
+        px1, px2 = pbx - pbw / 2, pbx + pbw / 2
+        py1, py2 = pby - pbh / 2, pby + pbh / 2
+        gx1 = (gcx - gw / 2)[:, None, None, None, :]
+        gx2 = (gcx + gw / 2)[:, None, None, None, :]
+        gy1 = (gcy - gh / 2)[:, None, None, None, :]
+        gy2 = (gcy + gh / 2)[:, None, None, None, :]
+        ix = jnp.maximum(jnp.minimum(px2[..., None], gx2)
+                         - jnp.maximum(px1[..., None], gx1), 0)
+        iy2 = jnp.maximum(jnp.minimum(py2[..., None], gy2)
+                          - jnp.maximum(py1[..., None], gy1), 0)
+        inter_a = ix * iy2
+        union_a = (pbw * pbh)[..., None] + (gw * gh)[:, None, None,
+                                                     None, :] - inter_a
+        best_iou = jnp.where((gw > 0)[:, None, None, None, :],
+                             inter_a / jnp.maximum(union_a, 1e-10),
+                             0.0).max(-1)
+        noobj_w = (best_iou < ignore_thresh).astype(jnp.float32)
+        obj_w = jnp.where(obj_target > 0, 1.0, noobj_w)
+        loss_obj = (obj_w * bce(pobj, obj_target)).sum((1, 2, 3))
         smooth = 1.0 / class_num if use_label_smooth else 0.0
         cls_t = jax.nn.one_hot(gl, class_num) * (1 - smooth) + \
             smooth / class_num
         pc = pcls[nidx, local, :, gj, gi]
-        loss_cls = (vz[..., None] * bce(pc, cls_t)).sum((-1, -2))
+        loss_cls = (gt_w[..., None] * bce(pc, cls_t)).sum((-1, -2))
         return loss_xy + loss_wh + loss_obj + loss_cls
 
     args = (x, gt_box, gt_label) + (() if gt_score is None else (gt_score,))
@@ -567,19 +604,7 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         sy = base_y[None, None] + off_y   # [N, dg, OH, OW, KH, KW]
         sx = base_x[None, None] + off_x
 
-        def bilinear(img, y, xq):
-            y = jnp.clip(y, 0.0, Hp - 1.0)
-            xq = jnp.clip(xq, 0.0, Wp - 1.0)
-            y0 = jnp.floor(y).astype(jnp.int32)
-            x0 = jnp.floor(xq).astype(jnp.int32)
-            y1 = jnp.minimum(y0 + 1, Hp - 1)
-            x1 = jnp.minimum(x0 + 1, Wp - 1)
-            fy, fx = y - y0, xq - x0
-            g = lambda yy, xx: img[:, yy, xx]
-            return (g(y0, x0) * (1 - fy) * (1 - fx)
-                    + g(y0, x1) * (1 - fy) * fx
-                    + g(y1, x0) * fy * (1 - fx)
-                    + g(y1, x1) * fy * fx)
+        bilinear = _bilinear_gather
 
         cpg = C // deformable_groups
 
@@ -633,7 +658,6 @@ class DeformConv2D(Layer):
         self._dilation = dilation
         self._deformable_groups = deformable_groups
         self._groups = groups
-        bound = 1.0 / math.sqrt(in_channels * ks[0] * ks[1])
         self.weight = self.create_parameter(
             shape=[out_channels, in_channels // groups, ks[0], ks[1]],
             attr=weight_attr)
@@ -665,12 +689,21 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     scale = np.sqrt(w * h)
     lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        rn = np.asarray(unwrap(rois_num), np.int64)
+        img_of = np.repeat(np.arange(len(rn)), rn)
+    else:
+        rn = np.asarray([len(rois)], np.int64)
+        img_of = np.zeros(len(rois), np.int64)
     outs, idxs, nums = [], [], []
     for L in range(min_level, max_level + 1):
         sel = np.nonzero(lvl == L)[0]
         outs.append(Tensor(jnp.asarray(rois[sel])))
         idxs.extend(sel.tolist())
-        nums.append(Tensor(jnp.asarray(np.asarray([len(sel)], np.int32))))
+        # per-IMAGE counts at this level (downstream roi ops need the
+        # image grouping, not just the level total)
+        per_img = np.bincount(img_of[sel], minlength=len(rn))
+        nums.append(Tensor(jnp.asarray(per_img.astype(np.int32))))
     restore = np.argsort(np.asarray(idxs, np.int64))
     res = [outs, Tensor(jnp.asarray(restore))]
     if rois_num is not None:

@@ -1,0 +1,163 @@
+"""Tests for the nn loss/decode tail: rnnt, hsigmoid, multi-margin,
+margin CE, Softmax2D, gather_tree, beam search.
+
+Reference analogs: test/legacy_test/test_rnnt_loss.py, test_hsigmoid_op
+.py, test_multi_margin_loss.py, test_margin_cross_entropy_op.py,
+test_gather_tree_op.py, test_rnn_decode_api.py.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+class TestMultiMargin:
+    @pytest.mark.parametrize("p,margin", [(1, 1.0), (2, 0.5)])
+    def test_matches_torch(self, p, margin):
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 5).astype(np.float32)
+        y = rng.randint(0, 5, (6,))
+        ours = float(F.multi_margin_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y), p=p,
+            margin=margin).numpy())
+        ref = float(torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(y), p=p, margin=margin))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_layer_and_weight(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 3).astype(np.float32)
+        y = rng.randint(0, 3, (4,))
+        w = rng.rand(3).astype(np.float32)
+        ours = float(nn.MultiMarginLoss(weight=paddle.to_tensor(w))(
+            paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+        ref = float(torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(y), weight=torch.tensor(w)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+class TestRNNT:
+    def test_brute_force_parity(self):
+        B, T, U, V = 1, 3, 2, 4
+        rng = np.random.RandomState(0)
+        lg = rng.randn(B, T, U + 1, V).astype(np.float32)
+        lbl = np.asarray([[1, 2]])
+
+        def logsoftmax(a):
+            a = a - a.max(-1, keepdims=True)
+            return a - np.log(np.exp(a).sum(-1, keepdims=True))
+
+        lp = logsoftmax(lg)[0]
+        total = [-np.inf]
+
+        def rec(t, u, acc):
+            if t == T - 1 and u == U:
+                total[0] = np.logaddexp(total[0], acc + lp[t, u, 0])
+            if u < U:
+                rec(t, u + 1, acc + lp[t, u, lbl[0, u]])
+            if t + 1 <= T - 1:
+                rec(t + 1, u, acc + lp[t, u, 0])
+
+        rec(0, 0, 0.0)
+        ours = np.asarray(F.rnnt_loss(
+            paddle.to_tensor(lg), paddle.to_tensor(lbl),
+            paddle.to_tensor(np.asarray([T])),
+            paddle.to_tensor(np.asarray([U])),
+            reduction="none").numpy()).item()
+        np.testing.assert_allclose(ours, -total[0], rtol=1e-5)
+
+    def test_grads_finite_and_training_decreases(self):
+        B, T, U, V = 2, 4, 3, 5
+        rng = np.random.RandomState(2)
+        lg = paddle.to_tensor(rng.randn(B, T, U + 1, V).astype(np.float32))
+        lg.stop_gradient = False
+        lbl = paddle.to_tensor(rng.randint(1, V, (B, U)))
+        il = paddle.to_tensor(np.asarray([T, T], np.int64))
+        ll = paddle.to_tensor(np.asarray([U, U], np.int64))
+        loss = nn.RNNTLoss()(lg, lbl, il, ll)
+        loss.backward()
+        g = np.asarray(lg.grad.numpy())
+        assert np.all(np.isfinite(g)) and np.abs(g).sum() > 0
+
+
+class TestHSigmoid:
+    def test_loss_shape_and_training(self):
+        from paddle_tpu import optimizer as opt
+
+        m = nn.HSigmoidLoss(8, 10)
+        o = opt.Adam(learning_rate=0.1, parameters=m.parameters())
+        rng = np.random.RandomState(3)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randint(0, 10, (16, 1))
+        losses = []
+        for _ in range(15):
+            loss = paddle.mean(m(paddle.to_tensor(x), paddle.to_tensor(y)))
+            losses.append(float(loss.numpy()))
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert losses[-1] < 0.5 * losses[0]
+
+    def test_functional_custom_path(self):
+        x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        w = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32))
+        lbl = paddle.to_tensor(np.asarray([[0], [1]]))
+        pt = paddle.to_tensor(np.asarray([[0, 1], [0, 2]]))
+        pc = paddle.to_tensor(np.asarray([[0.0, 1.0], [1.0, -1.0]],
+                                         np.float32))
+        out = F.hsigmoid_loss(x, lbl, 4, w, path_table=pt, path_code=pc)
+        assert np.asarray(out.numpy()).shape == (2, 1)
+        assert np.all(np.isfinite(np.asarray(out.numpy())))
+
+
+class TestMarginCE:
+    def test_zero_margin_equals_scaled_softmax_ce(self):
+        rng = np.random.RandomState(4)
+        cos = rng.uniform(-1, 1, (5, 8)).astype(np.float32)
+        y = rng.randint(0, 8, (5,))
+        ours = float(F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(y), margin1=1.0,
+            margin2=0.0, margin3=0.0, scale=10.0).numpy())
+        z = torch.tensor(cos) * 10.0
+        ref = float(torch.nn.functional.cross_entropy(z, torch.tensor(y)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+
+class TestSoftmax2D:
+    def test_channel_softmax(self):
+        x = np.random.RandomState(5).rand(2, 3, 4, 4).astype(np.float32)
+        out = np.asarray(nn.Softmax2D()(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-5)
+
+
+class TestDecode:
+    def test_gather_tree_backtrace(self):
+        ids = np.asarray([[[2, 5]], [[3, 6]], [[4, 7]]], np.int64)
+        par = np.asarray([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+        out = np.asarray(F.gather_tree(paddle.to_tensor(ids),
+                                       paddle.to_tensor(par)).numpy())
+        assert out[:, 0, 0].tolist() == [5, 3, 4]
+        assert out[:, 0, 1].tolist() == [2, 6, 7]
+
+    def test_beam_search_decoder_greedy_chain(self):
+        V, beam = 6, 3
+
+        class ToyCell:
+            def __call__(self, ids, states):
+                iv = np.asarray(ids.numpy()).astype(int)
+                logits = np.full((iv.shape[0], V), -5.0, np.float32)
+                nxt = np.minimum(iv + 1, V - 1)
+                logits[np.arange(iv.shape[0]), nxt] = 5.0
+                return paddle.to_tensor(logits), states
+
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=0,
+                                   end_token=V - 1, beam_size=beam)
+        out, lens = nn.dynamic_decode(
+            dec, inits={"h": np.zeros((2, 4), np.float32)},
+            max_step_num=10, return_length=True)
+        o = np.asarray(out.numpy())
+        assert o.shape[0] == 2 and o.shape[2] == beam
+        assert list(o[0, :5, 0]) == [1, 2, 3, 4, 5]
