@@ -140,13 +140,21 @@ def scatter_object_list(out_object_list: List, in_object_list=None,
 
 def gather(tensor, gather_list=None, dst: int = 0, group=None,
            sync_op=True):
-    """Gather tensors to dst (reference communication/gather.py). The
-    single-controller form is all_gather with only dst consuming the
-    list — data already lives in one logical address space."""
-    out: List = []
-    all_gather(out, tensor, group=group, sync_op=sync_op)
+    """Gather tensors to dst (reference communication/gather.py). Under
+    single-controller SPMD a host-side tensor is logically REPLICATED
+    across the group, so the gathered list is nranks copies; the
+    stacked-ranks eager form (leading dim == group size) is sliced."""
+    from .communication.core import get_group
     from .env import get_rank
 
+    g = get_group(group)
+    n = max(1, g.nranks)
+    v = tensor.value if hasattr(tensor, "value") else tensor
+    if getattr(v, "shape", ()) and v.shape[0] == n:
+        out: List = []
+        all_gather(out, tensor, group=group, sync_op=sync_op)
+    else:
+        out = [tensor] * n  # replicated host value
     if gather_list is not None and get_rank() == dst:
         gather_list[:] = out
     return gather_list

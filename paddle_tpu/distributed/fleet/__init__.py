@@ -282,21 +282,27 @@ class UtilBase:
     utilities over the collective backend."""
 
     def all_reduce(self, input, mode="sum", comm_world="worker"):
+        """Cross-WORKER (process-level) reduction — host values are
+        per-process, so the world is jax.process_count(), not the device
+        mesh; single process = identity."""
+        import jax
         import numpy as np
 
-        from ... import distributed as dist
-        from ...core.tensor import Tensor
+        out = np.asarray(input)
+        if jax.process_count() == 1:
+            return out
+        from jax.experimental import multihost_utils
 
-        op = {"sum": dist.ReduceOp.SUM, "mean": dist.ReduceOp.SUM,
-              "min": dist.ReduceOp.MIN, "max": dist.ReduceOp.MAX}[mode]
-        t = Tensor(np.asarray(input))
-        dist.all_reduce(t, op=op)
-        out = np.asarray(t.numpy())
+        gathered = np.asarray(multihost_utils.process_allgather(out))
+        if mode == "sum":
+            return gathered.sum(0)
         if mode == "mean":
-            import jax
-
-            out = out / max(1, jax.process_count())
-        return out
+            return gathered.mean(0)
+        if mode == "min":
+            return gathered.min(0)
+        if mode == "max":
+            return gathered.max(0)
+        raise ValueError(f"unknown mode {mode!r}")
 
     def barrier(self, comm_world="worker"):
         from ... import distributed as dist
@@ -304,15 +310,16 @@ class UtilBase:
         dist.barrier()
 
     def all_gather(self, input, comm_world="worker"):
-        out = []
-        from ... import distributed as dist
-        from ...core.tensor import Tensor
-
+        import jax
         import numpy as np
 
-        dist.all_gather(out, Tensor(np.asarray(input)))
-        return [np.asarray(o.numpy() if hasattr(o, "numpy") else o)
-                for o in out]
+        if jax.process_count() == 1:
+            return [np.asarray(input)]
+        from jax.experimental import multihost_utils
+
+        g = np.asarray(multihost_utils.process_allgather(
+            np.asarray(input)))
+        return [g[i] for i in range(g.shape[0])]
 
     def get_file_shard(self, files):
         import jax

@@ -1,0 +1,72 @@
+"""API-surface regression guard: the parity report must stay at 100%.
+
+Audits every public name in the reference's module ``__all__`` lists
+against this package (tools/api_parity_report.py). Any regression shows
+up as a named missing symbol.
+"""
+import os
+import sys
+
+import pytest
+
+REF = "/root/reference"
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_exact_name_parity_is_complete():
+    from api_parity_report import MODULES, our_surface, parse_all
+
+    base = os.path.join(REF, "python", "paddle")
+    top_extra = parse_all(os.path.join(base, "tensor/__init__.py")) or []
+    missing_all = {}
+    for rel, ours in MODULES:
+        if ours is None:
+            continue
+        ref_names = parse_all(os.path.join(base, rel))
+        if ref_names is None:
+            continue
+        if rel == "__init__.py":
+            ref_names = sorted(set(ref_names) | set(top_extra))
+        have = our_surface(ours)
+        missing = [n for n in ref_names if n.split(".")[0] not in have]
+        if missing:
+            missing_all["paddle." + ours if ours else "paddle"] = missing
+    assert not missing_all, f"API parity regressed: {missing_all}"
+
+
+def test_distributed_extras_single_process():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    # aliases dispatch to the canonical collectives
+    assert dist.alltoall.__name__ == "alltoall"
+    t = paddle.to_tensor(np.ones(3, np.float32))
+    dist.wait(t)
+    out = ["x"]
+    assert dist.broadcast_object_list(out) == ["x"]
+    dest = []
+    dist.scatter_object_list(dest, [1, 2, 3], src=0)
+    assert dest  # single-process: src's first shard
+    got = []
+    dist.gather(t, got, dst=0)
+    assert len(got) >= 1
+    dist.destroy_process_group()
+    assert dist.is_available()
+
+
+def test_fleet_role_and_util():
+    from paddle_tpu.distributed import fleet
+
+    rm = fleet.UserDefinedRoleMaker(current_id=1, worker_num=4)
+    assert rm.worker_index() == 1 and rm.worker_num() == 4
+    u = fleet.UtilBase()
+    assert u.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+    import numpy as np
+
+    r = u.all_reduce(np.asarray([2.0]), mode="min")
+    assert float(np.asarray(r)[0]) == 2.0
