@@ -1,0 +1,252 @@
+"""Tests for paddle.vision.ops and the transforms tail.
+
+Reference analogs: test/legacy_test/test_roi_align_op.py,
+test_roi_pool_op.py, test_nms_op.py, test_matrix_nms_op.py,
+test_prior_box_op.py, test_yolo_box_op.py, test_deformable_conv_op.py,
+test_distribute_fpn_proposals_op.py, test_transforms.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.ops as vops
+import paddle_tpu.vision.transforms as T
+from paddle_tpu.nn import functional as F
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestRoIFamily:
+    def test_roi_align_uniform_region(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 2:6, 2:6] = 1.0
+        out = vops.roi_align(t(x), t([[2.0, 2.0, 6.0, 6.0]]),
+                             t(np.asarray([1], np.int32)), 2,
+                             aligned=False)
+        o = np.asarray(out.numpy())
+        assert o.shape == (1, 1, 2, 2)
+        assert o[0, 0, 0, 0] > 0.95          # interior bin fully inside
+        assert o.mean() > 0.7                # edge bins interpolate out
+
+    def test_roi_align_batch_mapping(self):
+        x = np.zeros((2, 1, 4, 4), np.float32)
+        x[1] = 1.0  # second image all ones
+        boxes = np.asarray([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
+        out = vops.roi_align(t(x), t(boxes), t(np.asarray([1, 1],
+                                               np.int32)), 2)
+        o = np.asarray(out.numpy())
+        assert o[0].max() == 0.0 and o[1].min() > 0.9
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 3, 3] = 7.0
+        out = vops.roi_pool(t(x), t([[0.0, 0.0, 7.0, 7.0]]),
+                            t(np.asarray([1], np.int32)), 2)
+        assert np.asarray(out.numpy()).max() == 7.0
+
+    def test_psroi_pool_channel_groups(self):
+        oh = ow = 2
+        out_c = 3
+        x = np.random.RandomState(0).rand(1, out_c * oh * ow, 8,
+                                          8).astype(np.float32)
+        out = vops.psroi_pool(t(x), t([[0.0, 0.0, 8.0, 8.0]]),
+                              t(np.asarray([1], np.int32)), oh)
+        assert np.asarray(out.numpy()).shape == (1, out_c, oh, ow)
+
+
+class TestNMS:
+    def test_nms_suppression_order(self):
+        b = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                       np.float32)
+        s = np.asarray([0.9, 0.8, 0.7], np.float32)
+        keep = np.asarray(vops.nms(t(b), 0.5, t(s)).numpy())
+        assert keep.tolist() == [0, 2]
+
+    def test_nms_category_aware(self):
+        b = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        s = np.asarray([0.9, 0.8], np.float32)
+        cats = np.asarray([0, 1])
+        keep = np.asarray(vops.nms(t(b), 0.5, t(s), t(cats),
+                                   categories=[0, 1]).numpy())
+        assert keep.tolist() == [0, 1]  # different class: no suppression
+
+    def test_matrix_nms_decays_overlaps(self):
+        b = np.zeros((1, 3, 4), np.float32)
+        b[0, 0] = [0, 0, 10, 10]
+        b[0, 1] = [0.5, 0.5, 10.5, 10.5]
+        b[0, 2] = [20, 20, 30, 30]
+        sc = np.zeros((1, 2, 3), np.float32)
+        sc[0, 1] = [0.9, 0.85, 0.8]
+        out, nums = vops.matrix_nms(t(b), t(sc), score_threshold=0.1,
+                                    post_threshold=0.0, nms_top_k=10,
+                                    keep_top_k=10, background_label=0)
+        o = np.asarray(out.numpy())
+        assert int(np.asarray(nums.numpy())[0]) == 3
+        assert o[:, 1].min() < 0.5  # the overlapping box got decayed
+        assert o[:, 1].max() == pytest.approx(0.9)
+
+
+class TestAnchors:
+    def test_prior_box_shapes_and_range(self):
+        pb, pv = vops.prior_box(
+            t(np.zeros((1, 3, 4, 4), np.float32)),
+            t(np.zeros((1, 3, 32, 32), np.float32)),
+            min_sizes=[8.0], aspect_ratios=(1.0, 2.0), flip=True,
+            clip=True)
+        b = np.asarray(pb.numpy())
+        assert b.shape[:2] == (4, 4) and b.shape[-1] == 4
+        assert b.min() >= 0.0 and b.max() <= 1.0
+
+    def test_box_coder_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(0)
+        priors = np.asarray([[10, 10, 30, 30], [5, 5, 20, 25]], np.float32)
+        var = np.full((2, 4), 0.1, np.float32)
+        targets = np.asarray([[12, 11, 28, 33]], np.float32)
+        enc = vops.box_coder(t(priors), t(var), t(targets),
+                             code_type="encode_center_size")
+        dec = vops.box_coder(t(priors), t(var), enc,
+                             code_type="decode_center_size", axis=0)
+        d = np.asarray(dec.numpy())
+        np.testing.assert_allclose(d[0, 0], targets[0], atol=1e-3)
+
+    def test_yolo_box_shapes(self):
+        yb, ys = vops.yolo_box(
+            t(np.random.RandomState(0).rand(2, 3 * 7, 4, 4)
+              .astype(np.float32)),
+            t(np.asarray([[64, 64], [64, 64]], np.int32)),
+            anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+            conf_thresh=0.01, downsample_ratio=16)
+        assert np.asarray(yb.numpy()).shape == (2, 48, 4)
+        assert np.asarray(ys.numpy()).shape == (2, 48, 2)
+
+    def test_yolo_box_iou_aware_gated(self):
+        with pytest.raises(NotImplementedError):
+            vops.yolo_box(t(np.zeros((1, 21, 4, 4), np.float32)),
+                          t(np.asarray([[64, 64]], np.int32)),
+                          anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+                          conf_thresh=0.01, downsample_ratio=16,
+                          iou_aware=True)
+
+    def test_yolo_loss_runs_and_grads(self):
+        x = t(np.random.RandomState(1).rand(1, 3 * 7, 4, 4)
+              .astype(np.float32))
+        x.stop_gradient = False
+        loss = vops.yolo_loss(
+            x, t(np.asarray([[[0.5, 0.5, 0.3, 0.3]]], np.float32)),
+            t(np.asarray([[1]], np.int64)),
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+            class_num=2, ignore_thresh=0.5, downsample_ratio=16)
+        paddle.sum(loss).backward()
+        g = np.asarray(x.grad.numpy())
+        assert np.all(np.isfinite(g)) and np.abs(g).sum() > 0
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_dense_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 2 * 9, 4, 4), np.float32)
+        dc = vops.deform_conv2d(t(x), t(off), t(w))
+        ref = F.conv2d(t(x), t(w))
+        np.testing.assert_allclose(np.asarray(dc.numpy()),
+                                   np.asarray(ref.numpy()), atol=1e-4)
+
+    def test_layer_with_mask(self):
+        layer = vops.DeformConv2D(2, 3, 3)
+        x = t(np.random.RandomState(2).randn(1, 2, 6, 6)
+              .astype(np.float32))
+        off = t(np.zeros((1, 18, 4, 4), np.float32))
+        mask = t(np.ones((1, 9, 4, 4), np.float32))
+        out = layer(x, off, mask=mask)
+        assert tuple(out.shape) == (1, 3, 4, 4)
+
+
+class TestProposals:
+    def test_distribute_fpn_per_image_counts(self):
+        rois = np.asarray([[0, 0, 10, 10], [0, 0, 100, 100],
+                           [0, 0, 12, 12]], np.float32)
+        outs, restore, nums = vops.distribute_fpn_proposals(
+            t(rois), 2, 4, 3, 30,
+            rois_num=t(np.asarray([2, 1], np.int32)))
+        counts = [np.asarray(n.numpy()) for n in nums]
+        assert all(c.shape == (2,) for c in counts)
+        total = np.stack(counts).sum(0)
+        np.testing.assert_array_equal(total, [2, 1])
+        assert sorted(np.asarray(restore.numpy()).tolist()) == [0, 1, 2]
+
+    def test_generate_proposals(self):
+        rng = np.random.RandomState(3)
+        H = W = 4
+        A = 3
+        scores = rng.rand(1, A, H, W).astype(np.float32)
+        deltas = (rng.rand(1, A * 4, H, W).astype(np.float32) - 0.5)
+        anchors = rng.rand(H, W, A, 4).astype(np.float32) * 10
+        anchors[..., 2:] += 20
+        var = np.full((H, W, A, 4), 0.1, np.float32)
+        rois, rscores, rnum = vops.generate_proposals(
+            t(scores), t(deltas), t(np.asarray([[64.0, 64.0]],
+                                               np.float32)),
+            t(anchors), t(var), pre_nms_top_n=12, post_nms_top_n=5,
+            return_rois_num=True)
+        assert np.asarray(rois.numpy()).shape[1] == 4
+        assert int(np.asarray(rnum.numpy())[0]) <= 5
+
+
+class TestFileOps:
+    def test_read_file_and_decode_jpeg(self, tmp_path):
+        from PIL import Image
+
+        p = str(tmp_path / "x.jpg")
+        Image.fromarray(np.full((8, 8, 3), 128, np.uint8)).save(p)
+        raw = vops.read_file(p)
+        assert np.asarray(raw.numpy()).dtype == np.uint8
+        img = vops.decode_jpeg(raw)
+        assert np.asarray(img.numpy()).shape == (3, 8, 8)
+
+
+class TestTransformsTail:
+    def _img(self):
+        return np.random.RandomState(0).randint(
+            0, 255, (32, 32, 3)).astype(np.float32)
+
+    def test_color_adjust_identity_factors(self):
+        img = self._img()
+        np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img)
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img,
+                                   atol=1e-3)
+        np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img,
+                                   atol=1e-3)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=2.0)
+
+    def test_rotate_full_turn_is_identity_interior(self):
+        img = self._img()
+        out = T.rotate(img, 360.0)
+        assert np.abs(out[8:24, 8:24] - img[8:24, 8:24]).mean() < 2.0
+
+    def test_affine_shear_tilts_vertical_line(self):
+        img = np.zeros((21, 21, 1), np.float32)
+        img[:, 10] = 1.0
+        sh = T.affine(img, shear=(30, 0))
+        rows = [int(np.argmax(sh[r, :, 0])) for r in (2, 18)]
+        assert rows[0] != rows[1]
+
+    def test_perspective_identity(self):
+        img = self._img()
+        pts = [(0, 0), (31, 0), (31, 31), (0, 31)]
+        np.testing.assert_allclose(T.perspective(img, pts, pts), img,
+                                   atol=1e-3)
+
+    def test_random_classes_shapes(self):
+        img = self._img()
+        assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)._apply_image(
+            img).shape == img.shape
+        assert T.RandomResizedCrop(16)._apply_image(img).shape[:2] \
+            == (16, 16)
+        out = T.RandomErasing(prob=1.0)._apply_image(img)
+        assert out.shape == img.shape and not np.allclose(out, img)
+        assert T.RandomRotation(10)._apply_image(img).shape == img.shape
+        assert T.RandomPerspective(prob=1.0)._apply_image(
+            img).shape == img.shape
