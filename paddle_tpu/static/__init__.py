@@ -590,6 +590,7 @@ class ExponentialMovingAverage:
         self._shadow = {}
         self._backup = {}
         self._step = 0
+        self._tracked = {}  # key -> the Parameter object itself
 
     def update(self, parameters=None):
         params = parameters or [
@@ -599,7 +600,8 @@ class ExponentialMovingAverage:
 
         d = min(self._decay, (1 + self._step) / (10 + self._step))
         for p in params:
-            key = getattr(p, "name", id(p))
+            key = getattr(p, "name", None) or id(p)
+            self._tracked[key] = p  # remember WHICH params we average
             prev = self._shadow.get(key)
             v = p.value.astype(jnp.float32)
             self._shadow[key] = v if prev is None else (
@@ -608,10 +610,8 @@ class ExponentialMovingAverage:
     def apply(self, executor=None, need_restore=True):
         import contextlib
 
-        params = [p for _, p in default_main_program().param_objs.items()]
-        self._backup = {getattr(p, "name", id(p)): p.value for p in params}
-        for p in params:
-            key = getattr(p, "name", id(p))
+        self._backup = {k: p.value for k, p in self._tracked.items()}
+        for key, p in self._tracked.items():
             if key in self._shadow:
                 p.set_value(self._shadow[key].astype(p.value.dtype))
 
@@ -628,9 +628,7 @@ class ExponentialMovingAverage:
         return guard()
 
     def restore(self, executor=None):
-        params = [p for _, p in default_main_program().param_objs.items()]
-        for p in params:
-            key = getattr(p, "name", id(p))
+        for key, p in self._tracked.items():
             if key in self._backup:
                 p.set_value(self._backup[key])
         self._backup = {}

@@ -1,0 +1,178 @@
+"""Tests for the grind-1/2 parity surfaces: incubate API, static EMA/
+metrics, callbacks ReduceLROnPlateau, distributed split, autograd
+jacobian/hessian.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+from paddle_tpu import incubate, optimizer as opt
+
+
+class TestIncubateAPI:
+    def test_softmax_mask_fuse(self):
+        x = np.random.RandomState(0).rand(2, 3, 4).astype(np.float32)
+        mask = np.zeros((2, 3, 4), np.float32)
+        mask[..., -1] = -1e9
+        out = np.asarray(incubate.softmax_mask_fuse(
+            paddle.to_tensor(x), paddle.to_tensor(mask)).numpy())
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+        assert np.all(out[..., -1] < 1e-6)
+
+    def test_softmax_mask_fuse_upper_triangle(self):
+        x = np.random.RandomState(1).rand(1, 4, 4).astype(np.float32)
+        out = np.asarray(incubate.softmax_mask_fuse_upper_triangle(
+            paddle.to_tensor(x)).numpy())
+        assert out[0, 0, 1] == 0 and out[0, 0, 0] == pytest.approx(1.0)
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+    def test_identity_loss_reductions(self):
+        x = paddle.to_tensor(np.asarray([1.0, 3.0], np.float32))
+        assert float(incubate.identity_loss(x, "sum").numpy()) == 4.0
+        assert float(incubate.identity_loss(x, "mean").numpy()) == 2.0
+        np.testing.assert_allclose(
+            np.asarray(incubate.identity_loss(x, "none").numpy()), [1, 3])
+
+    def test_graph_khop_sampler(self):
+        # CSC graph: 0 -> {1, 2}, 1 -> {2}, 2 -> {}
+        row = paddle.to_tensor(np.asarray([1, 2, 2], np.int64))
+        colptr = paddle.to_tensor(np.asarray([0, 2, 3, 3], np.int64))
+        src, dst, nodes = incubate.graph_khop_sampler(
+            row, colptr, paddle.to_tensor(np.asarray([0], np.int64)),
+            sample_sizes=[2])
+        n = np.asarray(nodes.numpy())
+        assert n[0] == 0 and set(n.tolist()) <= {0, 1, 2}
+        assert np.asarray(src.numpy()).shape == np.asarray(
+            dst.numpy()).shape
+
+    def test_lookahead_slow_weights(self):
+        m = nn.Linear(4, 2)
+        la = incubate.LookAhead(
+            opt.SGD(learning_rate=0.5, parameters=m.parameters()),
+            alpha=0.5, k=2)
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 4).astype(np.float32)
+        y = rng.randn(4, 2).astype(np.float32)
+
+        def step():
+            loss = paddle.mean((m(paddle.to_tensor(x))
+                                - paddle.to_tensor(y)) ** 2)
+            loss.backward()
+            la.step()
+            la.clear_grad()
+
+        step()
+        w_after1 = np.asarray(m.weight.numpy()).copy()
+        step()  # k=2: slow-weight interpolation fires
+        w_after2 = np.asarray(m.weight.numpy())
+        assert not np.allclose(w_after1, w_after2)
+
+    def test_model_average_apply_restore(self):
+        m = nn.Linear(3, 2)
+        ma = incubate.ModelAverage(0.15, parameters=list(m.parameters()))
+        for i in range(3):
+            m.weight.set_value(m.weight.value + 1.0)
+            ma.step()
+        now = np.asarray(m.weight.numpy()).copy()
+        with ma.apply():
+            avg = np.asarray(m.weight.numpy()).copy()
+        assert not np.allclose(now, avg)
+        np.testing.assert_allclose(np.asarray(m.weight.numpy()), now)
+
+
+class TestStaticExtras:
+    def test_ema_update_apply_restore(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                w = paddle.create_parameter([2, 2], "float32", name="ema_w")
+            ema = static.ExponentialMovingAverage(0.5)
+            w.set_value(np.ones((2, 2), np.float32))
+            ema.update([w])
+            w.set_value(np.full((2, 2), 3.0, np.float32))
+            ema.update([w])
+            cur = np.asarray(w.numpy()).copy()
+            with ema.apply():
+                shadow = np.asarray(w.numpy()).copy()
+            assert shadow.mean() < cur.mean()
+            np.testing.assert_allclose(np.asarray(w.numpy()), cur)
+        finally:
+            paddle.disable_static()
+
+    def test_accuracy_topk(self):
+        pred = paddle.to_tensor(np.asarray(
+            [[0.1, 0.5, 0.4], [0.8, 0.1, 0.1]], np.float32))
+        lbl = paddle.to_tensor(np.asarray([[2], [0]], np.int64))
+        a1 = float(static.accuracy(pred, lbl, k=1).numpy())
+        a2 = float(static.accuracy(pred, lbl, k=2).numpy())
+        assert a1 == pytest.approx(0.5) and a2 == pytest.approx(1.0)
+
+    def test_auc_ranks_perfect_separation(self):
+        pred = paddle.to_tensor(np.asarray(
+            [[0.1, 0.9], [0.9, 0.1], [0.2, 0.8], [0.7, 0.3]], np.float32))
+        lbl = paddle.to_tensor(np.asarray([[1], [0], [1], [0]], np.int64))
+        a, _, _ = static.auc(pred, lbl)
+        assert float(a.numpy()) > 0.95
+
+
+class TestReduceLROnPlateau:
+    def test_reduces_after_patience(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        class FakeOpt:
+            def __init__(self):
+                self.lr = 0.1
+
+            def get_lr(self):
+                return self.lr
+
+            def set_lr(self, v):
+                self.lr = v
+
+        class FakeModel:
+            _optimizer = FakeOpt()
+
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               verbose=0, mode="min")
+        cb.model = FakeModel()
+        cb.on_epoch_end(0, {"loss": 1.0})
+        for e in range(1, 3):
+            cb.on_epoch_end(e, {"loss": 1.0})  # 2 stale epochs -> reduce
+        assert FakeModel._optimizer.lr == pytest.approx(0.05)
+        for e in range(3, 5):
+            cb.on_epoch_end(e, {"loss": 1.0})  # plateau again -> reduce
+        assert FakeModel._optimizer.lr == pytest.approx(0.025)
+
+
+class TestDistributedSplit:
+    def test_split_routes_to_mpu_linear(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import fleet
+
+        fleet.init(is_collective=True)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(2, 8).astype(np.float32))
+        out = dist.split(x, (8, 4), "linear", axis=1, num_partitions=1)
+        assert tuple(np.asarray(
+            out.numpy() if hasattr(out, "numpy") else out).shape) == (2, 4)
+
+
+class TestJacobianHessian:
+    def test_jacobian_diag(self):
+        from paddle_tpu.autograd import jacobian
+
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        J = np.asarray(jacobian(lambda v: v * v, x).numpy())
+        np.testing.assert_allclose(J, np.diag([2.0, 4.0]))
+
+    def test_hessian_of_cubic(self):
+        from paddle_tpu.autograd import hessian
+
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        H = np.asarray(hessian(
+            lambda v: paddle.sum(v * v * v), x).numpy())
+        np.testing.assert_allclose(H, np.diag([6.0, 12.0]))
