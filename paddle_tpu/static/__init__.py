@@ -583,7 +583,11 @@ class WeightNormParamAttr:
 
 class ExponentialMovingAverage:
     """EMA of parameters (reference static.ExponentialMovingAverage):
-    update() folds current params into shadows, apply()/restore() swap."""
+    update() folds current params into shadows, apply()/restore() swap.
+
+    apply() targets the PARAMETER OBJECTS seen by update() (dygraph-EMA
+    semantics) — a separately rebuilt program with same-named parameters
+    is a different set of objects and is not touched."""
 
     def __init__(self, decay=0.999, thres_steps=None, name=None):
         self._decay = decay
