@@ -176,3 +176,33 @@ class TestJacobianHessian:
         H = np.asarray(hessian(
             lambda v: paddle.sum(v * v * v), x).numpy())
         np.testing.assert_allclose(H, np.diag([6.0, 12.0]))
+
+
+class TestHapiCallbackIntegration:
+    def test_fit_with_reduce_lr_on_plateau(self):
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+        from paddle_tpu.io import Dataset
+
+        class Flat(Dataset):
+            def __init__(self, n=8):
+                rng = np.random.RandomState(0)
+                self.x = rng.rand(n, 4).astype(np.float32)
+                self.y = rng.rand(n, 2).astype(np.float32)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return len(self.x)
+
+        net = nn.Linear(4, 2)
+        model = Model(net)
+        optim = opt.SGD(learning_rate=0.0, parameters=net.parameters())
+        model.prepare(optim, nn.MSELoss())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0, mode="min", min_delta=0.0)
+        # lr==0 -> loss constant -> plateau fires; lr halves from 0 stays 0
+        model.fit(Flat(), batch_size=4, epochs=4, verbose=0,
+                  callbacks=[cb])
+        assert cb.best is not None and np.isfinite(cb.best)
