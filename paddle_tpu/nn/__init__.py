@@ -17,6 +17,7 @@ from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from . import utils  # noqa: F401
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from .parameter import Parameter  # noqa: F401

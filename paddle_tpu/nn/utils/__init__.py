@@ -1,0 +1,10 @@
+"""paddle.nn.utils parity (reference: python/paddle/nn/utils/ —
+weight/spectral norm hooks, parameter flattening, gradient clipping).
+"""
+from .utils import (clip_grad_norm_, clip_grad_value_,
+                    parameters_to_vector, remove_weight_norm,
+                    spectral_norm, vector_to_parameters, weight_norm)
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]

@@ -1,0 +1,194 @@
+"""nn.utils implementations.
+
+weight_norm / spectral_norm reparameterize a layer's weight via a
+forward pre-hook (reference weight_norm_hook.py:141 /
+spectral_norm_hook.py:117): the hook recomputes `weight` from the
+auxiliary parameters before every forward, so the optimizer trains
+(weight_g, weight_v) / the norm sees power-iterated u,v — identical
+training semantics, jit-friendly (plain jnp math per forward).
+"""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..parameter import Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except_dim(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute(self, layer):
+        g = getattr(layer, self.name + "_g").value
+        v = getattr(layer, self.name + "_v").value
+        w = v * (g / jnp.maximum(_norm_except_dim(v, self.dim), 1e-12))
+        object.__setattr__(layer, self.name, Tensor(w))
+
+    def __call__(self, layer, inputs):
+        self.compute(layer)
+        return inputs
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (reference
+    weight_norm_hook.weight_norm). Returns the layer."""
+    w = getattr(layer, name)
+    wv = w.value
+    g0 = _norm_except_dim(wv, dim)
+    # replace the original Parameter with (g, v)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", Parameter(jnp.asarray(g0)))
+    layer.add_parameter(name + "_v", Parameter(jnp.asarray(wv)))
+    hook = _WeightNormHook(name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hook = (hook, handle, name)
+    hook.compute(layer)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Fold g * v/||v|| back into a plain weight Parameter (reference
+    remove_weight_norm)."""
+    hook, handle, hname = layer._weight_norm_hook
+    hook.compute(layer)
+    w = getattr(layer, hname)
+    handle.remove() if hasattr(handle, "remove") else None
+    del layer._parameters[hname + "_g"]
+    del layer._parameters[hname + "_v"]
+    layer.add_parameter(hname, Parameter(jnp.asarray(
+        w.value if isinstance(w, Tensor) else w)))
+    del layer._weight_norm_hook
+    return layer
+
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def compute(self, layer, update_uv=True):
+        w = getattr(layer, self.name + "_orig").value
+        wm = jnp.moveaxis(w, self.dim, 0).reshape(w.shape[self.dim], -1)
+        u = getattr(layer, self.name + "_u")
+        v_buf = getattr(layer, self.name + "_v")
+        uv = u.value if isinstance(u, Tensor) else jnp.asarray(u)
+        vv = v_buf.value if isinstance(v_buf, Tensor) else jnp.asarray(
+            v_buf)
+        if update_uv and layer.training:
+            for _ in range(self.n):
+                vv = wm.T @ uv
+                vv = vv / jnp.maximum(jnp.linalg.norm(vv), self.eps)
+                uv = wm @ vv
+                uv = uv / jnp.maximum(jnp.linalg.norm(uv), self.eps)
+            u.set_value(uv)
+            v_buf.set_value(vv)
+        sigma = uv @ wm @ vv
+        object.__setattr__(layer, self.name,
+                           Tensor(w / jnp.maximum(sigma, self.eps)))
+
+    def __call__(self, layer, inputs):
+        self.compute(layer)
+        return inputs
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim=None):
+    """Spectral normalization w / sigma_max(w) with power iteration
+    (reference spectral_norm_hook.spectral_norm)."""
+    w = getattr(layer, name)
+    wv = w.value
+    if dim is None:
+        dim = 1 if type(layer).__name__.startswith(
+            ("Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+             "Linear")) else 0
+    h = wv.shape[dim]
+    wm = jnp.moveaxis(wv, dim, 0).reshape(h, -1)
+    key = jax.random.PRNGKey(0)
+    u0 = jax.random.normal(key, (h,), wv.dtype)
+    u0 = u0 / jnp.maximum(jnp.linalg.norm(u0), eps)
+    v0 = jax.random.normal(jax.random.PRNGKey(1), (wm.shape[1],), wv.dtype)
+    v0 = v0 / jnp.maximum(jnp.linalg.norm(v0), eps)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", Parameter(jnp.asarray(wv)))
+    # u, v are buffers, not parameters
+    setattr(layer, name + "_u", Tensor(u0))
+    setattr(layer, name + "_v", Tensor(v0))
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    layer.register_forward_pre_hook(hook)
+    hook.compute(layer, update_uv=False)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one 1-D tensor (reference
+    transform_parameters.parameters_to_vector)."""
+    vals = [p.value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Inverse of parameters_to_vector — writes slices back in-place."""
+    v = vec.value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(math.prod(p.shape)) if hasattr(math, "prod") else int(
+            jnp.prod(jnp.asarray(p.shape)))
+        p.set_value(v[off:off + n].reshape(p.shape).astype(p.value.dtype))
+        off += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """In-place global-norm gradient clip (reference clip_grad_norm_).
+    Returns the total norm."""
+    params = [parameters] if isinstance(parameters, Parameter) \
+        else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g.value)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.value.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"The total norm of {norm_type} order of the gradients is "
+            "non-finite, so it cannot be clipped")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad.set_value(p.grad.value * scale.astype(
+                p.grad.value.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place elementwise gradient clip (reference clip_grad_value_)."""
+    params = [parameters] if isinstance(parameters, Parameter) \
+        else list(parameters)
+    cv = abs(float(clip_value))
+    for p in params:
+        if p.grad is not None:
+            p.grad.set_value(jnp.clip(p.grad.value, -cv, cv))

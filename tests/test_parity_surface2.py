@@ -206,3 +206,50 @@ class TestHapiCallbackIntegration:
         model.fit(Flat(), batch_size=4, epochs=4, verbose=0,
                   callbacks=[cb])
         assert cb.best is not None and np.isfinite(cb.best)
+
+
+class TestNNUtils:
+    def test_weight_norm_roundtrip(self):
+        lin = nn.Linear(4, 3)
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        nn.utils.weight_norm(lin, dim=0)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        out = np.asarray(lin(x).numpy())
+        ref = np.asarray(x.numpy()) @ w0 + np.asarray(lin.bias.numpy())
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight_g" in names and "weight_v" in names
+        nn.utils.remove_weight_norm(lin)
+        np.testing.assert_allclose(np.asarray(lin.weight.numpy()), w0,
+                                   atol=1e-5)
+
+    def test_spectral_norm_unit_sigma(self):
+        lin = nn.Linear(6, 6)
+        nn.utils.spectral_norm(lin, n_power_iterations=5)
+        lin.train()
+        for _ in range(2):
+            lin(paddle.to_tensor(
+                np.random.randn(2, 6).astype(np.float32)))
+        sig = np.linalg.svd(np.asarray(lin.weight.numpy()),
+                            compute_uv=False)[0]
+        assert 0.8 < sig < 1.2
+
+    def test_vector_roundtrip_and_clip(self):
+        m = nn.Linear(3, 3)
+        before = [np.asarray(p.numpy()).copy() for p in m.parameters()]
+        vec = nn.utils.parameters_to_vector(list(m.parameters()))
+        nn.utils.vector_to_parameters(vec, list(m.parameters()))
+        for b, p in zip(before, m.parameters()):
+            np.testing.assert_allclose(b, np.asarray(p.numpy()))
+        loss = paddle.sum(m(paddle.to_tensor(
+            np.ones((2, 3), np.float32))) ** 2)
+        loss.backward()
+        nn.utils.clip_grad_norm_(list(m.parameters()), max_norm=0.1)
+        g2 = np.sqrt(sum(
+            float(np.sum(np.asarray(p.grad.numpy()) ** 2))
+            for p in m.parameters()))
+        assert g2 <= 0.11
+        nn.utils.clip_grad_value_(list(m.parameters()), 0.01)
+        for p in m.parameters():
+            assert np.abs(np.asarray(p.grad.numpy())).max() <= 0.01 + 1e-7

@@ -23,6 +23,7 @@ MODULES = [
     ("nn/__init__.py", "nn"),
     ("nn/functional/__init__.py", "nn.functional"),
     ("nn/initializer/__init__.py", "nn.initializer"),
+    ("nn/utils/__init__.py", "nn.utils"),
     ("optimizer/__init__.py", "optimizer"),
     ("optimizer/lr.py", "optimizer.lr"),
     ("amp/__init__.py", "amp"),
