@@ -1,10 +1,26 @@
 """Elastic training manager (reference: fleet/elastic/manager.py:124).
 
 The reference registers nodes in etcd, heartbeats, and relaunches with a
-regenerated rank map when membership changes. TPU-native slot: membership
-rides the native TCPStore (no etcd in image); scale events surface as the
-dedicated exit code the launcher's --elastic_level loop honors, and state
-recovery is the sharded-checkpoint restore (distributed/checkpoint).
+regenerated rank map when membership changes (watch loop manager.py:120-124,
+exit code :30). TPU-native slot: membership rides the native TCPStore (no
+etcd in this image) —
+
+- every node heartbeats by bumping the counter ``elastic/hbc/<rank>``
+  (counters, not wall-clock stamps: the native store's GET blocks on a
+  missing key — rendezvous semantics — while ``add(key, 0)`` reads-or-
+  creates without blocking, so the watch loop never wedges on a peer that
+  has not come up yet);
+- ``watch()`` scans peer heartbeat FRESHNESS: a counter that has not moved
+  for ``dead_timeout`` is a dead peer -> RESTART; a bumped ``elastic/join``
+  counter is a scale-up -> RESTART; all ranks done -> COMPLETED;
+- a RESTART surfaces as :data:`ELASTIC_EXIT_CODE`, which the launcher's
+  ``--elastic_level`` loop honors by relaunching every local worker;
+- rank regeneration on relaunch is :func:`rendezvous` — a dense rank is
+  drawn from a per-generation counter, so survivors of a failure are
+  re-admitted with fresh contiguous ranks (the reference rebuilds its rank
+  map the same way on membership change);
+- state recovery is the sharded-checkpoint restore
+  (``distributed/checkpoint``).
 """
 from __future__ import annotations
 
@@ -16,7 +32,8 @@ from typing import Optional
 ELASTIC_EXIT_CODE = 101            # manager.py:30
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
 
-__all__ = ["ElasticManager", "ElasticStatus", "ELASTIC_EXIT_CODE"]
+__all__ = ["ElasticManager", "ElasticStatus", "ELASTIC_EXIT_CODE",
+           "rendezvous"]
 
 
 class ElasticStatus:
@@ -27,34 +44,108 @@ class ElasticStatus:
     EXIT = "exit"
 
 
+def rendezvous(store, generation: int, host: str = "") -> int:
+    """Draw a dense rank for this generation (0-based). After a relaunch the
+    generation bumps and survivors re-draw contiguous ranks — the
+    reference's regenerated rank map on membership change."""
+    rank = store.add(f"elastic/gen/{generation}/next_rank", 1) - 1
+    if host:
+        store.set(f"elastic/gen/{generation}/node/{rank}", host.encode())
+    return rank
+
+
 class ElasticManager:
-    """Heartbeat + membership watch over TCPStore (etcd stand-in)."""
+    """Heartbeat + membership watch over TCPStore (etcd stand-in).
+
+    ``watch()`` is the reference watch-loop body (manager.py:120): it
+    returns HOLD while the world is healthy, RESTART when a peer died or
+    joined, COMPLETED when every rank reported done.
+    """
 
     def __init__(self, args=None, store=None, np: Optional[int] = None,
-                 heartbeat_interval: float = 3.0):
+                 heartbeat_interval: float = 3.0,
+                 dead_timeout: Optional[float] = None,
+                 generation: Optional[int] = None):
         self.np = np or int(os.environ.get("PADDLE_ELASTIC_NP", "1") or 1)
+        # generation-scoped keys: a relaunched world starts from clean
+        # counters instead of inheriting the dead generation's state
+        self.generation = generation if generation is not None else int(
+            os.environ.get("PADDLE_ELASTIC_GENERATION", "0") or 0)
+        self._pre = f"elastic/g{self.generation}"
+
         self.host = os.environ.get("POD_IP", "127.0.0.1")
         self.rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
         self.heartbeat_interval = heartbeat_interval
+        # a peer is dead after missing ~3 beats (manager.py watch cadence)
+        self.dead_timeout = dead_timeout or heartbeat_interval * 3 + 1.0
         self._store = store
         self._stop = threading.Event()
         self._thread = None
         self.enabled = self._store is not None
         self.need_restart = False
+        self._done_marked = False
+        self._registered_at = 0.0
+        # a peer that NEVER heartbeated is only dead after the assembly
+        # grace — slow container starts must not trigger restart loops
+        self.assembly_timeout = self.dead_timeout * 10
+        # rank -> (last seen beat counter, when it last changed)
+        self._beat_seen = {}
 
+    # -- lifecycle ---------------------------------------------------------
     def register(self):
         if not self.enabled:
             return
-        self._store.set(f"elastic/node/{self.rank}", self.host.encode())
-        self._store.add("elastic/alive", 1)
+        self._store.set(f"{self._pre}/node/{self.rank}", self.host.encode())
+        self._beat()
+        self._store.add(f"{self._pre}/join", 1)
+        self._registered_at = time.time()
         self._thread = threading.Thread(target=self._heartbeat, daemon=True)
         self._thread.start()
 
+    def _beat(self):
+        self._store.add(f"{self._pre}/hbc/{self.rank}", 1)
+
     def _heartbeat(self):
         while not self._stop.is_set():
-            self._store.set(f"elastic/hb/{self.rank}",
-                            str(time.time()).encode())
+            try:
+                self._beat()
+            except Exception:
+                pass  # store briefly unreachable: next beat retries
             self._stop.wait(self.heartbeat_interval)
+
+    # -- membership --------------------------------------------------------
+    def _peer_beats(self, r: int) -> Optional[int]:
+        try:
+            return int(self._store.add(f"{self._pre}/hbc/{r}", 0))
+        except Exception:
+            return None
+
+    def dead_peers(self):
+        """Ranks whose heartbeat counter has not moved for dead_timeout.
+        A rank that never heartbeated (counter 0) counts as dead once the
+        local grace period (one dead_timeout after our own registration)
+        has passed."""
+        now = time.time()
+        dead = []
+        for r in range(self.np):
+            if r == self.rank:
+                continue
+            beats = self._peer_beats(r)
+            if beats is None:
+                continue  # store unreachable: no verdict this scan
+            if beats == 0:
+                # never came up: wait out the assembly grace, not the
+                # (much shorter) heartbeat staleness window — a peer whose
+                # container starts late must not cause a restart loop
+                if now - self._registered_at > self.assembly_timeout:
+                    dead.append(r)
+                continue
+            prev = self._beat_seen.get(r)
+            if prev is None or beats != prev[0]:
+                self._beat_seen[r] = (beats, now)
+            elif now - prev[1] > self.dead_timeout:
+                dead.append(r)
+        return dead
 
     def watch(self) -> str:
         """One membership check (the reference's watch loop body :120)."""
@@ -62,13 +153,45 @@ class ElasticManager:
             return ElasticStatus.COMPLETED
         if self.need_restart:
             return ElasticStatus.RESTART
+        try:
+            done = int(self._store.add(f"{self._pre}/done", 0))
+        except Exception:
+            done = 0
+        if done >= self.np:
+            return ElasticStatus.COMPLETED
+        # scale-up: more registrations than the expected world size
+        # (bring-up joins <= np are normal, not a membership change)
+        try:
+            join_now = self._store.add(f"{self._pre}/join", 0)
+        except Exception:
+            join_now = 0
+        if join_now > self.np:
+            self.need_restart = True
+            return ElasticStatus.RESTART
+        if self.dead_peers():
+            self.need_restart = True
+            return ElasticStatus.RESTART
         return ElasticStatus.HOLD
 
     def signal_restart(self):
         self.need_restart = True
 
+    def mark_done(self):
+        """Rank reports clean completion; when all np ranks have, watch()
+        returns COMPLETED everywhere. Idempotent per rank (exit() also
+        calls it — a double bump would let one rank count twice and flip
+        peers to COMPLETED mid-training)."""
+        if self.enabled and not self._done_marked:
+            self._done_marked = True
+            self._store.add(f"{self._pre}/done", 1)
+
     def exit(self, completed: bool = True):
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
+        if completed and self.enabled:
+            try:
+                self.mark_done()
+            except Exception:
+                pass
         return 0 if completed else ELASTIC_EXIT_CODE

@@ -1,0 +1,248 @@
+"""Stage-LOCAL parameter pipeline parallelism (compiled 1F1B).
+
+`pp_compiled.py` passes the full param pytree replicated across the ``pp``
+axis — correct, but every device then holds params+grads for the whole
+model, so PP there scales only activation memory. The reference's
+PipelineLayer instead gives each stage ONLY its own layers
+(meta_parallel/parallel_layers/pp_layers.py:239 — per-stage param
+ownership; SegmentLayers:92): that partitioning is why PP exists at 65B.
+
+This module is the TPU-native equivalent for homogeneous-body pipelines
+(the shape every LLM has): per-layer params are STACKED into leading-dim
+arrays — ``blocks`` with leading dims ``(S, V, lpc, ...)`` where ``S`` =
+pipeline stages, ``V`` = virtual chunks per stage, ``lpc`` = layers per
+chunk — and sharded ``P("pp")`` on dim 0. Under ``shard_map`` (manual over
+``pp`` only) each device materializes exactly its own ``(V, lpc, ...)``
+slice; the 1F1B grad carry is that same local shape, so params, grads AND
+optimizer state are all 1/S per device. Small non-repeating "edge" params
+(embedding, final norm, lm head) ride along replicated; their cotangents
+are psum'd (they are O(vocab·h), not O(L·h²)).
+
+Schedule algebra is identical to ``pp_compiled.build_pipeline_1f1b_grad_fn``
+(see its docstring): virtual stage p = k·S + s, forward micro-step i at
+tick t = i + s, backward j at t = j + L + S − 2 − s, modular ``ppermute``
+rings. Because every branch indexes the LOCAL chunk k = p//S statically,
+device s only ever touches chunks it owns.
+
+TP / DP / ZeRO compose through GSPMD: ``mp``/``dp``/``sharding`` mesh axes
+stay *auto* inside the shard_map, so NamedSharding annotations on the
+feature dims of ``blocks`` (Megatron column/row splits), on the microbatch
+dim of the inputs (dp), and on the optimizer moments (ZeRO placement)
+propagate and XLA inserts the collectives. See
+``models/llama_pp.build_llama_hybrid_step`` for the composed 4-axis step.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from ...topology import get_mesh
+
+__all__ = ["build_sharded_1f1b_grad_fn", "blocks_from_stacked",
+           "stacked_from_blocks"]
+
+
+def blocks_from_stacked(stacked, S: int, V: int = 1):
+    """Rearrange a layer-stacked pytree (leading dim = n_layers, layer order)
+    into pp blocks with leading dims (S, V, lpc): block[s, k] holds the
+    layers of virtual stage p = k·S + s (chunk k of device s), i.e. global
+    layers [p·lpc, (p+1)·lpc).  lpc = n_layers // (S·V)."""
+
+    def go(x):
+        L = x.shape[0]
+        if L % (S * V):
+            raise ValueError(
+                f"{L} layers not divisible into {S} stages x {V} chunks")
+        lpc = L // (S * V)
+        # (p, lpc, ...) with p = k*S + s  ->  index [s, k] = chunk k*S+s
+        y = x.reshape((V, S, lpc) + x.shape[1:])   # [k, s, lpc, ...]
+        return jnp.swapaxes(y, 0, 1)               # [s, k, lpc, ...]
+
+    return jax.tree.map(go, stacked)
+
+
+def stacked_from_blocks(blocks):
+    """Inverse of :func:`blocks_from_stacked` (for checkpoint interop)."""
+
+    def go(x):
+        S, V, lpc = x.shape[:3]
+        y = jnp.swapaxes(x, 0, 1)                  # [k, s, lpc, ...]
+        return y.reshape((S * V * lpc,) + x.shape[3:])
+
+    return jax.tree.map(go, blocks)
+
+
+def build_sharded_1f1b_grad_fn(
+        first_fn: Callable[[Any, Any], Any],
+        body_fn: Callable[[Any, Any], Any],
+        last_fn: Callable[[Any, Any, Any], Any],
+        accumulate_steps: int,
+        mesh: Optional[Mesh] = None,
+        num_virtual_stages: int = 1) -> Callable:
+    """Returns ``grad_fn(blocks, edge, inputs, labels) ->
+    (loss, (block_grads, edge_grads))`` running TRUE 1F1B with stage-local
+    parameters.
+
+    - ``first_fn(edge, x_raw) -> h``: the stage-0 prefix (embedding).
+    - ``body_fn(chunk, h) -> h``: one chunk of ``lpc`` homogeneous layers;
+      ``chunk`` is the pytree slice with leading dim ``lpc``.
+    - ``last_fn(edge, h, labels_mb) -> scalar loss`` (final norm + head +
+      loss), mean over the microbatch.
+    - ``blocks``: pytree, every leaf leading dims ``(S, V, lpc, ...)``; pass
+      it in sharded ``P("pp")`` (dim 0) for stage-local placement.
+    - ``edge``: small replicated pytree consumed by first/last.
+
+    The returned ``block_grads`` keeps the (S, V, lpc, ...) layout sharded
+    over pp — feed it straight to a functional optimizer whose state carries
+    the same sharding and the whole update stays 1/S per device.
+    """
+    mesh = mesh or get_mesh()
+    S = int(mesh.shape.get("pp", 1))
+    M = int(accumulate_steps)
+    V = int(num_virtual_stages)
+    L = S * V
+    NF = M * V
+    G = 2 * S + 4
+
+    if V > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs accumulate_steps ({M}) divisible "
+            f"by the number of stages ({S})")
+
+    if S == 1:
+        # no pp axis: serial chunks with scanned grad accumulation
+        def loss_all(blocks, edge, inputs, labels):
+            mb = inputs.shape[0] // M
+            xs = jnp.reshape(inputs, (M, mb) + inputs.shape[1:])
+            ys = jnp.reshape(labels, (M, mb) + labels.shape[1:])
+
+            def micro(acc, xy):
+                x, y = xy
+                h = first_fn(edge, x)
+                for p in range(L):
+                    h = body_fn(jax.tree.map(lambda b: b[0, p // S], blocks),
+                                h)
+                return acc + last_fn(edge, h, y), None
+
+            tot, _ = lax.scan(micro, jnp.zeros((), jnp.float32), (xs, ys))
+            return tot / M
+
+        vg = jax.value_and_grad(loss_all, argnums=(0, 1))
+        return lambda b, e, i, y: vg(b, e, i, y)
+
+    from ....core.random import default_generator, trace_key_scope
+
+    def grad_fn(blocks, edge, inputs, labels):
+        mb = inputs.shape[0] // M
+        xs = jnp.reshape(inputs, (M, mb) + inputs.shape[1:])
+        ys = jnp.reshape(labels, (M, mb) + labels.shape[1:])
+        # activation aval at a stage boundary (post-embedding shape)
+        h_aval = jax.eval_shape(
+            lambda e, x: first_fn(e, x), edge,
+            jax.ShapeDtypeStruct((mb,) + inputs.shape[1:], inputs.dtype))
+        base_key = default_generator.next_key()
+
+        def worker(blocks, edge, xs, ys):
+            # local view: (1, V, lpc, ...) -> (V, lpc, ...)
+            blocks = jax.tree.map(lambda b: b[0], blocks)
+            s = lax.axis_index("pp")
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+            T = NF + L + S - 2
+
+            def branch(p):
+                k, first, last = p // S, p == 0, p == L - 1
+
+                def go(local, edge, x_raw, h_in, y):
+                    chunk = jax.tree.map(lambda b: b[k], local)
+                    h = body_fn(chunk, first_fn(edge, x_raw) if first
+                                else h_in)
+                    if last:
+                        l = last_fn(edge, h, y)
+                        return (jnp.zeros(h_aval.shape, h_aval.dtype),
+                                l.astype(jnp.float32))
+                    return (h.astype(h_aval.dtype),
+                            jnp.zeros((), jnp.float32))
+
+                return go
+
+            branches = [branch(p) for p in range(L)]
+
+            def tick(carry, t):
+                h_recv, g_recv, stash, bgrads, egrads, lacc = carry
+                # ---- forward ----
+                i = t - s
+                fvalid = jnp.logical_and(i >= 0, i < NF)
+                ic = jnp.clip(i, 0, NF - 1)
+                k = (ic % L) // S
+                p = k * S + s
+                m = (ic // L) * S + ic % S
+                with trace_key_scope(jax.random.fold_in(base_key, m)):
+                    h_out, _ = lax.switch(p, branches, blocks, edge,
+                                          xs[m], h_recv, ys[m])
+                stash = lax.cond(
+                    fvalid,
+                    lambda st: st.at[k, m % G].set(
+                        h_recv.astype(h_aval.dtype)),
+                    lambda st: st, stash)
+
+                # ---- backward ----
+                j = t - (L + S - 2 - s)
+                bvalid = jnp.logical_and(j >= 0, j < NF)
+                jc = jnp.clip(j, 0, NF - 1)
+                kb = V - 1 - (jc % L) // S
+                pb = kb * S + s
+                m_b = (jc // L) * S + jc % S
+                x_b = stash[kb, m_b % G]
+
+                def f(local, edge, h_in):
+                    with trace_key_scope(jax.random.fold_in(base_key, m_b)):
+                        return lax.switch(pb, branches, local, edge,
+                                          xs[m_b], h_in, ys[m_b])
+
+                (_, l_b), vjp = jax.vjp(f, blocks, edge, x_b)
+                bmask = bvalid.astype(jnp.float32)
+                seed = (g_recv * bmask.astype(h_aval.dtype), bmask)
+                gl, ge, gx = vjp(seed)
+                bgrads = jax.tree.map(jnp.add, bgrads, gl)
+                egrads = jax.tree.map(jnp.add, egrads, ge)
+                lacc = lacc + jnp.where(bvalid, l_b, 0.0)
+
+                h_next = lax.ppermute(h_out, "pp", fwd_perm)
+                g_next = lax.ppermute(gx, "pp", bwd_perm)
+                return (h_next, g_next, stash, bgrads, egrads, lacc), None
+
+            carry0 = (
+                jnp.zeros(h_aval.shape, h_aval.dtype),
+                jnp.zeros(h_aval.shape, h_aval.dtype),
+                jnp.zeros((V, G) + h_aval.shape, h_aval.dtype),
+                jax.tree.map(lambda b: jnp.zeros(b.shape, b.dtype), blocks),
+                jax.tree.map(lambda e: jnp.zeros(e.shape, e.dtype), edge),
+                jnp.zeros((), jnp.float32),
+            )
+            (_, _, _, bgrads, egrads, lacc), _ = lax.scan(
+                tick, carry0, jnp.arange(T))
+            # block grads are STAGE-LOCAL: just restore the sharded leading
+            # dim — no cross-stage psum (this is the memory win)
+            bgrads = jax.tree.map(lambda g: g[None] / M, bgrads)
+            # edge grads & loss are replicated-contract: psum assembles
+            egrads = jax.tree.map(lambda g: lax.psum(g, "pp") / M, egrads)
+            return lax.psum(lacc, "pp") / M, bgrads, egrads
+
+        from jax import shard_map
+
+        fn = shard_map(
+            worker, mesh=mesh,
+            in_specs=(P("pp"), P(), P(), P()),
+            out_specs=(P(), P("pp"), P()),
+            axis_names={"pp"},
+            check_vma=False)
+        loss, bgrads, egrads = fn(blocks, edge, xs, ys)
+        return loss, (bgrads, egrads)
+
+    return grad_fn

@@ -49,13 +49,16 @@ def _parse(argv):
     return p.parse_args(argv)
 
 
-def _child_env(args, local_rank: int) -> dict:
+def _child_env(args, local_rank: int, generation: int = 0) -> dict:
     env = dict(os.environ)
     world = args.nnodes * args.nproc_per_node
     rank = args.node_rank * args.nproc_per_node + local_rank
     master = args.master or "127.0.0.1:0"
     host, _, port = master.partition(":")
     env.update({
+        # restart generation: ElasticManager scopes its store keys by this
+        # so a relaunched world starts from clean membership counters
+        "PADDLE_ELASTIC_GENERATION": str(generation),
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_LOCAL_RANK": str(local_rank),
         "PADDLE_TRAINERS_NUM": str(world),
@@ -84,7 +87,8 @@ def launch(argv: Optional[List[str]] = None) -> int:
             logs.append(log)
             cmd = [sys.executable, args.script] + args.script_args
             procs.append(subprocess.Popen(
-                cmd, env=_child_env(args, lr), stdout=log, stderr=log))
+                cmd, env=_child_env(args, lr, generation=restarts),
+                stdout=log, stderr=log))
 
         # watch loop (≙ CollectiveController.run :268)
         fail_code = 0

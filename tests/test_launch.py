@@ -104,8 +104,10 @@ class TestElastic:
         import time
 
         time.sleep(0.15)
-        assert store.get("elastic/node/0") == b"127.0.0.1"
-        assert float(store.get("elastic/hb/0")) > 0
+        assert store.get("elastic/g0/node/0") == b"127.0.0.1"
+        # heartbeat is a counter bump (native GET blocks on missing keys,
+        # so freshness rides add(key, 0) reads)
+        assert store.add("elastic/g0/hbc/0", 0) > 0
         assert m.watch() == ElasticStatus.HOLD
         m.signal_restart()
         assert m.watch() == ElasticStatus.RESTART

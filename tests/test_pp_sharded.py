@@ -1,0 +1,205 @@
+"""Stage-local compiled PP tests.
+
+Contract (VERDICT r2 #3): params+grads+opt-state must be per-device 1/S of
+the replicated path — the reason PP exists at 65B (reference per-stage param
+ownership: meta_parallel/parallel_layers/pp_layers.py:239) — while the 1F1B
+numerics stay identical to the serial model.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.distributed.fleet.meta_parallel.pp_sharded import (
+    blocks_from_stacked, build_sharded_1f1b_grad_fn, stacked_from_blocks)
+from paddle_tpu.distributed.topology import build_mesh, set_mesh
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.models.llama_functional import build_loss_fn, stack_params
+from paddle_tpu.models.llama_pp import (build_llama_hybrid_step,
+                                        llama_pp_fns)
+
+
+def tiny_cfg(layers=8):
+    return LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=layers, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64)
+
+
+def make_params(cfg, seed=0):
+    from paddle_tpu.models import LlamaForCausalLM
+
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    params = {k: p.value for k, p in model.named_parameters()}
+    return stack_params(params, cfg)
+
+
+def batch(cfg, b=8, s=16, seed=1):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32)
+    y = rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32)
+    return ids, y
+
+
+class TestBlockLayout:
+    def test_roundtrip(self):
+        x = {"w": jnp.arange(8 * 3 * 5, dtype=jnp.float32).reshape(8, 3, 5)}
+        for S, V in [(4, 1), (2, 2), (8, 1), (1, 1)]:
+            b = blocks_from_stacked(x, S, V)
+            assert b["w"].shape[:3] == (S, V, 8 // (S * V))
+            np.testing.assert_array_equal(stacked_from_blocks(b)["w"], x["w"])
+
+    def test_chunk_placement(self):
+        # block[s, k] must hold virtual stage p = k*S + s == layers
+        # [p*lpc, (p+1)*lpc)
+        x = {"w": jnp.arange(8, dtype=jnp.float32)}
+        b = blocks_from_stacked(x, 2, 2)["w"]  # lpc = 2
+        for s in range(2):
+            for k in range(2):
+                p = k * 2 + s
+                np.testing.assert_array_equal(b[s, k], [2 * p, 2 * p + 1])
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            blocks_from_stacked({"w": jnp.zeros((6, 2))}, 4, 1)
+
+
+class TestShardedParity:
+    """pp=4 stage-local 1F1B == serial llama loss AND grads."""
+
+    def setup_method(self):
+        self.mesh = build_mesh(pp=4, dp=2)
+        set_mesh(self.mesh)
+
+    def _parity(self, S, V, mesh):
+        cfg = tiny_cfg(8)
+        stacked, rest = make_params(cfg)
+        ids, y = batch(cfg)
+        ref = jax.value_and_grad(
+            lambda p: build_loss_fn(cfg, remat=False)(
+                p["s"], p["r"], ids, y))({"s": stacked, "r": rest})
+        first, body, last = llama_pp_fns(cfg, remat=False)
+        gf = build_sharded_1f1b_grad_fn(first, body, last,
+                                        accumulate_steps=4, mesh=mesh,
+                                        num_virtual_stages=V)
+        blocks = blocks_from_stacked(stacked, S, V)
+        blocks = {k: jax.device_put(v, NamedSharding(mesh, P("pp")))
+                  for k, v in blocks.items()}
+        loss, (gb, ge) = jax.jit(gf)(blocks, rest, ids, y)
+        ref_loss, ref_g = ref
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=2e-4, atol=2e-5)
+        got = stacked_from_blocks(gb)
+        for k in stacked:
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(ref_g["s"][k]),
+                                       rtol=2e-3, atol=2e-4, err_msg=k)
+        for k in rest:
+            np.testing.assert_allclose(np.asarray(ge[k]),
+                                       np.asarray(ref_g["r"][k]),
+                                       rtol=2e-3, atol=2e-4, err_msg=k)
+
+    def test_pp4_parity(self):
+        self._parity(4, 1, self.mesh)
+
+    def test_pp2_interleaved_v2_parity(self):
+        mesh = build_mesh(pp=2, dp=4)
+        self._parity(2, 2, mesh)
+
+    def test_serial_s1_matches(self):
+        cfg = tiny_cfg(4)
+        stacked, rest = make_params(cfg)
+        ids, y = batch(cfg, b=4)
+        mesh = build_mesh(dp=8)
+        first, body, last = llama_pp_fns(cfg, remat=False)
+        gf = build_sharded_1f1b_grad_fn(first, body, last,
+                                        accumulate_steps=2, mesh=mesh)
+        blocks = blocks_from_stacked(stacked, 1, 1)
+        loss, _ = gf(blocks, rest, ids, y)
+        ref = build_loss_fn(cfg, remat=False)(stacked, rest, ids, y)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=2e-4,
+                                   atol=2e-5)
+
+
+class TestStageLocalMemory:
+    """The memory contract: per-device param/grad bytes scale as 1/S."""
+
+    def _compiled(self, S, layers=8):
+        cfg = tiny_cfg(layers)
+        # widen so body params dominate activations
+        cfg.hidden_size, cfg.intermediate_size = 64, 256
+        stacked, rest = make_params(cfg)
+        mesh = build_mesh(pp=S, dp=8 // S)
+        first, body, last = llama_pp_fns(cfg, remat=False)
+        gf = build_sharded_1f1b_grad_fn(first, body, last,
+                                        accumulate_steps=4, mesh=mesh)
+        blocks = blocks_from_stacked(stacked, S, 1)
+        blocks = {k: jax.device_put(v, NamedSharding(mesh, P("pp")))
+                  for k, v in blocks.items()}
+        ids, y = batch(cfg, b=4, s=8)
+        c = jax.jit(gf).lower(blocks, rest, ids, y).compile()
+        return c, blocks
+
+    def test_block_args_sharded_over_pp(self):
+        c, blocks = self._compiled(4)
+        # every block input sharding splits dim 0 four ways -> per-device
+        # argument bytes for the body are exactly 1/4 of the global
+        in_sh = c.input_shardings[0]
+        n_pp_sharded = 0
+        for s in jax.tree.leaves(in_sh, is_leaf=lambda x: hasattr(x, "spec")):
+            spec = getattr(s, "spec", None)
+            if spec and len(spec) and spec[0] == "pp":
+                n_pp_sharded += 1
+        assert n_pp_sharded >= len(blocks), (n_pp_sharded, len(blocks))
+
+    def test_temp_memory_scales_with_stages(self):
+        """Grad accumulation (the dominant temp at big-param/small-act
+        shapes) must be stage-local: pp=4 temp ≲ pp=2 temp · 0.7."""
+        c2, _ = self._compiled(2)
+        c4, _ = self._compiled(4)
+        t2 = c2.memory_analysis().temp_size_in_bytes
+        t4 = c4.memory_analysis().temp_size_in_bytes
+        assert t4 < t2 * 0.7, (t4, t2)
+
+
+class TestHybridStep:
+    """Composed dp x mp x pp x sharding step (BASELINE config 3 shape)."""
+
+    def _run(self, dp, pp, mp, sharding, V=1, params=None):
+        cfg = tiny_cfg(8)
+        mesh = build_mesh(dp=dp, pp=pp, mp=mp, sharding=sharding)
+        set_mesh(mesh)
+        stacked, rest = params if params else make_params(cfg)
+        ids, y = batch(cfg)
+        step, prepare = build_llama_hybrid_step(
+            cfg, mesh, accumulate_steps=4, num_virtual_stages=V,
+            lr=1e-2, remat=False)
+        blocks, edge, st = prepare(stacked, rest)
+        b, e, st, l0 = step(blocks, edge, st, ids, y)
+        for _ in range(3):
+            b, e, st, l = step(b, e, st, ids, y)
+        assert float(l) < float(l0), (float(l), float(l0))
+        return float(l0)
+
+    def test_2x2x2x1(self):
+        cfg = tiny_cfg(8)
+        stacked, rest = make_params(cfg)
+        ids, y = batch(cfg)
+        # ref BEFORE the hybrid step: step donates its buffers and
+        # prepare()'s device_put may alias the originals
+        ref = float(build_loss_fn(cfg, remat=False)(stacked, rest, ids, y))
+        l_a = self._run(dp=2, pp=2, mp=2, sharding=1,
+                        params=(stacked, rest))
+        # loss at step0 must agree with the serial model (parity across
+        # composition modes, reference fleet/model.py:134-170)
+        np.testing.assert_allclose(l_a, ref, rtol=5e-3, atol=5e-4)
+
+    def test_1x2x2x2(self):
+        self._run(dp=1, pp=2, mp=2, sharding=2)
+
+    def test_interleaved_2x2_v2(self):
+        self._run(dp=2, pp=2, mp=1, sharding=2, V=2)
