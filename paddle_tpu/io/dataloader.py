@@ -1,18 +1,24 @@
 """DataLoader (python/paddle/io/dataloader/dataloader_iter.py parity).
 
-The reference forks multiprocess workers feeding shared-memory tensors into a
-C++ blocking queue (_DataLoaderIterMultiProcess, dataloader_iter.py:358).
-TPU-native design: the input pipeline's job is to keep the host→HBM transfer
-ahead of the step; workers here are a process pool (true parallel decode for
-numpy-producing datasets) with a bounded prefetch queue, and batches stay as
-stacked numpy arrays — jit boundaries do the single host→device transfer.
+The reference forks multiprocess workers feeding shared-memory tensors into
+a C++ blocking queue (_DataLoaderIterMultiProcess dataloader_iter.py:358,
+_worker_loop :451, out-of-order reorder :700). TPU-native design: the input
+pipeline's job is to keep the host→HBM transfer ahead of the step. With
+``num_workers>0`` samples are produced by FORKED WORKER PROCESSES — Python-
+heavy transforms (the vision pipeline) run truly in parallel, not
+GIL-serialized — results ride a pickle-over-pipe queue and are re-ordered
+by batch index in the parent. ``use_shared_memory=False`` falls back to the
+thread pool (fine for numpy-decode datasets that release the GIL; also the
+path for unpicklable datasets). Batches stay as stacked numpy arrays —
+the jit boundary does the single host→device transfer.
 """
 from __future__ import annotations
 
 import itertools
+import multiprocessing as mp
 import queue
 import threading
-from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -63,6 +69,84 @@ def _fetch(dataset, indices, collate_fn):
     return collate_fn([dataset[i] for i in indices])
 
 
+def _np_collate(batch: List[Any]):
+    """default_collate in numpy form — what worker PROCESSES produce.
+    Device arrays must not be created in forked children (each would boot
+    its own backend); the parent wraps the numpy tree into Tensors."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        for s in batch:
+            v = s.value
+            devs = getattr(v, "devices", None)
+            if devs and any(d.platform != "cpu" for d in devs()):
+                raise RuntimeError(
+                    "DataLoader worker process received an accelerator-"
+                    "backed Tensor from __getitem__; device transfers "
+                    "inside forked workers hang. Return numpy arrays from "
+                    "the dataset, or use use_shared_memory=False (thread "
+                    "workers).")
+        return np.stack([np.asarray(s.value) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.floating, np.integer)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: _np_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(_np_collate(list(f)) for f in transposed)
+    raise TypeError(f"batch data cannot be a {type(sample)}")
+
+
+def _tensorize(tree):
+    """Wrap a numpy collate tree into Tensors (parent-side, zero-copy)."""
+    if isinstance(tree, np.ndarray):
+        return Tensor(tree)
+    if isinstance(tree, dict):
+        return {k: _tensorize(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)) and tree and not isinstance(
+            tree[0], (str, bytes)):
+        return type(tree)(_tensorize(v) for v in tree)
+    return tree
+
+
+def _worker_loop(dataset, index_q, result_q, collate_fn, init_fn, wid,
+                 num_workers):
+    """Reference _worker_loop (dataloader_iter.py:451): pull index batches,
+    fetch + collate, push pre-pickled (batch_idx, result) — errors travel
+    as strings. Results are serialized HERE (mp.Queue pickles in a feeder
+    thread, where an unpicklable result would be silently dropped and the
+    parent would wait forever; pickling in the try block turns that into a
+    propagated error instead)."""
+    import pickle
+    import traceback
+
+    def _err(bidx, e):
+        result_q.put(pickle.dumps(
+            (bidx, None, f"{type(e).__name__}: {e}\n"
+                         f"{traceback.format_exc()}")))
+
+    _worker_info.info = WorkerInfo(wid, num_workers, dataset)
+    try:
+        if init_fn:
+            init_fn(wid)
+    except Exception as e:  # a failed init must not go unnoticed: wrong
+        _err(None, e)       # seeding/shard would silently corrupt training
+        return
+    while True:
+        item = index_q.get()
+        if item is None:
+            break
+        bidx, indices = item
+        try:
+            result_q.put(pickle.dumps(
+                (bidx, _fetch(dataset, indices, collate_fn), None)))
+        except Exception as e:  # propagate to parent, keep worker alive
+            _err(bidx, e)
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -72,6 +156,7 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.num_workers = max(0, int(num_workers))
+        self.use_shared_memory = bool(use_shared_memory)
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
@@ -108,6 +193,8 @@ class DataLoader:
                     for i in range(len(self.dataset)))
         if self.num_workers == 0:
             return self._iter_single()
+        if self.use_shared_memory:
+            return self._iter_processes()
         return self._iter_workers()
 
     def _iter_single(self):
@@ -159,6 +246,98 @@ class DataLoader:
         finally:
             stop.set()
             pool.shutdown(wait=False, cancel_futures=True)
+
+    def _iter_processes(self):
+        """Forked worker processes + ordered delivery (the reference
+        multiprocess path, dataloader_iter.py:358). Index batches fan out
+        over one shared queue; results come back (batch_idx, data, err) and
+        a reorder buffer restores sampler order (reference :700)."""
+        ctx = mp.get_context("fork")
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        # default collate runs in numpy form inside workers; custom
+        # collate_fns run as-is (reference semantics — user's code runs in
+        # the worker process)
+        wl_collate = (_np_collate if self.collate_fn is default_collate_fn
+                      else self.collate_fn)
+        workers = [
+            ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, index_q, result_q, wl_collate,
+                      self.worker_init_fn, i, self.num_workers),
+                daemon=True)
+            for i in range(self.num_workers)
+        ]
+        for w in workers:
+            w.start()
+        max_inflight = self.num_workers * self.prefetch_factor
+        indices_iter = enumerate(iter(self.batch_sampler))
+        sent = 0
+        done_sending = False
+
+        def send_one():
+            nonlocal sent, done_sending
+            try:
+                bidx, indices = next(indices_iter)
+            except StopIteration:
+                done_sending = True
+                return
+            index_q.put((bidx, list(indices)))
+            sent += 1
+
+        try:
+            for _ in range(max_inflight):
+                if done_sending:
+                    break
+                send_one()
+            reorder = {}
+            nxt = 0
+            while nxt < sent or not done_sending:
+                if nxt in reorder:
+                    data, err = reorder.pop(nxt)
+                else:
+                    try:
+                        import pickle
+
+                        bidx, data, err = pickle.loads(result_q.get(
+                            timeout=self.timeout or 5.0))
+                    except queue.Empty:
+                        if self.timeout:
+                            raise RuntimeError(
+                                f"DataLoader worker timed out after "
+                                f"{self.timeout}s (batch {nxt})")
+                        # no user timeout: periodic liveness poll — a
+                        # worker killed mid-batch would deadlock the get
+                        if not all(w.is_alive() for w in workers):
+                            raise RuntimeError(
+                                "DataLoader worker died unexpectedly "
+                                "(killed / segfault); restart the loader")
+                        continue
+                    if bidx is None:   # worker_init_fn failure
+                        raise RuntimeError(
+                            f"DataLoader worker_init_fn raised:\n{err}")
+                    if bidx != nxt:
+                        reorder[bidx] = (data, err)
+                        continue
+                if err is not None:
+                    raise RuntimeError(
+                        f"DataLoader worker raised:\n{err}")
+                if not done_sending:
+                    send_one()
+                if wl_collate is _np_collate:
+                    data = _tensorize(data)
+                yield data
+                nxt += 1
+        finally:
+            for _ in workers:
+                index_q.put(None)
+            for w in workers:
+                w.join(timeout=2)
+                if w.is_alive():
+                    w.terminate()
+            for q_ in (index_q, result_q):
+                q_.cancel_join_thread()
+                q_.close()
 
     def __call__(self):
         return self.__iter__()

@@ -1,0 +1,478 @@
+"""Dy2static control-flow conversion (reference: jit/dy2static/ —
+program_translator.py:305 + ifelse_transformer.py / loop_transformer.py /
+logical_transformer.py among its ~20 AST transformers).
+
+The reference rewrites imperative Python into ProgramDesc control-flow ops
+(cond / while). The TPU-native target is XLA: tensor-predicate ``if`` /
+``while`` must become ``lax.cond`` / ``lax.while_loop`` or jit tracing
+either fails or silently specializes on the traced branch. This module is
+the same architecture at 1/30 the code because JAX traces natively and only
+CONTROL FLOW needs source rewriting:
+
+- :func:`convert_to_static` parses the function source, rewrites
+
+  * ``if <pred>: A else: B``      -> ``convert_ifelse(pred, tfn, ffn, vars)``
+  * ``while <pred>: BODY``        -> ``convert_while(cond_fn, body_fn, vars)``
+  * ``a and b`` / ``a or b``      -> lazy ``convert_logical_and/or``
+  * ``not a``                     -> ``convert_logical_not``
+
+  using autograph-style nested functions whose arguments/returns are the
+  branch-assigned variables (no nonlocal mutation under trace).
+- The runtime converters dispatch on the predicate: a concrete Python/numpy
+  bool keeps plain Python semantics (zero overhead, branches may diverge in
+  structure); a traced value lowers to ``lax.cond``/``lax.while_loop``.
+- Patterns that cannot lower (``break``/``continue``/``return`` inside a
+  tensor-predicate loop) are left as Python and surface as a LOUD error
+  naming the function and the rewrite (:func:`control_flow_guidance`).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert_to_static", "convert_ifelse", "convert_while",
+           "convert_logical_and", "convert_logical_or",
+           "convert_logical_not", "control_flow_guidance"]
+
+
+# --------------------------------------------------------------------------
+# runtime converters
+# --------------------------------------------------------------------------
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_dynamic(pred) -> bool:
+    """True when the predicate is a traced value (jit trace time) — the
+    only case that must lower to lax control flow."""
+    return isinstance(_raw(pred), jax.core.Tracer)
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   args: Tuple = ()):
+    """``if``/``else`` with branch-assigned vars passed through ``args``
+    and returned as a tuple. Traced predicate -> ``lax.cond`` (both
+    branches traced, structures must match); concrete -> plain call."""
+    if not _is_dynamic(pred):
+        return true_fn(*args) if bool(_raw(pred)) else false_fn(*args)
+    from jax import lax
+
+    try:
+        return lax.cond(jnp.asarray(_raw(pred)).astype(bool).reshape(()),
+                        true_fn, false_fn, *args)
+    except TypeError as e:
+        raise TypeError(
+            f"to_static: the two branches of a tensor-predicate `if` must "
+            f"produce matching shapes/dtypes for every assigned variable "
+            f"(lax.cond contract). {control_flow_guidance()}") from e
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable, init: Tuple):
+    """``while`` with loop-carried vars. Traced condition ->
+    ``lax.while_loop`` (body must keep shapes/dtypes); concrete -> plain
+    Python loop (which may itself go dynamic mid-loop — re-checked every
+    iteration)."""
+    if not _is_dynamic(cond_fn(*init)):
+        vars_ = tuple(init)
+        while bool(_raw(cond_fn(*vars_))):
+            vars_ = tuple(body_fn(*vars_))
+            if _is_dynamic(cond_fn(*vars_)):
+                break
+        else:
+            return vars_
+        # condition became traced mid-loop: finish with lax
+    from jax import lax
+
+    try:
+        return lax.while_loop(
+            lambda vs: jnp.asarray(
+                _raw(cond_fn(*vs))).astype(bool).reshape(()),
+            lambda vs: tuple(body_fn(*vs)), tuple(init))
+    except TypeError as e:
+        raise TypeError(
+            f"to_static: a tensor-predicate `while` body must keep every "
+            f"loop variable's shape and dtype fixed (lax.while_loop "
+            f"contract). {control_flow_guidance()}") from e
+
+
+def convert_logical_and(lhs_fn: Callable, rhs_fn: Callable):
+    l = lhs_fn()
+    if not _is_dynamic(l):
+        return l if not bool(_raw(l)) else rhs_fn()
+    return jnp.logical_and(jnp.asarray(_raw(l)).astype(bool),
+                           jnp.asarray(_raw(rhs_fn())).astype(bool))
+
+
+def convert_logical_or(lhs_fn: Callable, rhs_fn: Callable):
+    l = lhs_fn()
+    if not _is_dynamic(l):
+        return l if bool(_raw(l)) else rhs_fn()
+    return jnp.logical_or(jnp.asarray(_raw(l)).astype(bool),
+                          jnp.asarray(_raw(rhs_fn())).astype(bool))
+
+
+def convert_logical_not(x):
+    if not _is_dynamic(x):
+        return not bool(_raw(x))
+    return jnp.logical_not(jnp.asarray(_raw(x)).astype(bool))
+
+
+def control_flow_guidance() -> str:
+    return (
+        "Supported rewrites: (1) keep the `if`/`while` free of "
+        "break/continue/return so dy2static can lower it to "
+        "lax.cond/lax.while_loop; (2) use jnp.where / paddle.where for "
+        "per-element selection; (3) hoist the data-dependent decision out "
+        "of the jitted function; (4) mark the function @not_to_static to "
+        "run it eagerly.")
+
+
+# --------------------------------------------------------------------------
+# AST transformation
+# --------------------------------------------------------------------------
+
+_RT = "_paddle_jst"          # runtime module alias injected into globals
+
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by a statement list (stopping at nested scopes)."""
+
+    def __init__(self):
+        self.names: List[str] = []
+
+    def _add(self, name):
+        if name not in self.names:
+            self.names.append(name)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self._add(node.name)      # binds the name; don't descend
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_ListComp(self, node):  # comprehensions have their own scope
+        pass
+
+    visit_SetComp = visit_DictComp = visit_GeneratorExp = visit_ListComp
+
+
+def _assigned(stmts) -> List[str]:
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _HasEscape(ast.NodeVisitor):
+    """break/continue/return/yield at this control-flow level (not inside a
+    nested loop for break/continue, never inside a nested function)."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Yield(self, node):
+        self.found = True
+
+    visit_YieldFrom = visit_Yield
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_While(self, node):      # nested loop owns its break/continue
+        for s in node.body + node.orelse:
+            _ret = _ReturnOnly()
+            _ret.visit(s)
+            self.found = self.found or _ret.found
+
+    visit_For = visit_While
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+
+class _ReturnOnly(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+
+def _has_escape(stmts) -> bool:
+    v = _HasEscape()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _uses_global_nonlocal(stmts) -> bool:
+    for s in stmts:
+        for n in ast.walk(s):
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                return True
+    return False
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Statement-level rewrite with a sequential maybe-bound name table (a
+    name assigned anywhere earlier in document order counts as bound — the
+    autograph approximation; truly-unbound names fail at the call site the
+    same way they would have in the original code)."""
+
+    def __init__(self):
+        self._uid = 0
+        self.bound: List[str] = []
+        self.changed = False
+
+    def _fresh(self, kind):
+        self._uid += 1
+        return f"__pt_{kind}_{self._uid}"
+
+    def _bind(self, names):
+        for n in names:
+            if n not in self.bound:
+                self.bound.append(n)
+
+    # -- scope roots -------------------------------------------------------
+    def visit_FunctionDef(self, node, _outer=True):
+        args = node.args
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        self._bind(names)
+        node.body = self._visit_block(node.body)
+        return node
+
+    def _visit_block(self, stmts):
+        out = []
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                # nested scopes are left untouched: transforming them with
+                # the OUTER maybe-bound table could turn a valid closure
+                # read into an unbound argument
+                self._bind([s.name])
+                out.append(s)
+                continue
+            r = self.visit(s)
+            self._bind(_assigned([s]))
+            if isinstance(r, list):
+                out.extend(r)
+            elif r is not None:
+                out.append(r)
+        return out
+
+    # -- expression rewrites ----------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[-1]
+        for prev in reversed(node.values[:-1]):
+            expr = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_RT, ctx=ast.Load()), attr=fn,
+                    ctx=ast.Load()),
+                args=[ast.Lambda(args=_empty_args(), body=prev),
+                      ast.Lambda(args=_empty_args(), body=expr)],
+                keywords=[])
+        self.changed = True
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            self.changed = True
+            return ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_RT, ctx=ast.Load()),
+                    attr="convert_logical_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+    # -- statement rewrites -----------------------------------------------
+    def visit_If(self, node):
+        node.test = self.visit(node.test)
+        if (_has_escape(node.body) or _has_escape(node.orelse)
+                or _uses_global_nonlocal(node.body + node.orelse)):
+            # unconvertible: leave as Python (concrete predicates still
+            # work; traced ones get the loud guidance error from jit)
+            node.body = self._visit_block(node.body)
+            node.orelse = self._visit_block(node.orelse)
+            return node
+        bound_before = list(self.bound)   # snapshot: names live BEFORE the
+        body = self._visit_block(list(node.body))     # if, not branch-born
+        orelse = self._visit_block(list(node.orelse))
+        outs = _assigned(node.body + node.orelse)
+        passed = [n for n in outs if n in bound_before]
+        tname, fname = self._fresh("true"), self._fresh("false")
+        tdef = _make_branch_fn(tname, passed, body, outs)
+        fdef = _make_branch_fn(fname, passed, orelse, outs)
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                               attr="convert_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in passed], ctx=ast.Load())],
+            keywords=[])
+        assign = _tuple_assign(outs, call)
+        self._bind(outs)
+        self.changed = True
+        return [tdef, fdef, assign]
+
+    def visit_While(self, node):
+        node.test = self.visit(node.test)
+        if (node.orelse or _has_escape(node.body)
+                or _uses_global_nonlocal(node.body)):
+            node.body = self._visit_block(node.body)
+            node.orelse = self._visit_block(node.orelse)
+            return node
+        bound_before = list(self.bound)
+        body = self._visit_block(list(node.body))
+        assigned = _assigned(node.body)
+        # loop-carried = assigned in body AND bound before the loop; names
+        # born inside the body stay internal to the body function
+        carried = [n for n in assigned if n in bound_before]
+        if not carried:
+            # nothing carried: a tensor predicate would never progress;
+            # leave as Python (concrete predicates work unchanged)
+            node.body = body
+            return node
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        cdef = _make_branch_fn(cname, carried, [], [], ret_expr=node.test)
+        bdef = _make_branch_fn(bname, carried, body, carried)
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                               attr="convert_while", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in carried], ctx=ast.Load())],
+            keywords=[])
+        assign = _tuple_assign(carried, call)
+        self._bind(assigned)
+        self.changed = True
+        return [cdef, bdef, assign]
+
+    def visit_FunctionDef_nested(self, node):
+        return node
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _make_branch_fn(name: str, params: List[str], body: List[ast.stmt],
+                    outs: List[str], ret_expr: Optional[ast.expr] = None):
+    """def name(p1, ..., pN): BODY; return (o1, ..., oM)"""
+    ret_val = (ret_expr if ret_expr is not None else
+               ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                               for n in outs], ctx=ast.Load()))
+    fn_body = list(body) + [ast.Return(value=ret_val)]
+    args = ast.arguments(
+        posonlyargs=[],
+        args=[ast.arg(arg=p) for p in params],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+    return ast.FunctionDef(name=name, args=args, body=fn_body,
+                           decorator_list=[], returns=None,
+                           type_params=[])
+
+
+def _tuple_assign(names: List[str], value: ast.expr):
+    # always a tuple target — the converters return tuples even for one var
+    tgt = ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store())
+                          for n in names], ctx=ast.Store())
+    return ast.Assign(targets=[tgt], value=value)
+
+
+# --------------------------------------------------------------------------
+# entry point
+# --------------------------------------------------------------------------
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Source-rewrite ``fn``'s control flow for jit tracing. Returns the
+    transformed function, or ``fn`` unchanged when transformation is not
+    possible (no source, closures, parse failure) — tracing then relies on
+    the loud-error path for tensor predicates."""
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    inner = getattr(fn, "__func__", fn)       # unwrap bound methods
+    if getattr(inner, "__closure__", None):
+        return fn                             # cells can't be rebuilt
+    try:
+        src = textwrap.dedent(inspect.getsource(inner))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []                  # strip @to_static etc.
+    tr = _ControlFlowTransformer()
+    try:
+        tr.visit_FunctionDef(fdef)
+    except Exception:
+        return fn
+    if not tr.changed:
+        return fn
+    ast.fix_missing_locations(tree)
+    import linecache
+    import sys
+
+    ns: Dict[str, Any] = dict(inner.__globals__)
+    ns[_RT] = sys.modules[__name__]
+    filename = f"<dy2static {inner.__name__}>"
+    try:
+        new_src = ast.unparse(tree)
+        code = compile(tree, filename=filename, mode="exec")
+        exec(code, ns)
+    except Exception:
+        return fn
+    new_fn = ns[fdef.name]
+    functools.update_wrapper(new_fn, inner)
+    new_fn.__wrapped_original__ = fn
+    new_fn.__dy2static_source__ = new_src
+    # tracebacks and inspect.getsource resolve through linecache
+    linecache.cache[filename] = (
+        len(new_src), None, [l + "\n" for l in new_src.splitlines()],
+        filename)
+    if hasattr(fn, "__self__"):               # rebind methods
+        import types
+
+        return types.MethodType(new_fn, fn.__self__)
+    return new_fn

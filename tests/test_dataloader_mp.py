@@ -1,0 +1,129 @@
+"""Multiprocess DataLoader tests (VERDICT r2 #7).
+
+Reference contract (_DataLoaderIterMultiProcess dataloader_iter.py:358):
+worker PROCESSES fetch+collate in parallel, results return in sampler
+order, worker exceptions propagate, and Python-heavy (GIL-bound)
+transforms actually speed up — the thread pool cannot deliver that.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class RangeDs(Dataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((4,), i, dtype=np.float32), np.int64(i)
+
+
+class GilBoundDs(Dataset):
+    """Pure-python per-item work: holds the GIL the whole time."""
+
+    def __init__(self, n=24, iters=1_200_000):
+        self.n, self.iters = n, iters
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0
+        for j in range(self.iters):
+            acc += j & 7
+        return np.float32(acc + i)
+
+
+class BadDs(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("poison item")
+        return np.float32(i)
+
+
+class PidDs(Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return np.int64(os.getpid())
+
+
+class TestProcessWorkers:
+    def test_ordered_and_complete(self):
+        loader = DataLoader(RangeDs(64), batch_size=8, num_workers=4)
+        seen = []
+        for xb, yb in loader:
+            assert xb.shape == [8, 4]
+            seen.extend(np.asarray(yb.value).tolist())
+        assert seen == list(range(64))
+
+    def test_really_multiple_processes(self):
+        loader = DataLoader(PidDs(), batch_size=2, num_workers=4)
+        pids = set()
+        for b in loader:
+            pids.update(np.asarray(b.value).tolist())
+        assert os.getpid() not in pids, "work ran in the parent"
+        assert len(pids) >= 2, pids
+
+    def test_worker_exception_propagates(self):
+        loader = DataLoader(BadDs(), batch_size=4, num_workers=2)
+        with pytest.raises(RuntimeError, match="poison item"):
+            list(loader)
+
+    def test_thread_fallback_flag(self):
+        loader = DataLoader(RangeDs(32), batch_size=8, num_workers=2,
+                            use_shared_memory=False)
+        seen = []
+        for xb, yb in loader:
+            seen.extend(np.asarray(yb.value).tolist())
+        assert seen == list(range(32))
+
+    def test_worker_init_fn_runs_in_worker(self):
+        def init(wid):
+            os.environ["DL_WORKER_MARK"] = str(wid)
+
+        class MarkDs(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.int64("DL_WORKER_MARK" in os.environ)
+
+        loader = DataLoader(MarkDs(), batch_size=2, num_workers=2,
+                            worker_init_fn=init)
+        vals = [v for b in loader for v in np.asarray(b.value).tolist()]
+        assert all(v == 1 for v in vals)
+        assert "DL_WORKER_MARK" not in os.environ  # only in children
+
+    @pytest.mark.slow
+    @pytest.mark.skipif((os.cpu_count() or 1) < 2,
+                        reason="speedup needs >=2 cores; this container "
+                               "exposes 1 — process-parallelism itself is "
+                               "asserted by test_really_multiple_processes")
+    def test_gil_bound_speedup_vs_threads(self):
+        """The whole point of process workers (>1.5x at num_workers=4 over
+        the thread pool on CPU-bound transforms, multicore hosts)."""
+        ds = GilBoundDs()
+
+        def run(**kw):
+            loader = DataLoader(ds, batch_size=2, num_workers=4, **kw)
+            t0 = time.perf_counter()
+            n = sum(1 for _ in loader)
+            dt = time.perf_counter() - t0
+            assert n == 12
+            return dt
+
+        t_threads = run(use_shared_memory=False)
+        t_procs = run(use_shared_memory=True)
+        assert t_procs * 1.5 < t_threads, (t_procs, t_threads)
