@@ -641,3 +641,14 @@ class TransformedDistribution(Distribution):
 
 
 __all__ += ["ExponentialFamily", "Independent", "TransformedDistribution"]
+
+
+# -- transforms (reference distribution/__init__.py:15,29-30,56) -----------
+from . import transform  # noqa: E402
+from .transform import (AbsTransform, AffineTransform,  # noqa: E402,F401
+                        ChainTransform, ExpTransform, IndependentTransform,
+                        PowerTransform, ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform, Transform)
+
+__all__ += ["transform"] + transform.__all__

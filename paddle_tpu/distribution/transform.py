@@ -1,0 +1,456 @@
+"""Random-variable transforms (reference: distribution/transform.py —
+13-class family, __all__ at :28, base Transform at :59).
+
+A Transform is a differentiable injective map f with a tractable log-det-
+Jacobian; pushing a base distribution through a chain of them yields
+``TransformedDistribution`` with
+``log p_Y(y) = log p_X(f^{-1}(y)) - log|det J_f(f^{-1}(y))|``.
+
+TPU-native: every op is jnp (jit/vmap/grad-safe — no data-dependent Python
+branching), values round-trip as framework Tensors.
+"""
+from __future__ import annotations
+
+import enum
+import functools
+import math
+import operator
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Type(enum.Enum):
+    """Mapping types (reference transform.py:45)."""
+    BIJECTION = "bijection"       # injective + surjective
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t) -> bool:
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+def _wrap(v) -> Tensor:
+    return Tensor(v)
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    # -- public API --------------------------------------------------------
+    @classmethod
+    def _is_injective(cls) -> bool:
+        return Type.is_injective(cls._type)
+
+    def __call__(self, input):
+        if isinstance(input, Transform):
+            return ChainTransform([input, self])
+        from . import Distribution, TransformedDistribution
+
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        return self.forward(input)
+
+    def forward(self, x):
+        return _wrap(self._forward(_raw(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_raw(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._call_forward_ldj(_raw(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return _wrap(self._call_inverse_ldj(_raw(y)))
+
+    def forward_shape(self, shape: Sequence[int]) -> Tuple[int, ...]:
+        return tuple(self._forward_shape(tuple(shape)))
+
+    def inverse_shape(self, shape: Sequence[int]) -> Tuple[int, ...]:
+        return tuple(self._inverse_shape(tuple(shape)))
+
+    # -- hooks -------------------------------------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _call_forward_ldj(self, x):
+        try:
+            return self._forward_log_det_jacobian(x)
+        except NotImplementedError:
+            # raw inverse hook only — calling _call_inverse_ldj here would
+            # recurse forever when neither hook is implemented
+            return -self._inverse_log_det_jacobian(self._forward(x))
+
+    def _call_inverse_ldj(self, y):
+        try:
+            return self._inverse_log_det_jacobian(y)
+        except NotImplementedError:
+            # route through _call_forward_ldj (NOT the raw hook): chain/
+            # stack combinators only override the _call_ layer, and their
+            # members' ldj support must surface here
+            return -self._call_forward_ldj(self._inverse(y))
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def _inverse_log_det_jacobian(self, y):
+        raise NotImplementedError
+
+    def _forward_shape(self, shape):
+        return shape
+
+    def _inverse_shape(self, shape):
+        return shape
+
+
+class AbsTransform(Transform):
+    r"""y = |x| — surjective, not injective; inverse picks the positive
+    branch (reference AbsTransform:342 semantics: inverse(y) -> (−y, y)
+    conceptually, value form returns the positive preimage)."""
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class AffineTransform(Transform):
+    r"""y = loc + scale·x (reference :414)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        super().__init__()
+        self._loc = _raw(loc)
+        self._scale = _raw(scale)
+
+    @property
+    def loc(self):
+        return _wrap(self._loc)
+
+    @property
+    def scale(self):
+        return _wrap(self._scale)
+
+    def _forward(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse(self, y):
+        return (y - self._loc) / self._scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self._scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    r"""y = exp(x) (reference :621)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    r"""y = x^p on the positive half-line (reference :765)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        super().__init__()
+        self._power = _raw(power)
+
+    @property
+    def power(self):
+        return _wrap(self._power)
+
+    def _forward(self, x):
+        return jnp.power(x, self._power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self._power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self._power * jnp.power(x, self._power - 1)))
+
+
+class SigmoidTransform(Transform):
+    r"""y = 1/(1+exp(-x)) (reference :952)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log sigmoid'(x) = -softplus(-x) - softplus(x)
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    r"""y = tanh(x) (reference :1237)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x)) — numerically
+        # stable for large |x|
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    r"""x -> softmax(x) over the last axis (reference :995). Not a
+    bijection (softmax is shift-invariant); inverse is log(y) up to an
+    additive constant."""
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_shape(self, shape):
+        if len(shape) < 1:
+            raise ValueError("SoftmaxTransform needs rank >= 1")
+        return shape
+
+    _inverse_shape = _forward_shape
+
+
+class StickBreakingTransform(Transform):
+    r"""Unconstrained R^{K-1} -> open simplex Δ^{K-1} by stick-breaking
+    (reference :1171): each sigmoid(x_i − log(K−1−i)) breaks off a fraction
+    of the remaining stick; the last coordinate is the leftover."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate(
+            [z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], -1)
+        cum = jnp.cumprod(1 - z, -1)
+        cumpad = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), cum], -1)
+        return zpad * cumpad
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        k = y_crop.shape[-1]
+        # same offsets as _forward (k sticks: log(k), ..., log(1))
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        rem = 1.0 - jnp.cumsum(y_crop, -1)
+        prev_rem = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), rem[..., :-1]], -1)
+        z = y_crop / prev_rem
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        cum = jnp.cumprod(1 - z, -1)
+        prev = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), cum[..., :-1]], -1)
+        # dy_i/dz_i = prev_rem_i; dz_i/dx_i = sigmoid'(t_i)
+        return jnp.sum(jnp.log(prev) - jax.nn.softplus(-t)
+                       - jax.nn.softplus(t), -1)
+
+    def _forward_shape(self, shape):
+        return shape[:-1] + (shape[-1] + 1,)
+
+    def _inverse_shape(self, shape):
+        return shape[:-1] + (shape[-1] - 1,)
+
+
+class IndependentTransform(Transform):
+    r"""Reinterpret the rightmost ``reinterpreted_batch_rank`` dims as event
+    dims: the log-det sums over them (reference :670)."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        super().__init__()
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self._base._forward(x)
+
+    def _inverse(self, y):
+        return self._base._inverse(y)
+
+    def _call_forward_ldj(self, x):
+        ldj = self._base._call_forward_ldj(x)
+        return jnp.sum(ldj, axis=tuple(range(-self._rank, 0)))
+
+    def _call_inverse_ldj(self, y):
+        ldj = self._base._call_inverse_ldj(y)
+        return jnp.sum(ldj, axis=tuple(range(-self._rank, 0)))
+
+    def _forward_shape(self, shape):
+        return self._base._forward_shape(shape)
+
+    def _inverse_shape(self, shape):
+        return self._base._inverse_shape(shape)
+
+
+class ReshapeTransform(Transform):
+    r"""Reshape the event part (reference :829)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        super().__init__()
+        self._in = tuple(int(s) for s in in_event_shape)
+        self._out = tuple(int(s) for s in out_event_shape)
+        if functools.reduce(operator.mul, self._in, 1) != functools.reduce(
+                operator.mul, self._out, 1):
+            raise ValueError(
+                f"in_event_shape {self._in} and out_event_shape "
+                f"{self._out} have different sizes")
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self._in)]
+        return jnp.reshape(x, batch + self._out)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self._out)]
+        return jnp.reshape(y, batch + self._in)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self._in)]
+        return jnp.zeros(batch, x.dtype)
+
+    def _forward_shape(self, shape):
+        n = len(self._in)
+        if tuple(shape[len(shape) - n:]) != self._in:
+            raise ValueError(f"shape {shape} does not end in {self._in}")
+        return tuple(shape[: len(shape) - n]) + self._out
+
+    def _inverse_shape(self, shape):
+        n = len(self._out)
+        if tuple(shape[len(shape) - n:]) != self._out:
+            raise ValueError(f"shape {shape} does not end in {self._out}")
+        return tuple(shape[: len(shape) - n]) + self._in
+
+
+class ChainTransform(Transform):
+    r"""Composition f = f_n ∘ ... ∘ f_1 (reference :496); log-det adds."""
+
+    def __init__(self, transforms: Sequence[Transform]):
+        super().__init__()
+        self._transforms = list(transforms)
+
+    @property
+    def transforms(self):
+        return list(self._transforms)
+
+    @classmethod
+    def _is_injective(cls) -> bool:
+        return True
+
+    def _forward(self, x):
+        for t in self._transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self._transforms):
+            y = t._inverse(y)
+        return y
+
+    def _call_forward_ldj(self, x):
+        total = 0.0
+        for t in self._transforms:
+            total = total + t._call_forward_ldj(x)
+            x = t._forward(x)
+        return total
+
+    def _forward_shape(self, shape):
+        for t in self._transforms:
+            shape = t._forward_shape(shape)
+        return shape
+
+    def _inverse_shape(self, shape):
+        for t in reversed(self._transforms):
+            shape = t._inverse_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    r"""Apply a sequence of transforms to slices along ``axis`` (reference
+    :1051): slice i gets transforms[i]."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        super().__init__()
+        self._transforms = list(transforms)
+        self._axis = int(axis)
+
+    @property
+    def transforms(self):
+        return list(self._transforms)
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _split(self, v):
+        n = len(self._transforms)
+        return [jnp.squeeze(s, self._axis)
+                for s in jnp.split(v, n, axis=self._axis)]
+
+    def _forward(self, x):
+        outs = [t._forward(s)
+                for t, s in zip(self._transforms, self._split(x))]
+        return jnp.stack(outs, self._axis)
+
+    def _inverse(self, y):
+        outs = [t._inverse(s)
+                for t, s in zip(self._transforms, self._split(y))]
+        return jnp.stack(outs, self._axis)
+
+    def _call_forward_ldj(self, x):
+        outs = [t._call_forward_ldj(s)
+                for t, s in zip(self._transforms, self._split(x))]
+        return jnp.stack(outs, self._axis)

@@ -76,27 +76,52 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
             f"(lax.cond contract). {control_flow_guidance()}") from e
 
 
+class Undefined:
+    """A local that no branch/loop iteration has assigned yet (autograph's
+    'Undefined' pattern): VALUE-like use fails loudly with the variable
+    name, while attribute probes stay inert (hasattr checks from pytree
+    flattening must see a plain AttributeError, not a crash)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _die(self, *a, **k):
+        raise UnboundLocalError(
+            f"local variable {self.name!r} referenced before assignment "
+            f"(a dy2static-converted branch/loop did not bind it on the "
+            f"path taken)")
+
+    def __repr__(self):
+        return f"<undefined local {self.name!r}>"
+
+    __bool__ = __iter__ = __len__ = __call__ = _die
+    __add__ = __radd__ = __sub__ = __rsub__ = _die
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _die
+    __neg__ = __float__ = __int__ = __getitem__ = _die
+    __lt__ = __le__ = __gt__ = __ge__ = _die
+
+
 def convert_while(cond_fn: Callable, body_fn: Callable, init: Tuple):
-    """``while`` with loop-carried vars. Traced condition ->
-    ``lax.while_loop`` (body must keep shapes/dtypes); concrete -> plain
-    Python loop (which may itself go dynamic mid-loop — re-checked every
-    iteration)."""
-    if not _is_dynamic(cond_fn(*init)):
-        vars_ = tuple(init)
-        while bool(_raw(cond_fn(*vars_))):
-            vars_ = tuple(body_fn(*vars_))
-            if _is_dynamic(cond_fn(*vars_)):
-                break
-        else:
+    """``while`` with loop-carried vars. Concrete condition -> plain Python
+    iteration (checked once per iteration; may go dynamic mid-loop, in
+    which case lax takes over FROM THE CURRENT state); traced condition ->
+    ``lax.while_loop`` (body must keep shapes/dtypes)."""
+    vars_ = tuple(init)
+    c = cond_fn(*vars_)
+    while not _is_dynamic(c):
+        if not bool(_raw(c)):
             return vars_
-        # condition became traced mid-loop: finish with lax
+        vars_ = tuple(body_fn(*vars_))
+        c = cond_fn(*vars_)
     from jax import lax
 
     try:
         return lax.while_loop(
             lambda vs: jnp.asarray(
                 _raw(cond_fn(*vs))).astype(bool).reshape(()),
-            lambda vs: tuple(body_fn(*vs)), tuple(init))
+            lambda vs: tuple(body_fn(*vs)), vars_)
     except TypeError as e:
         raise TypeError(
             f"to_static: a tensor-predicate `while` body must keep every "
@@ -336,9 +361,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         orelse = self._visit_block(list(node.orelse))
         outs = _assigned(node.body + node.orelse)
         passed = [n for n in outs if n in bound_before]
+        born = [n for n in outs if n not in bound_before]
+        # branch-born names start as Undefined INSIDE each branch fn (never
+        # as lax.cond operands): a branch that assigns returns the value, a
+        # branch that doesn't returns the placeholder — concrete paths keep
+        # Python semantics, traced asymmetry fails the cond structure check
         tname, fname = self._fresh("true"), self._fresh("false")
-        tdef = _make_branch_fn(tname, passed, body, outs)
-        fdef = _make_branch_fn(fname, passed, orelse, outs)
+        tdef = _make_branch_fn(tname, passed,
+                               [_undef_assign(n) for n in born] + body, outs)
+        fdef = _make_branch_fn(fname, passed,
+                               [_undef_assign(n) for n in born] + orelse,
+                               outs)
         call = ast.Call(
             func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
                                attr="convert_ifelse", ctx=ast.Load()),
@@ -362,15 +395,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return node
         bound_before = list(self.bound)
         body = self._visit_block(list(node.body))
-        assigned = _assigned(node.body)
-        # loop-carried = assigned in body AND bound before the loop; names
-        # born inside the body stay internal to the body function
-        carried = [n for n in assigned if n in bound_before]
+        carried = _assigned(node.body)
         if not carried:
-            # nothing carried: a tensor predicate would never progress;
+            # nothing assigned: a tensor predicate would never progress;
             # leave as Python (concrete predicates work unchanged)
             node.body = body
             return node
+        # loop-born names (first assigned in the body) start as Undefined
+        # placeholders so they are carried and visible after the loop —
+        # matching Python, where they exist iff an iteration ran
+        pre = [_undef_assign(n) for n in carried if n not in bound_before]
         cname, bname = self._fresh("cond"), self._fresh("body")
         cdef = _make_branch_fn(cname, carried, [], [], ret_expr=node.test)
         bdef = _make_branch_fn(bname, carried, body, carried)
@@ -383,12 +417,23 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                   for n in carried], ctx=ast.Load())],
             keywords=[])
         assign = _tuple_assign(carried, call)
-        self._bind(assigned)
+        self._bind(carried)
         self.changed = True
-        return [cdef, bdef, assign]
+        return pre + [cdef, bdef, assign]
 
     def visit_FunctionDef_nested(self, node):
         return node
+
+
+def _undef_assign(name: str):
+    """``name = _RT.Undefined('name')`` — placeholder for a branch/loop-
+    born local."""
+    return ast.Assign(
+        targets=[ast.Name(id=name, ctx=ast.Store())],
+        value=ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                               attr="Undefined", ctx=ast.Load()),
+            args=[ast.Constant(value=name)], keywords=[]))
 
 
 def _empty_args():

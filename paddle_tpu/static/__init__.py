@@ -19,6 +19,7 @@ from .program import (Program, Scope, default_main_program,
                       global_scope, in_static_mode, program_guard,
                       static_state)
 from .record import make_symbolic
+from . import quantization  # noqa: F401  (reference static/quantization)
 
 __all__ = ["data", "Executor", "Program", "program_guard",
            "default_main_program", "default_startup_program", "scope_guard",

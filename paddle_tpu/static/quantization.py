@@ -1,0 +1,160 @@
+"""Program-level quantization passes (reference:
+python/paddle/static/quantization/quantization_pass.py —
+QuantizationTransformPass inserts fake_quantize/dequantize ops in front of
+quantizable ops; QuantizationFreezePass rewrites them to fixed scales).
+
+TPU-native: the Program here is the recorded-op IR (static/program.py), so
+a "pass" is a node-list rewrite — insert absmax fake-quant nodes on the
+inputs of matmul-class ops (QAT: scales ride the forward dynamically with
+a straight-through estimator, so append_backward/minimize train through
+them), then freeze weight scales to constants computed from the calibrated
+scope for inference. int8 simulation math reuses
+``paddle_tpu.quantization.fake_quant`` (STE custom_vjp).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .program import Program, Scope, StaticNode, global_scope
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "quant_aware", "convert"]
+
+_QUANT_OP_TYPES = ("matmul", "mul", "conv2d", "linear")
+_FQ_NAME = "fake_quantize_dequantize_absmax"
+_vid_counter = itertools.count(1 << 62)
+
+
+def _dyn_fake_quant(x, bits: int):
+    """Absmax fake quant with runtime scale (QAT forward); STE backward
+    comes from quantization._fake_quant's custom_vjp. Calls the RAW jnp
+    core — the Tensor-level fake_quant routes through apply_op, which
+    would re-enter record mode while the executor composes this node."""
+    from ..quantization import _fake_quant
+
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    scale = jnp.maximum(scale, jnp.asarray(1e-9, x.dtype))
+    return _fake_quant(x, scale, float(2 ** (bits - 1) - 1))
+
+
+def _fixed_fake_quant(x, scale: float, bits: int):
+    from ..quantization import _fake_quant
+
+    return _fake_quant(x, jnp.asarray(scale, jnp.result_type(x)),
+                       float(2 ** (bits - 1) - 1))
+
+
+class QuantizationTransformPass:
+    """Insert fake-quant nodes on every float tensor input of quantizable
+    ops (reference QuantizationTransformPass.apply: the
+    _transform_forward insertion walk)."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 quantizable_op_type: Iterable[str] = _QUANT_OP_TYPES):
+        self._wbits = int(weight_bits)
+        self._abits = int(activation_bits)
+        self._types = tuple(quantizable_op_type)
+
+    def _quantizable(self, node: StaticNode) -> bool:
+        name = (node.name or "").lower()
+        return any(t in name for t in self._types) and name != _FQ_NAME
+
+    def apply(self, program: Program) -> Program:
+        out = program.clone()
+        param_ids = set(program.param_vars.values())
+        new_nodes = []
+        n_inserted = 0
+        quantized: Dict[Tuple[int, int], int] = {}  # (src vid, bits) → qvid
+        for node in out.nodes:
+            if not self._quantizable(node):
+                new_nodes.append(node)
+                continue
+            new_slots = []
+            for kind, v in node.in_ids:
+                if kind != "var" or v not in out.var_meta:
+                    new_slots.append((kind, v))
+                    continue
+                name, aval = out.var_meta[v]
+                dt = getattr(aval, "dtype", None)
+                if (dt is None or not jnp.issubdtype(dt, jnp.floating)
+                        or len(getattr(aval, "shape", ())) < 1):
+                    new_slots.append((kind, v))
+                    continue
+                bits = self._wbits if v in param_ids else self._abits
+                qvid = quantized.get((v, bits))  # reuse across consumers
+                if qvid is None:                 # (reference dequantized_vars)
+                    qvid = next(_vid_counter)
+                    quantized[(v, bits)] = qvid
+                    out.add_var(qvid, f"{name}.quantized", aval)
+                    new_nodes.append(StaticNode(
+                        fn=lambda x, _b=bits: _dyn_fake_quant(x, _b),
+                        in_ids=[("var", v)], const_args=None,
+                        out_ids=[qvid], name=_FQ_NAME))
+                    n_inserted += 1
+                new_slots.append(("var", qvid))
+            new_nodes.append(StaticNode(
+                fn=node.fn, in_ids=new_slots, const_args=node.const_args,
+                out_ids=node.out_ids, name=node.name))
+        out.nodes = new_nodes
+        out._quant_inserted = n_inserted
+        out._quant_bits = (self._wbits, self._abits)
+        return out
+
+
+class QuantizationFreezePass:
+    """Freeze WEIGHT fake-quants to fixed scales read from the (calibrated)
+    scope (reference QuantizationFreezePass: scale transfer + op rewrite).
+    Activation quants keep dynamic scales (the runtime absmax is the TPU-
+    friendly form — no per-batch state to thread)."""
+
+    def __init__(self, weight_bits: int = 8):
+        self._wbits = int(weight_bits)
+
+    def apply(self, program: Program,
+              scope: Optional[Scope] = None) -> Program:
+        scope = scope or global_scope()
+        out = program.clone()
+        id_to_pname = {vid: n for n, vid in program.param_vars.items()}
+        scales: Dict[str, float] = {}
+        new_nodes = []
+        for node in out.nodes:
+            src = node.in_ids[0][1] if node.in_ids else None
+            if (node.name == _FQ_NAME and src in id_to_pname):
+                pname = id_to_pname[src]
+                val = scope.var(pname)
+                if val is None and pname in out.param_objs:
+                    val = out.param_objs[pname]._value
+                scale = max(float(jnp.max(jnp.abs(jnp.asarray(val)))),
+                            1e-9)  # zero-init params (bias) divide by scale
+                scales[pname] = scale
+                new_nodes.append(StaticNode(
+                    fn=lambda x, _s=scale, _b=self._wbits:
+                        _fixed_fake_quant(x, _s, _b),
+                    in_ids=node.in_ids, const_args=None,
+                    out_ids=node.out_ids,
+                    name="fake_quantize_dequantize_frozen"))
+            else:
+                new_nodes.append(node)
+        out.nodes = new_nodes
+        out._quant_scales = scales
+        return out
+
+
+def quant_aware(program: Program, weight_bits: int = 8,
+                activation_bits: int = 8,
+                quantizable_op_type: Iterable[str] = _QUANT_OP_TYPES
+                ) -> Program:
+    """One-call QAT program transform (reference paddleslim-style
+    quant_aware over a static program)."""
+    return QuantizationTransformPass(
+        weight_bits, activation_bits, quantizable_op_type).apply(program)
+
+
+def convert(program: Program, scope: Optional[Scope] = None,
+            weight_bits: int = 8) -> Program:
+    """Freeze the trained/calibrated quant program for inference."""
+    return QuantizationFreezePass(weight_bits).apply(program, scope)

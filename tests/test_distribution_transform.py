@@ -1,0 +1,180 @@
+"""Transform family parity tests (VERDICT r2 #9).
+
+Oracle: torch.distributions.transforms (same math as the reference's
+distribution/transform.py family — both follow the TF-Probability
+bijector contract). Checks forward/inverse round-trips, log-det-Jacobians
+(also against autodiff), shape transforms, and TransformedDistribution
+log_prob end-to-end.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.distribution as D
+
+torch = pytest.importorskip("torch")
+td = torch.distributions.transforms
+
+
+def _np(x):
+    return np.asarray(x.value if hasattr(x, "value") else x)
+
+
+PAIRS = [
+    (lambda: D.ExpTransform(), lambda: td.ExpTransform(),
+     np.random.RandomState(0).randn(5).astype(np.float32)),
+    (lambda: D.AffineTransform(2.0, -3.0), lambda: td.AffineTransform(2.0, -3.0),
+     np.random.RandomState(1).randn(5).astype(np.float32)),
+    (lambda: D.SigmoidTransform(), lambda: td.SigmoidTransform(),
+     np.random.RandomState(2).randn(5).astype(np.float32)),
+    (lambda: D.TanhTransform(), lambda: td.TanhTransform(),
+     np.random.RandomState(3).randn(5).astype(np.float32) * 0.8),
+    (lambda: D.PowerTransform(2.0), lambda: td.PowerTransform(
+        torch.tensor(2.0)),
+     np.random.RandomState(4).rand(5).astype(np.float32) + 0.5),
+    (lambda: D.StickBreakingTransform(), lambda: td.StickBreakingTransform(),
+     np.random.RandomState(5).randn(4).astype(np.float32)),
+]
+
+
+class TestTorchParity:
+    @pytest.mark.parametrize("mk_ours,mk_torch,x", PAIRS,
+                             ids=["exp", "affine", "sigmoid", "tanh",
+                                  "power", "stickbreaking"])
+    def test_forward_inverse_ldj(self, mk_ours, mk_torch, x):
+        ours, ref = mk_ours(), mk_torch()
+        tx = torch.tensor(x)
+        y_ours = _np(ours.forward(x))
+        y_ref = ref(tx).numpy()
+        np.testing.assert_allclose(y_ours, y_ref, rtol=1e-5, atol=1e-6)
+        # inverse round-trip
+        x_back = _np(ours.inverse(y_ours))
+        np.testing.assert_allclose(x_back, x, rtol=1e-4, atol=1e-5)
+        # log-det-jacobian
+        ldj_ours = _np(ours.forward_log_det_jacobian(x))
+        ldj_ref = ref.log_abs_det_jacobian(tx, ref(tx)).numpy()
+        np.testing.assert_allclose(ldj_ours, ldj_ref, rtol=1e-4, atol=1e-5)
+
+    def test_ldj_matches_autodiff(self):
+        """Jacobian from jax.jacfwd must agree with the closed forms."""
+        for t in (D.ExpTransform(), D.SigmoidTransform(), D.TanhTransform(),
+                  D.AffineTransform(1.0, 2.5)):
+            x = jnp.asarray([0.3])
+            j = jax.jacfwd(lambda v: t._forward(v))(x)
+            expect = jnp.log(jnp.abs(j[0, 0]))
+            got = t._forward_log_det_jacobian(x)[0]
+            np.testing.assert_allclose(float(got), float(expect), rtol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        t = D.StickBreakingTransform()
+        x = np.random.RandomState(7).randn(3, 6).astype(np.float32)
+        y = _np(t.forward(x))
+        assert y.shape == (3, 7)
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+        assert (y > 0).all()
+        np.testing.assert_allclose(_np(t.inverse(y)), x, rtol=1e-3,
+                                   atol=1e-4)
+        assert t.forward_shape((3, 6)) == (3, 7)
+        assert t.inverse_shape((3, 7)) == (3, 6)
+
+
+class TestCombinators:
+    def test_chain(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = np.asarray([0.5], np.float32)
+        y = _np(chain.forward(x))
+        np.testing.assert_allclose(y, np.exp(2 * 0.5), rtol=1e-6)
+        np.testing.assert_allclose(_np(chain.inverse(y)), x, rtol=1e-6)
+        # ldj adds: log|2| + (2x)
+        np.testing.assert_allclose(
+            _np(chain.forward_log_det_jacobian(x)),
+            np.log(2.0) + 1.0, rtol=1e-6)
+
+    def test_independent_sums_event_dims(self):
+        base = D.ExpTransform()
+        t = D.IndependentTransform(base, 1)
+        x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        ldj = _np(t.forward_log_det_jacobian(x))
+        assert ldj.shape == (2,)
+        np.testing.assert_allclose(ldj, x.sum(-1), rtol=1e-6)
+
+    def test_reshape(self):
+        t = D.ReshapeTransform((6,), (2, 3))
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        y = _np(t.forward(x))
+        assert y.shape == (2, 2, 3)
+        np.testing.assert_allclose(_np(t.inverse(y)), x)
+        assert t.forward_shape((5, 6)) == (5, 2, 3)
+        with pytest.raises(ValueError):
+            D.ReshapeTransform((6,), (4,))
+
+    def test_stack(self):
+        t = D.StackTransform([D.ExpTransform(),
+                              D.AffineTransform(0.0, 3.0)], axis=1)
+        x = np.asarray([[0.0, 1.0], [1.0, 2.0]], np.float32)
+        y = _np(t.forward(x))
+        np.testing.assert_allclose(y[:, 0], np.exp(x[:, 0]), rtol=1e-6)
+        np.testing.assert_allclose(y[:, 1], 3 * x[:, 1], rtol=1e-6)
+        np.testing.assert_allclose(_np(t.inverse(y)), x, rtol=1e-6)
+
+    def test_call_composition(self):
+        # Transform(Transform) chains; Transform(Distribution) transforms
+        chained = D.ExpTransform()(D.AffineTransform(0.0, 2.0))
+        assert isinstance(chained, D.ChainTransform)
+        dist = D.ExpTransform()(D.Normal(loc=0.0, scale=1.0))
+        assert isinstance(dist, D.TransformedDistribution)
+
+
+class TestTransformedDistributionParity:
+    def test_lognormal_via_exp_normal(self):
+        """TransformedDistribution(Normal, [Exp]) ≡ LogNormal (the
+        canonical reference example)."""
+        ours = D.TransformedDistribution(D.Normal(loc=0.3, scale=0.7),
+                                         [D.ExpTransform()])
+        ref = torch.distributions.TransformedDistribution(
+            torch.distributions.Normal(0.3, 0.7), [td.ExpTransform()])
+        v = np.asarray([0.5, 1.0, 2.5], np.float32)
+        np.testing.assert_allclose(
+            _np(ours.log_prob(v)), ref.log_prob(torch.tensor(v)).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_affine_sigmoid_chain_logprob(self):
+        ours = D.TransformedDistribution(
+            D.Normal(loc=0.0, scale=1.0),
+            [D.AffineTransform(0.5, 2.0), D.SigmoidTransform()])
+        ref = torch.distributions.TransformedDistribution(
+            torch.distributions.Normal(0.0, 1.0),
+            [td.AffineTransform(0.5, 2.0), td.SigmoidTransform()])
+        v = np.asarray([0.2, 0.5, 0.9], np.float32)
+        np.testing.assert_allclose(
+            _np(ours.log_prob(v)), ref.log_prob(torch.tensor(v)).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_sample_range_respects_transform(self):
+        d = D.TransformedDistribution(D.Normal(loc=0.0, scale=1.0),
+                                      [D.SigmoidTransform()])
+        s = _np(d.sample((500,)))
+        assert ((s > 0) & (s < 1)).all()
+
+
+class TestInverseLdjFallbacks:
+    def test_chain_inverse_ldj(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = np.asarray([0.5], np.float32)
+        y = _np(chain.forward(x))
+        fwd = _np(chain.forward_log_det_jacobian(x))
+        inv = _np(chain.inverse_log_det_jacobian(y))
+        np.testing.assert_allclose(inv, -fwd, rtol=1e-6)
+
+    def test_stack_inverse_ldj(self):
+        t = D.StackTransform([D.ExpTransform(),
+                              D.AffineTransform(0.0, 3.0)], axis=0)
+        x = np.asarray([[0.5], [1.0]], np.float32)
+        y = _np(t.forward(x))
+        fwd = _np(t.forward_log_det_jacobian(x))
+        inv = _np(t.inverse_log_det_jacobian(y))
+        np.testing.assert_allclose(inv, -fwd, rtol=1e-6)

@@ -178,3 +178,46 @@ class TestGradThroughControlFlow:
         g = m.fc.weight.grad
         assert g is not None
         assert np.isfinite(np.asarray(g.value)).all()
+
+
+class TestConcreteSemanticsPreserved:
+    """Regression guards: converted code must keep plain-Python semantics
+    for concrete predicates (branch-asymmetric and loop-born locals)."""
+
+    def test_branch_asymmetric_assignment(self):
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        def f(flag, x):
+            if flag:
+                msg = x + 1
+            return x
+
+        g = convert_to_static(f)
+        assert g(False, 3) == 3
+        assert g(True, 3) == 3
+
+    def test_loop_born_local_visible_after(self):
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        def f(n):
+            i = 0
+            while i < n:
+                out = i * 2
+                i = i + 1
+            return out
+
+        g = convert_to_static(f)
+        assert g(3) == 4
+
+    def test_use_before_assign_fails_at_use(self):
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        def f(flag):
+            if flag:
+                v = 1
+            return v + 1   # value-use of a maybe-unbound local
+
+        g = convert_to_static(f)
+        assert g(True) == 2
+        with pytest.raises(UnboundLocalError, match="'v'"):
+            g(False)
