@@ -52,10 +52,15 @@ def _devices_or_cpu_fallback():
     return jax.devices()
 
 
-def main():
+def main(model_size: str = "350m"):
     import os
 
     import jax
+
+    if model_size not in ("350m", "1.3b"):
+        raise SystemExit(
+            f"unknown model size {model_size!r} (350m|1.3b) — refusing to "
+            f"mislabel a benchmark record")
 
     # persistent compile cache: bench iterations recompile a ~20-min XLA
     # program otherwise (remote-compile helper has no cross-run cache)
@@ -75,15 +80,30 @@ def main():
     from paddle_tpu.models.llama_functional import (build_train_step,
                                                     stack_params)
 
+    moment_dtype = None
     if on_tpu:
         # 350M-param Llama with head_dim 128 (8 heads x 128 instead of
         # 16 x 64): same parameter count, full-width MXU lanes on the
         # attention contractions. Full activation recompute bounds live
         # activations to one layer's worth (round-1 bench OOMed without it).
-        cfg = llama_config("350m", dtype="bfloat16",
-                           num_attention_heads=8, num_key_value_heads=8,
-                           max_position_embeddings=2048, recompute="full")
-        batch, seq, steps = 8, 2048, 10
+        if model_size == "1.3b":
+            # BASELINE config 2 scale on ONE chip: bf16 FIRST moment
+            # (v must stay fp32 — 1-beta2 is below the bf16 ulp and the
+            # stored v would freeze) + batch 4; fp32 moments alone were
+            # the r2 OOM (10.4GB)
+            import jax.numpy as jnp
+
+            cfg = llama_config("1b3", dtype="bfloat16",
+                               max_position_embeddings=2048,
+                               recompute="full")
+            batch, seq, steps = 4, 2048, 6
+            moment_dtype = jnp.bfloat16
+        else:
+            cfg = llama_config("350m", dtype="bfloat16",
+                               num_attention_heads=8, num_key_value_heads=8,
+                               max_position_embeddings=2048,
+                               recompute="full")
+            batch, seq, steps = 8, 2048, 10
         kind = jax.devices()[0].device_kind.lower()
         if "lite" in kind or "v5e" in kind:
             peak = 394e12  # v5e bf16
@@ -103,7 +123,8 @@ def main():
     # regardless of depth (an inlined 24-layer remat+vjp HLO took the
     # remote compile helper >40 min; this compiles in ~1 min)
     stacked, rest = stack_params(params, cfg)
-    step, init = build_train_step(cfg, lr=1e-4, remat=True)
+    step, init = build_train_step(cfg, lr=1e-4, remat=True,
+                                  moment_dtype=moment_dtype)
     opt_state = init(stacked, rest)
 
     # ONE dispatch for the whole timed loop (lax.fori_loop inside jit): the
@@ -145,7 +166,8 @@ def main():
     model_flops = 6.0 * n_params * tokens  # fwd+bwd ≈ 6·N per token
     mfu = model_flops / dt / peak
     rec = {
-        "metric": f"llama_{'350m' if on_tpu else 'tiny'}_train_tokens_per_sec_per_chip",
+        "metric": f"llama_{model_size if on_tpu else 'tiny'}"
+                  "_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.50, 4),
@@ -335,7 +357,9 @@ if __name__ == "__main__":
     elif mode == "moe":
         moe_bench()
     elif mode == "train":
-        main()
+        main(sys.argv[2] if len(sys.argv) > 2 else "350m")
+    elif mode == "1.3b":
+        main("1.3b")
     else:
         raise SystemExit(
-            f"unknown bench mode {mode!r} (train|decode|resnet|moe)")
+            f"unknown bench mode {mode!r} (train|decode|resnet|moe|1.3b)")

@@ -1,7 +1,12 @@
-from . import fs, hybrid_parallel_util, log_util  # noqa: F401
+from . import (fs, hybrid_parallel_inference,  # noqa: F401
+               hybrid_parallel_util, log_util, mix_precision_utils)
 from .fs import HDFSClient, LocalFS  # noqa: F401
+from .hybrid_parallel_inference import (  # noqa: F401
+    HybridParallelInferenceHelper)
 from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401
 from .log_util import logger  # noqa: F401
+from .mix_precision_utils import (MixPrecisionLayer,  # noqa: F401
+                                  MixPrecisionOptimizer, MixPrecisionScaler)
 
 
 def recompute(function, *args, **kwargs):

@@ -1,0 +1,155 @@
+"""Main-grad mixed precision (reference:
+fleet/utils/mix_precision_utils.py — MixPrecisionLayer:30 keeps a fp32
+``main_grad`` per bf16 param via grad hooks, MixPrecisionOptimizer:93 steps
+on it, MixPrecisionScaler:244 unscales into it).
+
+Why it exists: with bf16 params, accumulating gradients across micro-
+batches in bf16 loses ~8 mantissa bits; accumulating into an fp32
+main_grad keeps the optimizer math exact while compute stays bf16. On TPU
+this is the standard bf16-compute/fp32-state recipe; the jitted training
+paths (optimizer/functional.adamw_update) already do fp32 math internally,
+so this module serves the EAGER (dygraph) path where grads land on
+``param.grad`` between backward calls.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+__all__ = ["MixPrecisionLayer", "MixPrecisionOptimizer", "MixPrecisionScaler"]
+
+
+class MixPrecisionLayer:
+    """Wrap a layer whose params run in ``dtype`` (bf16): every backward
+    accumulates the fresh grad into ``param.main_grad`` (fp32) via a
+    registered grad hook, then clears the low-precision grad reference.
+
+    reference MixPrecisionLayer:30 (its _update_main_grad hook)."""
+
+    def __init__(self, layers, dtype: str = "bfloat16"):
+        self._layers = layers
+        target = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+        for _, p in layers.named_parameters():
+            if jnp.issubdtype(jnp.result_type(p._value), jnp.floating):
+                p._value = p._value.astype(target)
+            p.main_grad = None
+
+            def hook(g, _p=p):
+                gv = g._value if isinstance(g, Tensor) else g
+                g32 = gv.astype(jnp.float32)
+                if _p.main_grad is None:
+                    _p.main_grad = Tensor(g32)
+                else:
+                    _p.main_grad = Tensor(_p.main_grad._value + g32)
+                return g
+
+            p.register_hook(hook)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    __call__ = forward
+
+    def __getattr__(self, item):
+        return getattr(self._layers, item)
+
+
+class MixPrecisionOptimizer:
+    """Step on fp32 master weights using main_grad (reference :93):
+    maintains a master fp32 copy per bf16 param; at ``step()`` the inner
+    optimizer sees (master fp32 param, fp32 main_grad), and the bf16 param
+    is refreshed from the updated master."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        self._masters = {}
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def _params(self):
+        return list(self._inner._parameter_list or [])
+
+    def step(self):
+        swapped = []
+        for p in self._params():
+            g = getattr(p, "main_grad", None)
+            if g is None and p.grad is None:
+                continue
+            key = id(p)
+            master = self._masters.get(key)
+            if master is None:
+                master = p._value.astype(jnp.float32)
+            low_value, low_grad = p._value, p.grad
+            p._value = master
+            if g is not None:
+                p.grad = g
+            else:
+                gv = low_grad._value if isinstance(low_grad, Tensor) \
+                    else low_grad
+                p.grad = Tensor(gv.astype(jnp.float32))
+            swapped.append((p, key, low_value, low_grad))
+        self._inner.step()
+        for p, key, low_value, low_grad in swapped:
+            self._masters[key] = p._value          # updated fp32 master
+            p._value = p._value.astype(low_value.dtype)
+            p.grad = low_grad
+
+    def clear_grad(self, set_to_zero: bool = True):
+        self._inner.clear_grad()
+        for p in self._params():
+            p.main_grad = None
+
+    def state_dict(self):
+        """Includes the fp32 masters (keyed by param NAME — ids don't
+        survive a restart): without them, resume would rebuild masters
+        from bf16 params and lose the sub-ulp accumulation this module
+        exists to preserve."""
+        sd = self._inner.state_dict()
+        masters = {}
+        for p in self._params():
+            m = self._masters.get(id(p))
+            if m is not None:
+                masters[p.name] = m
+        sd["mix_precision_masters"] = masters
+        return sd
+
+    def set_state_dict(self, sd):
+        masters = sd.pop("mix_precision_masters", None) if isinstance(
+            sd, dict) else None
+        out = self._inner.set_state_dict(sd)
+        if masters:
+            by_name = {p.name: p for p in self._params()}
+            for name, m in masters.items():
+                p = by_name.get(name)
+                if p is not None:
+                    self._masters[id(p)] = jnp.asarray(m, jnp.float32)
+                    p._value = self._masters[id(p)].astype(p._value.dtype)
+        return out
+
+
+class MixPrecisionScaler:
+    """GradScaler shim for the main-grad flow (reference :244): bf16 on
+    TPU needs no loss scaling (same exponent range as fp32), so scale is
+    identity and ``step`` delegates — kept for API compatibility with
+    fp16-era training scripts."""
+
+    def __init__(self, scaler=None):
+        self._scaler = scaler
+
+    def scale(self, loss):
+        return self._scaler.scale(loss) if self._scaler else loss
+
+    def unscale_(self, optimizer):
+        if self._scaler:
+            self._scaler.unscale_(optimizer)
+
+    def step(self, optimizer):
+        optimizer.step()
+
+    def update(self):
+        if self._scaler:
+            self._scaler.update()

@@ -386,9 +386,9 @@ class ChainTransform(Transform):
     def transforms(self):
         return list(self._transforms)
 
-    @classmethod
-    def _is_injective(cls) -> bool:
-        return True
+    def _is_injective(self) -> bool:
+        # injective iff every member is (reference ChainTransform)
+        return all(t._is_injective() for t in self._transforms)
 
     def _forward(self, x):
         for t in self._transforms:

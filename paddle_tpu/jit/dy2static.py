@@ -101,6 +101,8 @@ class Undefined:
     __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _die
     __neg__ = __float__ = __int__ = __getitem__ = _die
     __lt__ = __le__ = __gt__ = __ge__ = _die
+    __eq__ = __ne__ = _die          # v == 2 must not silently be False
+    __hash__ = object.__hash__      # (defining __eq__ clears __hash__)
 
 
 def convert_while(cond_fn: Callable, body_fn: Callable, init: Tuple):

@@ -141,17 +141,20 @@ def build_loss_fn(cfg: LlamaConfig, remat=True,
 
 
 def build_train_step(cfg: LlamaConfig, lr: float = 1e-4,
-                     clip_norm: float = 1.0, remat=True):
+                     clip_norm: float = 1.0, remat=True,
+                     moment_dtype=None):
     """Jittable AdamW train step over (stacked, rest) param pytrees.
     Optimizer state is stacked too — the update compiles once per tensor
-    kind, not once per layer."""
+    kind, not once per layer. ``moment_dtype=jnp.bfloat16`` halves
+    optimizer HBM (the 1.3B-on-one-chip policy; math stays fp32)."""
     from ..optimizer.functional import (adamw_init, adamw_update,
                                         clip_by_global_norm)
 
     loss_fn = build_loss_fn(cfg, remat)
 
     def init(stacked, rest):
-        return adamw_init({"stacked": stacked, "rest": rest})
+        return adamw_init({"stacked": stacked, "rest": rest},
+                          moment_dtype=moment_dtype)
 
     def step(stacked, rest, opt_state, ids, labels):
         loss, grads = jax.value_and_grad(

@@ -338,6 +338,34 @@ def _pair(v):
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError(
-        "class_center_sample is PartialFC-specific; planned with parallel margin loss"
-    )
+    """PartialFC class-center sampling (reference
+    nn/functional/common.py:2034, arXiv:2010.05222): keep every positive
+    class center present in ``label``, top up with uniformly sampled
+    negatives to ``num_samples``, and remap labels into the sampled set.
+
+    Returns ``(remapped_label, sampled_class_center)``. Eager-only: the
+    output size is data-dependent (all positives are kept even beyond
+    ``num_samples``), which has no static shape — call it on host data
+    before the jitted step, like the reference calls it outside the fused
+    margin-softmax kernel."""
+    import numpy as np
+
+    from ...core.random import default_generator
+    from ...core.tensor import Tensor
+
+    lv = np.asarray(label._value if isinstance(label, Tensor) else label)
+    pos = np.unique(lv)
+    if pos.size >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=pos.dtype),
+                                pos, assume_unique=True)
+        import jax
+
+        key = default_generator.next_key()
+        perm = np.asarray(jax.random.permutation(key, neg_pool.size))
+        extra = neg_pool[perm[: num_samples - pos.size]]
+        sampled = np.sort(np.concatenate([pos, extra]))
+    # remap each label to its index in the sampled (sorted) center list
+    remapped = np.searchsorted(sampled, lv).astype(lv.dtype)
+    return (Tensor(remapped), Tensor(sampled))

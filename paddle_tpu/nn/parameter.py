@@ -18,7 +18,7 @@ _param_counter = [0]
 class Parameter(Tensor):
     __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
                  "do_model_average", "is_distributed", "split_axis",
-                 "pp_stage", "grad_pspec")
+                 "pp_stage", "grad_pspec", "main_grad")
 
     def __init__(self, value, trainable: bool = True, name=None,
                  learning_rate: float = 1.0, regularizer=None,

@@ -60,8 +60,10 @@ class QuantizationTransformPass:
         self._types = tuple(quantizable_op_type)
 
     def _quantizable(self, node: StaticNode) -> bool:
-        name = (node.name or "").lower()
-        return any(t in name for t in self._types) and name != _FQ_NAME
+        # EXACT op-type match (reference matches op types, where 'mul' is
+        # the legacy matmul op): substring matching would int8-quantize
+        # elementwise 'multiply', 'bilinear', 'multi_*' etc.
+        return (node.name or "").lower() in self._types
 
     def apply(self, program: Program) -> Program:
         out = program.clone()

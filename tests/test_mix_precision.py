@@ -1,0 +1,127 @@
+"""Main-grad mixed precision tests (VERDICT r2 fleet-utils gap).
+
+Reference contract (fleet/utils/mix_precision_utils.py): bf16 compute,
+fp32 main_grad accumulation across micro-batches, optimizer steps on fp32
+masters — micro-batch grad accumulation must NOT lose bf16 precision, and
+params must stay bf16 after the step.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.utils.mix_precision_utils import (
+    MixPrecisionLayer, MixPrecisionOptimizer, MixPrecisionScaler)
+from paddle_tpu.optimizer import SGD
+
+
+def _mk(seed=0):
+    np.random.seed(seed)
+    m = nn.Linear(8, 4)
+    return m
+
+
+class TestMainGrad:
+    def test_params_become_bf16_and_main_grad_fp32(self):
+        m = MixPrecisionLayer(_mk(), dtype="bfloat16")
+        p = m._layers.weight
+        assert p._value.dtype == jnp.bfloat16
+        x = paddle.to_tensor(np.ones((2, 8), np.float32).astype(jnp.bfloat16))
+        loss = m(x).sum()
+        loss.backward()
+        assert p.main_grad is not None
+        assert p.main_grad._value.dtype == jnp.float32
+
+    def test_micro_batch_accumulation_fp32_exact(self):
+        """Accumulating K tiny grads must happen in fp32: in bf16 the
+        small addends would be swallowed."""
+        m = MixPrecisionLayer(_mk(), dtype="bfloat16")
+        p = m._layers.weight
+        big = paddle.to_tensor(np.full((1, 8), 256.0, np.float32)
+                               .astype(jnp.bfloat16))
+        tiny = paddle.to_tensor(np.full((1, 8), 0.5, np.float32)
+                                .astype(jnp.bfloat16))
+        m(big).sum().backward()
+        p.grad = None
+        for _ in range(4):
+            m(tiny).sum().backward()
+            p.grad = None
+        got = np.asarray(p.main_grad._value, np.float32)[:, 0]
+        # 256 + 4*0.5 = 258; bf16 running sum would round each +0.5 away
+        np.testing.assert_allclose(got, 258.0, rtol=1e-6)
+
+    def test_optimizer_steps_master_weights(self):
+        m = MixPrecisionLayer(_mk(), dtype="bfloat16")
+        opt = MixPrecisionOptimizer(
+            SGD(learning_rate=0.5,
+                parameters=list(m._layers.parameters())))
+        p = m._layers.weight
+        w0 = np.asarray(p._value, np.float32).copy()
+        x = paddle.to_tensor(np.ones((2, 8), np.float32).astype(jnp.bfloat16))
+        m(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        assert p._value.dtype == jnp.bfloat16        # stays low precision
+        w1 = np.asarray(p._value, np.float32)
+        assert not np.allclose(w0, w1)               # actually stepped
+        assert p.main_grad is None                   # cleared
+        # master drift: repeated tiny steps apply exactly through fp32
+        master = opt._masters[id(p)]
+        assert master.dtype == jnp.float32
+
+    def test_scaler_shim(self):
+        s = MixPrecisionScaler()
+        loss = paddle.to_tensor(np.float32(2.0))
+        assert float(s.scale(loss).value) == 2.0
+
+
+class TestMomentDtype:
+    def test_bf16_moments_fp32_math(self):
+        from paddle_tpu.optimizer.functional import adamw_init, adamw_update
+
+        params = {"w": jnp.ones((8, 8), jnp.bfloat16)}
+        st = adamw_init(params, moment_dtype=jnp.bfloat16)
+        assert st.m["w"].dtype == jnp.bfloat16
+        g = {"w": jnp.full((8, 8), 0.01, jnp.bfloat16)}
+        st, params = adamw_update(g, st, params, lr=1e-2)
+        assert st.m["w"].dtype == jnp.bfloat16       # stored compact
+        assert params["w"].dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(st.v["w"], np.float32)).all()
+
+    def test_default_unchanged(self):
+        from paddle_tpu.optimizer.functional import adamw_init
+
+        st = adamw_init({"w": jnp.ones((4,), jnp.bfloat16)})
+        assert st.m["w"].dtype == jnp.float32
+
+
+class TestHybridParallelInferenceHelper:
+    def test_wrap_model_sharded_forward_parity(self):
+        from paddle_tpu.distributed.fleet.utils.hybrid_parallel_inference \
+            import HybridParallelInferenceHelper
+        from paddle_tpu.distributed.topology import build_mesh, set_mesh
+        from paddle_tpu.distributed.fleet.layers.mpu import (
+            ColumnParallelLinear, RowParallelLinear)
+
+        from paddle_tpu.distributed.topology import get_mesh
+
+        prev = get_mesh()
+        mesh = build_mesh(mp=2, dp=4)
+        set_mesh(mesh)
+        self._prev_mesh = prev
+        m = nn.Sequential(
+            ColumnParallelLinear(16, 32, gather_output=False),
+            RowParallelLinear(32, 8, input_is_parallel=True))
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        ref = m(paddle.to_tensor(x))
+        helper = HybridParallelInferenceHelper(num_mp=2, mesh=mesh)
+        fwd, params = helper.wrap_model(m)
+        out = fwd(params, x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(ref.value), rtol=2e-5,
+                                   atol=2e-6)
+        # TP placement actually happened: some param is mp-sharded
+        assert any("mp" in str(v.sharding.spec) for v in params.values())
+        set_mesh(self._prev_mesh)  # don't leak the mp mesh to other tests

@@ -92,6 +92,65 @@ def our_surface(attr_path: str):
     return set(dir(obj))
 
 
+_GATED_RE = re.compile(
+    r"raise\s+(NotImplementedError|RuntimeError|ImportError)\b"
+    r"|_gated\(|_require\(")
+
+
+def classify(obj) -> str:
+    """Behavior smoke (VERDICT r2 #10: 'present' != 'works'). A name is
+    'gated' when its body (or __init__/__call__) immediately raises — the
+    raise-on-call stub pattern — so 100% name parity can't hide stubs.
+
+    'ok' = resolves and is not a gated stub; 'value' = non-callable
+    constant/module. Static inspection, not invocation: calling arbitrary
+    public fns without their example args would be both unsafe and a
+    false negative generator."""
+    import inspect
+
+    if not callable(obj):
+        return "value"
+    fn = obj
+    if isinstance(obj, type):
+        fn = obj.__dict__.get("__init__", obj.__init__)
+    try:
+        fn = inspect.unwrap(fn)
+        src = inspect.getsource(fn)
+    except (OSError, TypeError):
+        return "ok"          # C/builtin: callable by construction
+    # only the first statements matter: a guard deep in a big function is
+    # input validation, not a stub
+    head = "\n".join(src.splitlines()[:12])
+    if _GATED_RE.search(head) and "def " in src:
+        body_lines = [l.strip() for l in src.splitlines()
+                      if l.strip() and not l.strip().startswith(
+                          ("#", "def ", "@", '"', "'", "r'", 'r"'))]
+        # a stub's FIRST real statement raises
+        if body_lines and body_lines[0].startswith("raise "):
+            return "gated"
+    return "ok"
+
+
+def smoke_module(attr_path: str, names):
+    """Classify each present name → {'ok': [...], 'gated': [...],
+    'value': [...]}."""
+    import paddle_tpu as paddle
+
+    obj = paddle
+    if attr_path:
+        for part in attr_path.split("."):
+            obj = getattr(obj, part)
+    out = {"ok": [], "gated": [], "value": []}
+    for n in names:
+        root = n.split(".")[0]
+        try:
+            target = getattr(obj, root)
+        except AttributeError:
+            continue
+        out[classify(target)].append(root)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ref", default="/root/reference")
@@ -100,7 +159,7 @@ def main():
     base = os.path.join(args.ref, "python", "paddle")
 
     report = {}
-    total_ref = total_have = 0
+    total_ref = total_have = total_gated = total_ok = 0
     top_extra = parse_all(os.path.join(base, "tensor/__init__.py")) or []
     for rel, ours in MODULES:
         if ours is None:
@@ -115,19 +174,30 @@ def main():
         except AttributeError:
             have = set()
         missing = sorted(n for n in ref_names if n.split(".")[0] not in have)
+        smoke = smoke_module(ours, ref_names)
         total_ref += len(ref_names)
         total_have += len(ref_names) - len(missing)
+        total_gated += len(smoke["gated"])
+        total_ok += len(smoke["ok"]) + len(smoke["value"])
         report["paddle." + ours if ours else "paddle"] = {
-            "ref": len(ref_names), "missing": missing}
+            "ref": len(ref_names), "missing": missing,
+            "gated": sorted(smoke["gated"])}
         tag = "OK " if not missing else f"{len(missing):3d} missing"
+        gtag = "" if not smoke["gated"] else f"  {len(smoke['gated'])} gated"
         print(f"{('paddle.' + ours).rstrip('.'):34s} "
-              f"{len(ref_names) - len(missing):4d}/{len(ref_names):4d} {tag}")
+              f"{len(ref_names) - len(missing):4d}/{len(ref_names):4d} "
+              f"{tag}{gtag}")
     pct = 100.0 * total_have / max(total_ref, 1)
-    print(f"\nTOTAL {total_have}/{total_ref} ({pct:.1f}%)")
+    wpct = 100.0 * total_ok / max(total_ref, 1)
+    print(f"\nTOTAL present {total_have}/{total_ref} ({pct:.1f}%)   "
+          f"works (present & not gated) {total_ok}/{total_ref} "
+          f"({wpct:.1f}%), gated stubs: {total_gated}")
     if args.out:
         with open(args.out, "w") as f:
             json.dump({"total_ref": total_ref, "total_have": total_have,
-                       "pct": round(pct, 2), "modules": report}, f, indent=1)
+                       "total_works": total_ok, "total_gated": total_gated,
+                       "pct": round(pct, 2), "works_pct": round(wpct, 2),
+                       "modules": report}, f, indent=1)
         print(f"wrote {args.out}")
 
 
