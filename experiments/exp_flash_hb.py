@@ -1,137 +1,76 @@
-"""Prototype: head-batched flash fwd — all q heads per block share the
-K/V block (KV HBM traffic /H, grid /H). Standalone experiment before
-integrating. Run: python experiments/exp_flash_hb.py
+"""Head-batched (BSHD-native) vs per-head (BHSD) flash kernel on TPU.
+
+Measures, at the 350M bench shapes, the END-TO-END cost each path implies:
+kernel fwd / fwd+bwd PLUS the BSHD<->BHSD transposes the per-head path
+forces on the caller. Decides FLAGS_flash_head_batched.
+(The round-2 fwd-only prototype this file held is superseded by the real
+fwd+bwd kernel in paddle_tpu/ops/flash_attention_hb.py.)
+
+Run: python experiments/exp_flash_hb.py
 """
-import functools
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
-NEG = -1e30
-
-
-def _fwd_hb_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                   sm_scale, causal, block_q, block_k, h):
-    b, iq, ik = (pl.program_id(i) for i in range(3))
-    nk = pl.num_programs(2)
-
-    @pl.when(ik == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, NEG)
-        l_ref[...] = jnp.zeros_like(l_ref)
-
-    def _compute():
-        q = q_ref[0]                        # (H, bq, D)
-        k = k_ref[0]                        # (H, bk, D)
-        v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * sm_scale  # (H, bq, bk)
-        if causal:
-            qpos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = (kpos <= qpos)[None]
-            s = jnp.where(valid, s, NEG)
-        m_prev = m_ref[...]                 # (H, bq)
-        l_prev = l_ref[...]
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[..., None])
-        if causal:
-            p = jnp.where(valid, p, 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc_ref[...] = (acc_ref[...] * alpha[..., None]
-                        + jax.lax.dot_general(
-                            p.astype(v.dtype), v,
-                            (((2,), (1,)), ((0,), (0,))),
-                            preferred_element_type=jnp.float32))
-        m_ref[...] = m_new
-        l_ref[...] = l_new
-
-    if causal:
-        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_compute)
-    else:
-        _compute()
-
-    @pl.when(ik == nk - 1)
-    def _fin():
-        l_safe = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = (acc_ref[...] / l_safe[..., None]).astype(o_ref.dtype)
-
-
-def flash_fwd_hb(q, k, v, causal=True, block_q=512, block_k=512):
-    bsz, h, s, d = q.shape
-    bq, bk = min(block_q, s), min(block_k, s)
-    nq, nk = s // bq, s // bk
-    return pl.pallas_call(
-        functools.partial(_fwd_hb_kernel, sm_scale=1.0 / np.sqrt(d),
-                          causal=causal, block_q=bq, block_k=bk, h=h),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        grid=(bsz, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, h, bq, d), lambda b, i, j: (b, 0, i, 0)),
-            pl.BlockSpec((1, h, bk, d), lambda b, i, j: (b, 0, j, 0)),
-            pl.BlockSpec((1, h, bk, d), lambda b, i, j: (b, 0, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, h, bq, d), lambda b, i, j: (b, 0, i, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((h, bq, d), jnp.float32),
-            pltpu.VMEM((h, bq), jnp.float32),
-            pltpu.VMEM((h, bq), jnp.float32),
-        ],
-    )(q, k, v)
 
 
 def main():
+    import jax
+    import jax.numpy as jnp
+
     cache = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".jax_cache")
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from exp_micro import timed
+    from paddle_tpu.ops.flash_attention_hb import flash_attention_bshd_hb
     from paddle_tpu.ops.flash_attention_kernel import flash_attention_bhsd
 
-    B, H, S, D = 8, 8, 2048, 128
-    key = jax.random.PRNGKey(0)
-    q = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)
-    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, D), jnp.bfloat16)
-    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D), jnp.bfloat16)
+    B, S, H, D = 8, 2048, 8, 128
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
 
-    # numerical parity vs the production kernel
-    o_ref = flash_attention_bhsd(q[:1, :, :1024], k[:1, :, :1024],
-                                 v[:1, :, :1024], causal=True)
-    o_hb = flash_fwd_hb(q[:1, :, :1024], k[:1, :, :1024], v[:1, :, :1024],
-                        causal=True, block_q=256, block_k=256)
-    err = float(jnp.max(jnp.abs(o_hb.astype(jnp.float32)
-                                - o_ref.astype(jnp.float32))))
-    print("parity maxerr:", err, flush=True)
-    assert err < 2e-2
+    def per_head(q, k, v):
+        # what ops/pallas.flash_attention does today: transpose around
+        # the BHSD kernel — the transposes are PART of this path's cost
+        qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+        out = flash_attention_bhsd(qt, kt, vt, causal=True)
+        return jnp.swapaxes(out, 1, 2)
 
-    att = 2 * B * H * S * S * D
-    for bq, bk in [(256, 256), (256, 512), (512, 256), (128, 512),
-                   (512, 512), (128, 1024), (256, 1024)]:
+    variants = {"per_head_1024": per_head}
+    for blk in (256, 512):
+        variants[f"hb_{blk}"] = (
+            lambda q, k, v, b=blk: flash_attention_bshd_hb(
+                q, k, v, causal=True, block_q=b, block_k=b))
+
+    results = {}
+    for name, f in variants.items():
         try:
-            t = timed(lambda q, k, v: flash_fwd_hb(q, k, v, causal=True,
-                                                   block_q=bq, block_k=bk),
-                      (q, k, v), iters=10)
-            print(json.dumps({"bq": bq, "bk": bk,
-                              "hb_fwd_ms": round(t * 1e3, 3),
-                              "mxu_pct": round(100 * att / t / 394e12, 1)}),
-                  flush=True)
-        except Exception as e:
-            print(json.dumps({"bq": bq, "bk": bk,
-                              "error": str(e)[:120]}), flush=True)
+            fwd_ms = timed(jax.jit(f), (q, k, v))
+
+            def loss(q, k, v, _f=f):
+                return jnp.sum(_f(q, k, v).astype(jnp.float32))
+
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            bwd_ms = timed(g, (q, k, v))
+            results[name] = {"fwd_ms": round(fwd_ms, 3),
+                             "fwdbwd_ms": round(bwd_ms, 3)}
+        except Exception as e:  # noqa: BLE001 - report per-variant
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        print(json.dumps({name: results[name]}), flush=True)
+
+    timed_rs = [(r["fwdbwd_ms"], n) for n, r in results.items()
+                if "fwdbwd_ms" in r]
+    if timed_rs:
+        best = min(timed_rs)
+        print(json.dumps({"best": best[1], "fwdbwd_ms": best[0],
+                          "flip_flag": best[1].startswith("hb_")}))
 
 
 if __name__ == "__main__":

@@ -38,6 +38,7 @@ define_flag("FLAGS_cudnn_deterministic", False)
 define_flag("FLAGS_embedding_deterministic", 0)
 define_flag("FLAGS_benchmark", False)
 define_flag("FLAGS_use_pallas_kernels", True)      # TPU-native: route fused ops to Pallas
+define_flag("FLAGS_flash_head_batched", False)    # BSHD-native flash (opt-in until TPU-measured)
 define_flag("FLAGS_use_autotune", True)            # kernel autotune cache (ops/autotune.py)
 define_flag("FLAGS_log_level", 0)
 

@@ -1,0 +1,327 @@
+"""Head-batched flash attention: native ``[B, S, H, D]`` layout.
+
+The BHSD kernel (flash_attention_kernel.py) forces BSHD->BHSD transposes
+around every attention call — ~11ms/step of pure HBM relayout at the
+350M bench shapes (PERF.md). A per-head BSHD block (1, bq, 1, D) is
+illegal on TPU (the H dim breaks the (8,128) tiling), but a HEAD-BATCHED
+block (1, bq, H, D) is legal: the last two dims are (H, D) = (8, 128).
+This kernel processes ALL heads per grid step:
+
+- scores are a single batched ``dot_general`` over H: (H, bq, bk) in VMEM,
+- online-softmax stats are (H, bq, 1),
+- the grid drops the head dimension: (B, nq, nk) — H x fewer grid steps.
+
+VMEM bounds the block size: scores+probs at fp32 are 2·H·bq·bk·4 bytes
+(8MB at H=8, bq=bk=512), so default blocks are 512 here vs 1024 for the
+per-head kernel. Whether the transpose savings beat the smaller blocks is
+an EMPIRICAL question — `experiments/exp_flash_hb.py` measures it; the
+router (ops/pallas.py) keeps this path opt-in via FLAGS_flash_head_batched
+until the TPU numbers say otherwise.
+
+Scope: Hq == Hkv (the bench config), dropout-free. GQA/dropout route to
+the per-head kernel.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention_kernel import (_NEG_INF, _VMEM, _apply_causal_mask,
+                                     _interpret, _pick_block)
+
+__all__ = ["flash_attention_bshd_hb", "supports_hb"]
+
+# scores+probs live in VMEM at fp32: 2 * H * bq * bk * 4 bytes must fit
+# alongside q/k/v blocks and the fp32 accumulators (~16MB VMEM/core)
+_VMEM_SCORE_BUDGET = 16 << 20
+
+
+def supports_hb(q_shape, k_shape, dropout_p: float,
+                interpret: Optional[bool] = None,
+                block: int = 512) -> bool:
+    b, sq, h, d = q_shape
+    hkv, sk = k_shape[2], k_shape[1]
+    it = _interpret() if interpret is None else interpret
+    return (h == hkv and dropout_p == 0.0
+            and 2 * h * block * block * 4 <= _VMEM_SCORE_BUDGET
+            and _pick_block(sq, block, it) is not None
+            and _pick_block(sk, block, it) is not None)
+
+
+def _scores_hb(q, k, sm_scale, causal, iq, ik, bq, bk, offset):
+    """(H, bq, bk) fp32 scores; masking shared with the per-head kernel
+    (_apply_causal_mask) so the alignment convention cannot diverge."""
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((1,), (1,))),      # batch H, contract D
+        preferred_element_type=jnp.float32) * sm_scale
+    return _apply_causal_mask(s, causal, iq, ik, bq, bk, offset,
+                              lead_batch=True)
+
+
+def _fwd_kernel_hb(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                   l_ref, *, sm_scale, causal, offset, bq, bk):
+    b, iq, ik = (pl.program_id(i) for i in range(3))
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0]                          # (bq, H, D)
+        k = k_ref[0]                          # (bk, H, D)
+        v = v_ref[0]
+        s, valid = _scores_hb(q, k, sm_scale, causal, iq, ik, bq, bk,
+                              offset)         # (H, bq, bk)
+        m_prev = m_ref[:, :, 0:1]             # (H, bq, 1)
+        l_prev = l_ref[:, :, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, :, 0:1] = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
+        # (H, bq, bk) @ (bk, H, D) batched over H -> (H, bq, D)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[:, :, 0:1] = m_new
+
+    if causal:
+        needed = ik * bk <= iq * bq + bq - 1 + offset
+        pl.when(needed)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_ref[:, :, 0:1]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = jnp.transpose(acc_ref[...] / l_safe,
+                                 (1, 0, 2)).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, :, 0:1] + jnp.log(l_safe))[:, :, 0]
+
+
+def _fwd_impl_hb(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    bsz, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq = _pick_block(sq, block_q, interpret)
+    bk = _pick_block(sk, block_k, interpret)
+    nq, nk = sq // bq, sk // bk
+    offset = sk - sq
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_hb, sm_scale=sm_scale, causal=causal,
+                          offset=offset, bq=bq, bk=bk),
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((bsz, h, sq), jnp.float32)],
+        grid=(bsz, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, h, d), lambda b, i, j: (b, i, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda b, i, j: (b, j, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda b, i, j: (b, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, h, d), lambda b, i, j: (b, i, 0, 0)),
+            pl.BlockSpec((1, h, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        scratch_shapes=[
+            _VMEM((h, bq, d), jnp.float32),
+            _VMEM((h, bq, 128), jnp.float32),
+            _VMEM((h, bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_dq_kernel_hb(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, acc_ref, *, sm_scale, causal, offset, bq, bk):
+    b, iq, ik = (pl.program_id(i) for i in range(3))
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = jnp.transpose(do_ref[0], (1, 0, 2))      # (H, bq, D)
+        lse = lse_ref[0][:, :, None]                  # (H, bq, 1)
+        delta = delta_ref[0][:, :, None]
+        s, valid = _scores_hb(q, k, sm_scale, causal, iq, ik, bq, bk,
+                              offset)
+        p = jnp.exp(s - lse)
+        if causal and offset < 0:
+            p = jnp.where(valid, p, 0.0)
+        # dP = dO @ V^T batched over H: (H,bq,D) x (bk,H,D) -> (H,bq,bk)
+        dpd = jax.lax.dot_general(
+            do, v, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        ds = p * (dpd - delta)
+        # dQ += dS @ K batched: (H,bq,bk) x (bk,H,D) -> (H,bq,D)
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    if causal:
+        needed = ik * bk <= iq * bq + bq - 1 + offset
+        pl.when(needed)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = jnp.transpose(acc_ref[...], (1, 0, 2)).astype(
+            dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_hb(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                       offset, bq, bk):
+    b, ik, iq = (pl.program_id(i) for i in range(3))
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = jnp.transpose(do_ref[0], (1, 0, 2))      # (H, bq, D)
+        lse = lse_ref[0][:, :, None]
+        delta = delta_ref[0][:, :, None]
+        s, valid = _scores_hb(q, k, sm_scale, causal, iq, ik, bq, bk,
+                              offset)
+        p = jnp.exp(s - lse)
+        if causal and offset < 0:
+            p = jnp.where(valid, p, 0.0)
+        dpd = jax.lax.dot_general(
+            do, v, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        ds = p * (dpd - delta)
+        # dV += P^T @ dO batched: (H,bq,bk)^T x (H,bq,D) -> (H,bk,D)
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        # dK += dS^T @ Q batched: (H,bq,bk)^T x (bq,H,D) -> (H,bk,D)
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    if causal:
+        needed = ik * bk <= iq * bq + bq - 1 + offset
+        pl.when(needed)(_compute)
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = jnp.transpose(dk_acc[...], (1, 0, 2)).astype(
+            dk_ref.dtype)
+        dv_ref[0] = jnp.transpose(dv_acc[...], (1, 0, 2)).astype(
+            dv_ref.dtype)
+
+
+def _bwd_impl_hb(q, k, v, out, lse, do, causal, sm_scale, block_q, block_k,
+                 interpret):
+    bsz, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq = _pick_block(sq, block_q, interpret)
+    bk = _pick_block(sk, block_k, interpret)
+    nq, nk = sq // bq, sk // bk
+    offset = sk - sq
+    # delta = rowsum(dO * O): [B, H, S]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                            # [B, S, H]
+    delta = jnp.transpose(delta, (0, 2, 1))             # [B, H, S] (small)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_hb, sm_scale=sm_scale,
+                          causal=causal, offset=offset, bq=bq, bk=bk),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bsz, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, h, d), lambda b, i, j: (b, i, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda b, i, j: (b, j, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda b, i, j: (b, j, 0, 0)),
+            pl.BlockSpec((1, bq, h, d), lambda b, i, j: (b, i, 0, 0)),
+            pl.BlockSpec((1, h, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, h, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, h, d), lambda b, i, j: (b, i, 0, 0)),
+        scratch_shapes=[_VMEM((h, bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_hb, sm_scale=sm_scale,
+                          causal=causal, offset=offset, bq=bq, bk=bk),
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        grid=(bsz, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, h, d), lambda b, j, i: (b, i, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda b, j, i: (b, j, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda b, j, i: (b, j, 0, 0)),
+            pl.BlockSpec((1, bq, h, d), lambda b, j, i: (b, i, 0, 0)),
+            pl.BlockSpec((1, h, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, h, bq), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, h, d), lambda b, j, i: (b, j, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda b, j, i: (b, j, 0, 0)),
+        ],
+        scratch_shapes=[_VMEM((h, bk, d), jnp.float32),
+                        _VMEM((h, bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_hb(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, _ = _fwd_impl_hb(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return out
+
+
+def _flash_hb_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _fwd_impl_hb(q, k, v, causal, sm_scale, block_q, block_k,
+                            interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_hb_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _bwd_impl_hb(q, k, v, out, lse, do, causal, sm_scale,
+                        block_q, block_k, interpret)
+
+
+_flash_hb.defvjp(_flash_hb_fwd, _flash_hb_bwd)
+
+
+def flash_attention_bshd_hb(q, k, v, *, causal: bool = False,
+                            sm_scale: Optional[float] = None,
+                            block_q: int = 512, block_k: int = 512,
+                            interpret: Optional[bool] = None):
+    """Head-batched flash attention over native ``[B, S, H, D]`` tensors
+    (no layout transposes). Requires Hq == Hkv and no dropout — the router
+    falls back to :func:`flash_attention_bhsd` otherwise."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    it = _interpret() if interpret is None else interpret
+    return _flash_hb(q, k, v, causal, float(sm_scale), block_q, block_k, it)

@@ -119,16 +119,42 @@ def _causal_valid(iq, ik, block_q, block_k, offset):
     return kpos <= qpos + offset
 
 
+def _apply_causal_mask(s, causal, iq, ik, block_q, block_k, offset,
+                       lead_batch: bool = False):
+    """Causal masking for a score block (``s`` is (bq, bk), or (H, bq, bk)
+    with ``lead_batch``), SPECIALIZED to diagonal blocks: blocks entirely
+    below the causal boundary skip the iota/compare/select passes (at
+    1024x1024 those are 3 extra VPU sweeps — most blocks of a long-
+    sequence causal kernel are fully valid). Shared by the per-head AND
+    head-batched kernels so the alignment convention cannot diverge.
+
+    Returns (s, valid); valid is non-None only when offset < 0, the one
+    case where rows can be globally all-masked and the caller must re-mask
+    probabilities (there the mask is applied unconditionally — the valid
+    matrix is needed anyway, so the cond would buy nothing)."""
+    if not causal:
+        return s, None
+
+    def mask(x):
+        v = _causal_valid(iq, ik, block_q, block_k, offset)
+        return jnp.where(v[None] if lead_batch else v, x, _NEG_INF), v
+
+    if offset < 0:
+        s, v = mask(s)
+        return s, (v[None] if lead_batch else v)
+    # does this block contain ANY masked entry? (bottom-right alignment:
+    # the block's last key position vs its first query's boundary)
+    is_diag = (ik * block_k + block_k - 1) > (iq * block_q + offset)
+    s = jax.lax.cond(is_diag, lambda x: mask(x)[0], lambda x: x, s)
+    return s, None
+
+
 def _block_scores(q, k, sm_scale, causal, iq, ik, block_q, block_k, offset):
-    """Masked fp32 score block; returns (s, valid) with valid=None when not
-    causal."""
+    """Masked fp32 score block for the per-head kernel."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale
-    if not causal:
-        return s, None
-    valid = _causal_valid(iq, ik, block_q, block_k, offset)
-    return jnp.where(valid, s, _NEG_INF), valid
+    return _apply_causal_mask(s, causal, iq, ik, block_q, block_k, offset)
 
 
 def _dropped(p, seed, b, h, iq, ik, block_q, block_k, dropout_p):
@@ -169,7 +195,10 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_cur = jnp.max(s, axis=-1, keepdims=True)      # (bq, 1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
-        if causal:
+        if valid is not None:
+            # offset < 0 only: globally all-masked rows have m_new == -inf
+            # and exp(0) == 1 garbage; offset >= 0 needs no re-mask — the
+            # masked s give exp(-1e30 - finite) == 0 exactly
             p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)                 # (bq, 1)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)

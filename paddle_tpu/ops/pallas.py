@@ -96,6 +96,21 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: float = None,
     """
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    # head-batched BSHD-native path: no layout transposes (PERF.md ~11ms/
+    # step at bench shapes). Opt-in until TPU-measured faster — flip
+    # FLAGS_flash_head_batched once experiments/exp_flash_hb.py says so.
+    from ..framework.flags import get_flags
+
+    if get_flags("FLAGS_flash_head_batched")["FLAGS_flash_head_batched"] \
+            and _on_tpu():
+        from .flash_attention_hb import (flash_attention_bshd_hb,
+                                         supports_hb)
+
+        if supports_hb(q.shape, k.shape, dropout_p):
+            return flash_attention_bshd_hb(q, k, v, causal=causal,
+                                           sm_scale=scale)
+
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
