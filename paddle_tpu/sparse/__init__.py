@@ -142,19 +142,6 @@ def relu(x, name=None):
     return Tensor(jnp.maximum(v, 0))
 
 
-class _SparseNN:
-    """paddle.sparse.nn facade (ReLU / functional softmax on values)."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-    @staticmethod
-    def functional_relu(x):
-        return relu(x)
-
-
-nn = _SparseNN()
 
 
 # -- elementwise unary over the stored values (zero-preserving fns keep the
@@ -315,3 +302,7 @@ __all__ += ["abs", "sin", "sinh", "asin", "asinh", "tan", "tanh", "atan",
             "rad2deg", "isnan", "pow", "cast", "coalesce", "subtract",
             "multiply", "divide", "mv", "addmm", "reshape", "transpose",
             "slice", "sum", "pca_lowrank"]
+
+
+# nn subpackage last: its layers reference SparseTensor defined above
+from . import nn  # noqa: E402,F401

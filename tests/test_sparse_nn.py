@@ -1,0 +1,153 @@
+"""paddle.sparse.nn tests (VERDICT r2 sparse-depth gap).
+
+Reference contract (python/paddle/sparse/nn): activations preserve
+structure, softmax normalizes over PRESENT entries only, BatchNorm
+normalizes value channels over active elements, convs/pool keep sparse
+in/out, SubmConv keeps the input's active sites.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.sparse as sparse
+from paddle_tpu.sparse import nn as snn
+
+
+def coo_2d():
+    # [[0, 2, 0], [-3, 0, 4]]
+    return sparse.sparse_coo_tensor(
+        np.asarray([[0, 1, 1], [1, 0, 2]]),
+        np.asarray([2.0, -3.0, 4.0], np.float32), shape=(2, 3))
+
+
+class TestActivations:
+    def test_relu_structure_preserved(self):
+        out = snn.ReLU()(coo_2d())
+        assert out.is_sparse_coo()
+        np.testing.assert_allclose(np.asarray(out.to_dense().value),
+                                   [[0, 2, 0], [0, 0, 4]])
+
+    def test_relu6(self):
+        x = sparse.sparse_coo_tensor(np.asarray([[0], [0]]),
+                                     np.asarray([9.0], np.float32), (1, 1))
+        out = snn.ReLU6()(x)
+        assert float(np.asarray(out.to_dense().value)[0, 0]) == 6.0
+
+    def test_leaky_relu(self):
+        out = snn.LeakyReLU(0.1)(coo_2d())
+        np.testing.assert_allclose(np.asarray(out.to_dense().value),
+                                   [[0, 2, 0], [-0.3, 0, 4]], rtol=1e-6)
+
+
+class TestSoftmax:
+    def test_present_entries_only(self):
+        """Missing entries are -inf, NOT zero: row [0, 2, 0] with one
+        present entry softmaxes to 1.0 at that entry."""
+        out = snn.Softmax()(coo_2d())
+        d = np.asarray(out.to_dense().value)
+        np.testing.assert_allclose(d[0], [0, 1.0, 0], atol=1e-6)
+        # row 1 has entries -3 and 4 at cols 0, 2
+        e = np.exp([-3.0 - 4.0, 0.0])  # shifted by max
+        np.testing.assert_allclose(d[1], [e[0] / e.sum(), 0,
+                                          e[1] / e.sum()], rtol=1e-5)
+
+
+class TestBatchNorm:
+    def test_normalizes_active_values_only(self):
+        # 3 active sites with C=4 channel vectors
+        vals = np.random.RandomState(0).randn(3, 4).astype(np.float32) * 5
+        x = sparse.sparse_coo_tensor(np.asarray([[0, 2, 5]]), vals,
+                                     shape=(8, 4))
+        bn = snn.BatchNorm(4)
+        out = bn(x)
+        got = np.asarray(out.values().value)
+        np.testing.assert_allclose(got.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(got.std(0), 1.0, atol=1e-2)
+        # structure untouched
+        np.testing.assert_array_equal(
+            np.asarray(out.indices().value), [[0, 2, 5]])
+
+    def test_sync_variant_same_math(self):
+        vals = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        x = sparse.sparse_coo_tensor(np.asarray([[0, 1, 2, 3]]), vals,
+                                     shape=(4, 2))
+        a = snn.BatchNorm(2)(x)
+        b = snn.SyncBatchNorm(2)(x)
+        np.testing.assert_allclose(np.asarray(a.values().value),
+                                   np.asarray(b.values().value), rtol=1e-6)
+
+
+class TestConvPool:
+    def test_conv3d_matches_dense(self):
+        rng = np.random.RandomState(0)
+        dense = rng.randn(1, 4, 4, 4, 2).astype(np.float32)
+        dense[dense < 0.5] = 0  # sparsify
+        x = sparse.SparseTensor(
+            jax.experimental.sparse.BCOO.fromdense(jnp.asarray(dense),
+                                                   n_dense=1))
+        conv = snn.Conv3D(2, 3, kernel_size=2, bias_attr=False)
+        out = conv(x)
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(dense), conv.weight.value, (1, 1, 1), [(0, 0)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        np.testing.assert_allclose(np.asarray(out.to_dense().value),
+                                   np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv_keeps_active_sites(self):
+        rng = np.random.RandomState(0)
+        dense = np.zeros((1, 4, 4, 1), np.float32)
+        dense[0, 1, 1, 0] = 1.0
+        dense[0, 2, 3, 0] = 2.0
+        x = sparse.SparseTensor(
+            jax.experimental.sparse.BCOO.fromdense(jnp.asarray(dense),
+                                                   n_dense=1))
+        conv = snn.SubmConv2D(1, 1, kernel_size=3, bias_attr=False)
+        out = np.asarray(conv(x).to_dense().value)
+        active = (dense != 0).any(-1)
+        assert (out[~active] == 0).all()   # submanifold: no dilation
+
+    def test_max_pool3d(self):
+        dense = np.zeros((1, 2, 2, 2, 1), np.float32)
+        dense[0, 0, 0, 0, 0] = 3.0
+        dense[0, 1, 1, 1, 0] = 5.0
+        x = sparse.SparseTensor(
+            jax.experimental.sparse.BCOO.fromdense(jnp.asarray(dense),
+                                                   n_dense=1))
+        out = snn.MaxPool3D(kernel_size=2)(x)
+        np.testing.assert_allclose(
+            np.asarray(out.to_dense().value).ravel(), [5.0])
+
+
+class TestReviewRegressions:
+    def test_softmax_preserves_csr(self):
+        x = sparse.sparse_csr_tensor(np.asarray([0, 1, 3]),
+                                     np.asarray([1, 0, 2]),
+                                     np.asarray([1.0, 2.0, 3.0], np.float32),
+                                     (2, 3))
+        out = snn.Softmax()(x)
+        assert out.is_sparse_csr()
+
+    def test_subm_stride_raises(self):
+        with pytest.raises(ValueError, match="stride 1"):
+            snn.functional.subm_conv2d(coo_2d(), np.zeros((1, 1, 1, 1)),
+                                       stride=2)
+
+    def test_maxpool_list_padding(self):
+        dense = np.ones((1, 2, 2, 2, 1), np.float32)
+        x = sparse.SparseTensor(
+            jax.experimental.sparse.BCOO.fromdense(jnp.asarray(dense),
+                                                   n_dense=1))
+        out = snn.MaxPool3D(kernel_size=2, padding=[1, 1, 1])(x)
+        # stride defaults to kernel: (2 + 2*1 - 2)//2 + 1 = 2 per dim
+        assert np.asarray(out.to_dense().value).shape == (1, 2, 2, 2, 1)
+
+    def test_conv_weights_reproducible_with_seed(self):
+        import paddle_tpu as paddle
+
+        paddle.seed(123)
+        w1 = np.asarray(snn.Conv3D(2, 3, 2).weight.value)
+        paddle.seed(123)
+        w2 = np.asarray(snn.Conv3D(2, 3, 2).weight.value)
+        np.testing.assert_array_equal(w1, w2)
