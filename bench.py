@@ -348,6 +348,97 @@ def moe_bench():
         "platform": platform}))
 
 
+def vit_bench():
+    """BASELINE config 5: ViT-Huge fused-transformer INFERENCE imgs/sec.
+    Encoder = patch-embed conv + scan-over-layers pre-LN transformer with
+    the framework's flash kernel (non-causal), mean-pool head — the
+    fused_multi_transformer inference path at encoder shapes.
+    Run: python bench.py vit."""
+    import jax
+    import jax.numpy as jnp
+
+    platform = _devices_or_cpu_fallback()[0].platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        # ViT-H/14 at 224: hidden 1280, 32 layers, 16 heads, mlp 5120;
+        # mean-pool (no cls token) keeps 256 tokens — flash-block friendly
+        H, L, NH, MLP, P_, IMG, B = 1280, 32, 16, 5120, 14, 224, 32
+        dt = jnp.bfloat16
+    else:
+        H, L, NH, MLP, P_, IMG, B = 64, 2, 4, 128, 16, 64, 2
+        dt = jnp.float32
+    S = (IMG // P_) ** 2
+    hd = H // NH
+    rng = np.random.RandomState(0)
+
+    def mk(*s):
+        return jnp.asarray(rng.randn(*s).astype(np.float32) * 0.02, dt)
+
+    params = {
+        "patch": mk(P_, P_, 3, H), "pos": mk(1, S, H),
+        "ln1": jnp.ones((L, H), dt), "qkv": mk(L, H, 3 * H),
+        "proj": mk(L, H, H), "ln2": jnp.ones((L, H), dt),
+        "fc1": mk(L, H, MLP), "fc2": mk(L, MLP, H),
+        "head": mk(H, 1000),
+    }
+
+    def ln(x, w):
+        xf = x.astype(jnp.float32)
+        y = (xf - xf.mean(-1, keepdims=True)) * jax.lax.rsqrt(
+            xf.var(-1, keepdims=True) + 1e-6)
+        return (y * w.astype(jnp.float32)).astype(x.dtype)
+
+    def encoder_layer(x, lp):
+        from paddle_tpu.ops.pallas import flash_attention
+
+        b, s, _ = x.shape
+        xn = ln(x, lp["ln1"])
+        qkv = (xn @ lp["qkv"]).reshape(b, s, 3, NH, hd)
+        ctx = flash_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                              causal=False)
+        x = x + ctx.reshape(b, s, H) @ lp["proj"]
+        xn = ln(x, lp["ln2"])
+        return x + jax.nn.gelu(xn @ lp["fc1"]) @ lp["fc2"]
+
+    def fwd(pv, img):
+        x = jax.lax.conv_general_dilated(
+            img, pv["patch"], (P_, P_), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = x.reshape(img.shape[0], S, H) + pv["pos"]
+        x, _ = jax.lax.scan(
+            lambda c, lp: (encoder_layer(c, lp), None), x,
+            {k: pv[k] for k in ("ln1", "qkv", "proj", "ln2", "fc1", "fc2")})
+        return jnp.mean(x.astype(jnp.float32), axis=1) @ pv[
+            "head"].astype(jnp.float32)
+
+    def multi(pv, img, n):
+        def body(_, carry):
+            acc, img = carry
+            out = fwd(pv, img)
+            s = jnp.sum(out) * 1e-30
+            return acc + jnp.sum(out), img + s.astype(img.dtype)
+
+        acc, _ = jax.lax.fori_loop(0, n, body,
+                                   (jnp.zeros((), jnp.float32), img))
+        return acc
+
+    jitted = jax.jit(multi, static_argnums=(2,))
+    img = jnp.asarray(rng.randn(B, IMG, IMG, 3).astype(np.float32), dt)
+    steps = 10 if on_tpu else 2
+    _ = float(jitted(params, img, steps))          # compile + warm
+    t0 = time.perf_counter()
+    _ = float(jitted(params, img, steps))          # one dispatch
+    dt_s = time.perf_counter() - t0
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    print(json.dumps({
+        "metric": "vit_h_infer_imgs_per_sec" if on_tpu
+        else "vit_tiny_infer_imgs_per_sec",
+        "value": round(B * steps / dt_s, 1), "unit": "imgs/s",
+        "vs_baseline": 0.0,  # reference publishes no number (BASELINE.md)
+        "params": n_params, "platform": platform}))
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "train"
     if mode == "decode":
@@ -356,10 +447,13 @@ if __name__ == "__main__":
         resnet_bench()
     elif mode == "moe":
         moe_bench()
+    elif mode == "vit":
+        vit_bench()
     elif mode == "train":
         main(sys.argv[2] if len(sys.argv) > 2 else "350m")
     elif mode == "1.3b":
         main("1.3b")
     else:
         raise SystemExit(
-            f"unknown bench mode {mode!r} (train|decode|resnet|moe|1.3b)")
+            f"unknown bench mode {mode!r} "
+            "(train|decode|resnet|moe|vit|1.3b)")

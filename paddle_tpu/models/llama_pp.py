@@ -32,7 +32,8 @@ from .llama import LlamaConfig, _rope_cos_sin, apply_rotary_emb
 from .llama_functional import _layer_fwd, _rms
 
 __all__ = ["llama_pp_fns", "block_specs", "edge_specs", "moment_specs",
-           "build_llama_hybrid_step"]
+           "build_llama_hybrid_step", "save_hybrid_checkpoint",
+           "load_hybrid_checkpoint"]
 
 
 def llama_pp_fns(cfg: LlamaConfig, remat: bool = True,
@@ -176,3 +177,54 @@ def build_llama_hybrid_step(cfg: LlamaConfig, mesh: Mesh,
         return params["b"], params["e"], opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1, 2)), prepare
+
+
+def save_hybrid_checkpoint(path: str, blocks, edge):
+    """Persist hybrid-PP params in the CANONICAL layer-stacked layout, so a
+    checkpoint written at one (S, V) pipeline config reloads at any other
+    (the reference needs pp_parallel_adaptor.py to convert per-stage
+    checkpoints between pp degrees; storing the canonical form makes the
+    conversion a reshape at load)."""
+    from ..distributed.checkpoint import save_state_dict
+    from ..distributed.fleet.meta_parallel.pp_sharded import (
+        stacked_from_blocks)
+
+    sd = {f"stacked.{k}": v for k, v in stacked_from_blocks(blocks).items()}
+    sd.update({f"rest.{k}": v for k, v in edge.items()})
+    save_state_dict(sd, path)
+
+
+def load_hybrid_checkpoint(path: str, cfg: LlamaConfig, mesh: Mesh,
+                           num_virtual_stages: int = 1):
+    """Load a canonical checkpoint into the (possibly different) pipeline
+    layout of ``mesh``: returns (blocks, edge) raw-array dicts placed per
+    the hybrid specs (same types ``prepare`` produces). Resharding across
+    pp degrees is the blocks_from_stacked reshape + device_put.
+
+    NOTE: the restore materializes full arrays on the host before
+    device placement (orbax streaming into the BLOCK layout would need
+    per-leaf target structs — the canonical layout is reshaped, which
+    tensorstore cannot express). Fine single-host; multi-host 65B restores
+    should build target ShapeDtypeStructs from the model and use
+    distributed.checkpoint.load_state_dict directly."""
+    from ..core.tensor import Tensor
+    from ..distributed.checkpoint import load_state_dict
+    from ..distributed.fleet.meta_parallel.pp_sharded import (
+        blocks_from_stacked)
+
+    S = int(mesh.shape.get("pp", 1))
+    V = int(num_virtual_stages)
+    if cfg.num_hidden_layers % (S * V):
+        raise ValueError(
+            f"{cfg.num_hidden_layers} layers cannot split into {S} stages "
+            f"x {V} virtual chunks")
+    sd = load_state_dict(path)
+    raw = {k: (v._value if isinstance(v, Tensor) else v)
+           for k, v in sd.items()}   # type-symmetric with prepare()
+    stacked = {k[len("stacked."):]: v for k, v in raw.items()
+               if k.startswith("stacked.")}
+    rest = {k[len("rest."):]: v for k, v in raw.items()
+            if k.startswith("rest.")}
+    blocks = blocks_from_stacked(stacked, S, V)
+    return (_shard(blocks, block_specs(blocks.keys()), mesh),
+            _shard(rest, edge_specs(rest.keys()), mesh))

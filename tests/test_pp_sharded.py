@@ -203,3 +203,32 @@ class TestHybridStep:
 
     def test_interleaved_2x2_v2(self):
         self._run(dp=2, pp=2, mp=1, sharding=2, V=2)
+
+
+class TestHybridCheckpointReshape:
+    """5.4 depth: a checkpoint saved at pp=4 reloads at pp=2 (canonical
+    stacked layout — reference needs pp_parallel_adaptor for this)."""
+
+    def test_save_pp4_load_pp2_loss_identical(self, tmp_path):
+        from paddle_tpu.models.llama_pp import (load_hybrid_checkpoint,
+                                                save_hybrid_checkpoint)
+
+        cfg = tiny_cfg(8)
+        stacked, rest = make_params(cfg)
+        ids, y = batch(cfg)
+        ref = float(build_loss_fn(cfg, remat=False)(stacked, rest, ids, y))
+
+        mesh4 = build_mesh(pp=4, dp=2)
+        set_mesh(mesh4)
+        b4 = blocks_from_stacked(stacked, 4, 1)
+        save_hybrid_checkpoint(str(tmp_path / "ck"), b4, rest)
+
+        mesh2 = build_mesh(pp=2, dp=4)
+        set_mesh(mesh2)
+        blocks2, edge2 = load_hybrid_checkpoint(str(tmp_path / "ck"), cfg,
+                                                mesh2)
+        first, body, last = llama_pp_fns(cfg, remat=False)
+        gf = build_sharded_1f1b_grad_fn(first, body, last,
+                                        accumulate_steps=4, mesh=mesh2)
+        loss, _ = jax.jit(gf)(blocks2, edge2, ids, y)
+        np.testing.assert_allclose(float(loss), ref, rtol=2e-4, atol=2e-5)
