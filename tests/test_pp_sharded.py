@@ -232,3 +232,32 @@ class TestHybridCheckpointReshape:
                                         accumulate_steps=4, mesh=mesh2)
         loss, _ = jax.jit(gf)(blocks2, edge2, ids, y)
         np.testing.assert_allclose(float(loss), ref, rtol=2e-4, atol=2e-5)
+
+
+class TestRematParity:
+    """The bench path runs remat=True (jax.checkpoint inside the scanned
+    body) — its interplay with the per-tick vjp must not change numerics."""
+
+    def test_pp4_remat_loss_matches_no_remat(self):
+        cfg = tiny_cfg(8)
+        stacked, rest = make_params(cfg)
+        ids, y = batch(cfg)
+        mesh = build_mesh(pp=4, dp=2)
+        set_mesh(mesh)
+        losses = {}
+        grads = {}
+        for remat in (False, True):
+            first, body, last = llama_pp_fns(cfg, remat=remat)
+            gf = build_sharded_1f1b_grad_fn(first, body, last,
+                                            accumulate_steps=4, mesh=mesh)
+            blocks = blocks_from_stacked(stacked, 4, 1)
+            blocks = {k: jax.device_put(v, NamedSharding(mesh, P("pp")))
+                      for k, v in blocks.items()}
+            loss, (gb, _) = jax.jit(gf)(blocks, rest, ids, y)
+            losses[remat] = float(loss)
+            grads[remat] = stacked_from_blocks(gb)
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
+        for k in grads[True]:
+            np.testing.assert_allclose(np.asarray(grads[True][k]),
+                                       np.asarray(grads[False][k]),
+                                       rtol=1e-5, atol=1e-6, err_msg=k)
