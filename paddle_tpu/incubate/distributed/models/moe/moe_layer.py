@@ -110,7 +110,9 @@ class MoELayer(Layer):
             if gtype == "naive":
                 gate = NaiveGate(d_model, self.num_expert, topk=topk)
             elif gtype == "gshard":
-                gate = GShardGate(d_model, self.num_expert)
+                # forward top_k so a non-2 request FAILS (GShardGate is
+                # top-2 by construction) instead of silently routing top-2
+                gate = GShardGate(d_model, self.num_expert, topk=topk)
             elif gtype == "switch":
                 gate = SwitchGate(d_model, self.num_expert, topk=1)
             else:

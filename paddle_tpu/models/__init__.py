@@ -13,20 +13,29 @@ __all__ = ["llama", "LlamaConfig", "LlamaForCausalLM", "LlamaModel",
            "llama_config"]
 
 
-_GPT_NAMES = ("GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_config")
+# lazy model families: submodule name → its public names
+_LAZY = {
+    "gpt": ("GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_config"),
+    "ernie": ("ErnieMoEConfig", "ErnieMoEModel", "ErnieMoEForMaskedLM",
+              "ernie_moe_config"),
+}
 
 
 def __getattr__(name):
-    if name == "gpt" or name in _GPT_NAMES:
-        import importlib
+    for sub, names in _LAZY.items():
+        if name == sub or name in names:
+            import importlib
 
-        mod = importlib.import_module(".gpt", __name__)
-        globals()["gpt"] = mod
-        for n in _GPT_NAMES:
-            globals()[n] = getattr(mod, n)
-        return globals()[name]
+            mod = importlib.import_module(f".{sub}", __name__)
+            globals()[sub] = mod
+            for n in names:
+                globals()[n] = getattr(mod, n)
+            return globals()[name]
     raise AttributeError(name)
 
 
 def __dir__():
-    return sorted(set(globals()) | {"gpt"} | set(_GPT_NAMES))
+    out = set(globals()) | set(_LAZY)
+    for names in _LAZY.values():
+        out |= set(names)
+    return sorted(out)

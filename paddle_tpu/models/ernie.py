@@ -1,0 +1,233 @@
+"""ERNIE-MoE encoder (BASELINE.json config 4: ERNIE-MoE expert-parallel).
+
+Bidirectional pre-LN transformer encoder in the ERNIE 3.0 shape with
+Mixture-of-Experts FFN on alternating layers (the ERNIE 3.0 Titan /
+reference incubate MoE training recipe: dense attention everywhere, GShard
+top-2 dispatched expert MLPs over the ``ep`` mesh axis —
+incubate/distributed/models/moe/moe_layer.py:263) and an MLM head for
+pretraining. Non-MoE pieces reuse the TP layer stack (fleet/layers/mpu),
+so the model composes dp x mp x ep out of the box.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..distributed._spmd import P, constraint
+from ..distributed.fleet.layers.mpu import (ColumnParallelLinear,
+                                            RowParallelLinear,
+                                            VocabParallelEmbedding)
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+
+__all__ = ["ErnieMoEConfig", "ErnieMoEModel", "ErnieMoEForMaskedLM",
+           "ernie_moe_config"]
+
+
+@dataclass
+class ErnieMoEConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: Optional[int] = None      # None → 4*hidden
+    num_experts: int = 8
+    top_k: int = 2
+    moe_every: int = 2          # MoE FFN on every Nth layer (1-indexed)
+    capacity_factor: float = 1.2
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 4
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.0
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+_PRESETS = {
+    # name: (hidden, layers, heads, experts, vocab)
+    "tiny": (64, 2, 4, 4, 256),
+    "base": (768, 12, 12, 8, 40000),
+    "large": (1024, 24, 16, 64, 40000),
+}
+
+
+def ernie_moe_config(preset: str = "tiny", **overrides) -> ErnieMoEConfig:
+    h, l, a, e, v = _PRESETS[preset]
+    cfg = ErnieMoEConfig(hidden_size=h, num_hidden_layers=l,
+                         num_attention_heads=a, num_experts=e, vocab_size=v)
+    for k, val in overrides.items():
+        setattr(cfg, k, val)
+    return cfg
+
+
+class _SelfAttention(Layer):
+    def __init__(self, config: ErnieMoEConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        h = config.hidden_size
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, has_bias=True,
+                                          input_is_parallel=True)
+
+    def forward(self, x):
+        cfg = self.config
+        b, s = x.shape[0], x.shape[1]
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        qkv = self.qkv_proj(x)
+
+        def split_heads(t):
+            t = t.reshape(b, s, 3, nh, hd)
+            return t[:, :, 0], t[:, :, 1], t[:, :, 2]
+
+        q, k, v = apply_op(split_heads, qkv, op_name="split_qkv")
+        ctx, _ = F.flash_attention(q, k, v, causal=False,
+                                   dropout=cfg.dropout,
+                                   training=self.training)
+        ctx = apply_op(lambda c: c.reshape(b, s, nh * hd), ctx,
+                       op_name="merge_heads")
+        return self.out_proj(ctx)
+
+
+def _make_moe_ffn(config: ErnieMoEConfig):
+    from .. import nn
+    from ..incubate.distributed.models.moe import MoELayer
+
+    experts = [
+        nn.Sequential(nn.Linear(config.hidden_size, config.ffn_size),
+                      nn.GELU(),
+                      nn.Linear(config.ffn_size, config.hidden_size))
+        for _ in range(config.num_experts)
+    ]
+    return MoELayer(d_model=config.hidden_size, experts=experts,
+                    gate={"type": "gshard", "top_k": config.top_k},
+                    capacity_factor=config.capacity_factor)
+
+
+class _DenseFFN(Layer):
+    def __init__(self, config: ErnieMoEConfig):
+        super().__init__(dtype=config.dtype)
+        self.fc_in = ColumnParallelLinear(config.hidden_size,
+                                          config.ffn_size, has_bias=True,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(config.ffn_size, config.hidden_size,
+                                        has_bias=True,
+                                        input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class ErnieMoEEncoderLayer(Layer):
+    def __init__(self, config: ErnieMoEConfig, use_moe: bool):
+        super().__init__(dtype=config.dtype)
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_eps)
+        self.attn = _SelfAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_eps)
+        self.ffn = _make_moe_ffn(config) if use_moe else _DenseFFN(config)
+        self.use_moe = use_moe
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.ffn(self.ln_2(x))
+        return constraint(x, P("dp", None, None))
+
+
+class ErnieMoEModel(Layer):
+    def __init__(self, config: ErnieMoEConfig):
+        super().__init__(dtype=config.dtype)
+        from ..core.dtype import get_default_dtype, set_default_dtype
+        from ..nn.layer.common import Embedding
+        from ..nn.layer.container import LayerList
+
+        self.config = config
+        # sublayers create params via the default dtype (same pattern as
+        # GPTModel): config.dtype must actually apply
+        prev = get_default_dtype()
+        set_default_dtype(config.dtype)
+        try:
+            self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                       config.hidden_size)
+            self.embed_pos = Embedding(config.max_position_embeddings,
+                                       config.hidden_size)
+            self.embed_type = Embedding(config.type_vocab_size,
+                                        config.hidden_size)
+            self.layers = LayerList([
+                ErnieMoEEncoderLayer(
+                    config, use_moe=((i + 1) % config.moe_every == 0))
+                for i in range(config.num_hidden_layers)
+            ])
+            self.norm = LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        finally:
+            set_default_dtype(prev)
+
+    def forward(self, input_ids, token_type_ids=None):
+        import paddle_tpu as paddle
+
+        b, s = input_ids.shape[0], input_ids.shape[1]
+        pos = paddle.arange(s).unsqueeze(0)
+        x = self.embed_tokens(input_ids) + self.embed_pos(pos)
+        if token_type_ids is not None:
+            x = x + self.embed_type(token_type_ids)
+        for layer in self.layers:
+            x = layer(x)
+        return self.norm(x)
+
+    def moe_aux_loss(self):
+        """Sum of the GShard load-balancing losses of every MoE layer
+        (gates stash them via BaseGate.set_loss during forward)."""
+        total = None
+        for layer in self.layers:
+            if layer.use_moe:
+                l = layer.ffn.gate.get_loss(clear=True)
+                if l is not None:
+                    total = l if total is None else total + l
+        return total
+
+
+class ErnieMoEForMaskedLM(Layer):
+    """MLM pretraining head (ERNIE's knowledge-masking objective reduces
+    to masked-token CE at the modeling level)."""
+
+    def __init__(self, config: ErnieMoEConfig):
+        super().__init__(dtype=config.dtype)
+        from ..core.dtype import get_default_dtype, set_default_dtype
+
+        self.ernie = ErnieMoEModel(config)
+        prev = get_default_dtype()
+        set_default_dtype(config.dtype)
+        try:
+            self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                                config.vocab_size,
+                                                has_bias=False,
+                                                gather_output=True)
+        finally:
+            set_default_dtype(prev)
+
+    def forward(self, input_ids, labels=None, token_type_ids=None,
+                aux_loss_weight: float = 0.01):
+        h = self.ernie(input_ids, token_type_ids)
+        logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.ernie.config.vocab_size]),
+            labels.reshape([-1]), ignore_index=-100)
+        aux = self.ernie.moe_aux_loss()
+        if aux is not None:
+            loss = loss + aux_loss_weight * aux
+        return loss, logits

@@ -1,0 +1,109 @@
+"""ERNIE-MoE model family tests (BASELINE config 4 as a real model).
+
+Contract: bidirectional encoder forward, MoE layers on the configured
+cadence, MLM loss (with GShard aux) trains, and the expert dim composes
+with the ep mesh axis.
+"""
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as paddle
+from paddle_tpu.distributed.topology import build_mesh, set_mesh
+from paddle_tpu.models import (ErnieMoEForMaskedLM, ErnieMoEModel,
+                               ernie_moe_config)
+
+
+def tiny():
+    return ernie_moe_config("tiny", num_hidden_layers=2, num_experts=4,
+                            moe_every=2)
+
+
+def batch(cfg, b=4, s=16, mask_frac=0.25, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32)
+    labels = np.full((b, s), -100, np.int64)
+    m = rng.rand(b, s) < mask_frac
+    labels[m] = ids[m]
+    return paddle.to_tensor(ids), paddle.to_tensor(labels)
+
+
+class TestErnieMoE:
+    def setup_method(self, _):
+        set_mesh(build_mesh(ep=4, dp=2))
+
+    def test_moe_cadence(self):
+        m = ErnieMoEModel(tiny())
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+        kinds = [isinstance(l.ffn, MoELayer) for l in m.layers]
+        assert kinds == [False, True]   # every 2nd layer is MoE
+
+    def test_forward_shapes(self):
+        cfg = tiny()
+        m = ErnieMoEForMaskedLM(cfg)
+        ids, _ = batch(cfg)
+        logits = m(ids)
+        assert list(logits.shape) == [4, 16, cfg.vocab_size]
+
+    def test_bidirectional_not_causal(self):
+        """Encoder attention must see the future: changing a LATER token
+        must change an EARLIER position's representation. The RNG is
+        re-seeded before each forward so gate random-routing can't fake
+        the difference."""
+        cfg = tiny()
+        m = ErnieMoEModel(cfg)
+        m.eval()
+        ids, _ = batch(cfg)
+        paddle.seed(99)
+        h1 = np.asarray(m(ids).value)
+        # same seed, same input → identical (routing noise controlled)
+        paddle.seed(99)
+        h1b = np.asarray(m(ids).value)
+        np.testing.assert_allclose(h1, h1b, rtol=1e-6)
+        ids2 = np.asarray(ids.value).copy()
+        ids2[:, -1] = (ids2[:, -1] + 1) % cfg.vocab_size
+        paddle.seed(99)
+        h2 = np.asarray(m(paddle.to_tensor(ids2)).value)
+        assert np.abs(h1[:, 0] - h2[:, 0]).max() > 1e-6
+
+    def test_mlm_trains_with_aux_loss(self):
+        cfg = tiny()
+        m = ErnieMoEForMaskedLM(cfg)
+        m.train()
+        from paddle_tpu.optimizer import AdamW
+
+        opt = AdamW(learning_rate=5e-3, parameters=m.parameters())
+        ids, labels = batch(cfg)
+        losses = []
+        for _ in range(5):
+            loss, _logits = m(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.value))
+        assert losses[-1] < losses[0], losses
+
+    def test_expert_dispatch_rides_ep_axis(self):
+        """The MoE layer's dispatched expert compute must actually be
+        placed over the ep mesh axis (BASELINE config 4: dispatch over
+        ICI) — asserted on the dispatch constraint spec the MoELayer
+        applies, not just on layer types."""
+        import paddle_tpu.incubate.distributed.models.moe.moe_layer as ml
+
+        cfg = tiny()
+        m = ErnieMoEForMaskedLM(cfg)
+        ids, labels = batch(cfg)
+        seen = []
+        orig = ml.constraint
+
+        def spy(x, spec, *a, **kw):
+            seen.append(str(spec))
+            return orig(x, spec, *a, **kw)
+
+        ml.constraint = spy
+        try:
+            m(ids, labels)
+        finally:
+            ml.constraint = orig
+        assert any("ep" in s for s in seen), seen
