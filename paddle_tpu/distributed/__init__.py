@@ -81,11 +81,15 @@ def __getattr__(name):
         from ..native import TCPStore
 
         return TCPStore
+    if name == "passes":
+        from . import passes as passes_mod
+
+        return passes_mod
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
 
 
 def __dir__():
     lazy = {"fleet", "sharding", "checkpoint", "utils", "meta_parallel",
             "auto_parallel", "launch", "sequence_parallel", "rpc",
-            "auto_tuner", "io", "spawn", "TCPStore"}
+            "auto_tuner", "io", "spawn", "TCPStore", "passes"}
     return sorted(set(globals()) | lazy | set(_EXTRAS))

@@ -1,0 +1,208 @@
+"""Distributed pass framework (reference: distributed/passes/pass_base.py
+— new_pass:?, PassManager, PassContext — plus the auto_parallel_* pass set
+applied by the static Engine).
+
+TPU-native: a "pass" transforms the recorded-op Program
+(static/program.py) — the same IR the executor jits — instead of a
+ProgramDesc. The passes that survive on TPU are the ones that change the
+COMPUTATION (precision casts, rematerialization, quantization); the ones
+that existed to inject collectives (sharding/pipeline/data-parallel
+passes) are carried by sharding annotations + GSPMD and are intentionally
+absent here (DESIGN.md role-collapse notes).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PassContext", "PassBase", "PassManager", "new_pass",
+           "register_pass"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+class PassContext:
+    """Shared state across a pass pipeline (reference PassContext)."""
+
+    def __init__(self):
+        self._attrs: Dict[str, Any] = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class PassBase:
+    name: str = "base"
+
+    def __init__(self, attrs: Optional[Dict[str, Any]] = None):
+        self._attrs = dict(attrs or {})
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    # reference contract: check then apply
+    def check_before_apply(self, main_program, startup_program) -> bool:
+        return True
+
+    def apply(self, main_programs, startup_programs=None,
+              context: Optional[PassContext] = None):
+        """Apply to one program or a list; returns the transformed
+        program(s) (recorded Programs are immutably cloned)."""
+        single = not isinstance(main_programs, (list, tuple))
+        progs = [main_programs] if single else list(main_programs)
+        outs = []
+        for p in progs:
+            if not self.check_before_apply(p, None):
+                raise ValueError(f"pass {self.name} preconditions failed")
+            outs.append(self._apply_single(p, context or PassContext()))
+        return outs[0] if single else outs
+
+    def _apply_single(self, program, context):
+        raise NotImplementedError
+
+
+def register_pass(name: str):
+    def deco(cls):
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name: str, pass_attrs: Optional[Dict[str, Any]] = None
+             ) -> PassBase:
+    """reference new_pass(name, attrs) — construct a registered pass."""
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown pass {name!r}; registered: {sorted(_REGISTRY)}")
+    p = _REGISTRY[name](pass_attrs)
+    p.name = name   # a class may register under aliases (amp/fp16)
+    return p
+
+
+class PassManager:
+    """reference PassManager: ordered pipeline over programs."""
+
+    def __init__(self, passes: List[PassBase]):
+        self._passes = list(passes)
+        self.context = PassContext()
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def apply(self, main_programs, startup_programs=None):
+        single = not isinstance(main_programs, (list, tuple))
+        progs = [main_programs] if single else list(main_programs)
+        for p in self._passes:
+            progs = [p.apply(pr, None, self.context) for pr in progs]
+        return progs[0] if single else progs
+
+
+# ---------------------------------------------------------------------------
+# TPU-native pass set
+# ---------------------------------------------------------------------------
+
+_MATMUL_OPS = ("matmul", "linear", "mul", "conv2d")
+
+
+def _clone_with_nodes(program, nodes):
+    out = program.clone()
+    out.nodes = nodes
+    return out
+
+
+@register_pass("auto_parallel_fp16")
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """Cast matmul-class compute to bf16 (reference auto_parallel_amp /
+    fp16 passes insert cast ops around fp16-safe ops). attrs:
+    ``dtype`` ("bfloat16"), ``custom_white_list`` (extra op names)."""
+
+    def _apply_single(self, program, context):
+        from ...static.program import StaticNode
+
+        # alias-aware default: the fp16 registration means FLOAT16 unless
+        # the caller says otherwise (bf16 would silently change mantissa)
+        default_dt = ("float16" if self.name == "auto_parallel_fp16"
+                      else "bfloat16")
+        dt = jnp.bfloat16 if self.get_attr("dtype", default_dt) in (
+            "bfloat16", "bf16") else jnp.float16
+        white = set(_MATMUL_OPS) | {
+            str(n).lower() for n in self.get_attr("custom_white_list", ())}
+        new_nodes = []
+        for node in program.nodes:
+            if (node.name or "").lower() not in white:
+                new_nodes.append(node)
+                continue
+
+            def cast_fn(*flat, _fn=node.fn, _dt=dt):
+                lo = [x.astype(_dt) if hasattr(x, "astype")
+                      and jnp.issubdtype(jnp.result_type(x), jnp.floating)
+                      else x for x in flat]
+                out = _fn(*lo)
+                return jax.tree.map(
+                    lambda o: o.astype(jnp.float32)
+                    if hasattr(o, "astype") and jnp.issubdtype(
+                        jnp.result_type(o), jnp.floating) else o, out)
+
+            new_nodes.append(StaticNode(
+                fn=cast_fn, in_ids=node.in_ids, const_args=node.const_args,
+                out_ids=node.out_ids, name=node.name))
+        out = _clone_with_nodes(program, new_nodes)
+        context.set_attr("amp_applied", True)
+        return out
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Rematerialize matched ops in the backward (reference
+    auto_parallel_recompute segments the program; here jax.checkpoint on
+    the node function IS the segment marker — XLA recomputes it in the
+    grad pass instead of saving residuals). attrs: ``ops`` (names to
+    wrap; default matmul-class)."""
+
+    def _apply_single(self, program, context):
+        from ...static.program import StaticNode
+
+        targets = {str(n).lower() for n in self.get_attr("ops",
+                                                         _MATMUL_OPS)}
+        new_nodes = []
+        n = 0
+        for node in program.nodes:
+            if (node.name or "").lower() not in targets:
+                new_nodes.append(node)
+                continue
+            new_nodes.append(StaticNode(
+                fn=jax.checkpoint(node.fn), in_ids=node.in_ids,
+                const_args=node.const_args, out_ids=node.out_ids,
+                name=node.name))
+            n += 1
+        out = _clone_with_nodes(program, new_nodes)
+        context.set_attr("recomputed_ops", n)
+        return out
+
+
+@register_pass("auto_parallel_quantization")
+class QuantizationPass(PassBase):
+    """Delegates to the program-level QAT transform
+    (static/quantization.QuantizationTransformPass). attrs:
+    ``weight_bits``/``activation_bits``."""
+
+    def _apply_single(self, program, context):
+        from ...static.quantization import QuantizationTransformPass
+
+        return QuantizationTransformPass(
+            weight_bits=self.get_attr("weight_bits", 8),
+            activation_bits=self.get_attr("activation_bits", 8),
+        ).apply(program)

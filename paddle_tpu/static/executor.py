@@ -82,8 +82,11 @@ class Executor:
                 raise TypeError("fetch_list entries must be program outputs")
 
         feed_names = tuple(sorted(feed))
-        key = (prog.id, len(prog.nodes), tuple(fetch_ids), feed_names,
-               prog.train_config is not None)
+        # content-aware key: pure clones share node OBJECTS and hit the
+        # cache; pass-transformed programs carry new StaticNodes and miss
+        key = (getattr(prog, "_origin_id", prog.id),
+               tuple(id(n) for n in prog.nodes), tuple(fetch_ids),
+               feed_names, prog.train_config is not None)
         step = self._cache.get(key)
         if step is None:
             step = self._build(prog, fetch_ids, feed_names)
@@ -94,7 +97,8 @@ class Executor:
         feeds = {n: jnp.asarray(np.asarray(
             feed[n]._value if isinstance(feed[n], Tensor) else feed[n]))
             for n in feed_names}
-        opt_state = scope.var(f"__opt_state_{prog.id}")
+        opt_key = f"__opt_state_{getattr(prog, '_origin_id', prog.id)}"
+        opt_state = scope.var(opt_key)
 
         if prog.train_config is not None:
             lr = jnp.asarray(prog.train_config[0].get_lr(), jnp.float32)
@@ -106,7 +110,7 @@ class Executor:
             for n, v in new_params.items():
                 scope.set(n, v)
                 prog.param_objs[n]._value = v  # keep eager view in sync
-            scope.set(f"__opt_state_{prog.id}", opt_state)
+            scope.set(opt_key, opt_state)
         else:
             if key not in self._aval_cache:
                 self._aval_cache[key] = _avals((feeds, params))

@@ -109,7 +109,14 @@ class Program:
 
     def clone(self, for_test: bool = False) -> "Program":
         p = Program.__new__(Program)
-        p.id = self.id
+        # FRESH id: the executor caches compiled steps by node identity —
+        # a transformed clone (amp/recompute passes wrap fns in place,
+        # keeping the node COUNT) must never alias the original's cache.
+        # _origin_id keeps optimizer state continuous across clones (the
+        # reference's clone shares scope variables the same way).
+        Program._counter[0] += 1
+        p.id = Program._counter[0]
+        p._origin_id = getattr(self, "_origin_id", self.id)
         p.nodes = list(self.nodes)
         p.var_meta = dict(self.var_meta)
         p.feed_vars = dict(self.feed_vars)
@@ -118,6 +125,12 @@ class Program:
         p.train_config = None if for_test else self.train_config
         p._var_names = dict(self._var_names)
         p.random_seed = self.random_seed
+        # gradient-fetch bookkeeping must survive transforms: without it a
+        # grad fetch on the clone would silently take the non-grad path
+        for attr in ("grad_vars", "input_grad_vars", "loss_id"):
+            if hasattr(self, attr):
+                v = getattr(self, attr)
+                setattr(p, attr, dict(v) if isinstance(v, dict) else v)
         return p
 
     def __repr__(self):
