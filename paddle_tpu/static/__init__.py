@@ -20,6 +20,7 @@ from .program import (Program, Scope, default_main_program,
                       static_state)
 from .record import make_symbolic
 from . import quantization  # noqa: F401  (reference static/quantization)
+from . import amp  # noqa: F401  (reference static/amp)
 
 __all__ = ["data", "Executor", "Program", "program_guard",
            "default_main_program", "default_startup_program", "scope_guard",

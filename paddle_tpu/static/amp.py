@@ -1,0 +1,166 @@
+"""Static-graph AMP (reference: python/paddle/static/amp — decorator.py
+``decorate``, fp16_lists ``CustomOpLists``/``AutoMixedPrecisionLists``,
+fp16_utils ``fp16_guard``/``cast_model_to_fp16``/``cast_parameters_to_fp16``).
+
+TPU-native: the reference rewrites the static program with cast ops; here
+the same rewrite is the distributed AMP pass over the recorded-Program IR
+(distributed/passes.AMPPass), and bf16 is the default low precision (the
+TPU-native choice — fp16 on request). Loss scaling is unnecessary for
+bf16 (same exponent range as fp32); the decorated optimizer keeps the
+reference's scaler-shaped surface with scale 1.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterable, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["decorate", "CustomOpLists", "AutoMixedPrecisionLists",
+           "fp16_guard", "bf16_guard", "cast_model_to_fp16",
+           "cast_model_to_bf16", "cast_parameters_to_fp16",
+           "cast_parameters_to_bf16"]
+
+
+class AutoMixedPrecisionLists:
+    """Op allow/deny lists (reference fp16_lists.AutoMixedPrecisionLists):
+    white ops run in low precision, black ops stay fp32."""
+
+    def __init__(self, custom_white_list: Optional[Iterable[str]] = None,
+                 custom_black_list: Optional[Iterable[str]] = None,
+                 custom_black_varnames=None, dtype: str = "float16"):
+        from ..amp.amp_lists import black_list, white_list
+
+        self.white_list = set(white_list(dtype)) | {
+            str(n).lower() for n in (custom_white_list or ())}
+        self.black_list = (set(black_list(dtype)) | {
+            str(n).lower() for n in (custom_black_list or ())})
+        self.white_list -= self.black_list
+        self.dtype = dtype
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+_in_guard = [False]
+
+
+@contextlib.contextmanager
+def fp16_guard():
+    """Marks a region whose ops are amp-eligible (reference
+    fp16_utils.fp16_guard). Recording captures ops either way; the guard
+    is kept for script parity and future selective casting."""
+    _in_guard[0] = True
+    try:
+        yield
+    finally:
+        _in_guard[0] = False
+
+
+bf16_guard = fp16_guard
+
+
+def _cast_program(program, dtype: str, amp_lists=None):
+    from ..distributed.passes import new_pass
+
+    attrs = {"dtype": dtype}
+    if amp_lists is not None:
+        attrs["custom_white_list"] = sorted(amp_lists.white_list)
+    name = ("auto_parallel_fp16" if dtype in ("float16", "fp16")
+            else "auto_parallel_amp")
+    return new_pass(name, attrs).apply(program)
+
+
+def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard: bool = True,
+                       dest_type=None):
+    """Rewrite the program's white-list ops to fp16 compute (reference
+    fp16_utils.cast_model_to_fp16); returns the transformed program."""
+    return _cast_program(program, "float16", amp_lists)
+
+
+def cast_model_to_bf16(program, amp_lists=None, use_bf16_guard: bool = True):
+    return _cast_program(program, "bfloat16", amp_lists)
+
+
+def cast_parameters_to_fp16(place=None, program=None, scope=None,
+                            to_fp16_var_names=None):
+    """Cast stored params to fp16 (reference fp16_utils) — on TPU this is
+    a scope-value dtype change; master copies stay with the optimizer."""
+    _cast_params(program, scope, jnp.float16, to_fp16_var_names)
+
+
+def cast_parameters_to_bf16(place=None, program=None, scope=None,
+                            to_bf16_var_names=None):
+    _cast_params(program, scope, jnp.bfloat16, to_bf16_var_names)
+
+
+def _cast_params(program, scope, dt, names):
+    from .program import default_main_program, global_scope
+
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    targets = set(names) if names else None
+    for name in program.param_vars:
+        if targets is not None and name not in targets:
+            continue
+        v = scope.var(name)
+        if v is not None and hasattr(v, "astype") and jnp.issubdtype(
+                jnp.result_type(v), jnp.floating):
+            scope.set(name, v.astype(dt))
+        p = program.param_objs.get(name)
+        if p is not None and jnp.issubdtype(
+                jnp.result_type(p._value), jnp.floating):
+            p._value = p._value.astype(dt)
+
+
+class _DecoratedOptimizer:
+    """Optimizer wrapper (reference decorator.OptimizerWithMixedPrecision):
+    minimize() casts the program through the AMP pass first; the scaler
+    surface is identity for bf16 (no loss scaling needed on TPU)."""
+
+    def __init__(self, optimizer, amp_lists=None, level="O1",
+                 dtype="bfloat16", init_loss_scaling=1.0, **kw):
+        self._opt = optimizer
+        self._amp_lists = amp_lists
+        self._dtype = dtype
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def get_loss_scaling(self):
+        return 1.0
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        if self._dtype in ("float16", "fp16"):
+            cast_parameters_to_fp16(place, scope=scope)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .program import default_main_program, static_state
+
+        prog = default_main_program()
+        casted = _cast_program(prog, self._dtype, self._amp_lists)
+        # swap the transformed program in for execution (the reference
+        # rewrites in place; recorded programs are immutable clones)
+        static_state.main_program = casted
+        with _swap_guard(casted):
+            return self._opt.minimize(loss)
+
+
+@contextlib.contextmanager
+def _swap_guard(prog):
+    yield
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8, use_dynamic_loss_scaling=None,
+             use_amp_guard=None, level="O1", dtype="bfloat16",
+             use_pure_fp16=False, use_fp16_guard=None, master_weight=None,
+             use_promote=False):
+    """reference static/amp/decorator.py decorate."""
+    if use_pure_fp16:
+        dtype = "float16"
+    return _DecoratedOptimizer(optimizer, amp_lists, level, dtype,
+                               init_loss_scaling)

@@ -168,3 +168,61 @@ class TestCloneSemantics:
         # indirect check: pass name resolved and default dtype is fp16
         assert p.name == "auto_parallel_fp16"
         assert p.get_attr("dtype", "float16") == "float16"
+
+
+class TestStaticAMP:
+    """paddle.static.amp surface (reference static/amp: decorate /
+    CustomOpLists / cast_model_to_fp16 / fp16_guard)."""
+
+    def test_cast_model_to_bf16_runs_close(self, static_mode):
+        out, prog = _prog()
+        from paddle_tpu.static import amp as samp
+
+        casted = samp.cast_model_to_bf16(prog)
+        exe = paddle.static.Executor()
+        X = np.random.RandomState(0).randn(8, 8).astype(np.float32) * 3
+        (ref,) = exe.run(prog, feed={"x": X}, fetch_list=[out])
+        (got,) = exe.run(casted, feed={"x": X}, fetch_list=[out])
+        err = np.abs(got - ref).max()
+        assert 0 < err < 0.1, err
+
+    def test_decorated_optimizer_trains(self, static_mode):
+        from paddle_tpu.static import amp as samp
+
+        x = paddle.static.data("x", [None, 8])
+        y = paddle.static.data("y", [None, 1])
+        loss = paddle.mean((nn.Linear(8, 1)(x) - y) ** 2)
+        from paddle_tpu.optimizer import SGD
+
+        opt = samp.decorate(SGD(learning_rate=0.1), dtype="bfloat16")
+        assert opt.get_loss_scaling() == 1.0
+        opt.minimize(loss)
+        prog = paddle.static.default_main_program()
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 8).astype(np.float32)
+        Y = X @ rng.randn(8, 1).astype(np.float32)
+        losses = [float(exe.run(prog, feed={"x": X, "y": Y},
+                                fetch_list=[loss])[0]) for _ in range(20)]
+        assert losses[-1] < losses[0] * 0.5, losses[::5]
+
+    def test_op_lists_and_guard(self, static_mode):
+        from paddle_tpu.static import amp as samp
+
+        lists = samp.CustomOpLists(custom_white_list=["tanh"],
+                                   custom_black_list=["softmax"])
+        assert "tanh" in lists.white_list
+        assert "softmax" not in lists.white_list
+        with samp.fp16_guard():
+            pass  # parity surface; records fine
+
+    def test_cast_parameters(self, static_mode):
+        import jax.numpy as jnp
+
+        _, prog = _prog()
+        from paddle_tpu.static import amp as samp
+
+        samp.cast_parameters_to_bf16(program=prog)
+        for name, p in prog.param_objs.items():
+            if hasattr(p, "_value"):
+                assert p._value.dtype == jnp.bfloat16, name
