@@ -140,6 +140,10 @@ class AMPPass(PassBase):
             "bfloat16", "bf16") else jnp.float16
         white = set(_MATMUL_OPS) | {
             str(n).lower() for n in self.get_attr("custom_white_list", ())}
+        # a black-listed op must NOT be cast even if it is in the default
+        # matmul set — the user marked it numerically unsafe
+        white -= {str(n).lower()
+                  for n in self.get_attr("custom_black_list", ())}
         new_nodes = []
         for node in program.nodes:
             if (node.name or "").lower() not in white:

@@ -1,7 +1,7 @@
 """paddle.incubate parity namespace (reference: python/paddle/incubate/)."""
 import importlib
 
-_LAZY = {"distributed", "nn", "asp", "optimizer"}
+_LAZY = {"distributed", "nn", "asp", "optimizer", "autograd"}
 _API = ("segment_sum", "segment_mean", "segment_min", "segment_max",
         "graph_send_recv", "graph_reindex", "graph_sample_neighbors",
         "graph_khop_sampler", "softmax_mask_fuse",

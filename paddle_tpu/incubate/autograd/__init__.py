@@ -1,0 +1,228 @@
+"""paddle.incubate.autograd — functional/primitive AD surface (reference:
+python/paddle/incubate/autograd/__init__.py — Jacobian/Hessian/jvp/vjp
+from functional.py, forward_grad/grad from primapi.py, prim2orig from
+primx.py, enable_prim/disable_prim/prim_enabled from utils.py).
+
+TPU-native: the reference's "prim" mode lowers composite ops to primitive
+ops so a rule-based transpose can differentiate them — that IS JAX's
+execution model (every op is a primitive with jvp/transpose rules), so
+the toggles are honest no-ops and the functional surface maps straight
+onto jax.jvp/vjp/jacobian. Values round-trip as framework Tensors.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["Jacobian", "Hessian", "jvp", "vjp", "forward_grad", "grad",
+           "prim2orig", "enable_prim", "disable_prim", "prim_enabled"]
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _raw_tree(xs):
+    if isinstance(xs, (list, tuple)):
+        return [_raw(x) for x in xs]
+    return _raw(xs)
+
+
+def _wrap_tree(vals):
+    if isinstance(vals, (list, tuple)):
+        return [Tensor(v) for v in vals]
+    return Tensor(vals)
+
+
+def _pure(func: Callable):
+    def f(*raws):
+        out = func(*[Tensor(r) for r in raws])
+        # outputs may be a Tensor or a (possibly nested) sequence of them
+        return jax.tree.map(
+            lambda o: o._value if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
+
+    return f
+
+
+class Jacobian:
+    """Lazy Jacobian (reference functional.Jacobian): J[i, j] indexes the
+    full matrix; ``batch_axis=0`` treats dim 0 as batch. Computed once via
+    jax.jacrev on first access."""
+
+    def __init__(self, func, xs, is_batched: bool = False,
+                 batch_axis=None):
+        self._func = func
+        self._xs = xs
+        self._batched = is_batched or batch_axis == 0
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        xs = _raw_tree(self._xs)
+        multi = isinstance(xs, list)
+        f = _pure(self._func)
+
+        if self._batched:
+            def single(*row):
+                return f(*[r[None] for r in row])[0]
+
+            jac = jax.vmap(jax.jacrev(single, argnums=tuple(
+                range(len(xs))) if multi else 0))(*(xs if multi else [xs]))
+        else:
+            jac = jax.jacrev(f, argnums=tuple(range(len(xs)))
+                             if multi else 0)(*(xs if multi else [xs]))
+        if multi:
+            # concatenate along the input dimension (reference lays the
+            # multi-input Jacobian out as one wide matrix). Batched blocks
+            # are (B, out, in): keep batch and out, flatten in.
+            if self._batched:
+                flat = [j.reshape(j.shape[0], j.shape[1], -1) for j in jac]
+            else:
+                flat = [j.reshape(j.shape[0], -1) if j.ndim >= 2
+                        else j.reshape(1, -1) for j in jac]
+            jac = jnp.concatenate(flat, axis=-1)
+        self._mat = jac
+        return jac
+
+    def __getitem__(self, idx):
+        return Tensor(jnp.asarray(self._compute())[idx])
+
+    @property
+    def shape(self):
+        return tuple(jnp.shape(self._compute()))
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._compute())
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian of a SCALAR-output func (reference functional.Hessian)."""
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        xs = _raw_tree(self._xs)
+        multi = isinstance(xs, list)
+        args = xs if multi else [xs]
+        f = _pure(self._func)
+        # flatten-concat ALL inputs into one vector so the Hessian is the
+        # full (n, n) matrix INCLUDING cross terms (argnums=0 alone would
+        # silently drop d2f/dxdy for multi-input funcs)
+        shapes = [jnp.shape(a) for a in args]
+        if self._batched:
+            row_shapes = [s[1:] for s in shapes]
+            row_sizes = [max(1, int(jnp.prod(jnp.asarray(s, jnp.int32))))
+                         if s else 1 for s in row_shapes]
+            offs = [0]
+            for s in row_sizes:
+                offs.append(offs[-1] + s)
+
+            def single(z):
+                parts = [z[offs[i]:offs[i + 1]].reshape(row_shapes[i])
+                         for i in range(len(args))]
+                return jnp.sum(f(*[p[None] for p in parts]))
+
+            zb = jnp.concatenate(
+                [a.reshape(a.shape[0], -1) for a in args], axis=-1)
+            h = jax.vmap(jax.hessian(single))(zb)
+        else:
+            sizes = [int(jnp.size(a)) for a in args]
+            offs = [0]
+            for s in sizes:
+                offs.append(offs[-1] + s)
+
+            def scalar_of_vec(z):
+                parts = [z[offs[i]:offs[i + 1]].reshape(shapes[i])
+                         for i in range(len(args))]
+                return jnp.sum(f(*parts))
+
+            z = jnp.concatenate([a.ravel() for a in args])
+            h = jax.hessian(scalar_of_vec)(z)
+        self._mat = h
+        return h
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v) (reference functional.jvp;
+    v defaults to ones)."""
+    raws = _raw_tree(xs)
+    multi = isinstance(raws, list)
+    args = raws if multi else [raws]
+    tangents = (_raw_tree(v) if v is not None
+                else [jnp.ones_like(a) for a in args])
+    if not isinstance(tangents, list):
+        tangents = [tangents]
+    f = _pure(func)
+    out, tangent_out = jax.jvp(f, tuple(args), tuple(tangents))
+    return _wrap_tree(out), _wrap_tree(tangent_out)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns (func(xs), J^T @ v) (reference
+    functional.vjp; v defaults to ones over the output)."""
+    raws = _raw_tree(xs)
+    multi = isinstance(raws, list)
+    args = raws if multi else [raws]
+    f = _pure(func)
+    out, pullback = jax.vjp(f, *args)
+    if v is not None:
+        cot = jax.tree.map(
+            lambda o: o._value if isinstance(o, Tensor) else jnp.asarray(o),
+            v, is_leaf=lambda o: isinstance(o, Tensor))
+        if isinstance(cot, list):      # match jax's tuple output structure
+            cot = tuple(cot)
+    else:
+        cot = jax.tree.map(jnp.ones_like, out)
+    grads = pullback(cot)
+    grads = list(grads) if multi else grads[0]
+    return _wrap_tree(out), _wrap_tree(grads)
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """reference primapi.forward_grad — forward-mode gradients in the
+    static prim world. Recorded-program/static use should go through
+    Executor + input_grad fetches; eager use maps to :func:`jvp`."""
+    raise NotImplementedError(
+        "forward_grad operates on the reference's static prim program; "
+        "use incubate.autograd.jvp (eager forward-mode) or "
+        "static append_backward + Executor fetches instead")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """reference primapi.grad (static prim reverse-mode). Eager
+    equivalent: paddle.grad — delegated for API familiarity."""
+    from ...autograd.functional import grad as eager_grad
+
+    return eager_grad(outputs, inputs, grad_outputs)
+
+
+def prim2orig(block=None):
+    """No-op on TPU: there is no separate prim dialect to lower back —
+    JAX programs are already primitive-level (reference primx.prim2orig)."""
+    return None
+
+
+_prim_flag = [False]
+
+
+def enable_prim():
+    """No-op toggle kept for parity: JAX *is* the primitive autodiff
+    backend (every op has jvp/transpose rules); there is no composite
+    mode to switch away from."""
+    _prim_flag[0] = True
+
+
+def disable_prim():
+    _prim_flag[0] = False
+
+
+def prim_enabled() -> bool:
+    return _prim_flag[0]

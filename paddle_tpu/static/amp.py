@@ -66,6 +66,7 @@ def _cast_program(program, dtype: str, amp_lists=None):
     attrs = {"dtype": dtype}
     if amp_lists is not None:
         attrs["custom_white_list"] = sorted(amp_lists.white_list)
+        attrs["custom_black_list"] = sorted(amp_lists.black_list)
     name = ("auto_parallel_fp16" if dtype in ("float16", "fp16")
             else "auto_parallel_amp")
     return new_pass(name, attrs).apply(program)
@@ -123,6 +124,8 @@ class _DecoratedOptimizer:
         self._opt = optimizer
         self._amp_lists = amp_lists
         self._dtype = dtype
+        self._level = level
+        self.program = None   # the casted program minimize() produced
 
     def __getattr__(self, item):
         return getattr(self._opt, item)
@@ -132,25 +135,31 @@ class _DecoratedOptimizer:
 
     def amp_init(self, place=None, scope=None, test_program=None,
                  use_fp16_test=False):
-        if self._dtype in ("float16", "fp16"):
+        # only PURE (O2) mode casts stored params; O1 keeps fp32 masters
+        # (reference decorator amp_init semantics)
+        if self._level == "O2" and self._dtype in ("float16", "fp16"):
             cast_parameters_to_fp16(place, scope=scope)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        from .program import default_main_program, static_state
+        from .program import default_main_program, program_guard, \
+            static_state
 
         prog = default_main_program()
         casted = _cast_program(prog, self._dtype, self._amp_lists)
-        # swap the transformed program in for execution (the reference
-        # rewrites in place; recorded programs are immutable clones)
+        # the reference rewrites the program IN PLACE; recorded programs
+        # are immutable clones, so the casted program (a) becomes the
+        # default main program for subsequent exe.run(None) calls and
+        # (b) is exposed as .program / returned state for explicit use.
+        # NOTE: call minimize OUTSIDE a program_guard, or run the
+        # returned .program explicitly — a guard's __exit__ restores the
+        # pre-cast program.
+        with program_guard(casted, startup_program or
+                           static_state.startup_program):
+            out = self._opt.minimize(loss)
         static_state.main_program = casted
-        with _swap_guard(casted):
-            return self._opt.minimize(loss)
-
-
-@contextlib.contextmanager
-def _swap_guard(prog):
-    yield
+        self.program = casted
+        return out
 
 
 def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,

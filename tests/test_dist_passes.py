@@ -226,3 +226,30 @@ class TestStaticAMP:
         for name, p in prog.param_objs.items():
             if hasattr(p, "_value"):
                 assert p._value.dtype == jnp.bfloat16, name
+
+
+class TestAMPBlackList:
+    def test_black_list_blocks_cast(self, static_mode):
+        out, prog = _prog()
+        amped = new_pass("auto_parallel_amp",
+                         {"custom_black_list": ["linear"]}).apply(prog)
+        exe = paddle.static.Executor()
+        X = np.random.RandomState(0).randn(8, 8).astype(np.float32) * 3
+        (ref,) = exe.run(prog, feed={"x": X}, fetch_list=[out])
+        (got,) = exe.run(amped, feed={"x": X}, fetch_list=[out])
+        # linear was the only white op in this program: with it black-
+        # listed the pass is an exact no-op
+        np.testing.assert_array_equal(got, ref)
+
+    def test_decorated_minimize_returns_casted_program(self, static_mode):
+        from paddle_tpu.static import amp as samp
+
+        x = paddle.static.data("x", [None, 8])
+        y = paddle.static.data("y", [None, 1])
+        loss = paddle.mean((nn.Linear(8, 1)(x) - y) ** 2)
+        from paddle_tpu.optimizer import SGD
+
+        opt = samp.decorate(SGD(learning_rate=0.1), dtype="bfloat16")
+        opt.minimize(loss)
+        assert opt.program is not None
+        assert opt.program is paddle.static.default_main_program()

@@ -1,0 +1,99 @@
+"""incubate.autograd functional-AD tests (reference
+incubate/autograd/__init__.py surface: Jacobian/Hessian/jvp/vjp + prim
+toggles)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as iag
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestJacobianHessian:
+    def test_jacobian_matches_analytic(self):
+        # f(x) = [x0^2, 2*x1] -> J = [[2x0, 0], [0, 2]]
+        def f(x):
+            import paddle_tpu as paddle
+
+            return paddle.concat([(x[0] ** 2).reshape([1]),
+                                  (2 * x[1]).reshape([1])])
+
+        x = t([3.0, 5.0])
+        J = iag.Jacobian(f, x)
+        np.testing.assert_allclose(J[:].value, [[6.0, 0.0], [0.0, 2.0]],
+                                   rtol=1e-6)
+        assert J.shape == (2, 2)
+
+    def test_hessian_of_quadratic(self):
+        def f(x):
+            return (x * x).sum()
+
+        H = iag.Hessian(f, t([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(H[:].value, 2 * np.eye(3), rtol=1e-6)
+
+
+class TestJvpVjp:
+    def test_jvp(self):
+        def f(x):
+            return x ** 3
+
+        out, tang = iag.jvp(f, t([2.0]), t([1.0]))
+        np.testing.assert_allclose(out.value, [8.0], rtol=1e-6)
+        np.testing.assert_allclose(tang.value, [12.0], rtol=1e-6)  # 3x^2
+
+    def test_vjp(self):
+        def f(x):
+            return x ** 2
+
+        out, g = iag.vjp(f, t([3.0, 4.0]), t([1.0, 1.0]))
+        np.testing.assert_allclose(g.value, [6.0, 8.0], rtol=1e-6)
+
+    def test_vjp_multi_input(self):
+        def f(a, b):
+            return a * b
+
+        out, (ga, gb) = iag.vjp(f, [t([2.0]), t([5.0])], t([1.0]))
+        np.testing.assert_allclose(ga.value, [5.0], rtol=1e-6)
+        np.testing.assert_allclose(gb.value, [2.0], rtol=1e-6)
+
+
+class TestPrimToggles:
+    def test_toggles(self):
+        assert iag.prim_enabled() is False
+        iag.enable_prim()
+        assert iag.prim_enabled() is True
+        iag.disable_prim()
+        assert iag.prim_enabled() is False
+        assert iag.prim2orig() is None
+
+    def test_forward_grad_actionable(self):
+        with pytest.raises(NotImplementedError, match="jvp"):
+            iag.forward_grad(None, None)
+
+
+class TestReviewRegressions:
+    def test_grad_delegates(self):
+        x = t([2.0, 3.0])
+        x.stop_gradient = False
+        y = (x ** 2).sum()
+        (g,) = iag.grad(y, [x])
+        np.testing.assert_allclose(g.value, [4.0, 6.0], rtol=1e-6)
+
+    def test_hessian_multi_input_cross_terms(self):
+        # f(x, y) = x*y -> full hessian [[0, 1], [1, 0]]
+        def f(a, b):
+            return (a * b).sum()
+
+        H = iag.Hessian(f, [t([1.0]), t([1.0])])
+        np.testing.assert_allclose(H[:].value, [[0.0, 1.0], [1.0, 0.0]],
+                                   atol=1e-6)
+
+    def test_vjp_multi_output(self):
+        def f(a):
+            return (a * 2, a * 3)
+
+        out, g = iag.vjp(f, t([1.0, 1.0]))
+        np.testing.assert_allclose(g.value, [5.0, 5.0], rtol=1e-6)  # 2+3
