@@ -77,6 +77,7 @@ _LAZY_SUBMODULES = {
     "regularizer",
     "sparse",
     "static",
+    "utils",
     "vision",
 }
 

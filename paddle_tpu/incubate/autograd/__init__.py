@@ -56,6 +56,9 @@ class Jacobian:
 
     def __init__(self, func, xs, is_batched: bool = False,
                  batch_axis=None):
+        if batch_axis not in (None, 0):
+            raise ValueError(
+                f"batch_axis must be None or 0, got {batch_axis!r}")
         self._func = func
         self._xs = xs
         self._batched = is_batched or batch_axis == 0
@@ -79,13 +82,21 @@ class Jacobian:
                              if multi else 0)(*(xs if multi else [xs]))
         if multi:
             # concatenate along the input dimension (reference lays the
-            # multi-input Jacobian out as one wide matrix). Batched blocks
-            # are (B, out, in): keep batch and out, flatten in.
+            # multi-input Jacobian out as one wide matrix). Each jacrev
+            # block has shape (*out_shape, *in_shape_i): reshape to
+            # (out_size, in_size_i) from the KNOWN output size so scalar
+            # inputs and multi-dim outputs keep the right layout.
+            import math
+
             if self._batched:
-                flat = [j.reshape(j.shape[0], j.shape[1], -1) for j in jac]
+                out_aval = jax.eval_shape(
+                    lambda *a: f(*a), *[a[:1] for a in xs])
+                out_size = math.prod(out_aval.shape[1:]) or 1
+                flat = [j.reshape(j.shape[0], out_size, -1) for j in jac]
             else:
-                flat = [j.reshape(j.shape[0], -1) if j.ndim >= 2
-                        else j.reshape(1, -1) for j in jac]
+                out_aval = jax.eval_shape(f, *xs)
+                out_size = math.prod(out_aval.shape) or 1
+                flat = [j.reshape(out_size, -1) for j in jac]
             jac = jnp.concatenate(flat, axis=-1)
         self._mat = jac
         return jac
@@ -116,11 +127,19 @@ class Hessian(Jacobian):
         # flatten-concat ALL inputs into one vector so the Hessian is the
         # full (n, n) matrix INCLUDING cross terms (argnums=0 alone would
         # silently drop d2f/dxdy for multi-input funcs)
+        import math
+
         shapes = [jnp.shape(a) for a in args]
+        out_aval = jax.eval_shape(f, *args)
+        out_sz = math.prod(getattr(out_aval, "shape", ())) or 1
+        per_item = math.prod(getattr(out_aval, "shape", ())[1:]) or 1
+        if (per_item if self._batched else out_sz) != 1:
+            raise TypeError(
+                f"Hessian needs a scalar-output function (per batch item "
+                f"when batched); got output shape {out_aval.shape}")
         if self._batched:
             row_shapes = [s[1:] for s in shapes]
-            row_sizes = [max(1, int(jnp.prod(jnp.asarray(s, jnp.int32))))
-                         if s else 1 for s in row_shapes]
+            row_sizes = [math.prod(s) if s else 1 for s in row_shapes]
             offs = [0]
             for s in row_sizes:
                 offs.append(offs[-1] + s)

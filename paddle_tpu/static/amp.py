@@ -171,5 +171,6 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
     """reference static/amp/decorator.py decorate."""
     if use_pure_fp16:
         dtype = "float16"
+        level = "O2"   # pure fp16 IS O2: amp_init casts stored params
     return _DecoratedOptimizer(optimizer, amp_lists, level, dtype,
                                init_loss_scaling)

@@ -1,0 +1,104 @@
+"""paddle.utils parity (reference: python/paddle/utils/__init__.py —
+__all__ = deprecated, run_check, require_version, try_import; plus the
+unique_name / dlpack / download submodule surface).
+
+TPU-native notes: run_check exercises the actual accelerator path (a
+jitted matmul on every visible device) instead of the reference's CUDA
+install probe; dlpack rides jax's zero-copy dlpack bridge.
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+import re
+import warnings
+from typing import Optional
+
+from . import dlpack, download, unique_name  # noqa: F401
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import",
+           "unique_name", "dlpack", "download"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Decorator marking an API deprecated (reference
+    utils/deprecated.py): warns once per site; level>=2 raises."""
+
+    def deco(fn):
+        msg = f"API '{getattr(fn, '__name__', fn)}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use {update_to} instead"
+        if reason:
+            msg += f" ({reason})"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        wrapper.__deprecated__ = msg
+        return wrapper
+
+    return deco
+
+
+def run_check():
+    """Install check (reference utils/install_check.py run_check): run a
+    jitted matmul on the visible devices and report."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 8, ).astype("float32"))
+    out = jax.jit(lambda a: a @ a)(x)
+    out.block_until_ready()
+    print(f"PaddlePaddle (TPU-native) works on {len(devs)} "
+          f"{devs[0].platform} device(s).")
+    if len(devs) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(devs), ("d",))
+        y = jax.device_put(x, NamedSharding(mesh, P("d")))
+        jax.jit(lambda a: a * 2)(y).block_until_ready()
+        print(f"PaddlePaddle (TPU-native) works on {len(devs)} devices "
+              f"in parallel.")
+
+
+def _parse_ver(v: str):
+    return [int(p) for p in re.findall(r"\d+", v)[:4]]
+
+
+def require_version(min_version: str, max_version: Optional[str] = None):
+    """Check the installed framework version is within range (reference
+    utils/__init__ require_version)."""
+    import paddle_tpu
+
+    cur = _parse_ver(paddle_tpu.__version__)
+    if min_version is not None and cur < _parse_ver(str(min_version)):
+        raise Exception(
+            f"installed version {paddle_tpu.__version__} < required "
+            f"minimum {min_version}")
+    if max_version is not None and cur > _parse_ver(str(max_version)):
+        raise Exception(
+            f"installed version {paddle_tpu.__version__} > allowed "
+            f"maximum {max_version}")
+    return True
+
+
+def try_import(module_name: str, err_msg: Optional[str] = None):
+    """Import or raise with an actionable message (reference
+    utils/lazy_import.try_import)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"module {module_name!r} is required but not "
+            f"installed (and this environment forbids pip install — gate "
+            f"the feature instead)") from e

@@ -1,0 +1,23 @@
+"""paddle.utils.dlpack (reference: utils/dlpack.py to_dlpack/from_dlpack)
+over jax's zero-copy dlpack bridge — the interop path to torch/numpy."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor → DLPack capsule. jax arrays implement __dlpack__; torch &
+    numpy consume it zero-copy (device permitting)."""
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return v.__dlpack__()
+
+
+def from_dlpack(capsule) -> Tensor:
+    """DLPack capsule (or any __dlpack__ object, e.g. a torch tensor) →
+    Tensor."""
+    return Tensor(jnp.from_dlpack(capsule))

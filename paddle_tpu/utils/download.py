@@ -1,0 +1,36 @@
+"""paddle.utils.download (reference: utils/download.py
+get_weights_path_from_url): cache-dir resolution + fetch. This image has
+ZERO egress, so a cache MISS raises an actionable error instead of
+half-downloading; cache hits (pre-seeded weights) work normally."""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def get_weights_path_from_url(url: str, md5sum: Optional[str] = None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)
+
+
+def get_path_from_url(url: str, root_dir: str,
+                      md5sum: Optional[str] = None,
+                      check_exist: bool = True) -> str:
+    fname = os.path.basename(url.split("?")[0])
+    path = os.path.join(root_dir, fname)
+    if check_exist and os.path.isfile(path):
+        return path
+    try:
+        import urllib.request
+
+        os.makedirs(root_dir, exist_ok=True)
+        urllib.request.urlretrieve(url, path)  # noqa: S310
+        return path
+    except Exception as e:
+        raise RuntimeError(
+            f"could not download {url!r} (this environment may have no "
+            f"network egress); pre-seed the file at {path!r} instead"
+        ) from e

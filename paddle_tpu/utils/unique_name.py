@@ -1,0 +1,54 @@
+"""paddle.utils.unique_name (reference: utils/unique_name.py —
+generate/guard/switch over per-prefix counters)."""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids: Dict[str, int] = {}
+
+    def __call__(self, key: str) -> str:
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+def switch(new_generator: Optional[_Generator] = None):
+    """Swap the counter table; returns the old one (reference switch)."""
+    global _generator
+    old = _generator
+    _generator = new_generator if new_generator is not None else _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Fresh naming scope (reference guard): names inside restart from 0;
+    the outer table is restored on exit."""
+    if isinstance(new_generator, str):
+        # reference allows a prefix string: namespaced fresh generator
+        prefix = new_generator
+
+        class _Prefixed(_Generator):
+            def __call__(self, key):
+                return super().__call__(f"{prefix}{key}")
+
+        old = switch(_Prefixed())
+    else:
+        old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

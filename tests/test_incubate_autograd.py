@@ -97,3 +97,31 @@ class TestReviewRegressions:
 
         out, g = iag.vjp(f, t([1.0, 1.0]))
         np.testing.assert_allclose(g.value, [5.0, 5.0], rtol=1e-6)  # 2+3
+
+
+class TestJacobianLayouts:
+    def test_scalar_second_input(self):
+        # f(a, b) = a * b with b scalar: J = [diag-ish | a] (3, 4)
+        def f(a, b):
+            return a * b
+
+        J = iag.Jacobian(f, [t([1.0, 2.0, 3.0]), t(2.0)])
+        m = np.asarray(J[:].value)
+        assert m.shape == (3, 4)
+        np.testing.assert_allclose(m[:, :3], 2.0 * np.eye(3), rtol=1e-6)
+        np.testing.assert_allclose(m[:, 3], [1.0, 2.0, 3.0], rtol=1e-6)
+
+    def test_batch_axis_validation(self):
+        with pytest.raises(ValueError, match="batch_axis"):
+            iag.Jacobian(lambda x: x, t([1.0]), batch_axis=1)
+
+    def test_hessian_rejects_vector_output(self):
+        with pytest.raises(TypeError, match="scalar-output"):
+            iag.Hessian(lambda x: x ** 2, t([1.0, 2.0]))[:]
+
+    def test_pure_fp16_decorate_is_o2(self):
+        from paddle_tpu.static import amp as samp
+        from paddle_tpu.optimizer import SGD
+
+        opt = samp.decorate(SGD(learning_rate=0.1), use_pure_fp16=True)
+        assert opt._level == "O2" and opt._dtype == "float16"

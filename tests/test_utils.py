@@ -1,0 +1,86 @@
+"""paddle.utils tests (reference utils/__init__ surface + unique_name /
+dlpack / download submodules)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import utils
+
+
+class TestTopLevel:
+    def test_deprecated_warns_and_works(self):
+        @utils.deprecated(update_to="paddle.new_op", since="2.0")
+        def old_op(x):
+            return x + 1
+
+        with pytest.warns(DeprecationWarning, match="new_op"):
+            assert old_op(1) == 2
+
+    def test_deprecated_level2_raises(self):
+        @utils.deprecated(level=2)
+        def gone():
+            pass
+
+        with pytest.raises(RuntimeError, match="deprecated"):
+            gone()
+
+    def test_require_version(self):
+        assert utils.require_version("0.0.1") is True
+        with pytest.raises(Exception, match="minimum"):
+            utils.require_version("999.0.0")
+        with pytest.raises(Exception, match="maximum"):
+            utils.require_version("0.0.1", "0.0.2")
+
+    def test_try_import(self):
+        assert utils.try_import("math").sqrt(4) == 2
+        with pytest.raises(ImportError, match="no_such_mod"):
+            utils.try_import("no_such_mod")
+
+    def test_run_check(self, capsys):
+        utils.run_check()
+        out = capsys.readouterr().out
+        assert "works on" in out
+
+
+class TestUniqueName:
+    def test_generate_monotonic(self):
+        a = utils.unique_name.generate("fc")
+        b = utils.unique_name.generate("fc")
+        assert a != b and a.startswith("fc_") and b.startswith("fc_")
+
+    def test_guard_scopes(self):
+        with utils.unique_name.guard():
+            x = utils.unique_name.generate("w")
+        with utils.unique_name.guard():
+            y = utils.unique_name.generate("w")
+        assert x == y == "w_0"   # fresh scope restarts numbering
+
+    def test_guard_prefix(self):
+        with utils.unique_name.guard("block1_"):
+            n = utils.unique_name.generate("w")
+        assert n == "block1_w_0"
+
+
+class TestDlpack:
+    def test_roundtrip_with_torch(self):
+        torch = pytest.importorskip("torch")
+        t = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+        cap = utils.dlpack.to_dlpack(t)
+        tt = torch.utils.dlpack.from_dlpack(cap)
+        np.testing.assert_allclose(tt.numpy(), [1.0, 2.0, 3.0])
+        back = utils.dlpack.from_dlpack(torch.tensor([4.0, 5.0]))
+        np.testing.assert_allclose(np.asarray(back.value), [4.0, 5.0])
+
+
+class TestDownload:
+    def test_cache_hit(self, tmp_path):
+        f = tmp_path / "w.pdparams"
+        f.write_bytes(b"x")
+        got = utils.download.get_path_from_url(
+            "http://example.invalid/w.pdparams", str(tmp_path))
+        assert got == str(f)
+
+    def test_cache_miss_actionable(self, tmp_path):
+        with pytest.raises(RuntimeError, match="pre-seed"):
+            utils.download.get_path_from_url(
+                "http://example.invalid/missing.bin", str(tmp_path))

@@ -57,6 +57,7 @@ MODULES = [
     ("hub.py", "hub"),
     ("regularizer.py", "regularizer"),
     ("callbacks.py", "callbacks"),
+    ("utils/__init__.py", "utils"),
 ]
 
 _SKIP = {
