@@ -52,13 +52,13 @@ def main():
     results = {}
     for name, f in variants.items():
         try:
-            fwd_ms = timed(jax.jit(f), (q, k, v))
+            fwd_ms = timed(jax.jit(f), (q, k, v)) * 1e3
 
             def loss(q, k, v, _f=f):
                 return jnp.sum(_f(q, k, v).astype(jnp.float32))
 
             g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-            bwd_ms = timed(g, (q, k, v))
+            bwd_ms = timed(g, (q, k, v)) * 1e3
             results[name] = {"fwd_ms": round(fwd_ms, 3),
                              "fwdbwd_ms": round(bwd_ms, 3)}
         except Exception as e:  # noqa: BLE001 - report per-variant

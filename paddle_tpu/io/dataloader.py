@@ -247,12 +247,63 @@ class DataLoader:
             stop.set()
             pool.shutdown(wait=False, cancel_futures=True)
 
+    def _start_method(self) -> str:
+        """fork is cheapest, but forking after the JAX backend has live
+        threads+locks in the parent (the typical case — the model is built
+        before iteration) can deadlock children on cloned locked mutexes.
+        Prefer forkserver then, provided dataset/collate/init_fn survive
+        pickling (forkserver children start fresh, nothing is cloned).
+        Unpicklable datasets keep fork; ``use_shared_memory=False``
+        (thread pool) is the fully-safe fallback."""
+        cached = getattr(self, "_start_method_cache", None)
+        if cached is not None:
+            return cached
+        import os
+        import sys
+
+        try:
+            from jax._src import xla_bridge
+
+            jax_up = bool(getattr(xla_bridge, "_backends", None))
+        except Exception:
+            # probe broke (private attr moved): fail toward the SAFE mode
+            # whenever jax is even imported — fork is the deadlock risk
+            jax_up = "jax" in sys.modules
+        if not jax_up:
+            return "fork"  # liveness can transition up: don't cache
+        # forkserver children re-import __main__ (spawn.prepare); that
+        # requires __main__ to actually be importable — a stdin/REPL/
+        # notebook session has no real file and the child would die in
+        # runpy. fork is the only working mode there.
+        main = sys.modules.get("__main__")
+        spec = getattr(main, "__spec__", None)
+        mfile = getattr(main, "__file__", None)
+        if spec is None and not (mfile and os.path.exists(mfile)):
+            method = "fork"
+        else:
+            try:
+                import pickle
+
+                pickle.dumps((self.dataset, self.collate_fn,
+                              self.worker_init_fn))
+                method = "forkserver"
+            except Exception:
+                method = "fork"
+        # jax-up is permanent; cache so epochs>1 skip the dataset pickle
+        self._start_method_cache = method
+        return method
+
     def _iter_processes(self):
         """Forked worker processes + ordered delivery (the reference
         multiprocess path, dataloader_iter.py:358). Index batches fan out
         over one shared queue; results come back (batch_idx, data, err) and
         a reorder buffer restores sampler order (reference :700)."""
-        ctx = mp.get_context("fork")
+        method = self._start_method()
+        ctx = mp.get_context(method)
+        if method == "forkserver":
+            # forkserver preloads __main__ by default, which would re-run
+            # unguarded user training scripts inside the server process
+            ctx.set_forkserver_preload([])
         index_q = ctx.Queue()
         result_q = ctx.Queue()
         # default collate runs in numpy form inside workers; custom

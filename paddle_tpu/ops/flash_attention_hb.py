@@ -324,4 +324,20 @@ def flash_attention_bshd_hb(q, k, v, *, causal: bool = False,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     it = _interpret() if interpret is None else interpret
+    # re-validate VMEM score budget against the ACTUAL blocks this call
+    # will run (supports_hb only checks its default block=512; a direct
+    # call with larger blocks must not silently exceed the budget)
+    h = q.shape[2]
+    bq = _pick_block(q.shape[1], block_q, it)
+    bk = _pick_block(k.shape[1], block_k, it)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"flash_attention_bshd_hb: seq lens {q.shape[1]}/{k.shape[1]} "
+            f"not tileable by block_q={block_q}/block_k={block_k}")
+    if 2 * h * bq * bk * 4 > _VMEM_SCORE_BUDGET:
+        raise ValueError(
+            f"flash_attention_bshd_hb: scores+probs VMEM "
+            f"2*{h}*{bq}*{bk}*4 = {2 * h * bq * bk * 4} bytes exceeds the "
+            f"{_VMEM_SCORE_BUDGET} budget; use smaller block_q/block_k or "
+            "the per-head kernel (flash_attention_bhsd)")
     return _flash_hb(q, k, v, causal, float(sm_scale), block_q, block_k, it)

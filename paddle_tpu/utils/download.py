@@ -4,12 +4,23 @@ ZERO egress, so a cache MISS raises an actionable error instead of
 half-downloading; cache hits (pre-seeded weights) work normally."""
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Optional
 
 __all__ = ["get_weights_path_from_url", "get_path_from_url"]
 
 WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def _md5_matches(path: str, md5sum: Optional[str]) -> bool:
+    if md5sum is None:
+        return True
+    h = hashlib.md5()  # noqa: S324 - integrity check, not security
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
 
 
 def get_weights_path_from_url(url: str, md5sum: Optional[str] = None) -> str:
@@ -21,16 +32,31 @@ def get_path_from_url(url: str, root_dir: str,
                       check_exist: bool = True) -> str:
     fname = os.path.basename(url.split("?")[0])
     path = os.path.join(root_dir, fname)
+    stale = False
     if check_exist and os.path.isfile(path):
-        return path
+        if _md5_matches(path, md5sum):
+            return path
+        stale = True  # keep the file until a good replacement exists
+    # download to a temp path; only replace the cache entry on success so
+    # a failed re-fetch never destroys a pre-seeded file
+    tmp = path + ".part"
     try:
         import urllib.request
 
         os.makedirs(root_dir, exist_ok=True)
-        urllib.request.urlretrieve(url, path)  # noqa: S310
-        return path
+        urllib.request.urlretrieve(url, tmp)  # noqa: S310
     except Exception as e:
+        if os.path.isfile(tmp):
+            os.remove(tmp)
+        detail = (f"cached file failed md5 check ({md5sum}) and "
+                  if stale else "")
         raise RuntimeError(
-            f"could not download {url!r} (this environment may have no "
-            f"network egress); pre-seed the file at {path!r} instead"
+            f"could not download {url!r}: {detail}this environment may "
+            f"have no network egress; pre-seed the file at {path!r} instead"
         ) from e
+    if not _md5_matches(tmp, md5sum):
+        os.remove(tmp)
+        raise RuntimeError(
+            f"md5 mismatch for downloaded {url!r}: expected {md5sum}")
+    os.replace(tmp, path)
+    return path
