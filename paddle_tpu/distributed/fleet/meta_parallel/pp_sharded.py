@@ -43,8 +43,8 @@ from jax.sharding import PartitionSpec as P
 
 from ...topology import get_mesh
 
-__all__ = ["build_sharded_1f1b_grad_fn", "blocks_from_stacked",
-           "stacked_from_blocks"]
+__all__ = ["build_sharded_1f1b_grad_fn", "build_sharded_1f1b_resid_grad_fn",
+           "blocks_from_stacked", "stacked_from_blocks"]
 
 
 def blocks_from_stacked(stacked, S: int, V: int = 1):
@@ -77,6 +77,271 @@ def stacked_from_blocks(blocks):
     return jax.tree.map(go, blocks)
 
 
+def _psum_f32(tree, axis):
+    """Cross-stage grad reduction in fp32. Two reasons: (1) summing S
+    bf16 partials in fp32 is numerically tighter (the mix-precision
+    main-grad convention); (2) XLA CPU's AllReducePromotion pass crashes
+    cloning a LOW-precision all-reduce emitted by a partially-manual
+    shard_map (bf16 psum over the manual 'pp' axis while mp/sharding are
+    auto ->  reduction computation contains a 'copy' opcode; reproduced
+    jax 0.9.0) — fp32 psums never enter that pass, keeping the compile-
+    only 13B/65B memory analysis runnable on virtual CPU meshes."""
+    return jax.tree.map(
+        lambda g: lax.psum(g.astype(jnp.float32), axis).astype(g.dtype),
+        tree)
+
+
+def _schedule_dims(mesh, accumulate_steps, num_virtual_stages):
+    """Shared 1F1B schedule constants for both builders: (S stages, M
+    microbatches, V chunks/device, L virtual stages, NF fwd micro-steps,
+    G stash slots). Keep the two builders' schedule algebra identical —
+    edit here, not in one of them.
+
+    G = 2S is the TIGHT stash bound (it directly scales residual-stash
+    HBM): a slot written at forward tick t_f is read at its backward tick
+    t_b with t_b − t_f = (V−1−2k)·S + L+S−2−2s ≤ 2L−2, and the next
+    write to the same (chunk, m % G) slot comes (G/S)·L ticks later —
+    G = 2S (a multiple of S) gives 2L > 2L−2. Wraparound is exercised by
+    the M ≫ G parity test (tests/test_pp_resid.py)."""
+    S = int(mesh.shape.get("pp", 1))
+    M = int(accumulate_steps)
+    V = int(num_virtual_stages)
+    if V > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs accumulate_steps ({M}) divisible "
+            f"by the number of stages ({S})")
+    return S, M, V, S * V, M * V, 2 * S
+
+
+def build_sharded_1f1b_resid_grad_fn(
+        first_fn: Callable[[Any, Any], Any],
+        body_fwd: Callable[[Any, Any], Any],
+        body_bwd: Callable[[Any, Any, Any], Any],
+        last_fn: Callable[[Any, Any, Any], Any],
+        accumulate_steps: int,
+        mesh: Optional[Mesh] = None,
+        num_virtual_stages: int = 1) -> Callable:
+    """Residual-stashing 1F1B: the double-forward eliminator.
+
+    :func:`build_sharded_1f1b_grad_fn` stashes only each chunk's INPUT and
+    re-runs the chunk forward inside ``jax.vjp`` at the backward tick —
+    ~33% extra FLOPs (forward runs twice per microbatch). This variant
+    takes the chunk as an explicit fwd/bwd PAIR:
+
+    - ``body_fwd(chunk, h) -> (h_out, res)`` — residuals are plain arrays;
+    - ``body_bwd(chunk, res, g) -> (g_chunk, g_h)`` — MUST be linear in
+      ``g`` (invalid-tick masking seeds a zero cotangent) and take the
+      chunk params explicitly (no weight copies ride the stash).
+
+    The schedule stashes ``res`` between a microbatch's forward and
+    backward ticks — exactly the reference's stored-activation 1F1B
+    (meta_parallel/pipeline_parallel.py:372 holds forward outputs until
+    _backward_step :677) — so each DECODER forward runs ONCE. The edges
+    still go through per-tick ``jax.vjp``: ``last_fn`` (norm+head+loss)
+    runs once total (its forward only executes at the backward tick),
+    while ``first_fn`` runs twice (forward tick + vjp re-run) — fine for
+    an embedding lookup, so keep ``first_fn`` cheap. Total FLOPs come out
+    ~ideal fwd+bwd (measured 1.001x per device);
+    tests/test_pp_resid.py asserts the compiled-HLO bound.
+
+    Memory: the stash holds ``G = 2S`` slots of FULL per-chunk residuals
+    (vs one boundary activation) — the same activation footprint the
+    reference's stored-activation 1F1B pays. At scales where that exceeds
+    HBM, use the input-stashing builder (its vjp re-run is then the remat
+    choice, like the reference's recompute integration).
+
+    Build the pair for Llama with ``models.llama_residual.make_body_fwd_bwd``;
+    grad parity vs the serial model is asserted in tests/test_pp_resid.py.
+    """
+    mesh = mesh or get_mesh()
+    S, M, V, L, NF, G = _schedule_dims(mesh, accumulate_steps,
+                                       num_virtual_stages)
+
+    if S == 1:
+        # serial: same composition; the chunk's AD rule IS the hand-split
+        # pair (custom_vjp), so the body backward never re-traces the
+        # forward — and never tries to differentiate through a raw
+        # pallas_call inside body_fwd
+        @jax.custom_vjp
+        def chunk_apply(chunk, h):
+            return body_fwd(chunk, h)[0]
+
+        def _ca_fwd(chunk, h):
+            y, res = body_fwd(chunk, h)
+            return y, (chunk, res)
+
+        def _ca_bwd(saved, g):
+            chunk, res = saved
+            return body_bwd(chunk, res, g)
+
+        chunk_apply.defvjp(_ca_fwd, _ca_bwd)
+
+        def loss_all(blocks, edge, inputs, labels):
+            mb = inputs.shape[0] // M
+            xs = jnp.reshape(inputs, (M, mb) + inputs.shape[1:])
+            ys = jnp.reshape(labels, (M, mb) + labels.shape[1:])
+
+            def micro(acc, xy):
+                x, y = xy
+                h = first_fn(edge, x)
+                for p in range(L):
+                    h = chunk_apply(
+                        jax.tree.map(lambda b: b[0, p // S], blocks), h)
+                return acc + last_fn(edge, h, y), None
+
+            tot, _ = lax.scan(micro, jnp.zeros((), jnp.float32), (xs, ys))
+            return tot / M
+
+        vg = jax.value_and_grad(loss_all, argnums=(0, 1))
+        return lambda b, e, i, y: vg(b, e, i, y)
+
+    from ....core.random import default_generator, trace_key_scope
+
+    def grad_fn(blocks, edge, inputs, labels):
+        mb = inputs.shape[0] // M
+        xs = jnp.reshape(inputs, (M, mb) + inputs.shape[1:])
+        ys = jnp.reshape(labels, (M, mb) + labels.shape[1:])
+        h_aval = jax.eval_shape(
+            lambda e, x: first_fn(e, x), edge,
+            jax.ShapeDtypeStruct((mb,) + inputs.shape[1:], inputs.dtype))
+        # residual aval: structure is chunk-independent (homogeneous body)
+        chunk_aval = jax.tree.map(
+            lambda b: jax.ShapeDtypeStruct(b.shape[2:], b.dtype), blocks)
+        res_aval = jax.eval_shape(
+            lambda c, h: body_fwd(c, h)[1], chunk_aval, h_aval)
+        base_key = default_generator.next_key()
+
+        def worker(blocks, edge, xs, ys):
+            blocks = jax.tree.map(lambda b: b[0], blocks)   # local (V, lpc,…)
+            s = lax.axis_index("pp")
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+            T = NF + L + S - 2
+
+            def fbranch(p):
+                k, first, last = p // S, p == 0, p == L - 1
+
+                def go(local, edge, x_raw, h_in):
+                    chunk = jax.tree.map(lambda b: b[k], local)
+                    h0 = first_fn(edge, x_raw) if first else h_in
+                    h, res = body_fwd(chunk, h0)
+                    # ring value; the last chunk's body OUTPUT is stashed
+                    # separately for the backward tick's last_fn vjp
+                    ring = (jnp.zeros(h_aval.shape, h_aval.dtype) if last
+                            else h.astype(h_aval.dtype))
+                    h_last = (h.astype(h_aval.dtype) if last
+                              else jnp.zeros(h_aval.shape, h_aval.dtype))
+                    return ring, res, h_last
+
+                return go
+
+            def bbranch(pb):
+                kb, first, last = pb // S, pb == 0, pb == L - 1
+
+                def go(local, edge, res, g_recv, h_last, x_raw, y, bmask):
+                    chunk = jax.tree.map(lambda b: b[kb], local)
+                    if last:
+                        l_b, vjp_l = jax.vjp(
+                            lambda e, h: last_fn(e, h, y), edge, h_last)
+                        ge, g_h = vjp_l(bmask)
+                        g_h = g_h.astype(h_aval.dtype)
+                    else:
+                        l_b = jnp.zeros((), jnp.float32)
+                        ge = jax.tree.map(
+                            lambda e: jnp.zeros(e.shape, e.dtype), edge)
+                        g_h = g_recv * bmask.astype(h_aval.dtype)
+                    g_chunk, g_h_in = body_bwd(chunk, res, g_h)
+                    if first:
+                        _, vjp_f = jax.vjp(
+                            lambda e: first_fn(e, x_raw), edge)
+                        (ge_f,) = vjp_f(g_h_in)
+                        ge = jax.tree.map(jnp.add, ge, ge_f)
+                        g_out = jnp.zeros(h_aval.shape, h_aval.dtype)
+                    else:
+                        g_out = g_h_in.astype(h_aval.dtype)
+                    return g_out, g_chunk, ge, l_b.astype(jnp.float32)
+
+                return go
+
+            fbranches = [fbranch(p) for p in range(L)]
+            bbranches = [bbranch(p) for p in range(L)]
+
+            def tick(carry, t):
+                (h_recv, g_recv, stash_res, stash_hl, bgrads, egrads,
+                 lacc) = carry
+                # ---- forward ----
+                i = t - s
+                fvalid = jnp.logical_and(i >= 0, i < NF)
+                ic = jnp.clip(i, 0, NF - 1)
+                k = (ic % L) // S
+                p = k * S + s
+                m = (ic // L) * S + ic % S
+                with trace_key_scope(jax.random.fold_in(base_key, m)):
+                    h_out, res, h_last = lax.switch(
+                        p, fbranches, blocks, edge, xs[m], h_recv)
+                stash_res = lax.cond(
+                    fvalid,
+                    lambda st: jax.tree.map(
+                        lambda sl, r: sl.at[k, m % G].set(r), st, res),
+                    lambda st: st, stash_res)
+                stash_hl = lax.cond(
+                    jnp.logical_and(fvalid, p == L - 1),
+                    lambda st: st.at[m % G].set(h_last),
+                    lambda st: st, stash_hl)
+
+                # ---- backward ----
+                j = t - (L + S - 2 - s)
+                bvalid = jnp.logical_and(j >= 0, j < NF)
+                jc = jnp.clip(j, 0, NF - 1)
+                kb = V - 1 - (jc % L) // S
+                pb = kb * S + s
+                m_b = (jc // L) * S + jc % S
+                res_b = jax.tree.map(lambda sl: sl[kb, m_b % G], stash_res)
+                bmask = bvalid.astype(jnp.float32)
+                with trace_key_scope(jax.random.fold_in(base_key, m_b)):
+                    g_out, g_chunk, ge, l_b = lax.switch(
+                        pb, bbranches, blocks, edge, res_b, g_recv,
+                        stash_hl[m_b % G], xs[m_b], ys[m_b], bmask)
+                bgrads = jax.tree.map(
+                    lambda bg, gc: bg.at[kb].add(gc), bgrads, g_chunk)
+                egrads = jax.tree.map(jnp.add, egrads, ge)
+                lacc = lacc + jnp.where(bvalid, l_b, 0.0)
+
+                h_next = lax.ppermute(h_out, "pp", fwd_perm)
+                g_next = lax.ppermute(g_out, "pp", bwd_perm)
+                return (h_next, g_next, stash_res, stash_hl, bgrads,
+                        egrads, lacc), None
+
+            carry0 = (
+                jnp.zeros(h_aval.shape, h_aval.dtype),
+                jnp.zeros(h_aval.shape, h_aval.dtype),
+                jax.tree.map(lambda r: jnp.zeros((V, G) + r.shape, r.dtype),
+                             res_aval),
+                jnp.zeros((G,) + h_aval.shape, h_aval.dtype),
+                jax.tree.map(lambda b: jnp.zeros(b.shape, b.dtype), blocks),
+                jax.tree.map(lambda e: jnp.zeros(e.shape, e.dtype), edge),
+                jnp.zeros((), jnp.float32),
+            )
+            (_, _, _, _, bgrads, egrads, lacc), _ = lax.scan(
+                tick, carry0, jnp.arange(T))
+            bgrads = jax.tree.map(lambda g: g[None] / M, bgrads)
+            egrads = jax.tree.map(lambda g: g / M, _psum_f32(egrads, "pp"))
+            return lax.psum(lacc, "pp") / M, bgrads, egrads
+
+        from jax import shard_map
+
+        fn = shard_map(
+            worker, mesh=mesh,
+            in_specs=(P("pp"), P(), P(), P()),
+            out_specs=(P(), P("pp"), P()),
+            axis_names={"pp"},
+            check_vma=False)
+        loss, bgrads, egrads = fn(blocks, edge, xs, ys)
+        return loss, (bgrads, egrads)
+
+    return grad_fn
+
+
 def build_sharded_1f1b_grad_fn(
         first_fn: Callable[[Any, Any], Any],
         body_fn: Callable[[Any, Any], Any],
@@ -102,17 +367,8 @@ def build_sharded_1f1b_grad_fn(
     the same sharding and the whole update stays 1/S per device.
     """
     mesh = mesh or get_mesh()
-    S = int(mesh.shape.get("pp", 1))
-    M = int(accumulate_steps)
-    V = int(num_virtual_stages)
-    L = S * V
-    NF = M * V
-    G = 2 * S + 4
-
-    if V > 1 and M % S:
-        raise ValueError(
-            f"interleaved schedule needs accumulate_steps ({M}) divisible "
-            f"by the number of stages ({S})")
+    S, M, V, L, NF, G = _schedule_dims(mesh, accumulate_steps,
+                                       num_virtual_stages)
 
     if S == 1:
         # no pp axis: serial chunks with scanned grad accumulation
@@ -231,7 +487,7 @@ def build_sharded_1f1b_grad_fn(
             # dim — no cross-stage psum (this is the memory win)
             bgrads = jax.tree.map(lambda g: g[None] / M, bgrads)
             # edge grads & loss are replicated-contract: psum assembles
-            egrads = jax.tree.map(lambda g: lax.psum(g, "pp") / M, egrads)
+            egrads = jax.tree.map(lambda g: g / M, _psum_f32(egrads, "pp"))
             return lax.psum(lacc, "pp") / M, bgrads, egrads
 
         from jax import shard_map

@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
@@ -32,8 +33,8 @@ from .llama import LlamaConfig, _rope_cos_sin, apply_rotary_emb
 from .llama_functional import _layer_fwd, _rms
 
 __all__ = ["llama_pp_fns", "block_specs", "edge_specs", "moment_specs",
-           "build_llama_hybrid_step", "save_hybrid_checkpoint",
-           "load_hybrid_checkpoint"]
+           "build_llama_hybrid_step", "hybrid_memory_analysis",
+           "save_hybrid_checkpoint", "load_hybrid_checkpoint"]
 
 
 def llama_pp_fns(cfg: LlamaConfig, remat: bool = True,
@@ -128,7 +129,8 @@ def build_llama_hybrid_step(cfg: LlamaConfig, mesh: Mesh,
                             num_virtual_stages: int = 1,
                             lr: float = 1e-4, clip_norm: float = 1.0,
                             zero: bool = True, remat: bool = True,
-                            moment_dtype=jnp.float32):
+                            moment_dtype=jnp.float32,
+                            stash: Optional[str] = None):
     """Returns ``(step, prepare)``:
 
     - ``prepare(stacked, rest) -> (blocks, edge, opt_state)`` — rearranges
@@ -137,18 +139,46 @@ def build_llama_hybrid_step(cfg: LlamaConfig, mesh: Mesh,
     - ``step(blocks, edge, opt_state, ids, labels) ->
       (blocks, edge, opt_state, loss)`` — jitted 1F1B hybrid train step
       with donated buffers.
+
+    ``stash`` picks the 1F1B activation policy:
+
+    - ``"residuals"``: hand-split decoder backward over stashed per-layer
+      residuals — each decoder forward runs ONCE (~ideal FLOPs; the
+      reference's stored-activation 1F1B, pipeline_parallel.py:372/677) at
+      the cost of ~2S in-flight microbatches of full layer activations.
+      ``remat`` is moot on this path (nothing is recomputed).
+    - ``"input"``: stash only stage-boundary activations and re-run the
+      chunk forward inside the backward tick's ``jax.vjp`` (~1.33x FLOPs)
+      — the full-recompute choice for memory-bound scales.
+    - ``None`` (default): follow ``remat`` — a caller asking for remat
+      wants the memory-lean profile (``"input"``); ``remat=False`` gets
+      the fast path (``"residuals"``). Existing callers keep their
+      memory behavior; pass ``stash`` explicitly to decouple.
     """
     from ..distributed.fleet.meta_parallel.pp_sharded import (
-        blocks_from_stacked, build_sharded_1f1b_grad_fn)
+        blocks_from_stacked, build_sharded_1f1b_grad_fn,
+        build_sharded_1f1b_resid_grad_fn)
     from ..optimizer.functional import (adamw_init, adamw_update,
                                         clip_by_global_norm)
 
     S = int(mesh.shape.get("pp", 1))
     V = int(num_virtual_stages)
     first_fn, body_fn, last_fn = llama_pp_fns(cfg, remat=remat)
-    grad_fn = build_sharded_1f1b_grad_fn(
-        first_fn, body_fn, last_fn, accumulate_steps, mesh,
-        num_virtual_stages=V)
+    if stash is None:
+        stash = "input" if remat not in (False, "none") else "residuals"
+    if stash == "residuals":
+        from .llama_residual import make_body_fwd_bwd
+
+        body_fwd, body_bwd = make_body_fwd_bwd(cfg)
+        grad_fn = build_sharded_1f1b_resid_grad_fn(
+            first_fn, body_fwd, body_bwd, last_fn, accumulate_steps, mesh,
+            num_virtual_stages=V)
+    elif stash == "input":
+        grad_fn = build_sharded_1f1b_grad_fn(
+            first_fn, body_fn, last_fn, accumulate_steps, mesh,
+            num_virtual_stages=V)
+    else:
+        raise ValueError(f"unknown stash policy {stash!r}")
 
     def prepare(stacked, rest):
         blocks = blocks_from_stacked(stacked, S, V)
@@ -177,6 +207,131 @@ def build_llama_hybrid_step(cfg: LlamaConfig, mesh: Mesh,
         return params["b"], params["e"], opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1, 2)), prepare
+
+
+def llama_param_shapes(cfg: LlamaConfig):
+    """(stacked_shapes, rest_shapes) of the llama_functional layout, from
+    the config alone — lets compile-only analysis at 13B/65B dims build
+    abstract arguments without materializing half a terabyte of params."""
+    L, H, I = (cfg.num_hidden_layers, cfg.hidden_size,
+               cfg.intermediate_size)
+    nh, kvh, hd = (cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim)
+    stacked = {
+        "input_layernorm.weight": (L, H),
+        "post_attention_layernorm.weight": (L, H),
+        "self_attn.q_proj.weight": (L, H, nh * hd),
+        "self_attn.k_proj.weight": (L, H, kvh * hd),
+        "self_attn.v_proj.weight": (L, H, kvh * hd),
+        "self_attn.o_proj.weight": (L, nh * hd, H),
+        "mlp.gate_proj.weight": (L, H, I),
+        "mlp.up_proj.weight": (L, H, I),
+        "mlp.down_proj.weight": (L, I, H),
+    }
+    rest = {
+        "model.embed_tokens.weight": (cfg.vocab_size, H),
+        "model.norm.weight": (H,),
+        "lm_head.weight": (H, cfg.vocab_size),
+    }
+    return stacked, rest
+
+
+def hybrid_memory_analysis(cfg: LlamaConfig, mesh: Mesh,
+                           accumulate_steps: int,
+                           num_virtual_stages: int = 1,
+                           batch_per_micro: int = 1, seq_len: int = 4096,
+                           zero: bool = True, remat=True,
+                           stash: Optional[str] = None,
+                           param_dtype=jnp.bfloat16,
+                           moment_dtype=jnp.float32,
+                           hbm_budget: int = 95 << 30) -> Dict[str, Any]:
+    """Compile-only per-device memory feasibility for BASELINE config 3
+    (Llama-2 13B/65B hybrid TP x PP x sharding) — proves the stage-local
+    PP + ZeRO placement fits a v5p HBM budget WITHOUT the hardware.
+
+    Builds the full jitted hybrid train step at real dims over abstract
+    sharded arguments (``jax.ShapeDtypeStruct`` + NamedSharding — nothing
+    is materialized), compiles it AOT, and reads XLA's buffer-assignment
+    ``memory_analysis()``. Returns a report dict; ``fits`` is the headline
+    (per-device arguments + temps within ``hbm_budget``; with donation the
+    outputs alias the argument buffers).
+
+    Run via ``python bench.py hybrid`` (spawns the virtual-device mesh) or
+    the 13B/8-device test in tests/test_hybrid_memory.py.
+    """
+    import functools
+
+    from ..distributed.fleet.meta_parallel.pp_sharded import (
+        blocks_from_stacked)
+    from ..optimizer.functional import adamw_init
+
+    S = int(mesh.shape.get("pp", 1))
+    V = int(num_virtual_stages)
+    M = int(accumulate_steps)
+    # resolve the stash default ONCE (same rule as build_llama_hybrid_step)
+    # so the report names the policy that was actually compiled
+    if stash is None:
+        stash = "input" if remat not in (False, "none") else "residuals"
+    stacked_shapes, rest_shapes = llama_param_shapes(cfg)
+    stacked_avals = {k: jax.ShapeDtypeStruct(s, param_dtype)
+                     for k, s in stacked_shapes.items()}
+    rest_avals = {k: jax.ShapeDtypeStruct(s, param_dtype)
+                  for k, s in rest_shapes.items()}
+    blocks_avals = jax.eval_shape(
+        functools.partial(blocks_from_stacked, S=S, V=V), stacked_avals)
+
+    def _sds(avals, specs):
+        return {k: jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=NamedSharding(mesh, specs[k]))
+                for k, v in avals.items()}
+
+    bspec = block_specs(blocks_avals.keys())
+    espec = edge_specs(rest_avals.keys())
+    blocks_in = _sds(blocks_avals, bspec)
+    edge_in = _sds(rest_avals, espec)
+    opt_aval = jax.eval_shape(
+        lambda b, e: adamw_init({"b": b, "e": e},
+                                master_dtype=moment_dtype),
+        blocks_avals, rest_avals)
+    if zero:
+        mb, me = moment_specs(blocks_avals, rest_avals)
+    else:
+        mb, me = bspec, espec
+    rep = NamedSharding(mesh, P())
+    opt_in = opt_aval._replace(
+        step=jax.ShapeDtypeStruct(opt_aval.step.shape, opt_aval.step.dtype,
+                                  sharding=rep),
+        m={"b": _sds(opt_aval.m["b"], mb), "e": _sds(opt_aval.m["e"], me)},
+        v={"b": _sds(opt_aval.v["b"], mb), "e": _sds(opt_aval.v["e"], me)})
+    gb = M * batch_per_micro
+    ids_in = jax.ShapeDtypeStruct((gb, seq_len), jnp.int32, sharding=rep)
+    y_in = jax.ShapeDtypeStruct((gb, seq_len), jnp.int32, sharding=rep)
+
+    step, _ = build_llama_hybrid_step(
+        cfg, mesh, accumulate_steps=M, num_virtual_stages=V,
+        zero=zero, remat=remat, stash=stash, moment_dtype=moment_dtype)
+    compiled = step.lower(blocks_in, edge_in, opt_in, ids_in, y_in).compile()
+    ma = compiled.memory_analysis()
+    arg_b = int(ma.argument_size_in_bytes)
+    tmp_b = int(ma.temp_size_in_bytes)
+    out_b = int(ma.output_size_in_bytes)
+    # donated params/opt-state alias their outputs; peak ~ args + temps
+    peak = arg_b + tmp_b
+    n_params = sum(int(np.prod(s)) for s in stacked_shapes.values())
+    n_params += sum(int(np.prod(s)) for s in rest_shapes.values())
+    return {
+        "model": f"llama-{n_params/1e9:.1f}B",
+        "mesh": {ax: int(n) for ax, n in mesh.shape.items()},
+        "virtual_stages": V, "accumulate_steps": M,
+        "micro_batch": batch_per_micro, "seq_len": seq_len,
+        "stash": stash,
+        "zero": zero,
+        "per_device": {"argument_bytes": arg_b, "temp_bytes": tmp_b,
+                       "output_bytes": out_b, "peak_bytes": peak},
+        "hbm_budget_bytes": int(hbm_budget),
+        "fits": peak <= hbm_budget,
+        "peak_gib": round(peak / (1 << 30), 2),
+    }
 
 
 def save_hybrid_checkpoint(path: str, blocks, edge):

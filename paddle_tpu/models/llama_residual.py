@@ -1,0 +1,182 @@
+"""Hand-split Llama decoder layer: forward returning stashable residuals,
+backward consuming them — the 1F1B double-forward eliminator.
+
+The compiled 1F1B schedule (pp_sharded) originally rematerialized each
+chunk's forward inside per-tick ``jax.vjp`` (~33% extra FLOPs — the
+forward runs once to feed the pipeline and AGAIN inside the backward
+tick's vjp). The reference instead stores activations between the forward
+and backward micro-steps (meta_parallel/pipeline_parallel.py:372 holds
+``_forward_step`` outputs until ``_backward_step`` :677). This module is
+the TPU equivalent: the layer backward is written BY HAND as a pure
+function of (params, residuals, cotangent), so residuals — plain arrays —
+ride the schedule's stash instead of a vjp closure, and no weight copies
+enter the carry (params are passed explicitly at the backward tick).
+
+What gets stashed per layer (``LayerResiduals``): the layer input, post-rope
+q/k, v, the attention context + its log-sum-exp (the flash-attention
+backward contract, ops/flash_residual.py), the post-attention residual
+stream, and the two MLP pre-activations. Everything else (RMS norms, RoPE,
+SiLU) is elementwise and recomputed inside the backward — their cost is
+noise next to the matmuls, which are never re-run. Matmul backwards are
+hand-written (dW = x^T g, dx = g W^T); elementwise backwards reuse local
+``jax.vjp`` (cheap, and immune to hand-derivation slips).
+
+Grad parity vs ``jax.vjp`` of the fused forward is asserted in
+tests/test_pp_resid.py, together with a compiled-HLO FLOPs bound.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, _rope_cos_sin, apply_rotary_emb
+from .llama_functional import _rms
+
+__all__ = ["LayerResiduals", "layer_fwd_res", "layer_bwd_res",
+           "make_body_fwd_bwd"]
+
+
+class LayerResiduals(NamedTuple):
+    """Stashable activations of one decoder layer (see module docstring)."""
+    x: jax.Array        # layer input                     [B, S, H]
+    qh: jax.Array       # post-rope queries               [B, S, nh, hd]
+    kh: jax.Array       # post-rope keys                  [B, S, kvh, hd]
+    vh: jax.Array       # values                          [B, S, kvh, hd]
+    ctx: jax.Array      # attention context               [B, S, nh, hd]
+    lse: jax.Array      # attention log-sum-exp fp32      [B, nh, S]
+    x2: jax.Array       # post-attention residual stream  [B, S, H]
+    zg: jax.Array       # gate pre-activation             [B, S, I]
+    u: jax.Array        # up projection                   [B, S, I]
+
+
+def layer_fwd_res(lp: Dict[str, Any], x, cos, sin, cfg: LlamaConfig
+                  ) -> Tuple[jax.Array, LayerResiduals]:
+    """Same math as llama_functional._layer_fwd, but attention goes through
+    the explicit-residual flash pair and every backward-needed intermediate
+    is returned."""
+    from ..ops.flash_residual import flash_fwd_res
+
+    b, s, h = x.shape
+    hd = cfg.head_dim
+    xn = _rms(x, lp["input_layernorm.weight"], cfg.rms_norm_eps)
+    q = xn @ lp["self_attn.q_proj.weight"]
+    k = xn @ lp["self_attn.k_proj.weight"]
+    v = xn @ lp["self_attn.v_proj.weight"]
+    qh = apply_rotary_emb(q.reshape(b, s, cfg.num_attention_heads, hd),
+                          cos, sin)
+    kh = apply_rotary_emb(k.reshape(b, s, cfg.kv_heads, hd), cos, sin)
+    vh = v.reshape(b, s, cfg.kv_heads, hd)
+    ctx, lse = flash_fwd_res(qh, kh, vh, causal=True)
+    x2 = x + ctx.reshape(b, s, -1) @ lp["self_attn.o_proj.weight"]
+    xn2 = _rms(x2, lp["post_attention_layernorm.weight"], cfg.rms_norm_eps)
+    zg = xn2 @ lp["mlp.gate_proj.weight"]
+    u = xn2 @ lp["mlp.up_proj.weight"]
+    y = x2 + (jax.nn.silu(zg) * u) @ lp["mlp.down_proj.weight"]
+    return y, LayerResiduals(x, qh, kh, vh, ctx, lse, x2, zg, u)
+
+
+def layer_bwd_res(lp: Dict[str, Any], res: LayerResiduals, gy, cos, sin,
+                  cfg: LlamaConfig) -> Tuple[Dict[str, Any], jax.Array]:
+    """(grad_layer_params, grad_layer_input) from stashed residuals.
+    Linear in ``gy``. Matmuls run exactly once (their transposes); only
+    elementwise ops (rms/rope/silu) are recomputed."""
+    from ..ops.flash_residual import flash_bwd_res
+
+    x, qh, kh, vh, ctx, lse, x2, zg, u = res
+    b, s, h = x.shape
+    hd = cfg.head_dim
+    eps = cfg.rms_norm_eps
+    w_ln2 = lp["post_attention_layernorm.weight"]
+    w_ln1 = lp["input_layernorm.weight"]
+
+    # ---- MLP ----
+    gate = jax.nn.silu(zg)
+    gu = gate * u
+    d_gu = gy @ lp["mlp.down_proj.weight"].T
+    dWd = jnp.einsum("bsi,bsh->ih", gu, gy)
+    du = d_gu * gate
+    sg = jax.nn.sigmoid(zg)
+    dzg = d_gu * u * (sg * (1.0 + zg * (1.0 - sg)))      # silu'
+    xn2, rms2_vjp = jax.vjp(lambda xx, ww: _rms(xx, ww, eps), x2, w_ln2)
+    dWg = jnp.einsum("bsh,bsi->hi", xn2, dzg)
+    dWu = jnp.einsum("bsh,bsi->hi", xn2, du)
+    dxn2 = dzg @ lp["mlp.gate_proj.weight"].T + du @ lp["mlp.up_proj.weight"].T
+    dx2_rms, dw_ln2 = rms2_vjp(dxn2)
+    dx2 = gy + dx2_rms
+
+    # ---- attention output projection ----
+    ctxf = ctx.reshape(b, s, -1)
+    dctxf = dx2 @ lp["self_attn.o_proj.weight"].T
+    dWo = jnp.einsum("bsc,bsh->ch", ctxf, dx2)
+    dctx = dctxf.reshape(b, s, cfg.num_attention_heads, hd)
+
+    # ---- flash attention ----
+    dqh, dkh, dvh = flash_bwd_res(qh, kh, vh, ctx, lse, dctx, causal=True)
+
+    # ---- RoPE transpose: rotation by -theta (rope is orthogonal) ----
+    dq = apply_rotary_emb(dqh, cos, -sin).reshape(b, s, -1)
+    dk = apply_rotary_emb(dkh, cos, -sin).reshape(b, s, -1)
+    dv = dvh.reshape(b, s, -1)
+
+    # ---- qkv projections + input norm ----
+    xn1, rms1_vjp = jax.vjp(lambda xx, ww: _rms(xx, ww, eps), x, w_ln1)
+    dWq = jnp.einsum("bsh,bsc->hc", xn1, dq)
+    dWk = jnp.einsum("bsh,bsc->hc", xn1, dk)
+    dWv = jnp.einsum("bsh,bsc->hc", xn1, dv)
+    dxn1 = (dq @ lp["self_attn.q_proj.weight"].T
+            + dk @ lp["self_attn.k_proj.weight"].T
+            + dv @ lp["self_attn.v_proj.weight"].T)
+    dx_rms, dw_ln1 = rms1_vjp(dxn1)
+    dx = dx2 + dx_rms
+
+    g_lp = {
+        "input_layernorm.weight": dw_ln1.astype(w_ln1.dtype),
+        "post_attention_layernorm.weight": dw_ln2.astype(w_ln2.dtype),
+        "self_attn.q_proj.weight": dWq.astype(lp["self_attn.q_proj.weight"].dtype),
+        "self_attn.k_proj.weight": dWk.astype(lp["self_attn.k_proj.weight"].dtype),
+        "self_attn.v_proj.weight": dWv.astype(lp["self_attn.v_proj.weight"].dtype),
+        "self_attn.o_proj.weight": dWo.astype(lp["self_attn.o_proj.weight"].dtype),
+        "mlp.gate_proj.weight": dWg.astype(lp["mlp.gate_proj.weight"].dtype),
+        "mlp.up_proj.weight": dWu.astype(lp["mlp.up_proj.weight"].dtype),
+        "mlp.down_proj.weight": dWd.astype(lp["mlp.down_proj.weight"].dtype),
+    }
+    return g_lp, dx.astype(x.dtype)
+
+
+def make_body_fwd_bwd(cfg: LlamaConfig):
+    """(body_fwd, body_bwd) over a stacked chunk (leaves lead with lpc) for
+    pp_sharded.build_sharded_1f1b_resid_grad_fn:
+
+    - ``body_fwd(chunk, h) -> (h_out, res)`` — forward scan collecting
+      per-layer residuals (res leaves lead with lpc).
+    - ``body_bwd(chunk, res, g) -> (g_chunk, g_h)`` — REVERSE scan through
+      the hand-split layer backward; g_chunk comes out stacked in the
+      chunk's own layout.
+    """
+
+    def body_fwd(chunk, h):
+        cos, sin = _rope_cos_sin(h.shape[1], cfg.head_dim, cfg.rope_theta,
+                                 h.dtype)
+
+        def step(x, lp):
+            y, res = layer_fwd_res(lp, x, cos, sin, cfg)
+            return y, res
+
+        h_out, res = jax.lax.scan(step, h, chunk)
+        return h_out, res
+
+    def body_bwd(chunk, res, g):
+        cos, sin = _rope_cos_sin(g.shape[1], cfg.head_dim, cfg.rope_theta,
+                                 g.dtype)
+
+        def step(gy, lp_res):
+            lp, r = lp_res
+            g_lp, g_x = layer_bwd_res(lp, r, gy, cos, sin, cfg)
+            return g_x, g_lp
+
+        g_h, g_chunk = jax.lax.scan(step, g, (chunk, res), reverse=True)
+        return g_chunk, g_h
+
+    return body_fwd, body_bwd

@@ -1,0 +1,142 @@
+"""Explicit-residual flash attention: fwd returns (out, lse), bwd consumes
+them — no closure, so the pair can straddle a pipeline schedule.
+
+``jax.vjp``'s backward closure cannot ride a ``lax.scan`` carry; the 1F1B
+residual-stashing schedule (pp_sharded.build_sharded_1f1b_resid_grad_fn)
+needs attention backward as a PURE function of stashable arrays. This module
+exposes exactly that pair in the paddle ``[B, S, H, D]`` layout:
+
+- ``flash_fwd_res(q, k, v, causal)   -> (out, lse)``
+- ``flash_bwd_res(q, k, v, out, lse, do, causal) -> (dq, dk, dv)``
+
+TPU routes to this framework's Pallas kernels (flash_attention_kernel.py
+``_fwd_impl``/``_bwd_impl`` — the same code the custom_vjp path runs, so
+numerics are identical); other backends use a jnp composition that
+materializes the [B, H, Sq, Sk] score matrix (test-scale only — the TPU
+path never does).
+
+Reference analog: phi/kernels/gpu/flash_attn_grad_kernel.cu consumes the
+softmax_lse the forward kernel saved (flash_attn_kernel.cu:213) — the same
+explicit-residual contract.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_fwd_res", "flash_bwd_res"]
+
+
+def _use_kernel(q, k, interpret) -> bool:
+    from .flash_attention_kernel import _interpret, supports
+
+    it = _interpret() if interpret is None else interpret
+    on_tpu = False
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        pass
+    return (on_tpu or it) and supports(q.shape[1], k.shape[1], it)
+
+
+def _blocks(q, k, causal):
+    from .autotune import flash_signature, lookup
+
+    tuned = lookup("flash_attention",
+                   flash_signature(q.shape[1], k.shape[1], q.shape[-1],
+                                   causal, jnp.dtype(q.dtype).name)) or {}
+    return tuned.get("block_q", 1024), tuned.get("block_k", 1024)
+
+
+def _mask(sq, sk, causal):
+    if not causal:
+        return None
+    # bottom-right alignment: query i attends keys <= i + (sk - sq)
+    qpos = jnp.arange(sq)[:, None]
+    kpos = jnp.arange(sk)[None, :]
+    return qpos + (sk - sq) >= kpos
+
+
+def _scores(q, k, sm_scale):
+    # q,k: [B,S,H,D] -> [B,H,Sq,Sk] fp32
+    return jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                      k.astype(jnp.float32)) * sm_scale
+
+
+def _rep_kv(q, k, v):
+    g = q.shape[2] // k.shape[2]
+    if g > 1:
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+    return k, v, g
+
+
+def flash_fwd_res(q, k, v, *, causal: bool = False,
+                  sm_scale: Optional[float] = None,
+                  interpret: Optional[bool] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """[B, S, H, D] in; returns (out [B,S,H,D], lse [B,H,S] fp32)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if _use_kernel(q, k, interpret):
+        from .flash_attention_kernel import _fwd_impl, _interpret
+
+        it = _interpret() if interpret is None else interpret
+        bq, bk = _blocks(q, k, causal)
+        qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+        seed = jnp.zeros((1,), jnp.int32)
+        out, lse = _fwd_impl(qt, kt, vt, seed, causal, float(sm_scale),
+                             0.0, bq, bk, it)
+        return jnp.swapaxes(out, 1, 2), lse
+    kr, vr, _ = _rep_kv(q, k, v)
+    s = _scores(q, kr, sm_scale)
+    m = _mask(q.shape[1], k.shape[1], causal)
+    if m is not None:
+        s = jnp.where(m[None, None], s, -jnp.inf)
+    lse = jax.nn.logsumexp(s, axis=-1)                      # [B,H,Sq]
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), vr)
+    return out.astype(q.dtype), lse
+
+
+def flash_bwd_res(q, k, v, out, lse, do, *, causal: bool = False,
+                  sm_scale: Optional[float] = None,
+                  interpret: Optional[bool] = None):
+    """Gradient of flash attention from stashed (q, k, v, out, lse).
+    Linear in ``do`` (a zero cotangent yields zero grads — the pipeline
+    schedule relies on this to mask invalid ticks)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if _use_kernel(q, k, interpret):
+        from .flash_attention_kernel import _bwd_impl, _interpret
+
+        it = _interpret() if interpret is None else interpret
+        bq, bk = _blocks(q, k, causal)
+        qt, kt, vt, ot, dot = (jnp.swapaxes(x, 1, 2)
+                               for x in (q, k, v, out, do))
+        seed = jnp.zeros((1,), jnp.int32)
+        dq, dk, dv = _bwd_impl(qt, kt, vt, seed, ot, lse, dot, causal,
+                               float(sm_scale), 0.0, bq, bk, it)
+        return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+                jnp.swapaxes(dv, 1, 2))
+    kr, vr, g = _rep_kv(q, k, v)
+    s = _scores(q, kr, sm_scale)
+    m = _mask(q.shape[1], k.shape[1], causal)
+    if m is not None:
+        s = jnp.where(m[None, None], s, -jnp.inf)
+    p = jnp.exp(s - lse[..., None])                         # [B,H,Sq,Sk]
+    dof = do.astype(jnp.float32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vr.astype(jnp.float32))
+    delta = jnp.einsum("bqhd,bqhd->bhq", dof, out.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * sm_scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kr.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    if g > 1:
+        b, sk_, hq, d = dk.shape
+        dk = dk.reshape(b, sk_, hq // g, g, d).sum(axis=3)
+        dv = dv.reshape(b, sk_, hq // g, g, d).sum(axis=3)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
