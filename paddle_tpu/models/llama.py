@@ -70,7 +70,7 @@ _PRESETS = {
     "1b3":   (2048, 5504, 24, 16, 16, 32000),
     "7b":    (4096, 11008, 32, 32, 32, 32000),
     "13b":   (5120, 13824, 40, 40, 40, 32000),
-    "65b":   (8192, 22016, 80, 64, 8, 32000),
+    "65b":   (8192, 22016, 80, 64, 64, 32000),  # Llama-2-65B: MHA (kv=64)
 }
 
 
