@@ -151,3 +151,113 @@ class TestReviewRegressions:
         paddle.seed(123)
         w2 = np.asarray(snn.Conv3D(2, 3, 2).weight.value)
         np.testing.assert_array_equal(w1, w2)
+
+
+class TestSubmGatherGEMM:
+    """True sparse path (VERDICT r3 #4): gather-GEMM submanifold conv must
+    match the dense lowering on random sparse inputs AND never materialize
+    the dense volume (128^3 at ~0.5% density)."""
+
+    def _random_sparse(self, rng, shape_sp, cin, density, nd):
+        # unique random active coords, NONZERO channel vectors
+        n_total = int(np.prod(shape_sp))
+        nnz = max(4, int(n_total * density))
+        flat = rng.choice(n_total, size=nnz, replace=False)
+        coords = np.stack(np.unravel_index(flat, shape_sp), axis=1)
+        coords = np.concatenate(
+            [np.zeros((nnz, 1), np.int64), coords], axis=1)  # batch 0
+        vals = rng.randn(nnz, cin).astype(np.float32) + 0.1
+        dense = np.zeros((1,) + shape_sp + (cin,), np.float32)
+        dense[tuple(coords.T)] = vals
+        bcoo = jax.experimental.sparse.BCOO(
+            (jnp.asarray(vals), jnp.asarray(coords)),
+            shape=(1,) + shape_sp + (cin,))
+        return sparse.SparseTensor(bcoo), dense
+
+    def _dense_ref(self, dense, conv, nd):
+        out = jax.lax.conv_general_dilated(
+            jnp.asarray(dense), conv.weight.value, (1,) * nd, "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC") if nd == 2
+            else ("NDHWC", "DHWIO", "NDHWC"))
+        if conv.bias is not None:
+            out = out + conv.bias.value
+        active = (dense != 0).any(-1, keepdims=True)
+        return np.asarray(jnp.where(active, out, 0))
+
+    def test_parity_3d_random(self):
+        rng = np.random.RandomState(7)
+        x, dense = self._random_sparse(rng, (6, 7, 5), cin=3,
+                                       density=0.15, nd=3)
+        conv = snn.SubmConv3D(3, 4, kernel_size=3)
+        out = np.asarray(conv(x).to_dense().value)
+        ref = self._dense_ref(dense, conv, 3)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_parity_2d_even_kernel(self):
+        rng = np.random.RandomState(8)
+        x, dense = self._random_sparse(rng, (9, 8), cin=2,
+                                       density=0.2, nd=2)
+        conv = snn.SubmConv2D(2, 3, kernel_size=2, bias_attr=False)
+        out = np.asarray(conv(x).to_dense().value)
+        ref = self._dense_ref(dense, conv, 2)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_parity_2d_dilation(self):
+        rng = np.random.RandomState(9)
+        x, dense = self._random_sparse(rng, (10, 10), cin=2,
+                                       density=0.2, nd=2)
+        conv = snn.SubmConv2D(2, 2, kernel_size=3, bias_attr=False,
+                              dilation=2)
+        out = np.asarray(conv(x).to_dense().value)
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(dense), conv.weight.value, (1, 1), "SAME",
+            rhs_dilation=(2, 2),
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))
+        active = (dense != 0).any(-1, keepdims=True)
+        ref = np.asarray(jnp.where(jnp.asarray(active), ref, 0))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows_through_gather_gemm(self):
+        rng = np.random.RandomState(10)
+        x, _ = self._random_sparse(rng, (5, 5), cin=2, density=0.2, nd=2)
+        conv = snn.SubmConv2D(2, 2, kernel_size=3, bias_attr=False)
+
+        def loss(w):
+            out = snn.functional.subm_conv2d(x, w)
+            return jnp.sum(out._value.data ** 2)
+
+        g = jax.grad(loss)(conv.weight.value)
+        assert float(jnp.max(jnp.abs(g))) > 0
+
+    def test_128cubed_never_densifies(self):
+        """128^3 grid at ~0.5% density: compiled temp memory must be a
+        small multiple of the nnz working set — orders of magnitude under
+        the 128^3 dense volume the old lowering materialized."""
+        rng = np.random.RandomState(11)
+        grid, cin, cout = (128, 128, 128), 4, 4
+        nnz = int(np.prod(grid) * 0.005)          # ~10k sites
+        flat = rng.choice(np.prod(grid), size=nnz, replace=False)
+        coords = np.stack(np.unravel_index(flat, grid), axis=1)
+        coords = np.concatenate(
+            [np.zeros((nnz, 1), np.int64), coords], axis=1)
+        vals = rng.randn(nnz, cin).astype(np.float32)
+        bcoo = jax.experimental.sparse.BCOO(
+            (jnp.asarray(vals), jnp.asarray(coords)),
+            shape=(1,) + grid + (cin,))
+        w = jnp.asarray(rng.randn(3, 3, 3, cin, cout).astype(np.float32))
+
+        def f(data, w):
+            v = jax.experimental.sparse.BCOO(
+                (data, jnp.asarray(coords)), shape=(1,) + grid + (cin,))
+            return snn._subm_gather_gemm(v, w, None, 1, 3).values().value
+
+        c = jax.jit(f).lower(jnp.asarray(vals), w).compile()
+        tmp = c.memory_analysis().temp_size_in_bytes
+        dense_out = int(np.prod(grid)) * cout * 4        # 33.5 MB
+        dense_in = int(np.prod(grid)) * cin * 4          # 33.5 MB
+        # measured temp: 9.06 MB = the K·nnz·C gather working set
+        # (27 x 10485 x 4ch x 4B ~ 4.5MB, ~2x for einsum operands) —
+        # the old dense lowering materialized input + output + conv
+        # temps >= 67 MB, and the gap grows as grid^3 while this path
+        # stays nnz-bound
+        assert tmp < (dense_in + dense_out) // 4, (tmp, dense_in + dense_out)
