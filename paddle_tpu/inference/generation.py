@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..nn.functional_call import substituted_state
 
-__all__ = ["GenerationConfig", "CausalLMEngine"]
+__all__ = ["GenerationConfig", "CausalLMEngine", "ContinuousBatchingEngine"]
 
 
 class GenerationConfig:
@@ -160,3 +160,217 @@ class CausalLMEngine:
         else:
             gen = np.asarray(first)[:, None]
         return np.concatenate([ids, gen], axis=1)
+
+
+class ContinuousBatchingEngine:
+    """Ragged / continuous batching decode service.
+
+    The dense :class:`CausalLMEngine` serves one common-length batch per
+    ``generate()``. The reference's decode kernel instead removes padding
+    and serves MIXED-length batches with per-sequence lengths
+    (fused_multi_transformer_op.cu.h:1641 remove_padding, :1680 the
+    length-indexed masked MHA). This engine is the TPU-native equivalent:
+
+    - a fixed pool of ``max_batch`` cache SLOTS, each with its own
+      ``seq_len`` (the decode_mha kernel's per-row ``seq_lens`` vector —
+      its S-block grid skips blocks past each row's length, so a short
+      row costs O(its length), not O(max_len));
+    - requests are ADMITTED into free slots between jitted decode
+      segments (prefill is per-request B=1, its rows scattered into the
+      pool), and finished rows are retired between segments — new work
+      starts without waiting for the longest running request;
+    - one compiled segment program serves every slot occupancy pattern
+      (slot ids and lengths are traced values, not shapes).
+
+    Usage::
+
+        eng = ContinuousBatchingEngine(model, max_batch=4, max_len=512)
+        outs = eng.serve([ids1, ids2, ...], GenerationConfig(...))
+    """
+
+    def __init__(self, model, max_batch: int, max_len: int):
+        self.model = model
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.params = {k: p.value for k, p in model.named_parameters()}
+        self.caches = model.init_cache(max_batch, max_len)
+        self.lens = jnp.zeros((max_batch,), jnp.int32)
+        self.last = jnp.zeros((max_batch,), jnp.int32)
+        self.done_dev = jnp.zeros((max_batch,), bool)
+        self.active_dev = jnp.zeros((max_batch,), bool)
+        self._free = list(range(max_batch))
+        self._slot_req = {}            # slot -> request id
+        self._tokens = {}              # request id -> [generated ids]
+        self._budget = {}              # request id -> remaining tokens
+        self._finished = {}            # request id -> np.ndarray
+        self._next_req = 0
+        self._segments_run = 0         # PRNG stream position for sampling
+
+        def prefill_one(params, ids, mini):
+            logits, mini = self._fwd_prefill(params, ids, mini)
+            return logits[:, -1], mini
+
+        self._prefill = jax.jit(prefill_one, donate_argnums=(2,))
+
+        def admit(caches, mini, slot, lens, last, done, active, plen, tok,
+                  tok_done):
+            caches = jax.tree.map(
+                lambda c, m: jax.lax.dynamic_update_slice_in_dim(
+                    c, m.astype(c.dtype), slot, axis=0), caches, mini)
+            return (caches, lens.at[slot].set(plen),
+                    last.at[slot].set(tok), done.at[slot].set(tok_done),
+                    active.at[slot].set(True))
+
+        # mini is NOT donated: its rows are dtype-cast into the pool, so
+        # the buffers can't alias (donation would only warn)
+        self._admit = jax.jit(admit, donate_argnums=(0,))
+        self._segment_cache = {}
+
+    def _fwd_prefill(self, params, ids, caches):
+        from ..core.autograd import no_grad
+
+        with substituted_state(self.model, params), no_grad():
+            logits, caches = self.model.forward_with_cache(
+                Tensor(ids), caches, 0)
+        return (logits.value if isinstance(logits, Tensor) else logits,
+                caches)
+
+    def _fwd_ragged(self, params, tok, caches, lens, live):
+        from ..core.autograd import no_grad
+
+        with substituted_state(self.model, params), no_grad():
+            logits, caches = self.model.forward_decode_ragged(
+                Tensor(tok), caches, lens, live)
+        return (logits.value if isinstance(logits, Tensor) else logits,
+                caches)
+
+    # -- admission / retirement (host-side, between segments) ---------------
+    def add_request(self, prompt_ids, cfg: GenerationConfig) -> int:
+        """Prefill one request into a free slot; returns the request id.
+        Raises if no slot is free (call decode_segment / collect first)."""
+        if not self._free:
+            raise RuntimeError("no free slot; drain with decode_segment()")
+        ids = np.asarray(prompt_ids.value if isinstance(prompt_ids, Tensor)
+                         else prompt_ids).astype(np.int32).reshape(1, -1)
+        plen = ids.shape[1]
+        if plen + cfg.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt({plen}) + max_new_tokens({cfg.max_new_tokens}) "
+                f"exceeds engine max_len({self.max_len})")
+        slot = self._free.pop(0)
+        rid = self._next_req
+        self._next_req += 1
+        mini = self.model.init_cache(1, self.max_len)
+        last_logits, mini = self._prefill(self.params, ids, mini)
+        key = jax.random.PRNGKey(cfg.seed + rid)
+        first = _sample(last_logits, key, cfg)[0]
+        tok_done = (jnp.asarray(False) if cfg.eos_token_id is None
+                    else first == cfg.eos_token_id)
+        (self.caches, self.lens, self.last, self.done_dev,
+         self.active_dev) = self._admit(
+            self.caches, mini, jnp.int32(slot), self.lens, self.last,
+            self.done_dev, self.active_dev, jnp.int32(plen), first,
+            tok_done)
+        self._slot_req[slot] = rid
+        self._tokens[rid] = [int(first)]
+        self._budget[rid] = cfg.max_new_tokens - 1
+        if bool(tok_done) or self._budget[rid] <= 0:
+            self._retire(slot)
+        return rid
+
+    def _retire(self, slot):
+        rid = self._slot_req.pop(slot)
+        self._finished[rid] = np.asarray(self._tokens.pop(rid), np.int32)
+        del self._budget[rid]
+        self.active_dev = self.active_dev.at[slot].set(False)
+        self._free.append(slot)
+        self._free.sort()
+
+    def _segment_fn(self, n_steps: int, cfg: GenerationConfig):
+        key_cfg = (n_steps, cfg.do_sample, cfg.temperature, cfg.top_k,
+                   cfg.top_p, cfg.eos_token_id)
+        if key_cfg not in self._segment_cache:
+            max_len = self.max_len
+
+            def segment(params, last, lens, done, active, caches, key):
+                def step(carry, _):
+                    last, lens, done, caches, key = carry
+                    live = active & ~done & (lens < max_len)
+                    logits, caches = self._fwd_ragged(
+                        params, last[:, None], caches, lens, live)
+                    key, sub = jax.random.split(key)
+                    nxt = _sample(logits[:, 0], sub, cfg)
+                    nxt = jnp.where(live, nxt, last)
+                    lens = lens + live.astype(jnp.int32)
+                    if cfg.eos_token_id is not None:
+                        done = done | (live & (nxt == cfg.eos_token_id))
+                    done = done | (lens >= max_len)
+                    return (nxt, lens, done, caches, key), nxt
+
+                (last, lens, done, caches, _), toks = jax.lax.scan(
+                    step, (last, lens, done, caches, key), None,
+                    length=n_steps)
+                return (jnp.swapaxes(toks, 0, 1), last, lens, done,
+                        caches)
+
+            self._segment_cache[key_cfg] = jax.jit(
+                segment, donate_argnums=(5,))
+        return self._segment_cache[key_cfg]
+
+    def decode_segment(self, n_steps: int, cfg: GenerationConfig):
+        """Run ``n_steps`` ragged decode steps over the current slots;
+        collect per-request tokens and retire finished requests. Returns
+        the number of still-active requests."""
+        if not self._slot_req:
+            return 0
+        # every segment must draw fresh sampling noise even when no
+        # request was admitted in between — fold in a segment counter
+        self._segments_run += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed),
+                                 self._segments_run)
+        toks, self.last, self.lens, self.done_dev, self.caches = \
+            self._segment_fn(n_steps, cfg)(
+                self.params, self.last, self.lens, self.done_dev,
+                self.active_dev, self.caches, key)
+        toks = np.asarray(toks)
+        done = np.asarray(self.done_dev)
+        for slot, rid in list(self._slot_req.items()):
+            take = min(self._budget[rid], n_steps)
+            seq = toks[slot, :take].tolist()
+            if cfg.eos_token_id is not None and cfg.eos_token_id in seq:
+                seq = seq[:seq.index(cfg.eos_token_id) + 1]
+            self._tokens[rid].extend(int(t) for t in seq)
+            self._budget[rid] -= len(seq)
+            if (self._budget[rid] <= 0 or bool(done[slot])
+                    or len(seq) < take):
+                self._retire(slot)
+        return len(self._slot_req)
+
+    def collect_finished(self):
+        out, self._finished = self._finished, {}
+        return out
+
+    # -- convenience driver -------------------------------------------------
+    def serve(self, prompts, cfg: Optional[GenerationConfig] = None,
+              segment_steps: int = 8):
+        """Continuous-batching driver: admits requests as slots free up,
+        decoding in fixed segments. Returns generated ids (prompt NOT
+        included) in submission order."""
+        cfg = cfg or GenerationConfig()
+        pending = list(enumerate(prompts))
+        order = {}
+        results = {}
+        foreign = {}   # requests admitted outside this serve() call
+        while len(results) < len(prompts):
+            while pending and self._free:
+                idx, p = pending.pop(0)
+                order[self.add_request(p, cfg)] = idx
+            self.decode_segment(segment_steps, cfg)
+            for rid, seq in self.collect_finished().items():
+                if rid in order:
+                    results[order[rid]] = seq
+                else:
+                    foreign[rid] = seq
+        # foreign requests finished during our segments stay collectable
+        self._finished.update(foreign)
+        return [results[i] for i in range(len(prompts))]

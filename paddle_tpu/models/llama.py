@@ -96,17 +96,23 @@ def apply_rotary_emb(x, cos, sin):
     """x: [B, S, H, D]; rotate-half RoPE (reference analog:
     fused_rope_kernel.cu:87 fused_rotary_position_embedding).
 
-    On TPU this routes to the Pallas fused_rope kernel: the half-split of
-    the 128-lane head_dim is VMEM-local there, where the jnp slice+concat
-    forms cost two HBM relayouts (measured ~20x slower at llama shapes)."""
-    if jax.default_backend() == "tpu" and x.shape[-1] % 2 == 0:
+    ``cos``/``sin`` are either the shared position tables ``[S, d2]`` or
+    already broadcast to x's rank (the ragged-decode path passes per-ROW
+    angles ``[B, 1, 1, d2]``).
+
+    On TPU the shared-table form routes to the Pallas fused_rope kernel:
+    the half-split of the 128-lane head_dim is VMEM-local there, where the
+    jnp slice+concat forms cost two HBM relayouts (measured ~20x slower at
+    llama shapes). The per-row form stays in jnp (one token per row)."""
+    shared = cos.ndim == 2
+    if shared and jax.default_backend() == "tpu" and x.shape[-1] % 2 == 0:
         from ..ops.pallas_kernels import fused_rope
 
         return fused_rope(x, cos, sin)
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    c = cos[None, :, None, :] if shared else cos
+    s = sin[None, :, None, :] if shared else sin
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
@@ -184,6 +190,56 @@ class LlamaAttention(Layer):
         val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
         return self.o_proj(ctx), (val(kc), val(vc))
 
+    def forward_decode_ragged(self, x, cos_full, sin_full, cache, lens,
+                              live):
+        """Ragged decode step: mixed-length rows, padding-free semantics.
+
+        x: [B, 1, h]; lens: [B] int32 tokens already in each ROW's cache
+        (per-row positions — rows need not agree); live: [B] bool — only
+        live rows write their k/v and advance. Reference: the reference
+        decode kernel serves mixed-length batches after remove_padding
+        (fused_multi_transformer_op.cu.h:1641) with per-sequence lengths
+        (:1680); here the per-row state IS the seq_lens vector the
+        decode_mha kernel already takes (its S-block grid skips blocks
+        past each row's length, so compute is O(lens[b]), not O(max_len)).
+        """
+        b = x.shape[0]
+        hd = self.config.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        kc0, vc0 = cache
+
+        def attend(qv, kv, vv, kc, vc):
+            max_len = kc.shape[1]
+            idx = jnp.minimum(lens, max_len - 1)
+            c = cos_full[idx][:, None, None, :]    # [B, 1, 1, d2] per row
+            s = sin_full[idx][:, None, None, :]
+            qh = apply_rotary_emb(
+                qv.reshape(b, 1, self.num_heads, hd), c, s)[:, 0]
+            kh = apply_rotary_emb(
+                kv.reshape(b, 1, self.kv_heads, hd), c, s)[:, 0]
+            vh = vv.reshape(b, self.kv_heads, hd)
+            ar = jnp.arange(b)
+            # dead rows re-write their existing cell (no-op write): the
+            # scatter stays unconditional = one compiled program
+            kw = jnp.where(live[:, None, None], kh.astype(kc.dtype),
+                           kc[ar, idx])
+            vw = jnp.where(live[:, None, None], vh.astype(vc.dtype),
+                           vc[ar, idx])
+            kc = kc.at[ar, idx].set(kw)
+            vc = vc.at[ar, idx].set(vw)
+            from ..ops._decode import gqa_decode_attention
+
+            ctx = gqa_decode_attention(
+                qh, kc, vc, lens + live.astype(jnp.int32))
+            return ctx.reshape(b, 1, self.num_heads * hd), kc, vc
+
+        ctx, kc, vc = apply_op(attend, q, k, v, kc0, vc0,
+                               op_name="ragged_attention")
+        val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
+        return self.o_proj(ctx), (val(kc), val(vc))
+
     def forward(self, x, cos, sin, attn_mask=None):
         b = x.shape[0]
         s = x.shape[1]
@@ -253,6 +309,14 @@ class LlamaDecoderLayer(Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
 
+    def forward_decode_ragged(self, x, cos_full, sin_full, cache, lens,
+                              live):
+        attn, cache = self.self_attn.forward_decode_ragged(
+            self.input_layernorm(x), cos_full, sin_full, cache, lens, live)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
 
 class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
@@ -307,6 +371,20 @@ class LlamaModel(Layer):
             new_caches.append(cache)
         return self.norm(x), new_caches
 
+    def forward_decode_ragged(self, input_ids, caches, lens, live):
+        cfg = self.config
+        x = self.embed_tokens(input_ids)
+        max_len = caches[0][0].shape[1]
+        cos_full, sin_full = _rope_cos_sin(
+            max_len, cfg.head_dim, cfg.rope_theta,
+            x.value.dtype if isinstance(x, Tensor) else x.dtype)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            x, cache = layer.forward_decode_ragged(
+                x, cos_full, sin_full, cache, lens, live)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
+
 
 class LlamaForCausalLM(Layer):
     IGNORE_INDEX = -100
@@ -353,4 +431,11 @@ class LlamaForCausalLM(Layer):
     def forward_with_cache(self, input_ids, caches, pos):
         """(logits_of_last_positions, new_caches) — the serving forward."""
         hidden, caches = self.model.forward_with_cache(input_ids, caches, pos)
+        return self.logits(hidden), caches
+
+    def forward_decode_ragged(self, input_ids, caches, lens, live):
+        """(logits [B, 1, V], new_caches) — the mixed-length decode step
+        (per-row positions; see LlamaAttention.forward_decode_ragged)."""
+        hidden, caches = self.model.forward_decode_ragged(
+            input_ids, caches, lens, live)
         return self.logits(hidden), caches

@@ -11,7 +11,7 @@ checkpoints, and loss continuity across the kill.
 """
 import os
 import socket
-import subprocess  # noqa: F401  (used by launch internals)
+
 import sys
 import textwrap
 
