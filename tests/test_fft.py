@@ -1,0 +1,224 @@
+"""paddle.fft behavior-depth parity vs numpy.fft (VERDICT r3 #7).
+
+Reference: python/paddle/fft.py — full fft/fft2/fftn/rfft/hfft families
+with norm modes (backward/ortho/forward), n/s truncation+padding, and
+axes edge cases. Every case here checks VALUES against numpy.fft (the
+reference's own ground truth) at fp32-appropriate tolerance (x64 is
+disabled on TPU; inputs are fp32/complex64).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.fft as pfft
+
+NORMS = ("backward", "ortho", "forward")
+RTOL, ATOL = 2e-4, 2e-4
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+def _np(x):
+    return np.asarray(x.value if hasattr(x, "value") else x)
+
+
+def _close(got, want, msg=""):
+    np.testing.assert_allclose(_np(got), want.astype(_np(got).dtype),
+                               rtol=RTOL, atol=ATOL, err_msg=msg)
+
+
+class TestFFT1DNorms:
+    """Every 1-D transform x norm x n (pad/truncate/default) x axis."""
+
+    @pytest.mark.parametrize("norm", NORMS)
+    @pytest.mark.parametrize("n", [None, 6, 16])
+    def test_fft_ifft(self, norm, n):
+        rng = np.random.RandomState(0)
+        a = (rng.randn(3, 10) + 1j * rng.randn(3, 10)).astype(np.complex64)
+        _close(pfft.fft(_t(a), n=n, norm=norm),
+               np.fft.fft(a, n=n, norm=norm), f"fft n={n} {norm}")
+        _close(pfft.ifft(_t(a), n=n, norm=norm),
+               np.fft.ifft(a, n=n, norm=norm), f"ifft n={n} {norm}")
+
+    @pytest.mark.parametrize("norm", NORMS)
+    @pytest.mark.parametrize("axis", [0, 1, -1])
+    def test_fft_axis(self, norm, axis):
+        rng = np.random.RandomState(1)
+        a = (rng.randn(4, 6) + 1j * rng.randn(4, 6)).astype(np.complex64)
+        _close(pfft.fft(_t(a), axis=axis, norm=norm),
+               np.fft.fft(a, axis=axis, norm=norm))
+
+    @pytest.mark.parametrize("norm", NORMS)
+    @pytest.mark.parametrize("n", [None, 6, 16])
+    def test_rfft_irfft(self, norm, n):
+        rng = np.random.RandomState(2)
+        a = rng.randn(3, 10).astype(np.float32)
+        _close(pfft.rfft(_t(a), n=n, norm=norm),
+               np.fft.rfft(a, n=n, norm=norm).astype(np.complex64))
+        spec = np.fft.rfft(a).astype(np.complex64)
+        _close(pfft.irfft(_t(spec), n=n, norm=norm),
+               np.fft.irfft(spec, n=n, norm=norm))
+
+    @pytest.mark.parametrize("norm", NORMS)
+    @pytest.mark.parametrize("n", [None, 8, 18])
+    def test_hfft_ihfft(self, norm, n):
+        rng = np.random.RandomState(3)
+        a = (rng.randn(2, 10) + 1j * rng.randn(2, 10)).astype(np.complex64)
+        _close(pfft.hfft(_t(a), n=n, norm=norm),
+               np.fft.hfft(a, n=n, norm=norm))
+        r = rng.randn(2, 10).astype(np.float32)
+        _close(pfft.ihfft(_t(r), n=n, norm=norm),
+               np.fft.ihfft(r, n=n, norm=norm).astype(np.complex64))
+
+
+class TestFFT2DAndND:
+    @pytest.mark.parametrize("norm", NORMS)
+    @pytest.mark.parametrize("axes", [(-2, -1), (0, 1), (1, 0), (-1, -2)])
+    def test_fft2_axes(self, norm, axes):
+        rng = np.random.RandomState(4)
+        a = (rng.randn(5, 6) + 1j * rng.randn(5, 6)).astype(np.complex64)
+        _close(pfft.fft2(_t(a), axes=axes, norm=norm),
+               np.fft.fft2(a, axes=axes, norm=norm), f"{axes} {norm}")
+        _close(pfft.ifft2(_t(a), axes=axes, norm=norm),
+               np.fft.ifft2(a, axes=axes, norm=norm))
+
+    @pytest.mark.parametrize("norm", NORMS)
+    @pytest.mark.parametrize("s", [None, (4, 8), (8, 4)])
+    def test_fft2_s(self, norm, s):
+        rng = np.random.RandomState(5)
+        a = (rng.randn(6, 6) + 1j * rng.randn(6, 6)).astype(np.complex64)
+        _close(pfft.fft2(_t(a), s=s, norm=norm),
+               np.fft.fft2(a, s=s, norm=norm))
+
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_rfft2_irfft2(self, norm):
+        rng = np.random.RandomState(6)
+        a = rng.randn(4, 6).astype(np.float32)
+        _close(pfft.rfft2(_t(a), norm=norm),
+               np.fft.rfft2(a, norm=norm).astype(np.complex64))
+        spec = np.fft.rfft2(a).astype(np.complex64)
+        _close(pfft.irfft2(_t(spec), s=a.shape, norm=norm),
+               np.fft.irfft2(spec, s=a.shape, norm=norm))
+
+    @pytest.mark.parametrize("norm", NORMS)
+    @pytest.mark.parametrize("axes", [None, (0,), (0, 2), (2, 1)])
+    def test_fftn_axes_subsets(self, norm, axes):
+        rng = np.random.RandomState(7)
+        a = (rng.randn(3, 4, 5) + 1j * rng.randn(3, 4, 5)).astype(
+            np.complex64)
+        _close(pfft.fftn(_t(a), axes=axes, norm=norm),
+               np.fft.fftn(a, axes=axes, norm=norm), f"{axes} {norm}")
+        _close(pfft.ifftn(_t(a), axes=axes, norm=norm),
+               np.fft.ifftn(a, axes=axes, norm=norm))
+
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_rfftn_irfftn(self, norm):
+        rng = np.random.RandomState(8)
+        a = rng.randn(3, 4, 6).astype(np.float32)
+        _close(pfft.rfftn(_t(a), norm=norm),
+               np.fft.rfftn(a, norm=norm).astype(np.complex64))
+        spec = np.fft.rfftn(a).astype(np.complex64)
+        _close(pfft.irfftn(_t(spec), s=a.shape, norm=norm),
+               np.fft.irfftn(spec, s=a.shape, norm=norm))
+
+
+class TestHermitian2DND:
+    """hfft2/ihfft2/hfftn/ihfftn — numpy has no nd-hermitian transforms;
+    ground truth is the reference's own composition (c2c on the leading
+    axes, c2r/r2c hermitian on the LAST axis — python/paddle/fft.py
+    fftn_c2r/fftn_r2c order) built from numpy 1-D primitives."""
+
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_hfft2_matches_composition(self, norm):
+        rng = np.random.RandomState(9)
+        a = (rng.randn(4, 6) + 1j * rng.randn(4, 6)).astype(np.complex64)
+        want = np.fft.hfft(np.fft.fft(a, axis=0, norm=norm), axis=1,
+                           norm=norm)
+        _close(pfft.hfft2(_t(a)) if norm == "backward"
+               else pfft.hfft2(_t(a), norm=norm), want)
+
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_ihfft2_roundtrips_hfft2(self, norm):
+        # hfft2(ihfft2(y)) == y for real y (the numpy 1-D contract,
+        # lifted through the composition)
+        rng = np.random.RandomState(10)
+        y = rng.randn(4, 10).astype(np.float32)
+        spec = pfft.ihfft2(_t(y), norm=norm)
+        back = pfft.hfft2(spec, s=(4, 10), norm=norm)
+        _close(back, y)
+
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_hfftn_ihfftn_roundtrip_3d(self, norm):
+        rng = np.random.RandomState(11)
+        y = rng.randn(3, 4, 8).astype(np.float32)
+        spec = pfft.ihfftn(_t(y), norm=norm)
+        back = pfft.hfftn(spec, s=(3, 4, 8), norm=norm)
+        _close(back, y)
+
+    def test_hfftn_subset_axes(self):
+        rng = np.random.RandomState(12)
+        a = (rng.randn(3, 4, 5) + 1j * rng.randn(3, 4, 5)).astype(
+            np.complex64)
+        got = pfft.hfftn(_t(a), axes=(1, 2))
+        want = np.fft.hfft(np.fft.fft(a, axis=1), axis=2)
+        _close(got, want)
+
+
+class TestHelpers:
+    def test_fftfreq_rfftfreq(self):
+        for n, d in ((8, 1.0), (7, 0.25)):
+            np.testing.assert_allclose(_np(pfft.fftfreq(n, d)),
+                                       np.fft.fftfreq(n, d), rtol=1e-6)
+            np.testing.assert_allclose(_np(pfft.rfftfreq(n, d)),
+                                       np.fft.rfftfreq(n, d), rtol=1e-6)
+
+    @pytest.mark.parametrize("axes", [None, (0,), (0, 1)])
+    def test_fftshift_roundtrip(self, axes):
+        rng = np.random.RandomState(13)
+        a = rng.randn(5, 6).astype(np.float32)
+        sh = pfft.fftshift(_t(a), axes=axes)
+        np.testing.assert_allclose(_np(sh), np.fft.fftshift(a, axes=axes))
+        back = pfft.ifftshift(sh, axes=axes)
+        np.testing.assert_allclose(_np(back), a)
+
+
+class TestGrad:
+    def _numeric_grad(self, f, x, eps=1e-3):
+        g = np.zeros_like(x)
+        for i in range(x.size):
+            xp, xm = x.copy(), x.copy()
+            xp.flat[i] += eps
+            xm.flat[i] -= eps
+            g.flat[i] = (f(xp) - f(xm)) / (2 * eps)
+        return g
+
+    def test_rfft_power_spectrum_grad(self):
+        """AD through the r2c transform must match the numerical grad of
+        sum(|rfft(x)|^2) (rfft is half-spectrum, so no closed form)."""
+        rng = np.random.RandomState(14)
+        x = rng.randn(8).astype(np.float32)
+
+        def loss(v):
+            s = jnp.fft.rfft(v)
+            return jnp.sum(jnp.abs(s) ** 2)
+
+        g = jax.grad(loss)(jnp.asarray(x))
+        num = self._numeric_grad(lambda v: float(loss(jnp.asarray(v))), x)
+        np.testing.assert_allclose(np.asarray(g), num, rtol=2e-2,
+                                   atol=2e-2)
+
+    def test_autograd_through_tensor_api(self):
+        xv = np.random.RandomState(15).randn(8).astype(np.float32)
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        y = pfft.irfft(pfft.rfft(x))     # c2r(r2c(x)) == x, AD through both
+        out = (y * y).sum()
+        out.backward()
+        assert x.grad is not None
+        np.testing.assert_allclose(np.asarray(x.grad.value), 2 * xv,
+                                   rtol=1e-3, atol=1e-3)
