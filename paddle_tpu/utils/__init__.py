@@ -14,10 +14,10 @@ import re
 import warnings
 from typing import Optional
 
-from . import dlpack, download, unique_name  # noqa: F401
+from . import cpp_extension, dlpack, download, unique_name  # noqa: F401
 
 __all__ = ["deprecated", "run_check", "require_version", "try_import",
-           "unique_name", "dlpack", "download"]
+           "unique_name", "dlpack", "download", "cpp_extension"]
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = "",

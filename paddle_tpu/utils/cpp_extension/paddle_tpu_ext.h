@@ -1,0 +1,48 @@
+/* paddle_tpu custom-op C ABI (the phi/capi role, TPU-native form).
+ *
+ * Reference analog: paddle/phi/capi exposes a C kernel ABI so user ops
+ * compile against a stable surface (PD_BUILD_OP, paddle/phi/api/ext/
+ * op_meta_info.h:874). On TPU, device kernels are Pallas (Python-side);
+ * the C ABI covers HOST ops: custom CPU math, data prep, tokenizers —
+ * anything that runs as a host callback inside or outside jit.
+ *
+ * Contract: export  `int <name>(int n_in, const PTExtBuffer* in,
+ *                               int n_out, PTExtBuffer* out)`
+ * with C linkage. Inputs are read-only; outputs are pre-allocated by the
+ * framework according to the op's registered output shapes. Return 0 on
+ * success, nonzero to raise RuntimeError in Python.
+ */
+#ifndef PADDLE_TPU_EXT_H_
+#define PADDLE_TPU_EXT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  PT_FLOAT32 = 0,
+  PT_FLOAT64 = 1,
+  PT_INT32 = 2,
+  PT_INT64 = 3,
+  PT_BOOL = 4,
+  PT_UINT8 = 5,
+} PTExtDtype;
+
+typedef struct {
+  void* data;            /* contiguous, C order */
+  const int64_t* shape;  /* ndim entries */
+  int32_t ndim;
+  int32_t dtype;         /* PTExtDtype */
+  int64_t numel;
+} PTExtBuffer;
+
+#define PT_KERNEL(name)                                                    \
+  int name(int n_in, const PTExtBuffer* in, int n_out, PTExtBuffer* out)
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_EXT_H_ */
