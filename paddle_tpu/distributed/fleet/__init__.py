@@ -22,13 +22,28 @@ __all__ = ["init", "DistributedStrategy", "distributed_model",
            "worker_num", "worker_index", "is_first_worker", "barrier_worker",
            "meta_parallel", "mpu", "utils"]
 
-_fleet_state = {"initialized": False, "hcg": None, "strategy": None}
+_fleet_state = {"initialized": False, "hcg": None, "strategy": None,
+                "role_maker": None, "ps_client": None, "ps_server": None}
 
 
 def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStrategy] = None):
     """fleet/fleet.py:167 parity. Builds the hybrid mesh from strategy
-    degrees (defaults: whole world on dp)."""
+    degrees (defaults: whole world on dp). A parameter-server role maker
+    (``PaddleCloudRoleMaker(is_collective=False)``) switches fleet into
+    PS mode instead: servers then call ``init_server()``/``run_server()``
+    and trainers ``init_worker()`` (reference the_one_ps.py flow)."""
     import jax
+
+    if role_maker is not None and not getattr(
+            role_maker, "_is_collective", True):
+        _fleet_state.update(initialized=True, role_maker=role_maker,
+                            ps_client=None, ps_server=None, hcg=None,
+                            strategy=strategy)
+        return
+    # a collective init must fully leave PS mode (test suites reuse the
+    # process): stale role makers would flip is_server()/is_worker()
+    _fleet_state.update(role_maker=role_maker, ps_client=None,
+                        ps_server=None)
 
     strategy = strategy or DistributedStrategy()
     h = strategy.hybrid_configs
@@ -214,16 +229,42 @@ class Role:
 
 class PaddleCloudRoleMaker:
     """Env-driven role maker (reference role_maker.PaddleCloudRoleMaker).
-    Collective mode only — PS server roles are descoped (DESIGN.md)."""
+
+    ``is_collective=False`` reads the parameter-server env contract
+    (reference role_maker.py _ps_env): TRAINING_ROLE (PSERVER|TRAINER),
+    PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINERS_NUM,
+    PADDLE_TRAINER_ID, and for servers POD_IP:PADDLE_PORT to locate this
+    node in the server list."""
 
     def __init__(self, is_collective: bool = True, **kwargs):
-        if not is_collective:
-            raise NotImplementedError(
-                "parameter-server roles are descoped in this TPU-native "
-                "build (DESIGN.md); use is_collective=True")
-        self._is_collective = True
+        self._is_collective = bool(is_collective)
+        if self._is_collective:
+            return
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._ps_role = (Role.SERVER if role == "PSERVER" else Role.WORKER)
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in eps.split(",") if e]
+        if not self._server_endpoints:
+            raise ValueError(
+                "PS mode needs PADDLE_PSERVERS_IP_PORT_LIST "
+                "(reference role_maker._ps_env contract)")
+        self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        if self._ps_role == Role.SERVER:
+            me = (f"{os.environ.get('POD_IP', '127.0.0.1')}:"
+                  f"{os.environ['PADDLE_PORT']}")
+            if me not in self._server_endpoints:
+                raise ValueError(
+                    f"this server's endpoint {me!r} (POD_IP:PADDLE_PORT; "
+                    f"POD_IP defaults to 127.0.0.1) is not in "
+                    f"PADDLE_PSERVERS_IP_PORT_LIST "
+                    f"{self._server_endpoints} — the strings must match "
+                    "exactly (hostname vs IP mismatches included)")
+            self._server_index = self._server_endpoints.index(me)
 
     def _worker_num(self):
+        if not self._is_collective:
+            return self._trainers_num
         import jax
 
         return jax.process_count()
@@ -231,6 +272,8 @@ class PaddleCloudRoleMaker:
     worker_num = _worker_num
 
     def _worker_index(self):
+        if not self._is_collective:
+            return self._trainer_id
         import jax
 
         return jax.process_index()
@@ -238,15 +281,15 @@ class PaddleCloudRoleMaker:
     worker_index = _worker_index
 
     def _role(self):
-        return Role.WORKER
+        return getattr(self, "_ps_role", Role.WORKER)
 
     def _is_worker(self):
-        return True
+        return self._role() == Role.WORKER
 
     is_worker = _is_worker
 
     def _is_server(self):
-        return False
+        return self._role() == Role.SERVER
 
     is_server = _is_server
 
@@ -257,14 +300,23 @@ class PaddleCloudRoleMaker:
 
 
 class UserDefinedRoleMaker(PaddleCloudRoleMaker):
-    """reference role_maker.UserDefinedRoleMaker — explicit rank/world."""
+    """reference role_maker.UserDefinedRoleMaker — explicit rank/world/
+    role, NO env parsing (unlike the parent's PS env contract)."""
 
     def __init__(self, is_collective: bool = True, init_gloo: bool = False,
                  current_id: int = 0, worker_num: int = 1, role=None,
-                 **kwargs):
-        super().__init__(is_collective=is_collective)
+                 server_endpoints=None, **kwargs):
+        # deliberately NOT super().__init__: explicit args replace the env
+        self._is_collective = bool(is_collective)
         self._id = int(current_id)
         self._num = int(worker_num)
+        if not self._is_collective:
+            self._ps_role = role if role is not None else Role.WORKER
+            self._server_endpoints = list(server_endpoints or [])
+            self._trainers_num = self._num
+            self._trainer_id = self._id
+            if self._ps_role == Role.SERVER:
+                self._server_index = self._id
 
     def _worker_index(self):
         return self._id
@@ -370,15 +422,138 @@ class Fleet:
 
         dist.barrier()
 
+    def is_server(self):
+        return is_server()
+
+    def is_worker(self):
+        return is_worker()
+
+    def init_server(self, *a, **kw):
+        return init_server(*a, **kw)
+
+    def run_server(self):
+        return run_server()
+
+    def init_worker(self, *a, **kw):
+        return init_worker(*a, **kw)
+
+    def stop_worker(self):
+        return stop_worker()
+
+
+# -- parameter-server role flow (reference fleet.init_server/run_server/
+#    init_worker over distributed/ps/the_one_ps.py; our PS lives in
+#    distributed/ps/__init__.py) ---------------------------------------------
+
+
+def _ps_role_maker():
+    rm = _fleet_state.get("role_maker")
+    if rm is None or getattr(rm, "_is_collective", True):
+        raise RuntimeError(
+            "fleet is not in parameter-server mode; call fleet.init("
+            "PaddleCloudRoleMaker(is_collective=False)) under the PS env "
+            "contract first")
+    return rm
+
+
+def is_server() -> bool:
+    rm = _fleet_state.get("role_maker")
+    return bool(rm is not None and not getattr(rm, "_is_collective", True)
+                and rm.is_server())
+
+
+def is_worker() -> bool:
+    rm = _fleet_state.get("role_maker")
+    if rm is None or getattr(rm, "_is_collective", True):
+        return True
+    return rm.is_worker()
+
+
+def init_server(*args, **kwargs):
+    """Build this node's PsServer shard (reference fleet.init_server).
+    An optional ``dirname`` restores tables previously written by
+    ``PsClient.save`` (the reference's load-model-on-init contract).
+    Binds the port from the env contract; run_server() serves."""
+    from ..ps import PsServer
+
+    rm = _ps_role_maker()
+    ep = rm._server_endpoints[rm._server_index]
+    host, port = ep.rsplit(":", 1)
+    srv = PsServer(rm._server_index, len(rm._server_endpoints),
+                   port=int(port), host=host)
+    dirname = args[0] if args else (kwargs.get("dirname")
+                                    or kwargs.get("model_dir"))
+    if dirname:
+        srv.load_model(dirname)
+    _fleet_state["ps_server"] = srv
+    return srv
+
+
+def run_server():
+    """Serve until a trainer sends stop (reference fleet.run_server)."""
+    srv = _fleet_state.get("ps_server") or init_server()
+    srv.run()
+
+
+def init_worker(*args, **kwargs):
+    """Connect this trainer to the server group (reference
+    fleet.init_worker); the PsClient is then available via
+    fleet.get_ps_client() and used by DistributedEmbedding."""
+    from ..ps import PsClient
+
+    rm = _ps_role_maker()
+    client = PsClient(rm._server_endpoints)
+    _fleet_state["ps_client"] = client
+    return client
+
+
+def get_ps_client():
+    client = _fleet_state.get("ps_client")
+    if client is None:
+        raise RuntimeError("call fleet.init_worker() first")
+    return client
+
+
+def stop_worker():
+    """Disconnect; the LAST trainer also stops the servers (reference
+    fleet.stop_worker barrier-then-shutdown)."""
+    rm = _ps_role_maker()
+    client = _fleet_state.get("ps_client")
+    if client is None:
+        return
+    pos = client.barrier("stop_worker", world=rm._trainers_num)
+    if pos == rm._trainers_num:      # LAST arrival shuts the servers down
+        client.stop_servers()
+    client.close()
+    _fleet_state["ps_client"] = None
+
 
 class MultiSlotDataGenerator:
-    """reference distributed/fleet/data_generator — PS-pipeline data
-    format; descoped with the PS stack (DESIGN.md)."""
+    """reference distributed/fleet/data_generator — the PS pipeline's
+    line-oriented sample format: ``generate_sample`` yields
+    (slot_name, [ids...]) pairs per input line; ``run_from_stdin`` emits
+    the wire form ``slot:len id...``."""
 
-    def __init__(self, *a, **kw):
+    def generate_sample(self, line):
         raise NotImplementedError(
-            "MultiSlotDataGenerator belongs to the descoped parameter-"
-            "server pipeline (DESIGN.md 'Descoped subsystems')")
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample(line) -> iterable of (slot, values)")
+
+    def _format(self, sample) -> str:
+        parts = []
+        for slot, values in sample:
+            vals = list(values)
+            parts.append(f"{slot}:{len(vals)} "
+                         + " ".join(str(v) for v in vals))
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        import sys as _sys
+
+        for line in _sys.stdin:
+            gen = self.generate_sample(line)
+            for sample in (gen() if callable(gen) else gen):
+                _sys.stdout.write(self._format(sample) + "\n")
 
 
 class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
@@ -387,4 +562,6 @@ class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
 
 __all__ += ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
             "UtilBase", "Fleet", "CommunicateTopology",
-            "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
+            "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
+            "is_server", "is_worker", "init_server", "run_server",
+            "init_worker", "stop_worker", "get_ps_client"]

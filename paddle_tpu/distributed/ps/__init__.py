@@ -1,0 +1,451 @@
+"""Parameter-server training (reference: paddle/fluid/distributed/ps/ —
+brpc PsService, table/ (dense + sparse accessor tables, server-side
+optimizers), and the fleet PS role flow: fleet.init(role) ->
+init_server()/run_server() on PSERVER nodes, init_worker() + pull/push
+on TRAINER nodes).
+
+TPU-native redesign, not a port: on a TPU pod the DENSE model is
+synchronous SPMD (sharded on the mesh — see DESIGN.md), so the PS role
+that survives is the one brpc exists for: EMBEDDING TABLES TOO BIG FOR
+HBM, held on host servers, with trainers pulling the rows a batch needs
+and pushing sparse gradients back. That is exactly what this module
+provides:
+
+- :class:`PsServer` — a host service holding table SHARDS (row id %
+  num_servers), applying server-side optimizers (sgd/adagrad/adam) under
+  a per-table lock on each push (async by default; ``barrier`` gives
+  sync-mode edges). Transport is length-prefixed pickles over TCP
+  sockets on a trusted cluster network — the data plane the reference
+  implements in brpc C++; the accept loop and table math are numpy.
+- :class:`PsClient` — trainer-side handle: ``pull_sparse(table, ids)``,
+  ``push_sparse(table, ids, grads)``, dense pull/push, barrier, save.
+- :class:`DistributedEmbedding` — the `paddle.static.nn.sparse_embedding`
+  analog: forward pulls rows onto the device, backward pushes the sparse
+  grad rows from the autograd hook.
+
+Row sharding across servers means each server owns 1/S of every table;
+lookups fan out only to the servers owning the requested rows.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PsServer", "PsClient", "DistributedEmbedding", "TableConfig"]
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: [u32 length][pickle (cmd, payload)] -> same shape response
+# ---------------------------------------------------------------------------
+
+
+def _send(sock: socket.socket, obj) -> None:
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(blob)) + blob)
+
+
+def _recv(sock: socket.socket):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class TableConfig:
+    """One table's schema + server-side optimizer (reference
+    ps/table/ctr_accessor + sparse_sgd_rule: the optimizer runs ON the
+    server at push time)."""
+
+    def __init__(self, name: str, dim: int, optimizer: str = "sgd",
+                 lr: float = 0.01, initializer: str = "uniform",
+                 init_range: float = 0.1, seed: int = 0,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8):
+        self.name = name
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.initializer = initializer
+        self.init_range = float(init_range)
+        self.seed = int(seed)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+
+class _SparseShard:
+    """This server's rows of one sparse table: id -> (row, opt slots),
+    created on first touch (the reference's on-demand CTR table rows)."""
+
+    def __init__(self, cfg: TableConfig, server_idx: int):
+        self.cfg = cfg
+        self.rows: Dict[int, np.ndarray] = {}
+        self.slots: Dict[int, tuple] = {}
+        self.step = 0
+        self._seed = (cfg.seed * 1000003 + server_idx) & 0x7FFFFFFF
+        self.lock = threading.Lock()
+
+    def _init_row(self, rid: int) -> np.ndarray:
+        rng = np.random.RandomState((self._seed + rid) & 0x7FFFFFFF)
+        if self.cfg.initializer == "zeros":
+            return np.zeros((self.cfg.dim,), np.float32)
+        r = self.cfg.init_range
+        return rng.uniform(-r, r, (self.cfg.dim,)).astype(np.float32)
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        with self.lock:
+            out = np.empty((len(ids), self.cfg.dim), np.float32)
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                if rid not in self.rows:
+                    self.rows[rid] = self._init_row(rid)
+                out[i] = self.rows[rid]
+            return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        cfg = self.cfg
+        with self.lock:
+            self.step += 1
+            for rid, g in zip(ids, grads):
+                rid = int(rid)
+                w = self.rows.get(rid)
+                if w is None:
+                    w = self.rows[rid] = self._init_row(rid)
+                if cfg.optimizer == "sgd":
+                    w -= cfg.lr * g
+                elif cfg.optimizer == "adagrad":
+                    acc = self.slots.get(rid)
+                    acc = acc[0] if acc else np.zeros_like(w)
+                    acc += g * g
+                    self.slots[rid] = (acc,)
+                    w -= cfg.lr * g / (np.sqrt(acc) + cfg.epsilon)
+                elif cfg.optimizer == "adam":
+                    m, v, t = self.slots.get(
+                        rid, (np.zeros_like(w), np.zeros_like(w), 0))
+                    t += 1
+                    m = cfg.beta1 * m + (1 - cfg.beta1) * g
+                    v = cfg.beta2 * v + (1 - cfg.beta2) * g * g
+                    mh = m / (1 - cfg.beta1 ** t)
+                    vh = v / (1 - cfg.beta2 ** t)
+                    w -= cfg.lr * mh / (np.sqrt(vh) + cfg.epsilon)
+                    self.slots[rid] = (m, v, t)
+                else:
+                    raise ValueError(
+                        f"unknown server optimizer {cfg.optimizer!r}")
+
+
+class PsServer:
+    """One parameter-server node. ``start()`` returns immediately (the
+    accept loop runs on threads — reference PsService handlers);
+    ``run()`` blocks until a client sends STOP (reference
+    fleet.run_server)."""
+
+    def __init__(self, server_idx: int, num_servers: int, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.server_idx = int(server_idx)
+        self.num_servers = int(num_servers)
+        self._tables: Dict[str, _SparseShard] = {}
+        self._dense: Dict[str, np.ndarray] = {}
+        self._dense_lock = threading.Lock()
+        self._barrier_count: Dict[str, int] = {}
+        self._barrier_lock = threading.Lock()
+        self._barrier_cv = threading.Condition(self._barrier_lock)
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+
+    # -- service ------------------------------------------------------------
+    def start(self):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        return self
+
+    def run(self):
+        """Block until stopped (reference fleet.run_server)."""
+        self._accept_loop_started = True
+        self.start()
+        self._stop.wait()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                cmd, payload = _recv(conn)
+                try:
+                    resp = ("ok", self._dispatch(cmd, payload))
+                except Exception as e:  # noqa: BLE001 - ship to client
+                    resp = ("err", f"{type(e).__name__}: {e}")
+                _send(conn, resp)
+                if cmd == "stop":
+                    self.stop()
+                    return
+        except ConnectionError:
+            pass
+        finally:
+            conn.close()
+
+    def _dispatch(self, cmd: str, p):
+        if cmd == "create_table":
+            cfg = p
+            if cfg.name not in self._tables:
+                self._tables[cfg.name] = _SparseShard(cfg, self.server_idx)
+            return True
+        if cmd == "pull_sparse":
+            return self._tables[p["table"]].pull(p["ids"])
+        if cmd == "push_sparse":
+            self._tables[p["table"]].push(p["ids"], p["grads"])
+            return True
+        if cmd == "init_dense":
+            with self._dense_lock:
+                self._dense.setdefault(p["name"], np.array(p["value"],
+                                                           np.float32))
+            return True
+        if cmd == "pull_dense":
+            with self._dense_lock:
+                return self._dense[p["name"]]
+        if cmd == "push_dense":
+            with self._dense_lock:
+                self._dense[p["name"]] -= p["lr"] * p["grad"]
+            return True
+        if cmd == "barrier":
+            return self._barrier(p["name"], p["world"])
+        if cmd == "save":
+            return self._save(p["dirname"])
+        if cmd == "stats":
+            return {name: len(t.rows) for name, t in self._tables.items()}
+        if cmd == "stop":
+            return True
+        raise ValueError(f"unknown PS command {cmd!r}")
+
+    def _barrier(self, name: str, world: int):
+        """Returns this caller's ARRIVAL POSITION in the generation
+        (1..world) — position == world identifies the last arrival, the
+        one allowed to run post-barrier teardown (stop_worker)."""
+        with self._barrier_cv:
+            self._barrier_count[name] = self._barrier_count.get(name, 0) + 1
+            count = self._barrier_count[name]
+            pos = (count - 1) % world + 1
+            target = ((count - 1) // world + 1) * world
+            while self._barrier_count[name] < target \
+                    and not self._stop.is_set():
+                self._barrier_cv.wait(timeout=0.1)
+            self._barrier_cv.notify_all()
+            return pos
+
+    def _save(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        for name, t in self._tables.items():
+            with t.lock:
+                ids = np.fromiter(t.rows.keys(), np.int64,
+                                  count=len(t.rows))
+                vals = (np.stack([t.rows[int(i)] for i in ids])
+                        if len(ids) else
+                        np.zeros((0, t.cfg.dim), np.float32))
+            np.savez(os.path.join(
+                dirname, f"{name}.shard{self.server_idx}.npz"),
+                ids=ids, values=vals)
+        return True
+
+    def load_model(self, dirname: str):
+        """Restore THIS shard's rows from a prior ``save`` (reference
+        fleet.init_server(dirname) loads the saved model)."""
+        import glob
+
+        suffix = f".shard{self.server_idx}.npz"
+        for path in glob.glob(os.path.join(dirname, f"*{suffix}")):
+            name = os.path.basename(path)[: -len(suffix)]
+            data = np.load(path)
+            ids, vals = data["ids"], data["values"]
+            shard = self._tables.get(name)
+            if shard is None:
+                dim = int(vals.shape[1]) if vals.ndim == 2 else 0
+                shard = self._tables[name] = _SparseShard(
+                    TableConfig(name, dim), self.server_idx)
+            with shard.lock:
+                for i, rid in enumerate(ids):
+                    shard.rows[int(rid)] = vals[i].astype(np.float32)
+        return self
+
+
+class PsClient:
+    """Trainer-side handle to the server group (reference brpc_ps_client).
+    Row routing: id % num_servers picks the owning shard."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        self.endpoints = list(endpoints)
+        self._socks: List[socket.socket] = []
+        self._locks: List[threading.Lock] = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=30)
+            self._socks.append(s)
+            self._locks.append(threading.Lock())
+
+    def _call(self, idx: int, cmd: str, payload):
+        with self._locks[idx]:
+            _send(self._socks[idx], (cmd, payload))
+            status, resp = _recv(self._socks[idx])
+        if status != "ok":
+            raise RuntimeError(f"PS server {idx}: {resp}")
+        return resp
+
+    def _all(self, cmd: str, payload):
+        return [self._call(i, cmd, payload)
+                for i in range(len(self._socks))]
+
+    # -- tables --------------------------------------------------------------
+    def create_table(self, cfg: TableConfig):
+        self._all("create_table", cfg)
+
+    def pull_sparse(self, table: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).ravel()
+        n = len(self._socks)
+        if ids.size == 0:
+            return np.empty((0, 0), np.float32)
+        parts = []
+        for s in range(n):
+            mask = (ids % n) == s
+            if not mask.any():
+                parts.append(None)
+                continue
+            rows = self._call(s, "pull_sparse",
+                              {"table": table, "ids": ids[mask]})
+            parts.append((mask, rows))
+        dim = next(p[1].shape[1] for p in parts if p is not None)
+        out = np.empty((ids.size, dim), np.float32)
+        for p in parts:
+            if p is not None:
+                out[p[0]] = p[1]
+        return out
+
+    def push_sparse(self, table: str, ids, grads) -> None:
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(ids.size, -1)
+        n = len(self._socks)
+        for s in range(n):
+            mask = (ids % n) == s
+            if mask.any():
+                self._call(s, "push_sparse",
+                           {"table": table, "ids": ids[mask],
+                            "grads": grads[mask]})
+
+    # -- dense ---------------------------------------------------------------
+    def init_dense(self, name: str, value) -> None:
+        # dense params live on server 0 (small: biases/stats; the big
+        # dense model is mesh-sharded SPMD, not PS-served — DESIGN.md)
+        self._call(0, "init_dense", {"name": name, "value": value})
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self._call(0, "pull_dense", {"name": name})
+
+    def push_dense(self, name: str, grad, lr: float = 0.01) -> None:
+        self._call(0, "push_dense", {"name": name, "grad": grad, "lr": lr})
+
+    # -- control -------------------------------------------------------------
+    def barrier(self, name: str = "default", world: int = 1):
+        return self._call(0, "barrier", {"name": name, "world": world})
+
+    def save(self, dirname: str):
+        return self._all("save", {"dirname": dirname})
+
+    def stats(self):
+        return self._all("stats", None)
+
+    def stop_servers(self):
+        for i in range(len(self._socks)):
+            try:
+                self._call(i, "stop", None)
+            except (RuntimeError, ConnectionError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class DistributedEmbedding:
+    """`paddle.static.nn.sparse_embedding` analog: an embedding whose
+    table lives on the parameter servers. The device only ever holds the
+    rows a batch touches — tables may exceed HBM by orders of magnitude.
+
+    Eager (paddle Tensor) usage: ``rows = emb(ids_tensor)`` pulls the
+    rows and registers a gradient HOOK, so ``loss.backward()`` pushes the
+    per-row sparse gradient to the servers automatically (server-side
+    optimize — the reference accessor flow). Functional/jit usage is the
+    explicit pair ``rows = emb.pull(ids)`` ... ``emb.push(ids, grad)``
+    with the cotangent from ``jax.grad`` w.r.t. ``rows``."""
+
+    def __init__(self, client: PsClient, name: str, dim: int,
+                 optimizer: str = "sgd", lr: float = 0.01, **cfg_kw):
+        self.client = client
+        self.name = name
+        self.dim = dim
+        client.create_table(TableConfig(name, dim, optimizer=optimizer,
+                                        lr=lr, **cfg_kw))
+
+    def pull(self, ids) -> np.ndarray:
+        flat = np.asarray(ids, np.int64).ravel()
+        rows = self.client.pull_sparse(self.name, flat)
+        return rows.reshape(tuple(np.shape(ids)) + (self.dim,))
+
+    def push(self, ids, grads) -> None:
+        flat = np.asarray(ids, np.int64).ravel()
+        self.client.push_sparse(self.name, flat,
+                                np.asarray(grads).reshape(flat.size, -1))
+
+    def __call__(self, ids):
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        raw = ids.value if isinstance(ids, Tensor) else ids
+        rows = self.pull(np.asarray(raw))
+        if not isinstance(ids, Tensor):
+            return jnp.asarray(rows)
+        out = Tensor(jnp.asarray(rows), stop_gradient=False)
+        flat = np.asarray(raw, np.int64).ravel()
+        client, name = self.client, self.name
+
+        def _push_hook(g):
+            client.push_sparse(
+                name, flat,
+                np.asarray(g.value).reshape(flat.size, -1))
+            return None                 # keep the grad unchanged
+
+        out.register_hook(_push_hook)
+        return out
