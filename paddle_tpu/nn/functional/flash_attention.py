@@ -170,13 +170,21 @@ class sdp_kernel:
 
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                      key_padding_mask=None, attn_mask=None, name=None):
-    """Block-sparse attention (reference nn/functional/sparse_attention.py).
-    Dense-mask emulation: CSR pattern → boolean mask; on TPU the dense masked
-    form is usually faster than gather-based sparsity for moderate S."""
+    """CSR-pattern attention (reference nn/functional/sparse_attention.py,
+    phi/kernels/sparse/gpu/sparse_attention — computes ONLY the stored
+    (q, k) pairs).
+
+    Gather path (default): per-row key/value gathers at static capacity
+    R = max row nnz (rounded to the 8-sublane tile), scores [bh, s, R] —
+    memory O(s·R·d), never the dense [s, s] score matrix, matching the
+    reference kernel's point. Falls back to the dense masked form when
+    the pattern is near-dense (R > s/2 — the gather would cost more than
+    it saves) or when the CSR arrays are tracers (row capacity must be
+    static)."""
     offs = unwrap(sparse_csr_offset)
     cols = unwrap(sparse_csr_columns)
 
-    def f(q, k, v):
+    def dense_f(q, k, v):
         b, h, s, d = q.shape
         # CSR pattern → boolean mask by scattering (vectorized over batch*head)
         bh = b * h
@@ -197,5 +205,52 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
         probs = jax.nn.softmax(scores, axis=-1)
         probs = jnp.where(mask, probs, 0.0).astype(q.dtype)
         return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    def gather_f(q, k, v, R):
+        b, h, s, d = q.shape
+        bh = b * h
+        offs2 = offs.reshape(bh, s + 1)
+        cols2 = cols.reshape(bh, -1)
+        lens = offs2[:, 1:] - offs2[:, :-1]                 # [bh, s]
+        r = jnp.arange(R)
+        base = offs2[:, :-1, None] + r[None, None, :]       # [bh, s, R]
+        nnz = cols2.shape[-1]
+        idx = jnp.take_along_axis(
+            cols2[:, None, :], jnp.clip(base, 0, max(nnz - 1, 0)),
+            axis=2)                                          # [bh, s, R]
+        valid = r[None, None, :] < lens[:, :, None]
+        q2 = q.reshape(bh, s, d)
+        k2 = k.reshape(bh, s, d)
+        v2 = v.reshape(bh, s, d)
+        kg = jax.vmap(lambda kk, ii: kk[ii])(k2, idx)        # [bh, s, R, d]
+        vg = jax.vmap(lambda vv, ii: vv[ii])(v2, idx)
+        scores = jnp.einsum("bqd,bqrd->bqr", q2.astype(jnp.float32),
+                            kg.astype(jnp.float32)) / (d ** 0.5)
+        scores = jnp.where(valid, scores, -jnp.inf)
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores - jnp.where(jnp.isfinite(m), m, 0.0))
+        p = jnp.where(valid, p, 0.0)
+        denom = jnp.maximum(jnp.sum(p, -1, keepdims=True), 1e-30)
+        out = jnp.einsum("bqr,bqrd->bqd", (p / denom).astype(v.dtype), vg)
+        return out.reshape(b, h, s, d)
+
+    # static row capacity decides the path; tracers can't give one
+    R = None
+    try:
+        import numpy as _np
+
+        o = _np.asarray(offs)
+        R = int((o.reshape(-1, o.shape[-1])[:, 1:]
+                 - o.reshape(-1, o.shape[-1])[:, :-1]).max())
+    except (TypeError, jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        pass
+
+    def f(q, k, v):
+        s = q.shape[2]
+        if R is not None and 0 < R <= s // 2:
+            # round capacity to the sublane tile so the gather lanes align
+            return gather_f(q, k, v, min(s, -(-R // 8) * 8))
+        return dense_f(q, k, v)
 
     return apply_op(f, query, key, value, op_name="sparse_attention")

@@ -233,3 +233,76 @@ class TestIntegration:
         out = model(ids)
         assert tuple(out.shape) == (2, 16, cfg.vocab_size)
         assert np.isfinite(np.asarray(out.value)).all()
+
+
+class TestSparseAttentionGather:
+    """CSR gather path == dense-mask path, without the [s, s] buffer
+    (reference sparse_attention computes only stored pairs)."""
+
+    def _random_csr(self, rng, bh, s, max_row):
+        offs = np.zeros((bh, s + 1), np.int32)
+        cols_l = []
+        for b in range(bh):
+            cs = []
+            for q in range(s):
+                n = rng.randint(1, max_row + 1)
+                cs.append(np.sort(rng.choice(s, size=n, replace=False)))
+                offs[b, q + 1] = offs[b, q] + n
+            cols_l.append(np.concatenate(cs))
+        nnz = max(len(c) for c in cols_l)
+        cols = np.zeros((bh, nnz), np.int32)
+        for b, c in enumerate(cols_l):
+            cols[b, :len(c)] = c
+        return offs, cols
+
+    def test_gather_matches_dense_mask(self):
+        from paddle_tpu.nn.functional.flash_attention import sparse_attention
+        import paddle_tpu as paddle
+
+        rng = np.random.RandomState(0)
+        b, h, s, d = 2, 2, 32, 8
+        offs, cols = self._random_csr(rng, b * h, s, max_row=6)  # R<<s/2
+        q = rng.randn(b, h, s, d).astype(np.float32)
+        k = rng.randn(b, h, s, d).astype(np.float32)
+        v = rng.randn(b, h, s, d).astype(np.float32)
+        o3 = offs.reshape(b, h, s + 1)
+        c3 = cols.reshape(b, h, -1)
+        got = sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                               paddle.to_tensor(v), paddle.to_tensor(o3),
+                               paddle.to_tensor(c3))
+        # dense reference: mask-built softmax over stored pairs only
+        mask = np.zeros((b * h, s, s), bool)
+        for bi in range(b * h):
+            for qi in range(s):
+                mask[bi, qi, cols[bi, offs[bi, qi]:offs[bi, qi + 1]]] = True
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        scores = np.where(mask.reshape(b, h, s, s), scores, -np.inf)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(np.asarray(got.value), want,
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_gather_never_builds_s2_buffer(self):
+        """Long sequence, narrow rows: compiled temp memory must stay
+        far below the dense [bh, s, s] score matrix."""
+        from paddle_tpu.nn.functional.flash_attention import sparse_attention
+
+        rng = np.random.RandomState(1)
+        b, h, s, d, row = 1, 2, 1024, 16, 8
+        offs = np.tile(np.arange(s + 1, dtype=np.int32) * row, (b * h, 1))
+        cols = np.tile(
+            np.concatenate([np.sort(rng.choice(s, row, replace=False))
+                            for _ in range(s)]).astype(np.int32),
+            (b * h, 1))
+        q = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+        o3 = jnp.asarray(offs.reshape(b, h, s + 1))
+        c3 = jnp.asarray(cols.reshape(b, h, -1))
+
+        def f(q, k, v):
+            return sparse_attention(q, k, v, o3, c3)
+
+        c = jax.jit(f).lower(q, q, q).compile()
+        tmp = c.memory_analysis().temp_size_in_bytes
+        dense_scores = b * h * s * s * 4        # 8.4 MB fp32
+        assert tmp < dense_scores // 2, (tmp, dense_scores)
