@@ -1,0 +1,130 @@
+"""The dots-bucket attack plan (PERF.md Headroom #1): dots sit at ~43%
+MXU and dominate the 350M step (223ms). Each experiment here is an
+UNTRIED lever (merged-QKV and remat="dots" already measured and
+rejected — see PERF.md "did NOT work"); run on TPU, flip a default only
+on a >=3% full-step win.
+
+  E1 scan unroll      — lax.scan(unroll=k) exposes k consecutive layers
+                        to one XLA fusion scope: boundary relayouts and
+                        convert tails can fuse across layers. Measures
+                        the FULL 350M loss fwd+bwd at unroll 1/2/4.
+  E2 dot form         — [B,S,H]x[H,N] einsum vs reshape-to-2D
+                        [B*S,H]@[H,N]: batched-3D vs flat-2D tiling.
+  E3 rhs layout       — W[in,out] (ours) vs W[out,in] consumed as
+                        dot_general with contracting dim 1 ("transposed
+                        weights"): whether XLA inserts a relayout for
+                        one of the forms at bf16.
+  E4 dot out dtype    — bf16 dot -> f32 output (preferred_element_type)
+                        vs bf16 output + later upcast: convert-tail
+                        fusion (PERF.md's ~25ms convert bucket).
+
+Run: python experiments/exp_dots.py            (TPU; ~2 min)
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    if os.environ.get("EXP_FORCE_CPU"):
+        # the axon sitecustomize force-sets jax_platforms; the env var
+        # alone cannot pin CPU (see tests/conftest.py note)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from exp_micro import timed
+
+    from paddle_tpu.models import LlamaForCausalLM, llama_config
+    from paddle_tpu.models.llama_functional import (build_loss_fn,
+                                                    stack_params)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = llama_config("350m", dtype="bfloat16", num_attention_heads=8,
+                           num_key_value_heads=8,
+                           max_position_embeddings=2048, recompute="full")
+        B, S = 8, 2048
+    else:
+        cfg = llama_config("tiny")
+        B, S = 2, 64
+    model = LlamaForCausalLM(cfg)
+    params = {k: p.value for k, p in model.named_parameters()}
+    stacked, rest = stack_params(params, cfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    results = {}
+
+    # ---- E1: scan unroll on the full loss fwd+bwd --------------------------
+    for unroll in (1, 2, 4):
+        try:
+            loss_fn = build_loss_fn(cfg, remat=True, scan_unroll=unroll)
+
+            # timed() chains its perturbation through arg 0, which must
+            # be a float array: thread the embedding weight through
+            def gfn(emb_w, _lf=loss_fn):
+                r2 = dict(rest)
+                r2["model.embed_tokens.weight"] = emb_w
+                return jax.grad(
+                    lambda p: _lf(p["s"], p["r"], ids, y))(
+                        {"s": stacked, "r": r2})
+
+            ms = timed(jax.jit(gfn),
+                       (rest["model.embed_tokens.weight"],)) * 1e3
+            results[f"E1_unroll{unroll}_fwdbwd_ms"] = round(ms, 2)
+        except Exception as e:  # noqa: BLE001
+            results[f"E1_unroll{unroll}_fwdbwd_ms"] = \
+                f"{type(e).__name__}: {e}"[:120]
+        print(json.dumps({f"E1_unroll{unroll}":
+                          results[f"E1_unroll{unroll}_fwdbwd_ms"]}),
+              flush=True)
+
+    # ---- E2/E3/E4: dot micro-forms at layer shapes -------------------------
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    x3 = jnp.asarray(rng.randn(B, S, H), dt)
+    w = jnp.asarray(rng.randn(H, I) * 0.02, dt)
+    wt = jnp.asarray(np.asarray(w).T.copy())
+
+    def e2_einsum(x, w):
+        return jnp.einsum("bsh,hi->bsi", x, w)
+
+    def e2_flat(x, w):
+        return (x.reshape(-1, H) @ w).reshape(B, S, I)
+
+    def e3_transposed(x, wt):
+        return jax.lax.dot_general(x, wt, (((2,), (1,)), ((), ())))
+
+    def e4_f32out(x, w):
+        return jax.lax.dot_general(
+            x, w, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dt)
+
+    for name, fn, args in (
+            ("E2_einsum3d", e2_einsum, (x3, w)),
+            ("E2_flat2d", e2_flat, (x3, w)),
+            ("E3_rhs_transposed", e3_transposed, (x3, wt)),
+            ("E4_f32_out", e4_f32out, (x3, w))):
+        try:
+            ms = timed(jax.jit(fn), args) * 1e3
+            results[f"{name}_ms"] = round(ms, 3)
+        except Exception as e:  # noqa: BLE001
+            results[f"{name}_ms"] = f"{type(e).__name__}: {e}"[:120]
+        print(json.dumps({name: results[f"{name}_ms"]}), flush=True)
+
+    print(json.dumps({"platform": jax.default_backend(), **results}))
+
+
+if __name__ == "__main__":
+    main()

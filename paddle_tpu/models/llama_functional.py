@@ -103,8 +103,12 @@ def _remat_policy(remat):
     raise ValueError(f"unknown remat spec {remat!r}")
 
 
-def forward(stacked, rest, ids, cfg: LlamaConfig, remat=True):
-    """Logits for [B, S] ids. Decoder runs as scan-over-layers."""
+def forward(stacked, rest, ids, cfg: LlamaConfig, remat=True,
+            scan_unroll: int = 1):
+    """Logits for [B, S] ids. Decoder runs as scan-over-layers.
+    ``scan_unroll`` exposes that many consecutive layers to one XLA
+    fusion scope (experiments/exp_dots.py E1 measures whether boundary
+    relayouts fuse away; keep 1 until a TPU win is recorded)."""
     x = jnp.take(rest["model.embed_tokens.weight"], ids, axis=0)
     cos, sin = _rope_cos_sin(ids.shape[1], cfg.head_dim, cfg.rope_theta,
                              x.dtype)
@@ -114,7 +118,7 @@ def forward(stacked, rest, ids, cfg: LlamaConfig, remat=True):
 
     if remat not in (False, "none"):
         body = jax.checkpoint(body, **_remat_policy(remat))
-    x, _ = jax.lax.scan(body, x, stacked)
+    x, _ = jax.lax.scan(body, x, stacked, unroll=scan_unroll)
     x = _rms(x, rest["model.norm.weight"], cfg.rms_norm_eps)
     if "lm_head.weight" in rest:
         return x @ rest["lm_head.weight"]
@@ -122,11 +126,12 @@ def forward(stacked, rest, ids, cfg: LlamaConfig, remat=True):
 
 
 def build_loss_fn(cfg: LlamaConfig, remat=True,
-                  ignore_index: int = -100):
+                  ignore_index: int = -100, scan_unroll: int = 1):
     """Pure (stacked, rest, ids, labels) -> mean CE loss."""
 
     def loss_fn(stacked, rest, ids, labels):
-        logits = forward(stacked, rest, ids, cfg, remat)
+        logits = forward(stacked, rest, ids, cfg, remat,
+                         scan_unroll=scan_unroll)
         # lse − logit[label] form: never materializes a [B,S,V] fp32
         # log-softmax (the convert fuses into the reduction; the direct
         # form wrote+read an extra ~3x vocab-sized fp32 temp)
