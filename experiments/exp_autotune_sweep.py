@@ -1,0 +1,58 @@
+"""On-TPU autotune sweep (VERDICT r3 #8): block sizes for flash fwd+bwd
+and decode_mha at the llama bench/serving shapes, persisted to the
+IN-REPO cache (.autotune_cache.json) so `bench.py` picks tuned blocks on
+first run. Commit the file after a successful sweep.
+
+Run: python experiments/exp_autotune_sweep.py        (TPU; ~3-5 min)
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+
+    if os.environ.get("EXP_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    cache = os.path.join(REPO, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from paddle_tpu.ops import autotune
+
+    autotune.set_cache_path(os.path.join(REPO, ".autotune_cache.json"))
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        print(json.dumps({"warning": "not on TPU — sweep would record "
+                          "meaningless CPU timings; refusing to persist"}))
+        return
+
+    results = {}
+    # flash at the two bench configs (350M: h8 d128 s2048; 1.3B: h16 d128)
+    for b, h, s, d in ((8, 8, 2048, 128), (4, 16, 2048, 128),
+                       (8, 8, 1024, 128)):
+        for grad in (True, False):
+            cfg = autotune.tune_flash(b, h, s, d, causal=True,
+                                      dtype="bfloat16", grad=grad)
+            results[f"flash_b{b}h{h}s{s}{'_grad' if grad else ''}"] = cfg
+            print(json.dumps({f"flash s={s} h={h} grad={grad}": cfg}),
+                  flush=True)
+    # decode at serving shapes (engine max_len 2048/4096)
+    for b, h, s_max, d in ((8, 8, 2048, 128), (8, 8, 4096, 128)):
+        cfg = autotune.tune_decode_mha(b, h, s_max, d, dtype="bfloat16")
+        results[f"decode_s{s_max}"] = cfg
+        print(json.dumps({f"decode s_max={s_max}": cfg}), flush=True)
+
+    autotune.get_cache().save()
+    print(json.dumps({"saved": os.path.join(REPO, ".autotune_cache.json"),
+                      "entries": autotune.get_cache().stats}))
+
+
+if __name__ == "__main__":
+    main()

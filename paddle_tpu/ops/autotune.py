@@ -25,15 +25,25 @@ import time
 from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 __all__ = ["AutoTuneCache", "get_cache", "lookup", "record", "tune",
-           "tune_flash", "set_cache_path"]
+           "tune_flash", "tune_decode_mha", "decode_signature",
+           "set_cache_path"]
 
 _CACHE_ENV = "PADDLE_TPU_AUTOTUNE_CACHE"
 
 
 def _default_path() -> str:
-    return os.environ.get(
-        _CACHE_ENV, os.path.join(os.path.expanduser("~"),
-                                 ".paddle_tpu_autotune.json"))
+    env = os.environ.get(_CACHE_ENV)
+    if env:
+        return env
+    # a committed in-repo cache (written by experiments/
+    # exp_autotune_sweep.py on real hardware) wins over the per-user
+    # file, so bench.py picks tuned blocks on first run anywhere
+    repo = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".autotune_cache.json")
+    if os.path.exists(repo):
+        return repo
+    return os.path.join(os.path.expanduser("~"),
+                        ".paddle_tpu_autotune.json")
 
 
 class AutoTuneCache:
@@ -201,4 +211,38 @@ def tune_flash(b: int, h: int, s: int, d: int, causal: bool = True,
     cands = [{"block_q": bq, "block_k": bk} for bq, bk in candidates
              if bq <= s and bk <= s]
     return tune("flash_attention", flash_signature(s, s, d, causal, dtype),
+                cands, runner)
+
+
+# -- decode attention -----------------------------------------------------
+
+DECODE_BLOCK_CANDIDATES = (256, 512, 1024, 2048)
+
+
+def decode_signature(s_max: int, h: int, d: int, dtype="bfloat16") -> Tuple:
+    return ("s_max", s_max, "h", h, "d", d, "dtype", str(dtype))
+
+
+def tune_decode_mha(b: int, h: int, s_max: int, d: int, dtype="bfloat16",
+                    candidates=DECODE_BLOCK_CANDIDATES) -> dict:
+    """Benchmark decode_mha S-block sizes at [b, h, s_max, d] over a
+    mixed-length batch (the serving shape) and cache the winner."""
+    import jax
+    import jax.numpy as jnp
+
+    from .pallas_kernels import decode_mha
+
+    key = jax.random.PRNGKey(0)
+    dt = jnp.dtype(dtype)
+    q = jax.random.normal(key, (b, h, d), dt)
+    kc = jax.random.normal(key, (b, s_max, h, d), dt)
+    vc = jax.random.normal(key, (b, s_max, h, d), dt)
+    lens = jnp.linspace(s_max // 8, s_max, b).astype(jnp.int32)
+
+    def runner(cfg):
+        out = decode_mha(q, kc, vc, lens, block_s=cfg["block_s"])
+        float(jnp.sum(out.astype(jnp.float32)))   # host readback barrier
+
+    cands = [{"block_s": bs} for bs in candidates if bs <= s_max]
+    return tune("decode_mha", decode_signature(s_max, h, d, dtype),
                 cands, runner)

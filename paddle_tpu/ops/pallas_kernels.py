@@ -338,8 +338,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
-@jax.jit
-def decode_mha(q, k_cache, v_cache, seq_lens):
+def decode_mha(q, k_cache, v_cache, seq_lens, block_s=None):
     """Single-step decode attention (≙ masked_multihead_attention_kernel,
     fused_multi_transformer_op.cu.h:745).
 
@@ -347,12 +346,25 @@ def decode_mha(q, k_cache, v_cache, seq_lens):
     [B] valid lengths (the new token's k/v must already be written at
     position seq_lens-1). Returns [B, H, D]. The cache streams through VMEM
     in S-blocks with online-softmax accumulation (flash recurrence), so
-    S is bounded by HBM, not VMEM.
+    S is bounded by HBM, not VMEM. ``block_s=None`` consults the autotune
+    cache (experiments/exp_autotune_sweep.py populates it), default 512.
     """
+    if block_s is None:
+        from .autotune import decode_signature, lookup
+
+        tuned = lookup("decode_mha", decode_signature(
+            k_cache.shape[1], q.shape[1], q.shape[2],
+            jnp.dtype(q.dtype).name)) or {}
+        block_s = tuned.get("block_s", 512)
+    return _decode_mha_jit(q, k_cache, v_cache, seq_lens, block_s)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _decode_mha_jit(q, k_cache, v_cache, seq_lens, block_s):
     b_, h_, d_ = q.shape
     s_max = k_cache.shape[1]
     scale = 1.0 / math.sqrt(d_)
-    bs = _row_block(s_max, 512)
+    bs = _row_block(s_max, block_s)
     return pl.pallas_call(
         functools.partial(_decode_kernel, scale=scale, block_s=bs),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
