@@ -243,35 +243,204 @@ def gloo_release():
     _global_store[0] = None
 
 
-# -- parameter-server surface (descoped subsystem — DESIGN.md): the names
-#    exist and explain themselves instead of AttributeError-ing ------------
-
-_PS_MSG = ("the brpc parameter-server stack is deliberately out of scope "
-           "for this TPU-native build (synchronous SPMD + sharded "
-           "embeddings replace async PS; see DESIGN.md 'Descoped "
-           "subsystems')")
+# -- parameter-server data surface (reference distributed/entry_attr.py +
+#    fleet/dataset/dataset.py), backed by the real PS in distributed/ps ----
 
 
-class _PSGated:
-    def __init__(self, *a, **kw):
-        raise NotImplementedError(f"{type(self).__name__}: {_PS_MSG}")
+class EntryAttr:
+    """Sparse-table entry-admission policy base (reference
+    entry_attr.EntryAttr:47 — `_to_attr()` is the wire form the table
+    config carries). Consumed by ``ps.TableConfig(entry=...)``: the shard
+    applies the policy when a row is first pushed."""
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError("use a concrete EntryAttr subclass")
 
 
-class InMemoryDataset(_PSGated):
-    pass
+class ProbabilityEntry(EntryAttr):
+    """Admit a NEW row with probability p (reference entry_attr.py:57):
+    rejected rows stay zero and their pushes are dropped — the CTR-table
+    admission filter for ultra-long-tail ids."""
+
+    def __init__(self, probability: float):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError(
+                f"probability must be in (0, 1], got {probability}")
+        self._probability = float(probability)
+
+    def _to_attr(self) -> str:
+        return f"probability_entry:{self._probability}"
 
 
-class QueueDataset(_PSGated):
-    pass
+class CountFilterEntry(EntryAttr):
+    """A row becomes stored/trainable only after it was pushed
+    ``count_filter`` times (reference entry_attr.py:98); earlier pushes
+    just bump the occurrence counter."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 1:
+            raise ValueError(
+                f"count_filter must be >= 1, got {count_filter}")
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self) -> str:
+        return f"count_filter_entry:{self._count_filter}"
 
 
-class CountFilterEntry(_PSGated):
-    pass
+class ShowClickEntry(EntryAttr):
+    """Declare the show/click stat slots a CTR table tracks per row
+    (reference entry_attr.py:142); the shard accumulates them via
+    ``PsClient.push_show_click``."""
+
+    def __init__(self, show_name: str, click_name: str):
+        self._show = str(show_name)
+        self._click = str(click_name)
+
+    def _to_attr(self) -> str:
+        return f"show_click_entry:{self._show}:{self._click}"
 
 
-class ProbabilityEntry(_PSGated):
-    pass
+def _parse_multislot(line: str):
+    """One MultiSlot line -> {slot: np.ndarray}. Format (the PS pipeline
+    wire form, fleet.MultiSlotDataGenerator): ``slot:len v1 .. vlen ...``"""
+    import numpy as np
+
+    toks = line.split()
+    out = {}
+    i = 0
+    while i < len(toks):
+        slot, n = toks[i].rsplit(":", 1)
+        n = int(n)
+        vals = toks[i + 1: i + 1 + n]
+        try:
+            arr = np.asarray([int(v) for v in vals], np.int64)
+        except ValueError:
+            arr = np.asarray([float(v) for v in vals], np.float32)
+        out[slot] = arr
+        i += 1 + n
+    return out
 
 
-class ShowClickEntry(_PSGated):
-    pass
+class DatasetBase:
+    """Reference fleet/dataset/dataset.py DatasetBase: filelist + batch
+    config over the MultiSlot text format; ``pipe_command`` (when set)
+    transforms each file's lines through a shell pipe, exactly the
+    data-generator contract."""
+
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._pipe_command = None
+        self._use_var = []
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             download_cmd="cat", **kwargs):
+        self._batch_size = int(batch_size)
+        self._thread_num = int(thread_num)
+        self._use_var = list(use_var or [])
+        self._pipe_command = pipe_command
+        return self
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _read_file(self, path):
+        if self._pipe_command:
+            import subprocess
+
+            proc = subprocess.run(
+                self._pipe_command, shell=True,  # noqa: S602 - user cmd
+                stdin=open(path, "rb"), capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pipe_command failed on {path}: {proc.stderr[:200]}")
+            lines = proc.stdout.splitlines()
+        else:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        return [_parse_multislot(ln) for ln in lines if ln.strip()]
+
+    def _iter_samples(self):
+        for path in self._filelist:
+            yield from self._read_file(path)
+
+    def _batches(self, samples):
+        buf = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self._batch_size:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+
+class InMemoryDataset(DatasetBase):
+    """Reference InMemoryDataset (dataset.py:351): load files into
+    memory, shuffle, iterate batches. ``global_shuffle`` on one host is
+    the local shuffle (multi-host exchange belongs to the descoped brpc
+    data plane; documented)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory = None
+        self._epoch_seed = 0
+
+    def load_into_memory(self, is_shuffle: bool = False):
+        self._memory = list(self._iter_samples())
+        if is_shuffle:
+            self.local_shuffle()
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        if self._memory is None:
+            raise RuntimeError("preload_into_memory was not called")
+
+    def local_shuffle(self):
+        import random
+
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        rng = random.Random(self._epoch_seed)
+        self._epoch_seed += 1
+        rng.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return 0 if self._memory is None else len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return self.get_memory_data_size(fleet)
+
+    def release_memory(self):
+        self._memory = None
+
+    def __iter__(self):
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        return self._batches(iter(self._memory))
+
+
+class QueueDataset(DatasetBase):
+    """Reference QueueDataset (dataset.py:1460-ish): STREAMS the filelist
+    without materializing it; shuffle/in-memory ops raise, matching the
+    reference's own NotImplementedError contract for this class."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams files; use InMemoryDataset to shuffle "
+            "(the reference raises the same way)")
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        raise NotImplementedError(
+            "QueueDataset streams files; use InMemoryDataset to shuffle "
+            "(the reference raises the same way)")
+
+    def __iter__(self):
+        return self._batches(self._iter_samples())

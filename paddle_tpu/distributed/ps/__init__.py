@@ -76,7 +76,7 @@ class TableConfig:
                  lr: float = 0.01, initializer: str = "uniform",
                  init_range: float = 0.1, seed: int = 0,
                  beta1: float = 0.9, beta2: float = 0.999,
-                 epsilon: float = 1e-8):
+                 epsilon: float = 1e-8, entry=None):
         self.name = name
         self.dim = int(dim)
         self.optimizer = optimizer
@@ -85,6 +85,10 @@ class TableConfig:
         self.init_range = float(init_range)
         self.seed = int(seed)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        # EntryAttr admission policy (distributed.ProbabilityEntry /
+        # CountFilterEntry / ShowClickEntry — reference entry_attr.py);
+        # None = plain embedding semantics (rows admitted on first touch)
+        self.entry = entry
 
 
 class _SparseShard:
@@ -95,6 +99,9 @@ class _SparseShard:
         self.cfg = cfg
         self.rows: Dict[int, np.ndarray] = {}
         self.slots: Dict[int, tuple] = {}
+        self.counts: Dict[int, int] = {}        # CountFilterEntry
+        self.rejected: set = set()              # ProbabilityEntry
+        self.show_click: Dict[int, list] = {}   # ShowClickEntry stats
         self.step = 0
         self._seed = (cfg.seed * 1000003 + server_idx) & 0x7FFFFFFF
         self.lock = threading.Lock()
@@ -106,15 +113,56 @@ class _SparseShard:
         r = self.cfg.init_range
         return rng.uniform(-r, r, (self.cfg.dim,)).astype(np.float32)
 
+    def _admit(self, rid: int) -> bool:
+        """Entry-admission policy for an ABSENT row at push time
+        (reference CTR accessor + entry_attr): ProbabilityEntry draws
+        once per row (deterministic in (seed, rid)); CountFilterEntry
+        requires count_filter occurrences first."""
+        entry = self.cfg.entry
+        attr = getattr(entry, "_to_attr", lambda: "")()
+        if attr.startswith("probability_entry"):
+            if rid in self.rejected:
+                return False
+            p = entry._probability
+            draw = np.random.RandomState(
+                (self._seed ^ (rid * 2654435761)) & 0x7FFFFFFF).rand()
+            if draw >= p:
+                self.rejected.add(rid)
+                return False
+            return True
+        if attr.startswith("count_filter_entry"):
+            c = self.counts.get(rid, 0) + 1
+            self.counts[rid] = c
+            return c >= entry._count_filter
+        return True
+
     def pull(self, ids: np.ndarray) -> np.ndarray:
+        gated = self.cfg.entry is not None
         with self.lock:
             out = np.empty((len(ids), self.cfg.dim), np.float32)
             for i, rid in enumerate(ids):
                 rid = int(rid)
                 if rid not in self.rows:
+                    if gated:
+                        # entry policies admit on PUSH; unadmitted rows
+                        # read as zeros and are not stored
+                        out[i] = 0.0
+                        continue
                     self.rows[rid] = self._init_row(rid)
                 out[i] = self.rows[rid]
             return out
+
+    def push_show_click(self, ids, shows, clicks):
+        with self.lock:
+            for rid, sh, ck in zip(ids, shows, clicks):
+                rec = self.show_click.setdefault(int(rid), [0.0, 0.0])
+                rec[0] += float(sh)
+                rec[1] += float(ck)
+
+    def pull_show_click(self, ids):
+        with self.lock:
+            return np.asarray([self.show_click.get(int(r), [0.0, 0.0])
+                               for r in ids], np.float32)
 
     def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
         cfg = self.cfg
@@ -124,6 +172,8 @@ class _SparseShard:
                 rid = int(rid)
                 w = self.rows.get(rid)
                 if w is None:
+                    if cfg.entry is not None and not self._admit(rid):
+                        continue
                     w = self.rows[rid] = self._init_row(rid)
                 if cfg.optimizer == "sgd":
                     w -= cfg.lr * g
@@ -227,6 +277,12 @@ class PsServer:
         if cmd == "push_sparse":
             self._tables[p["table"]].push(p["ids"], p["grads"])
             return True
+        if cmd == "push_show_click":
+            self._tables[p["table"]].push_show_click(
+                p["ids"], p["shows"], p["clicks"])
+            return True
+        if cmd == "pull_show_click":
+            return self._tables[p["table"]].pull_show_click(p["ids"])
         if cmd == "init_dense":
             with self._dense_lock:
                 self._dense.setdefault(p["name"], np.array(p["value"],
@@ -360,6 +416,30 @@ class PsClient:
                 self._call(s, "push_sparse",
                            {"table": table, "ids": ids[mask],
                             "grads": grads[mask]})
+
+    def push_show_click(self, table: str, ids, shows, clicks) -> None:
+        """Accumulate CTR stats for a ShowClickEntry table."""
+        ids = np.asarray(ids, np.int64).ravel()
+        shows = np.asarray(shows, np.float32).ravel()
+        clicks = np.asarray(clicks, np.float32).ravel()
+        n = len(self._socks)
+        for s in range(n):
+            mask = (ids % n) == s
+            if mask.any():
+                self._call(s, "push_show_click",
+                           {"table": table, "ids": ids[mask],
+                            "shows": shows[mask], "clicks": clicks[mask]})
+
+    def pull_show_click(self, table: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).ravel()
+        n = len(self._socks)
+        out = np.zeros((ids.size, 2), np.float32)
+        for s in range(n):
+            mask = (ids % n) == s
+            if mask.any():
+                out[mask] = self._call(s, "pull_show_click",
+                                       {"table": table, "ids": ids[mask]})
+        return out
 
     # -- dense ---------------------------------------------------------------
     def init_dense(self, name: str, value) -> None:

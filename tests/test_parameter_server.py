@@ -290,3 +290,159 @@ class TestSaveRestore:
             for s in restored:
                 s.stop()
             fleet.init()                      # leave PS mode for the suite
+
+
+class TestEntryPolicies:
+    """Entry-admission policies (reference distributed/entry_attr.py)
+    applied by the shard at push time."""
+
+    def test_count_filter_entry_delays_admission(self):
+        from paddle_tpu.distributed import CountFilterEntry
+
+        srvs, eps = _servers(1)
+        try:
+            c = PsClient(eps)
+            c.create_table(TableConfig("cf", dim=2,
+                                       entry=CountFilterEntry(3), lr=1.0))
+            ids = np.array([5], np.int64)
+            g = np.ones((1, 2), np.float32)
+            # pushes 1 and 2: below threshold -> row not stored
+            c.push_sparse("cf", ids, g)
+            c.push_sparse("cf", ids, g)
+            np.testing.assert_array_equal(c.pull_sparse("cf", ids), 0.0)
+            assert c.stats()[0]["cf"] == 0
+            # push 3 admits AND applies
+            c.push_sparse("cf", ids, g)
+            assert c.stats()[0]["cf"] == 1
+            assert not np.allclose(c.pull_sparse("cf", ids), 0.0)
+        finally:
+            for s in srvs:
+                s.stop()
+
+    def test_probability_entry_filters_some_rows(self):
+        from paddle_tpu.distributed import ProbabilityEntry
+
+        srvs, eps = _servers(1)
+        try:
+            c = PsClient(eps)
+            c.create_table(TableConfig("pe", dim=2,
+                                       entry=ProbabilityEntry(0.5)))
+            ids = np.arange(200, dtype=np.int64)
+            c.push_sparse("pe", ids, np.ones((200, 2), np.float32))
+            n = c.stats()[0]["pe"]
+            assert 60 < n < 140, n          # ~half admitted
+            # decision is sticky: repeat pushes change nothing
+            c.push_sparse("pe", ids, np.ones((200, 2), np.float32))
+            assert c.stats()[0]["pe"] == n
+        finally:
+            for s in srvs:
+                s.stop()
+
+    def test_show_click_entry_stats(self):
+        from paddle_tpu.distributed import ShowClickEntry
+
+        e = ShowClickEntry("show", "click")
+        assert e._to_attr() == "show_click_entry:show:click"
+        srvs, eps = _servers(2)
+        try:
+            c = PsClient(eps)
+            c.create_table(TableConfig("ctr", dim=2, entry=e))
+            ids = np.array([1, 2, 3], np.int64)
+            c.push_show_click("ctr", ids, [1, 1, 1], [0, 1, 0])
+            c.push_show_click("ctr", ids, [1, 0, 1], [1, 0, 0])
+            got = c.pull_show_click("ctr", ids)
+            np.testing.assert_allclose(got, [[2, 1], [1, 1], [2, 0]])
+        finally:
+            for s in srvs:
+                s.stop()
+
+    def test_entry_validation(self):
+        from paddle_tpu.distributed import (CountFilterEntry,
+                                            ProbabilityEntry)
+
+        with pytest.raises(ValueError):
+            ProbabilityEntry(0.0)
+        with pytest.raises(ValueError):
+            CountFilterEntry(0)
+        assert ProbabilityEntry(0.25)._to_attr() == "probability_entry:0.25"
+        assert CountFilterEntry(7)._to_attr() == "count_filter_entry:7"
+
+
+class TestPSDatasets:
+    """InMemoryDataset / QueueDataset over the MultiSlot text format
+    (reference fleet/dataset/dataset.py), fed by MultiSlotDataGenerator."""
+
+    def _write_files(self, tmp_path, n_files=2, lines_per=5):
+        paths = []
+        for fi in range(n_files):
+            p = tmp_path / f"part-{fi}.txt"
+            rows = []
+            for li in range(lines_per):
+                uid = fi * lines_per + li
+                rows.append(f"uid:1 {uid} feat:3 {uid} {uid+1} {uid+2} "
+                            f"label:1 {uid % 2}")
+            p.write_text("\n".join(rows) + "\n")
+            paths.append(str(p))
+        return paths
+
+    def test_in_memory_load_shuffle_iterate(self, tmp_path):
+        from paddle_tpu.distributed import InMemoryDataset
+
+        ds = InMemoryDataset()
+        ds.init(batch_size=4)
+        ds.set_filelist(self._write_files(tmp_path))
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 10
+        before = [int(s["uid"][0]) for s in ds._memory]
+        ds.local_shuffle()
+        after = [int(s["uid"][0]) for s in ds._memory]
+        assert sorted(before) == sorted(after) and before != after
+        batches = list(ds)
+        assert [len(b) for b in batches] == [4, 4, 2]
+        sample = batches[0][0]
+        assert set(sample) == {"uid", "feat", "label"}
+        assert sample["feat"].shape == (3,)
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset_streams_and_refuses_shuffle(self, tmp_path):
+        from paddle_tpu.distributed import QueueDataset
+
+        ds = QueueDataset()
+        ds.init(batch_size=3)
+        ds.set_filelist(self._write_files(tmp_path, n_files=1,
+                                          lines_per=7))
+        assert sum(len(b) for b in ds) == 7
+        with pytest.raises(NotImplementedError):
+            ds.local_shuffle()
+
+    def test_generator_to_dataset_pipeline(self, tmp_path):
+        """MultiSlotDataGenerator output parses back through the dataset
+        (the reference pipe_command contract, run in-process)."""
+        import paddle_tpu.distributed.fleet as fleet
+
+        class Gen(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def g():
+                    uid, label = line.strip().split(",")
+                    yield [("uid", [int(uid)]), ("label", [int(label)])]
+                return g
+
+        gen = Gen()
+        raw = ["7,1", "8,0"]
+        out_lines = []
+        for ln in raw:
+            for sample in gen.generate_sample(ln)():
+                out_lines.append(gen._format(sample))
+        p = tmp_path / "gen.txt"
+        p.write_text("\n".join(out_lines) + "\n")
+
+        from paddle_tpu.distributed import InMemoryDataset
+
+        ds = InMemoryDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist([str(p)])
+        ds.load_into_memory()
+        (batch,) = list(ds)
+        assert [int(s["uid"][0]) for s in batch] == [7, 8]
+        assert [int(s["label"][0]) for s in batch] == [1, 0]
