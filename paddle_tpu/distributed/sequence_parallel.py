@@ -7,10 +7,14 @@ mesh axis ``sp``, the capability extension the TPU build requires:
 
 - **ring attention**: Q stays put; K/V blocks rotate around the sp ring via
   ``ppermute`` while each device accumulates its queries' attention with an
-  online softmax (flash-attention recurrence across devices). Peak memory
-  per device is O(S/R · S/R) scores; the K/V rotation rides ICI and XLA
-  overlaps it with the block compute. Causality is enforced with global
-  position masks, so results are bit-comparable to single-device attention.
+  online softmax (flash-attention recurrence across devices). The LOCAL
+  block is itself chunked (``block_q`` x ``block_k`` inner scans), so peak
+  per-device score memory is O(block_q · block_k) — NOT O((S/R)²) — and
+  million-token contexts fit (tests/test_long_context.py proves 256k/1M
+  compile-only). Causal runs skip ring steps that are entirely in the
+  future (their sources hold only later positions), saving ~half the
+  FLOPs. Causality is enforced with global position masks, so results are
+  bit-comparable to single-device attention.
 - **Ulysses**: all-to-all swaps the sharded axis seq↔heads, runs ordinary
   (flash) attention with full sequence per head group, and swaps back.
   Cheaper than ring for moderate S (two all-to-alls), requires H % sp == 0.
@@ -58,33 +62,86 @@ def _online_block(q, k, v, acc, m, l, qpos, kpos, causal, scale):
     return acc, m_new, l
 
 
+def _pick_chunk(s: int, target: int) -> int:
+    """Largest divisor of s that is <= target (chunked scans need exact
+    tiling; sequences here are powers of two in practice)."""
+    for c in (target, 1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if c <= target and s % c == 0:
+            return c
+    return 1
+
+
+def _local_attend(q, kb, vb, state, q0, k0, causal, scale, bq, bk):
+    """Chunked local attention of q against one K/V block, merged into the
+    running online-softmax ``state`` = (acc, m, l). Scores exist only at
+    (bq, bk) granularity — the long-context contract. ``q0``/``k0`` are
+    the GLOBAL positions of the block starts."""
+    b, sq, h, d = q.shape
+    sk = kb.shape[1]
+    nq, nk = sq // bq, sk // bk
+
+    def q_step(state, qi):
+        acc, m, l = state
+        qs = qi * bq
+        qc = lax.dynamic_slice_in_dim(q, qs, bq, axis=1)
+        a = lax.dynamic_slice_in_dim(acc, qs, bq, axis=2)
+        mm = lax.dynamic_slice_in_dim(m, qs, bq, axis=2)
+        ll = lax.dynamic_slice_in_dim(l, qs, bq, axis=2)
+        qpos = q0 + qs + jnp.arange(bq)
+
+        def k_step(carry, ki):
+            a, mm, ll = carry
+            ks = ki * bk
+            kc = lax.dynamic_slice_in_dim(kb, ks, bk, axis=1)
+            vc = lax.dynamic_slice_in_dim(vb, ks, bk, axis=1)
+            kpos = k0 + ks + jnp.arange(bk)
+            a, mm, ll = _online_block(qc, kc, vc, a, mm, ll, qpos, kpos,
+                                      causal, scale)
+            return (a, mm, ll), None
+
+        (a, mm, ll), _ = lax.scan(k_step, (a, mm, ll), jnp.arange(nk))
+        acc = lax.dynamic_update_slice_in_dim(acc, a, qs, axis=2)
+        m = lax.dynamic_update_slice_in_dim(m, mm, qs, axis=2)
+        l = lax.dynamic_update_slice_in_dim(l, ll, qs, axis=2)
+        return (acc, m, l), None
+
+    state, _ = lax.scan(q_step, state, jnp.arange(nq))
+    return state
+
+
 def ring_attention(q, k, v, causal: bool = False,
                    sm_scale: Optional[float] = None,
-                   mesh: Optional[Mesh] = None, axis: str = "sp"):
-    """Ring attention over the ``axis`` mesh dim. q/k/v: [B, S, H, D] global.
+                   mesh: Optional[Mesh] = None, axis: str = "sp",
+                   block_q: int = 1024, block_k: int = 1024):
+    """Ring attention over the ``axis`` mesh dim. q/k/v: [B, S, H, D]
+    global. Use under jit with S sharded over ``axis``; on a 1-wide axis
+    it computes plain (chunked) exact attention.
 
-    Use under jit with S sharded over ``axis``; on a 1-wide axis it computes
-    plain exact attention.
+    Score memory is O(block_q · block_k) per device regardless of S —
+    the local block runs the same online-softmax recurrence chunked — so
+    context length is bounded by the O(S/R · D) q/k/v + accumulator
+    footprint, not by an (S/R)² buffer. Causal runs skip ring steps whose
+    source block lies entirely in the future.
     """
     mesh = mesh or get_mesh()
     R = int(mesh.shape.get(axis, 1))
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     if R == 1:
-        # single block: one online step == exact attention
         b, s_, h, _ = q.shape
-        pos = jnp.arange(s_)
+        bq, bk = _pick_chunk(s_, block_q), _pick_chunk(s_, block_k)
         acc = jnp.zeros((b, h, s_, d), jnp.float32)
         m = jnp.full((b, h, s_), -jnp.inf, jnp.float32)
         l = jnp.zeros((b, h, s_), jnp.float32)
-        acc, m, l = _online_block(q, k, v, acc, m, l, pos, pos, causal, scale)
+        acc, m, l = _local_attend(q, k, v, (acc, m, l), 0, 0, causal,
+                                  scale, bq, bk)
         out = acc / jnp.maximum(l[..., None], 1e-30)
         return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
     def worker(q, k, v):
         r = lax.axis_index(axis)
         b, sq, h, _ = q.shape  # local seq block
-        qpos = r * sq + jnp.arange(sq)
+        bq, bk = _pick_chunk(sq, block_q), _pick_chunk(sq, block_k)
         perm = [(i, (i + 1) % R) for i in range(R)]  # rotate kv around ring
 
         acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
@@ -95,9 +152,18 @@ def ring_attention(q, k, v, causal: bool = False,
             acc, m, l, kb, vb = carry
             # block i holds rank (r - i) mod R's kv
             src = (r - i) % R
-            kpos = src * sq + jnp.arange(sq)
-            acc, m, l = _online_block(q, kb, vb, acc, m, l, qpos, kpos,
-                                      causal, scale)
+
+            def compute(state):
+                return _local_attend(q, kb, vb, state, r * sq, src * sq,
+                                     causal, scale, bq, bk)
+
+            if causal:
+                # a source strictly in the future contributes nothing:
+                # skip its whole chunked sweep (~half the ring FLOPs)
+                acc, m, l = lax.cond(src <= r, compute,
+                                     lambda st: st, (acc, m, l))
+            else:
+                acc, m, l = compute((acc, m, l))
             kb = lax.ppermute(kb, axis, perm)
             vb = lax.ppermute(vb, axis, perm)
             return (acc, m, l, kb, vb), None

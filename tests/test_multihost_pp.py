@@ -82,9 +82,16 @@ WORKER = textwrap.dedent("""
     ids = drng.randint(0, 64, (4, 16)).astype(np.int32)
     labels = drng.randint(0, 64, (4, 16)).astype(np.int32)
 
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_sharded import (
+        build_sharded_1f1b_resid_grad_fn)
+    from paddle_tpu.models.llama_residual import make_body_fwd_bwd
+
     first, body, last = llama_pp_fns(cfg, remat=False)
     gf = build_sharded_1f1b_grad_fn(first, body, last, accumulate_steps=2,
                                     mesh=mesh)
+    body_fwd, body_bwd = make_body_fwd_bwd(cfg)
+    gf_resid = build_sharded_1f1b_resid_grad_fn(
+        first, body_fwd, body_bwd, last, accumulate_steps=2, mesh=mesh)
     blocks = blocks_from_stacked(stacked, 2, 1)
     # global arrays across BOTH processes: stage dim sharded over pp
     sh = NamedSharding(mesh, P("pp"))
@@ -94,11 +101,17 @@ WORKER = textwrap.dedent("""
     blocks = {{k: to_global(v) for k, v in blocks.items()}}
     loss, (gb, ge) = jax.jit(gf)(blocks, rest, ids, labels)
     loss = float(loss)
+    # the residual-stashing schedule must agree ACROSS the same two
+    # processes (activations + cotangents + stashed residuals all ride
+    # gloo ppermutes)
+    loss_r, _ = jax.jit(gf_resid)(blocks, rest, ids, labels)
+    loss_r = float(loss_r)
 
     # serial single-process reference (computed in-process, full model)
     ref = float(build_loss_fn(cfg, remat=False)(
         {{k: np.asarray(v) for k, v in stacked.items()}}, rest, ids, labels))
-    print(json.dumps({{"rank": rank, "loss": loss, "ref": ref}}))
+    print(json.dumps({{"rank": rank, "loss": loss, "loss_resid": loss_r,
+                       "ref": ref}}))
 """)
 
 
@@ -140,6 +153,10 @@ class TestCrossProcessPipeline:
         for o in outs:
             np.testing.assert_allclose(o["loss"], o["ref"], rtol=2e-4,
                                        atol=2e-5)
+            np.testing.assert_allclose(o["loss_resid"], o["ref"],
+                                       rtol=2e-4, atol=2e-5)
         # both ranks computed the SAME global loss
         np.testing.assert_allclose(outs[0]["loss"], outs[1]["loss"],
                                    rtol=1e-6)
+        np.testing.assert_allclose(outs[0]["loss_resid"],
+                                   outs[1]["loss_resid"], rtol=1e-6)
