@@ -1,0 +1,37 @@
+#!/bin/bash
+# First-TPU-session runbook (VERDICT r3 #1/#8, PERF.md attack plan) —
+# run the moment the tunnel is up. Order matters:
+#   1. flash parity ON-CHIP (the diagonal-block specialization is
+#      default-on but has only ever run in interpret mode — Weak #2)
+#   2. the round-record bench
+#   3. kernel/layout experiments that decide flags
+#   4. autotune sweep persisted in-repo
+#   5. the bigger configs
+# Every step appends to experiments/tpu_session.log; steps are
+# independent — a failure moves on (the log is the evidence either way).
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_session.log
+run() {
+  echo "=== $(date -u +%FT%TZ) $*" | tee -a "$LOG"
+  timeout "${STEP_TIMEOUT:-2400}" "$@" 2>&1 | tee -a "$LOG"
+  echo "=== rc=$? ===" | tee -a "$LOG"
+}
+
+# 1. kernel parity on real hardware (conftest escape hatch)
+run env PADDLE_TPU_TESTS_ON_DEVICE=1 python -m pytest \
+    tests/test_flash_attention.py tests/test_flash_hb.py \
+    tests/test_pallas_kernels.py -q -p no:cacheprovider
+# 2. round record
+run python bench.py
+# 3. flag-deciding experiments
+run python experiments/exp_flash_hb.py     # FLAGS_flash_head_batched
+run python experiments/exp_dots.py         # scan_unroll default
+# 4. autotune sweep -> .autotune_cache.json (commit it)
+run python experiments/exp_autotune_sweep.py
+# 5. bigger configs
+run python bench.py 1.3b
+run python bench.py ragged
+run python bench.py decode
+echo "=== session done; review $LOG, flip flags per PERF.md decision" \
+     "rules, re-run bench.py, commit .autotune_cache.json ===" | tee -a "$LOG"

@@ -3,12 +3,19 @@ import so multi-chip sharding tests run anywhere (driver parity: the judge's
 dryrun uses xla_force_host_platform_device_count the same way)."""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# PADDLE_TPU_TESTS_ON_DEVICE=1 runs the suite on the REAL accelerator
+# (experiments/tpu_session.sh uses it for on-chip kernel parity — the
+# default-on flash specializations must be re-validated on hardware,
+# where Mosaic lowering differs from interpret mode)
+_ON_DEVICE = bool(os.environ.get("PADDLE_TPU_TESTS_ON_DEVICE"))
+
+if not _ON_DEVICE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 # keep compile cache warm between tests
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 # numerical-parity tests want f32 accumulation; benchmarks use the hardware
@@ -20,7 +27,8 @@ import jax  # noqa: E402
 # The environment's sitecustomize may force jax_platforms="axon,cpu" (real
 # TPU tunnel) at interpreter start — env vars alone cannot override it, so
 # pin CPU via the config API after import.
-jax.config.update("jax_platforms", "cpu")
+if not _ON_DEVICE:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np  # noqa: E402
