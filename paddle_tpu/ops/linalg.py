@@ -100,6 +100,12 @@ def norm(x, p=None, axis=None, keepdim=False, name=None):
     ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
 
     def impl(v):
+        if isinstance(ax, tuple) and len(ax) == 2 and p not in (None, 0):
+            # MATRIX norm over the axis pair: induced/Schatten semantics
+            # (reference p_matrix_norm — p=±1 column sums, ±inf row sums,
+            # 2 spectral, 'fro'/'nuc' Schatten), NOT an elementwise
+            # reduction over both axes
+            return jnp.linalg.norm(v, ord=p, axis=ax, keepdims=keepdim)
         if p is None or p == "fro":
             if ax is None:
                 return jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2)).astype(v.dtype)

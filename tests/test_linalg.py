@@ -1,0 +1,267 @@
+"""paddle.linalg behavior-depth parity vs numpy (reference:
+python/paddle/tensor/linalg.py + test/legacy_test/test_linalg_*).
+
+Decomposition contracts (reconstruction, orthogonality), solver
+residuals, norm order/axis/keepdim matrix, batched forms, and AD
+spot-checks — the same depth-over-smoke treatment tests/test_fft.py
+gives the fft module.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+RTOL, ATOL = 2e-4, 2e-4
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+def _np(x):
+    return np.asarray(x.value if hasattr(x, "value") else x)
+
+
+def rand(*shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype(
+        np.float32)
+
+
+def spd(n, seed=0):
+    a = rand(n, n, seed=seed)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+class TestNorms:
+    @pytest.mark.parametrize("p", [0, 1, 2, np.inf, -np.inf, 3.5])
+    def test_vector_norm_orders(self, p):
+        x = rand(6, seed=1)
+        got = _np(paddle.linalg.norm(_t(x), p=p))
+        np.testing.assert_allclose(got, np.linalg.norm(x, ord=p),
+                                   rtol=RTOL, atol=ATOL)
+
+    @pytest.mark.parametrize("axis,keepdim", [(0, False), (1, True),
+                                              (-1, False)])
+    def test_vector_norm_axis(self, axis, keepdim):
+        x = rand(4, 5, seed=2)
+        got = _np(paddle.linalg.norm(_t(x), p=2, axis=axis,
+                                     keepdim=keepdim))
+        want = np.linalg.norm(x, axis=axis, keepdims=keepdim)
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    @pytest.mark.parametrize("p", ["fro", 1, np.inf])
+    def test_matrix_norms(self, p):
+        x = rand(4, 5, seed=3)
+        got = _np(paddle.linalg.norm(_t(x), p=p, axis=(-2, -1)))
+        np.testing.assert_allclose(got, np.linalg.norm(x, ord=p),
+                                   rtol=RTOL, atol=ATOL)
+
+
+class TestDecompositions:
+    def test_svd_reconstruction_and_modes(self):
+        x = rand(5, 3, seed=4)
+        for full in (False, True):
+            u, s, vh = (paddle.linalg.svd(_t(x), full_matrices=full))
+            u, s, vh = _np(u), _np(s), _np(vh)
+            k = 3
+            rec = (u[:, :k] * s) @ vh[:k] if full else (u * s) @ vh
+            np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-3)
+            np.testing.assert_allclose(
+                s, np.linalg.svd(x, compute_uv=False), rtol=1e-4,
+                atol=1e-4)
+
+    def test_qr_modes(self):
+        x = rand(5, 3, seed=5)
+        q, r = paddle.linalg.qr(_t(x), mode="reduced")
+        q, r = _np(q), _np(r)
+        assert q.shape == (5, 3) and r.shape == (3, 3)
+        np.testing.assert_allclose(q @ r, x, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(q.T @ q, np.eye(3), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(r, np.triu(r), rtol=1e-5, atol=1e-5)
+        q2, r2 = paddle.linalg.qr(_t(x), mode="complete")
+        assert _np(q2).shape == (5, 5) and _np(r2).shape == (5, 3)
+        np.testing.assert_allclose(_np(q2) @ _np(r2), x, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_eigh_symmetric(self):
+        a = spd(4, seed=6)
+        w, v = paddle.linalg.eigh(_t(a))
+        w, v = _np(w), _np(v)
+        np.testing.assert_allclose(a @ v, v * w, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.sort(w),
+                                   np.sort(np.linalg.eigvalsh(a)),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            _np(paddle.linalg.eigvalsh(_t(a))), w, rtol=1e-4, atol=1e-4)
+
+    def test_eig_general(self):
+        a = rand(4, 4, seed=7)
+        w, v = paddle.linalg.eig(_t(a))
+        w, v = _np(w), _np(v)
+        np.testing.assert_allclose(a.astype(np.complex64) @ v, v * w,
+                                   rtol=1e-3, atol=1e-3)
+        got = np.sort_complex(_np(paddle.linalg.eigvals(_t(a))))
+        np.testing.assert_allclose(got, np.sort_complex(np.linalg.eigvals(a)),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_cholesky_and_solve(self):
+        a = spd(4, seed=8)
+        b = rand(4, 2, seed=9)
+        lo = _np(paddle.linalg.cholesky(_t(a), upper=False))
+        np.testing.assert_allclose(lo @ lo.T, a, rtol=1e-3, atol=1e-3)
+        up = _np(paddle.linalg.cholesky(_t(a), upper=True))
+        np.testing.assert_allclose(up.T @ up, a, rtol=1e-3, atol=1e-3)
+        x = _np(paddle.linalg.cholesky_solve(_t(b), _t(lo), upper=False))
+        np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-3)
+
+    def test_lu_unpack_reconstructs(self):
+        a = rand(4, 4, seed=10)
+        lu_t, piv, _ = paddle.linalg.lu(_t(a), get_infos=True)
+        p, l, u = paddle.linalg.lu_unpack(lu_t, piv)
+        rec = _np(p) @ _np(l) @ _np(u)
+        np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+
+    def test_householder_product_matches_qr_q(self):
+        a = rand(5, 3, seed=11)
+        # LAPACK geqrf gives the elementary-reflector form directly
+        import scipy.linalg as sla
+
+        (h, tau), _ = sla.qr(a, mode="raw")
+        got = _np(paddle.linalg.householder_product(
+            _t(np.ascontiguousarray(h).astype(np.float32)),
+            _t(tau.astype(np.float32))))
+        q_ref = sla.qr(a, mode="economic")[0]
+        # columns are unique up to sign
+        np.testing.assert_allclose(np.abs(got), np.abs(q_ref), rtol=1e-3,
+                                   atol=1e-3)
+
+
+class TestSolvers:
+    def test_solve_batched(self):
+        a = np.stack([spd(3, seed=s) for s in (12, 13)])
+        b = rand(2, 3, 2, seed=14)
+        x = _np(paddle.linalg.solve(_t(a), _t(b)))
+        np.testing.assert_allclose(np.einsum("bij,bjk->bik", a, x), b,
+                                   rtol=1e-3, atol=1e-3)
+
+    @pytest.mark.parametrize("upper,transpose", [(True, False),
+                                                 (False, False),
+                                                 (True, True)])
+    def test_triangular_solve(self, upper, transpose):
+        a = spd(4, seed=15)
+        tri = np.triu(a) if upper else np.tril(a)
+        b = rand(4, 2, seed=16)
+        x = _np(paddle.linalg.triangular_solve(
+            _t(tri), _t(b), upper=upper, transpose=transpose))
+        m = tri.T if transpose else tri
+        np.testing.assert_allclose(m @ x, b, rtol=1e-3, atol=1e-3)
+
+    def test_lstsq_overdetermined(self):
+        a = rand(6, 3, seed=17)
+        b = rand(6, 2, seed=18)
+        sol = paddle.linalg.lstsq(_t(a), _t(b))
+        x = _np(sol[0])
+        want = np.linalg.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(x, want, rtol=1e-3, atol=1e-3)
+
+    def test_pinv_properties(self):
+        a = rand(4, 3, seed=19)
+        p = _np(paddle.linalg.pinv(_t(a)))
+        np.testing.assert_allclose(a @ p @ a, a, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(p, np.linalg.pinv(a), rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_inv_det_slogdet_batched(self):
+        a = np.stack([spd(3, seed=s) for s in (20, 21)])
+        np.testing.assert_allclose(_np(paddle.linalg.inv(_t(a))),
+                                   np.linalg.inv(a), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(_np(paddle.linalg.det(_t(a))),
+                                   np.linalg.det(a), rtol=1e-3, atol=1e-1)
+        sign, logdet = paddle.linalg.slogdet(_t(a))
+        s_ref, l_ref = np.linalg.slogdet(a)
+        np.testing.assert_allclose(_np(sign), s_ref, rtol=1e-5)
+        np.testing.assert_allclose(_np(logdet), l_ref, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_matrix_power_negative(self):
+        a = spd(3, seed=22)
+        np.testing.assert_allclose(
+            _np(paddle.linalg.matrix_power(_t(a), -2)),
+            np.linalg.matrix_power(a, -2), rtol=1e-2, atol=1e-2)
+
+    def test_matrix_rank_tol(self):
+        a = rand(5, 3, seed=23)
+        lowrank = a[:, :2] @ rand(2, 3, seed=24)   # rank 2
+        assert int(_np(paddle.linalg.matrix_rank(_t(lowrank)))) == 2
+
+    def test_cond_orders(self):
+        a = spd(4, seed=25)
+        for p in (None, 2, "fro"):
+            got = float(_np(paddle.linalg.cond(_t(a), p=p)))
+            want = float(np.linalg.cond(a, p=2 if p is None else p))
+            np.testing.assert_allclose(got, want, rtol=1e-3)
+
+
+class TestProductsAndStats:
+    def test_multi_dot_matches_chain(self):
+        ms = [rand(4, 5, seed=26), rand(5, 2, seed=27), rand(2, 6, seed=28)]
+        got = _np(paddle.linalg.multi_dot([_t(m) for m in ms]))
+        np.testing.assert_allclose(got, ms[0] @ ms[1] @ ms[2], rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_cov_corrcoef(self):
+        x = rand(3, 20, seed=29)
+        np.testing.assert_allclose(_np(paddle.linalg.cov(_t(x))),
+                                   np.cov(x), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(_np(paddle.linalg.corrcoef(_t(x))),
+                                   np.corrcoef(x), rtol=1e-3, atol=1e-3)
+
+    def test_cdist(self):
+        from scipy.spatial.distance import cdist as scdist
+
+        a, b = rand(4, 3, seed=30), rand(5, 3, seed=31)
+        np.testing.assert_allclose(_np(paddle.linalg.cdist(_t(a), _t(b))),
+                                   scdist(a, b), rtol=1e-3, atol=1e-3)
+
+    def test_histogram_bincount(self):
+        x = np.array([0, 1, 1, 3, 2, 1], np.int64)
+        np.testing.assert_array_equal(
+            _np(paddle.linalg.bincount(_t(x))), np.bincount(x))
+        h = _np(paddle.linalg.histogram(_t(x.astype(np.float32)), bins=4,
+                                        min=0, max=4))
+        np.testing.assert_array_equal(h, np.histogram(
+            x, bins=4, range=(0, 4))[0])
+
+
+class TestGrads:
+    def test_det_grad_is_det_times_invT(self):
+        a = spd(3, seed=32)
+        g = jax.grad(lambda m: jnp.linalg.det(m))(jnp.asarray(a))
+        want = np.linalg.det(a) * np.linalg.inv(a).T
+        np.testing.assert_allclose(np.asarray(g), want, rtol=1e-3,
+                                   atol=1e-2)
+
+    def test_solve_grad_numeric(self):
+        a = spd(3, seed=33)
+        b = rand(3, seed=34)
+
+        def f(bv):
+            x = paddle.linalg.solve(_t(a), paddle.to_tensor(bv))
+            return (x * x).sum()
+
+        bt = paddle.to_tensor(b)
+        bt.stop_gradient = False
+        x = paddle.linalg.solve(_t(a), bt)
+        (x * x).sum().backward()
+        g = _np(bt.grad)
+        eps, num = 1e-3, np.zeros_like(b)
+        for i in range(3):
+            bp, bm = b.copy(), b.copy()
+            bp[i] += eps
+            bm[i] -= eps
+            num[i] = (float(_np(f(bp))) - float(_np(f(bm)))) / (2 * eps)
+        np.testing.assert_allclose(g, num, rtol=5e-2, atol=5e-2)
