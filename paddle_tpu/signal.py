@@ -84,8 +84,18 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         wsum = wsum.at[idx].add((w * w)[None, :].repeat(nf, 0))
         out = out / jnp.maximum(wsum, 1e-11)[None]
         if center:
-            out = out[:, n_fft // 2: t_len - n_fft // 2]
+            # with an explicit length, only the LEFT half-window is
+            # trimmed — the right-edge samples (reconstructed from the
+            # centering pad) satisfy the requested length (the
+            # reference/torch contract); without it both halves go
+            if length is not None:
+                out = out[:, n_fft // 2:]
+            else:
+                out = out[:, n_fft // 2: t_len - n_fft // 2]
         if length is not None:
+            if out.shape[-1] < length:  # trimmed OR zero-padded exactly
+                out = jnp.pad(out, ((0, 0),
+                                    (0, length - out.shape[-1])))
             out = out[:, :length]
         return out[0] if squeeze else out
 
