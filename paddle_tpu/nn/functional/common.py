@@ -248,40 +248,75 @@ def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
         else:
             out_shape = (v.shape[0], v.shape[1], *out_sp)
         if mode == "nearest":
-            return jax.image.resize(v, out_shape, method="nearest")
+            # paddle/torch nearest: src = floor(i * in/out) — NOT the
+            # rounded half-pixel centers jax.image.resize uses
+            return _resize_gather(v, out_shape, "nearest", False,
+                                  channel_last)
+        if mode == "bicubic":
+            # torch/paddle bicubic kernel is Keys a=-0.75; jax's cubic is
+            # a=-0.5 — must be explicit for parity, both align modes
+            return _resize_gather(v, out_shape, "cubic", align_corners,
+                                  channel_last)
         if align_corners:
-            # jax.image.resize has no align_corners; emulate with explicit gather
-            return _resize_align_corners(v, out_shape, jax_method, channel_last)
-        return jax.image.resize(v, out_shape, method=jax_method)
+            return _resize_gather(v, out_shape, "linear", True,
+                                  channel_last)
+        # torch/paddle do NOT antialias on downsample; jax defaults to True
+        return jax.image.resize(v, out_shape, method=jax_method,
+                                antialias=False)
 
     return apply_op(f, x, op_name="interpolate")
 
 
-def _resize_align_corners(v, out_shape, method, channel_last):
-    import numpy as np
+def _cubic_weight(t, a=-0.75):
+    """Keys cubic kernel with a=-0.75 (the torch/paddle/OpenCV choice)."""
+    at = jnp.abs(t)
+    return jnp.where(
+        at <= 1.0, (a + 2.0) * at ** 3 - (a + 3.0) * at ** 2 + 1.0,
+        jnp.where(at < 2.0,
+                  a * at ** 3 - 5.0 * a * at ** 2 + 8.0 * a * at - 4.0 * a,
+                  0.0))
 
+
+def _resize_gather(v, out_shape, kind, align_corners, channel_last):
+    """Separable explicit-gather resize along every spatial axis.
+
+    kind: 'nearest' (floor source), 'linear' (2 taps), 'cubic' (4 taps,
+    a=-0.75). Source coordinates: align_corners maps corners to corners;
+    otherwise half-pixel centers src = (i + 0.5)·in/out − 0.5."""
     if channel_last:
-        in_sp = v.shape[1:-1]
-        out_sp = out_shape[1:-1]
+        in_sp, out_sp = v.shape[1:-1], out_shape[1:-1]
         sp_axes = list(range(1, v.ndim - 1))
     else:
-        in_sp = v.shape[2:]
-        out_sp = out_shape[2:]
+        in_sp, out_sp = v.shape[2:], out_shape[2:]
         sp_axes = list(range(2, v.ndim))
     out = v
     for ax, insz, outsz in zip(sp_axes, in_sp, out_sp):
-        if outsz == 1 or insz == 1:
-            idx = jnp.zeros((outsz,), jnp.float32)
+        i = jnp.arange(outsz, dtype=jnp.float32)
+        if kind == "nearest":
+            src = jnp.floor(i * (insz / outsz)).astype(jnp.int32)
+            out = jnp.take(out, jnp.clip(src, 0, insz - 1), axis=ax)
+            continue
+        if align_corners:
+            src = (i * (insz - 1) / (outsz - 1) if outsz > 1
+                   else jnp.zeros_like(i))
         else:
-            idx = jnp.arange(outsz, dtype=jnp.float32) * (insz - 1) / (outsz - 1)
-        lo = jnp.floor(idx).astype(jnp.int32)
-        hi = jnp.clip(lo + 1, 0, insz - 1)
-        w = (idx - lo).astype(v.dtype)
-        shape = [1] * out.ndim
-        shape[ax] = outsz
-        w = w.reshape(shape)
-        out = (jnp.take(out, lo, axis=ax) * (1 - w)
-               + jnp.take(out, hi, axis=ax) * w)
+            src = (i + 0.5) * (insz / outsz) - 0.5
+        base = jnp.floor(src)
+        frac = src - base
+        taps = (0, 1) if kind == "linear" else (-1, 0, 1, 2)
+        acc = None
+        wsh = [1] * out.ndim
+        wsh[ax] = outsz
+        for k in taps:
+            idx = jnp.clip(base.astype(jnp.int32) + k, 0, insz - 1)
+            if kind == "linear":
+                w = (1.0 - frac) if k == 0 else frac
+            else:
+                w = _cubic_weight(frac - k)
+            term = jnp.take(out, idx, axis=ax) * w.reshape(wsh).astype(
+                v.dtype)
+            acc = term if acc is None else acc + term
+        out = acc
     return out
 
 

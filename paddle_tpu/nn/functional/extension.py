@@ -129,6 +129,26 @@ def grid_sample(x, grid, mode: str = "bilinear", padding_mode: str = "zeros",
             fx = ((gx + 1) * w - 1) / 2
             fy = ((gy + 1) * h - 1) / 2
 
+        if padding_mode == "reflection":
+            # fold the FLOAT coordinate back into range before any tap
+            # math (torch reflect_coordinates): align_corners reflects
+            # about the corner centers [0, size-1]; otherwise about the
+            # half-pixel borders [-0.5, size-0.5]
+            def reflect(coord, size):
+                if size == 1:
+                    return jnp.zeros_like(coord)
+                if align_corners:
+                    m = 2.0 * (size - 1)
+                    t = jnp.mod(jnp.abs(coord), m)
+                    return jnp.where(t > size - 1, m - t, t)
+                m = 2.0 * size
+                t = jnp.mod(jnp.abs(coord + 0.5), m)
+                t = jnp.where(t > size, m - t, t)
+                return jnp.clip(t - 0.5, 0.0, size - 1.0)
+
+            fx = reflect(fx, w)
+            fy = reflect(fy, h)
+
         def sample(ix, iy):
             valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
             cx = jnp.clip(ix, 0, w - 1)

@@ -1,0 +1,139 @@
+"""nn.functional geometry/resampling ops vs torch — the classic
+convention bug nests (align_corners, padding modes, NCHW layouts,
+normalized grids). torch.nn.functional is an independent implementation
+of the same reference semantics (paddle mirrors torch here), so
+disagreement means a real convention bug.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+F = paddle.nn.functional
+RTOL, ATOL = 1e-3, 1e-3
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+def _np(x):
+    return np.asarray(x.value if hasattr(x, "value") else x)
+
+
+def rand(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype(np.float32)
+
+
+class TestInterpolate:
+    @pytest.mark.parametrize("mode,align", [
+        ("nearest", False),
+        ("bilinear", False), ("bilinear", True),
+        ("bicubic", False), ("bicubic", True),
+    ])
+    def test_upsample_2d_modes(self, mode, align):
+        x = rand(2, 3, 5, 7, seed=1)
+        kw = {} if mode == "nearest" else {"align_corners": align}
+        got = _np(F.interpolate(_t(x), size=(10, 13), mode=mode, **kw))
+        want = TF.interpolate(torch.from_numpy(x), size=(10, 13),
+                              mode=mode, **kw).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL,
+                                   err_msg=f"{mode} align={align}")
+
+    @pytest.mark.parametrize("align", [False, True])
+    def test_downsample_bilinear(self, align):
+        x = rand(1, 2, 12, 16, seed=2)
+        got = _np(F.interpolate(_t(x), size=(5, 7), mode="bilinear",
+                                align_corners=align))
+        want = TF.interpolate(torch.from_numpy(x), size=(5, 7),
+                              mode="bilinear", align_corners=align).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_scale_factor(self):
+        x = rand(1, 2, 6, 6, seed=3)
+        got = _np(F.interpolate(_t(x), scale_factor=2.0, mode="nearest"))
+        want = TF.interpolate(torch.from_numpy(x),
+                              scale_factor=2.0, mode="nearest").numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_linear_1d_and_trilinear_3d(self):
+        x1 = rand(2, 3, 9, seed=4)
+        got = _np(F.interpolate(_t(x1), size=(15,), mode="linear",
+                                align_corners=True))
+        want = TF.interpolate(torch.from_numpy(x1), size=15,
+                              mode="linear", align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+        x3 = rand(1, 2, 4, 5, 6, seed=5)
+        got = _np(F.interpolate(_t(x3), size=(8, 7, 9), mode="trilinear",
+                                align_corners=False))
+        want = TF.interpolate(torch.from_numpy(x3), size=(8, 7, 9),
+                              mode="trilinear",
+                              align_corners=False).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    @pytest.mark.parametrize("align", [False, True])
+    def test_grid_sample_full_matrix(self, mode, pad, align):
+        x = rand(2, 3, 6, 7, seed=6)
+        grid = (np.random.RandomState(7).rand(2, 5, 4, 2).astype(
+            np.float32) * 2.4 - 1.2)       # includes out-of-bounds
+        got = _np(F.grid_sample(_t(x), _t(grid), mode=mode,
+                                padding_mode=pad, align_corners=align))
+        want = TF.grid_sample(torch.from_numpy(x),
+                              torch.from_numpy(grid), mode=mode,
+                              padding_mode=pad,
+                              align_corners=align).numpy()
+        np.testing.assert_allclose(
+            got, want, rtol=RTOL, atol=ATOL,
+            err_msg=f"{mode}/{pad}/align={align}")
+
+    def test_affine_grid_matches_torch(self):
+        theta = np.array([[[0.8, 0.1, 0.2], [-0.1, 0.9, -0.3]]],
+                         np.float32)
+        for align in (False, True):
+            got = _np(F.affine_grid(_t(theta), [1, 3, 5, 6],
+                                    align_corners=align))
+            want = TF.affine_grid(torch.from_numpy(theta), [1, 3, 5, 6],
+                                  align_corners=align).numpy()
+            np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL,
+                                       err_msg=f"align={align}")
+
+
+class TestPadAndShuffle:
+    @pytest.mark.parametrize("mode", ["reflect", "replicate", "circular"])
+    def test_pad_modes_4d(self, mode):
+        x = rand(2, 3, 5, 6, seed=8)
+        pads = [1, 2, 2, 1]
+        got = _np(F.pad(_t(x), pads, mode=mode))
+        want = TF.pad(torch.from_numpy(x), pads, mode=mode).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_pad_constant_value(self):
+        x = rand(2, 3, 4, 4, seed=9)
+        got = _np(F.pad(_t(x), [1, 1, 2, 0], mode="constant", value=3.5))
+        want = TF.pad(torch.from_numpy(x), [1, 1, 2, 0],
+                      mode="constant", value=3.5).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_pixel_shuffle_roundtrip_and_torch(self):
+        x = rand(2, 8, 3, 4, seed=10)
+        got = _np(F.pixel_shuffle(_t(x), 2))
+        want = TF.pixel_shuffle(torch.from_numpy(x), 2).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+        back = _np(F.pixel_unshuffle(_t(got), 2))
+        np.testing.assert_allclose(back, x, rtol=RTOL, atol=ATOL)
+
+    def test_unfold_fold_roundtrip(self):
+        x = rand(1, 2, 6, 6, seed=11)
+        u = F.unfold(_t(x), kernel_sizes=3, strides=3)
+        want_u = TF.unfold(torch.from_numpy(x), 3, stride=3).numpy()
+        np.testing.assert_allclose(_np(u), want_u, rtol=RTOL, atol=ATOL)
+        back = _np(F.fold(u, output_sizes=[6, 6], kernel_sizes=3,
+                          strides=3))
+        np.testing.assert_allclose(back, x, rtol=RTOL, atol=ATOL)
