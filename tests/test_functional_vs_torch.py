@@ -137,3 +137,85 @@ class TestPadAndShuffle:
         back = _np(F.fold(u, output_sizes=[6, 6], kernel_sizes=3,
                           strides=3))
         np.testing.assert_allclose(back, x, rtol=RTOL, atol=ATOL)
+
+
+class TestPooling:
+    @pytest.mark.parametrize("ceil", [False, True])
+    def test_max_pool2d_ceil_mode(self, ceil):
+        x = rand(2, 3, 7, 9, seed=12)
+        got = _np(F.max_pool2d(_t(x), kernel_size=3, stride=2,
+                               padding=1, ceil_mode=ceil))
+        want = TF.max_pool2d(torch.from_numpy(x), 3, stride=2, padding=1,
+                             ceil_mode=ceil).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    @pytest.mark.parametrize("exclusive", [True, False])
+    def test_avg_pool2d_count_include_pad(self, exclusive):
+        # paddle exclusive=True == torch count_include_pad=False
+        x = rand(1, 2, 6, 6, seed=13)
+        got = _np(F.avg_pool2d(_t(x), kernel_size=3, stride=2, padding=1,
+                               exclusive=exclusive))
+        want = TF.avg_pool2d(torch.from_numpy(x), 3, stride=2, padding=1,
+                             count_include_pad=not exclusive).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_adaptive_pools_uneven(self):
+        # 7 -> 3 forces uneven windows: the classic adaptive-pool bug
+        x = rand(2, 3, 7, 7, seed=14)
+        got = _np(F.adaptive_avg_pool2d(_t(x), output_size=3))
+        want = TF.adaptive_avg_pool2d(torch.from_numpy(x), 3).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+        got = _np(F.adaptive_max_pool2d(_t(x), output_size=3))
+        want = TF.adaptive_max_pool2d(torch.from_numpy(x), 3).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_max_pool1d_3d(self):
+        x1 = rand(2, 3, 11, seed=15)
+        got = _np(F.max_pool1d(_t(x1), kernel_size=2, stride=2))
+        want = TF.max_pool1d(torch.from_numpy(x1), 2, stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+        x3 = rand(1, 2, 4, 6, 6, seed=16)
+        got = _np(F.max_pool3d(_t(x3), kernel_size=2, stride=2))
+        want = TF.max_pool3d(torch.from_numpy(x3), 2, stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+class TestConvs:
+    @pytest.mark.parametrize("groups", [1, 2])
+    @pytest.mark.parametrize("dilation", [1, 2])
+    def test_conv2d_groups_dilation(self, groups, dilation):
+        x = rand(2, 4, 9, 9, seed=17)
+        w = rand(6, 4 // groups, 3, 3, seed=18) * 0.2
+        b = rand(6, seed=19)
+        got = _np(F.conv2d(_t(x), _t(w), _t(b), stride=2, padding=2,
+                           dilation=dilation, groups=groups))
+        want = TF.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                         torch.from_numpy(b), stride=2, padding=2,
+                         dilation=dilation, groups=groups).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-3)
+
+    @pytest.mark.parametrize("output_padding", [0, 1])
+    def test_conv2d_transpose_output_padding(self, output_padding):
+        x = rand(1, 3, 5, 5, seed=20)
+        w = rand(3, 4, 3, 3, seed=21) * 0.2
+        got = _np(F.conv2d_transpose(_t(x), _t(w), stride=2, padding=1,
+                                     output_padding=output_padding))
+        want = TF.conv_transpose2d(torch.from_numpy(x),
+                                   torch.from_numpy(w), stride=2,
+                                   padding=1,
+                                   output_padding=output_padding).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-3)
+
+    def test_conv1d_and_3d(self):
+        x1 = rand(2, 3, 12, seed=22)
+        w1 = rand(5, 3, 4, seed=23) * 0.2
+        got = _np(F.conv1d(_t(x1), _t(w1), stride=2, padding=1))
+        want = TF.conv1d(torch.from_numpy(x1), torch.from_numpy(w1),
+                         stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-3)
+        x3 = rand(1, 2, 5, 6, 6, seed=24)
+        w3 = rand(4, 2, 3, 3, 3, seed=25) * 0.2
+        got = _np(F.conv3d(_t(x3), _t(w3), padding=1))
+        want = TF.conv3d(torch.from_numpy(x3), torch.from_numpy(w3),
+                         padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-3)
