@@ -346,7 +346,10 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
     lb_len = unwrap(label_lengths)
 
     def f(lp):
-        # lp: [T, B, C] log-probs (paddle layout: max_logit_length, batch, classes)
+        # lp: [T, B, C] UNNORMALIZED logits (paddle layout + contract:
+        # "softmax with CTC" — warpctc normalizes internally, reference
+        # loss.py:1770; torch by contrast takes log-probs)
+        lp = jax.nn.log_softmax(lp, axis=-1)
         T, B, C = lp.shape
         S = lbl.shape[1]
         ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)

@@ -219,3 +219,159 @@ class TestConvs:
         want = TF.conv3d(torch.from_numpy(x3), torch.from_numpy(w3),
                          padding=1).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-3)
+
+
+class TestNorms2:
+    def test_batch_norm_train_vs_eval(self):
+        x = rand(4, 3, 5, 5, seed=26)
+        w = rand(3, seed=27) * 0.5 + 1.0
+        b = rand(3, seed=28) * 0.1
+        rm = np.zeros(3, np.float32)
+        rv = np.ones(3, np.float32)
+        for training in (True, False):
+            got = _np(F.batch_norm(_t(x), _t(rm.copy()), _t(rv.copy()),
+                                   weight=_t(w), bias=_t(b),
+                                   training=training, momentum=0.9,
+                                   epsilon=1e-5))
+            want = TF.batch_norm(torch.from_numpy(x),
+                                 torch.from_numpy(rm.copy()),
+                                 torch.from_numpy(rv.copy()),
+                                 torch.from_numpy(w), torch.from_numpy(b),
+                                 training=training, momentum=0.1,
+                                 eps=1e-5).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3,
+                                       err_msg=f"training={training}")
+
+    def test_layer_group_instance_norm(self):
+        x = rand(2, 4, 6, 6, seed=29)
+        w4 = rand(4, seed=30) + 1.0
+        b4 = rand(4, seed=31) * 0.1
+        got = _np(F.group_norm(_t(x), num_groups=2, weight=_t(w4),
+                               bias=_t(b4), epsilon=1e-5))
+        want = TF.group_norm(torch.from_numpy(x), 2,
+                             torch.from_numpy(w4), torch.from_numpy(b4),
+                             eps=1e-5).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+        got = _np(F.instance_norm(_t(x), weight=_t(w4), bias=_t(b4),
+                                  eps=1e-5))
+        want = TF.instance_norm(torch.from_numpy(x),
+                                weight=torch.from_numpy(w4),
+                                bias=torch.from_numpy(b4),
+                                eps=1e-5).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_local_response_norm(self):
+        x = rand(2, 6, 5, 5, seed=32)
+        got = _np(F.local_response_norm(_t(x), size=3, alpha=1e-3,
+                                        beta=0.8, k=1.2))
+        want = TF.local_response_norm(torch.from_numpy(x), 3, alpha=1e-3,
+                                      beta=0.8, k=1.2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+class TestLosses:
+    def test_cross_entropy_label_smoothing_and_weights(self):
+        logits = rand(6, 5, seed=33)
+        labels = np.array([0, 2, 4, 1, 3, 2], np.int64)
+        w = (np.abs(rand(5, seed=34)) + 0.5).astype(np.float32)
+        got = _np(F.cross_entropy(_t(logits), _t(labels), weight=_t(w),
+                                  reduction="mean"))
+        want = TF.cross_entropy(torch.from_numpy(logits),
+                                torch.from_numpy(labels),
+                                weight=torch.from_numpy(w)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+        # soft labels
+        soft = np.abs(rand(6, 5, seed=35)).astype(np.float32)
+        soft /= soft.sum(1, keepdims=True)
+        got = _np(F.cross_entropy(_t(logits), _t(soft), soft_label=True))
+        want = TF.cross_entropy(torch.from_numpy(logits),
+                                torch.from_numpy(soft)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_kl_div_reductions(self, reduction):
+        p = np.abs(rand(4, 5, seed=36)) + 0.1
+        p /= p.sum(1, keepdims=True)
+        q = np.abs(rand(4, 5, seed=37)) + 0.1
+        q /= q.sum(1, keepdims=True)
+        logq = np.log(q).astype(np.float32)
+        got = _np(F.kl_div(_t(logq), _t(p.astype(np.float32)),
+                           reduction=reduction))
+        want = TF.kl_div(torch.from_numpy(logq), torch.from_numpy(
+            p.astype(np.float32)), reduction=reduction).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_ctc_loss_matches_torch(self):
+        T, B, C = 12, 2, 5           # time, batch, classes (0 = blank)
+        logits = rand(T, B, C, seed=38)
+        logp = torch.from_numpy(logits).log_softmax(-1)
+        labels = np.array([[1, 2, 3], [2, 4, 0]], np.int64)
+        in_lens = np.array([12, 10], np.int64)
+        lbl_lens = np.array([3, 2], np.int64)
+        want = TF.ctc_loss(logp, torch.from_numpy(labels),
+                           torch.from_numpy(in_lens),
+                           torch.from_numpy(lbl_lens), blank=0,
+                           reduction="none").numpy()
+        got = _np(F.ctc_loss(_t(logits), _t(labels), _t(in_lens),
+                             _t(lbl_lens), blank=0, reduction="none"))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_margin_and_bce(self):
+        a, b, c = rand(4, 6, seed=39), rand(4, 6, seed=40), rand(4, 6,
+                                                                 seed=41)
+        got = _np(F.triplet_margin_loss(_t(a), _t(b), _t(c), margin=0.5))
+        want = TF.triplet_margin_loss(torch.from_numpy(a),
+                                      torch.from_numpy(b),
+                                      torch.from_numpy(c),
+                                      margin=0.5).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+        logits = rand(5, 3, seed=42)
+        tgt = (np.abs(rand(5, 3, seed=43)) < 0.7).astype(np.float32)
+        got = _np(F.binary_cross_entropy_with_logits(_t(logits), _t(tgt)))
+        want = TF.binary_cross_entropy_with_logits(
+            torch.from_numpy(logits), torch.from_numpy(tgt)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_smooth_l1_huber_delta(self):
+        x, y = rand(6, seed=44), rand(6, seed=45)
+        got = _np(F.smooth_l1_loss(_t(x), _t(y), delta=2.0))
+        want = TF.huber_loss(torch.from_numpy(x), torch.from_numpy(y),
+                             delta=2.0).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+class TestActivationsEmbedding:
+    def test_gelu_exact_vs_tanh(self):
+        x = rand(100, seed=46) * 3
+        got = _np(F.gelu(_t(x), approximate=False))
+        want = TF.gelu(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+        got = _np(F.gelu(_t(x), approximate=True))
+        want = TF.gelu(torch.from_numpy(x), approximate="tanh").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_embedding_padding_idx_zero_vector(self):
+        # PADDLE semantics (reference input.py:155): the padding id's
+        # output is ALL-ZERO in forward — torch instead returns the row
+        # and only zeroes its gradient. Non-padding rows match torch.
+        w = rand(10, 4, seed=47)
+        ids = np.array([[1, 2, 3], [3, 2, 9]], np.int64)
+        got = _np(F.embedding(_t(ids), _t(w), padding_idx=2))
+        want = TF.embedding(torch.from_numpy(ids),
+                            torch.from_numpy(w)).numpy()
+        pad_mask = ids == 2
+        np.testing.assert_allclose(got[pad_mask], 0.0)
+        np.testing.assert_allclose(got[~pad_mask], want[~pad_mask],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_softmax_log_softmax_axis(self):
+        x = rand(3, 4, 5, seed=48)
+        for ax in (0, 1, -1):
+            np.testing.assert_allclose(
+                _np(F.softmax(_t(x), axis=ax)),
+                TF.softmax(torch.from_numpy(x), dim=ax).numpy(),
+                rtol=1e-3, atol=1e-3)
+            np.testing.assert_allclose(
+                _np(F.log_softmax(_t(x), axis=ax)),
+                TF.log_softmax(torch.from_numpy(x), dim=ax).numpy(),
+                rtol=1e-3, atol=1e-3)
