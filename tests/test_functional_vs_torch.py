@@ -375,3 +375,64 @@ class TestActivationsEmbedding:
                 _np(F.log_softmax(_t(x), axis=ax)),
                 TF.log_softmax(torch.from_numpy(x), dim=ax).numpy(),
                 rtol=1e-3, atol=1e-3)
+
+
+class TestRNNsVsTorch:
+    """LSTM/GRU/SimpleRNN numerics with identical weights — gate order
+    and bias-pair conventions are where ports silently diverge."""
+
+    def _copy_weights(self, pd_rnn, th_rnn):
+        """paddle 'rnns.{l}[.rnn_fw|.rnn_bw].cell.{kind}' maps onto torch
+        '{kind}_l{l}[_reverse]'."""
+        import torch as th
+
+        pd = {k: p for k, p in pd_rnn.named_parameters()}
+        for name, par in th_rnn.named_parameters():
+            kind, rest = name.split("_l", 1) if "_l" in name else (name, "")
+            layer = rest.split("_")[0]
+            rev = rest.endswith("_reverse")
+            mid = ".rnn_bw" if rev else (
+                ".rnn_fw" if any("rnn_fw" in k for k in pd) else "")
+            pd_name = f"rnns.{layer}{mid}.cell.{kind}"
+            assert pd_name in pd, (name, pd_name, list(pd))
+            v = _np(pd[pd_name])
+            with th.no_grad():
+                par.copy_(th.from_numpy(np.ascontiguousarray(v)))
+
+    @pytest.mark.parametrize("cls", ["LSTM", "GRU", "SimpleRNN"])
+    def test_single_layer_forward(self, cls):
+        B, T, I, H = 2, 5, 4, 3
+        x = rand(B, T, I, seed=50)
+        pd_rnn = getattr(paddle.nn, cls)(I, H)
+        th_cls = {"LSTM": torch.nn.LSTM, "GRU": torch.nn.GRU,
+                  "SimpleRNN": torch.nn.RNN}[cls]
+        th_rnn = th_cls(I, H, batch_first=True)
+        self._copy_weights(pd_rnn, th_rnn)
+        got, _ = pd_rnn(_t(x))
+        want, _ = th_rnn(torch.from_numpy(x))
+        np.testing.assert_allclose(_np(got), want.detach().numpy(),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_bidirectional_lstm(self):
+        B, T, I, H = 2, 6, 3, 4
+        x = rand(B, T, I, seed=51)
+        pd_rnn = paddle.nn.LSTM(I, H, direction="bidirect")
+        th_rnn = torch.nn.LSTM(I, H, batch_first=True, bidirectional=True)
+        self._copy_weights(pd_rnn, th_rnn)
+        got, _ = pd_rnn(_t(x))
+        want, _ = th_rnn(torch.from_numpy(x))
+        np.testing.assert_allclose(_np(got), want.detach().numpy(),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_two_layer_gru_states(self):
+        B, T, I, H = 2, 4, 3, 3
+        x = rand(B, T, I, seed=52)
+        pd_rnn = paddle.nn.GRU(I, H, num_layers=2)
+        th_rnn = torch.nn.GRU(I, H, num_layers=2, batch_first=True)
+        self._copy_weights(pd_rnn, th_rnn)
+        got, h = pd_rnn(_t(x))
+        want, h_t = th_rnn(torch.from_numpy(x))
+        np.testing.assert_allclose(_np(got), want.detach().numpy(),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(_np(h), h_t.detach().numpy(),
+                                   rtol=1e-3, atol=1e-3)
