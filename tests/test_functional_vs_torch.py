@@ -436,3 +436,66 @@ class TestRNNsVsTorch:
                                    rtol=1e-3, atol=1e-3)
         np.testing.assert_allclose(_np(h), h_t.detach().numpy(),
                                    rtol=1e-3, atol=1e-3)
+
+
+class TestAttentionVsTorch:
+    """MultiHeadAttention with identical in/out projection weights —
+    mask conventions and head splitting are the classic divergences.
+    NOTE paddle masks are ADDITIVE (or bool keep=True); torch attn_mask
+    bool means True=BLOCKED. The test covers both forms."""
+
+    def _mha_pair(self, E=8, H=2, seed=60):
+        import torch as th
+
+        pd = paddle.nn.MultiHeadAttention(E, H)
+        t = th.nn.MultiheadAttention(E, H, batch_first=True)
+        rng = np.random.RandomState(seed)
+        wq, wk, wv = (rng.randn(E, E).astype(np.float32) * 0.3
+                      for _ in range(3))
+        wo = rng.randn(E, E).astype(np.float32) * 0.3
+        bq, bk, bv, bo = (rng.randn(E).astype(np.float32) * 0.1
+                          for _ in range(4))
+        # paddle: per-proj Linear [in,out]; torch: packed [3E, E] (out,in)
+        for name, w, b in (("q_proj", wq, bq), ("k_proj", wk, bk),
+                           ("v_proj", wv, bv), ("out_proj", wo, bo)):
+            getattr(pd, name).weight.set_value(paddle.to_tensor(w))
+            getattr(pd, name).bias.set_value(paddle.to_tensor(b))
+        with th.no_grad():
+            t.in_proj_weight.copy_(th.from_numpy(
+                np.concatenate([wq.T, wk.T, wv.T], 0)))
+            t.in_proj_bias.copy_(th.from_numpy(
+                np.concatenate([bq, bk, bv], 0)))
+            t.out_proj.weight.copy_(th.from_numpy(wo.T))
+            t.out_proj.bias.copy_(th.from_numpy(bo))
+        return pd, t
+
+    def test_self_attention_no_mask(self):
+        pd, t = self._mha_pair()
+        x = rand(2, 5, 8, seed=61)
+        got = _np(pd(_t(x), _t(x), _t(x)))
+        want, _ = t(torch.from_numpy(x), torch.from_numpy(x),
+                    torch.from_numpy(x))
+        np.testing.assert_allclose(got, want.detach().numpy(), rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_additive_mask_matches_torch_float_mask(self):
+        pd, t = self._mha_pair(seed=62)
+        x = rand(2, 4, 8, seed=63)
+        # causal additive mask
+        m = np.triu(np.full((4, 4), -1e9, np.float32), k=1)
+        got = _np(pd(_t(x), _t(x), _t(x), attn_mask=_t(m)))
+        want, _ = t(torch.from_numpy(x), torch.from_numpy(x),
+                    torch.from_numpy(x),
+                    attn_mask=torch.from_numpy(m))
+        np.testing.assert_allclose(got, want.detach().numpy(), rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_cross_attention_different_kv_len(self):
+        pd, t = self._mha_pair(seed=64)
+        q = rand(2, 3, 8, seed=65)
+        kv = rand(2, 6, 8, seed=66)
+        got = _np(pd(_t(q), _t(kv), _t(kv)))
+        want, _ = t(torch.from_numpy(q), torch.from_numpy(kv),
+                    torch.from_numpy(kv))
+        np.testing.assert_allclose(got, want.detach().numpy(), rtol=1e-3,
+                                   atol=1e-3)
