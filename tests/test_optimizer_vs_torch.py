@@ -1,0 +1,89 @@
+"""Optimizer trajectory parity vs torch: identical params/grads/hparams
+must produce the same parameter sequences (update rules, bias
+correction, decoupled weight decay, epsilon placement are where
+optimizer ports silently drift).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+
+
+def run_paddle(opt_name, steps, lr=0.1, **kw):
+    w0 = np.linspace(-1, 1, 6).astype(np.float32).reshape(2, 3)
+    p = paddle.to_tensor(w0.copy())
+    p.stop_gradient = False
+    opt_cls = getattr(paddle.optimizer, opt_name)
+    opt = opt_cls(learning_rate=lr, parameters=[p], **kw)
+    traj = []
+    for i in range(steps):
+        loss = ((p * p) * (i + 1) * 0.1).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        traj.append(np.asarray(p.value).copy())
+    return traj
+
+
+def run_torch(opt_cls, steps, lr=0.1, **kw):
+    w0 = np.linspace(-1, 1, 6).astype(np.float32).reshape(2, 3)
+    p = torch.from_numpy(w0.copy()).requires_grad_(True)
+    opt = opt_cls([p], lr=lr, **kw)
+    traj = []
+    for i in range(steps):
+        opt.zero_grad()
+        loss = ((p * p) * (i + 1) * 0.1).sum()
+        loss.backward()
+        opt.step()
+        traj.append(p.detach().numpy().copy())
+    return traj
+
+
+def assert_traj(got, want, rtol=1e-4, atol=1e-5):
+    for i, (g, w) in enumerate(zip(got, want)):
+        np.testing.assert_allclose(g, w, rtol=rtol, atol=atol,
+                                   err_msg=f"step {i}")
+
+
+class TestTrajectories:
+    def test_sgd(self):
+        assert_traj(run_paddle("SGD", 5),
+                    run_torch(torch.optim.SGD, 5))
+
+    def test_momentum(self):
+        assert_traj(run_paddle("Momentum", 5, momentum=0.9),
+                    run_torch(torch.optim.SGD, 5, momentum=0.9))
+
+    def test_adam(self):
+        assert_traj(
+            run_paddle("Adam", 6, beta1=0.9, beta2=0.99, epsilon=1e-8),
+            run_torch(torch.optim.Adam, 6, betas=(0.9, 0.99), eps=1e-8))
+
+    def test_adamw_decoupled_decay(self):
+        assert_traj(
+            run_paddle("AdamW", 6, weight_decay=0.05),
+            run_torch(torch.optim.AdamW, 6, weight_decay=0.05))
+
+    def test_adagrad(self):
+        # paddle Adagrad default initial_accumulator_value=0 matches torch
+        assert_traj(
+            run_paddle("Adagrad", 5, epsilon=1e-10),
+            run_torch(torch.optim.Adagrad, 5, eps=1e-10))
+
+    def test_rmsprop(self):
+        assert_traj(
+            run_paddle("RMSProp", 5, rho=0.9, epsilon=1e-8),
+            run_torch(torch.optim.RMSprop, 5, alpha=0.9, eps=1e-8))
+
+    def test_adamax(self):
+        assert_traj(
+            run_paddle("Adamax", 5, beta1=0.9, beta2=0.995, epsilon=1e-8),
+            run_torch(torch.optim.Adamax, 5, betas=(0.9, 0.995),
+                      eps=1e-8))
+
+    def test_adadelta(self):
+        assert_traj(
+            run_paddle("Adadelta", 5, rho=0.95, epsilon=1e-6),
+            run_torch(torch.optim.Adadelta, 5, rho=0.95, eps=1e-6))
