@@ -87,3 +87,84 @@ class TestTrajectories:
         assert_traj(
             run_paddle("Adadelta", 5, rho=0.95, epsilon=1e-6),
             run_torch(torch.optim.Adadelta, 5, rho=0.95, eps=1e-6))
+
+
+class TestLRSchedules:
+    """LR schedule value sequences vs torch equivalents."""
+
+    def _pd_seq(self, sched, steps, metric=None):
+        out = []
+        for _ in range(steps):
+            out.append(float(sched()))
+            if metric is not None:
+                sched.step(metric)
+            else:
+                sched.step()
+        return out
+
+    def _th_seq(self, sched_cls, steps, lr=0.1, metric=None, **kw):
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.SGD([p], lr=lr)
+        s = sched_cls(opt, **kw)
+        out = []
+        for _ in range(steps):
+            out.append(opt.param_groups[0]["lr"])
+            opt.step()
+            if metric is not None:
+                s.step(metric)
+            else:
+                s.step()
+        return out
+
+    def test_step_decay(self):
+        got = self._pd_seq(paddle.optimizer.lr.StepDecay(
+            learning_rate=0.1, step_size=3, gamma=0.5), 10)
+        want = self._th_seq(torch.optim.lr_scheduler.StepLR, 10,
+                            step_size=3, gamma=0.5)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_multistep_decay(self):
+        got = self._pd_seq(paddle.optimizer.lr.MultiStepDecay(
+            learning_rate=0.1, milestones=[2, 5], gamma=0.1), 8)
+        want = self._th_seq(torch.optim.lr_scheduler.MultiStepLR, 8,
+                            milestones=[2, 5], gamma=0.1)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_exponential_decay(self):
+        got = self._pd_seq(paddle.optimizer.lr.ExponentialDecay(
+            learning_rate=0.1, gamma=0.8), 6)
+        want = self._th_seq(torch.optim.lr_scheduler.ExponentialLR, 6,
+                            gamma=0.8)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_cosine_annealing(self):
+        got = self._pd_seq(paddle.optimizer.lr.CosineAnnealingDecay(
+            learning_rate=0.1, T_max=10, eta_min=0.01), 10)
+        want = self._th_seq(torch.optim.lr_scheduler.CosineAnnealingLR,
+                            10, T_max=10, eta_min=0.01)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_reduce_on_plateau(self):
+        sched = paddle.optimizer.lr.ReduceOnPlateau(
+            learning_rate=0.1, factor=0.5, patience=1, cooldown=0)
+        metrics = [1.0, 1.0, 1.0, 0.5, 0.7, 0.7, 0.7]
+        got = []
+        for m in metrics:
+            got.append(float(sched()))
+            sched.step(paddle.to_tensor(np.float32(m)))
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.SGD([p], lr=0.1)
+        s = torch.optim.lr_scheduler.ReduceLROnPlateau(
+            opt, factor=0.5, patience=1, cooldown=0)
+        want = []
+        for m in metrics:
+            want.append(opt.param_groups[0]["lr"])
+            s.step(m)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_lambda_decay(self):
+        got = self._pd_seq(paddle.optimizer.lr.LambdaDecay(
+            learning_rate=0.1, lr_lambda=lambda e: 0.9 ** e), 6)
+        want = self._th_seq(torch.optim.lr_scheduler.LambdaLR, 6,
+                            lr_lambda=lambda e: 0.9 ** e)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
