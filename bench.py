@@ -520,11 +520,17 @@ def hybrid_bench():
     import subprocess
 
     configs = [
-        # (preset, ndev, axes dict, stash, seq, M, budget GiB)
-        ("13b", 8, dict(pp=2, mp=2, sharding=2), "input", 4096, 8, 95),
-        ("13b", 8, dict(pp=2, mp=2, sharding=2), "residuals", 4096, 8, 95),
-        ("65b", 64, dict(pp=8, mp=4, sharding=2), "input", 4096, 16, 95),
-        ("65b", 64, dict(pp=8, mp=4, sharding=2), "residuals", 4096, 16, 95),
+        # (preset, ndev, axes dict, stash, seq, M, budget GiB, zero_stage)
+        ("13b", 8, dict(pp=2, mp=2, sharding=2), "input", 4096, 8, 95, 2),
+        ("13b", 8, dict(pp=2, mp=2, sharding=2), "residuals", 4096, 8, 95,
+         2),
+        ("65b", 64, dict(pp=8, mp=4, sharding=2), "input", 4096, 16, 95,
+         2),
+        ("65b", 64, dict(pp=8, mp=4, sharding=2), "residuals", 4096, 16,
+         95, 2),
+        # BASELINE config 3 names sharding-stage-3 explicitly
+        ("65b", 64, dict(pp=8, mp=4, sharding=2), "residuals", 4096, 16,
+         95, 3),
     ]
     runner = r'''
 import sys, os, json, time
@@ -545,14 +551,16 @@ t0 = time.time()
 rep = hybrid_memory_analysis(
     cfg, mesh, accumulate_steps=spec["M"], seq_len=spec["seq"],
     remat=(spec["stash"] == "input"), stash=spec["stash"],
-    hbm_budget=spec["budget_gib"] << 30)
+    hbm_budget=spec["budget_gib"] << 30,
+    zero_stage=spec.get("zero_stage", 2))
 rep["compile_secs"] = round(time.time() - t0, 1)
 print("HYBRID_REPORT " + json.dumps(rep))
 '''
     reports = []
-    for preset, ndev, axes, stash, seq, M, budget in configs:
+    for preset, ndev, axes, stash, seq, M, budget, zstage in configs:
         spec = json.dumps({"preset": preset, "axes": axes, "stash": stash,
-                            "seq": seq, "M": M, "budget_gib": budget})
+                           "seq": seq, "M": M, "budget_gib": budget,
+                           "zero_stage": zstage})
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", runner, spec, str(ndev)],

@@ -130,7 +130,8 @@ def build_llama_hybrid_step(cfg: LlamaConfig, mesh: Mesh,
                             lr: float = 1e-4, clip_norm: float = 1.0,
                             zero: bool = True, remat: bool = True,
                             moment_dtype=jnp.float32,
-                            stash: Optional[str] = None):
+                            stash: Optional[str] = None,
+                            zero_stage: int = 2):
     """Returns ``(step, prepare)``:
 
     - ``prepare(stacked, rest) -> (blocks, edge, opt_state)`` — rearranges
@@ -182,8 +183,13 @@ def build_llama_hybrid_step(cfg: LlamaConfig, mesh: Mesh,
 
     def prepare(stacked, rest):
         blocks = blocks_from_stacked(stacked, S, V)
-        bspec = block_specs(blocks.keys())
-        espec = edge_specs(rest.keys())
+        # ZeRO stage 3: PARAMS are sharded at rest too (the non-mp
+        # feature dim rides the `sharding` axis; GSPMD all-gathers at
+        # use) — the DygraphShardingOptimizer stage-3 placement,
+        # BASELINE config 3's "sharding-stage-3"
+        stage3 = zero and zero_stage >= 3
+        bspec = block_specs(blocks.keys(), zero=stage3)
+        espec = edge_specs(rest.keys(), zero=stage3)
         blocks = _shard(blocks, bspec, mesh)
         edge = _shard(rest, espec, mesh)
         st = adamw_init({"b": blocks, "e": edge}, master_dtype=moment_dtype)
@@ -243,7 +249,8 @@ def hybrid_memory_analysis(cfg: LlamaConfig, mesh: Mesh,
                            stash: Optional[str] = None,
                            param_dtype=jnp.bfloat16,
                            moment_dtype=jnp.float32,
-                           hbm_budget: int = 95 << 30) -> Dict[str, Any]:
+                           hbm_budget: int = 95 << 30,
+                           zero_stage: int = 2) -> Dict[str, Any]:
     """Compile-only per-device memory feasibility for BASELINE config 3
     (Llama-2 13B/65B hybrid TP x PP x sharding) — proves the stage-local
     PP + ZeRO placement fits a v5p HBM budget WITHOUT the hardware.
@@ -285,8 +292,9 @@ def hybrid_memory_analysis(cfg: LlamaConfig, mesh: Mesh,
                     sharding=NamedSharding(mesh, specs[k]))
                 for k, v in avals.items()}
 
-    bspec = block_specs(blocks_avals.keys())
-    espec = edge_specs(rest_avals.keys())
+    stage3 = zero and zero_stage >= 3
+    bspec = block_specs(blocks_avals.keys(), zero=stage3)
+    espec = edge_specs(rest_avals.keys(), zero=stage3)
     blocks_in = _sds(blocks_avals, bspec)
     edge_in = _sds(rest_avals, espec)
     opt_aval = jax.eval_shape(
@@ -309,7 +317,8 @@ def hybrid_memory_analysis(cfg: LlamaConfig, mesh: Mesh,
 
     step, _ = build_llama_hybrid_step(
         cfg, mesh, accumulate_steps=M, num_virtual_stages=V,
-        zero=zero, remat=remat, stash=stash, moment_dtype=moment_dtype)
+        zero=zero, remat=remat, stash=stash, moment_dtype=moment_dtype,
+        zero_stage=zero_stage)
     compiled = step.lower(blocks_in, edge_in, opt_in, ids_in, y_in).compile()
     ma = compiled.memory_analysis()
     arg_b = int(ma.argument_size_in_bytes)
@@ -325,7 +334,7 @@ def hybrid_memory_analysis(cfg: LlamaConfig, mesh: Mesh,
         "virtual_stages": V, "accumulate_steps": M,
         "micro_batch": batch_per_micro, "seq_len": seq_len,
         "stash": stash,
-        "zero": zero,
+        "zero": zero, "zero_stage": zero_stage if zero else 0,
         "per_device": {"argument_bytes": arg_b, "temp_bytes": tmp_b,
                        "output_bytes": out_b, "peak_bytes": peak},
         "hbm_budget_bytes": int(hbm_budget),

@@ -74,3 +74,48 @@ class Test13BCompileOnly:
         total_bf16 = 13e9 * 2 + 13e9 * 8  # params + fp32 moments
         assert args[4] < total_bf16 / 4, args
         assert args[2] < total_bf16 / 4, args
+
+
+class TestZeroStage3:
+    def test_stage3_shrinks_at_rest_params(self):
+        """ZeRO stage 3 (params sharded at rest over the sharding axis —
+        BASELINE config 3's 'sharding-stage-3') must cut per-device
+        ARGUMENT bytes vs stage 2 at the same mesh."""
+        cfg = llama_config("13b")
+        mesh = build_mesh(pp=2, mp=2, sharding=2)
+        set_mesh(mesh)
+        args = {}
+        for stage in (2, 3):
+            rep = hybrid_memory_analysis(
+                cfg, mesh, accumulate_steps=8, seq_len=2048,
+                remat=True, stash="input", zero_stage=stage)
+            args[stage] = rep["per_device"]["argument_bytes"]
+            assert rep["zero_stage"] == stage
+        # stage 2 replicates bf16 params over `sharding`; stage 3 halves
+        # the body/edge param share on this sharding=2 mesh
+        assert args[3] < 0.85 * args[2], args
+
+    def test_stage3_step_runs_tiny(self):
+        """The stage-3 placement must EXECUTE, not just compile: one
+        train step on tiny dims with params sharded at rest."""
+        import numpy as np
+
+        from paddle_tpu.models import LlamaForCausalLM
+        from paddle_tpu.models.llama_functional import stack_params
+        from paddle_tpu.models.llama_pp import build_llama_hybrid_step
+
+        cfg = llama_config("tiny", num_hidden_layers=4)
+        mesh = build_mesh(pp=2, mp=2, sharding=2)
+        set_mesh(mesh)
+        np.random.seed(0)
+        model = LlamaForCausalLM(cfg)
+        raw = {k: np.asarray(p.value) for k, p in model.named_parameters()}
+        stacked, rest = stack_params(raw, cfg)
+        step, prepare = build_llama_hybrid_step(
+            cfg, mesh, accumulate_steps=4, lr=1e-3, zero_stage=3)
+        blocks, edge, st = prepare(stacked, rest)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        y = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        blocks, edge, st, loss = step(blocks, edge, st, ids, y)
+        assert np.isfinite(float(loss))
