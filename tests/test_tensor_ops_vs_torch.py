@@ -1,0 +1,152 @@
+"""Tensor-op semantics vs torch: reduction/sort/index conventions
+(interpolation modes, tie handling, side conventions, stability) where
+implementations silently diverge.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+def _np(x):
+    return np.asarray(x.value if hasattr(x, "value") else x)
+
+
+def rand(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype(np.float32)
+
+
+class TestSortTopk:
+    def test_topk_values_and_indices(self):
+        x = rand(3, 8, seed=1)
+        for largest in (True, False):
+            v, i = paddle.topk(_t(x), k=3, largest=largest)
+            tv, ti = torch.topk(torch.from_numpy(x), 3, largest=largest)
+            np.testing.assert_allclose(_np(v), tv.numpy(), rtol=1e-6)
+            np.testing.assert_array_equal(_np(i), ti.numpy())
+
+    def test_sort_descending_with_indices(self):
+        x = rand(4, 6, seed=2)
+        v = paddle.sort(_t(x), axis=-1, descending=True)
+        i = paddle.argsort(_t(x), axis=-1, descending=True)
+        tv, ti = torch.sort(torch.from_numpy(x), dim=-1, descending=True)
+        np.testing.assert_allclose(_np(v), tv.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(_np(i), ti.numpy())
+
+    def test_kthvalue_and_mode(self):
+        x = rand(3, 7, seed=3)
+        v, i = paddle.kthvalue(_t(x), k=3, axis=-1)
+        tv, ti = torch.kthvalue(torch.from_numpy(x), 3, dim=-1)
+        np.testing.assert_allclose(_np(v), tv.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(_np(i), ti.numpy())
+        xm = np.array([[1, 2, 2, 3], [3, 3, 1, 2]], np.float32)
+        v, i = paddle.mode(_t(xm), axis=-1)
+        tv, ti = torch.mode(torch.from_numpy(xm), dim=-1)
+        np.testing.assert_allclose(_np(v), tv.numpy(), rtol=1e-6)
+
+
+class TestReductions:
+    def test_quantile_linear_and_axis(self):
+        # the reference quantile has NO interpolation param (stat.py:579,
+        # linear only); check values + axis/keepdim against numpy
+        x = rand(4, 20, seed=4)
+        np.testing.assert_allclose(
+            float(_np(paddle.quantile(_t(x), q=0.3))),
+            np.quantile(x, 0.3), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.quantile(_t(x), q=[0.25, 0.75], axis=1)),
+            np.quantile(x, [0.25, 0.75], axis=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.quantile(_t(x), q=0.5, axis=0, keepdim=True)),
+            np.quantile(x, 0.5, axis=0, keepdims=True), rtol=1e-5)
+
+    def test_median_even_count(self):
+        # paddle median averages the two middle values by default
+        # (torch.median takes the LOWER) — use numpy as the contract
+        x = rand(6, seed=5)
+        got = float(_np(paddle.median(_t(x))))
+        np.testing.assert_allclose(got, np.median(x), rtol=1e-6)
+
+    def test_cumsum_cumprod_logcumsumexp(self):
+        x = rand(3, 5, seed=6)
+        np.testing.assert_allclose(
+            _np(paddle.cumsum(_t(x), axis=1)),
+            torch.cumsum(torch.from_numpy(x), 1).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.cumprod(_t(x), dim=1)),
+            torch.cumprod(torch.from_numpy(x), 1).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.logcumsumexp(_t(x), axis=1)),
+            torch.logcumsumexp(torch.from_numpy(x), 1).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_nanmean_nansum_nanquantile(self):
+        x = rand(8, seed=7)
+        x[2] = np.nan
+        np.testing.assert_allclose(
+            float(_np(paddle.nanmean(_t(x)))), np.nanmean(x), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(_np(paddle.nansum(_t(x)))), np.nansum(x), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(_np(paddle.nanquantile(_t(x), 0.5))),
+            np.nanquantile(x, 0.5), rtol=1e-6)
+
+
+class TestIndexing:
+    @pytest.mark.parametrize("right", [False, True])
+    def test_searchsorted_sides(self, right):
+        sorted_x = np.array([1.0, 2.0, 2.0, 3.0, 5.0], np.float32)
+        q = np.array([0.5, 2.0, 2.5, 5.0, 6.0], np.float32)
+        got = _np(paddle.searchsorted(_t(sorted_x), _t(q), right=right))
+        want = torch.searchsorted(torch.from_numpy(sorted_x),
+                                  torch.from_numpy(q),
+                                  right=right).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_unique_with_inverse_and_counts(self):
+        x = np.array([3, 1, 2, 3, 1, 1], np.int64)
+        u, inv, cnt = paddle.unique(_t(x), return_inverse=True,
+                                    return_counts=True)
+        np.testing.assert_array_equal(_np(u), [1, 2, 3])
+        np.testing.assert_array_equal(_np(u)[_np(inv)], x)
+        np.testing.assert_array_equal(_np(cnt), [3, 1, 2])
+
+    def test_take_along_axis_put_along_axis(self):
+        x = rand(3, 4, seed=8)
+        idx = np.array([[0, 3], [1, 2], [2, 0]], np.int64)
+        got = _np(paddle.take_along_axis(_t(x), _t(idx), axis=1))
+        want = torch.gather(torch.from_numpy(x),
+                            1, torch.from_numpy(idx)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        vals = np.full((3, 2), 9.0, np.float32)
+        got = _np(paddle.put_along_axis(_t(x), _t(idx), _t(vals), axis=1))
+        want = torch.from_numpy(x.copy()).scatter_(
+            1, torch.from_numpy(idx), torch.from_numpy(vals)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_index_add_index_put(self):
+        x = rand(4, 3, seed=9)
+        idx = np.array([1, 3], np.int64)
+        vals = np.ones((2, 3), np.float32)
+        got = _np(paddle.index_add(_t(x), _t(idx), 0, _t(vals)))
+        want = torch.from_numpy(x.copy()).index_add_(
+            0, torch.from_numpy(idx), torch.from_numpy(vals)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_roll_flip_diff(self):
+        x = rand(3, 5, seed=10)
+        np.testing.assert_allclose(
+            _np(paddle.roll(_t(x), shifts=2, axis=1)),
+            np.roll(x, 2, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(
+            _np(paddle.flip(_t(x), axis=[0, 1])),
+            np.flip(x, (0, 1)), rtol=1e-6)
+        np.testing.assert_allclose(
+            _np(paddle.diff(_t(x), axis=1)), np.diff(x, axis=1),
+            rtol=1e-6)
