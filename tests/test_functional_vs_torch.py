@@ -499,3 +499,49 @@ class TestAttentionVsTorch:
                     torch.from_numpy(kv))
         np.testing.assert_allclose(got, want.detach().numpy(), rtol=1e-3,
                                    atol=1e-3)
+
+
+class TestActivationZoo:
+    """One sweep over the activation family vs torch."""
+
+    CASES = [
+        ("relu6", {}, "relu6", {}),
+        ("selu", {}, "selu", {}),
+        ("celu", {"alpha": 1.3}, "celu", {"alpha": 1.3}),
+        ("elu", {"alpha": 0.7}, "elu", {"alpha": 0.7}),
+        ("mish", {}, "mish", {}),
+        ("hardswish", {}, "hardswish", {}),
+        ("hardsigmoid", {}, "hardsigmoid", {}),
+        ("softplus", {"beta": 2.0}, "softplus", {"beta": 2.0}),
+        ("softsign", {}, "softsign", {}),
+        ("tanhshrink", {}, "tanhshrink", {}),
+        ("hardtanh", {"min": -0.6, "max": 0.4}, "hardtanh",
+         {"min_val": -0.6, "max_val": 0.4}),
+        ("leaky_relu", {"negative_slope": 0.2}, "leaky_relu",
+         {"negative_slope": 0.2}),
+        ("log_sigmoid", {}, "logsigmoid", {}),
+        ("silu", {}, "silu", {}),
+    ]
+
+    @pytest.mark.parametrize("pd_name,pd_kw,th_name,th_kw", CASES)
+    def test_matches_torch(self, pd_name, pd_kw, th_name, th_kw):
+        x = rand(64, seed=70) * 3
+        got = _np(getattr(F, pd_name)(_t(x), **pd_kw))
+        want = getattr(TF, th_name)(torch.from_numpy(x), **th_kw).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=pd_name)
+
+    def test_softshrink_hardshrink_thresholded(self):
+        x = rand(32, seed=71)
+        np.testing.assert_allclose(
+            _np(F.softshrink(_t(x), threshold=0.3)),
+            TF.softshrink(torch.from_numpy(x), lambd=0.3).numpy(),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _np(F.hardshrink(_t(x), threshold=0.3)),
+            TF.hardshrink(torch.from_numpy(x), lambd=0.3).numpy(),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _np(F.thresholded_relu(_t(x), threshold=0.2)),
+            TF.threshold(torch.from_numpy(x), 0.2, 0.0).numpy(),
+            rtol=1e-5, atol=1e-6)

@@ -1,0 +1,58 @@
+"""paddle.metric vs sklearn — the reference metric semantics
+(Accuracy top-k, binary Precision/Recall at 0.5, bucketed ROC AUC).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+sk = pytest.importorskip("sklearn.metrics")
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        rng = np.random.RandomState(0)
+        logits = rng.randn(64, 5).astype(np.float32)
+        labels = rng.randint(0, 5, (64, 1)).astype(np.int64)
+        for k in (1, 2):
+            m = paddle.metric.Accuracy(topk=(k,))
+            m.update(m.compute(_t(logits), _t(labels)))
+            got = float(np.asarray(m.accumulate()))
+            top = np.argsort(-logits, axis=1)[:, :k]
+            want = float(np.mean([labels[i, 0] in top[i]
+                                  for i in range(64)]))
+            np.testing.assert_allclose(got, want, rtol=1e-6,
+                                       err_msg=f"top{k}")
+
+    def test_precision_recall_binary(self):
+        rng = np.random.RandomState(1)
+        preds = rng.rand(200).astype(np.float32)
+        labels = (rng.rand(200) < 0.4).astype(np.int64)
+        p = paddle.metric.Precision()
+        p.update(np.asarray(preds), labels)
+        r = paddle.metric.Recall()
+        r.update(np.asarray(preds), labels)
+        hard = (preds > 0.5).astype(np.int64)
+        np.testing.assert_allclose(float(p.accumulate()),
+                                   sk.precision_score(labels, hard),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(r.accumulate()),
+                                   sk.recall_score(labels, hard),
+                                   rtol=1e-6)
+
+    def test_auc_vs_sklearn(self):
+        rng = np.random.RandomState(2)
+        labels = (rng.rand(500) < 0.5).astype(np.int64)
+        # informative scores so AUC is away from 0.5
+        scores = (labels * 0.4 + rng.rand(500) * 0.8).clip(0, 1) \
+            .astype(np.float32)
+        preds = np.stack([1 - scores, scores], axis=1)
+        m = paddle.metric.Auc(num_thresholds=4095)
+        m.update(preds, labels[:, None])
+        got = float(m.accumulate())
+        want = float(sk.roc_auc_score(labels, scores))
+        np.testing.assert_allclose(got, want, rtol=5e-3)
