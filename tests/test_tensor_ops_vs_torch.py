@@ -150,3 +150,51 @@ class TestIndexing:
         np.testing.assert_allclose(
             _np(paddle.diff(_t(x), axis=1)), np.diff(x, axis=1),
             rtol=1e-6)
+
+
+class TestSpecialFunctions:
+    """Special-function values vs scipy (erf family, gamma family,
+    Bessel, sinc) — formula/branch mistakes show up immediately."""
+
+    def test_erf_family(self):
+        import scipy.special as sp
+
+        x = rand(64, seed=20) * 2
+        np.testing.assert_allclose(_np(paddle.erf(_t(x))), sp.erf(x),
+                                   rtol=1e-5, atol=1e-6)
+        u = (np.random.RandomState(21).rand(32).astype(np.float32)
+             * 1.8 - 0.9)
+        np.testing.assert_allclose(_np(paddle.erfinv(_t(u))),
+                                   sp.erfinv(u), rtol=1e-4, atol=1e-5)
+
+    def test_gamma_family(self):
+        import scipy.special as sp
+
+        x = np.abs(rand(32, seed=22)) * 4 + 0.2
+        np.testing.assert_allclose(_np(paddle.lgamma(_t(x))),
+                                   sp.gammaln(x), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(paddle.digamma(_t(x))),
+                                   sp.digamma(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(_np(paddle.polygamma(_t(x), 1)),
+                                   sp.polygamma(1, x), rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_bessel_i0_i1(self):
+        import scipy.special as sp
+
+        x = np.abs(rand(32, seed=23)) * 3
+        np.testing.assert_allclose(_np(paddle.i0(_t(x))), sp.i0(x),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(paddle.i1(_t(x))), sp.i1(x),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(paddle.i0e(_t(x))), sp.i0e(x),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_logit(self):
+        # (no sinc: not in the reference snapshot's tensor surface)
+        import scipy.special as sp
+
+        p = np.random.RandomState(25).rand(32).astype(np.float32) * 0.9 \
+            + 0.05
+        np.testing.assert_allclose(_np(paddle.logit(_t(p))),
+                                   sp.logit(p), rtol=1e-4, atol=1e-4)
