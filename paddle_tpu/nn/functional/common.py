@@ -248,10 +248,15 @@ def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
         else:
             out_shape = (v.shape[0], v.shape[1], *out_sp)
         if mode == "nearest":
-            # paddle/torch nearest: src = floor(i * in/out) — NOT the
-            # rounded half-pixel centers jax.image.resize uses
-            return _resize_gather(v, out_shape, "nearest", False,
+            # paddle/torch nearest: src = floor(i * in/out); with
+            # align_corners the src is round(i * (in-1)/(out-1))
+            return _resize_gather(v, out_shape, "nearest", align_corners,
                                   channel_last)
+        if mode == "area":
+            # paddle area == adaptive average pooling: out[i] averages
+            # the source interval [floor(i*in/out), ceil((i+1)*in/out))
+            # — a 2-tap linear sample is NOT a box filter
+            return _adaptive_mean(v, out_shape, channel_last)
         if mode == "bicubic":
             # torch/paddle bicubic kernel is Keys a=-0.75; jax's cubic is
             # a=-0.5 — must be explicit for parity, both align modes
@@ -277,6 +282,34 @@ def _cubic_weight(t, a=-0.75):
                   0.0))
 
 
+def _adaptive_mean(v, out_shape, channel_last):
+    """Separable adaptive-average resize (exact box means over the
+    rectangular source regions — regions are per-axis intervals, so the
+    nested per-axis means equal the region mean). Cumsum form handles
+    uneven windows in O(n)."""
+    if channel_last:
+        in_sp, out_sp = v.shape[1:-1], out_shape[1:-1]
+        sp_axes = list(range(1, v.ndim - 1))
+    else:
+        in_sp, out_sp = v.shape[2:], out_shape[2:]
+        sp_axes = list(range(2, v.ndim))
+    out = v
+    for ax, insz, outsz in zip(sp_axes, in_sp, out_sp):
+        i = jnp.arange(outsz)
+        lo = jnp.floor(i * insz / outsz).astype(jnp.int32)
+        hi = jnp.ceil((i + 1) * insz / outsz).astype(jnp.int32)
+        c = jnp.cumsum(out.astype(jnp.float32), axis=ax)
+        c = jnp.concatenate(
+            [jnp.zeros_like(jnp.take(c, jnp.array([0]), axis=ax)), c],
+            axis=ax)
+        sums = jnp.take(c, hi, axis=ax) - jnp.take(c, lo, axis=ax)
+        wsh = [1] * out.ndim
+        wsh[ax] = outsz
+        out = (sums / (hi - lo).astype(jnp.float32).reshape(wsh)).astype(
+            v.dtype)
+    return out
+
+
 def _resize_gather(v, out_shape, kind, align_corners, channel_last):
     """Separable explicit-gather resize along every spatial axis.
 
@@ -293,8 +326,12 @@ def _resize_gather(v, out_shape, kind, align_corners, channel_last):
     for ax, insz, outsz in zip(sp_axes, in_sp, out_sp):
         i = jnp.arange(outsz, dtype=jnp.float32)
         if kind == "nearest":
-            src = jnp.floor(i * (insz / outsz)).astype(jnp.int32)
-            out = jnp.take(out, jnp.clip(src, 0, insz - 1), axis=ax)
+            if align_corners and outsz > 1:
+                src = jnp.round(i * (insz - 1) / (outsz - 1))
+            else:
+                src = jnp.floor(i * (insz / outsz))
+            out = jnp.take(out, jnp.clip(src.astype(jnp.int32), 0,
+                                         insz - 1), axis=ax)
             continue
         if align_corners:
             src = (i * (insz - 1) / (outsz - 1) if outsz > 1

@@ -31,19 +31,19 @@ __all__ = ["AutoTuneCache", "get_cache", "lookup", "record", "tune",
 _CACHE_ENV = "PADDLE_TPU_AUTOTUNE_CACHE"
 
 
-def _default_path() -> str:
-    env = os.environ.get(_CACHE_ENV)
-    if env:
-        return env
-    # a committed in-repo cache (written by experiments/
-    # exp_autotune_sweep.py on real hardware) wins over the per-user
-    # file, so bench.py picks tuned blocks on first run anywhere
-    repo = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+def _repo_cache_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), ".autotune_cache.json")
-    if os.path.exists(repo):
-        return repo
-    return os.path.join(os.path.expanduser("~"),
-                        ".paddle_tpu_autotune.json")
+
+
+def _default_path() -> str:
+    """WRITE path: env override or the per-user file — never the
+    committed in-repo cache (a local tune() on non-TPU hardware must not
+    dirty/poison the version-controlled real-hardware results; the sweep
+    script opts into the repo path via set_cache_path)."""
+    return os.environ.get(
+        _CACHE_ENV, os.path.join(os.path.expanduser("~"),
+                                 ".paddle_tpu_autotune.json"))
 
 
 class AutoTuneCache:
@@ -98,10 +98,13 @@ _loaded = [False]
 def get_cache() -> AutoTuneCache:
     if not _loaded[0]:
         _loaded[0] = True
-        try:
-            _GLOBAL.load()
-        except (OSError, ValueError):
-            pass
+        # READ order: per-user file first, then the committed in-repo
+        # cache (real-hardware sweep results) so the repo entries win
+        for path in (_default_path(), _repo_cache_path()):
+            try:
+                _GLOBAL.load(path)
+            except (OSError, ValueError):
+                pass
     return _GLOBAL
 
 

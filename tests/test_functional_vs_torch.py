@@ -545,3 +545,32 @@ class TestActivationZoo:
             _np(F.thresholded_relu(_t(x), threshold=0.2)),
             TF.threshold(torch.from_numpy(x), 0.2, 0.0).numpy(),
             rtol=1e-5, atol=1e-6)
+
+
+class TestInterpolateAreaAndAlignNearest:
+    def test_area_is_box_mean(self):
+        # [0,0,0,100] downsampled 4x by area must give the block MEAN
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 3, 3] = 100.0
+        got = _np(F.interpolate(_t(x), size=(1, 1), mode="area"))
+        np.testing.assert_allclose(got, [[[[100.0 / 16]]]], rtol=1e-6)
+        # and matches torch adaptive/area semantics on random input
+        y = rand(2, 3, 9, 12, seed=80)
+        got = _np(F.interpolate(_t(y), size=(3, 4), mode="area"))
+        want = TF.interpolate(torch.from_numpy(y), size=(3, 4),
+                              mode="area").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_area_uneven_windows(self):
+        y = rand(1, 2, 7, 5, seed=81)
+        got = _np(F.interpolate(_t(y), size=(3, 2), mode="area"))
+        want = TF.interpolate(torch.from_numpy(y), size=(3, 2),
+                              mode="area").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_nearest_align_corners_true(self):
+        # paddle nearest_interp with align_corners: round(i*(in-1)/(out-1))
+        x = np.arange(5, dtype=np.float32).reshape(1, 1, 1, 5)
+        got = _np(F.interpolate(_t(x), size=(1, 3), mode="nearest",
+                                align_corners=True))
+        np.testing.assert_allclose(got.ravel(), [0.0, 2.0, 4.0])
