@@ -198,3 +198,49 @@ class TestSpecialFunctions:
             + 0.05
         np.testing.assert_allclose(_np(paddle.logit(_t(p))),
                                    sp.logit(p), rtol=1e-4, atol=1e-4)
+
+
+class TestEinsumAndSetitem:
+    def test_einsum_patterns(self):
+        a, b = rand(3, 4, seed=30), rand(4, 5, seed=31)
+        c = rand(2, 3, 4, seed=32)
+        for pat, ops in (("ij,jk->ik", (a, b)),
+                         ("bij,jk->bik", (c, b)),
+                         ("ij->ji", (a,)),
+                         ("bij->b", (c,)),
+                         ("ij,ij->", (a, a))):
+            got = _np(paddle.einsum(pat, *[_t(o) for o in ops]))
+            want = np.einsum(pat, *ops)
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                       err_msg=pat)
+
+    def test_setitem_slices_and_masks(self):
+        x = rand(4, 5, seed=33)
+        t = _t(x.copy())
+        t[1:3, ::2] = 7.0
+        want = x.copy()
+        want[1:3, ::2] = 7.0
+        np.testing.assert_allclose(_np(t), want)
+        t2 = _t(x.copy())
+        t2[x > 0.5] = 0.0
+        want2 = x.copy()
+        want2[x > 0.5] = 0.0
+        np.testing.assert_allclose(_np(t2), want2)
+
+    def test_getitem_forms(self):
+        x = rand(4, 5, 6, seed=34)
+        t = _t(x)
+        np.testing.assert_allclose(_np(t[::2, -1]), x[::2, -1])
+        np.testing.assert_allclose(_np(t[..., 2]), x[..., 2])
+        np.testing.assert_allclose(_np(t[None, 1]), x[None, 1])
+        idx = np.array([2, 0, 3], np.int64)
+        np.testing.assert_allclose(_np(t[_t(idx)]), x[idx])
+
+    def test_broadcast_binary_ops(self):
+        a = rand(4, 1, 5, seed=35)
+        b = rand(3, 1, seed=36)
+        np.testing.assert_allclose(_np(_t(a) + _t(b)), a + b, rtol=1e-6)
+        np.testing.assert_allclose(_np(_t(a) * _t(b)), a * b, rtol=1e-6)
+        np.testing.assert_allclose(
+            _np(paddle.maximum(_t(a), _t(b))), np.maximum(a, b),
+            rtol=1e-6)
