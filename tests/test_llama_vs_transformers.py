@@ -1,0 +1,149 @@
+"""Flagship-model oracle: our LlamaForCausalLM vs HuggingFace
+transformers' (the canonical implementation) with IDENTICAL weights —
+verifies the whole stack (RoPE convention, GQA head grouping, RMSNorm
+epsilon placement, SwiGLU, logits head) in one shot. Also the
+functional scan-over-layers form and the KV-cache decode path against
+the same oracle.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM
+from paddle_tpu.models.llama import LlamaConfig
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def build_pair(kvh=2, layers=2, hidden=32, inter=64, heads=4, vocab=97):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=kvh, max_position_embeddings=128,
+        rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=False,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=kvh, max_position_embeddings=128,
+        rms_norm_eps=1e-6, rope_theta=10000.0)
+    ours = LlamaForCausalLM(cfg)
+    ours.eval()
+
+    hf_sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    for name, p in ours.named_parameters():
+        v = hf_sd[name]
+        if name.endswith("proj.weight") or name == "lm_head.weight":
+            v = v.T          # torch Linear stores [out, in]; ours [in, out]
+        assert tuple(v.shape) == tuple(p.shape), (name, v.shape, p.shape)
+        p.set_value(paddle.to_tensor(np.ascontiguousarray(v)))
+    return ours, hf, cfg
+
+
+class TestLogitsParity:
+    @pytest.mark.parametrize("kvh", [4, 2])
+    def test_forward_logits_match(self, kvh):
+        ours, hf, _ = build_pair(kvh=kvh)
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 97, (2, 11)).astype(np.int64)
+        want = hf(torch.from_numpy(ids)).logits.detach().numpy()
+        got = np.asarray(ours(paddle.to_tensor(ids.astype(np.int32))).value)
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3,
+                                   err_msg=f"kvh={kvh}")
+
+    def test_functional_form_matches_hf(self):
+        from paddle_tpu.models.llama_functional import (forward,
+                                                        stack_params)
+
+        ours, hf, cfg = build_pair()
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, 97, (1, 9)).astype(np.int64)
+        params = {k: p.value for k, p in ours.named_parameters()}
+        stacked, rest = stack_params(params, cfg)
+        got = np.asarray(forward(stacked, rest,
+                                 np.asarray(ids, np.int32), cfg,
+                                 remat=False))
+        want = hf(torch.from_numpy(ids)).logits.detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_greedy_generation_matches_hf(self):
+        from paddle_tpu.inference.generation import (CausalLMEngine,
+                                                     GenerationConfig)
+
+        ours, hf, _ = build_pair()
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, 97, (1, 7)).astype(np.int64)
+        want = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                           do_sample=False).numpy()
+        eng = CausalLMEngine(ours, max_batch=1, max_len=64)
+        got = eng.generate(ids.astype(np.int32),
+                           GenerationConfig(max_new_tokens=8))
+        np.testing.assert_array_equal(got, want)
+
+
+class TestGPT2VsTransformers:
+    """Our GPT (GPT-2 architecture) vs HF GPT2 with shared weights.
+    HF Conv1D already stores [in, out] like our Linear — no transpose
+    except the lm_head torch Linear."""
+
+    def test_gpt2_logits_match(self):
+        import transformers as tr
+
+        hf_cfg = tr.GPT2Config(
+            vocab_size=97, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+            n_inner=64, activation_function="gelu_new",
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = tr.GPT2LMHeadModel(hf_cfg).eval()
+
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        ours = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, dropout=0.0))
+        ours.eval()
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        m = {
+            "model.embed_tokens.weight": "transformer.wte.weight",
+            "model.embed_positions.weight": "transformer.wpe.weight",
+            "model.ln_f.weight": "transformer.ln_f.weight",
+            "model.ln_f.bias": "transformer.ln_f.bias",
+            "lm_head.weight": ("transformer.wte.weight", "T"),
+        }
+        for i in range(2):
+            pre = f"model.layers.{i}."
+            h = f"transformer.h.{i}."
+            m[pre + "ln_1.weight"] = h + "ln_1.weight"
+            m[pre + "ln_1.bias"] = h + "ln_1.bias"
+            m[pre + "ln_2.weight"] = h + "ln_2.weight"
+            m[pre + "ln_2.bias"] = h + "ln_2.bias"
+            m[pre + "attn.qkv_proj.weight"] = h + "attn.c_attn.weight"
+            m[pre + "attn.qkv_proj.bias"] = h + "attn.c_attn.bias"
+            m[pre + "attn.out_proj.weight"] = h + "attn.c_proj.weight"
+            m[pre + "attn.out_proj.bias"] = h + "attn.c_proj.bias"
+            m[pre + "mlp.fc_in.weight"] = h + "mlp.c_fc.weight"
+            m[pre + "mlp.fc_in.bias"] = h + "mlp.c_fc.bias"
+            m[pre + "mlp.fc_out.weight"] = h + "mlp.c_proj.weight"
+            m[pre + "mlp.fc_out.bias"] = h + "mlp.c_proj.bias"
+        for name, p in ours.named_parameters():
+            src = m[name]
+            if isinstance(src, tuple):
+                v = sd[src[0]].T
+            else:
+                v = sd[src]
+            assert tuple(v.shape) == tuple(p.shape), (name, v.shape,
+                                                      p.shape)
+            p.set_value(paddle.to_tensor(np.ascontiguousarray(v)))
+
+        rng = np.random.RandomState(5)
+        ids = rng.randint(0, 97, (2, 10)).astype(np.int64)
+        want = hf(torch.from_numpy(ids)).logits.detach().numpy()
+        got = np.asarray(ours(paddle.to_tensor(
+            ids.astype(np.int32))).value)
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
