@@ -202,8 +202,13 @@ def build_pipeline_1f1b_grad_fn(pipe, accumulate_steps: int,
     memory profile, activations for all M microbatches live at the peak),
     this schedule interleaves one backward per forward tick and keeps only a
     stash of stage-INPUT activations bounded by the pipeline depth
-    (``2·S + 4`` slots per chunk, independent of M); stage interiors are
-    rematerialised by per-tick ``jax.vjp``.
+    (independent of M); stage interiors are rematerialised by per-tick
+    ``jax.vjp`` (~1.33x ideal FLOPs — the full-recompute choice).
+
+    This is the GENERIC builder: heterogeneous stages, replicated params.
+    The scale path is ``pp_sharded.build_sharded_1f1b_resid_grad_fn``
+    (stage-LOCAL params + residual stashing, ~1.001x ideal FLOPs): use it
+    for homogeneous-body LLMs where the double-forward matters.
 
     Schedule algebra (V chunks per device, L = S·V virtual stages, chunk k
     of device s is virtual stage p = k·S + s):
