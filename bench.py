@@ -22,12 +22,22 @@ def _devices_or_cpu_fallback():
     A stale remote claim makes backend init raise/hang; a degraded CPU
     record beats a crashed round record (round 1's bench signal was rc=1).
     The init attempt runs in a subprocess so a HANG (not just an error)
-    also falls back."""
+    also falls back. Also wires the persistent compile cache — EVERY
+    bench mode recompiles a multi-minute program through the remote
+    helper otherwise."""
     import os
     import subprocess
     import sys
 
     import jax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
     cfg_platforms = str(getattr(jax.config, "jax_platforms", "") or
                         os.environ.get("JAX_PLATFORMS", ""))
@@ -183,9 +193,15 @@ def main(model_size: str = "350m"):
         here = os.path.dirname(os.path.abspath(__file__))
         try:
             import glob as _glob
+            import re as _re
+
+            def _round_no(p):
+                m = _re.search(r"_r(\d+)\.log$", p)
+                return int(m.group(1)) if m else -1
 
             logs = sorted(_glob.glob(os.path.join(here,
-                                                  "TPU_PROBES_r*.log")))
+                                                  "TPU_PROBES_r*.log")),
+                          key=_round_no)
             if logs:
                 lines = open(logs[-1]).read().strip().splitlines()
                 rec["tpu_probes"] = {"file": os.path.basename(logs[-1]),

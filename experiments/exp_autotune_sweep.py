@@ -26,6 +26,11 @@ def main():
 
     from paddle_tpu.ops import autotune
 
+    # FRESH table: a merged per-user cache (CPU/interpret entries from
+    # prior tune() auto-saves) must never leak into the committed
+    # real-hardware file
+    autotune._GLOBAL = autotune.AutoTuneCache()
+    autotune._loaded[0] = True
     autotune.set_cache_path(os.path.join(REPO, ".autotune_cache.json"))
     on_tpu = jax.default_backend() == "tpu"
     if not on_tpu:

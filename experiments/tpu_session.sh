@@ -15,7 +15,8 @@ LOG=experiments/tpu_session.log
 run() {
   echo "=== $(date -u +%FT%TZ) $*" | tee -a "$LOG"
   timeout "${STEP_TIMEOUT:-2400}" "$@" 2>&1 | tee -a "$LOG"
-  echo "=== rc=$? ===" | tee -a "$LOG"
+  local rc=${PIPESTATUS[0]}   # the COMMAND's status, not tee's
+  echo "=== rc=$rc ===" | tee -a "$LOG"
 }
 
 # 1. kernel parity on real hardware (conftest escape hatch)

@@ -7,7 +7,8 @@ import os
 # (experiments/tpu_session.sh uses it for on-chip kernel parity — the
 # default-on flash specializations must be re-validated on hardware,
 # where Mosaic lowering differs from interpret mode)
-_ON_DEVICE = bool(os.environ.get("PADDLE_TPU_TESTS_ON_DEVICE"))
+_ON_DEVICE = os.environ.get("PADDLE_TPU_TESTS_ON_DEVICE",
+                            "").lower() not in ("", "0", "false", "no")
 
 if not _ON_DEVICE:
     os.environ["JAX_PLATFORMS"] = "cpu"
