@@ -1,0 +1,77 @@
+"""Initializer contracts: fan computation, bounds, statistical moments,
+and structural properties (orthogonality) — formula slips here silently
+destroy training quality.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _collect(init, shape, n=40):
+    outs = []
+    for i in range(n):
+        paddle.seed(1000 + i)
+        p = paddle.create_parameter(shape=shape, dtype="float32",
+                                    default_initializer=init)
+        outs.append(np.asarray(p.value))
+    return np.stack(outs)
+
+
+class TestFanBased:
+    def test_xavier_uniform_bound(self):
+        # bound = sqrt(6/(fan_in+fan_out)); [in=80, out=120] -> ~0.1732
+        s = _collect(paddle.nn.initializer.XavierUniform(), [80, 120])
+        bound = np.sqrt(6.0 / 200.0)
+        assert s.max() <= bound + 1e-6 and s.min() >= -bound - 1e-6
+        assert s.max() > bound * 0.98        # actually fills the range
+        np.testing.assert_allclose(s.std(), bound / np.sqrt(3), rtol=0.05)
+
+    def test_xavier_normal_std(self):
+        s = _collect(paddle.nn.initializer.XavierNormal(), [80, 120])
+        np.testing.assert_allclose(s.std(), np.sqrt(2.0 / 200.0),
+                                   rtol=0.05)
+
+    def test_kaiming_normal_fan_in(self):
+        # std = sqrt(2/fan_in) (relu gain); fan_in = 90
+        s = _collect(paddle.nn.initializer.KaimingNormal(), [90, 60])
+        np.testing.assert_allclose(s.std(), np.sqrt(2.0 / 90.0),
+                                   rtol=0.05)
+
+    def test_kaiming_uniform_bound(self):
+        s = _collect(paddle.nn.initializer.KaimingUniform(), [90, 60])
+        bound = np.sqrt(6.0 / 90.0)
+        assert s.max() <= bound + 1e-6 and s.min() >= -bound - 1e-6
+
+    def test_conv_fan_includes_receptive_field(self):
+        # conv weight [out, in, kh, kw]: fan_in = in*kh*kw = 4*3*3 = 36
+        s = _collect(paddle.nn.initializer.KaimingNormal(), [8, 4, 3, 3])
+        np.testing.assert_allclose(s.std(), np.sqrt(2.0 / 36.0),
+                                   rtol=0.06)
+
+
+class TestStructural:
+    def test_orthogonal_rows(self):
+        paddle.seed(7)
+        p = paddle.create_parameter(
+            shape=[16, 64], dtype="float32",
+            default_initializer=paddle.nn.initializer.Orthogonal())
+        w = np.asarray(p.value)
+        np.testing.assert_allclose(w @ w.T, np.eye(16), atol=1e-4)
+
+    def test_dirac_identity_conv(self):
+        paddle.seed(8)
+        p = paddle.create_parameter(
+            shape=[4, 4, 3, 3], dtype="float32",
+            default_initializer=paddle.nn.initializer.Dirac())
+        w = np.asarray(p.value)
+        # center tap is identity across channels, everything else zero
+        assert np.allclose(w[:, :, 1, 1], np.eye(4))
+        w2 = w.copy()
+        w2[:, :, 1, 1] = 0
+        assert np.allclose(w2, 0)
+
+    def test_truncated_normal_respects_bounds(self):
+        s = _collect(paddle.nn.initializer.TruncatedNormal(std=1.0),
+                     [50, 50], n=10)
+        assert np.abs(s).max() <= 2.0 + 1e-5   # +-2 std truncation
+        assert np.abs(s).max() > 1.5           # not silently clipped small
