@@ -47,6 +47,14 @@ def supports_hb(q_shape, k_shape, dropout_p: float,
     b, sq, h, d = q_shape
     hkv, sk = k_shape[2], k_shape[1]
     it = _interpret() if interpret is None else interpret
+    # 2026-07-31 on-chip finding (experiments/tpu_session.log): Mosaic on
+    # the v5e toolchain rejects the H-batched 3D tpu.matmul this kernel is
+    # built around ("Bad lhs type", remote_compile 500) at every block
+    # size tried — the kernel is interpret-verified only.  Refuse real-TPU
+    # routing until a libtpu that lowers batched dots lands; the per-head
+    # kernel (measured 6.0 ms fwd+bwd at bench shapes) is the device path.
+    if not it:
+        return False
     return (h == hkv and dropout_p == 0.0
             and 2 * h * block * block * 4 <= _VMEM_SCORE_BUDGET
             and _pick_block(sq, block, it) is not None

@@ -501,6 +501,16 @@ def flash_attention_bhsd(q, k, v, *, causal: bool = False,
         seed = jnp.zeros((1,), jnp.int32)
     else:
         seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    # Mosaic (libtpu v5e toolchain) rejects bf16 matmuls whose contraction
+    # dim is not a lane multiple ("Bad lhs type" on the D-contracting
+    # q·kᵀ / dO·vᵀ dots when D % 128 != 0).  fp32 at the same shapes
+    # compiles and passes parity on-chip, so sub-native head dims take the
+    # fp32 path; D % 128 == 0 keeps native bf16 MXU throughput.
+    in_dtype = q.dtype
+    upcast = (not it and q.shape[-1] % 128 != 0
+              and in_dtype != jnp.float32)
+    if upcast:
+        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
     if block_q is None or block_k is None:
         # consult the autotune cache (ops/autotune.py); 1024x1024 is the
         # measured default at llama shapes on v5e
@@ -508,9 +518,10 @@ def flash_attention_bhsd(q, k, v, *, causal: bool = False,
 
         tuned = lookup("flash_attention",
                        flash_signature(q.shape[2], k.shape[2], q.shape[-1],
-                                       causal, jnp.dtype(q.dtype).name)) \
+                                       causal, jnp.dtype(in_dtype).name)) \
             or {}
         block_q = block_q or tuned.get("block_q", 1024)
         block_k = block_k or tuned.get("block_k", 1024)
-    return _flash(q, k, v, seed, causal, float(sm_scale), float(dropout_p),
-                  block_q, block_k, it)
+    out = _flash(q, k, v, seed, causal, float(sm_scale), float(dropout_p),
+                 block_q, block_k, it)
+    return out.astype(in_dtype) if upcast else out

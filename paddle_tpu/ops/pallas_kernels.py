@@ -428,6 +428,13 @@ def fused_linear_param_grad_add(x, dy, dweight):
     bk = _row_block(kdim, 512)
     bn = _row_block(ndim, 512)
     bt = _row_block(tdim, 512)
+    if not _interpret() and (bt % 128 or bk % 128 or bn % 128) \
+            and (x2.dtype != jnp.float32 or dy2.dtype != jnp.float32):
+        # Mosaic rejects bf16 matmuls at sub-lane-multiple tile dims
+        # ("Bad lhs type"); fp32 compiles — real training shapes are
+        # 128-multiples and keep the bf16 MXU path
+        x2 = x2.astype(jnp.float32)
+        dy2 = dy2.astype(jnp.float32)
     dw32 = dweight.astype(jnp.float32)
     return pl.pallas_call(
         _grad_add_kernel,

@@ -13,7 +13,18 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.ops.flash_attention_kernel import flash_attention_bhsd
+from paddle_tpu.ops.flash_attention_kernel import (flash_attention_bhsd,
+                                                   supports)
+
+
+def require_tileable(sq, sk):
+    """Direct-kernel tests at shapes the platform can't tile skip loudly:
+    on real TPU blocks must be 128-multiples, and the PUBLIC router
+    (ops.pallas.flash_attention) falls back to the chunked XLA path for
+    exactly these shapes — the skip mirrors production routing."""
+    if not supports(sq, sk):
+        pytest.skip(f"seq lens ({sq}, {sk}) not tileable on this platform "
+                    "— router falls back to chunked XLA")
 
 
 def sdpa(q, k, v, causal=False, scale=None):
@@ -46,6 +57,7 @@ def rand(*shape, dtype=jnp.float32, seed=0):
 ])
 def test_forward_parity(shape, causal):
     b, hq, hkv, sq, sk, d = shape
+    require_tileable(sq, sk)
     q = rand(b, hq, sq, d, seed=1)
     k = rand(b, hkv, sk, d, seed=2)
     v = rand(b, hkv, sk, d, seed=3)
@@ -61,6 +73,7 @@ def test_causal_sq_gt_sk_empty_rows_grads_zero_and_finite():
     those rows and finite dk/dv (regression: the bwd kernels' re-mask is
     load-bearing only in this case)."""
     b, h, sq, sk, d = 1, 2, 128, 64, 32
+    require_tileable(sq, sk)
     q = rand(b, h, sq, d, seed=1)
     k = rand(b, h, sk, d, seed=2)
     v = rand(b, h, sk, d, seed=3)
@@ -122,6 +135,88 @@ def test_bf16_roundtrip():
                                rtol=3e-2, atol=3e-2)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("shape", [
+    (1, 4, 4, 256, 256, 64),    # MHA, sub-native head dim (fp32-upcast
+                                # path on real TPU — Mosaic rejects bf16
+                                # dots with D % 128 != 0)
+    (1, 4, 2, 256, 256, 128),   # GQA, native-lane head dim (bf16 MXU path)
+])
+def test_device_scale_parity(shape, dtype, causal):
+    """Parity at shapes real-TPU tiling accepts (seq/blocks 128-multiples)
+    in BOTH head-dim regimes and dtypes — the on-chip analog of
+    test_forward_parity, exercised by experiments/tpu_session.sh."""
+    b, hq, hkv, sq, sk, d = shape
+    tol = 3e-2 if dtype == jnp.bfloat16 else 2e-4
+    q = rand(b, hq, sq, d, dtype=dtype, seed=31)
+    k = rand(b, hkv, sk, d, dtype=dtype, seed=32)
+    v = rand(b, hkv, sk, d, dtype=dtype, seed=33)
+    out = flash_attention_bhsd(q, k, v, causal=causal)
+    assert out.dtype == dtype
+    ref = sdpa(q.astype(jnp.float32), k.astype(jnp.float32),
+               v.astype(jnp.float32), causal=causal)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=tol, atol=tol)
+
+
+def test_device_scale_causal_cross_empty_rows():
+    """Device-tileable variant of the sq>sk empty-rows regression (sq=256,
+    sk=128 — both 128-multiples): the bwd re-mask path gets on-chip
+    coverage even though the original 128/64 test skips on real TPU."""
+    b, h, sq, sk, d = 1, 2, 256, 128, 64
+    q = rand(b, h, sq, d, seed=51)
+    k = rand(b, h, sk, d, seed=52)
+    v = rand(b, h, sk, d, seed=53)
+    out = flash_attention_bhsd(q, k, v, causal=True)
+    empty = sq - sk
+    np.testing.assert_array_equal(np.asarray(out[:, :, :empty]), 0.0)
+    dq = jax.grad(lambda q: jnp.sum(
+        flash_attention_bhsd(q, k, v, causal=True) ** 2))(q)
+    assert np.all(np.isfinite(np.asarray(dq)))
+    np.testing.assert_array_equal(np.asarray(dq[:, :, :empty]), 0.0)
+    ref_dq = jax.grad(lambda q: jnp.sum(
+        sdpa(q, k, v, causal=True)[:, :, empty:] ** 2))(q)
+    got_dq = jax.grad(lambda q: jnp.sum(
+        flash_attention_bhsd(q, k, v, causal=True)[:, :, empty:] ** 2))(q)
+    np.testing.assert_allclose(np.asarray(got_dq[:, :, empty:]),
+                               np.asarray(ref_dq[:, :, empty:]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hb_kernel_gated_off_device():
+    """The head-batched kernel is Mosaic-rejected on real TPU (batched 3D
+    tpu.matmul 'Bad lhs type'); supports_hb must refuse device routing
+    regardless of platform this test runs on."""
+    from paddle_tpu.ops.flash_attention_hb import supports_hb
+    assert not supports_hb((1, 256, 8, 128), (1, 256, 8, 128), 0.0,
+                           interpret=False)
+
+
+@pytest.mark.parametrize("d", [64, 128])
+def test_device_scale_grad_parity(d):
+    """bf16 backward at device-tileable shapes: covers the D-contracting
+    dO·vᵀ dot in both the native-bf16 (d=128) and fp32-upcast (d=64)
+    regimes."""
+    b, hq, hkv, s = 1, 4, 2, 256
+    q = rand(b, hq, s, d, dtype=jnp.bfloat16, seed=41)
+    k = rand(b, hkv, s, d, dtype=jnp.bfloat16, seed=42)
+    v = rand(b, hkv, s, d, dtype=jnp.bfloat16, seed=43)
+
+    def f(fn):
+        return jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))
+
+    got = f(lambda q, k, v: flash_attention_bhsd(q, k, v, causal=True))(
+        q, k, v)
+    want = f(lambda q, k, v: sdpa(q, k, v, causal=True))(q, k, v)
+    for a, b_ in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b_, np.float32),
+                                   rtol=6e-2, atol=6e-2)
+
+
 class TestDropout:
     def test_deterministic_in_seed(self):
         q = rand(1, 2, 128, 32, seed=11)
@@ -173,6 +268,7 @@ class TestDropout:
     def test_finite_difference_dq(self):
         # same seed → same mask → finite differences must match the
         # analytic gradient even WITH dropout active
+        require_tileable(8, 8)
         q = rand(1, 1, 8, 16, seed=21).astype(jnp.float64).astype(jnp.float32)
         k = rand(1, 1, 8, 16, seed=22)
         v = rand(1, 1, 8, 16, seed=23)
