@@ -19,6 +19,12 @@ on a >=3% full-step win.
                         fusion (PERF.md's ~25ms convert bucket).
 
 Run: python experiments/exp_dots.py            (TPU; ~2 min)
+
+Each variant runs in its OWN subprocess with a wall-clock budget
+(EXP_VARIANT_SECS, default 600): the 2026-07-31 session lost the whole
+experiment when the FIRST variant's remote compile died on a transport
+error and the process then hung to the step timeout — per-variant
+isolation means one wedged compile costs one variant, not the session.
 """
 import json
 import os
@@ -28,8 +34,38 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+VARIANTS = ("E1_unroll1", "E1_unroll2", "E1_unroll4",
+            "E2_einsum3d", "E2_flat2d", "E3_rhs_transposed", "E4_f32_out")
 
-def main():
+
+def run_variants():
+    """Parent: one subprocess per variant via the shared budget harness
+    (own session, TERM-then-KILL group, SIGTERM forwarded — a hung
+    remote-compile helper can never outlive us holding the claim)."""
+    from _budget import run_budgeted
+
+    budget = int(os.environ.get("EXP_VARIANT_SECS", "600"))
+    lines = []
+    for name in VARIANTS:
+        r = run_budgeted([sys.executable, "-u", os.path.abspath(__file__),
+                          "--variant", name], budget)
+        if r.timed_out:
+            print(json.dumps({name: f"hung >{budget}s (group killed)"}),
+                  flush=True)
+        if r.err.strip():
+            sys.stderr.write(f"--- {name} stderr tail ---\n"
+                             + r.err[-2000:] + "\n")
+        got = [ln for ln in r.out.splitlines()
+               if ln.strip().startswith("{")]
+        for ln in got:
+            print(ln, flush=True)
+        if got:
+            lines.append(name)
+    print(json.dumps({"variants_with_output": len(lines),
+                      "of": len(VARIANTS)}))
+
+
+def main(only: str = None):
     import jax
 
     if os.environ.get("EXP_FORCE_CPU"):
@@ -58,16 +94,19 @@ def main():
     else:
         cfg = llama_config("tiny")
         B, S = 2, 64
-    model = LlamaForCausalLM(cfg)
-    params = {k: p.value for k, p in model.named_parameters()}
-    stacked, rest = stack_params(params, cfg)
     rng = np.random.RandomState(0)
-    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
-    y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
     results = {}
+    e1_unrolls = [u for u in (1, 2, 4)
+                  if only is None or only == f"E1_unroll{u}"]
+    if e1_unrolls:
+        model = LlamaForCausalLM(cfg)
+        params = {k: p.value for k, p in model.named_parameters()}
+        stacked, rest = stack_params(params, cfg)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+        y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
 
     # ---- E1: scan unroll on the full loss fwd+bwd --------------------------
-    for unroll in (1, 2, 4):
+    for unroll in e1_unrolls:
         try:
             loss_fn = build_loss_fn(cfg, remat=True, scan_unroll=unroll)
 
@@ -116,6 +155,8 @@ def main():
             ("E2_flat2d", e2_flat, (x3, w)),
             ("E3_rhs_transposed", e3_transposed, (x3, wt)),
             ("E4_f32_out", e4_f32out, (x3, w))):
+        if only is not None and only != name:
+            continue
         try:
             ms = timed(jax.jit(fn), args) * 1e3
             results[f"{name}_ms"] = round(ms, 3)
@@ -127,4 +168,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--variant":
+        main(only=sys.argv[2])
+    else:
+        run_variants()

@@ -13,9 +13,33 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=experiments/tpu_session.log
 run() {
-  echo "=== $(date -u +%FT%TZ) $*" | tee -a "$LOG"
-  timeout "${STEP_TIMEOUT:-2400}" "$@" 2>&1 | tee -a "$LOG"
-  local rc=${PIPESTATUS[0]}   # the COMMAND's status, not tee's
+  # Each step runs in its OWN process group (setsid) and the whole group
+  # is SIGKILLed on timeout — `timeout` alone signals only the direct
+  # child, and a remote-compile helper orphaned that way keeps holding
+  # the device claim for every later step (observed 2026-07-31: exp_dots
+  # and the autotune sweep hung >20min; killing the script leaked the
+  # sweep process, which then wedged the claim for fresh probes).
+  echo "=== $(date -u +%FT%TZ) $* (output -> $LOG; tail -f it)" \
+    | tee -a "$LOG"
+  setsid "$@" >>"$LOG" 2>&1 &
+  local pid=$! t=${STEP_TIMEOUT:-2400} waited=0 rc
+  while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt "$t" ]; do
+    sleep 5; waited=$((waited + 5))
+  done
+  if kill -0 "$pid" 2>/dev/null; then
+    # TERM first: the bench/experiment watchdogs trap it to reap their
+    # own detached children (which live in their OWN sessions and would
+    # escape a bare group-KILL); KILL after a grace period
+    kill -TERM -- "-$pid" 2>/dev/null
+    local grace=0
+    while kill -0 "$pid" 2>/dev/null && [ "$grace" -lt 15 ]; do
+      sleep 1; grace=$((grace + 1))
+    done
+    kill -KILL -- "-$pid" 2>/dev/null
+    rc=137
+  else
+    wait "$pid"; rc=$?
+  fi
   echo "=== rc=$rc ===" | tee -a "$LOG"
 }
 
@@ -23,16 +47,21 @@ run() {
 run env PADDLE_TPU_TESTS_ON_DEVICE=1 python -m pytest \
     tests/test_flash_attention.py tests/test_flash_hb.py \
     tests/test_pallas_kernels.py -q -p no:cacheprovider
-# 2. round record
-run python bench.py
+# 2. round record (bench has its own group-killing watchdog: accelerator
+#    attempt BENCH_WATCHDOG_SECS then a 600s CPU retry — keep the outer
+#    step timeout above their sum so the CPU retry can finish)
+STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py
 # 3. flag-deciding experiments
 run python experiments/exp_flash_hb.py     # FLAGS_flash_head_batched
-run python experiments/exp_dots.py         # scan_unroll default
-# 4. autotune sweep -> .autotune_cache.json (commit it)
-run python experiments/exp_autotune_sweep.py
-# 5. bigger configs
-run python bench.py 1.3b
-run python bench.py ragged
-run python bench.py decode
+# exp_dots: 7 variants x EXP_VARIANT_SECS(600) worst case — the step
+# timeout must cover the per-variant budgets, not fight them
+STEP_TIMEOUT=4500 run python experiments/exp_dots.py   # scan_unroll default
+# 4. autotune sweep -> .autotune_cache.json (commit it); 5 trials x
+#    EXP_TRIAL_SECS(900)
+STEP_TIMEOUT=4800 run python experiments/exp_autotune_sweep.py
+# 5. bigger configs (cold-cache compiles can be slow through the tunnel)
+STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py 1.3b
+STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py ragged
+STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py decode
 echo "=== session done; review $LOG, flip flags per PERF.md decision" \
      "rules, re-run bench.py, commit .autotune_cache.json ===" | tee -a "$LOG"

@@ -78,9 +78,14 @@ class AutoTuneCache:
 
     # -- persistence -------------------------------------------------------
     def save(self, path: Optional[str] = None):
+        """Atomic write (temp + rename): a sweep trial can be group-killed
+        mid-save, and a truncated committed cache would poison every later
+        trial's merge-load."""
         path = path or self._path or _default_path()
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(self._table, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
 
     def load(self, path: Optional[str] = None) -> bool:
         path = path or self._path or _default_path()
