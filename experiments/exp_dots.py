@@ -17,6 +17,10 @@ on a >=3% full-step win.
   E4 dot out dtype    — bf16 dot -> f32 output (preferred_element_type)
                         vs bf16 output + later upcast: convert-tail
                         fusion (PERF.md's ~25ms convert bucket).
+  E5 remat attn_out   — jax.checkpoint save_only_these_names("attn_out"):
+                        keep ONLY flash outputs across the scan; kills
+                        the refwd-flash bucket (~22ms/step) for ~800MB
+                        (vs remat="dots"'s rejected 8.4GB).
 
 Run: python experiments/exp_dots.py            (TPU; ~2 min)
 
@@ -34,7 +38,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-VARIANTS = ("E1_unroll1", "E1_unroll2", "E1_unroll4",
+VARIANTS = ("E1_unroll1", "E1_unroll2", "E1_unroll4", "E5_remat_attn_out",
             "E2_einsum3d", "E2_flat2d", "E3_rhs_transposed", "E4_f32_out")
 
 
@@ -96,19 +100,25 @@ def main(only: str = None):
         B, S = 2, 64
     rng = np.random.RandomState(0)
     results = {}
-    e1_unrolls = [u for u in (1, 2, 4)
-                  if only is None or only == f"E1_unroll{u}"]
-    if e1_unrolls:
+    full_step = [(f"E1_unroll{u}", dict(remat=True, scan_unroll=u))
+                 for u in (1, 2, 4)]
+    # E5: selective remat — save ONLY the flash outputs; kills the
+    # refwd-flash bucket (~22ms/step) for ~800MB at bench shapes (vs the
+    # rejected remat="dots" 8.4GB)
+    full_step.append(("E5_remat_attn_out", dict(remat="attn_out")))
+    full_step = [fs for fs in full_step
+                 if only is None or only == fs[0]]
+    if full_step:
         model = LlamaForCausalLM(cfg)
         params = {k: p.value for k, p in model.named_parameters()}
         stacked, rest = stack_params(params, cfg)
         ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
         y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
 
-    # ---- E1: scan unroll on the full loss fwd+bwd --------------------------
-    for unroll in e1_unrolls:
+    # ---- E1/E5: full loss fwd+bwd (scan unroll / remat policy) -------------
+    for vname, build_kw in full_step:
         try:
-            loss_fn = build_loss_fn(cfg, remat=True, scan_unroll=unroll)
+            loss_fn = build_loss_fn(cfg, **build_kw)
 
             # timed() chains its perturbation through arg 0, which must
             # be a float array: thread the embedding weight through
@@ -121,12 +131,11 @@ def main(only: str = None):
 
             ms = timed(jax.jit(gfn),
                        (rest["model.embed_tokens.weight"],)) * 1e3
-            results[f"E1_unroll{unroll}_fwdbwd_ms"] = round(ms, 2)
+            results[f"{vname}_fwdbwd_ms"] = round(ms, 2)
         except Exception as e:  # noqa: BLE001
-            results[f"E1_unroll{unroll}_fwdbwd_ms"] = \
+            results[f"{vname}_fwdbwd_ms"] = \
                 f"{type(e).__name__}: {e}"[:120]
-        print(json.dumps({f"E1_unroll{unroll}":
-                          results[f"E1_unroll{unroll}_fwdbwd_ms"]}),
+        print(json.dumps({vname: results[f"{vname}_fwdbwd_ms"]}),
               flush=True)
 
     # ---- E2/E3/E4: dot micro-forms at layer shapes -------------------------

@@ -43,8 +43,12 @@ run() {
   echo "=== rc=$rc ===" | tee -a "$LOG"
 }
 
-# 1. kernel parity on real hardware (conftest escape hatch)
-run env PADDLE_TPU_TESTS_ON_DEVICE=1 python -m pytest \
+# 1. kernel parity on real hardware (conftest escape hatch);
+#    PADDLE_TPU_HB_ON_DEVICE=1 also exercises the restructured
+#    head-batched kernel on-chip (its device routing is gated off until
+#    this passes + exp_flash_hb shows a win)
+run env PADDLE_TPU_TESTS_ON_DEVICE=1 PADDLE_TPU_HB_ON_DEVICE=1 \
+    python -m pytest \
     tests/test_flash_attention.py tests/test_flash_hb.py \
     tests/test_pallas_kernels.py -q -p no:cacheprovider
 # 2. round record (bench has its own group-killing watchdog: accelerator
@@ -53,9 +57,9 @@ run env PADDLE_TPU_TESTS_ON_DEVICE=1 python -m pytest \
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py
 # 3. flag-deciding experiments
 run python experiments/exp_flash_hb.py     # FLAGS_flash_head_batched
-# exp_dots: 7 variants x EXP_VARIANT_SECS(600) worst case — the step
+# exp_dots: 8 variants x EXP_VARIANT_SECS(600) worst case — the step
 # timeout must cover the per-variant budgets, not fight them
-STEP_TIMEOUT=4500 run python experiments/exp_dots.py   # scan_unroll default
+STEP_TIMEOUT=5100 run python experiments/exp_dots.py   # scan_unroll+remat
 # 4. autotune sweep -> .autotune_cache.json (commit it); 5 trials x
 #    EXP_TRIAL_SECS(900)
 STEP_TIMEOUT=4800 run python experiments/exp_autotune_sweep.py

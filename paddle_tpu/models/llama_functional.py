@@ -82,6 +82,13 @@ def _layer_fwd(lp: Dict[str, Any], x, cos, sin, cfg: LlamaConfig):
     from ..ops.pallas import flash_attention
 
     ctx = flash_attention(qh, kh, vh, causal=True)
+    # named for remat="attn_out": saving ONLY the flash output removes
+    # the refwd-flash bucket (~22ms/step at 350M, PERF.md decomposition)
+    # for B·S·H_model bytes/layer — ~800MB at the bench config, far less
+    # than remat="dots"'s rejected 8.4GB of dot outputs
+    from jax.ad_checkpoint import checkpoint_name
+
+    ctx = checkpoint_name(ctx, "attn_out")
     ctx = ctx.reshape(b, s, cfg.num_attention_heads * hd)
     x = x + ctx @ lp["self_attn.o_proj.weight"]
     xn = _rms(x, lp["post_attention_layernorm.weight"], cfg.rms_norm_eps)
@@ -97,6 +104,9 @@ def _remat_policy(remat):
     cost of per-layer dot residuals); False/"none" = no checkpoint."""
     if remat in (True, "full"):
         return {}
+    if remat == "attn_out":
+        return {"policy":
+                jax.checkpoint_policies.save_only_these_names("attn_out")}
     if remat == "dots":
         return {"policy":
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable}

@@ -7,7 +7,10 @@ illegal on TPU (the H dim breaks the (8,128) tiling), but a HEAD-BATCHED
 block (1, bq, H, D) is legal: the last two dims are (H, D) = (8, 128).
 This kernel processes ALL heads per grid step:
 
-- scores are a single batched ``dot_general`` over H: (H, bq, bk) in VMEM,
+- scores are a statically-unrolled Python loop of per-head 2D dots over
+  ``[:, i, :]`` slices of the native block, stacked to (H, bq, bk) in
+  VMEM (the original H-batched 3D ``dot_general`` was Mosaic-rejected
+  on-chip 2026-07-31 — "Bad lhs type"; see ``_per_head``),
 - online-softmax stats are (H, bq, 1),
 - the grid drops the head dimension: (B, nq, nk) — H x fewer grid steps.
 
@@ -25,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -48,12 +52,17 @@ def supports_hb(q_shape, k_shape, dropout_p: float,
     hkv, sk = k_shape[2], k_shape[1]
     it = _interpret() if interpret is None else interpret
     # 2026-07-31 on-chip finding (experiments/tpu_session.log): Mosaic on
-    # the v5e toolchain rejects the H-batched 3D tpu.matmul this kernel is
-    # built around ("Bad lhs type", remote_compile 500) at every block
-    # size tried — the kernel is interpret-verified only.  Refuse real-TPU
-    # routing until a libtpu that lowers batched dots lands; the per-head
-    # kernel (measured 6.0 ms fwd+bwd at bench shapes) is the device path.
-    if not it:
+    # the v5e toolchain rejected the H-batched 3D tpu.matmul the original
+    # kernel was built around ("Bad lhs type", remote_compile 500) at
+    # every block size tried.  The kernel has since been restructured to
+    # statically-unrolled per-head 2D dots (whose slice/store forms are
+    # themselves unverified on hardware — see _per_head), so device
+    # routing stays off until PADDLE_TPU_HB_ON_DEVICE=1 — set by the
+    # session script's on-chip test step (tpu_session.sh step 1; note
+    # exp_flash_hb calls the kernel DIRECTLY and never consults this
+    # gate) — verifies it; flip the default only after a measured win.
+    # Per-head (6.0 ms fwd+bwd at bench shapes) remains the device path.
+    if not it and os.environ.get("PADDLE_TPU_HB_ON_DEVICE", "") != "1":
         return False
     return (h == hkv and dropout_p == 0.0
             and 2 * h * block * block * 4 <= _VMEM_SCORE_BUDGET
@@ -61,12 +70,33 @@ def supports_hb(q_shape, k_shape, dropout_p: float,
             and _pick_block(sk, block, it) is not None)
 
 
+def _dot2d(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _per_head(fn, h):
+    """Static Python loop over heads, stacked to (H, ...): Mosaic on the
+    v5e toolchain rejects H-batched 3D tpu.matmul ("Bad lhs type",
+    2026-07-31 on-chip).  The replacement 2D dot forms match the per-head
+    kernel's on-chip-proven dots; the per-head STATIC slices of the
+    native (bq, H, D) block ([:, i, :] — no transposes, no materialized
+    head-leading copies) are themselves unverified on hardware until the
+    session script's on-chip test step runs.  H is a trace-time constant,
+    so this unrolls — kernel code size grows H×, MXU work is
+    identical."""
+    return jnp.stack([fn(i) for i in range(h)], 0)
+
+
 def _scores_hb(q, k, sm_scale, causal, iq, ik, bq, bk, offset):
     """(H, bq, bk) fp32 scores; masking shared with the per-head kernel
-    (_apply_causal_mask) so the alignment convention cannot diverge."""
-    s = jax.lax.dot_general(
-        q, k, (((2,), (2,)), ((1,), (1,))),      # batch H, contract D
-        preferred_element_type=jnp.float32) * sm_scale
+    (_apply_causal_mask) so the alignment convention cannot diverge.
+    ``q``/``k`` arrive in the NATIVE block layout (bq|bk, H, D); heads
+    are sliced statically, one 2D NT dot each."""
+    h = q.shape[1]
+    s = _per_head(
+        lambda i: _dot2d(q[:, i, :], k[:, i, :], ((1,), (1,))), h) \
+        * sm_scale
     return _apply_causal_mask(s, causal, iq, ik, bq, bk, offset,
                               lead_batch=True)
 
@@ -83,9 +113,10 @@ def _fwd_kernel_hb(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     def _compute():
-        q = q_ref[0]                          # (bq, H, D)
+        q = q_ref[0]                          # (bq, H, D) native layout
         k = k_ref[0]                          # (bk, H, D)
         v = v_ref[0]
+        h = q.shape[1]
         s, valid = _scores_hb(q, k, sm_scale, causal, iq, ik, bq, bk,
                               offset)         # (H, bq, bk)
         m_prev = m_ref[:, :, 0:1]             # (H, bq, 1)
@@ -97,10 +128,10 @@ def _fwd_kernel_hb(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
             p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:, :, 0:1] = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
-        # (H, bq, bk) @ (bk, H, D) batched over H -> (H, bq, D)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)
+        # per-head P_h @ V_h: (bq, bk) x (bk, D) -> stacked (H, bq, D)
+        pv = _per_head(
+            lambda i: _dot2d(p[i].astype(v.dtype), v[:, i, :],
+                             ((1,), (0,))), h)
         acc_ref[...] = acc_ref[...] * alpha + pv
         m_ref[:, :, 0:1] = m_new
 
@@ -114,8 +145,9 @@ def _fwd_kernel_hb(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
     def _finalize():
         l = l_ref[:, :, 0:1]
         l_safe = jnp.maximum(l, 1e-30)
-        o_ref[0] = jnp.transpose(acc_ref[...] / l_safe,
-                                 (1, 0, 2)).astype(o_ref.dtype)
+        for i in range(acc_ref.shape[0]):     # per-head static stores —
+            o_ref[0, :, i, :] = (acc_ref[i] / l_safe[i]).astype(
+                o_ref.dtype)                  # no (H,bq,D) transpose
         lse_ref[0] = (m_ref[:, :, 0:1] + jnp.log(l_safe))[:, :, 0]
 
 
@@ -161,10 +193,11 @@ def _bwd_dq_kernel_hb(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _compute():
-        q = q_ref[0]
-        k = k_ref[0]
+        q = q_ref[0]                                  # (bq, H, D) native
+        k = k_ref[0]                                  # (bk, H, D)
         v = v_ref[0]
-        do = jnp.transpose(do_ref[0], (1, 0, 2))      # (H, bq, D)
+        do = do_ref[0]                                # (bq, H, D)
+        h = q.shape[1]
         lse = lse_ref[0][:, :, None]                  # (H, bq, 1)
         delta = delta_ref[0][:, :, None]
         s, valid = _scores_hb(q, k, sm_scale, causal, iq, ik, bq, bk,
@@ -172,15 +205,14 @@ def _bwd_dq_kernel_hb(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)
         if causal and offset < 0:
             p = jnp.where(valid, p, 0.0)
-        # dP = dO @ V^T batched over H: (H,bq,D) x (bk,H,D) -> (H,bq,bk)
-        dpd = jax.lax.dot_general(
-            do, v, (((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)
+        # per-head dP_h = dO_h @ V_h^T: (bq, D) x (bk, D) -> (H, bq, bk)
+        dpd = _per_head(
+            lambda i: _dot2d(do[:, i, :], v[:, i, :], ((1,), (1,))), h)
         ds = p * (dpd - delta)
-        # dQ += dS @ K batched: (H,bq,bk) x (bk,H,D) -> (H,bq,D)
-        acc_ref[...] += jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32) * sm_scale
+        # per-head dQ_h += dS_h @ K_h: (bq, bk) x (bk, D) -> (H, bq, D)
+        acc_ref[...] += _per_head(
+            lambda i: _dot2d(ds[i].astype(k.dtype), k[:, i, :],
+                             ((1,), (0,))), h) * sm_scale
 
     if causal:
         needed = ik * bk <= iq * bq + bq - 1 + offset
@@ -190,8 +222,8 @@ def _bwd_dq_kernel_hb(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ik == nk - 1)
     def _finalize():
-        dq_ref[0] = jnp.transpose(acc_ref[...], (1, 0, 2)).astype(
-            dq_ref.dtype)
+        for i in range(acc_ref.shape[0]):     # per-head static stores
+            dq_ref[0, :, i, :] = acc_ref[i].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel_hb(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -206,10 +238,11 @@ def _bwd_dkv_kernel_hb(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        q = q_ref[0]
-        k = k_ref[0]
+        q = q_ref[0]                                  # (bq, H, D) native
+        k = k_ref[0]                                  # (bk, H, D)
         v = v_ref[0]
-        do = jnp.transpose(do_ref[0], (1, 0, 2))      # (H, bq, D)
+        do = do_ref[0]                                # (bq, H, D)
+        h = q.shape[1]
         lse = lse_ref[0][:, :, None]
         delta = delta_ref[0][:, :, None]
         s, valid = _scores_hb(q, k, sm_scale, causal, iq, ik, bq, bk,
@@ -217,18 +250,17 @@ def _bwd_dkv_kernel_hb(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)
         if causal and offset < 0:
             p = jnp.where(valid, p, 0.0)
-        dpd = jax.lax.dot_general(
-            do, v, (((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)
+        dpd = _per_head(
+            lambda i: _dot2d(do[:, i, :], v[:, i, :], ((1,), (1,))), h)
         ds = p * (dpd - delta)
-        # dV += P^T @ dO batched: (H,bq,bk)^T x (H,bq,D) -> (H,bk,D)
-        dv_acc[...] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        # dK += dS^T @ Q batched: (H,bq,bk)^T x (bq,H,D) -> (H,bk,D)
-        dk_acc[...] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((1,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32) * sm_scale
+        # per-head dV_h += P_h^T @ dO_h: (bq, bk) x (bq, D) -> (H, bk, D)
+        dv_acc[...] += _per_head(
+            lambda i: _dot2d(p[i].astype(do.dtype), do[:, i, :],
+                             ((0,), (0,))), h)
+        # per-head dK_h += dS_h^T @ Q_h: (bq, bk) x (bq, D) -> (H, bk, D)
+        dk_acc[...] += _per_head(
+            lambda i: _dot2d(ds[i].astype(q.dtype), q[:, i, :],
+                             ((0,), (0,))), h) * sm_scale
 
     if causal:
         needed = ik * bk <= iq * bq + bq - 1 + offset
@@ -238,10 +270,9 @@ def _bwd_dkv_kernel_hb(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(iq == nq - 1)
     def _finalize():
-        dk_ref[0] = jnp.transpose(dk_acc[...], (1, 0, 2)).astype(
-            dk_ref.dtype)
-        dv_ref[0] = jnp.transpose(dv_acc[...], (1, 0, 2)).astype(
-            dv_ref.dtype)
+        for i in range(dk_acc.shape[0]):      # per-head static stores
+            dk_ref[0, :, i, :] = dk_acc[i].astype(dk_ref.dtype)
+            dv_ref[0, :, i, :] = dv_acc[i].astype(dv_ref.dtype)
 
 
 def _bwd_impl_hb(q, k, v, out, lse, do, causal, sm_scale, block_q, block_k,

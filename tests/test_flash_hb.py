@@ -12,15 +12,21 @@ import jax.numpy as jnp
 from paddle_tpu.ops.flash_attention_hb import (flash_attention_bshd_hb,
                                                supports_hb)
 
-# The hb kernel is INTERPRET-ONLY: Mosaic on the v5e toolchain rejects its
-# H-batched 3D tpu.matmul ("Bad lhs type") at every block size tried
-# on-chip (experiments/tpu_session.log 2026-07-31), so supports_hb gates
-# it off real TPUs and the router uses the per-head kernel there.
+# The hb kernel's ORIGINAL batched-3D-dot form was Mosaic-rejected on-chip
+# ("Bad lhs type", experiments/tpu_session.log 2026-07-31); it has been
+# restructured to per-head 2D dots but that form is unverified on hardware,
+# so supports_hb refuses device routing (and this module skips on device)
+# unless the PADDLE_TPU_HB_ON_DEVICE=1 escape hatch opts in — the session
+# script's on-chip test step sets it.
+import os
+
 from paddle_tpu.ops.flash_attention_kernel import _interpret
 
 pytestmark = pytest.mark.skipif(
-    not _interpret(),
-    reason="hb kernel is interpret-only (Mosaic batched-matmul rejection)")
+    not _interpret() and os.environ.get("PADDLE_TPU_HB_ON_DEVICE") != "1",
+    reason="hb kernel not hardware-verified (original batched-dot form "
+           "was Mosaic-rejected; set PADDLE_TPU_HB_ON_DEVICE=1 to test "
+           "the per-head-unrolled restructure on-chip)")
 
 
 def ref_attention(q, k, v, causal, offset):

@@ -91,3 +91,40 @@ class TestOpTail2:
         q_ref = np.linalg.qr(a)[0]
         np.testing.assert_allclose(np.asarray(q.numpy()), q_ref.astype(
             np.float32), atol=1e-4)
+
+
+class TestRematPolicies:
+    """remat="attn_out" (save_only_these_names over the flash output,
+    llama_functional._remat_policy) must be grad-exact vs full remat and
+    no-remat — it changes WHAT is recomputed, never the math."""
+
+    def test_remat_modes_grad_exact(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models import LlamaForCausalLM, llama_config
+        from paddle_tpu.models.llama_functional import (build_loss_fn,
+                                                        stack_params)
+
+        cfg = llama_config("tiny")
+        m = LlamaForCausalLM(cfg)
+        params = {k: p.value for k, p in m.named_parameters()}
+        stacked, rest = stack_params(params, cfg)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 32)), jnp.int32)
+        y = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 32)), jnp.int32)
+        outs = {}
+        for mode in (True, "attn_out", "none"):
+            lf = build_loss_fn(cfg, remat=mode)
+            loss = float(jax.jit(lambda s, r, _lf=lf: _lf(s, r, ids, y))(
+                stacked, rest))
+            g = jax.grad(lambda s, _lf=lf: _lf(s, rest, ids, y))(stacked)
+            outs[mode] = (loss, g)
+        l0, g0 = outs[True]
+        for mode in ("attn_out", "none"):
+            l1, g1 = outs[mode]
+            assert l1 == pytest.approx(l0, abs=1e-6)
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+                g0, g1)
