@@ -138,8 +138,14 @@ def main(model_size: str = "350m"):
     # regardless of depth (an inlined 24-layer remat+vjp HLO took the
     # remote compile helper >40 min; this compiles in ~1 min)
     stacked, rest = stack_params(params, cfg)
-    step, init = build_train_step(cfg, lr=1e-4, remat=True,
-                                  moment_dtype=moment_dtype)
+    # BENCH_REMAT (full|attn_out|none) / BENCH_SCAN_UNROLL: the exp_dots
+    # E1/E5 levers, env-switchable so a TPU session can A/B the full
+    # bench without code edits; defaults match the recorded baseline
+    remat_env = os.environ.get("BENCH_REMAT", "full")
+    remat = True if remat_env == "full" else remat_env
+    step, init = build_train_step(
+        cfg, lr=1e-4, remat=remat, moment_dtype=moment_dtype,
+        scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")))
     opt_state = init(stacked, rest)
 
     # ONE dispatch for the whole timed loop (lax.fori_loop inside jit): the

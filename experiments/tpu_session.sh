@@ -67,5 +67,11 @@ STEP_TIMEOUT=4800 run python experiments/exp_autotune_sweep.py
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py 1.3b
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py ragged
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py decode
+# 6. lever A/B on the full bench (log evidence, not the round record;
+#    flip a default in code only on a >=3% full-step win per PERF.md)
+STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 BENCH_REMAT=attn_out \
+    python bench.py
+STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 BENCH_SCAN_UNROLL=2 \
+    python bench.py
 echo "=== session done; review $LOG, flip flags per PERF.md decision" \
      "rules, re-run bench.py, commit .autotune_cache.json ===" | tee -a "$LOG"

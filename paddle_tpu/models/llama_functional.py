@@ -157,15 +157,17 @@ def build_loss_fn(cfg: LlamaConfig, remat=True,
 
 def build_train_step(cfg: LlamaConfig, lr: float = 1e-4,
                      clip_norm: float = 1.0, remat=True,
-                     moment_dtype=None):
+                     moment_dtype=None, scan_unroll: int = 1):
     """Jittable AdamW train step over (stacked, rest) param pytrees.
     Optimizer state is stacked too — the update compiles once per tensor
     kind, not once per layer. ``moment_dtype=jnp.bfloat16`` halves
-    optimizer HBM (the 1.3B-on-one-chip policy; math stays fp32)."""
+    optimizer HBM (the 1.3B-on-one-chip policy; math stays fp32).
+    ``remat``/``scan_unroll`` pass through to the loss (exp_dots E1/E5
+    levers)."""
     from ..optimizer.functional import (adamw_init, adamw_update,
                                         clip_by_global_norm)
 
-    loss_fn = build_loss_fn(cfg, remat)
+    loss_fn = build_loss_fn(cfg, remat, scan_unroll=scan_unroll)
 
     def init(stacked, rest):
         return adamw_init({"stacked": stacked, "rest": rest},

@@ -116,10 +116,9 @@ class TestRematPolicies:
         outs = {}
         for mode in (True, "attn_out", "none"):
             lf = build_loss_fn(cfg, remat=mode)
-            loss = float(jax.jit(lambda s, r, _lf=lf: _lf(s, r, ids, y))(
-                stacked, rest))
-            g = jax.grad(lambda s, _lf=lf: _lf(s, rest, ids, y))(stacked)
-            outs[mode] = (loss, g)
+            loss, g = jax.jit(jax.value_and_grad(
+                lambda s, _lf=lf: _lf(s, rest, ids, y)))(stacked)
+            outs[mode] = (float(loss), g)
         l0, g0 = outs[True]
         for mode in ("attn_out", "none"):
             l1, g1 = outs[mode]
