@@ -141,8 +141,7 @@ def main(model_size: str = "350m"):
     # BENCH_REMAT (full|attn_out|none) / BENCH_SCAN_UNROLL: the exp_dots
     # E1/E5 levers, env-switchable so a TPU session can A/B the full
     # bench without code edits; defaults match the recorded baseline
-    remat_env = os.environ.get("BENCH_REMAT", "full")
-    remat = True if remat_env == "full" else remat_env
+    remat = os.environ.get("BENCH_REMAT", "full")  # _remat_policy vocab
     step, init = build_train_step(
         cfg, lr=1e-4, remat=remat, moment_dtype=moment_dtype,
         scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")))
@@ -228,6 +227,14 @@ def main(model_size: str = "350m"):
                  "zero_stage": m.get("zero_stage"),
                  "peak_gib": m.get("peak_gib"),
                  "fits": m.get("fits", False)} for m in mem]
+        except (OSError, ValueError):
+            pass
+        try:
+            # the last REAL-hardware record this repo captured (written
+            # by a TPU session from its own bench output, committed with
+            # provenance) — clearly labeled: it is NOT this run's number
+            rec["tpu_session_record"] = json.load(
+                open(os.path.join(here, "TPU_SESSION_RECORD.json")))
         except (OSError, ValueError):
             pass
     print(json.dumps(rec))
