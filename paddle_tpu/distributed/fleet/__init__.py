@@ -469,12 +469,28 @@ def is_worker() -> bool:
     return rm.is_worker()
 
 
+def _ps_plane():
+    """Data-plane selection (must be consistent across the server group
+    and all trainers): PADDLE_PS_DATA_PLANE=native picks the C++ plane
+    (ps/native.py over native/src/ps_table.cc — the brpc-analog hot
+    path); default is the full-featured Python plane."""
+    import os
+
+    if os.environ.get("PADDLE_PS_DATA_PLANE", "python") == "native":
+        from ..ps.native import NativePsClient, NativePsServer
+
+        return NativePsServer, NativePsClient
+    from ..ps import PsClient, PsServer
+
+    return PsServer, PsClient
+
+
 def init_server(*args, **kwargs):
     """Build this node's PsServer shard (reference fleet.init_server).
     An optional ``dirname`` restores tables previously written by
     ``PsClient.save`` (the reference's load-model-on-init contract).
     Binds the port from the env contract; run_server() serves."""
-    from ..ps import PsServer
+    PsServer, _ = _ps_plane()
 
     rm = _ps_role_maker()
     ep = rm._server_endpoints[rm._server_index]
@@ -499,7 +515,7 @@ def init_worker(*args, **kwargs):
     """Connect this trainer to the server group (reference
     fleet.init_worker); the PsClient is then available via
     fleet.get_ps_client() and used by DistributedEmbedding."""
-    from ..ps import PsClient
+    _, PsClient = _ps_plane()
 
     rm = _ps_role_maker()
     client = PsClient(rm._server_endpoints)

@@ -67,6 +67,38 @@ def _recv(sock: socket.socket):
     return pickle.loads(bytes(buf))
 
 
+def _sm64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 over uint64 numpy arrays — the row-init hash SHARED
+    with the native data plane (native/src/ps_table.cc::sm64); both
+    planes must produce bit-identical rows so tables are interchangeable
+    (cross-plane parity is tested)."""
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+def _hash_uniform(seed: int, server_idx: int, rid: int, dim: int,
+                  init_range: float) -> np.ndarray:
+    """Deterministic uniform[-r, r) row, portable across planes: float64
+    from the top 53 bits of splitmix64, cast to float32 (matches the C++
+    double path exactly)."""
+    base = _sm64(np.asarray([np.uint64(
+        (seed * 1000003 + server_idx) & 0xFFFFFFFFFFFFFFFF)],
+        np.uint64))[0]
+    h0 = _sm64(np.asarray([base ^ np.uint64(rid & 0xFFFFFFFFFFFFFFFF)],
+                          np.uint64))[0]
+    with np.errstate(over="ignore"):
+        v = _sm64(h0 + np.arange(dim, dtype=np.uint64))
+    u = (v >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+    # init_range through float32 first: the native plane's TableCfg
+    # carries it as f32 on the wire, and bit-parity requires multiplying
+    # by the same double (double(float(r)) != double(r) for e.g. 0.1)
+    r = np.float64(np.float32(init_range))
+    return ((2.0 * u - 1.0) * r).astype(np.float32)
+
+
 class TableConfig:
     """One table's schema + server-side optimizer (reference
     ps/table/ctr_accessor + sparse_sgd_rule: the optimizer runs ON the
@@ -97,6 +129,7 @@ class _SparseShard:
 
     def __init__(self, cfg: TableConfig, server_idx: int):
         self.cfg = cfg
+        self.server_idx = int(server_idx)
         self.rows: Dict[int, np.ndarray] = {}
         self.slots: Dict[int, tuple] = {}
         self.counts: Dict[int, int] = {}        # CountFilterEntry
@@ -107,11 +140,11 @@ class _SparseShard:
         self.lock = threading.Lock()
 
     def _init_row(self, rid: int) -> np.ndarray:
-        rng = np.random.RandomState((self._seed + rid) & 0x7FFFFFFF)
         if self.cfg.initializer == "zeros":
             return np.zeros((self.cfg.dim,), np.float32)
-        r = self.cfg.init_range
-        return rng.uniform(-r, r, (self.cfg.dim,)).astype(np.float32)
+        # hash-based uniform shared bit-for-bit with the native plane
+        return _hash_uniform(self.cfg.seed, self.server_idx, rid,
+                             self.cfg.dim, self.cfg.init_range)
 
     def _admit(self, rid: int) -> bool:
         """Entry-admission policy for an ABSENT row at push time
@@ -269,8 +302,24 @@ class PsServer:
     def _dispatch(self, cmd: str, p):
         if cmd == "create_table":
             cfg = p
-            if cfg.name not in self._tables:
+            shard = self._tables.get(cfg.name)
+            if shard is None:
                 self._tables[cfg.name] = _SparseShard(cfg, self.server_idx)
+            else:
+                # table exists (e.g. rows restored by load_model under a
+                # default config): ADOPT the caller's config, keep rows —
+                # otherwise a resumed run silently trains with sgd/lr=0.01
+                if shard.cfg.dim != cfg.dim:
+                    raise ValueError(
+                        f"table {cfg.name!r} exists with dim "
+                        f"{shard.cfg.dim}, cannot adopt dim {cfg.dim}")
+                with shard.lock:
+                    shard.cfg = cfg
+                    # derived admission seed must follow the adopted cfg
+                    # (ProbabilityEntry draws are 'deterministic in
+                    # (seed, rid)' — a stale _seed would break that)
+                    shard._seed = (cfg.seed * 1000003
+                                   + shard.server_idx) & 0x7FFFFFFF
             return True
         if cmd == "pull_sparse":
             return self._tables[p["table"]].pull(p["ids"])
@@ -340,7 +389,16 @@ class PsServer:
         import glob
 
         suffix = f".shard{self.server_idx}.npz"
-        for path in glob.glob(os.path.join(dirname, f"*{suffix}")):
+        found = glob.glob(os.path.join(dirname, f"*{suffix}"))
+        other = glob.glob(os.path.join(
+            dirname, f"*.shard{self.server_idx}.psbin"))
+        if not found and other:
+            raise ValueError(
+                f"{dirname} holds NATIVE-plane saves (.psbin) — the save "
+                "formats are per-plane. Restore with "
+                "PADDLE_PS_DATA_PLANE=native, or convert by loading there "
+                "and re-saving through a Python client")
+        for path in found:
             name = os.path.basename(path)[: -len(suffix)]
             data = np.load(path)
             ids, vals = data["ids"], data["values"]

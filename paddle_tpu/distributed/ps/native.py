@@ -1,0 +1,279 @@
+"""Native (C++) parameter-server data plane — ctypes over
+``native/src/ps_table.cc``.
+
+Reference analog: the brpc data plane (brpc_ps_server.cc /
+brpc_ps_client.cc) under the same fleet role flow. This plane carries
+the HOT path — plain embedding tables with server-side optimizers,
+binary wire protocol, zero pickling — and is row-init bit-identical to
+the Python plane (shared splitmix64 hash), so the two produce
+interchangeable tables. Feature split, documented:
+
+- native: sparse pull/push (sgd/adagrad/adam server-side), dense
+  init/pull/push, barrier, save/load (``.psbin``), stats, stop.
+- python plane only: entry-admission policies (Probability/CountFilter/
+  ShowClick) and show/click accessors — ``create_table`` here raises on
+  ``cfg.entry`` and points at the Python plane.
+
+Select per cluster via ``PADDLE_PS_DATA_PLANE=native`` (the fleet
+``init_server``/``init_worker`` flow honors it); mixing planes within
+one server group is not supported.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import time
+from typing import List, Sequence
+
+import numpy as np
+
+from . import TableConfig
+
+__all__ = ["NativePsServer", "NativePsClient"]
+
+_OPT_IDS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
+def _lib():
+    from ...native import _load
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "native library unavailable (g++ build failed?) — use the "
+            "Python data plane (distributed.ps.PsServer)")
+    return lib
+
+
+class NativePsServer:
+    """One native PS shard. API mirrors ``PsServer`` (start/run/stop,
+    ``load_model``); table state lives in C++."""
+
+    def __init__(self, server_idx: int, num_servers: int, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self._lib = _lib()
+        self.server_idx = int(server_idx)
+        self.num_servers = int(num_servers)
+        self.host = host
+        self._h = self._lib.pst_server_start(port, self.server_idx,
+                                             host.encode())
+        if not self._h:
+            raise OSError(f"cannot bind native PS server on port {port}")
+        self.port = int(self._lib.pst_server_port(self._h))
+        self._stopped = False
+
+    def start(self):
+        return self  # accept loop already runs on native threads
+
+    def run(self):
+        """Block until a client sends STOP (reference fleet.run_server)."""
+        while not self._lib.pst_server_stopped(self._h):
+            time.sleep(0.05)
+
+    def stop(self):
+        if not self._stopped:
+            self._stopped = True
+            self._lib.pst_server_stop(self._h)
+
+    def load_model(self, dirname: str, tables: Sequence[TableConfig] = ()):
+        """Restore this shard's rows from ``.psbin`` files written by
+        ``NativePsClient.save``. Table names are discovered from the
+        directory (reference init_server(dirname) contract); pass
+        ``tables`` to also restore each table's optimizer config."""
+        import glob
+
+        cfg_by_name = {c.name: c for c in tables}
+        suffix = f".shard{self.server_idx}.psbin"
+        found = glob.glob(os.path.join(dirname, f"*{suffix}"))
+        other = glob.glob(os.path.join(
+            dirname, f"*.shard{self.server_idx}.npz"))
+        if not found and other:
+            raise ValueError(
+                f"{dirname} holds PYTHON-plane saves (.npz) — the save "
+                "formats are per-plane. Restore with the Python plane, or "
+                "convert by loading there and re-saving through a native "
+                "client")
+        for path in found:
+            name = os.path.basename(path)[: -len(suffix)]
+            cfg = cfg_by_name.get(name)
+            opt = _OPT_IDS[cfg.optimizer] if cfg else 0
+            lr = cfg.lr if cfg else 0.01
+            rc = self._lib.pst_server_load(
+                self._h, dirname.encode(), name.encode(), opt,
+                ctypes.c_float(lr))
+            if rc < 0:
+                raise OSError(f"load_model({name}): native rc={rc}")
+        return self
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class NativePsClient:
+    """Trainer-side handle over the native wire protocol; same method
+    surface as ``PsClient`` for the plain-table subset, same row routing
+    (id % num_servers)."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        import threading
+
+        self._lib = _lib()
+        self.endpoints = list(endpoints)
+        self._conns: List = []
+        # one request-response at a time per socket (same invariant as
+        # the Python PsClient): DistributedEmbedding's backward hook and
+        # a prefetch thread may share one client
+        self._locks: List = []
+        self._dims = {}
+        self._dense_sizes = {}
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            h = self._lib.pst_connect(host.encode(), int(port))
+            if not h:
+                raise ConnectionError(f"cannot connect native PS at {ep}")
+            self._conns.append(h)
+            self._locks.append(threading.Lock())
+
+    def _check(self, rc: int, what: str):
+        if rc < 0:
+            raise RuntimeError(f"native PS {what}: rc={rc}")
+        return rc
+
+    # -- tables --------------------------------------------------------------
+    def create_table(self, cfg: TableConfig):
+        if cfg.entry is not None:
+            raise ValueError(
+                "entry-admission policies are a Python-data-plane feature "
+                "(distributed.ps.PsServer/PsClient); the native plane "
+                "serves plain tables")
+        init_kind = 1 if cfg.initializer == "zeros" else 0
+        for h, lk in zip(self._conns, self._locks):
+            with lk:
+                self._check(self._lib.pst_create_table(
+                    h, cfg.name.encode(), cfg.dim, _OPT_IDS[cfg.optimizer],
+                    init_kind, cfg.seed & 0xFFFFFFFFFFFFFFFF,
+                    ctypes.c_float(cfg.lr), ctypes.c_float(cfg.beta1),
+                    ctypes.c_float(cfg.beta2), ctypes.c_float(cfg.epsilon),
+                    ctypes.c_float(cfg.init_range)), "create_table")
+        self._dims[cfg.name] = cfg.dim
+
+    def pull_sparse(self, table: str, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
+        dim = self._dims[table]
+        n_srv = len(self._conns)
+        out = np.empty((ids.size, dim), np.float32)
+        if ids.size == 0:
+            return out
+        for s in range(n_srv):
+            mask = (ids % n_srv) == s
+            if not mask.any():
+                continue
+            part = np.ascontiguousarray(ids[mask])
+            rows = np.empty((part.size, dim), np.float32)
+            with self._locks[s]:
+                self._check(self._lib.pst_pull_sparse(
+                    self._conns[s], table.encode(), part.size,
+                    part.ctypes.data_as(ctypes.c_void_p),
+                    rows.ctypes.data_as(ctypes.c_void_p), dim),
+                    "pull_sparse")
+            out[mask] = rows
+        return out
+
+    def push_sparse(self, table: str, ids, grads) -> None:
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
+        dim = self._dims[table]
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(ids.size, dim))
+        n_srv = len(self._conns)
+        for s in range(n_srv):
+            mask = (ids % n_srv) == s
+            if not mask.any():
+                continue
+            part = np.ascontiguousarray(ids[mask])
+            g = np.ascontiguousarray(grads[mask])
+            with self._locks[s]:
+                self._check(self._lib.pst_push_sparse(
+                    self._conns[s], table.encode(), part.size, dim,
+                    part.ctypes.data_as(ctypes.c_void_p),
+                    g.ctypes.data_as(ctypes.c_void_p)), "push_sparse")
+
+    # -- dense ---------------------------------------------------------------
+    def init_dense(self, name: str, value) -> None:
+        v = np.ascontiguousarray(np.asarray(value, np.float32).ravel())
+        with self._locks[0]:
+            self._check(self._lib.pst_dense_init(
+                self._conns[0], name.encode(), v.size,
+                v.ctypes.data_as(ctypes.c_void_p)), "init_dense")
+        self._dense_sizes[name] = int(v.size)
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        # size known from init_dense / a prior pull: exact-size buffer,
+        # one round trip. Unknown (another trainer initialized it): probe
+        # with cap=0 to learn the size, then fetch.
+        cap = self._dense_sizes.get(name, 0)
+        got = ctypes.c_uint64(0)
+        for _ in range(2):
+            out = np.empty((cap,), np.float32)
+            with self._locks[0]:
+                self._check(self._lib.pst_dense_pull(
+                    self._conns[0], name.encode(),
+                    out.ctypes.data_as(ctypes.c_void_p), cap,
+                    ctypes.byref(got)), "pull_dense")
+            n = int(got.value)
+            if n <= cap:
+                self._dense_sizes[name] = n
+                return out[:n]
+            cap = n
+        raise RuntimeError(f"pull_dense({name}): size changed mid-pull")
+
+    def push_dense(self, name: str, grad, lr: float = 0.01) -> None:
+        g = np.ascontiguousarray(np.asarray(grad, np.float32).ravel())
+        with self._locks[0]:
+            self._check(self._lib.pst_dense_push(
+                self._conns[0], name.encode(), ctypes.c_float(lr), g.size,
+                g.ctypes.data_as(ctypes.c_void_p)), "push_dense")
+
+    # -- control -------------------------------------------------------------
+    def barrier(self, name: str = "default", world: int = 1):
+        with self._locks[0]:
+            return self._check(self._lib.pst_barrier(
+                self._conns[0], name.encode(), world), "barrier")
+
+    def save(self, dirname: str):
+        out = []
+        for h, lk in zip(self._conns, self._locks):
+            with lk:
+                out.append(self._check(
+                    self._lib.pst_save(h, dirname.encode()), "save"))
+        return out
+
+    def stats(self):
+        """Row counts per server for the tables THIS client created
+        (the Python plane reports every server-side table; the native
+        protocol has no table-list op)."""
+        out = []
+        for h, lk in zip(self._conns, self._locks):
+            with lk:
+                out.append({t: int(self._check(
+                    self._lib.pst_stats(h, t.encode()), "stats"))
+                    for t in self._dims})
+        return out
+
+    def stop_servers(self):
+        for h, lk in zip(self._conns, self._locks):
+            try:
+                with lk:
+                    self._lib.pst_stop(h)
+            except Exception:
+                pass
+
+    def close(self):
+        for h in self._conns:
+            try:
+                self._lib.pst_close(h)
+            except Exception:
+                pass
+        self._conns = []

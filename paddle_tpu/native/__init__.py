@@ -44,13 +44,19 @@ def _build() -> Optional[str]:
     out = os.path.join(_BUILD_DIR, "libpaddle_tpu_native.so")
     srcs = _sources()
     stamp = os.path.join(_BUILD_DIR, "stamp")
-    sig = str([(s, os.path.getmtime(s)) for s in srcs])
+    # -ffp-contract=off: g++'s default 'fast' fuses fp expressions into
+    # FMAs, breaking the bit-exact cross-plane row-init contract between
+    # ps_table.cc and the numpy implementation (distributed/ps)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           "-ffp-contract=off", "-o", out] + srcs
+    # the stamp covers the COMMAND too: a flag change (e.g. the
+    # load-bearing -ffp-contract) must trigger a rebuild, not silently
+    # reuse a stale .so
+    sig = str([(s, os.path.getmtime(s)) for s in srcs]) + str(cmd)
     if os.path.exists(out) and os.path.exists(stamp):
         with open(stamp) as f:
             if f.read() == sig:
                 return out
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           "-o", out] + srcs
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (subprocess.SubprocessError, FileNotFoundError):
@@ -118,6 +124,55 @@ def _load():
     lib.ts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
     lib.ts_wait.restype = ctypes.c_int64
     lib.ts_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    # parameter-server data plane (ps_table.cc)
+    lib.pst_server_start.restype = ctypes.c_void_p
+    lib.pst_server_start.argtypes = [ctypes.c_uint16, ctypes.c_uint32,
+                                     ctypes.c_char_p]
+    lib.pst_server_port.restype = ctypes.c_uint16
+    lib.pst_server_port.argtypes = [ctypes.c_void_p]
+    lib.pst_server_stopped.restype = ctypes.c_int
+    lib.pst_server_stopped.argtypes = [ctypes.c_void_p]
+    lib.pst_server_load.restype = ctypes.c_int64
+    lib.pst_server_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_char_p, ctypes.c_uint8,
+                                    ctypes.c_float]
+    lib.pst_server_stop.argtypes = [ctypes.c_void_p]
+    lib.pst_connect.restype = ctypes.c_void_p
+    lib.pst_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+    lib.pst_close.argtypes = [ctypes.c_void_p]
+    lib.pst_create_table.restype = ctypes.c_int64
+    lib.pst_create_table.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint8,
+        ctypes.c_uint8, ctypes.c_uint64, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float]
+    lib.pst_pull_sparse.restype = ctypes.c_int64
+    lib.pst_pull_sparse.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64, ctypes.c_void_p,
+                                    ctypes.c_void_p, ctypes.c_uint32]
+    lib.pst_push_sparse.restype = ctypes.c_int64
+    lib.pst_push_sparse.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64, ctypes.c_uint32,
+                                    ctypes.c_void_p, ctypes.c_void_p]
+    lib.pst_dense_init.restype = ctypes.c_int64
+    lib.pst_dense_init.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64, ctypes.c_void_p]
+    lib.pst_dense_pull.restype = ctypes.c_int64
+    lib.pst_dense_pull.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.POINTER(ctypes.c_uint64)]
+    lib.pst_dense_push.restype = ctypes.c_int64
+    lib.pst_dense_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_float, ctypes.c_uint64,
+                                   ctypes.c_void_p]
+    lib.pst_barrier.restype = ctypes.c_int64
+    lib.pst_barrier.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint32]
+    lib.pst_save.restype = ctypes.c_int64
+    lib.pst_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.pst_stats.restype = ctypes.c_int64
+    lib.pst_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.pst_stop.restype = ctypes.c_int64
+    lib.pst_stop.argtypes = [ctypes.c_void_p]
     _LIB = lib
     AVAILABLE = True
     return lib

@@ -1,0 +1,645 @@
+// Parameter-server data plane: sparse/dense table shards served over TCP.
+//
+// TPU-native analog of the reference's brpc PS data plane
+// (paddle/fluid/distributed/ps/service/brpc_ps_server.cc handlers over
+// ps/table/memory_sparse_table.cc with server-side sparse optimizers,
+// sparse_sgd_rule.cc). The Python plane (distributed/ps/__init__.py)
+// carries the full feature set (entry-admission policies, show/click
+// accessors); THIS plane is the native hot path for plain embedding
+// tables — the HBM-exceeding lookup/update traffic brpc exists for.
+//
+// Wire protocol (little-endian), one request per message:
+//   request:  u8 op | u32 nlen | name bytes | u64 n | payload
+//     op: 0=CREATE 1=PULL 2=PUSH 3=DENSE_INIT 4=DENSE_PULL 5=DENSE_PUSH
+//         6=BARRIER 7=SAVE 8=STATS 9=STOP
+//   response: i64 status | u64 plen | payload     (status<0 = error)
+//
+// Row init matches the Python plane EXACTLY (hash_uniform below ==
+// distributed/ps/__init__.py::_hash_uniform), so a table built through
+// either plane is bit-identical — cross-plane parity is tested.
+
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+bool read_n(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+// splitmix64 — the shared row-init hash (Python plane mirrors this).
+inline uint64_t sm64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+struct TableCfg {
+  uint32_t dim = 0;
+  uint8_t opt = 0;        // 0=sgd 1=adagrad 2=adam
+  uint8_t init_kind = 0;  // 0=uniform 1=zeros
+  uint64_t seed = 0;      // full width — Python hashes the full seed too
+  float lr = 0.01f, b1 = 0.9f, b2 = 0.999f, eps = 1e-8f, init_range = 0.1f;
+};
+
+struct Row {
+  std::vector<float> w;
+  std::vector<float> s0;  // adagrad acc / adam m
+  std::vector<float> s1;  // adam v
+  int64_t t = 0;          // adam step
+};
+
+struct Table {
+  TableCfg cfg;
+  std::unordered_map<int64_t, Row> rows;
+  std::mutex mu;
+};
+
+struct Server {
+  int listen_fd = -1;
+  uint32_t server_idx = 0;
+  std::thread thread;
+  std::atomic<bool> stop{false};
+  std::mutex tables_mu;
+  std::map<std::string, Table*> tables;  // Table* stable across rehash
+  std::mutex dense_mu;
+  std::map<std::string, std::vector<float>> dense;
+  std::mutex barrier_mu;
+  std::condition_variable barrier_cv;
+  std::map<std::string, int64_t> barrier_count;
+  std::vector<std::thread> workers;
+  std::mutex fds_mu;
+  std::vector<int> client_fds;
+};
+
+void init_row(const TableCfg& cfg, uint32_t server_idx, int64_t rid,
+              std::vector<float>* w) {
+  w->resize(cfg.dim);
+  if (cfg.init_kind == 1) {
+    std::fill(w->begin(), w->end(), 0.0f);
+    return;
+  }
+  uint64_t h0 = sm64(sm64(cfg.seed * 1000003ull + server_idx) ^
+                     static_cast<uint64_t>(rid));
+  for (uint32_t j = 0; j < cfg.dim; ++j) {
+    double u = static_cast<double>(sm64(h0 + j) >> 11) *
+               (1.0 / 9007199254740992.0);  // [0,1) from the top 53 bits
+    (*w)[j] = static_cast<float>((2.0 * u - 1.0) * cfg.init_range);
+  }
+}
+
+Table* get_table(Server* s, const std::string& name) {
+  std::lock_guard<std::mutex> lk(s->tables_mu);
+  auto it = s->tables.find(name);
+  return it == s->tables.end() ? nullptr : it->second;
+}
+
+void apply_push(Table* t, uint32_t server_idx, int64_t rid, const float* g) {
+  const TableCfg& c = t->cfg;
+  auto it = t->rows.find(rid);
+  if (it == t->rows.end()) {
+    it = t->rows.emplace(rid, Row{}).first;
+    init_row(c, server_idx, rid, &it->second.w);
+  }
+  Row& r = it->second;
+  float* w = r.w.data();
+  if (c.opt == 0) {  // sgd
+    for (uint32_t j = 0; j < c.dim; ++j) w[j] -= c.lr * g[j];
+  } else if (c.opt == 1) {  // adagrad
+    if (r.s0.empty()) r.s0.assign(c.dim, 0.0f);
+    for (uint32_t j = 0; j < c.dim; ++j) {
+      r.s0[j] += g[j] * g[j];
+      w[j] -= c.lr * g[j] / (std::sqrt(r.s0[j]) + c.eps);
+    }
+  } else {  // adam
+    if (r.s0.empty()) {
+      r.s0.assign(c.dim, 0.0f);
+      r.s1.assign(c.dim, 0.0f);
+    }
+    r.t += 1;
+    double bc1 = 1.0 - std::pow(static_cast<double>(c.b1), r.t);
+    double bc2 = 1.0 - std::pow(static_cast<double>(c.b2), r.t);
+    for (uint32_t j = 0; j < c.dim; ++j) {
+      r.s0[j] = c.b1 * r.s0[j] + (1.0f - c.b1) * g[j];
+      r.s1[j] = c.b2 * r.s1[j] + (1.0f - c.b2) * g[j] * g[j];
+      float mh = static_cast<float>(r.s0[j] / bc1);
+      float vh = static_cast<float>(r.s1[j] / bc2);
+      w[j] -= c.lr * mh / (std::sqrt(vh) + c.eps);
+    }
+  }
+}
+
+int64_t do_save(Server* s, const std::string& dirname) {
+  ::mkdir(dirname.c_str(), 0777);  // EEXIST is fine
+  std::lock_guard<std::mutex> lk(s->tables_mu);
+  for (auto& kv : s->tables) {
+    Table* t = kv.second;
+    std::lock_guard<std::mutex> tl(t->mu);
+    std::string path = dirname + "/" + kv.first + ".shard" +
+                       std::to_string(s->server_idx) + ".psbin";
+    FILE* f = std::fopen(path.c_str(), "wb");
+    if (!f) return -2;
+    uint32_t dim = t->cfg.dim;
+    uint64_t n = t->rows.size();
+    std::fwrite(&dim, 4, 1, f);
+    std::fwrite(&n, 8, 1, f);
+    for (auto& row : t->rows) {
+      std::fwrite(&row.first, 8, 1, f);
+      std::fwrite(row.second.w.data(), 4, dim, f);
+    }
+    std::fclose(f);
+  }
+  return 0;
+}
+
+void serve_client(Server* s, int fd) {
+  std::vector<uint8_t> payload, out;
+  for (;;) {
+    uint8_t op;
+    uint32_t nlen;
+    uint64_t n;
+    if (!read_n(fd, &op, 1) || !read_n(fd, &nlen, 4)) break;
+    std::string name(nlen, '\0');
+    if (nlen && !read_n(fd, name.data(), nlen)) break;
+    if (!read_n(fd, &n, 8)) break;
+
+    int64_t status = 0;
+    out.clear();
+    switch (op) {
+      case 0: {  // CREATE: payload = packed TableCfg
+        TableCfg cfg;
+        payload.resize(sizeof(TableCfg));
+        if (!read_n(fd, payload.data(), payload.size())) goto done;
+        std::memcpy(&cfg, payload.data(), sizeof(TableCfg));
+        std::lock_guard<std::mutex> lk(s->tables_mu);
+        auto it = s->tables.find(name);
+        if (it == s->tables.end()) {
+          auto* t = new Table();
+          t->cfg = cfg;
+          s->tables[name] = t;
+        } else {
+          // rows may have been restored by pst_server_load under a
+          // default config: adopt the caller's config, keep rows
+          std::lock_guard<std::mutex> tl(it->second->mu);
+          if (it->second->cfg.dim != cfg.dim) {
+            status = -4;
+          } else {
+            it->second->cfg = cfg;
+          }
+        }
+        break;
+      }
+      case 1: {  // PULL: n ids -> dim + n*dim floats
+        payload.resize(n * 8);
+        if (n && !read_n(fd, payload.data(), payload.size())) goto done;
+        Table* t = get_table(s, name);
+        if (!t) {
+          status = -3;
+          break;
+        }
+        const int64_t* ids = reinterpret_cast<const int64_t*>(payload.data());
+        // cfg is written by the CREATE adopt path under t->mu — dim must
+        // be read under the same lock (UB otherwise)
+        std::lock_guard<std::mutex> lk(t->mu);
+        uint32_t dim = t->cfg.dim;
+        out.resize(4 + n * dim * 4);
+        std::memcpy(out.data(), &dim, 4);
+        float* dst = reinterpret_cast<float*>(out.data() + 4);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto it = t->rows.find(ids[i]);
+          if (it == t->rows.end()) {
+            it = t->rows.emplace(ids[i], Row{}).first;
+            init_row(t->cfg, s->server_idx, ids[i], &it->second.w);
+          }
+          std::memcpy(dst + i * dim, it->second.w.data(), dim * 4);
+        }
+        break;
+      }
+      case 2: {  // PUSH: u32 dim | n ids | n*dim grads
+        uint32_t dim;
+        if (!read_n(fd, &dim, 4)) goto done;
+        payload.resize(n * 8 + static_cast<uint64_t>(n) * dim * 4);
+        if (n && !read_n(fd, payload.data(), payload.size())) goto done;
+        Table* t = get_table(s, name);
+        if (!t) {
+          status = -3;
+          break;
+        }
+        const int64_t* ids = reinterpret_cast<const int64_t*>(payload.data());
+        const float* g = reinterpret_cast<const float*>(payload.data() + n * 8);
+        std::lock_guard<std::mutex> lk(t->mu);  // cfg read + row updates
+        if (dim != t->cfg.dim) {
+          status = -4;
+          break;
+        }
+        for (uint64_t i = 0; i < n; ++i)
+          apply_push(t, s->server_idx, ids[i], g + i * dim);
+        break;
+      }
+      case 3: {  // DENSE_INIT: n floats (first write wins, like setdefault)
+        payload.resize(n * 4);
+        if (n && !read_n(fd, payload.data(), payload.size())) goto done;
+        const float* v = reinterpret_cast<const float*>(payload.data());
+        std::lock_guard<std::mutex> lk(s->dense_mu);
+        if (!s->dense.count(name)) s->dense[name].assign(v, v + n);
+        break;
+      }
+      case 4: {  // DENSE_PULL
+        std::lock_guard<std::mutex> lk(s->dense_mu);
+        auto it = s->dense.find(name);
+        if (it == s->dense.end()) {
+          status = -3;
+          break;
+        }
+        out.resize(it->second.size() * 4);
+        std::memcpy(out.data(), it->second.data(), out.size());
+        break;
+      }
+      case 5: {  // DENSE_PUSH: f32 lr | n grads  (server-side sgd)
+        float lr;
+        if (!read_n(fd, &lr, 4)) goto done;
+        payload.resize(n * 4);
+        if (n && !read_n(fd, payload.data(), payload.size())) goto done;
+        const float* g = reinterpret_cast<const float*>(payload.data());
+        std::lock_guard<std::mutex> lk(s->dense_mu);
+        auto it = s->dense.find(name);
+        if (it == s->dense.end() || it->second.size() != n) {
+          status = -3;
+          break;
+        }
+        for (uint64_t j = 0; j < n; ++j) it->second[j] -= lr * g[j];
+        break;
+      }
+      case 6: {  // BARRIER: n = world; status = arrival position 1..world
+        int64_t world = static_cast<int64_t>(n);
+        std::unique_lock<std::mutex> lk(s->barrier_mu);
+        int64_t count = ++s->barrier_count[name];
+        int64_t pos = (count - 1) % world + 1;
+        int64_t target = ((count - 1) / world + 1) * world;
+        s->barrier_cv.wait(lk, [&] {
+          return s->barrier_count[name] >= target || s->stop.load();
+        });
+        s->barrier_cv.notify_all();
+        status = pos;
+        break;
+      }
+      case 7:  // SAVE: name = dirname
+        status = do_save(s, name);
+        break;
+      case 8: {  // STATS: status = row count of table `name`
+        Table* t = get_table(s, name);
+        if (!t) {
+          status = -3;
+          break;
+        }
+        std::lock_guard<std::mutex> lk(t->mu);
+        status = static_cast<int64_t>(t->rows.size());
+        break;
+      }
+      case 9:  // STOP
+        break;
+      default:
+        status = -1;
+    }
+
+    {
+      uint64_t plen = out.size();
+      if (!write_n(fd, &status, 8) || !write_n(fd, &plen, 8)) break;
+      if (plen && !write_n(fd, out.data(), plen)) break;
+    }
+    if (op == 9) {
+      s->stop.store(true);
+      s->barrier_cv.notify_all();
+      ::shutdown(s->listen_fd, SHUT_RDWR);
+      break;
+    }
+  }
+done:
+  ::close(fd);
+  std::lock_guard<std::mutex> lk(s->fds_mu);
+  for (auto it = s->client_fds.begin(); it != s->client_fds.end(); ++it) {
+    if (*it == fd) {
+      s->client_fds.erase(it);
+      break;
+    }
+  }
+}
+
+void ps_accept_loop(Server* s) {
+  for (;;) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stop.load()) return;
+      // EMFILE/ENFILE etc. persist — don't busy-spin a core while the
+      // worker threads still serve live connections
+      ::usleep(10000);
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    {
+      std::lock_guard<std::mutex> lk(s->fds_mu);
+      s->client_fds.push_back(fd);
+    }
+    s->workers.emplace_back(serve_client, s, fd);
+  }
+}
+
+// ---- client-side request helper ----
+
+int64_t ps_request(int fd, uint8_t op, const char* name,
+                   const uint8_t* head, uint64_t head_len, uint64_t n,
+                   const uint8_t* body, uint64_t body_len, uint8_t* out,
+                   uint64_t out_cap, uint64_t* out_len) {
+  uint32_t nlen = static_cast<uint32_t>(std::strlen(name));
+  if (!write_n(fd, &op, 1) || !write_n(fd, &nlen, 4)) return -100;
+  if (nlen && !write_n(fd, name, nlen)) return -100;
+  if (!write_n(fd, &n, 8)) return -100;
+  if (head_len && !write_n(fd, head, head_len)) return -100;
+  if (body_len && !write_n(fd, body, body_len)) return -100;
+  int64_t status;
+  uint64_t plen;
+  if (!read_n(fd, &status, 8) || !read_n(fd, &plen, 8)) return -100;
+  if (out_len) *out_len = plen;
+  if (plen) {
+    std::vector<uint8_t> buf(plen);
+    if (!read_n(fd, buf.data(), plen)) return -100;
+    uint64_t c = plen < out_cap ? plen : out_cap;
+    if (out && c) std::memcpy(out, buf.data(), c);
+  }
+  return status;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pst_server_start(uint16_t port, uint32_t server_idx,
+                       const char* host) {
+  auto* s = new Server();
+  s->server_idx = server_idx;
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (host && *host && std::strcmp(host, "0.0.0.0") != 0) {
+    // bind the configured endpoint interface (the Python plane binds the
+    // endpoint host too); hostname or dotted-quad
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, nullptr, &hints, &res) == 0 && res) {
+      addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      ::freeaddrinfo(res);
+    }
+  }
+  addr.sin_port = htons(port);
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->thread = std::thread(ps_accept_loop, s);
+  return s;
+}
+
+uint16_t pst_server_port(void* sp) {
+  auto* s = static_cast<Server*>(sp);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  return ntohs(addr.sin_port);
+}
+
+int pst_server_stopped(void* sp) {
+  return static_cast<Server*>(sp)->stop.load() ? 1 : 0;
+}
+
+// Restore rows from .psbin files written by SAVE (this shard's suffix).
+// Missing tables are created with default cfg + the file's dim, matching
+// the Python plane's load_model contract.
+int64_t pst_server_load(void* sp, const char* dirname, const char* table,
+                        uint8_t opt, float lr) {
+  auto* s = static_cast<Server*>(sp);
+  std::string path = std::string(dirname) + "/" + table + ".shard" +
+                     std::to_string(s->server_idx) + ".psbin";
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return -2;
+  uint32_t dim;
+  uint64_t n;
+  if (std::fread(&dim, 4, 1, f) != 1 || std::fread(&n, 8, 1, f) != 1) {
+    std::fclose(f);
+    return -3;
+  }
+  Table* t;
+  {
+    std::lock_guard<std::mutex> lk(s->tables_mu);
+    auto it = s->tables.find(table);
+    if (it == s->tables.end()) {
+      t = new Table();
+      t->cfg.dim = dim;
+      t->cfg.opt = opt;
+      t->cfg.lr = lr;
+      s->tables[table] = t;
+    } else {
+      t = it->second;
+    }
+  }
+  std::lock_guard<std::mutex> tl(t->mu);
+  uint64_t loaded = 0;
+  for (; loaded < n; ++loaded) {
+    int64_t rid;
+    if (std::fread(&rid, 8, 1, f) != 1) break;
+    Row r;
+    r.w.resize(dim);
+    if (std::fread(r.w.data(), 4, dim, f) != dim) break;  // partial row
+    t->rows[rid] = std::move(r);                          // never stored
+  }
+  std::fclose(f);
+  // a truncated file (crash/full disk mid-save) is an ERROR, not a
+  // short success — silently re-initializing the missing rows would be
+  // a partial, inconsistent restore
+  return loaded == n ? static_cast<int64_t>(n) : -4;
+}
+
+void pst_server_stop(void* sp) {
+  auto* s = static_cast<Server*>(sp);
+  s->stop.store(true);
+  s->barrier_cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  {
+    std::lock_guard<std::mutex> lk(s->fds_mu);
+    for (int fd : s->client_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (s->thread.joinable()) s->thread.join();
+  for (auto& w : s->workers)
+    if (w.joinable()) w.join();
+  {
+    std::lock_guard<std::mutex> lk(s->tables_mu);
+    for (auto& kv : s->tables) delete kv.second;
+  }
+  delete s;
+}
+
+// ---- client ----
+
+void* pst_connect(const char* host, uint16_t port) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portstr[8];
+  std::snprintf(portstr, sizeof(portstr), "%u", static_cast<unsigned>(port));
+  if (::getaddrinfo(host, portstr, &hints, &res) != 0 || res == nullptr)
+    return nullptr;
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    if (fd >= 0) ::close(fd);
+    ::freeaddrinfo(res);
+    return nullptr;
+  }
+  ::freeaddrinfo(res);
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return new int(fd);
+}
+
+void pst_close(void* cp) {
+  int* fd = static_cast<int*>(cp);
+  ::close(*fd);
+  delete fd;
+}
+
+int64_t pst_create_table(void* cp, const char* name, uint32_t dim,
+                         uint8_t opt, uint8_t init_kind, uint64_t seed,
+                         float lr, float b1, float b2, float eps,
+                         float init_range) {
+  TableCfg cfg;
+  cfg.dim = dim;
+  cfg.opt = opt;
+  cfg.init_kind = init_kind;
+  cfg.seed = seed;
+  cfg.lr = lr;
+  cfg.b1 = b1;
+  cfg.b2 = b2;
+  cfg.eps = eps;
+  cfg.init_range = init_range;
+  return ps_request(*static_cast<int*>(cp), 0, name,
+                    reinterpret_cast<const uint8_t*>(&cfg), sizeof(cfg), 0,
+                    nullptr, 0, nullptr, 0, nullptr);
+}
+
+int64_t pst_pull_sparse(void* cp, const char* name, uint64_t n,
+                        const int64_t* ids, float* out, uint32_t dim) {
+  std::vector<uint8_t> resp(4 + n * static_cast<uint64_t>(dim) * 4);
+  uint64_t got = 0;
+  int64_t st = ps_request(*static_cast<int*>(cp), 1, name, nullptr, 0, n,
+                          reinterpret_cast<const uint8_t*>(ids), n * 8,
+                          resp.data(), resp.size(), &got);
+  if (st < 0) return st;
+  uint32_t sdim;
+  std::memcpy(&sdim, resp.data(), 4);
+  if (sdim != dim || got != resp.size()) return -5;
+  std::memcpy(out, resp.data() + 4, n * static_cast<uint64_t>(dim) * 4);
+  return 0;
+}
+
+int64_t pst_push_sparse(void* cp, const char* name, uint64_t n, uint32_t dim,
+                        const int64_t* ids, const float* grads) {
+  std::vector<uint8_t> body(n * 8 + n * static_cast<uint64_t>(dim) * 4);
+  std::memcpy(body.data(), ids, n * 8);
+  std::memcpy(body.data() + n * 8, grads, n * static_cast<uint64_t>(dim) * 4);
+  return ps_request(*static_cast<int*>(cp), 2, name,
+                    reinterpret_cast<const uint8_t*>(&dim), 4, n, body.data(),
+                    body.size(), nullptr, 0, nullptr);
+}
+
+int64_t pst_dense_init(void* cp, const char* name, uint64_t n,
+                       const float* v) {
+  return ps_request(*static_cast<int*>(cp), 3, name, nullptr, 0, n,
+                    reinterpret_cast<const uint8_t*>(v), n * 4, nullptr, 0,
+                    nullptr);
+}
+
+int64_t pst_dense_pull(void* cp, const char* name, float* out,
+                       uint64_t out_cap_floats, uint64_t* out_n) {
+  uint64_t got = 0;
+  int64_t st = ps_request(*static_cast<int*>(cp), 4, name, nullptr, 0, 0,
+                          nullptr, 0, reinterpret_cast<uint8_t*>(out),
+                          out_cap_floats * 4, &got);
+  if (out_n) *out_n = got / 4;
+  return st;
+}
+
+int64_t pst_dense_push(void* cp, const char* name, float lr, uint64_t n,
+                       const float* g) {
+  return ps_request(*static_cast<int*>(cp), 5, name,
+                    reinterpret_cast<const uint8_t*>(&lr), 4, n,
+                    reinterpret_cast<const uint8_t*>(g), n * 4, nullptr, 0,
+                    nullptr);
+}
+
+int64_t pst_barrier(void* cp, const char* name, uint32_t world) {
+  return ps_request(*static_cast<int*>(cp), 6, name, nullptr, 0, world,
+                    nullptr, 0, nullptr, 0, nullptr);
+}
+
+int64_t pst_save(void* cp, const char* dirname) {
+  return ps_request(*static_cast<int*>(cp), 7, dirname, nullptr, 0, 0,
+                    nullptr, 0, nullptr, 0, nullptr);
+}
+
+int64_t pst_stats(void* cp, const char* name) {
+  return ps_request(*static_cast<int*>(cp), 8, name, nullptr, 0, 0, nullptr,
+                    0, nullptr, 0, nullptr);
+}
+
+int64_t pst_stop(void* cp) {
+  return ps_request(*static_cast<int*>(cp), 9, "", nullptr, 0, 0, nullptr, 0,
+                    nullptr, 0, nullptr);
+}
+
+}  // extern "C"

@@ -199,11 +199,19 @@ def _free_port():
 
 @pytest.mark.slow
 class TestFleetRoleFlow:
-    def test_two_servers_two_trainers_processes(self, tmp_path):
+    @pytest.mark.parametrize("plane", ["python", "native"])
+    def test_two_servers_two_trainers_processes(self, tmp_path, plane):
         """The reference deployment shape: PSERVER and TRAINER processes
-        wired purely by the env contract; last trainer stops servers."""
+        wired purely by the env contract; last trainer stops servers.
+        Parametrized over BOTH data planes (PADDLE_PS_DATA_PLANE) — the
+        native C++ plane must carry the identical fleet flow."""
         import paddle_tpu.distributed.ps as distributed_ps  # noqa: F401
 
+        if plane == "native":
+            from paddle_tpu import native as native_lib
+
+            if native_lib.lib_path() is None:
+                pytest.skip("native toolchain unavailable")
         ports = [_free_port(), _free_port()]
         eps = ",".join(f"127.0.0.1:{p}" for p in ports)
         script = tmp_path / "node.py"
@@ -217,6 +225,7 @@ class TestFleetRoleFlow:
                 "PADDLE_PSERVERS_IP_PORT_LIST": eps,
                 "PADDLE_TRAINERS_NUM": "2",
                 "JAX_PLATFORMS": "cpu",
+                "PADDLE_PS_DATA_PLANE": plane,
             })
             if role == "PSERVER":
                 env["POD_IP"] = "127.0.0.1"
