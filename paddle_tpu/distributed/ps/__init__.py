@@ -396,8 +396,9 @@ class PsServer:
             raise ValueError(
                 f"{dirname} holds NATIVE-plane saves (.psbin) — the save "
                 "formats are per-plane. Restore with "
-                "PADDLE_PS_DATA_PLANE=native, or convert by loading there "
-                "and re-saving through a Python client")
+                "PADDLE_PS_DATA_PLANE=native, or run "
+                "distributed.ps.native.convert_save(dirname, to='python') "
+                "first")
         for path in found:
             name = os.path.basename(path)[: -len(suffix)]
             data = np.load(path)

@@ -29,7 +29,7 @@ import numpy as np
 
 from . import TableConfig
 
-__all__ = ["NativePsServer", "NativePsClient"]
+__all__ = ["NativePsServer", "NativePsClient", "convert_save"]
 
 _OPT_IDS = {"sgd": 0, "adagrad": 1, "adam": 2}
 
@@ -91,8 +91,8 @@ class NativePsServer:
             raise ValueError(
                 f"{dirname} holds PYTHON-plane saves (.npz) — the save "
                 "formats are per-plane. Restore with the Python plane, or "
-                "convert by loading there and re-saving through a native "
-                "client")
+                "run distributed.ps.native.convert_save(dirname, "
+                "to='native') first")
         for path in found:
             name = os.path.basename(path)[: -len(suffix)]
             cfg = cfg_by_name.get(name)
@@ -277,3 +277,49 @@ class NativePsClient:
             except Exception:
                 pass
         self._conns = []
+
+
+def convert_save(dirname: str, to: str) -> list:
+    """Convert a PS save directory between plane formats in place:
+    ``to="native"`` rewrites every ``*.npz`` shard (Python plane) as
+    ``.psbin``; ``to="python"`` the reverse. Returns the written paths.
+    Rows only — optimizer slots are not part of either save format (both
+    planes re-create them on first push, matching the reference's
+    save/load contract)."""
+    import glob
+    import struct
+
+    def _row_dtype(dim):
+        # matches the .psbin row layout: [i64 id][f32 * dim]
+        return np.dtype([("id", "<i8"), ("w", "<f4", (dim,))])
+
+    written = []
+    if to == "native":
+        for path in glob.glob(os.path.join(dirname, "*.shard*.npz")):
+            data = np.load(path)
+            ids = np.asarray(data["ids"], np.int64)
+            vals = np.ascontiguousarray(
+                np.asarray(data["values"], np.float32))
+            dim = int(vals.shape[1]) if vals.ndim == 2 else 0
+            rows = np.empty((len(ids),), _row_dtype(dim))
+            rows["id"] = ids
+            rows["w"] = vals
+            out = path[: -len(".npz")] + ".psbin"
+            with open(out, "wb") as f:
+                f.write(struct.pack("<IQ", dim, len(ids)))
+                rows.tofile(f)  # one vectorized pass — shards are huge
+            written.append(out)
+    elif to == "python":
+        for path in glob.glob(os.path.join(dirname, "*.shard*.psbin")):
+            with open(path, "rb") as f:
+                dim, n = struct.unpack("<IQ", f.read(12))
+                rows = np.fromfile(f, _row_dtype(dim), count=n)
+            if len(rows) != n:
+                raise ValueError(f"{path}: truncated ({len(rows)}/{n} rows)")
+            out = path[: -len(".psbin")] + ".npz"
+            np.savez(out, ids=rows["id"].astype(np.int64),
+                     values=np.ascontiguousarray(rows["w"]))
+            written.append(out)
+    else:
+        raise ValueError(f"unknown target plane {to!r} (native|python)")
+    return written

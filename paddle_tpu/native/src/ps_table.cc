@@ -104,7 +104,7 @@ struct Server {
   std::mutex barrier_mu;
   std::condition_variable barrier_cv;
   std::map<std::string, int64_t> barrier_count;
-  std::vector<std::thread> workers;
+  std::atomic<int> active_workers{0};  // detached serve_client threads
   std::mutex fds_mu;
   std::vector<int> client_fds;
 };
@@ -168,8 +168,14 @@ void apply_push(Table* t, uint32_t server_idx, int64_t rid, const float* g) {
 
 int64_t do_save(Server* s, const std::string& dirname) {
   ::mkdir(dirname.c_str(), 0777);  // EEXIST is fine
-  std::lock_guard<std::mutex> lk(s->tables_mu);
-  for (auto& kv : s->tables) {
+  // snapshot the table list only — holding tables_mu across the file
+  // I/O would stall every concurrent pull/push for the whole save
+  std::vector<std::pair<std::string, Table*>> snapshot;
+  {
+    std::lock_guard<std::mutex> lk(s->tables_mu);
+    snapshot.assign(s->tables.begin(), s->tables.end());
+  }
+  for (auto& kv : snapshot) {
     Table* t = kv.second;
     std::lock_guard<std::mutex> tl(t->mu);
     std::string path = dirname + "/" + kv.first + ".shard" +
@@ -309,6 +315,10 @@ void serve_client(Server* s, int fd) {
       }
       case 6: {  // BARRIER: n = world; status = arrival position 1..world
         int64_t world = static_cast<int64_t>(n);
+        if (world < 1) {  // div-by-zero would SIGFPE the whole server
+          status = -5;
+          break;
+        }
         std::unique_lock<std::mutex> lk(s->barrier_mu);
         int64_t count = ++s->barrier_count[name];
         int64_t pos = (count - 1) % world + 1;
@@ -378,7 +388,14 @@ void ps_accept_loop(Server* s) {
       std::lock_guard<std::mutex> lk(s->fds_mu);
       s->client_fds.push_back(fd);
     }
-    s->workers.emplace_back(serve_client, s, fd);
+    // detached + counted: an unjoined std::thread per connection would
+    // leak stacks/TCBs under reconnect churn; shutdown waits on the
+    // counter instead of join
+    s->active_workers.fetch_add(1);
+    std::thread([s, fd] {
+      serve_client(s, fd);
+      s->active_workers.fetch_sub(1);
+    }).detach();
   }
 }
 
@@ -515,8 +532,10 @@ void pst_server_stop(void* sp) {
     for (int fd : s->client_fds) ::shutdown(fd, SHUT_RDWR);
   }
   if (s->thread.joinable()) s->thread.join();
-  for (auto& w : s->workers)
-    if (w.joinable()) w.join();
+  // detached workers: wait (bounded) for the active counter — their fds
+  // were shut down above, so recv() returns and they exit promptly
+  for (int i = 0; i < 500 && s->active_workers.load() > 0; ++i)
+    ::usleep(10000);
   {
     std::lock_guard<std::mutex> lk(s->tables_mu);
     for (auto& kv : s->tables) delete kv.second;
