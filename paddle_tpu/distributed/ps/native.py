@@ -149,6 +149,11 @@ class NativePsClient:
                 "entry-admission policies are a Python-data-plane feature "
                 "(distributed.ps.PsServer/PsClient); the native plane "
                 "serves plain tables")
+        if "\n" in cfg.name:
+            raise ValueError(
+                "native-plane table names cannot contain newlines (the "
+                "LIST op is newline-framed); use the Python plane for "
+                "such names")
         init_kind = 1 if cfg.initializer == "zeros" else 0
         for h, lk in zip(self._conns, self._locks):
             with lk:
@@ -250,16 +255,32 @@ class NativePsClient:
                     self._lib.pst_save(h, dirname.encode()), "save"))
         return out
 
+    def _list_tables(self, idx: int):
+        cap = 1 << 16
+        for _ in range(2):
+            buf = ctypes.create_string_buffer(cap)
+            got = ctypes.c_uint64(0)
+            self._check(self._lib.pst_list_tables(
+                self._conns[idx], buf, cap, ctypes.byref(got)),
+                "list_tables")
+            n = int(got.value)
+            if n <= cap:  # ps_request reports the FULL length — a larger
+                blob = buf.raw[:n].decode()  # value means truncation
+                return [t for t in blob.split("\n") if t]
+            cap = n
+        raise RuntimeError("list_tables: table set changed mid-listing")
+
     def stats(self):
-        """Row counts per server for the tables THIS client created
-        (the Python plane reports every server-side table; the native
-        protocol has no table-list op)."""
+        """Row counts per server for EVERY server-side table (same
+        semantics as the Python plane — the LIST op discovers tables
+        this client did not itself create)."""
         out = []
-        for h, lk in zip(self._conns, self._locks):
+        for i, (h, lk) in enumerate(zip(self._conns, self._locks)):
             with lk:
+                names = self._list_tables(i)
                 out.append({t: int(self._check(
                     self._lib.pst_stats(h, t.encode()), "stats"))
-                    for t in self._dims})
+                    for t in names})
         return out
 
     def stop_servers(self):

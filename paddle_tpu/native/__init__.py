@@ -173,6 +173,10 @@ def _load():
     lib.pst_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.pst_stop.restype = ctypes.c_int64
     lib.pst_stop.argtypes = [ctypes.c_void_p]
+    lib.pst_list_tables.restype = ctypes.c_int64
+    lib.pst_list_tables.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_uint64,
+                                    ctypes.POINTER(ctypes.c_uint64)]
     _LIB = lib
     AVAILABLE = True
     return lib

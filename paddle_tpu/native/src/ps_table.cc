@@ -345,6 +345,17 @@ void serve_client(Server* s, int fd) {
       }
       case 9:  // STOP
         break;
+      case 10: {  // LIST: newline-joined table names (stats parity with
+                  // the Python plane, which reports every table)
+        std::lock_guard<std::mutex> lk(s->tables_mu);
+        std::string names;
+        for (auto& kv : s->tables) {
+          if (!names.empty()) names += '\n';
+          names += kv.first;
+        }
+        out.assign(names.begin(), names.end());
+        break;
+      }
       default:
         status = -1;
     }
@@ -659,6 +670,12 @@ int64_t pst_stats(void* cp, const char* name) {
 int64_t pst_stop(void* cp) {
   return ps_request(*static_cast<int*>(cp), 9, "", nullptr, 0, 0, nullptr, 0,
                     nullptr, 0, nullptr);
+}
+
+int64_t pst_list_tables(void* cp, uint8_t* out, uint64_t out_cap,
+                        uint64_t* out_len) {
+  return ps_request(*static_cast<int*>(cp), 10, "", nullptr, 0, 0, nullptr,
+                    0, out, out_cap, out_len);
 }
 
 }  // extern "C"

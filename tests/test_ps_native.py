@@ -128,6 +128,29 @@ class TestNativePlane:
         finally:
             fresh.stop()
 
+    def test_stats_discovers_other_clients_tables(self):
+        """stats() reports EVERY server-side table via the LIST op —
+        including tables a monitoring client never created (Python-plane
+        parity)."""
+        NativePsServer, NativePsClient = _native()
+        srvs, c1 = _pair(1)
+        try:
+            c1.create_table(TableConfig("emb", dim=4))
+            c1.pull_sparse("emb", np.arange(5, dtype=np.int64))
+            c2 = NativePsClient([f"127.0.0.1:{srvs[0].port}"])
+            assert c2.stats() == [{"emb": 5}]
+            c2.close()
+        finally:
+            c1.stop_servers()
+
+    def test_newline_table_name_refused(self):
+        srvs, c = _pair(1)
+        try:
+            with pytest.raises(ValueError, match="newline"):
+                c.create_table(TableConfig("a\nb", dim=2))
+        finally:
+            c.stop_servers()
+
     def test_convert_save_roundtrips_both_ways(self, tmp_path):
         """convert_save bridges the per-plane save formats: a Python-
         plane save restores on a native server after conversion with
