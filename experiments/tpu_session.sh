@@ -55,23 +55,26 @@ run env PADDLE_TPU_TESTS_ON_DEVICE=1 PADDLE_TPU_HB_ON_DEVICE=1 \
 #    attempt BENCH_WATCHDOG_SECS then a 600s CPU retry — keep the outer
 #    step timeout above their sum so the CPU retry can finish)
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py
-# 3. flag-deciding experiments
+# ---- steps 3+ ordered by VALUE-PER-MINUTE: the 2026-07-31 window
+# ---- lasted 35 min and died before any lever was measured — the
+# ---- MFU-moving experiments go before the bigger-config benches
+# 3. flag-deciding experiments (cheap compiles, decide defaults)
 run python experiments/exp_flash_hb.py     # FLAGS_flash_head_batched
 # exp_dots: 8 variants x EXP_VARIANT_SECS(600) worst case — the step
 # timeout must cover the per-variant budgets, not fight them
 STEP_TIMEOUT=5100 run python experiments/exp_dots.py   # scan_unroll+remat
-# 4. autotune sweep -> .autotune_cache.json (commit it); 5 trials x
-#    EXP_TRIAL_SECS(900)
-STEP_TIMEOUT=4800 run python experiments/exp_autotune_sweep.py
-# 5. bigger configs (cold-cache compiles can be slow through the tunnel)
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py 1.3b
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py ragged
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py decode
-# 6. lever A/B on the full bench (log evidence, not the round record;
+# 4. lever A/B on the full bench (log evidence, not the round record;
 #    flip a default in code only on a >=3% full-step win per PERF.md)
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 BENCH_REMAT=attn_out \
     python bench.py
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 BENCH_SCAN_UNROLL=2 \
     python bench.py
+# 5. autotune sweep -> .autotune_cache.json (commit it); 5 trials x
+#    EXP_TRIAL_SECS(900)
+STEP_TIMEOUT=4800 run python experiments/exp_autotune_sweep.py
+# 6. bigger configs (cold-cache compiles can be slow through the tunnel)
+STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py 1.3b
+STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py ragged
+STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py decode
 echo "=== session done; review $LOG, flip flags per PERF.md decision" \
      "rules, re-run bench.py, commit .autotune_cache.json ===" | tee -a "$LOG"
