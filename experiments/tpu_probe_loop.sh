@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-5 continuous tunnel probe (VERDICT r4 Next #1): probe the axon
+# TPU claim every PROBE_INTERVAL seconds from a SUBPROCESS with a hard
+# timeout (a wedged claim hangs jax.devices() forever — never probe
+# in-process), and the moment a window opens, run the prepared session
+# runbook end-to-end.  One claim at a time: the probe process exits
+# before the runbook starts.
+set -u
+cd "$(dirname "$0")/.."
+LOG=TPU_PROBES_r5.log
+N=${PROBE_START:-1}
+while true; do
+  ts=$(date -u +%FT%TZ)
+  # -k 10: the probe itself can ignore TERM while stuck in
+  # make_c_api_client; KILL follows.  270s absorbs the ~2.3s
+  # sitecustomize import plus slow-but-live tunnel handshakes.
+  out=$(timeout -k 10 270 python -c \
+    "import jax; ds=jax.devices(); print('PLAT', ds[0].platform, len(ds))" \
+    2>&1)
+  rc=$?
+  if [ "$rc" -eq 0 ] && printf '%s' "$out" | grep -q "PLAT tpu"; then
+    echo "$ts probe$N: WINDOW OPEN ($out) -> runbook" >>"$LOG"
+    touch experiments/TPU_WINDOW_OPEN
+    bash experiments/tpu_session.sh
+    echo "$(date -u +%FT%TZ) probe$N: runbook finished" >>"$LOG"
+    rm -f experiments/TPU_WINDOW_OPEN
+  elif [ "$rc" -eq 0 ]; then
+    echo "$ts probe$N: devices up but not tpu ($out)" >>"$LOG"
+  else
+    echo "$ts probe$N: no devices (claim hung or timeout, rc=$rc)" >>"$LOG"
+  fi
+  N=$((N + 1))
+  sleep "${PROBE_INTERVAL:-600}"
+done

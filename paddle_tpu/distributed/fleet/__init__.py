@@ -471,18 +471,56 @@ def is_worker() -> bool:
 
 def _ps_plane():
     """Data-plane selection (must be consistent across the server group
-    and all trainers): PADDLE_PS_DATA_PLANE=native picks the C++ plane
-    (ps/native.py over native/src/ps_table.cc — the brpc-analog hot
-    path); default is the full-featured Python plane."""
+    and all trainers, and save formats are per-plane):
+
+    - ``PADDLE_PS_DATA_PLANE=native`` — the C++ plane (ps/native.py over
+      native/src/ps_table.cc, binary wire protocol — the brpc-analog hot
+      path for plain tables).
+    - ``PADDLE_PS_DATA_PLANE=python`` — the full-featured numpy plane
+      (entry-admission policies, show/click accessors). Its transport is
+      pickle-over-TCP: TRUSTED NETWORKS ONLY.
+    - default (``auto``): native when the toolchain built it — plain
+      tables shouldn't pay pickling, and the native plane raises loudly
+      (pointing back here) if an accessor-feature table is requested.
+      When the build is UNAVAILABLE, auto raises instead of silently
+      falling back to python: the selection must resolve identically on
+      every node (a node-local fallback would let a toolchain-less
+      trainer pickle into peers' binary-protocol servers and die with an
+      opaque EOF) — pin the plane via the env var cluster-wide."""
     import os
 
-    if os.environ.get("PADDLE_PS_DATA_PLANE", "python") == "native":
+    plane = os.environ.get("PADDLE_PS_DATA_PLANE", "auto")
+    if plane == "auto":
+        plane = _ps_plane._auto  # one build probe per process: the
+        if plane is None:        # g++ compile behind lib_path() can
+            from ... import native as native_lib  # take ~2 min cold
+
+            plane = "native" if native_lib.lib_path() else "unavailable"
+            _ps_plane._auto = plane
+        if plane == "unavailable":
+            raise RuntimeError(
+                "PADDLE_PS_DATA_PLANE=auto: the native data plane did "
+                "not build on this node (g++ missing or compile failed) "
+                "— other nodes may still pick native, and mixed planes "
+                "fail with opaque stream errors. Set "
+                "PADDLE_PS_DATA_PLANE=python (or =native) identically "
+                "on every server and trainer node")
+    if plane == "native":
         from ..ps.native import NativePsClient, NativePsServer
 
         return NativePsServer, NativePsClient
+    if plane != "python":
+        # a typo must not silently engage the pickle transport (and
+        # desync from peers that resolved the value correctly)
+        raise ValueError(
+            f"PADDLE_PS_DATA_PLANE={plane!r}: expected 'auto', 'native' "
+            "or 'python'")
     from ..ps import PsClient, PsServer
 
     return PsServer, PsClient
+
+
+_ps_plane._auto = None  # memoized auto-mode probe result
 
 
 def init_server(*args, **kwargs):

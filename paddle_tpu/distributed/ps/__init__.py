@@ -235,10 +235,25 @@ class PsServer:
     """One parameter-server node. ``start()`` returns immediately (the
     accept loop runs on threads — reference PsService handlers);
     ``run()`` blocks until a client sends STOP (reference
-    fleet.run_server)."""
+    fleet.run_server).
+
+    .. warning:: TRUSTED NETWORKS ONLY. This plane's transport is
+       pickle-over-TCP: anyone who can reach the port can execute code
+       in this process via a crafted pickle. Bind it on a private
+       cluster interface only. Plain tables (no entry-admission /
+       show-click accessors) should use the native binary-protocol
+       plane instead (``distributed.ps.native``, the default under
+       ``fleet.init_server`` when the toolchain is available)."""
 
     def __init__(self, server_idx: int, num_servers: int, port: int = 0,
                  host: str = "127.0.0.1"):
+        import warnings
+
+        warnings.warn(
+            "PsServer's Python data plane unpickles from its TCP port — "
+            "trusted cluster networks only (use the native plane, "
+            "PADDLE_PS_DATA_PLANE=native, for plain tables)",
+            RuntimeWarning, stacklevel=2)
         self.server_idx = int(server_idx)
         self.num_servers = int(num_servers)
         self._tables: Dict[str, _SparseShard] = {}

@@ -14,9 +14,11 @@ interchangeable tables. Feature split, documented:
   ShowClick) and show/click accessors — ``create_table`` here raises on
   ``cfg.entry`` and points at the Python plane.
 
-Select per cluster via ``PADDLE_PS_DATA_PLANE=native`` (the fleet
-``init_server``/``init_worker`` flow honors it); mixing planes within
-one server group is not supported.
+This plane is the DEFAULT under the fleet ``init_server``/
+``init_worker`` flow whenever the toolchain builds it;
+``PADDLE_PS_DATA_PLANE`` (``native``/``python``) pins the choice and
+must be set identically on every node — mixing planes within one
+server group is not supported (and fails with opaque stream errors).
 """
 from __future__ import annotations
 

@@ -12,7 +12,19 @@
 //   request:  u8 op | u32 nlen | name bytes | u64 n | payload
 //     op: 0=CREATE 1=PULL 2=PUSH 3=DENSE_INIT 4=DENSE_PULL 5=DENSE_PUSH
 //         6=BARRIER 7=SAVE 8=STATS 9=STOP
+//         10=LIST (no payload; response payload = table names joined by
+//            '\n', truncated client-side only by the caller's out_cap —
+//            the server always sends the full list; native.py stats()
+//            depends on this op)
 //   response: i64 status | u64 plen | payload     (status<0 = error)
+//     error statuses: -1 unknown op, -2 io, -3 no such table/entry,
+//     -4 dim mismatch, -5 bad barrier world, -6 wire size over cap or
+//     invalid name, -7 server-side exception (connection closes),
+//     -9 barrier aborted by server stop.
+//     -6 closes the connection ONLY when the request payload could not
+//     be read under the cap (the unread bytes would desync the stream);
+//     a -6 for an invalid CREATE name or an over-cap PULL response
+//     leaves the fully-read connection open.
 //
 // Row init matches the Python plane EXACTLY (hash_uniform below ==
 // distributed/ps/__init__.py::_hash_uniform), so a table built through
@@ -32,6 +44,7 @@
 
 #include <atomic>
 #include <condition_variable>
+#include <exception>
 #include <map>
 #include <mutex>
 #include <string>
@@ -60,6 +73,32 @@ bool write_n(int fd, const void* buf, size_t n) {
     p += r;
     n -= r;
   }
+  return true;
+}
+
+// Wire-supplied sizes are UNTRUSTED: n/nlen/dim come off the socket, and
+// an overflowing n*dim*4 under-allocates the payload buffer while the
+// i<n loops still walk the full range (heap OOB), while a huge-but-valid
+// n would bad_alloc inside a detached thread (std::terminate kills the
+// whole host process — the server runs in-process of the Python trainer).
+constexpr uint64_t kMaxReqBytes = 1ull << 31;  // 2 GiB per request
+constexpr uint32_t kMaxNameLen = 4096;
+
+// total = a*b + c with overflow + cap check. Callers pass small c.
+inline bool checked_size(uint64_t a, uint64_t b, uint64_t c, uint64_t* total) {
+  if (b != 0 && a > (kMaxReqBytes - c) / b) return false;
+  *total = a * b + c;
+  return *total <= kMaxReqBytes;
+}
+
+// Table names become save/load file path components — reject separators
+// and traversal server-side (a raw client could otherwise escape the
+// SAVE dirname; native.py also rejects these client-side).
+bool valid_table_name(const std::string& name) {
+  if (name.empty() || name.size() > 256) return false;
+  if (name.find('/') != std::string::npos) return false;
+  if (name.find("..") != std::string::npos) return false;
+  if (name.find('\n') != std::string::npos) return false;
   return true;
 }
 
@@ -202,18 +241,28 @@ void serve_client(Server* s, int fd) {
     uint32_t nlen;
     uint64_t n;
     if (!read_n(fd, &op, 1) || !read_n(fd, &nlen, 4)) break;
+    if (nlen > kMaxNameLen) break;  // protocol violation: close
     std::string name(nlen, '\0');
     if (nlen && !read_n(fd, name.data(), nlen)) break;
     if (!read_n(fd, &n, 8)) break;
 
     int64_t status = 0;
+    // set when the request's payload could not be (fully) read off the
+    // wire under the size cap — the stream is desynced, so the reply is
+    // followed by a close instead of another parse round
+    bool close_conn = false;
     out.clear();
+    try {
     switch (op) {
       case 0: {  // CREATE: payload = packed TableCfg
         TableCfg cfg;
         payload.resize(sizeof(TableCfg));
         if (!read_n(fd, payload.data(), payload.size())) goto done;
         std::memcpy(&cfg, payload.data(), sizeof(TableCfg));
+        if (!valid_table_name(name)) {
+          status = -6;
+          break;
+        }
         std::lock_guard<std::mutex> lk(s->tables_mu);
         auto it = s->tables.find(name);
         if (it == s->tables.end()) {
@@ -233,7 +282,13 @@ void serve_client(Server* s, int fd) {
         break;
       }
       case 1: {  // PULL: n ids -> dim + n*dim floats
-        payload.resize(n * 8);
+        uint64_t need = 0;
+        if (!checked_size(n, 8, 0, &need)) {
+          status = -6;
+          close_conn = true;
+          break;
+        }
+        payload.resize(need);
         if (n && !read_n(fd, payload.data(), payload.size())) goto done;
         Table* t = get_table(s, name);
         if (!t) {
@@ -245,7 +300,12 @@ void serve_client(Server* s, int fd) {
         // be read under the same lock (UB otherwise)
         std::lock_guard<std::mutex> lk(t->mu);
         uint32_t dim = t->cfg.dim;
-        out.resize(4 + n * dim * 4);
+        uint64_t osz = 0;  // response size: payload was read, keep conn
+        if (!checked_size(n, static_cast<uint64_t>(dim) * 4, 4, &osz)) {
+          status = -6;
+          break;
+        }
+        out.resize(osz);
         std::memcpy(out.data(), &dim, 4);
         float* dst = reinterpret_cast<float*>(out.data() + 4);
         for (uint64_t i = 0; i < n; ++i) {
@@ -261,7 +321,14 @@ void serve_client(Server* s, int fd) {
       case 2: {  // PUSH: u32 dim | n ids | n*dim grads
         uint32_t dim;
         if (!read_n(fd, &dim, 4)) goto done;
-        payload.resize(n * 8 + static_cast<uint64_t>(n) * dim * 4);
+        uint64_t need = 0;
+        if (!checked_size(n, 8ull + static_cast<uint64_t>(dim) * 4, 0,
+                          &need)) {
+          status = -6;
+          close_conn = true;
+          break;
+        }
+        payload.resize(need);
         if (n && !read_n(fd, payload.data(), payload.size())) goto done;
         Table* t = get_table(s, name);
         if (!t) {
@@ -280,7 +347,13 @@ void serve_client(Server* s, int fd) {
         break;
       }
       case 3: {  // DENSE_INIT: n floats (first write wins, like setdefault)
-        payload.resize(n * 4);
+        uint64_t need = 0;
+        if (!checked_size(n, 4, 0, &need)) {
+          status = -6;
+          close_conn = true;
+          break;
+        }
+        payload.resize(need);
         if (n && !read_n(fd, payload.data(), payload.size())) goto done;
         const float* v = reinterpret_cast<const float*>(payload.data());
         std::lock_guard<std::mutex> lk(s->dense_mu);
@@ -301,7 +374,13 @@ void serve_client(Server* s, int fd) {
       case 5: {  // DENSE_PUSH: f32 lr | n grads  (server-side sgd)
         float lr;
         if (!read_n(fd, &lr, 4)) goto done;
-        payload.resize(n * 4);
+        uint64_t need = 0;
+        if (!checked_size(n, 4, 0, &need)) {
+          status = -6;
+          close_conn = true;
+          break;
+        }
+        payload.resize(need);
         if (n && !read_n(fd, payload.data(), payload.size())) goto done;
         const float* g = reinterpret_cast<const float*>(payload.data());
         std::lock_guard<std::mutex> lk(s->dense_mu);
@@ -327,7 +406,10 @@ void serve_client(Server* s, int fd) {
           return s->barrier_count[name] >= target || s->stop.load();
         });
         s->barrier_cv.notify_all();
-        status = pos;
+        // a stop-woken waiter whose barrier never filled must NOT look
+        // like a completed barrier — callers would proceed as if every
+        // peer had arrived
+        status = s->barrier_count[name] >= target ? pos : -9;
         break;
       }
       case 7:  // SAVE: name = dirname
@@ -359,12 +441,20 @@ void serve_client(Server* s, int fd) {
       default:
         status = -1;
     }
+    } catch (const std::exception&) {
+      // bad_alloc etc. in a DETACHED thread would std::terminate the
+      // whole host process; reply with an error and close instead
+      status = -7;
+      close_conn = true;
+      out.clear();
+    }
 
     {
       uint64_t plen = out.size();
       if (!write_n(fd, &status, 8) || !write_n(fd, &plen, 8)) break;
       if (plen && !write_n(fd, out.data(), plen)) break;
     }
+    if (close_conn) break;
     if (op == 9) {
       s->stop.store(true);
       s->barrier_cv.notify_all();
@@ -516,6 +606,13 @@ int64_t pst_server_load(void* sp, const char* dirname, const char* table,
     }
   }
   std::lock_guard<std::mutex> tl(t->mu);
+  // an existing table keeps its cfg — a file with a DIFFERENT dim would
+  // leave rows shorter than cfg.dim, and later PULL/PUSH memcpys would
+  // run past the row buffer (mirrors the CREATE adopt check, -4)
+  if (t->cfg.dim != dim) {
+    std::fclose(f);
+    return -4;
+  }
   uint64_t loaded = 0;
   for (; loaded < n; ++loaded) {
     int64_t rid;

@@ -260,11 +260,16 @@ class TestFleetRoleFlow:
 
 
 class TestSaveRestore:
-    def test_init_server_dirname_restores_tables(self, tmp_path):
+    def test_init_server_dirname_restores_tables(self, tmp_path,
+                                                 monkeypatch):
         """fleet.init_server(dirname) loads a prior save (reference
-        load-model-on-init contract), per shard."""
+        load-model-on-init contract), per shard. Pinned to the python
+        plane: the save here is .npz (save formats are per-plane, and
+        the auto default may pick native)."""
         import paddle_tpu.distributed.fleet as fleet
         from paddle_tpu.distributed.fleet import Role, UserDefinedRoleMaker
+
+        monkeypatch.setenv("PADDLE_PS_DATA_PLANE", "python")
 
         srvs, eps = _servers(2)
         c = PsClient(eps)
