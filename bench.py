@@ -196,6 +196,21 @@ def main(model_size: str = "350m"):
         "platform": platform,
         "final_loss": loss_val,
     }
+    try:
+        # which flash sub-lane plan this config's head_dim rides (the r4
+        # record's comparability problem: a silent fp32 upcast at hd<128
+        # would not be the same benchmark — surface it in the record)
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.flash_attention_kernel import _sublane_plan
+
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        smode, dpad = _sublane_plan(
+            hd, jnp.bfloat16 if on_tpu else jnp.float32, not on_tpu)
+        rec["flash_sublane"] = {"head_dim": hd, "mode": smode or "native",
+                                "dpad": dpad}
+    except Exception:
+        pass
     if not on_tpu:
         # a CPU fallback record is a MISSING TPU number, not a result —
         # attach the round's probe history and the hardware-free evidence

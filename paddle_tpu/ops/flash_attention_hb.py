@@ -64,6 +64,12 @@ def supports_hb(q_shape, k_shape, dropout_p: float,
     # Per-head (6.0 ms fwd+bwd at bench shapes) remains the device path.
     if not it and os.environ.get("PADDLE_TPU_HB_ON_DEVICE", "") != "1":
         return False
+    # this kernel does bf16 D-contracting dots WITHOUT the _sublane_plan
+    # padding the per-head kernels apply — at D % 128 != 0 Mosaic would
+    # reject them ("Bad lhs type"), so refuse device routing there (the
+    # per-head path handles those shapes natively via its pad plan)
+    if not it and d % 128 != 0:
+        return False
     return (h == hkv and dropout_p == 0.0
             and 2 * h * block * block * 4 <= _VMEM_SCORE_BUDGET
             and _pick_block(sq, block, it) is not None

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -77,6 +78,55 @@ def supports(sq: int, sk: int, interpret: Optional[bool] = None) -> bool:
     it = _interpret() if interpret is None else interpret
     return (_pick_block(sq, 1024, it) is not None
             and _pick_block(sk, 1024, it) is not None)
+
+
+def _sublane_plan(d: int, dtype, interpret: bool):
+    """Mosaic (v5e libtpu) rejects bf16 dots whose CONTRACTION dim is not
+    a lane multiple ("Bad lhs type" on the D-contracting q·kᵀ / dO·vᵀ
+    dots when D % 128 != 0, found on-chip 2026-07-31).  Returns
+    ``(mode, dpad)``:
+
+    - ``(None, d)``  — native path, nothing to do (D already a lane
+      multiple, fp32 input, or interpret mode).
+    - ``('pad', dp)``  — zero-pad D to ``dp`` OUTSIDE the kernel: the
+      kernel then runs the exact D=128 bf16 shapes that were on-chip
+      green from the start.  Full-rate bf16 MXU dots; costs ~2x q/k/v/o
+      HBM bytes at D=64.  The default.
+    - ``('kpad', dp)`` — zero-pad INSIDE the kernel (VMEM concat after
+      load, slice before store): same full-rate dots with NO extra HBM
+      traffic, but needs Mosaic's in-kernel concatenate lowering — run
+      the staged on-chip parity check before trusting it on hardware.
+    - ``('fp32', d)``  — the r4 guard: upcast everything to fp32
+      (compiles everywhere, but fp32 dots run at a fraction of bf16
+      MXU rate on the hottest kernel).  Escape hatch.
+
+    Select via ``PADDLE_TPU_FLASH_SUBLANE`` (pad|kpad|fp32).  Padding
+    with zeros is exact: zero lanes contribute 0 to every D-contraction,
+    and the padded tail of each output is sliced off (fwd) or provably
+    zero (grads).
+
+    ``PADDLE_TPU_FLASH_SUBLANE_FORCE=1`` applies the plan in interpret
+    mode too — that is how the CPU suite exercises the pad/kpad numerics
+    the device path will run.
+    """
+    force = os.environ.get("PADDLE_TPU_FLASH_SUBLANE_FORCE") == "1"
+    if ((interpret and not force) or d % 128 == 0
+            or jnp.dtype(dtype) == jnp.float32):
+        return None, d
+    mode = os.environ.get("PADDLE_TPU_FLASH_SUBLANE", "pad")
+    if mode not in ("pad", "kpad", "fp32"):
+        raise ValueError(
+            f"PADDLE_TPU_FLASH_SUBLANE={mode!r}: expected pad|kpad|fp32")
+    return mode, -(-d // 128) * 128
+
+
+def _pad_d(x, dpad: int):
+    """Zero-pad the trailing (head) dim to ``dpad`` lanes."""
+    if x.shape[-1] == dpad:
+        return x
+    return jnp.concatenate(
+        [x, jnp.zeros(x.shape[:-1] + (dpad - x.shape[-1],), x.dtype)],
+        axis=-1)
 
 
 # ---------------------------------------------------------------------------
@@ -172,7 +222,7 @@ def _dropped(p, seed, b, h, iq, ik, block_q, block_k, dropout_p):
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, sm_scale, causal, dropout_p,
-                offset, block_q, block_k):
+                offset, block_q, block_k, dpad):
     b, h, iq, ik = (pl.program_id(i) for i in range(4))
     nk = pl.num_programs(3)
 
@@ -183,9 +233,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     def _compute():
-        q = q_ref[0, 0]                      # (bq, D)
-        k = k_ref[0, 0]                      # (bk, D)
-        v = v_ref[0, 0]
+        q = _pad_d(q_ref[0, 0], dpad)        # (bq, Dp)
+        k = _pad_d(k_ref[0, 0], dpad)        # (bk, Dp)
+        v = _pad_d(v_ref[0, 0], dpad)
         s, valid = _block_scores(q, k, sm_scale, causal, iq, ik,
                                  block_q, block_k, offset)
         # single-column running stats: alpha's exp runs on (bq, 1), not the
@@ -227,12 +277,21 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = l_ref[:, 0:1]
         l_safe = jnp.maximum(l, 1e-30)
-        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        # [:, :D] is a no-op unless dpad padded the accumulator (kpad)
+        o_ref[0, 0] = ((acc_ref[...] / l_safe)[:, :o_ref.shape[-1]]
+                       .astype(o_ref.dtype))
         lse_ref[0, 0] = m_ref[:, 0:1] + jnp.log(l_safe)  # (bq, 1)
 
 
 def _fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p, block_q, block_k,
               interpret):
+    in_dtype = q.dtype
+    d_orig = q.shape[-1]
+    mode, dp = _sublane_plan(d_orig, in_dtype, interpret)
+    if mode == "fp32":
+        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    elif mode == "pad":
+        q, k, v = (_pad_d(x, dp) for x in (q, k, v))
     bsz, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     group = hq // hkv
@@ -240,10 +299,11 @@ def _fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p, block_q, block_k,
     bk = _pick_block(sk, block_k, interpret)
     nq, nk = sq // bq, sk // bk
     offset = sk - sq
+    dpad = dp if mode == "kpad" else d
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           dropout_p=dropout_p, offset=offset,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, dpad=dpad),
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct((bsz, hq, sq, 1), jnp.float32)],
         grid=(bsz, hq, nq, nk),
@@ -260,12 +320,16 @@ def _fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p, block_q, block_k,
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         scratch_shapes=[
-            _VMEM((bq, d), jnp.float32),
+            _VMEM((bq, dpad), jnp.float32),
             _VMEM((bq, 128), jnp.float32),
             _VMEM((bq, 128), jnp.float32),
         ],
         interpret=interpret,
     )(seed, q, k, v)
+    if mode == "pad":
+        out = out[..., :d_orig]
+    elif mode == "fp32":
+        out = out.astype(in_dtype)
     return out, lse
 
 
@@ -276,7 +340,7 @@ def _fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p, block_q, block_k,
 
 def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, acc_ref, *, sm_scale, causal, dropout_p, offset,
-                   block_q, block_k):
+                   block_q, block_k, dpad):
     b, h, iq, ik = (pl.program_id(i) for i in range(4))
     nk = pl.num_programs(3)
 
@@ -285,10 +349,10 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
+        q = _pad_d(q_ref[0, 0], dpad)
+        k = _pad_d(k_ref[0, 0], dpad)
+        v = _pad_d(v_ref[0, 0], dpad)
+        do = _pad_d(do_ref[0, 0], dpad)
         lse = lse_ref[0, 0]                             # (bq, 1)
         delta = delta_ref[0, 0]
         s, valid = _block_scores(q, k, sm_scale, causal, iq, ik,
@@ -321,12 +385,13 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ik == nk - 1)
     def _finalize():
-        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+        dq_ref[0, 0] = (acc_ref[...][:, :dq_ref.shape[-1]]
+                        .astype(dq_ref.dtype))
 
 
 def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
-                    dropout_p, offset, block_q, block_k, group):
+                    dropout_p, offset, block_q, block_k, group, dpad):
     b, hkv, ik, g, iq = (pl.program_id(i) for i in range(5))
     nq = pl.num_programs(4)
     h = hkv * group + g
@@ -337,10 +402,10 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
+        q = _pad_d(q_ref[0, 0], dpad)
+        k = _pad_d(k_ref[0, 0], dpad)
+        v = _pad_d(v_ref[0, 0], dpad)
+        do = _pad_d(do_ref[0, 0], dpad)
         lse = lse_ref[0, 0]                             # (bq, 1)
         delta = delta_ref[0, 0]
         s, valid = _block_scores(q, k, sm_scale, causal, iq, ik,
@@ -373,12 +438,25 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when((g == group - 1) & (iq == nq - 1))
     def _finalize():
-        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+        dk_ref[0, 0] = (dk_acc[...][:, :dk_ref.shape[-1]]
+                        .astype(dk_ref.dtype))
+        dv_ref[0, 0] = (dv_acc[...][:, :dv_ref.shape[-1]]
+                        .astype(dv_ref.dtype))
 
 
 def _bwd_impl(q, k, v, seed, out, lse, do, causal, sm_scale, dropout_p,
               block_q, block_k, interpret):
+    in_dtype = q.dtype
+    d_orig = q.shape[-1]
+    # delta from the ORIGINAL tensors (padding is exact but pointless
+    # here — the row-sum is over real lanes either way)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)             # [B, Hq, Sq, 1]
+    mode, dp = _sublane_plan(d_orig, in_dtype, interpret)
+    if mode == "fp32":
+        q, k, v, do = (x.astype(jnp.float32) for x in (q, k, v, do))
+    elif mode == "pad":
+        q, k, v, do = (_pad_d(x, dp) for x in (q, k, v, do))
     bsz, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     group = hq // hkv
@@ -386,13 +464,12 @@ def _bwd_impl(q, k, v, seed, out, lse, do, causal, sm_scale, dropout_p,
     bk = _pick_block(sk, block_k, interpret)
     nq, nk = sq // bq, sk // bk
     offset = sk - sq
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)             # [B, Hq, Sq, 1]
+    dpad = dp if mode == "kpad" else d
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           dropout_p=dropout_p, offset=offset,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, dpad=dpad),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=(bsz, hq, nq, nk),
         in_specs=[
@@ -407,14 +484,14 @@ def _bwd_impl(q, k, v, seed, out, lse, do, causal, sm_scale, dropout_p,
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
-        scratch_shapes=[_VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[_VMEM((bq, dpad), jnp.float32)],
         interpret=interpret,
     )(seed, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           dropout_p=dropout_p, offset=offset,
-                          block_q=bq, block_k=bk, group=group),
+                          block_q=bq, block_k=bk, group=group, dpad=dpad),
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         grid=(bsz, hkv, nk, group, nq),
@@ -435,10 +512,14 @@ def _bwd_impl(q, k, v, seed, out, lse, do, causal, sm_scale, dropout_p,
             pl.BlockSpec((1, 1, bk, d), lambda b, hk, j, g, i: (b, hk, j, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b, hk, j, g, i: (b, hk, j, 0)),
         ],
-        scratch_shapes=[_VMEM((bk, d), jnp.float32),
-                        _VMEM((bk, d), jnp.float32)],
+        scratch_shapes=[_VMEM((bk, dpad), jnp.float32),
+                        _VMEM((bk, dpad), jnp.float32)],
         interpret=interpret,
     )(seed, q, k, v, do, lse, delta)
+    if mode == "pad":
+        dq, dk, dv = (x[..., :d_orig] for x in (dq, dk, dv))
+    elif mode == "fp32":
+        dq, dk, dv = (x.astype(in_dtype) for x in (dq, dk, dv))
     return dq, dk, dv
 
 
@@ -501,16 +582,11 @@ def flash_attention_bhsd(q, k, v, *, causal: bool = False,
         seed = jnp.zeros((1,), jnp.int32)
     else:
         seed = jnp.asarray(seed, jnp.int32).reshape((1,))
-    # Mosaic (libtpu v5e toolchain) rejects bf16 matmuls whose contraction
-    # dim is not a lane multiple ("Bad lhs type" on the D-contracting
-    # q·kᵀ / dO·vᵀ dots when D % 128 != 0).  fp32 at the same shapes
-    # compiles and passes parity on-chip, so sub-native head dims take the
-    # fp32 path; D % 128 == 0 keeps native bf16 MXU throughput.
-    in_dtype = q.dtype
-    upcast = (not it and q.shape[-1] % 128 != 0
-              and in_dtype != jnp.float32)
-    if upcast:
-        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    # sub-lane head dims (D % 128 != 0, bf16, on device) are handled
+    # INSIDE _fwd_impl/_bwd_impl (_sublane_plan: zero-pad to a lane
+    # multiple by default, keeping native bf16 MXU dots) so the
+    # explicit-residual callers (ops/flash_residual.py) get the same
+    # treatment as this custom_vjp path.
     if block_q is None or block_k is None:
         # consult the autotune cache (ops/autotune.py); 1024x1024 is the
         # measured default at llama shapes on v5e
@@ -518,10 +594,9 @@ def flash_attention_bhsd(q, k, v, *, causal: bool = False,
 
         tuned = lookup("flash_attention",
                        flash_signature(q.shape[2], k.shape[2], q.shape[-1],
-                                       causal, jnp.dtype(in_dtype).name)) \
+                                       causal, jnp.dtype(q.dtype).name)) \
             or {}
         block_q = block_q or tuned.get("block_q", 1024)
         block_k = block_k or tuned.get("block_k", 1024)
-    out = _flash(q, k, v, seed, causal, float(sm_scale), float(dropout_p),
-                 block_q, block_k, it)
-    return out.astype(in_dtype) if upcast else out
+    return _flash(q, k, v, seed, causal, float(sm_scale), float(dropout_p),
+                  block_q, block_k, it)

