@@ -5,7 +5,7 @@ import jax.numpy as jnp
 
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor, to_tensor
-from ._helpers import unwrap
+from ._helpers import diff_op, unwrap
 
 __all__ = [
     "zeros",
@@ -112,47 +112,46 @@ def eye(num_rows, num_columns=None, dtype=None, name=None):
 
 
 def diag(x, offset=0, padding_value=0, name=None):
-    v = unwrap(x)
-    if jnp.ndim(v) == 1 and padding_value != 0:
-        base = jnp.full(
-            (v.shape[0] + abs(offset),) * 2, padding_value, jnp.result_type(v)
-        )
-        return Tensor(base + jnp.diag(v - padding_value, k=offset))
-    return Tensor(jnp.diag(v, k=offset))
+    # taped via diff_op (like tril/triu): a bare Tensor(...) wrap here
+    # silently dropped gradients (found by the r5 check_grad sweep)
+    def _diag(v):
+        if jnp.ndim(v) == 1 and padding_value != 0:
+            base = jnp.full((v.shape[0] + abs(offset),) * 2, padding_value,
+                            jnp.result_type(v))
+            return base + jnp.diag(v - padding_value, k=offset)
+        return jnp.diag(v, k=offset)
+
+    return diff_op(_diag, "diag")(x)
 
 
 def diagflat(x, offset=0, name=None):
-    return Tensor(jnp.diagflat(unwrap(x), k=offset))
+    return diff_op(lambda v: jnp.diagflat(v, k=offset), "diagflat")(x)
 
 
 def tril(x, diagonal=0, name=None):
-    from ._helpers import diff_op
-
     return diff_op(lambda v: jnp.tril(v, k=diagonal), "tril")(x)
 
 
 def triu(x, diagonal=0, name=None):
-    from ._helpers import diff_op
-
     return diff_op(lambda v: jnp.triu(v, k=diagonal), "triu")(x)
 
 
 def meshgrid(*args, **kwargs):
-    arrs = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
-    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+    # taped (r5 check_grad sweep: the bare Tensor wraps dropped grads)
+    arrs = (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple))
+            else args)
+    return diff_op(lambda *vs: list(jnp.meshgrid(*vs, indexing="ij")),
+                   "meshgrid")(*arrs)
 
 
 def assign(x, output=None):
-    v = jnp.asarray(unwrap(x))
     if output is not None:
-        output.set_value(v)
+        output.set_value(jnp.asarray(unwrap(x)))
         return output
-    return Tensor(v)
+    return diff_op(lambda v: jnp.asarray(v), "assign")(x)
 
 
 def clone(x, name=None):
-    from ._helpers import diff_op
-
     return diff_op(jnp.copy, "clone")(x)
 
 

@@ -126,7 +126,9 @@ def dist(x, y, p=2, name=None):
 
 
 def cond(x, p=None, name=None):
-    return Tensor(jnp.linalg.cond(unwrap(x), p=p))
+    # taped: jnp.linalg.cond is svd/inv-based and differentiable (the
+    # r5 check_grad sweep found the bare Tensor wrap dropped grads)
+    return apply_op(lambda v: jnp.linalg.cond(v, p=p), x, op_name="cond")
 
 
 def cross(x, y, axis=9, name=None):
@@ -182,19 +184,33 @@ def lu(x, pivot=True, get_infos=False, name=None):
 
 
 def qr(x, mode="reduced", name=None):
-    q, r = jnp.linalg.qr(unwrap(x), mode=mode)
-    return Tensor(q), Tensor(r)
+    if mode == "complete":
+        # JAX has no QR derivative for complete mode — taping would make
+        # the FORWARD raise for grad-enabled inputs; keep it untaped
+        q, r = jnp.linalg.qr(unwrap(x), mode=mode)
+        return Tensor(q), Tensor(r)
+    out = apply_op(lambda v: jnp.linalg.qr(v, mode=mode), x, op_name="qr")
+    return out if mode == "r" else (out[0], out[1])
 
 
 def svd(x, full_matrices=False, name=None):
     """Returns (U, S, VH) with U @ diag(S) @ VH == x, matching the reference
     (python/paddle/tensor/linalg.py svd returns VH)."""
-    u, s, vh = jnp.linalg.svd(unwrap(x), full_matrices=full_matrices)
-    return Tensor(u), Tensor(s), Tensor(vh)
+    if full_matrices:
+        # no JAX SVD derivative for full matrices — untaped (taping would
+        # break the forward for grad-enabled inputs)
+        u, s, vh = jnp.linalg.svd(unwrap(x), full_matrices=True)
+        return Tensor(u), Tensor(s), Tensor(vh)
+    out = apply_op(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=False)),
+        x, op_name="svd")
+    return out[0], out[1], out[2]
 
 
 def pinv(x, rcond=1e-15, hermitian=False, name=None):
-    return Tensor(jnp.linalg.pinv(unwrap(x), rtol=rcond, hermitian=hermitian))
+    return apply_op(
+        lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+        x, op_name="pinv")
 
 
 def inverse(x, name=None):
@@ -231,8 +247,9 @@ def eig(x, name=None):
 
 
 def eigh(x, UPLO="L", name=None):
-    w, v = jnp.linalg.eigh(unwrap(x), UPLO=UPLO)
-    return Tensor(w), Tensor(v)
+    out = apply_op(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), x,
+                   op_name="eigh")
+    return out[0], out[1]
 
 
 def eigvals(x, name=None):
@@ -280,7 +297,8 @@ builtins_max = max
 
 
 def corrcoef(x, rowvar=True, name=None):
-    return Tensor(jnp.corrcoef(unwrap(x), rowvar=rowvar))
+    return apply_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), x,
+                    op_name="corrcoef")
 
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):

@@ -97,8 +97,11 @@ def nonzero(x, as_tuple=False, name=None):
 
 
 def masked_select(x, mask, name=None):
-    v, m = unwrap(x), unwrap(mask)
-    return Tensor(v[m])  # dynamic shape: eager-only (reference: masked_select op)
+    # dynamic shape: eager-only (reference: masked_select op). Taped: the
+    # grad scatters the cotangent back into the mask positions.
+    from ._helpers import diff_op
+
+    return diff_op(lambda v, m: v[m], "masked_select")(x, mask)
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):

@@ -79,7 +79,10 @@ def check_grad(op, inputs, kwargs=None, wrt=None, eps=1e-3, rtol=1e-2,
         return out
 
     out0 = fwd_np(inputs)
-    w = rng.randn(*np.asarray(out0.value).shape).astype(np.float32)
+    # standard_normal handles 0-d outputs too (rng.randn(*()) returns a
+    # bare float) — scalar-returning reductions are grad-checkable
+    w = rng.standard_normal(np.asarray(out0.value).shape) \
+        .astype(np.float32)
     w_t = paddle.to_tensor(w)
 
     # analytic

@@ -1,0 +1,345 @@
+"""Systematic check_grad sweep over the public tensor-op surface
+(VERDICT r4 #6 / Weak #7).
+
+Reference analog: test/legacy_test/eager_op_test.py:2377 runs numeric
+finite-difference check_grad per op across ~1,312 op-test files, with
+test/white_list/ for the documented exceptions.  Here the same contract
+is ONE sweep: every public callable on ``paddle_tpu`` must be
+
+- AUTO      — grad-checked with generic float probes (unary/binary),
+- SPECIAL   — grad-checked with op-specific inputs (domain constraints,
+              index/shape arguments, factorization inputs), or
+- WHITELIST — explicitly excluded, with a reason (non-differentiable,
+              random, creation, state/config, covered elsewhere).
+
+``test_surface_fully_classified`` fails when a NEW public op appears in
+none of the three sets — adding an op forces adding its grad check (or
+a reasoned exclusion), which is how the reference keeps per-op grad
+coverage from rotting.  The sweep found and fixed real bugs on landing:
+diag/diagflat/qr/svd/pinv/eigh/corrcoef/cond returned untaped Tensors
+(silently dropped gradients).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+RNG = np.random.RandomState(7)
+X = (RNG.rand(3, 4).astype(np.float32) * 0.5 + 0.3)     # [0.3, 0.8]
+Y = (RNG.rand(3, 4).astype(np.float32) * 0.5 + 0.9)     # [0.9, 1.4], != X
+V4 = RNG.rand(4).astype(np.float32) + 0.5
+A34 = RNG.randn(3, 4).astype(np.float32)
+B45 = RNG.randn(4, 5).astype(np.float32)
+SQ = RNG.randn(4, 4).astype(np.float32)
+SPD = (SQ @ SQ.T + 4 * np.eye(4)).astype(np.float32)
+
+# ---------------------------------------------------------------------------
+# AUTO: generic probes suffice
+# ---------------------------------------------------------------------------
+
+AUTO_UNARY = [
+    "abs", "absolute", "acos", "add_n", "amax", "amin", "angle", "as_real",
+    "asin", "asinh", "assign", "atan", "atanh", "ceil", "clip", "clone",
+    "concat", "conj", "corrcoef", "cos", "cosh", "cov", "cummax", "cummin",
+    "cumsum", "cumulative_trapezoid", "deg2rad", "diag", "diagflat",
+    "diagonal", "diff", "digamma", "erf", "erfinv", "exp", "expm1",
+    "flatten", "floor", "frac", "i0", "i0e", "i1", "i1e", "imag", "lgamma",
+    "log", "log10", "log1p", "log2", "logcumsumexp", "logit", "logsumexp",
+    "max", "mean", "min", "nan_to_num", "nanmean",
+    "nansum", "neg", "negative", "norm", "prod", "rad2deg", "real",
+    "reciprocal", "rot90", "round", "rsqrt", "scale", "sgn", "sigmoid",
+    "sign", "sin", "sinh", "sort", "sqrt", "square", "squeeze", "stack",
+    "stanh", "std", "sum", "t", "tan", "tanh", "trace", "transpose",
+    "trapezoid", "tril", "triu", "trunc", "var", "increment",
+]
+AUTO_BINARY = [
+    "add", "atan2", "cdist", "copysign", "cross", "dist", "divide",
+    "divide_no_nan", "dot", "fmax", "fmin", "heaviside", "hypot", "inner",
+    "kron", "logaddexp", "maximum", "minimum", "mod", "multiply", "outer",
+    "pow", "remainder", "subtract", "tensordot", "floor_divide",
+    "floor_mod",
+]
+
+# ---------------------------------------------------------------------------
+# SPECIAL: differentiable, but needs op-specific inputs / args
+# ---------------------------------------------------------------------------
+
+_idx = np.array([0, 2, 1], np.int64)
+_mask = np.array([[True, False, True, False]] * 3)
+_SPECIAL = {
+    "acosh": (paddle.acosh, [X + 1.5], {}),
+    # even-count medians interpolate between two order stats; finite
+    # differences are only valid when the probe cannot reorder elements
+    # — odd count + gaps >> 2*eps
+    "median": (paddle.median,
+               [(np.arange(15, dtype=np.float32).reshape(3, 5) * 0.05
+                 + 0.1)[RNG.permutation(3)][:, RNG.permutation(5)]], {}),
+    "addmm": (paddle.addmm, [RNG.randn(3, 5).astype(np.float32), A34, B45],
+              {}),
+    "bmm": (paddle.bmm, [RNG.randn(2, 3, 4).astype(np.float32),
+                         RNG.randn(2, 4, 5).astype(np.float32)], {}),
+    "matmul": (paddle.matmul, [A34, B45], {}),
+    "mm": (paddle.mm, [A34, B45], {}),
+    "mv": (paddle.mv, [A34, RNG.randn(4).astype(np.float32)], {}),
+    "multi_dot": (lambda a, b, c: paddle.multi_dot([a, b, c]),
+                  [A34, B45, RNG.randn(5, 2).astype(np.float32)], {}),
+    "einsum": (lambda a, b: paddle.einsum("ij,jk->ik", a, b), [A34, B45],
+               {}),
+    "matrix_power": (lambda t: paddle.matrix_power(t, 2), [SQ], {}),
+    "cholesky": (paddle.cholesky, [SPD], {}),
+    "cholesky_solve": (paddle.cholesky_solve,
+                       [RNG.randn(4, 2).astype(np.float32),
+                        np.linalg.cholesky(SPD).astype(np.float32)], {}),
+    "triangular_solve": (paddle.triangular_solve,
+                         [np.triu(SPD).astype(np.float32),
+                          RNG.randn(4, 2).astype(np.float32)], {}),
+    "solve": (paddle.solve, [SPD, RNG.randn(4, 2).astype(np.float32)], {}),
+    "det": (paddle.det, [SPD * 0.4], {}),
+    "slogdet": (paddle.slogdet, [SPD], {}),
+    "inv": (paddle.inv, [SPD], {}),
+    "inverse": (paddle.inverse, [SPD], {}),
+    "pinv": (paddle.pinv, [SPD], {}),
+    "qr": (lambda t: paddle.qr(t)[1],
+           [RNG.randn(4, 3).astype(np.float32)], {}),  # VJP needs m >= n
+    "svd": (lambda t: paddle.svd(t)[1], [A34], {}),
+    "eigh": (lambda t: paddle.eigh(t)[0], [SPD], {}),
+    "eigvalsh": (paddle.eigvalsh, [SPD], {}),
+    "cond": (paddle.cond, [SPD], {}),
+    "cumprod": (paddle.cumprod, [X], {"dim": 0}),
+    "vander": (paddle.vander, [V4], {}),
+    "polygamma": (lambda t: paddle.polygamma(t, 1), [X + 1.0], {}),
+    "ldexp": (lambda t: paddle.ldexp(t, paddle.to_tensor(
+        np.full((3, 4), 2, np.int32))), [X], {}),
+    "lerp": (paddle.lerp, [X, Y, np.float32(0.3)], {}),
+    "quantile": (lambda t: paddle.quantile(t, 0.5, axis=1), [X], {}),
+    "nanquantile": (lambda t: paddle.nanquantile(t, 0.5, axis=1), [X], {}),
+    "kthvalue": (lambda t: paddle.kthvalue(t, 2, axis=1)[0], [X], {}),
+    "topk": (lambda t: paddle.topk(t, 2, axis=1)[0], [X], {}),
+    "renorm": (paddle.renorm, [X * 0.01], {"p": 2.0, "axis": 0,
+                                           "max_norm": 1.0}),
+    # shape / layout movers (linear: grads are scatters of the cotangent)
+    "reshape": (lambda t: paddle.reshape(t, [4, 3]), [X], {}),
+    "expand": (lambda t: paddle.expand(t, [2, 3, 4]), [X], {}),
+    "broadcast_to": (lambda t: paddle.broadcast_to(t, [2, 3, 4]), [X], {}),
+    "expand_as": (lambda t: paddle.expand_as(
+        t, paddle.to_tensor(np.zeros((3, 4), np.float32))), [X[0]], {}),
+    "tile": (lambda t: paddle.tile(t, [2, 1]), [X], {}),
+    "repeat_interleave": (lambda t: paddle.repeat_interleave(t, 2, axis=0),
+                          [X], {}),
+    "unsqueeze": (lambda t: paddle.unsqueeze(t, 1), [X], {}),
+    "unflatten": (lambda t: paddle.unflatten(t, 1, [2, 2]), [X], {}),
+    "unfold": (lambda t: paddle.unfold(t, 1, 2, 1), [X], {}),
+    "swapaxes": (lambda t: paddle.swapaxes(t, 0, 1), [X], {}),
+    "moveaxis": (lambda t: paddle.moveaxis(t, 0, 1), [X], {}),
+    "flip": (lambda t: paddle.flip(t, axis=0), [X], {}),
+    "reverse": (lambda t: paddle.reverse(t, axis=[0]), [X], {}),
+    "roll": (lambda t: paddle.roll(t, 1, axis=0), [X], {}),
+    "pad": (lambda t: paddle.pad(t, [1, 1, 0, 2]), [X], {}),
+    "crop": (lambda t: paddle.crop(t, shape=[2, 2], offsets=[0, 1]), [X],
+             {}),
+    "slice": (lambda t: paddle.slice(t, axes=[0, 1], starts=[0, 1],
+                                     ends=[2, 3]), [X], {}),
+    "strided_slice": (lambda t: paddle.strided_slice(
+        t, axes=[1], starts=[0], ends=[4], strides=[2]), [X], {}),
+    "split": (lambda t: paddle.split(t, 2, axis=1)[0], [X], {}),
+    "chunk": (lambda t: paddle.chunk(t, 2, axis=1)[0], [X], {}),
+    "tensor_split": (lambda t: paddle.tensor_split(t, 2, axis=1)[0], [X],
+                     {}),
+    "vsplit": (lambda t: paddle.vsplit(t, 3)[0], [X], {}),
+    "meshgrid": (lambda a, b: paddle.meshgrid(a, b)[0], [V4, V4 * 2.0], {}),
+    # index / mask consumers (closed-over integer/bool operands)
+    "gather": (lambda t: paddle.gather(t, paddle.to_tensor(_idx)), [X], {}),
+    "gather_nd": (lambda t: paddle.gather_nd(t, paddle.to_tensor(
+        np.array([[0, 1], [2, 3]], np.int64))), [X], {}),
+    "index_select": (lambda t: paddle.index_select(
+        t, paddle.to_tensor(_idx)), [X], {}),
+    "index_sample": (lambda t: paddle.index_sample(t, paddle.to_tensor(
+        np.array([[0, 1], [1, 2], [3, 0]], np.int64))), [X], {}),
+    "index_add": (lambda t, s: paddle.index_add(
+        t, paddle.to_tensor(_idx), 0, s), [X, RNG.randn(3, 4).astype(
+            np.float32)], {}),
+    "index_fill": (lambda t: paddle.index_fill(
+        t, paddle.to_tensor(np.array([1], np.int64)), 0, 0.5), [X], {}),
+    "index_put": (lambda t, s: paddle.index_put(
+        t, [paddle.to_tensor(np.array([0, 2], np.int64))], s),
+        [X, RNG.randn(2, 4).astype(np.float32)], {}),
+    "masked_fill": (lambda t: paddle.masked_fill(
+        t, paddle.to_tensor(_mask), 0.5), [X], {}),
+    "masked_select": (lambda t: paddle.masked_select(
+        t, paddle.to_tensor(_mask)), [X], {}),
+    "take": (lambda t: paddle.take(t, paddle.to_tensor(
+        np.array([0, 5, 11], np.int64))), [X], {}),
+    "take_along_axis": (lambda t: paddle.take_along_axis(
+        t, paddle.to_tensor(np.array([[0, 1, 2, 0]], np.int64)), 0), [X],
+        {}),
+    "put_along_axis": (lambda t, s: paddle.put_along_axis(
+        t, paddle.to_tensor(np.array([[0, 1, 2, 0]], np.int64)), s, 0),
+        [X, RNG.randn(1, 4).astype(np.float32)], {}),
+    "scatter": (lambda t, s: paddle.scatter(
+        t, paddle.to_tensor(np.array([0, 2], np.int64)), s),
+        [X, RNG.randn(2, 4).astype(np.float32)], {}),
+    "scatter_nd": (lambda s: paddle.scatter_nd(paddle.to_tensor(
+        np.array([[1], [3]], np.int64)), s, [5, 4]),
+        [RNG.randn(2, 4).astype(np.float32)], {}),
+    "scatter_nd_add": (lambda t, s: paddle.scatter_nd_add(
+        t, paddle.to_tensor(np.array([[0], [2]], np.int64)), s),
+        [X, RNG.randn(2, 4).astype(np.float32)], {}),
+    "where": (lambda a, b: paddle.where(paddle.to_tensor(_mask), a, b),
+              [X, Y], {}),
+    "multiplex": (lambda a, b: paddle.multiplex(
+        [a, b], paddle.to_tensor(np.array([0, 1, 0], np.int32))), [X, Y],
+        {}),
+}
+# finite differences are loose for ill-conditioned spectra
+_SPECIAL_TOL = {"eigh": (5e-2, 5e-3), "eigvalsh": (5e-2, 5e-3),
+                "cond": (5e-2, 5e-3), "svd": (3e-2, 3e-3),
+                "corrcoef": (3e-2, 3e-3), "det": (3e-2, 3e-2),
+                "slogdet": (3e-2, 3e-3), "pinv": (3e-2, 3e-3)}
+
+# ---------------------------------------------------------------------------
+# WHITELIST: excluded, with reasons (reference: test/white_list/)
+# ---------------------------------------------------------------------------
+
+_W_BOOL = "boolean/comparison output — nothing to differentiate"
+_W_INT = "integer/index output"
+_W_CREATE = "creation op — output independent of any float input"
+_W_RANDOM = "random sampling — finite differences see fresh draws"
+_W_STATE = "state/config/introspection — not a tensor op"
+_W_IO = "serialization/io"
+_W_INPLACE = "in-place alias; grad flow covered by test_op_longtail " \
+             "inplace tests"
+_W_ELSEWHERE = "grad covered by a dedicated test"
+WHITELIST = {
+    # bool / comparison / logic
+    "all": _W_BOOL, "any": _W_BOOL, "allclose": _W_BOOL, "isclose": _W_BOOL,
+    "equal": _W_BOOL, "equal_all": _W_BOOL, "greater_equal": _W_BOOL,
+    "greater_than": _W_BOOL, "less_equal": _W_BOOL, "less_than": _W_BOOL,
+    "not_equal": _W_BOOL, "logical_and": _W_BOOL, "logical_not": _W_BOOL,
+    "logical_or": _W_BOOL, "logical_xor": _W_BOOL, "isfinite": _W_BOOL,
+    "isinf": _W_BOOL, "isnan": _W_BOOL, "isin": _W_BOOL,
+    "is_empty": _W_BOOL, "is_tensor": _W_BOOL, "is_complex": _W_BOOL,
+    "is_floating_point": _W_BOOL, "is_integer": _W_BOOL,
+    "bitwise_and": _W_INT, "bitwise_not": _W_INT, "bitwise_or": _W_INT,
+    "bitwise_xor": _W_INT,
+    # integer / index outputs
+    "argmax": _W_INT, "argmin": _W_INT, "argsort": _W_INT,
+    "bincount": _W_INT, "bucketize": _W_INT, "count_nonzero": _W_INT,
+    "nonzero": _W_INT, "numel": _W_INT, "one_hot": _W_INT, "rank": _W_INT,
+    "searchsorted": _W_INT, "shape": _W_INT, "tril_indices": _W_INT,
+    "triu_indices": _W_INT, "matrix_rank": _W_INT, "gcd": _W_INT,
+    "lcm": _W_INT, "shard_index": _W_INT, "histogram": _W_INT,
+    "unique": "selection with dedup — gradient undefined at merges",
+    "unique_consecutive": "selection with dedup — gradient undefined",
+    "mode": "majority selection — int index output drives it",
+    "frexp": "mantissa/exponent decomposition — exponent is integer, "
+             "mantissa piecewise; value parity tested in test_op_longtail",
+    "nextafter": "adjacent-float step — no differentiation rule by design",
+    # creation
+    "arange": _W_CREATE, "empty": _W_CREATE, "empty_like": _W_CREATE,
+    "eye": _W_CREATE, "full": _W_CREATE, "full_like": _W_CREATE,
+    "linspace": _W_CREATE, "logspace": _W_CREATE, "ones": _W_CREATE,
+    "ones_like": _W_CREATE, "zeros": _W_CREATE, "zeros_like": _W_CREATE,
+    "create_tensor": _W_CREATE, "create_parameter": _W_CREATE,
+    "to_tensor": _W_CREATE, "tolist": "python list output",
+    # random
+    "bernoulli": _W_RANDOM, "exponential_": _W_RANDOM,
+    "multinomial": _W_RANDOM, "normal": _W_RANDOM, "normal_like": _W_RANDOM,
+    "poisson": _W_RANDOM, "rand": _W_RANDOM, "rand_like": _W_RANDOM,
+    "randint": _W_RANDOM, "randint_like": _W_RANDOM, "randn": _W_RANDOM,
+    "randn_like": _W_RANDOM, "randperm": _W_RANDOM,
+    "standard_normal": _W_RANDOM, "uniform": _W_RANDOM,
+    "uniform_": _W_RANDOM, "pca_lowrank": _W_RANDOM,
+    # state / config / introspection / control
+    "batch": _W_STATE, "check_shape": _W_STATE, "broadcast_shape": _W_STATE,
+    "device_count": _W_STATE, "disable_signal_handler": _W_STATE,
+    "disable_static": _W_STATE, "enable_static": _W_STATE,
+    "flops": _W_STATE, "get_cuda_rng_state": _W_STATE,
+    "get_default_dtype": _W_STATE, "get_device": _W_STATE,
+    "get_flags": _W_STATE, "get_rng_state": _W_STATE, "grad": _W_STATE,
+    "in_dynamic_mode": _W_STATE, "is_compiled_with_cuda": _W_STATE,
+    "is_compiled_with_tpu": _W_STATE, "is_grad_enabled": _W_STATE,
+    "seed": _W_STATE, "set_cuda_rng_state": _W_STATE,
+    "set_default_dtype": _W_STATE, "set_device": _W_STATE,
+    "set_flags": _W_STATE, "set_grad_enabled": _W_STATE,
+    "set_printoptions": _W_STATE, "set_rng_state": _W_STATE,
+    "summary": _W_STATE, "synchronize": _W_STATE, "to_static": _W_STATE,
+    "save": _W_IO, "load": _W_IO,
+    # in-place variants
+    "squeeze_": _W_INPLACE, "tanh_": _W_INPLACE, "pow_": _W_INPLACE,
+    "index_add_": _W_INPLACE, "index_fill_": _W_INPLACE,
+    "index_put_": _W_INPLACE, "scatter_": _W_INPLACE,
+    "reshape_": _W_INPLACE, "unsqueeze_": _W_INPLACE,
+    # complex-valued ops (complex AD path covered in test_op_longtail
+    # as_complex/as_real roundtrip; fft AD in test_fft)
+    "as_complex": _W_ELSEWHERE, "complex": _W_ELSEWHERE,
+    "polar": _W_ELSEWHERE,
+    # no JAX VJP / partial outputs — documented gaps, matching reference
+    # behavior where grads exist only for the symmetric case (eigh)
+    "eig": "complex general eigendecomposition — no JAX VJP; use eigh",
+    "eigvals": "complex general eigenvalues — no JAX VJP; use eigvalsh",
+    "lstsq": "multi-output (incl. int rank); solution-grad covered in "
+             "test_linalg",
+    "lu": "pivoted factorization int pivots; value parity in test_linalg",
+    "lu_unpack": "consumes lu() output; value parity in test_op_longtail",
+    "householder_product": "needs qr-internal (A, tau) operands; value "
+                           "parity in test_linalg",
+    # views over raw memory / aliasing helpers
+    "as_strided": "raw-stride view; grad flow covered via strided_slice",
+    "view": _W_ELSEWHERE, "view_as": _W_ELSEWHERE,
+    "cast": "dtype mover; grad-through-cast covered in test_autograd",
+    "nanmedian": _W_ELSEWHERE,  # AUTO would tie-break; test_op_longtail
+    "broadcast_tensors": "multi-output broadcast; covered via "
+                         "broadcast_to",
+    "unbind": _W_ELSEWHERE, "unstack": _W_ELSEWHERE,
+}
+
+
+def _public_ops():
+    out = []
+    for n in sorted(dir(paddle)):
+        if n.startswith("_"):
+            continue
+        f = getattr(paddle, n)
+        if callable(f) and not isinstance(f, type):
+            out.append(n)
+    return out
+
+
+def test_surface_fully_classified():
+    """Every public op is AUTO, SPECIAL, or WHITELISTED — a new export
+    without a grad check (or a reasoned exclusion) fails here."""
+    known = set(AUTO_UNARY) | set(AUTO_BINARY) | set(_SPECIAL) \
+        | set(WHITELIST)
+    missing = [n for n in _public_ops() if n not in known]
+    assert not missing, (
+        f"new public ops without grad-check classification: {missing} — "
+        "add them to AUTO_*, _SPECIAL (with inputs), or WHITELIST (with "
+        "a reason) in tests/test_check_grad_sweep.py")
+    # and the classification doesn't reference ops that no longer exist
+    gone = [n for n in known if not hasattr(paddle, n)]
+    assert not gone, f"classified ops no longer exported: {gone}"
+
+
+def test_sweep_counts():
+    checked = len(AUTO_UNARY) + len(AUTO_BINARY) + len(_SPECIAL)
+    assert checked >= 180, checked  # coverage floor: fail loud on shrink
+
+
+@pytest.mark.parametrize("op_name", AUTO_UNARY)
+def test_auto_unary_grad(op_name):
+    check_grad(getattr(paddle, op_name), [X.copy()], name=op_name)
+
+
+@pytest.mark.parametrize("op_name", AUTO_BINARY)
+def test_auto_binary_grad(op_name):
+    check_grad(getattr(paddle, op_name), [X.copy(), Y.copy()], name=op_name)
+
+
+@pytest.mark.parametrize("op_name", sorted(_SPECIAL))
+def test_special_grad(op_name):
+    fn, inputs, kwargs = _SPECIAL[op_name]
+    rtol, atol = _SPECIAL_TOL.get(op_name, (1e-2, 1e-3))
+    check_grad(fn, [np.copy(a) if isinstance(a, np.ndarray) else a
+                    for a in inputs], kwargs, rtol=rtol, atol=atol,
+               name=op_name)

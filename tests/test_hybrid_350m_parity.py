@@ -1,0 +1,90 @@
+"""Hybrid-parallel parity at 350M per-layer dimensions (VERDICT r4 #5).
+
+The tiny-dims hybrid tests (test_pp_sharded.py) prove the composition
+compiles and descends; THIS file is the largest correctness proof the
+CPU environment can host: a 4-layer slice of the EXACT 350M llama layer
+geometry (hidden 1024, 16 heads -> head_dim 64, intermediate 2816,
+vocab 32000 — models/llama.py preset table) trained for 3 steps under
+the residual-stashing 1F1B hybrid schedule (dp2 x pp2 x mp2 on the
+8-device virtual mesh, models/llama_pp.py build_llama_hybrid_step) must
+reproduce the SERIAL single-device AdamW trajectory step for step.
+
+Loss parity at step 0 checks forward sharding; trajectory parity at
+steps 1..2 checks the gradients and optimizer update too (AdamW's
+m-hat/v-hat ratio amplifies any grad mismatch immediately).
+
+head_dim 64 also routes these shapes through the sub-lane flash plan on
+device — on CPU the interpret path runs, but the hand-split decoder
+backward (models/llama_residual.py) is the same code the TPU executes.
+
+Reference analog: test/collective/fleet/hybrid_parallel_pp_transformer.py
+(loss parity of the pipeline composition vs the single-process model).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.distributed.topology import build_mesh, set_mesh
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.models.llama_functional import build_train_step, stack_params
+from paddle_tpu.models.llama_pp import build_llama_hybrid_step
+
+pytestmark = pytest.mark.slow
+
+
+def _cfg_350m_slice(layers=4):
+    return LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=layers, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=256)
+
+
+def _params(cfg, seed=0):
+    from paddle_tpu.models import LlamaForCausalLM
+
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    return stack_params({k: p.value for k, p in model.named_parameters()},
+                        cfg)
+
+
+def test_resid_1f1b_hybrid_matches_serial_trajectory():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = _cfg_350m_slice()
+    stacked, rest = _params(cfg)
+    rng = np.random.RandomState(1)
+    B, S, steps = 8, 128, 3
+    ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    y = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+
+    # serial reference trajectory (copies first: hybrid prepare()/step()
+    # donate + may alias buffers)
+    s_np = jax.tree_util.tree_map(np.asarray, stacked)
+    r_np = jax.tree_util.tree_map(np.asarray, rest)
+    step_s, init_s = build_train_step(cfg, lr=1e-3, remat=False)
+    st = init_s(stacked, rest)
+    serial = []
+    for _ in range(steps):
+        stacked, rest, st, loss = step_s(stacked, rest, st, ids, y)
+        serial.append(float(loss))
+
+    # residual-stashing 1F1B over dp2 x pp2 x mp2
+    mesh = build_mesh(dp=2, pp=2, mp=2, sharding=1,
+                      devices=jax.devices()[:8])
+    set_mesh(mesh)
+    step_h, prepare = build_llama_hybrid_step(
+        cfg, mesh, accumulate_steps=4, lr=1e-3, remat=False,
+        stash="residuals")
+    blocks, edge, sth = prepare(jax.tree_util.tree_map(np.copy, s_np),
+                                jax.tree_util.tree_map(np.copy, r_np))
+    hybrid = []
+    for _ in range(steps):
+        blocks, edge, sth, loss = step_h(blocks, edge, sth, ids, y)
+        hybrid.append(float(loss))
+
+    assert all(np.isfinite(hybrid)), hybrid
+    # step-0 parity = forward sharding; steps 1..2 = grad + AdamW parity
+    np.testing.assert_allclose(hybrid, serial, rtol=2e-3, atol=2e-4)
+    assert hybrid[-1] < hybrid[0]  # and it actually trains
