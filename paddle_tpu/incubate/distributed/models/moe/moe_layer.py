@@ -31,7 +31,8 @@ from .....distributed._spmd import P, constraint
 from .....nn.layer.container import LayerList
 from .....nn.layer.layers import Layer
 
-__all__ = ["MoELayer", "moe_dispatch", "moe_combine"]
+__all__ = ["MoELayer", "moe_dispatch", "moe_combine",
+           "moe_dispatch_sorted", "moe_combine_sorted"]
 
 
 def _build_dispatch(idx, val, num_expert: int, capacity: int):
@@ -45,11 +46,8 @@ def _build_dispatch(idx, val, num_expert: int, capacity: int):
     counts = jnp.zeros((num_expert,), jnp.int32)
     disp = jnp.zeros((T, num_expert, capacity), jnp.bool_)
     comb = jnp.zeros((T, num_expert, capacity), jnp.float32)
-    # val must be probability-like (gates emit softmaxed weights); zero out
-    # dropped choices (idx < 0) and renormalise over the kept ones
-    val = jnp.where(idx >= 0, val.astype(jnp.float32), 0.0)
-    denom = jnp.sum(val, axis=-1, keepdims=True)
-    val = val / jnp.maximum(denom, 1e-9)
+    # val must be probability-like (gates emit softmaxed weights)
+    val = _normalized_weights(idx, val)
     for j in range(k):  # k is tiny and static
         e = idx[:, j]
         onehot = jax.nn.one_hot(e, num_expert, dtype=jnp.int32)  # [T, E]
@@ -78,6 +76,77 @@ def moe_combine(expert_out, comb, dtype):
                       expert_out).astype(dtype)
 
 
+def _normalized_weights(idx, val):
+    """Shared by both dispatch paths: zero dropped choices (idx < 0) and
+    renormalise over the kept ones (capacity drops do NOT renormalise —
+    GShard loses that probability mass, and so do we, identically)."""
+    val = jnp.where(idx >= 0, val.astype(jnp.float32), 0.0)
+    denom = jnp.sum(val, axis=-1, keepdims=True)
+    return val / jnp.maximum(denom, 1e-9)
+
+
+def _sort_dispatch_plan(idx, val, num_expert: int, capacity: int):
+    """Capacity assignment via segment sort — O(T·k) index arrays instead
+    of the dense path's [T, E, C] one-hots (VERDICT r4 #7; reference CUDA
+    analog: fluid/operators/collective/global_scatter_op.cu.cc routes
+    with index buffers, phi/kernels/fusion/cutlass/moe_kernel.cu sorts).
+
+    Token ranking is IDENTICAL to ``_build_dispatch``: that path fills
+    each expert with all j=0 choices (in token order) before j=1, so the
+    flat (choice-major, then token) order sorted STABLY by expert id
+    reproduces the exact same keep/drop set.
+
+    Returns (t, w, slot, kept) over the T·k flat (token, choice) pairs:
+    ``slot`` is the destination row in the [E*C, d] expert buffer (an
+    out-of-range sentinel for drops — scatter/gather drop/fill modes
+    handle it), ``w`` the combine weight.
+    """
+    T, k = idx.shape
+    val = _normalized_weights(idx, val)
+    e = idx.T.reshape(-1)                         # choice-major flatten
+    t = jnp.tile(jnp.arange(T, dtype=jnp.int32), k)
+    w = val.T.reshape(-1)
+    ekey = jnp.where(e >= 0, e, num_expert).astype(jnp.int32)
+    order = jnp.argsort(ekey, stable=True)
+    es, ts, ws = ekey[order], t[order], w[order]
+    counts = jnp.bincount(ekey, length=num_expert + 1)
+    starts = jnp.cumsum(counts) - counts          # exclusive prefix
+    pos = jnp.arange(T * k, dtype=jnp.int32) - starts[es].astype(jnp.int32)
+    kept = (es < num_expert) & (pos < capacity)
+    slot = jnp.where(kept, es * capacity + pos, num_expert * capacity)
+    return ts, ws, slot, kept
+
+
+def moe_dispatch_sorted(x, idx, val, num_expert: int, capacity: int):
+    """Sort-based dispatch: same contract as ``moe_dispatch`` but the
+    return-trip state is the O(T·k) plan, not a [T, E, C] tensor."""
+    ts, ws, slot, kept = _sort_dispatch_plan(idx, val, num_expert, capacity)
+    d = x.shape[-1]
+    vals = x[ts] * kept[:, None].astype(x.dtype)
+    flat = jnp.zeros((num_expert * capacity, d), x.dtype)
+    flat = flat.at[slot].set(vals, mode="drop")   # sentinel rows dropped
+    return flat.reshape(num_expert, capacity, d), (ts, ws, slot, kept)
+
+
+def _pick_dispatch_mode(num_tokens: int, num_expert: int,
+                        capacity: int) -> str:
+    """auto-mode policy: the dense path materialises TWO [T, E, C]
+    fp32/bool tensors; past ~64 MB switch to the sort plan (O(T·k)
+    index arrays)."""
+    return ("sort" if num_tokens * num_expert * capacity > (1 << 24)
+            else "dense")
+
+
+def moe_combine_sorted(expert_out, ts, ws, slot, kept, num_tokens: int,
+                       dtype):
+    e, c, d = expert_out.shape
+    eo = expert_out.reshape(e * c, d)
+    contrib = jnp.take(eo, slot, axis=0, mode="fill", fill_value=0)
+    wk = (ws * kept).astype(eo.dtype)
+    y = jnp.zeros((num_tokens, d), eo.dtype)
+    return y.at[ts].add(contrib * wk[:, None]).astype(dtype)
+
+
 class MoELayer(Layer):
     """reference moe_layer.py:263 parity.
 
@@ -89,8 +158,12 @@ class MoELayer(Layer):
     def __init__(self, d_model: int, experts: Optional[List[Layer]] = None,
                  gate=None, moe_group=None, mp_group=None,
                  recompute_interval: int = 0, capacity_factor: float = 1.2,
-                 **kwargs):
+                 dispatch_mode: str = "auto", **kwargs):
         super().__init__()
+        if dispatch_mode not in ("auto", "dense", "sort"):
+            raise ValueError(
+                f"dispatch_mode={dispatch_mode!r}: expected auto|dense|sort")
+        self.dispatch_mode = dispatch_mode
         self.d_model = d_model
         if experts is None:
             raise ValueError("experts list is required")
@@ -141,12 +214,23 @@ class MoELayer(Layer):
 
         val, idx = self.gate(x)
 
-        # dispatch: [T,d] -> [E,C,d]; combine weights [T,E,C]
-        def dispatch_fn(xv, vv, iv):
-            return moe_dispatch(xv, iv, vv, E, capacity)
+        mode = self.dispatch_mode
+        if mode == "auto":
+            mode = _pick_dispatch_mode(T, E, capacity)
 
-        expert_in, comb = apply_op(dispatch_fn, x, val, idx.detach(),
-                                   op_name="moe_dispatch")
+        if mode == "sort":
+            def dispatch_fn(xv, vv, iv):
+                return moe_dispatch_sorted(xv, iv, vv, E, capacity)
+
+            expert_in, plan = apply_op(dispatch_fn, x, val, idx.detach(),
+                                       op_name="moe_dispatch")
+        else:
+            # dispatch: [T,d] -> [E,C,d]; combine weights [T,E,C]
+            def dispatch_fn(xv, vv, iv):
+                return moe_dispatch(xv, iv, vv, E, capacity)
+
+            expert_in, comb = apply_op(dispatch_fn, x, val, idx.detach(),
+                                       op_name="moe_dispatch")
         # ep placement: expert dim sharded over the mesh's ep axis → the
         # einsum above lowers to all-to-all over ICI
         expert_in = constraint(expert_in, P("ep"))
@@ -161,8 +245,16 @@ class MoELayer(Layer):
             stacked = _p.stack(outs, axis=0)
         stacked = constraint(stacked, P("ep"))
 
-        def combine_fn(eo, cw):
-            return moe_combine(eo, cw, eo.dtype)
+        if mode == "sort":
+            def combine_fn(eo, ts, ws, slot, kept):
+                return moe_combine_sorted(eo, ts, ws, slot, kept, T,
+                                          eo.dtype)
 
-        y = apply_op(combine_fn, stacked, comb, op_name="moe_combine")
+            y = apply_op(combine_fn, stacked, *plan,
+                         op_name="moe_combine")
+        else:
+            def combine_fn(eo, cw):
+                return moe_combine(eo, cw, eo.dtype)
+
+            y = apply_op(combine_fn, stacked, comb, op_name="moe_combine")
         return y.reshape(list(orig_shape))

@@ -152,3 +152,132 @@ class TestMoELayer:
         y2 = moe(paddle.Tensor(x))
         np.testing.assert_allclose(np.asarray(y), y2.numpy(), rtol=2e-4,
                                    atol=1e-5)
+
+
+class TestSortedDispatch:
+    """Sort-based dispatch (VERDICT r4 #7): the dense GShard path builds
+    two [T, E, C] tensors; the segment-sort plan must reproduce it
+    EXACTLY (same keep/drop set — token ranking is choice-major then
+    token order in both) while compiling with temp memory bounded by
+    O(T·k) index arrays + the [E·C, d] expert buffer at 1.3B-MoE dims."""
+
+    def _route(self, T=64, E=8, k=2, seed=0, frac_dropped=0.2):
+        rng = np.random.RandomState(seed)
+        idx = rng.randint(0, E, (T, k)).astype(np.int32)
+        drop = rng.rand(T, k) < frac_dropped
+        idx = np.where(drop, -1, idx)
+        val = rng.rand(T, k).astype(np.float32)
+        return idx, val
+
+    @pytest.mark.parametrize("cap_factor", [2.0, 0.4])
+    def test_exact_parity_with_dense(self, cap_factor):
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            moe_combine, moe_combine_sorted, moe_dispatch,
+            moe_dispatch_sorted)
+
+        T, E, k, d = 64, 8, 2, 16
+        idx, val = self._route(T, E, k)
+        capacity = max(1, int(np.ceil(cap_factor * k * T / E)))
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(T, d).astype(np.float32))
+        ein_d, comb = moe_dispatch(x, jnp.asarray(idx), jnp.asarray(val),
+                                   E, capacity)
+        ein_s, (ts, ws, slot, kept) = moe_dispatch_sorted(
+            x, jnp.asarray(idx), jnp.asarray(val), E, capacity)
+        np.testing.assert_allclose(np.asarray(ein_s), np.asarray(ein_d),
+                                   rtol=1e-6, atol=1e-6)
+        eo = jnp.asarray(rng.randn(E, capacity, d).astype(np.float32))
+        y_d = moe_combine(eo, comb, jnp.float32)
+        y_s = moe_combine_sorted(eo, ts, ws, slot, kept, T, jnp.float32)
+        np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_d),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_parity_with_dense(self):
+        """d(y)/d(x) and d(y)/d(val) agree between the paths — the gate
+        must learn identically whichever dispatch runs."""
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            moe_combine, moe_combine_sorted, moe_dispatch,
+            moe_dispatch_sorted)
+
+        T, E, k, d = 32, 4, 2, 8
+        idx, val = self._route(T, E, k, seed=3)
+        capacity = max(1, int(np.ceil(1.2 * k * T / E)))
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(T, d).astype(np.float32))
+        idxj, valj = jnp.asarray(idx), jnp.asarray(val)
+
+        def f_dense(xv, vv):
+            ein, comb = moe_dispatch(xv, idxj, vv, E, capacity)
+            return jnp.sum(moe_combine(ein * 1.5, comb, jnp.float32) ** 2)
+
+        def f_sort(xv, vv):
+            ein, plan = moe_dispatch_sorted(xv, idxj, vv, E, capacity)
+            return jnp.sum(moe_combine_sorted(ein * 1.5, *plan, T,
+                                              jnp.float32) ** 2)
+
+        gd = jax.grad(f_dense, argnums=(0, 1))(x, valj)
+        gs = jax.grad(f_sort, argnums=(0, 1))(x, valj)
+        for a, b in zip(gs, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_layer_output_parity_across_modes(self):
+        T_, E_ = 16, 4
+        rng = np.random.RandomState(5)
+        xin = rng.randn(2, T_ // 2, D).astype(np.float32)
+        outs = {}
+        for mmode in ("dense", "sort"):
+            paddle.seed(11)
+            layer = MoELayer(
+                D, experts=[nn.Linear(D, D) for _ in range(E_)],
+                gate={"type": "gshard", "top_k": 2},
+                dispatch_mode=mmode)
+            outs[mmode] = np.asarray(
+                layer(paddle.to_tensor(xin)).value, np.float32)
+        np.testing.assert_allclose(outs["sort"], outs["dense"],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_no_tec_materialization_at_1b3_dims(self):
+        """Compile-only at ERNIE-MoE scale (T=8192, E=64, d=2048, top-2):
+        the dense path's [T, E, C] pair alone is ~1.2 GB; the sorted
+        dispatch+combine round trip must compile with temp memory far
+        below that (the plan is O(T·k); the expert buffer dominates)."""
+        T, E, k, d = 8192, 64, 2, 2048
+        capacity = int(np.ceil(1.2 * k * T / E))          # 308
+        tec_bytes = T * E * capacity * 4                   # one fp32 [T,E,C]
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            moe_combine_sorted, moe_dispatch_sorted)
+
+        def roundtrip(x, idx, val):
+            ein, plan = moe_dispatch_sorted(x, idx, val, E, capacity)
+            return moe_combine_sorted(ein * 2.0, *plan, T, jnp.float32)
+
+        lowered = jax.jit(roundtrip).lower(
+            jax.ShapeDtypeStruct((T, d), jnp.bfloat16),
+            jax.ShapeDtypeStruct((T, k), jnp.int32),
+            jax.ShapeDtypeStruct((T, k), jnp.float32))
+        mem = lowered.compile().memory_analysis()
+        temp = int(getattr(mem, "temp_size_in_bytes", 0))
+        assert temp > 0, "memory analysis degenerate"
+        assert temp < tec_bytes // 2, (
+            f"sorted dispatch temp {temp/2**20:.0f} MiB not clearly below "
+            f"a single [T,E,C] one-hot ({tec_bytes/2**20:.0f} MiB) — is it "
+            "materializing dense routing tensors?")
+
+    def test_auto_mode_picks_sort_at_scale(self):
+        layer = MoELayer(D, experts=[nn.Linear(D, D) for _ in range(4)],
+                         gate={"type": "naive", "top_k": 2})
+        assert layer.dispatch_mode == "auto"
+        with pytest.raises(ValueError, match="dispatch_mode"):
+            MoELayer(D, experts=[nn.Linear(D, D)], dispatch_mode="fast")
+
+    def test_auto_threshold_policy(self):
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            _pick_dispatch_mode)
+
+        assert _pick_dispatch_mode(16, 4, 8) == "dense"
+        # ERNIE-MoE scale: T=8192, E=64, C=308 -> 161M > 2^24
+        assert _pick_dispatch_mode(8192, 64, 308) == "sort"
+        # boundary: exactly at the threshold stays dense, one past flips
+        assert _pick_dispatch_mode(1 << 24, 1, 1) == "dense"
+        assert _pick_dispatch_mode((1 << 24) + 1, 1, 1) == "sort"

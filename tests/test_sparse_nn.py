@@ -261,3 +261,62 @@ class TestSubmGatherGEMM:
         # temps >= 67 MB, and the gap grows as grid^3 while this path
         # stays nnz-bound
         assert tmp < (dense_in + dense_out) // 4, (tmp, dense_in + dense_out)
+
+
+class TestDensifyGuard:
+    """The strided-conv/pool dense fallbacks must announce themselves at
+    runtime above a volume threshold (VERDICT r4 Weak #4 / #8): warn by
+    default, refuse under PADDLE_TPU_SPARSE_DENSIFY=error, stay silent
+    under =silent and below the threshold."""
+
+    def _big_coo(self, shape=(1, 40, 40, 40, 2)):
+        d = np.zeros(shape, np.float32)
+        d[0, 0, 0, 0, 0] = 1.0
+        d[0, 3, 5, 7, 1] = 2.0
+        return sparse.sparse_coo_tensor_from_dense(d) if hasattr(
+            sparse, "sparse_coo_tensor_from_dense") else \
+            sparse.SparseTensor(
+                jax.experimental.sparse.BCOO.fromdense(
+                    jnp.asarray(d), n_batch=0, n_dense=1))
+
+    def test_warns_above_threshold(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SPARSE_DENSIFY_WARN_ELEMS", "1000")
+        x = self._big_coo()
+        w = np.random.RandomState(0).randn(2, 2, 2, 2, 3).astype(np.float32)
+        with pytest.warns(RuntimeWarning, match="DENSE.*volume"):
+            snn.functional.conv3d(x, w, stride=2)
+        with pytest.warns(RuntimeWarning, match="max_pool3d"):
+            snn.functional.max_pool3d(x, 2, stride=2)
+
+    def test_error_mode_refuses(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SPARSE_DENSIFY_WARN_ELEMS", "1000")
+        monkeypatch.setenv("PADDLE_TPU_SPARSE_DENSIFY", "error")
+        x = self._big_coo()
+        w = np.random.RandomState(0).randn(2, 2, 2, 2, 3).astype(np.float32)
+        with pytest.raises(ValueError, match="DENSE.*volume"):
+            snn.functional.conv3d(x, w, stride=2)
+
+    def test_below_threshold_and_silent_are_quiet(self, monkeypatch):
+        import warnings as _w
+
+        x = self._big_coo()          # 128k elements < default 2^24
+        w = np.random.RandomState(0).randn(2, 2, 2, 2, 3).astype(np.float32)
+        with _w.catch_warnings():
+            _w.simplefilter("error", RuntimeWarning)
+            snn.functional.conv3d(x, w, stride=2)   # no warning
+        monkeypatch.setenv("PADDLE_TPU_SPARSE_DENSIFY_WARN_ELEMS", "1000")
+        monkeypatch.setenv("PADDLE_TPU_SPARSE_DENSIFY", "silent")
+        with _w.catch_warnings():
+            _w.simplefilter("error", RuntimeWarning)
+            snn.functional.conv3d(x, w, stride=2)   # acknowledged
+
+    def test_subm_gather_gemm_path_never_guarded(self):
+        """The REAL sparse path (submanifold gather-GEMM) must not warn
+        at any size — it never densifies."""
+        import warnings as _w
+
+        x = self._big_coo()
+        w = np.random.RandomState(0).randn(2, 2, 2, 2, 3).astype(np.float32)
+        with _w.catch_warnings():
+            _w.simplefilter("error", RuntimeWarning)
+            snn.functional.subm_conv3d(x, w)        # gather-GEMM route
