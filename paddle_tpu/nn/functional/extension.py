@@ -30,9 +30,12 @@ def pdist(x, p: float = 2.0, name=None):
     def f(v):
         n = v.shape[0]
         diff = v[:, None, :] - v[None, :, :]
-        d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+        s = jnp.sum(jnp.abs(diff) ** p, axis=-1)
         iu = jnp.triu_indices(n, k=1)
-        return d[iu]
+        # root AFTER slicing off the diagonal: d(s^(1/p))/ds at the
+        # diagonal's exact 0 is inf, and 0-cotangent * inf = NaN would
+        # poison the whole gradient (r5 check_grad sweep finding)
+        return s[iu] ** (1.0 / p)
 
     return apply_op(f, x, op_name="pdist")
 
