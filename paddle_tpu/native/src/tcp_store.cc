@@ -11,6 +11,10 @@
 //   request:  u8 op | u32 klen | k bytes | u64 arg/vlen | v bytes
 //     op: 0=SET 1=GET 2=ADD 3=WAIT 4=PING
 //   response: i64 status/value | u64 vlen | v bytes
+//     error statuses: -1 stopped-before-set, -3 SET value > 64 MiB
+//     (reply then close — the unread payload would desync the stream),
+//     -4 server-side exception (reply then close). A key > 4 KiB is a
+//     protocol violation: the connection closes with NO reply.
 // GET on a missing key blocks server-side until set (like reference wait).
 
 #include <arpa/inet.h>
@@ -26,6 +30,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <exception>
 #include <map>
 #include <mutex>
 #include <string>
@@ -33,6 +38,13 @@
 #include <vector>
 
 namespace {
+
+// Wire-supplied sizes are untrusted (same hardening as ps_table.cc): a
+// huge klen/vlen would bad_alloc inside a server thread, and an uncaught
+// exception in ANY std::thread std::terminate()s the whole process —
+// which is the trainer, since the store runs in-process over ctypes.
+constexpr uint32_t kMaxKeyLen = 4096;
+constexpr uint64_t kMaxValLen = 64ull << 20;  // rendezvous blobs are small
 
 struct Server {
   int listen_fd = -1;
@@ -74,15 +86,35 @@ void serve_client(Server* s, int fd) {
     uint8_t op;
     uint32_t klen;
     if (!read_n(fd, &op, 1) || !read_n(fd, &klen, 4)) break;
+    if (klen > kMaxKeyLen) break;  // protocol violation: close
     std::string key(klen, '\0');
     if (klen && !read_n(fd, key.data(), klen)) break;
     uint64_t arg;
     if (!read_n(fd, &arg, 8)) break;
-    std::vector<uint8_t> val(arg && op == 0 ? arg : 0);
+    auto reply_and_close = [fd](int64_t st) {
+      uint64_t zero = 0;
+      write_n(fd, &st, 8);
+      write_n(fd, &zero, 8);
+    };
+    if (op == 0 && arg > kMaxValLen) {
+      // reply in-protocol, then close: the unread value bytes would be
+      // parsed as the next request otherwise
+      reply_and_close(-3);
+      break;
+    }
+    std::vector<uint8_t> val;
+    try {
+      val.resize(op == 0 ? arg : 0);
+    } catch (const std::exception&) {
+      reply_and_close(-4);  // within-cap bad_alloc: never terminate
+      break;
+    }
     if (op == 0 && arg && !read_n(fd, val.data(), arg)) break;
 
+    bool close_conn = false;
     int64_t status = 0;
     std::vector<uint8_t> out;
+    try {
     if (op == 0) {  // SET
       std::lock_guard<std::mutex> lk(s->mu);
       s->kv[key] = std::move(val);
@@ -100,10 +132,16 @@ void serve_client(Server* s, int fd) {
       s->counters[key] += static_cast<int64_t>(arg);
       status = s->counters[key];
     }  // op 4 PING: status 0
+    } catch (const std::exception&) {
+      status = -4;  // bad_alloc etc.: reply + close, never terminate
+      close_conn = true;
+      out.clear();
+    }
 
     uint64_t vlen = out.size();
     if (!write_n(fd, &status, 8) || !write_n(fd, &vlen, 8)) break;
     if (vlen && !write_n(fd, out.data(), vlen)) break;
+    if (close_conn) break;
   }
   ::close(fd);
   std::lock_guard<std::mutex> lk(s->fds_mu);
@@ -218,6 +256,11 @@ void ts_client_close(void* cp) {
 static int64_t request(int fd, uint8_t op, const char* key, uint32_t klen,
                        const uint8_t* val, uint64_t vlen, uint8_t* out,
                        uint64_t out_cap, uint64_t* out_len) {
+  // precheck BEFORE any bytes go out: the server would close on these,
+  // and a partial request would desync the stream for the caller's next
+  // use of this handle
+  if (klen > kMaxKeyLen) return -3;
+  if (op == 0 && vlen > kMaxValLen) return -3;
   if (!write_n(fd, &op, 1) || !write_n(fd, &klen, 4)) return -2;
   if (klen && !write_n(fd, key, klen)) return -2;
   if (!write_n(fd, &vlen, 8)) return -2;
@@ -225,6 +268,12 @@ static int64_t request(int fd, uint8_t op, const char* key, uint32_t klen,
   int64_t status;
   uint64_t rlen;
   if (!read_n(fd, &status, 8) || !read_n(fd, &rlen, 8)) return -2;
+  if (rlen > kMaxValLen) {
+    // malformed peer: don't bad_alloc, and poison the now-desynced fd
+    // so a retry on this handle fails like any dead socket
+    ::shutdown(fd, SHUT_RDWR);
+    return -2;
+  }
   if (out_len) *out_len = rlen;
   if (rlen) {
     std::vector<uint8_t> buf(rlen);

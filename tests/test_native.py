@@ -143,3 +143,63 @@ class TestWiring:
         s.set("x", b"y")
         assert s.get("x") == b"y"
         s.close()
+
+
+class TestTCPStoreWireHardening:
+    """Wire sizes are untrusted (same class as the PS-table hardening):
+    a huge SET length must yield an error reply + close — never a
+    bad_alloc that std::terminate()s the in-process trainer."""
+
+    def _raw(self, port):
+        import socket
+
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.settimeout(10)
+        return s
+
+    def _recv_exact(self, sock, n):
+        buf = b""
+        while len(buf) < n:
+            c = sock.recv(n - len(buf))
+            if not c:
+                return buf
+            buf += c
+        return buf
+
+    def test_oversized_set_value_rejected_server_survives(self):
+        import struct
+
+        master = native.TCPStore(is_master=True)
+        try:
+            s = self._raw(master.port)
+            # SET "k" with a 2^40-byte value length
+            s.sendall(struct.pack("<BI", 0, 1) + b"k"
+                      + struct.pack("<Q", 1 << 40))
+            status, vlen = struct.unpack(
+                "<qQ", self._recv_exact(s, 16))
+            assert status == -3 and vlen == 0
+            assert s.recv(1) == b""  # desynced stream closed
+            s.close()
+            # server alive: normal client traffic still works
+            c = native.TCPStore(port=master.port)
+            c.set("x", b"1")
+            assert c.get("x") == b"1"
+            c.close()
+        finally:
+            master.close()
+
+    def test_oversized_key_closes_connection(self):
+        import struct
+
+        master = native.TCPStore(is_master=True)
+        try:
+            s = self._raw(master.port)
+            s.sendall(struct.pack("<BI", 0, 1 << 20))  # 1 MiB key length
+            assert self._recv_exact(s, 16) == b""      # closed, no reply
+            s.close()
+            c = native.TCPStore(port=master.port)
+            c.add("n", 2)
+            assert c.add("n", 3) == 5
+            c.close()
+        finally:
+            master.close()
