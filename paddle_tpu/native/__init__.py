@@ -359,7 +359,17 @@ class TCPStore:
 
     def add(self, key: str, amount: int = 1) -> int:
         with self._mu:
-            return int(self._lib.ts_add(self._c, key.encode(), amount))
+            rc = int(self._lib.ts_add(self._c, key.encode(), amount))
+        if rc < 0 and rc >= -4:
+            # ADD's result rides the status channel, so transport/server
+            # errors (-2 io, -3 over-cap key, -4 server exception) are
+            # only distinguishable because counters in this store are
+            # nonnegative (they start at 0; barrier/rank users add
+            # positive amounts). Returning them as counts would hand
+            # barrier code a bogus rank.
+            k = key if len(key) <= 64 else key[:61] + "..."
+            raise OSError(f"TCPStore add({k!r}) failed: rc={rc}")
+        return rc
 
     def wait(self, key: str) -> None:
         # NOTE: wait blocks server-side; holding the lock would starve other
