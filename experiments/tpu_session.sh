@@ -81,7 +81,8 @@ STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py 1.3b
 run env PADDLE_TPU_TESTS_ON_DEVICE=1 PADDLE_TPU_HB_ON_DEVICE=1 \
     python -m pytest \
     tests/test_flash_attention.py tests/test_flash_hb.py \
-    tests/test_pallas_kernels.py -q -p no:cacheprovider
+    tests/test_pallas_kernels.py tests/test_paged_attention.py \
+    -q -p no:cacheprovider
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py ragged
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py decode
 # 7. the remaining BASELINE.md configs — one window should produce the

@@ -21,7 +21,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "convert_to_mixed_precision",
-           "PrecisionType", "PlaceType"]
+           "PrecisionType", "PlaceType", "PagedKVCache"]
+
+from .paged_cache import PagedKVCache  # noqa: E402
 
 
 class PrecisionType:

@@ -1,0 +1,132 @@
+"""PagedKVCache — page-pool KV cache manager for continuous batching.
+
+Reference analog: fused_multi_transformer's per-batch cache slabs
+(fused_multi_transformer_op.cu.h) sized ``[max_batch, max_len, ...]``.
+Here the cache is a SHARED pool of fixed-size pages plus a per-slot page
+table (ops/paged_attention.py consumes both), so:
+
+- HBM holds the tokens in flight (rounded up to pages), not
+  ``max_batch * max_len`` — with skewed lengths the pool can be a
+  fraction of the dense slabs;
+- any free page serves any slot: no fragmentation, admission between
+  decode segments allocates pages for at most one segment of growth.
+
+Split of responsibilities (mirrors the engine's host/device split):
+page ALLOCATION is host-side Python between jitted segments (the free
+list is plain state, like the engine's slot free list); page READS and
+token WRITES are pure jittable functions of (pools, page_table) so they
+ride inside compiled segment programs.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache", "write_tokens", "gather_dense"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def write_tokens(k_pool, v_pool, page_table, slots, positions, k_new,
+                 v_new):
+    """Scatter one new token per row into the pools (pure, jittable; the
+    pools are DONATED — per-step writes must not copy the dominant HBM
+    allocation, so callers follow the
+    ``cache.k, cache.v = write_tokens(cache.k, cache.v, ...)`` pattern
+    and never reuse the old arrays).
+
+    slots: [N] int32 page-table rows; positions: [N] int32 token index
+    within each sequence; k_new/v_new: [N, H, D]. Returns updated pools.
+    Writes whose position has NO mapped page (table entry -1 — caller
+    forgot ``ensure``) are DROPPED, never wrapped onto another
+    sequence's page (JAX scatter would wrap the -1 to the last pool
+    row otherwise).
+    """
+    ps = k_pool.shape[1]
+    pages = page_table[slots, positions // ps]        # [N]
+    # unmapped -> out-of-range sentinel; mode="drop" discards those rows
+    pages = jnp.where(pages >= 0, pages, k_pool.shape[0])
+    offs = positions % ps
+    k_pool = k_pool.at[pages, offs].set(k_new.astype(k_pool.dtype),
+                                        mode="drop")
+    v_pool = v_pool.at[pages, offs].set(v_new.astype(v_pool.dtype),
+                                        mode="drop")
+    return k_pool, v_pool
+
+
+@jax.jit
+def gather_dense(pool, page_table, row):
+    """Row's cache as a dense [max_pages*page_size, H, D] (testing/debug;
+    the attention kernel never materializes this)."""
+    return pool[jnp.maximum(page_table[row], 0)].reshape(
+        -1, *pool.shape[2:])
+
+
+class PagedKVCache:
+    """One layer's paged K/V pool + allocator.
+
+    ``num_pages * page_size`` bounds the TOTAL tokens in flight across
+    all slots; ``max_pages`` bounds one sequence's length. Allocation
+    (``ensure``) and free (``free_slot``) are host-side between
+    segments; reads/writes are the pure functions above.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, num_heads: int,
+                 head_dim: int, max_batch: int, max_pages: int,
+                 dtype=jnp.bfloat16):
+        self.page_size = page_size
+        self.k = jnp.zeros((num_pages, page_size, num_heads, head_dim),
+                           dtype)
+        self.v = jnp.zeros_like(self.k)
+        # -1 = unmapped; the kernel clamps skipped entries to page 0
+        self.page_table = jnp.full((max_batch, max_pages), -1, jnp.int32)
+        self._free: List[int] = list(range(num_pages))
+        self._owned: Dict[int, List[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def can_fit(self, slot: int, n_tokens: int) -> bool:
+        have = len(self._owned.get(slot, []))
+        return self.pages_for(n_tokens) - have <= len(self._free)
+
+    def ensure(self, slot: int, n_tokens: int) -> None:
+        """Grow ``slot``'s mapping to cover ``n_tokens`` positions.
+        Raises RuntimeError when the pool is exhausted — the engine's
+        admission control treats that like 'no free slot' and drains."""
+        owned = self._owned.setdefault(slot, [])
+        target = self.pages_for(n_tokens)
+        if target > self.page_table.shape[1]:
+            # an out-of-bounds table write would be silently dropped by
+            # JAX while the page was still consumed — leak + wrong pages
+            raise ValueError(
+                f"slot {slot}: {n_tokens} tokens needs {target} pages > "
+                f"max_pages={self.page_table.shape[1]} — grow max_pages "
+                "(per-sequence length bound)")
+        need = target - len(owned)
+        if need <= 0:
+            return
+        if need > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: slot {slot} needs {need} pages, "
+                f"{len(self._free)} free — drain finished requests or "
+                "grow num_pages")
+        row = self.page_table[slot]
+        for _ in range(need):
+            pid = self._free.pop(0)
+            row = row.at[len(owned)].set(pid)
+            owned.append(pid)
+        self.page_table = self.page_table.at[slot].set(row)
+
+    def free_slot(self, slot: int) -> None:
+        """Return the slot's pages to the pool (request retired)."""
+        for pid in self._owned.pop(slot, []):
+            self._free.append(pid)
+        self._free.sort()
+        self.page_table = self.page_table.at[slot].set(-1)

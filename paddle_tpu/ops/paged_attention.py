@@ -1,0 +1,147 @@
+"""Paged decode attention: KV cache as a shared page pool.
+
+Reference analog: the fused_multi_transformer decode path
+(paddle/phi/kernels/fusion/fused_multi_transformer_op.cu.h:745 masked
+MHA over a per-batch cache slab). The reference allocates each
+sequence's cache contiguously at ``max_len``; THIS module completes the
+SURVEY §7 hard part ("KV-cache decode kernel with paged/ragged
+batching"): cache pages of ``page_size`` tokens live in one shared pool
+``[num_pages, page_size, H, D]`` and a sequence's cache is the page-id
+row of a ``page_table`` — so HBM holds the tokens actually in flight
+(rounded up to pages), not ``max_batch * max_len``, and admission never
+fails on fragmentation (any free page serves any slot).
+
+TPU-native mechanism: the page table rides Pallas SCALAR PREFETCH
+(``pltpu.PrefetchScalarGridSpec``) — block index maps read the
+prefetched table to aim each K/V page DMA, which is the idiomatic TPU
+form of paged attention (indirect addressing happens at DMA-issue time,
+not as a gather in the kernel body). The softmax math is byte-for-byte
+the ragged ``decode_mha`` recurrence (pallas_kernels.py): online
+softmax over pages, block-skip past each row's length, so a short row
+costs O(its length).
+
+``PagedKVCache`` (inference/paged_cache.py) owns the pool + free-list;
+this module is the pure compute.
+
+Relationship to ``ops/pallas.py::paged_attention``: that function wraps
+the STOCK ``jax.experimental.pallas.ops.tpu.paged_attention`` kernel
+(same pool/page-table layout) and is the TPU-only, tuned option; THIS
+kernel is the framework's own from-scratch implementation — it also
+runs in interpret mode (CPU tests) and is the one the parity suite and
+PagedKVCache exercise. Numerics agree; fixes to the page-table
+convention (-1 unmapped, clamp-on-skip) must land in both.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["paged_decode_mha"]
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, scale, page_size):
+    """One (batch row, page) step of the online-softmax recurrence.
+
+    ``pt_ref``/``len_ref`` are scalar-prefetched; the K/V blocks arriving
+    here were already DMA'd from the page the index map selected."""
+    ib, jp = pl.program_id(0), pl.program_id(1)
+    npg = pl.num_programs(1)
+
+    @pl.when(jp == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ln = len_ref[ib]
+
+    # skip pages entirely past the valid length (same contract as
+    # decode_mha: short rows cost O(their length))
+    @pl.when(jp * page_size < ln)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [H, D]
+        k = k_ref[0].astype(jnp.float32)            # [ps, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.sum(q[None] * k, axis=-1) * scale   # [ps, H]
+        pos = jp * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)
+        mask = pos < ln                             # [ps, 1]
+        s = jnp.where(mask, s, -1e30)
+        m_prev = m_ref[...]                         # [1, H]
+        m_cur = jnp.max(s, axis=0, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                      # [ps, H]
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)             # [1, H]
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=0, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = (acc_ref[...] * jnp.transpose(alpha)
+                        + jnp.sum(p[:, :, None] * v, axis=0))  # [H, D]
+
+    @pl.when(jp == npg - 1)
+    def _finalize():
+        l_safe = jnp.maximum(jnp.transpose(l_ref[...]), 1e-30)  # [H, 1]
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
+                     interpret=None):
+    """Single-step decode attention over a paged KV pool.
+
+    q: [B, H, D] (this step's query)
+    k_pool/v_pool: [num_pages, page_size, H, D] shared pools
+    page_table: [B, max_pages] int32 — page ids per sequence, in order;
+        entries past a row's length are never dereferenced (clamped to 0
+        for the skipped DMA)
+    seq_lens: [B] int32 valid lengths (the new token's k/v must already
+        be written at position seq_lens-1 via PagedKVCache.write_tokens)
+    Returns [B, H, D].
+    """
+    b, h, d = q.shape
+    page_size = k_pool.shape[1]
+    npages = page_table.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    it = _interpret() if interpret is None else interpret
+
+    def _page(bi, pi, pt, _lens):
+        # clamp: skipped steps (page beyond seq_len, table entry -1)
+        # still issue a DMA — aim it at page 0 harmlessly
+        return (jnp.maximum(pt[bi, pi], 0), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, npages),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda bi, pi, pt, ln: (bi, 0, 0)),
+            pl.BlockSpec((1, page_size, h, d), _page),
+            pl.BlockSpec((1, page_size, h, d), _page),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda bi, pi, pt, ln: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((1, h), jnp.float32),
+            pltpu.VMEM((1, h), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale,
+                          page_size=page_size),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=grid_spec,
+        interpret=it,
+    )(page_table, seq_lens, q, k_pool, v_pool)

@@ -138,7 +138,9 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: float = None,
 def paged_attention(q, k_pages, v_pages, lengths, page_indices, **kw):
     """Decode-time KV-cache attention over paged KV (reference analog:
     masked_multihead_attention_kernel in fused_multi_transformer_op.cu.h:745).
-    TPU: JAX Pallas paged_attention kernel."""
+    TPU: JAX Pallas paged_attention kernel. See also the framework's own
+    ``ops/paged_attention.py::paged_decode_mha`` (same layout, runs in
+    interpret mode too, integrates with inference.PagedKVCache)."""
     from jax.experimental.pallas.ops.tpu.paged_attention import (
         paged_attention as _pa)
 

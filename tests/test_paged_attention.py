@@ -1,0 +1,182 @@
+"""Paged KV-cache decode attention (ops/paged_attention.py +
+inference/paged_cache.py).
+
+Reference analog: fused_multi_transformer's decode MHA over contiguous
+per-batch cache slabs (fused_multi_transformer_op.cu.h:745); the paged
+form completes SURVEY §7's "KV-cache decode kernel with paged/ragged
+batching" — the oracle here is the already-parity-tested ragged
+``decode_mha`` run over each row's pages gathered dense.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.inference.paged_cache import (PagedKVCache, gather_dense,
+                                              write_tokens)
+from paddle_tpu.ops.paged_attention import paged_decode_mha
+from paddle_tpu.ops.pallas_kernels import decode_mha
+
+
+def _filled_cache(lens, H=4, D=16, PS=8, MAXP=None, num_pages=None,
+                  dtype=jnp.float32, seed=0, interleave=True):
+    """Build a pool whose page assignment is deliberately FRAGMENTED:
+    slots allocate pages token-by-token in round-robin, so consecutive
+    pages of one sequence are scattered across the pool."""
+    rng = np.random.RandomState(seed)
+    B = len(lens)
+    MAXP = MAXP or -(-int(max(lens)) // PS)
+    num_pages = num_pages or B * MAXP
+    cache = PagedKVCache(num_pages, PS, H, D, B, MAXP, dtype=dtype)
+    if interleave:
+        for t in range(int(max(lens))):
+            for b in range(B):
+                if t < lens[b]:
+                    cache.ensure(b, t + 1)
+    else:
+        for b in range(B):
+            cache.ensure(b, int(lens[b]))
+    for b in range(B):
+        n = int(lens[b])
+        kt = jnp.asarray(rng.randn(n, H, D), dtype)
+        vt = jnp.asarray(rng.randn(n, H, D), dtype)
+        cache.k, cache.v = write_tokens(
+            cache.k, cache.v, cache.page_table,
+            jnp.full((n,), b, jnp.int32), jnp.arange(n, dtype=jnp.int32),
+            kt, vt)
+    return cache
+
+
+def _ref(cache, q, lens):
+    B = q.shape[0]
+    kd = jnp.stack([gather_dense(cache.k, cache.page_table, b)
+                    for b in range(B)])
+    vd = jnp.stack([gather_dense(cache.v, cache.page_table, b)
+                    for b in range(B)])
+    return decode_mha(q, kd, vd, jnp.asarray(lens))
+
+
+class TestPagedParity:
+    @pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5),
+                                           (jnp.bfloat16, 2e-2)])
+    def test_fragmented_pages_match_ragged_kernel(self, dtype, tol):
+        lens = np.array([5, 17, 48, 1], np.int32)
+        cache = _filled_cache(lens, dtype=dtype)
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(4, 4, 16), dtype)
+        out = paged_decode_mha(q, cache.k, cache.v, cache.page_table,
+                               jnp.asarray(lens))
+        ref = _ref(cache, q, lens)
+        assert out.dtype == dtype
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=tol, atol=tol)
+
+    def test_page_order_is_what_the_table_says(self):
+        """Same pool contents, contiguous vs fragmented tables: results
+        must depend only on the table's logical order."""
+        lens = np.array([23, 9], np.int32)
+        a = _filled_cache(lens, seed=3, interleave=True)
+        b = _filled_cache(lens, seed=3, interleave=False)
+        assert not np.array_equal(np.asarray(a.page_table),
+                                  np.asarray(b.page_table))
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(2, 4, 16), jnp.float32)
+        oa = paged_decode_mha(q, a.k, a.v, a.page_table, jnp.asarray(lens))
+        ob = paged_decode_mha(q, b.k, b.v, b.page_table, jnp.asarray(lens))
+        np.testing.assert_allclose(np.asarray(oa), np.asarray(ob),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_length_1_and_full_page_edges(self):
+        lens = np.array([1, 8, 16], np.int32)  # page boundaries exactly
+        cache = _filled_cache(lens, PS=8)
+        rng = np.random.RandomState(4)
+        q = jnp.asarray(rng.randn(3, 4, 16), jnp.float32)
+        out = paged_decode_mha(q, cache.k, cache.v, cache.page_table,
+                               jnp.asarray(lens))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_ref(cache, q, lens)),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestAllocator:
+    def test_alloc_free_reuse_cycle(self):
+        c = PagedKVCache(4, 8, 2, 8, max_batch=3, max_pages=2)
+        c.ensure(0, 16)                     # 2 pages
+        c.ensure(1, 9)                      # 2 pages (ceil)
+        assert c.free_pages == 0
+        with pytest.raises(RuntimeError, match="exhausted"):
+            c.ensure(2, 1)
+        c.free_slot(0)
+        assert c.free_pages == 2
+        c.ensure(2, 8)                      # reuses a freed page
+        assert c.free_pages == 1
+        # retired slot's table row is unmapped
+        assert int(np.asarray(c.page_table)[0].max()) == -1
+
+    def test_ensure_is_idempotent_and_incremental(self):
+        c = PagedKVCache(8, 4, 2, 8, max_batch=1, max_pages=8)
+        c.ensure(0, 3)
+        assert c.free_pages == 7
+        c.ensure(0, 3)                      # no growth
+        assert c.free_pages == 7
+        c.ensure(0, 5)                      # one more page
+        assert c.free_pages == 6
+        assert c.can_fit(0, 32) and not c.can_fit(0, 33)
+
+    def test_pool_memory_beats_dense_slabs_on_skewed_lengths(self):
+        """The point of paging: B=8 slots, max_len 256, but only one
+        long request — dense slabs hold B*max_len tokens, the pool holds
+        the tokens in flight."""
+        lens = [256, 8, 8, 8, 8, 8, 8, 8]
+        PS = 16
+        pages_needed = sum(-(-n // PS) for n in lens)   # 23
+        dense_pages = 8 * (256 // PS)                   # 128
+        assert pages_needed * 4 < dense_pages
+        c = PagedKVCache(pages_needed, PS, 4, 16, max_batch=8,
+                         max_pages=256 // PS)
+        for b, n in enumerate(lens):
+            c.ensure(b, n)                  # fits exactly, no error
+        assert c.free_pages == 0
+
+
+class TestWritePath:
+    def test_batched_write_lands_in_right_pages(self):
+        lens = np.array([10, 20], np.int32)
+        cache = _filled_cache(lens, PS=8)
+        # overwrite position 9 of row 0 and 17 of row 1 in ONE call
+        k_new = jnp.ones((2, 4, 16), jnp.float32) * 7
+        v_new = jnp.ones((2, 4, 16), jnp.float32) * 9
+        cache.k, cache.v = write_tokens(
+            cache.k, cache.v, cache.page_table,
+            jnp.array([0, 1], jnp.int32), jnp.array([9, 17], jnp.int32),
+            k_new, v_new)
+        kd0 = np.asarray(gather_dense(cache.k, cache.page_table, 0))
+        kd1 = np.asarray(gather_dense(cache.k, cache.page_table, 1))
+        np.testing.assert_array_equal(kd0[9], np.full((4, 16), 7.0))
+        np.testing.assert_array_equal(kd1[17], np.full((4, 16), 7.0))
+        assert not np.any(kd0[8] == 7.0)    # neighbors untouched
+
+
+class TestWriteGuards:
+    def test_unmapped_write_is_dropped_not_wrapped(self):
+        """A write at a position with no mapped page (-1 table entry)
+        must be DROPPED — JAX scatter would wrap -1 to the LAST pool
+        page and corrupt whoever owns it."""
+        c = PagedKVCache(4, 8, 2, 8, max_batch=2, max_pages=2,
+                         dtype=jnp.float32)
+        c.ensure(1, 16)   # slot 1 owns pages; slot 0 owns NONE
+        marker = jnp.full((1, 2, 8), 123.0, jnp.float32)
+        before_last = np.asarray(c.k)[-1].copy()
+        c.k, c.v = write_tokens(c.k, c.v, c.page_table,
+                                jnp.array([0], jnp.int32),
+                                jnp.array([0], jnp.int32), marker, marker)
+        np.testing.assert_array_equal(np.asarray(c.k)[-1], before_last)
+        assert not np.any(np.asarray(c.k) == 123.0)
+
+    def test_ensure_rejects_beyond_max_pages(self):
+        c = PagedKVCache(8, 4, 2, 8, max_batch=1, max_pages=2)
+        with pytest.raises(ValueError, match="max_pages"):
+            c.ensure(0, 12)        # needs 3 pages, table holds 2
+        assert c.free_pages == 8   # nothing leaked from the free list
