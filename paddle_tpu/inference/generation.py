@@ -22,7 +22,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..nn.functional_call import substituted_state
 
-__all__ = ["GenerationConfig", "CausalLMEngine", "ContinuousBatchingEngine"]
+__all__ = ["GenerationConfig", "CausalLMEngine",
+           "ContinuousBatchingEngine",
+           "PagedContinuousBatchingEngine"]
 
 
 class GenerationConfig:
@@ -56,6 +58,19 @@ def _sample(logits, key, cfg: GenerationConfig):
                          keepdims=True)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _prompt_ids(prompt):
+    """Normalize a prompt (Tensor / ndarray / list) to int32 [1, plen].
+    serve()'s capacity probe and add_request MUST agree on this — a
+    Tensor probed with a bare np.asarray becomes a size-1 object array
+    and defeats the paged defer logic."""
+    return np.asarray(prompt.value if isinstance(prompt, Tensor)
+                      else prompt).astype(np.int32).reshape(1, -1)
+
+
+def _prompt_len(prompt) -> int:
+    return _prompt_ids(prompt).shape[1]
 
 
 class CausalLMEngine:
@@ -193,7 +208,7 @@ class ContinuousBatchingEngine:
         self.max_batch = max_batch
         self.max_len = max_len
         self.params = {k: p.value for k, p in model.named_parameters()}
-        self.caches = model.init_cache(max_batch, max_len)
+        self.caches = self._make_caches()
         self.lens = jnp.zeros((max_batch,), jnp.int32)
         self.last = jnp.zeros((max_batch,), jnp.int32)
         self.done_dev = jnp.zeros((max_batch,), bool)
@@ -212,19 +227,20 @@ class ContinuousBatchingEngine:
 
         self._prefill = jax.jit(prefill_one, donate_argnums=(2,))
 
-        def admit(caches, mini, slot, lens, last, done, active, plen, tok,
-                  tok_done):
-            caches = jax.tree.map(
+        def admit(caches, mini, slot):
+            return jax.tree.map(
                 lambda c, m: jax.lax.dynamic_update_slice_in_dim(
                     c, m.astype(c.dtype), slot, axis=0), caches, mini)
-            return (caches, lens.at[slot].set(plen),
-                    last.at[slot].set(tok), done.at[slot].set(tok_done),
-                    active.at[slot].set(True))
 
         # mini is NOT donated: its rows are dtype-cast into the pool, so
         # the buffers can't alias (donation would only warn)
         self._admit = jax.jit(admit, donate_argnums=(0,))
         self._segment_cache = {}
+
+    def _make_caches(self):
+        """Cache layout hook — the paged subclass replaces the dense
+        [max_batch, max_len] slabs with page pools."""
+        return self.model.init_cache(self.max_batch, self.max_len)
 
     def _fwd_prefill(self, params, ids, caches):
         from ..core.autograd import no_grad
@@ -245,38 +261,55 @@ class ContinuousBatchingEngine:
                 caches)
 
     # -- admission / retirement (host-side, between segments) ---------------
+    def _can_admit(self, prompt_len: int, cfg) -> bool:
+        """Whether the head-of-queue request fits RIGHT NOW (a free slot
+        is assumed). The paged subclass adds page-pool capacity; serve()
+        consults this so a transiently full pool defers admission to the
+        next inter-segment gap instead of raising mid-loop."""
+        return True
+
     def add_request(self, prompt_ids, cfg: GenerationConfig) -> int:
         """Prefill one request into a free slot; returns the request id.
         Raises if no slot is free (call decode_segment / collect first)."""
         if not self._free:
             raise RuntimeError("no free slot; drain with decode_segment()")
-        ids = np.asarray(prompt_ids.value if isinstance(prompt_ids, Tensor)
-                         else prompt_ids).astype(np.int32).reshape(1, -1)
+        ids = _prompt_ids(prompt_ids)
         plen = ids.shape[1]
         if plen + cfg.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt({plen}) + max_new_tokens({cfg.max_new_tokens}) "
                 f"exceeds engine max_len({self.max_len})")
+        if not self._can_admit(plen, cfg):
+            raise RuntimeError(
+                "page pool exhausted; drain with decode_segment()")
         slot = self._free.pop(0)
         rid = self._next_req
         self._next_req += 1
-        mini = self.model.init_cache(1, self.max_len)
-        last_logits, mini = self._prefill(self.params, ids, mini)
+        last_logits = self._admit_cache(slot, ids, plen, cfg)
         key = jax.random.PRNGKey(cfg.seed + rid)
         first = _sample(last_logits, key, cfg)[0]
         tok_done = (jnp.asarray(False) if cfg.eos_token_id is None
                     else first == cfg.eos_token_id)
-        (self.caches, self.lens, self.last, self.done_dev,
-         self.active_dev) = self._admit(
-            self.caches, mini, jnp.int32(slot), self.lens, self.last,
-            self.done_dev, self.active_dev, jnp.int32(plen), first,
-            tok_done)
+        self.lens = self.lens.at[slot].set(plen)
+        self.last = self.last.at[slot].set(first)
+        self.done_dev = self.done_dev.at[slot].set(tok_done)
+        self.active_dev = self.active_dev.at[slot].set(True)
         self._slot_req[slot] = rid
         self._tokens[rid] = [int(first)]
         self._budget[rid] = cfg.max_new_tokens - 1
         if bool(tok_done) or self._budget[rid] <= 0:
             self._retire(slot)
         return rid
+
+    def _admit_cache(self, slot: int, ids, plen: int, cfg):
+        """Cache-layout hook: prefill the prompt and install its KV into
+        slot's cache; returns the prompt's last-position logits. The
+        dense base scatters a max_len mini cache; the paged subclass
+        reserves pages and scatters a prompt-sized one."""
+        mini = self.model.init_cache(1, self.max_len)
+        last_logits, mini = self._prefill(self.params, ids, mini)
+        self.caches = self._admit(self.caches, mini, jnp.int32(slot))
+        return last_logits
 
     def _retire(self, slot):
         rid = self._slot_req.pop(slot)
@@ -363,6 +396,14 @@ class ContinuousBatchingEngine:
         foreign = {}   # requests admitted outside this serve() call
         while len(results) < len(prompts):
             while pending and self._free:
+                nxt = _prompt_len(pending[0][1])
+                if not self._can_admit(nxt, cfg):
+                    if not self._slot_req:
+                        # nothing active to drain: the request can NEVER
+                        # fit — let add_request raise its loud error
+                        idx, p = pending.pop(0)
+                        order[self.add_request(p, cfg)] = idx
+                    break  # transient: defer to the next segment gap
                 idx, p = pending.pop(0)
                 order[self.add_request(p, cfg)] = idx
             self.decode_segment(segment_steps, cfg)
@@ -374,3 +415,89 @@ class ContinuousBatchingEngine:
         # foreign requests finished during our segments stay collectable
         self._finished.update(foreign)
         return [results[i] for i in range(len(prompts))]
+
+
+class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
+    """ContinuousBatchingEngine over a PAGED KV pool (vLLM-style layout
+    the reference's contiguous CacheKV slabs cannot express): cache
+    slots are page-table rows into shared per-layer pools, so HBM holds
+    ``num_pages * page_size`` tokens total — the tokens in flight — not
+    ``max_batch * max_len``, and any free page serves any slot.
+
+    Admission RESERVES a request's worst case (prompt + max_new_tokens,
+    capped at max_len) so a running request can never exhaust the pool
+    mid-decode; ``serve`` defers admission while the pool is
+    transiently full and raises only for requests that could never fit.
+    The page table lives host-side (numpy) and is shipped to the device
+    once per segment. Requires the model to implement
+    ``init_paged_cache`` / ``forward_decode_paged`` (llama does; see
+    LlamaAttention.forward_decode_paged).
+    """
+
+    def __init__(self, model, max_batch: int, num_pages: int,
+                 page_size: int, max_pages: int):
+        from .paged_cache import PageAllocator
+
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.alloc = PageAllocator(num_pages, page_size, max_batch,
+                                   max_pages)
+        super().__init__(model, max_batch,
+                         max_len=max_pages * page_size)
+
+    def _make_caches(self):
+        return (self.model.init_paged_cache(self.num_pages,
+                                            self.page_size),
+                jnp.asarray(self.alloc.page_table))
+
+    def _fwd_ragged(self, params, tok, caches, lens, live):
+        from ..core.autograd import no_grad
+
+        pools, pt = caches
+        with substituted_state(self.model, params), no_grad():
+            logits, pools = self.model.forward_decode_paged(
+                Tensor(tok), pools, pt, lens, live)
+        return (logits.value if isinstance(logits, Tensor) else logits,
+                (pools, pt))
+
+    def _reserved(self, plen: int, cfg) -> int:
+        return min(plen + cfg.max_new_tokens, self.max_len)
+
+    def _can_admit(self, prompt_len: int, cfg) -> bool:
+        # any free slot owns zero pages, so capacity is slot-agnostic
+        probe = self._free[0] if self._free else 0
+        return self.alloc.can_fit(probe, self._reserved(prompt_len, cfg))
+
+    def _admit_cache(self, slot: int, ids, plen: int, cfg):
+        from .paged_cache import write_tokens
+
+        # prefill into a dense mini cache sized to the PROMPT (no
+        # max_len slab — the pool is the whole point), then scatter the
+        # prompt's KV rows into freshly reserved pages
+        mini = self.model.init_cache(1, plen)
+        last_logits, mini = self._prefill(self.params, ids, mini)
+        self.alloc.ensure(slot, self._reserved(plen, cfg))
+        pt = jnp.asarray(self.alloc.page_table)
+        slots_v = jnp.full((plen,), slot, jnp.int32)
+        pos_v = jnp.arange(plen, dtype=jnp.int32)
+        pools, _ = self.caches
+        new_pools = []
+        for (kp, vp), (mk, mv) in zip(pools, mini):
+            kp, vp = write_tokens(kp, vp, pt, slots_v, pos_v, mk[0],
+                                  mv[0])
+            new_pools.append((kp, vp))
+        self.caches = (new_pools, pt)
+        return last_logits
+
+    def _retire(self, slot):
+        super()._retire(slot)
+        self.alloc.free_slot(slot)
+
+    def decode_segment(self, n_steps: int, cfg: GenerationConfig):
+        if not self._slot_req:
+            return 0
+        # admission reserved every running request's worst case, so no
+        # growth can fail here — just ship the current table
+        pools, _ = self.caches
+        self.caches = (pools, jnp.asarray(self.alloc.page_table))
+        return super().decode_segment(n_steps, cfg)

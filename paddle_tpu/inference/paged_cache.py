@@ -24,8 +24,10 @@ from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["PagedKVCache", "write_tokens", "gather_dense"]
+__all__ = ["PageAllocator", "PagedKVCache", "write_tokens",
+           "gather_dense"]
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -64,8 +66,10 @@ def gather_dense(pool, page_table, row):
         -1, *pool.shape[2:])
 
 
-class PagedKVCache:
-    """One layer's paged K/V pool + allocator.
+class PageAllocator:
+    """Page-table + free-list bookkeeping, pool-agnostic: ONE allocator
+    (one table) serves every layer's pools — the table maps logical
+    positions to page ids, and all layers use the same ids.
 
     ``num_pages * page_size`` bounds the TOTAL tokens in flight across
     all slots; ``max_pages`` bounds one sequence's length. Allocation
@@ -73,15 +77,16 @@ class PagedKVCache:
     segments; reads/writes are the pure functions above.
     """
 
-    def __init__(self, num_pages: int, page_size: int, num_heads: int,
-                 head_dim: int, max_batch: int, max_pages: int,
-                 dtype=jnp.bfloat16):
+    def __init__(self, num_pages: int, page_size: int, max_batch: int,
+                 max_pages: int):
         self.page_size = page_size
-        self.k = jnp.zeros((num_pages, page_size, num_heads, head_dim),
-                           dtype)
-        self.v = jnp.zeros_like(self.k)
-        # -1 = unmapped; the kernel clamps skipped entries to page 0
-        self.page_table = jnp.full((max_batch, max_pages), -1, jnp.int32)
+        self.num_pages = num_pages
+        # HOST-side numpy, mutated in place: ensure() runs for active
+        # slots in the latency-critical gap between jitted segments, and
+        # per-page jnp .at[].set updates would each be a device dispatch.
+        # Consumers convert once per segment (jnp.asarray). -1 =
+        # unmapped; the kernel clamps skipped entries to page 0.
+        self.page_table = np.full((max_batch, max_pages), -1, np.int32)
         self._free: List[int] = list(range(num_pages))
         self._owned: Dict[int, List[int]] = {}
 
@@ -117,16 +122,28 @@ class PagedKVCache:
                 f"page pool exhausted: slot {slot} needs {need} pages, "
                 f"{len(self._free)} free — drain finished requests or "
                 "grow num_pages")
-        row = self.page_table[slot]
         for _ in range(need):
             pid = self._free.pop(0)
-            row = row.at[len(owned)].set(pid)
+            self.page_table[slot, len(owned)] = pid
             owned.append(pid)
-        self.page_table = self.page_table.at[slot].set(row)
 
     def free_slot(self, slot: int) -> None:
         """Return the slot's pages to the pool (request retired)."""
         for pid in self._owned.pop(slot, []):
             self._free.append(pid)
         self._free.sort()
-        self.page_table = self.page_table.at[slot].set(-1)
+        self.page_table[slot, :] = -1
+
+
+class PagedKVCache(PageAllocator):
+    """One layer's paged K/V pool + its allocator (single-layer
+    convenience; multi-layer engines hold per-layer pools and ONE
+    PageAllocator)."""
+
+    def __init__(self, num_pages: int, page_size: int, num_heads: int,
+                 head_dim: int, max_batch: int, max_pages: int,
+                 dtype=jnp.bfloat16):
+        super().__init__(num_pages, page_size, max_batch, max_pages)
+        self.k = jnp.zeros((num_pages, page_size, num_heads, head_dim),
+                           dtype)
+        self.v = jnp.zeros_like(self.k)

@@ -240,6 +240,49 @@ class LlamaAttention(Layer):
         val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
         return self.o_proj(ctx), (val(kc), val(vc))
 
+    def forward_decode_paged(self, x, cos_full, sin_full, cache,
+                             page_table, lens, live):
+        """Paged decode step: like forward_decode_ragged but the KV cache
+        is this layer's slice of a shared page pool (ops/paged_attention
+        + inference/paged_cache — the vLLM-style serving layout the
+        reference's contiguous CacheKV slabs cannot express). Writes to
+        dead rows and unmapped pages are DROPPED via an out-of-range
+        sentinel, so the step stays one compiled program."""
+        b = x.shape[0]
+        hd = self.config.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        kp0, vp0 = cache
+
+        def attend(qv, kv, vv, kp, vp):
+            ps = kp.shape[1]
+            idx = jnp.minimum(lens, page_table.shape[1] * ps - 1)
+            c = cos_full[idx][:, None, None, :]
+            sn = sin_full[idx][:, None, None, :]
+            qh = apply_rotary_emb(
+                qv.reshape(b, 1, self.num_heads, hd), c, sn)[:, 0]
+            kh = apply_rotary_emb(
+                kv.reshape(b, 1, self.kv_heads, hd), c, sn)[:, 0]
+            vh = vv.reshape(b, self.kv_heads, hd)
+            page = page_table[jnp.arange(b), idx // ps]
+            # dead rows / unmapped pages -> sentinel, dropped by scatter
+            page = jnp.where(live & (page >= 0), page, kp.shape[0])
+            kp = kp.at[page, idx % ps].set(kh.astype(kp.dtype),
+                                           mode="drop")
+            vp = vp.at[page, idx % ps].set(vh.astype(vp.dtype),
+                                           mode="drop")
+            from ..ops.paged_attention import paged_decode_mha
+
+            ctx = paged_decode_mha(qh, kp, vp, page_table,
+                                   lens + live.astype(jnp.int32))
+            return ctx.reshape(b, 1, self.num_heads * hd), kp, vp
+
+        ctx, kp, vp = apply_op(attend, q, k, v, kp0, vp0,
+                               op_name="paged_attention")
+        val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
+        return self.o_proj(ctx), (val(kp), val(vp))
+
     def forward(self, x, cos, sin, attn_mask=None):
         b = x.shape[0]
         s = x.shape[1]
@@ -317,6 +360,15 @@ class LlamaDecoderLayer(Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
 
+    def forward_decode_paged(self, x, cos_full, sin_full, cache,
+                             page_table, lens, live):
+        attn, cache = self.self_attn.forward_decode_paged(
+            self.input_layernorm(x), cos_full, sin_full, cache,
+            page_table, lens, live)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
 
 class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
@@ -385,6 +437,30 @@ class LlamaModel(Layer):
             new_caches.append(cache)
         return self.norm(x), new_caches
 
+    def init_paged_cache(self, num_pages: int, page_size: int):
+        """Per-layer page POOLS (shared-table layout: one page_table,
+        inference/paged_cache.PageAllocator, serves every layer)."""
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype) if cfg.dtype != "float32" else jnp.float32
+        shape = (num_pages, page_size, cfg.kv_heads, cfg.head_dim)
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def forward_decode_paged(self, input_ids, caches, page_table, lens,
+                             live):
+        cfg = self.config
+        x = self.embed_tokens(input_ids)
+        max_len = page_table.shape[1] * caches[0][0].shape[1]
+        cos_full, sin_full = _rope_cos_sin(
+            max_len, cfg.head_dim, cfg.rope_theta,
+            x.value.dtype if isinstance(x, Tensor) else x.dtype)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            x, cache = layer.forward_decode_paged(
+                x, cos_full, sin_full, cache, page_table, lens, live)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
+
 
 class LlamaForCausalLM(Layer):
     IGNORE_INDEX = -100
@@ -438,4 +514,15 @@ class LlamaForCausalLM(Layer):
         (per-row positions; see LlamaAttention.forward_decode_ragged)."""
         hidden, caches = self.model.forward_decode_ragged(
             input_ids, caches, lens, live)
+        return self.logits(hidden), caches
+
+    def init_paged_cache(self, num_pages: int, page_size: int):
+        return self.model.init_paged_cache(num_pages, page_size)
+
+    def forward_decode_paged(self, input_ids, caches, page_table, lens,
+                             live):
+        """(logits [B, 1, V], new_caches) — paged decode step (page-pool
+        KV; see LlamaAttention.forward_decode_paged)."""
+        hidden, caches = self.model.forward_decode_paged(
+            input_ids, caches, page_table, lens, live)
         return self.logits(hidden), caches

@@ -73,10 +73,14 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     # decode_mha: short rows cost O(their length))
     @pl.when(jp * page_size < ln)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # [H, D]
-        k = k_ref[0].astype(jnp.float32)            # [ps, H, D]
+        q = q_ref[0].astype(jnp.float32)            # [Hq, D]
+        k = k_ref[0].astype(jnp.float32)            # [ps, Hkv, D]
         v = v_ref[0].astype(jnp.float32)
-        s = jnp.sum(q[None] * k, axis=-1) * scale   # [ps, H]
+        g = q.shape[0] // k.shape[1]
+        if g > 1:                                   # GQA: share KV heads
+            k = jnp.repeat(k, g, axis=1)            # VMEM-local repeat
+            v = jnp.repeat(v, g, axis=1)
+        s = jnp.sum(q[None] * k, axis=-1) * scale   # [ps, Hq]
         pos = jp * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (page_size, 1), 0)
         mask = pos < ln                             # [ps, 1]
@@ -103,8 +107,9 @@ def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
                      interpret=None):
     """Single-step decode attention over a paged KV pool.
 
-    q: [B, H, D] (this step's query)
-    k_pool/v_pool: [num_pages, page_size, H, D] shared pools
+    q: [B, Hq, D] (this step's query)
+    k_pool/v_pool: [num_pages, page_size, Hkv, D] shared pools (GQA:
+        Hq may be a multiple of Hkv — KV heads are shared in-kernel)
     page_table: [B, max_pages] int32 — page ids per sequence, in order;
         entries past a row's length are never dereferenced (clamped to 0
         for the skipped DMA)
@@ -113,6 +118,9 @@ def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
     Returns [B, H, D].
     """
     b, h, d = q.shape
+    hkv = k_pool.shape[2]
+    if h % hkv:
+        raise ValueError(f"Hq={h} not a multiple of Hkv={hkv}")
     page_size = k_pool.shape[1]
     npages = page_table.shape[1]
     scale = 1.0 / math.sqrt(d)
@@ -128,8 +136,8 @@ def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
         grid=(b, npages),
         in_specs=[
             pl.BlockSpec((1, h, d), lambda bi, pi, pt, ln: (bi, 0, 0)),
-            pl.BlockSpec((1, page_size, h, d), _page),
-            pl.BlockSpec((1, page_size, h, d), _page),
+            pl.BlockSpec((1, page_size, hkv, d), _page),
+            pl.BlockSpec((1, page_size, hkv, d), _page),
         ],
         out_specs=pl.BlockSpec((1, h, d), lambda bi, pi, pt, ln: (bi, 0, 0)),
         scratch_shapes=[

@@ -180,3 +180,190 @@ class TestWriteGuards:
         with pytest.raises(ValueError, match="max_pages"):
             c.ensure(0, 12)        # needs 3 pages, table holds 2
         assert c.free_pages == 8   # nothing leaked from the free list
+
+
+class TestPagedEngine:
+    """End-to-end serving over the paged pool: the
+    PagedContinuousBatchingEngine must reproduce the ragged engine's
+    outputs exactly (same model, same sampling stream) while holding
+    only tokens-in-flight worth of cache."""
+
+    def _model(self, layers=2):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaForCausalLM, llama_config
+
+        paddle.seed(0)
+        cfg = llama_config("tiny", num_hidden_layers=layers)
+        return LlamaForCausalLM(cfg), cfg
+
+    def test_greedy_matches_ragged_engine(self):
+        from paddle_tpu.inference.generation import (
+            ContinuousBatchingEngine, GenerationConfig,
+            PagedContinuousBatchingEngine)
+
+        model, cfg = self._model()
+        gcfg = GenerationConfig(max_new_tokens=12, do_sample=False,
+                                eos_token_id=None)
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (5, 9, 3)]
+        outs_r = ContinuousBatchingEngine(
+            model, max_batch=3, max_len=64).serve(prompts, gcfg,
+                                                  segment_steps=4)
+        paged = PagedContinuousBatchingEngine(
+            model, max_batch=3, num_pages=12, page_size=8, max_pages=8)
+        outs_p = paged.serve(prompts, gcfg, segment_steps=4)
+        for a, b in zip(outs_r, outs_p):
+            np.testing.assert_array_equal(a, b)
+        # every page returned after all requests retired
+        assert paged.alloc.free_pages == 12
+
+    def test_oversubscribed_continuous_serve(self):
+        """More requests than slots, sampled decoding, mixed prompt
+        lengths — the admission loop must cycle pages correctly."""
+        from paddle_tpu.inference.generation import (
+            GenerationConfig, PagedContinuousBatchingEngine)
+
+        model, cfg = self._model()
+        paged = PagedContinuousBatchingEngine(
+            model, max_batch=3, num_pages=12, page_size=8, max_pages=8)
+        gcfg = GenerationConfig(max_new_tokens=10, do_sample=True, seed=7,
+                                temperature=0.9, eos_token_id=None)
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (4, 30, 2, 11, 7, 19)]
+        outs = paged.serve(prompts, gcfg, segment_steps=3)
+        assert all(len(o) == 10 for o in outs)
+        assert paged.alloc.free_pages == 12
+
+    def test_pool_exhaustion_is_loud(self):
+        from paddle_tpu.inference.generation import (
+            GenerationConfig, PagedContinuousBatchingEngine)
+
+        model, cfg = self._model(layers=1)
+        # pool holds 2 pages = 16 tokens TOTAL; a 20-token prompt cannot
+        # ever fit and must fail loudly at admission
+        paged = PagedContinuousBatchingEngine(
+            model, max_batch=2, num_pages=2, page_size=8, max_pages=4)
+        gcfg = GenerationConfig(max_new_tokens=4, eos_token_id=None)
+        with pytest.raises(RuntimeError, match="pool exhausted"):
+            paged.add_request(np.arange(20, dtype=np.int32), gcfg)
+        assert paged._free == [0, 1]   # the slot was NOT consumed
+
+    def test_reservation_prevents_mid_decode_exhaustion(self):
+        """Admission reserves prompt+max_new_tokens, so two requests
+        that cannot run CONCURRENTLY are serialized by serve() instead
+        of exhausting the pool mid-decode and losing both (r5 review
+        crash repro)."""
+        from paddle_tpu.inference.generation import (
+            GenerationConfig, PagedContinuousBatchingEngine)
+
+        model, cfg = self._model(layers=1)
+        # 8 pages * 8 = 64 tokens total; each request reserves
+        # 25+10=35 tokens = 5 pages, so only ONE fits at a time
+        paged = PagedContinuousBatchingEngine(
+            model, max_batch=2, num_pages=8, page_size=8, max_pages=8)
+        gcfg = GenerationConfig(max_new_tokens=10, do_sample=False,
+                                eos_token_id=None)
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, cfg.vocab_size, (25,)).astype(np.int32)
+                   for _ in range(2)]
+        outs = paged.serve(prompts, gcfg, segment_steps=4)
+        assert all(len(o) == 10 for o in outs)
+        assert paged.alloc.free_pages == 8
+
+    def test_serve_defers_transient_pool_pressure(self):
+        """A free SLOT with a transiently full pool must defer admission
+        to the next segment gap, not raise out of serve()."""
+        from paddle_tpu.inference.generation import (
+            GenerationConfig, PagedContinuousBatchingEngine)
+
+        model, cfg = self._model(layers=1)
+        paged = PagedContinuousBatchingEngine(
+            model, max_batch=3, num_pages=6, page_size=8, max_pages=6)
+        gcfg = GenerationConfig(max_new_tokens=6, do_sample=False,
+                                eos_token_id=None)
+        rng = np.random.RandomState(4)
+        # each reserves ceil((18+6)/8)=3 pages; pool holds 2 at a time,
+        # 3 slots exist -> slot free while pool full
+        prompts = [rng.randint(0, cfg.vocab_size, (18,)).astype(np.int32)
+                   for _ in range(4)]
+        outs = paged.serve(prompts, gcfg, segment_steps=3)
+        assert all(len(o) == 6 for o in outs)
+        assert paged.alloc.free_pages == 6
+
+
+class TestPagedGQA:
+    """Hq > Hkv: the kernel shares KV heads in-kernel (query head i uses
+    kv head i // g, the gqa_decode_attention convention)."""
+
+    def test_gqa_parity_vs_dense_gqa_kernel(self):
+        from paddle_tpu.ops._decode import gqa_decode_attention
+
+        lens = np.array([13, 30], np.int32)
+        Hq, Hkv, D, PS = 4, 2, 16, 8
+        cache = _filled_cache(lens, H=Hkv, D=D, PS=PS)
+        rng = np.random.RandomState(6)
+        q = jnp.asarray(rng.randn(2, Hq, D), jnp.float32)
+        out = paged_decode_mha(q, cache.k, cache.v, cache.page_table,
+                               jnp.asarray(lens))
+        kd = jnp.stack([gather_dense(cache.k, cache.page_table, b)
+                        for b in range(2)])
+        vd = jnp.stack([gather_dense(cache.v, cache.page_table, b)
+                        for b in range(2)])
+        ref = gqa_decode_attention(q, kd, vd, jnp.asarray(lens))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_non_divisible_heads_rejected(self):
+        cache = _filled_cache(np.array([8], np.int32), H=3)
+        q = jnp.zeros((1, 4, 16), jnp.float32)
+        with pytest.raises(ValueError, match="multiple"):
+            paged_decode_mha(q, cache.k, cache.v, cache.page_table,
+                             jnp.asarray([8], jnp.int32))
+
+    def test_engine_with_gqa_model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.generation import (
+            ContinuousBatchingEngine, GenerationConfig,
+            PagedContinuousBatchingEngine)
+        from paddle_tpu.models import LlamaForCausalLM, llama_config
+
+        paddle.seed(0)
+        cfg = llama_config("tiny", num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2)
+        model = LlamaForCausalLM(cfg)
+        gcfg = GenerationConfig(max_new_tokens=8, do_sample=False,
+                                eos_token_id=None)
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (6, 11)]
+        outs_r = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=64).serve(prompts, gcfg,
+                                                  segment_steps=4)
+        outs_p = PagedContinuousBatchingEngine(
+            model, max_batch=2, num_pages=10, page_size=8,
+            max_pages=8).serve(prompts, gcfg, segment_steps=4)
+        for a, b in zip(outs_r, outs_p):
+            np.testing.assert_array_equal(a, b)
+
+    def test_serve_capacity_probe_accepts_tensor_prompts(self):
+        """The probe and add_request must normalize prompts identically
+        (a bare np.asarray on a Tensor is a size-1 object array)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.generation import (
+            GenerationConfig, PagedContinuousBatchingEngine)
+        from paddle_tpu.models import LlamaForCausalLM, llama_config
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_config("tiny",
+                                              num_hidden_layers=1))
+        paged = PagedContinuousBatchingEngine(
+            model, max_batch=2, num_pages=6, page_size=8, max_pages=6)
+        gcfg = GenerationConfig(max_new_tokens=6, do_sample=False,
+                                eos_token_id=None)
+        rng = np.random.RandomState(5)
+        prompts = [paddle.to_tensor(
+            rng.randint(0, 64, (18,)).astype(np.int32)) for _ in range(3)]
+        outs = paged.serve(prompts, gcfg, segment_steps=3)
+        assert all(len(o) == 6 for o in outs)
