@@ -73,6 +73,37 @@ def _prompt_len(prompt) -> int:
     return _prompt_ids(prompt).shape[1]
 
 
+class _NgramIndex:
+    """Incremental prompt-lookup index: maps each n-gram (n <=
+    ngram_max) to the continuation start of its most recent occurrence.
+    Registration lags one position behind the context tail so the
+    current suffix never matches itself; amortized O(ngram_max) per
+    appended token (a fresh linear scan per proposal would be O(L) of
+    host work per verify step — the latency this path exists to cut)."""
+
+    def __init__(self, ngram_max: int):
+        self.n_max = ngram_max
+        self.maps = {n: {} for n in range(1, ngram_max + 1)}
+        self._reg = 0          # grams ending before this index are in
+
+    def _register_upto(self, ctx, end):
+        for j in range(self._reg, end):
+            for n in range(1, min(self.n_max, j + 1) + 1):
+                self.maps[n][tuple(ctx[j - n + 1:j + 1])] = j + 1
+        self._reg = max(self._reg, end)
+
+    def propose(self, ctx, k: int):
+        L = len(ctx)
+        self._register_upto(ctx, L - 1)   # exclude the current tail
+        for n in range(min(self.n_max, L - 1), 0, -1):
+            start = self.maps[n].get(tuple(ctx[L - n:]))
+            if start is not None:
+                cont = ctx[start:start + k]
+                if cont:
+                    return (cont + [cont[-1]] * (k - len(cont)))[:k]
+        return [ctx[-1]] * k
+
+
 class CausalLMEngine:
     """Compiled prefill + decode for a causal LM exposing
     ``init_cache`` / ``forward_with_cache`` (LlamaForCausalLM, GPT...).
@@ -175,6 +206,123 @@ class CausalLMEngine:
         else:
             gen = np.asarray(first)[:, None]
         return np.concatenate([ids, gen], axis=1)
+
+    # -- speculative decoding -------------------------------------------------
+    def _spec_verify_fn(self, width: int):
+        """One jitted verification forward of ``width`` tokens at a
+        traced offset (compiled once per width)."""
+        key = ("spec", width)
+        if key not in self._decode_cache:
+            def verify(params, inp, caches, pos):
+                return self._fwd(params, inp, caches, pos)
+
+            self._decode_cache[key] = jax.jit(verify, donate_argnums=(2,))
+        return self._decode_cache[key]
+
+    def generate_speculative(self, input_ids,
+                             config: Optional[GenerationConfig] = None,
+                             draft_k: int = 8, ngram_max: int = 3):
+        """LOSSLESS n-gram (prompt-lookup) speculative decoding: propose
+        ``draft_k`` tokens by continuing the longest recent-suffix match
+        found earlier in the context, verify ALL of them in ONE model
+        forward, and accept the matched prefix plus the model's own next
+        token — so each forward yields between 1 and draft_k+1 tokens.
+
+        Losslessness: every emitted token is the model's own argmax —
+        acceptance targets and the bonus token come FROM the
+        verification forward, so the output is the model's greedy
+        continuation by construction. Bitwise it equals ``generate()``
+        wherever the chunked-verify and one-token decode attention paths
+        reduce identically (exactly true in f32 / the test suite; on a
+        bf16 TPU cache the two kernels' reduction orders can low-bit
+        flip a near-tied argmax — same class of divergence as any
+        speculative-vs-sequential system).
+
+        Greedy-only and B=1 (the latency-serving case). The reference
+        has no speculative path; on TPU, decode is HBM-bandwidth-bound,
+        so verifying k+1 positions costs barely more than one — the win
+        is model forwards per token (reported in
+        ``self.last_spec_stats``). Rejected drafts leave stale cache
+        entries past the accepted length; the next verification
+        overwrites them, and the cached-attention mask (absolute
+        ``kv_pos <= sq_pos``) never reads beyond the query's position.
+        """
+        cfg = config or GenerationConfig()
+        if cfg.do_sample:
+            raise ValueError(
+                "speculative decoding here is greedy-only (lossless "
+                "acceptance needs the argmax target); use generate() "
+                "for sampling")
+        ids = np.asarray(input_ids.value if isinstance(input_ids, Tensor)
+                         else input_ids).astype(np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, plen = ids.shape
+        if b != 1:
+            # NOT _prompt_ids: its reshape(1, -1) would silently flatten
+            # a batch into one long prompt
+            raise ValueError("speculative decoding serves B=1 requests")
+        if plen + cfg.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt({plen}) + max_new_tokens({cfg.max_new_tokens}) "
+                f"exceeds engine max_len({self.max_len})")
+        caches = self.model.init_cache(1, self.max_len)
+        last_logits, caches = self._prefill_fn(plen)(self.params, ids,
+                                                     caches)
+        ctx = [int(t) for t in ids[0]]
+        out = [int(np.argmax(np.asarray(last_logits[0])))]
+        ctx.append(out[0])
+        pos = plen                      # tokens the CACHE holds
+        forwards = 1                    # the prefill
+        eos = cfg.eos_token_id
+        verify = self._spec_verify_fn(draft_k + 1)
+        ngrams = _NgramIndex(ngram_max)
+        while (len(out) < cfg.max_new_tokens
+               and (eos is None or out[-1] != eos)
+               and pos + 1 + draft_k <= self.max_len):
+            draft = ngrams.propose(ctx, draft_k)
+            inp = np.asarray([[out[-1]] + draft], np.int32)
+            logits, caches = verify(self.params, inp, caches,
+                                    jnp.int32(pos))
+            forwards += 1
+            greedy = np.asarray(jnp.argmax(logits[0], axis=-1))
+            m = 0
+            while m < draft_k and int(greedy[m]) == draft[m]:
+                m += 1
+            accepted = draft[:m] + [int(greedy[m])]
+            for t in accepted:
+                out.append(t)
+                ctx.append(t)
+                if (len(out) >= cfg.max_new_tokens
+                        or (eos is not None and t == eos)):
+                    break
+            # cache gained [out_prev_last, accepted drafts]; the final
+            # accepted token is the model's own pick, not yet cached
+            pos += 1 + m
+        # tail: plain 1-wide steps when max_len headroom < draft_k+1
+        one = self._spec_verify_fn(1)
+        while (len(out) < cfg.max_new_tokens
+               and (eos is None or out[-1] != eos)
+               and pos + 1 <= self.max_len - 1):
+            logits, caches = one(self.params,
+                                 np.asarray([[out[-1]]], np.int32),
+                                 caches, jnp.int32(pos))
+            forwards += 1
+            out.append(int(np.argmax(np.asarray(logits[0, 0]))))
+            ctx.append(out[-1])
+            pos += 1
+        # generate() always emits the prefill token, even at budget 0
+        budget = max(cfg.max_new_tokens, 1)
+        if eos is not None and eos in out:
+            # generate() freezes finished rows on eos — match exactly
+            i = out.index(eos)
+            out = out[:i + 1] + [eos] * (budget - i - 1)
+        out = out[:budget]
+        self.last_spec_stats = {"forwards": forwards,
+                                "tokens": len(out),
+                                "tokens_per_forward":
+                                    len(out) / max(forwards, 1)}
+        return np.concatenate([ids, np.asarray([out], np.int32)], axis=1)
 
 
 class ContinuousBatchingEngine:
