@@ -2,11 +2,28 @@
 
 The reference emits RecordEvent spans inside every generated ad_func
 (eager_gen.py:1097-1098); here the single choke point is apply_op, which
-calls ``op_span_hook(name, start_ns, end_ns)`` when one is installed (the
-profiler does). None = zero overhead.
+calls ``op_span_hook(name, start_ns, end_ns)`` when one is installed.
+None = zero overhead. Two consumers exist — the profiler (trace spans)
+and the monitor (latency histograms) — and both install by saving the
+previous hook and chaining to it, so they compose in either order.
 """
 from __future__ import annotations
 
 from typing import Callable, Optional
 
 op_span_hook: Optional[Callable[[str, int, int], None]] = None
+
+# Chain protocol shared by the consumers: a hook that saves the previous
+# slot value and forwards to it exposes it as ``hook.prev_hook``; a hook
+# that can go permanently dead (a stopped profiler window stranded under
+# another consumer) flags itself with ``hook.armed = False``. Installers
+# and restorers prune dead links with skip_dead so chains never regrow
+# across profile/monitor interleaves.
+
+
+def skip_dead(hook):
+    """Follow ``prev_hook`` links past hooks whose ``armed`` flag is
+    False; returns the first live hook (or None)."""
+    while hook is not None and not getattr(hook, "armed", True):
+        hook = getattr(hook, "prev_hook", None)
+    return hook

@@ -482,11 +482,14 @@ def _ps_plane():
     - default (``auto``): native when the toolchain built it — plain
       tables shouldn't pay pickling, and the native plane raises loudly
       (pointing back here) if an accessor-feature table is requested.
-      When the build is UNAVAILABLE, auto raises instead of silently
-      falling back to python: the selection must resolve identically on
-      every node (a node-local fallback would let a toolchain-less
-      trainer pickle into peers' binary-protocol servers and die with an
-      opaque EOF) — pin the plane via the env var cluster-wide."""
+      When the build is UNAVAILABLE, auto falls back to python ONLY for
+      a single-node group (one server endpoint, one trainer — both
+      planes live in this process, nothing can desync); any multi-node
+      group raises instead of silently falling back, because the
+      selection must resolve identically on every node (a node-local
+      fallback would let a toolchain-less trainer pickle into peers'
+      binary-protocol servers and die with an opaque EOF) — pin the
+      plane via the env var cluster-wide."""
     import os
 
     plane = os.environ.get("PADDLE_PS_DATA_PLANE", "auto")
@@ -498,13 +501,34 @@ def _ps_plane():
             plane = "native" if native_lib.lib_path() else "unavailable"
             _ps_plane._auto = plane
         if plane == "unavailable":
-            raise RuntimeError(
-                "PADDLE_PS_DATA_PLANE=auto: the native data plane did "
-                "not build on this node (g++ missing or compile failed) "
-                "— other nodes may still pick native, and mixed planes "
-                "fail with opaque stream errors. Set "
-                "PADDLE_PS_DATA_PLANE=python (or =native) identically "
-                "on every server and trainer node")
+            if _ps_single_node_group():
+                # one local server + one trainer: the only other
+                # participant runs on this same host, which failed the
+                # same native build probe in any same-venv launch —
+                # g++-less laptops keep working. Caveat (hence the
+                # warning): server and trainer PROCESSES launched from
+                # DIFFERENT python envs on one host can still resolve
+                # differently; pin PADDLE_PS_DATA_PLANE to be safe.
+                import warnings
+
+                warnings.warn(
+                    "PADDLE_PS_DATA_PLANE=auto: native data plane "
+                    "unavailable (no g++); single-node group falls "
+                    "back to the python plane. If the server and "
+                    "trainer run from different python environments, "
+                    "set PADDLE_PS_DATA_PLANE=python explicitly for "
+                    "both.", RuntimeWarning, stacklevel=3)
+                plane = "python"
+            else:
+                raise RuntimeError(
+                    "PADDLE_PS_DATA_PLANE=auto: the native data plane "
+                    "did not build on this node (g++ missing or compile "
+                    "failed) — other nodes may still pick native, and "
+                    "mixed planes fail with opaque stream errors. Set "
+                    "PADDLE_PS_DATA_PLANE=python (or =native) "
+                    "identically on every server and trainer node "
+                    "(single-node groups fall back to python "
+                    "automatically)")
     if plane == "native":
         from ..ps.native import NativePsClient, NativePsServer
 
@@ -521,6 +545,41 @@ def _ps_plane():
 
 
 _ps_plane._auto = None  # memoized auto-mode probe result
+
+
+def _ps_single_node_group() -> bool:
+    """True when the PS group is one server endpoint + one trainer AND
+    that server endpoint is THIS host — the only configuration where a
+    node-local plane fallback cannot create a mixed-plane cluster. A
+    1-server/1-trainer group whose server lives on another machine still
+    resolves the plane independently per node, so it gets the loud
+    multi-node error, not the fallback."""
+    import socket
+
+    rm = _fleet_state.get("role_maker")
+    if rm is None or getattr(rm, "_is_collective", True):
+        return False
+    try:
+        if (len(rm._server_endpoints) != 1
+                or int(rm._worker_num()) > 1):
+            return False
+        host = rm._server_endpoints[0].rsplit(":", 1)[0]
+        if not host:
+            # a malformed ':port' endpoint must hit the loud error, not
+            # accidentally classify as local via an unset POD_IP
+            return False
+        local = {"127.0.0.1", "localhost", "0.0.0.0", "::1",
+                 socket.gethostname()}
+        pod_ip = os.environ.get("POD_IP")
+        if pod_ip:
+            local.add(pod_ip)
+        try:
+            local.add(socket.gethostbyname(socket.gethostname()))
+        except OSError:
+            pass
+        return host in local
+    except Exception:
+        return False
 
 
 def init_server(*args, **kwargs):

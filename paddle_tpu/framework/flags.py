@@ -41,6 +41,7 @@ define_flag("FLAGS_use_pallas_kernels", True)      # TPU-native: route fused ops
 define_flag("FLAGS_flash_head_batched", False)    # BSHD-native flash (opt-in until TPU-measured)
 define_flag("FLAGS_use_autotune", True)            # kernel autotune cache (ops/autotune.py)
 define_flag("FLAGS_log_level", 0)
+define_flag("FLAGS_enable_monitor", False)         # paddle_tpu.monitor metrics registry
 
 
 def get_flags(flags: Union[str, List[str]]):
@@ -58,3 +59,7 @@ def set_flags(flags: Dict[str, Any]):
         from ..core.amp_state import amp_state
 
         amp_state.check_nan_inf = bool(flags["FLAGS_check_nan_inf"])
+    if "FLAGS_enable_monitor" in flags:
+        from ..monitor import _sync_enabled
+
+        _sync_enabled(bool(flags["FLAGS_enable_monitor"]))

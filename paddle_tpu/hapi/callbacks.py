@@ -11,7 +11,7 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "VisualDL", "config_callbacks"]
+           "LRScheduler", "VisualDL", "MonitorCallback", "config_callbacks"]
 
 
 class Callback:
@@ -93,6 +93,8 @@ class ProgBarLogger(Callback):
     def _fmt(self, logs):
         out = []
         for k, v in (logs or {}).items():
+            if k in ("batch_size", "optimizer_step"):  # metadata
+                continue
             if isinstance(v, (numbers.Number, np.floating)):
                 out.append(f"{k}: {float(v):.4f}")
             elif isinstance(v, (list, tuple)) and v and isinstance(
@@ -226,10 +228,115 @@ class VisualDL(Callback):
         with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
             rec = {"step": self._step}
             for k, v in (logs or {}).items():
+                if k in ("batch_size", "optimizer_step"):  # metadata
+                    continue
                 if isinstance(v, (int, float, np.floating)):
                     rec[k] = float(v)
             f.write(json.dumps(rec) + "\n")
         self._step += 1
+
+
+_FINISHED_FIT_LABELS: List[str] = []  # sessions awaiting series cleanup
+
+
+class MonitorCallback(Callback):
+    """Feed ``Model.fit`` training telemetry into ``paddle_tpu.monitor``:
+    step-time histogram, samples/sec + steps/sec throughput gauges, step
+    and sample counters, and — when the per-sample cost is known — MFU.
+
+    ``flops_per_sample`` is the model's forward+backward FLOPs for ONE
+    sample (≈ 6 * params for a dense transformer LM over its sequence);
+    ``peak_flops_per_sec`` is the accelerator's peak (e.g. 197e12 for a
+    v5e chip in bf16). Both must be given for the MFU gauge; neither is
+    guessed — a wrong denominator is worse than no MFU.
+
+    ``config_callbacks`` installs this automatically whenever the
+    monitor is enabled, so a plain ``Model.fit`` run already exports
+    throughput; off-monitor it no-ops per batch after one bool check.
+    """
+
+    def __init__(self, flops_per_sample: Optional[float] = None,
+                 peak_flops_per_sec: Optional[float] = None):
+        super().__init__()
+        self.flops_per_sample = flops_per_sample
+        self.peak_flops_per_sec = peak_flops_per_sec
+        self._t0 = None
+        self._fit_label = None  # assigned per train session
+
+    def _monitor(self):
+        from .. import monitor
+
+        return monitor if monitor.enabled() else None
+
+    _GAUGES = (
+        ("paddle_tpu_train_throughput_samples_per_sec",
+         "instantaneous Model.fit throughput (latest batch), per fit "
+         "session"),
+        ("paddle_tpu_train_throughput_batches_per_sec",
+         "instantaneous train_batch rate (latest batch; equals optimizer "
+         "steps/sec only without grad accumulation), per fit session"),
+        ("paddle_tpu_train_mfu_ratio",
+         "model FLOPs utilization: achieved / peak, per fit session"),
+    )
+
+    def _fit_gauge(self, mon, idx):
+        name, help_ = self._GAUGES[idx]
+        return mon.gauge(name, help_, ("fit",))
+
+    def on_train_begin(self, logs=None):
+        mon = self._monitor()
+        if mon is not None:
+            # per-session gauge label: two concurrently fitting Models
+            # in one process must not clobber each other's throughput
+            # (same idiom as the engine/loader/pool labels). The series
+            # deliberately OUTLIVES fit so the final throughput stays
+            # visible in post-run snapshots — cleanup of FINISHED
+            # sessions is deferred to the next fit, which bounds
+            # cardinality at live sessions + one
+            while _FINISHED_FIT_LABELS:
+                stale = _FINISHED_FIT_LABELS.pop()
+                for i in range(len(self._GAUGES)):
+                    self._fit_gauge(mon, i).remove(fit=stale)
+            self._fit_label = mon.instance_label("fit")
+
+    def on_train_end(self, logs=None):
+        if self._fit_label is not None:
+            _FINISHED_FIT_LABELS.append(self._fit_label)
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        mon = self._monitor()
+        if mon is None or self._t0 is None:
+            return
+        if self._fit_label is None:  # monitor enabled mid-session
+            self._fit_label = mon.instance_label("fit")
+        dt = time.perf_counter() - self._t0
+        # the fit loop reports the ACTUAL row count per batch (tail
+        # batches can be short); configured size is only the fallback
+        batch_size = ((logs or {}).get("batch_size")
+                      or self.params.get("batch_size") or 1)
+        mon.histogram(
+            "paddle_tpu_train_step_seconds",
+            "wall time of one train_batch (forward+backward, plus the "
+            "update on optimizer-step batches)").observe(dt)
+        mon.counter("paddle_tpu_train_batches_total",
+                    "train_batch calls run by Model.fit").inc()
+        if (logs or {}).get("optimizer_step", True):
+            # with grad accumulation only every k-th batch steps the
+            # optimizer — the steps counter must reflect that
+            mon.counter("paddle_tpu_train_steps_total",
+                        "optimizer steps run by Model.fit").inc()
+        mon.counter("paddle_tpu_train_samples_total",
+                    "samples consumed by Model.fit").inc(batch_size)
+        sps = batch_size / dt if dt > 0 else 0.0
+        self._fit_gauge(mon, 0).labels(fit=self._fit_label).set(sps)
+        self._fit_gauge(mon, 1).labels(fit=self._fit_label).set(
+            1.0 / dt if dt > 0 else 0.0)
+        if self.flops_per_sample and self.peak_flops_per_sec:
+            self._fit_gauge(mon, 2).labels(fit=self._fit_label).set(
+                sps * self.flops_per_sample / self.peak_flops_per_sec)
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
@@ -243,6 +350,11 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks = cbks + [LRScheduler()]
+    from .. import monitor
+
+    if monitor.enabled() and not any(
+            isinstance(c, MonitorCallback) for c in cbks):
+        cbks = cbks + [MonitorCallback()]
     cb_list = CallbackList(cbks)
     cb_list.set_model(model)
     params = {

@@ -183,6 +183,16 @@ class Model:
                           or (steps is not None and step + 1 == steps))
                 out = self.train_batch(ins, labs, update=update)
                 logs = self._pack_logs(out)
+                # ACTUAL rows in this batch (reference fit:1870 passes
+                # batch_size in logs) — the tail batch can be short, and
+                # throughput consumers must not bill the configured size
+                try:
+                    logs["batch_size"] = int(ins[0].shape[0])
+                except Exception:
+                    pass
+                # with grad accumulation only every k-th batch is an
+                # optimizer step; metric consumers must not count 4x
+                logs["optimizer_step"] = bool(update)
                 cbks.on_train_batch_end(step, logs)
                 it += 1
                 if num_iters is not None and it >= num_iters:

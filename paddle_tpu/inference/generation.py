@@ -12,6 +12,7 @@ not once per token.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import numpy as np
@@ -19,6 +20,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import monitor
 from ..core.tensor import Tensor
 from ..nn.functional_call import substituted_state
 
@@ -128,7 +130,8 @@ class CausalLMEngine:
         # prompt-length/batch shape. decode stays keyed by GenerationConfig
         # because the config is *trace-static* (branching on do_sample/eos),
         # not shape-derived.
-        self._prefill = jax.jit(prefill, donate_argnums=(2,))
+        self._prefill = monitor.monitored_jit(prefill, name="lm_prefill",
+                                              donate_argnums=(2,))
         self._decode_cache = {}
 
     # -- pure functions -------------------------------------------------------
@@ -172,8 +175,8 @@ class CausalLMEngine:
                     length=n_steps)
                 return jnp.swapaxes(toks, 0, 1), caches   # [B, n_steps]
 
-            self._decode_cache[key_cfg] = jax.jit(
-                decode_n, donate_argnums=(2,))
+            self._decode_cache[key_cfg] = monitor.monitored_jit(
+                decode_n, name="lm_decode", donate_argnums=(2,))
         return self._decode_cache[key_cfg]
 
     # -- public ---------------------------------------------------------------
@@ -216,7 +219,8 @@ class CausalLMEngine:
             def verify(params, inp, caches, pos):
                 return self._fwd(params, inp, caches, pos)
 
-            self._decode_cache[key] = jax.jit(verify, donate_argnums=(2,))
+            self._decode_cache[key] = monitor.monitored_jit(
+                verify, name="lm_spec_verify", donate_argnums=(2,))
         return self._decode_cache[key]
 
     def generate_speculative(self, input_ids,
@@ -355,6 +359,9 @@ class ContinuousBatchingEngine:
         self.model = model
         self.max_batch = max_batch
         self.max_len = max_len
+        # engine label: concurrent engines (multi-model serving) publish
+        # throughput side by side; retired via close()/__del__
+        self._monitor_engine = monitor.instance_label("engine")
         self.params = {k: p.value for k, p in model.named_parameters()}
         self.caches = self._make_caches()
         self.lens = jnp.zeros((max_batch,), jnp.int32)
@@ -373,7 +380,8 @@ class ContinuousBatchingEngine:
             logits, mini = self._fwd_prefill(params, ids, mini)
             return logits[:, -1], mini
 
-        self._prefill = jax.jit(prefill_one, donate_argnums=(2,))
+        self._prefill = monitor.monitored_jit(
+            prefill_one, name="cb_prefill", donate_argnums=(2,))
 
         def admit(caches, mini, slot):
             return jax.tree.map(
@@ -382,7 +390,23 @@ class ContinuousBatchingEngine:
 
         # mini is NOT donated: its rows are dtype-cast into the pool, so
         # the buffers can't alias (donation would only warn)
-        self._admit = jax.jit(admit, donate_argnums=(0,))
+        self._admit = monitor.monitored_jit(admit, name="cb_admit",
+                                            donate_argnums=(0,))
+
+        def admit_state(lens, last, done, active, slot, plen, first,
+                        tok_done):
+            # one program for the four per-slot scalars — admission sits
+            # in the latency-critical gap between decode segments, and
+            # four separate .at[].set dispatches cost four host→device
+            # round-trips where this costs one
+            return (lens.at[slot].set(plen),
+                    last.at[slot].set(first),
+                    done.at[slot].set(tok_done),
+                    active.at[slot].set(True))
+
+        self._admit_state = monitor.monitored_jit(
+            admit_state, name="cb_admit_state",
+            donate_argnums=(0, 1, 2, 3))
         self._segment_cache = {}
 
     def _make_caches(self):
@@ -421,6 +445,7 @@ class ContinuousBatchingEngine:
         Raises if no slot is free (call decode_segment / collect first)."""
         if not self._free:
             raise RuntimeError("no free slot; drain with decode_segment()")
+        t0 = time.perf_counter()
         ids = _prompt_ids(prompt_ids)
         plen = ids.shape[1]
         if plen + cfg.max_new_tokens > self.max_len:
@@ -438,15 +463,32 @@ class ContinuousBatchingEngine:
         first = _sample(last_logits, key, cfg)[0]
         tok_done = (jnp.asarray(False) if cfg.eos_token_id is None
                     else first == cfg.eos_token_id)
-        self.lens = self.lens.at[slot].set(plen)
-        self.last = self.last.at[slot].set(first)
-        self.done_dev = self.done_dev.at[slot].set(tok_done)
-        self.active_dev = self.active_dev.at[slot].set(True)
+        # the four per-slot scalars update in ONE jitted program (shared
+        # by the dense and paged engines) instead of four dispatches
+        self.lens, self.last, self.done_dev, self.active_dev = \
+            self._admit_state(self.lens, self.last, self.done_dev,
+                              self.active_dev, jnp.int32(slot),
+                              jnp.int32(plen), first, tok_done)
         self._slot_req[slot] = rid
         self._tokens[rid] = [int(first)]
         self._budget[rid] = cfg.max_new_tokens - 1
         if bool(tok_done) or self._budget[rid] <= 0:
             self._retire(slot)
+        if monitor.enabled():
+            monitor.histogram(
+                "paddle_tpu_kv_admission_seconds",
+                "add_request latency: prefill + cache install + slot "
+                "state update").observe(time.perf_counter() - t0)
+            monitor.counter(
+                "paddle_tpu_requests_total",
+                "serving requests by lifecycle event",
+                ("event",)).labels(event="admitted").inc()
+            # the prompt's first generated token is sampled HERE, not in
+            # a decode segment — count it so tokens_total means tokens
+            monitor.counter(
+                "paddle_tpu_generated_tokens_total",
+                "tokens generated by the continuous-batching engines "
+                "(admission first-token + decode segments)").inc()
         return rid
 
     def _admit_cache(self, slot: int, ids, plen: int, cfg):
@@ -466,6 +508,11 @@ class ContinuousBatchingEngine:
         self.active_dev = self.active_dev.at[slot].set(False)
         self._free.append(slot)
         self._free.sort()
+        if monitor.enabled():
+            monitor.counter(
+                "paddle_tpu_requests_total",
+                "serving requests by lifecycle event",
+                ("event",)).labels(event="finished").inc()
 
     def _segment_fn(self, n_steps: int, cfg: GenerationConfig):
         key_cfg = (n_steps, cfg.do_sample, cfg.temperature, cfg.top_k,
@@ -494,8 +541,8 @@ class ContinuousBatchingEngine:
                 return (jnp.swapaxes(toks, 0, 1), last, lens, done,
                         caches)
 
-            self._segment_cache[key_cfg] = jax.jit(
-                segment, donate_argnums=(5,))
+            self._segment_cache[key_cfg] = monitor.monitored_jit(
+                segment, name="cb_segment", donate_argnums=(5,))
         return self._segment_cache[key_cfg]
 
     def decode_segment(self, n_steps: int, cfg: GenerationConfig):
@@ -504,6 +551,7 @@ class ContinuousBatchingEngine:
         the number of still-active requests."""
         if not self._slot_req:
             return 0
+        t0 = time.perf_counter()
         # every segment must draw fresh sampling noise even when no
         # request was admitted in between — fold in a segment counter
         self._segments_run += 1
@@ -515,6 +563,7 @@ class ContinuousBatchingEngine:
                 self.active_dev, self.caches, key)
         toks = np.asarray(toks)
         done = np.asarray(self.done_dev)
+        emitted = 0
         for slot, rid in list(self._slot_req.items()):
             take = min(self._budget[rid], n_steps)
             seq = toks[slot, :take].tolist()
@@ -522,10 +571,45 @@ class ContinuousBatchingEngine:
                 seq = seq[:seq.index(cfg.eos_token_id) + 1]
             self._tokens[rid].extend(int(t) for t in seq)
             self._budget[rid] -= len(seq)
+            emitted += len(seq)
             if (self._budget[rid] <= 0 or bool(done[slot])
                     or len(seq) < take):
                 self._retire(slot)
+        if monitor.enabled():
+            dt = time.perf_counter() - t0
+            monitor.counter(
+                "paddle_tpu_generated_tokens_total",
+                "tokens generated by the continuous-batching engines "
+                "(admission first-token + decode segments)").inc(emitted)
+            self._tokens_per_sec_gauge().labels(
+                engine=self._monitor_engine).set(
+                emitted / dt if dt > 0 else 0.0)
         return len(self._slot_req)
+
+    @staticmethod
+    def _tokens_per_sec_gauge():
+        return monitor.gauge(
+            "paddle_tpu_decode_tokens_per_sec",
+            "emitted tokens / wall time of the latest decode "
+            "segment (includes host collect), per engine", ("engine",))
+
+    def close(self):
+        """Retire this engine's per-instance monitor series (idempotent;
+        a dropped engine must not export its last tokens/sec forever)."""
+        try:
+            self._tokens_per_sec_gauge().remove(
+                engine=self._monitor_engine)
+        except Exception:
+            pass
+        alloc = getattr(self, "alloc", None)
+        if alloc is not None:
+            alloc.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def collect_finished(self):
         out, self._finished = self._finished, {}

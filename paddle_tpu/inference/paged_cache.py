@@ -89,10 +89,48 @@ class PageAllocator:
         self.page_table = np.full((max_batch, max_pages), -1, np.int32)
         self._free: List[int] = list(range(num_pages))
         self._owned: Dict[int, List[int]] = {}
+        # pool label so several allocators (multi-model serving) publish
+        # side by side instead of clobbering one process-global gauge
+        from .. import monitor
+
+        self.monitor_pool = monitor.instance_label("pool")
+        self._publish_occupancy()
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @staticmethod
+    def _pages_gauge():
+        from .. import monitor
+
+        return monitor.gauge("paddle_tpu_kv_pages",
+                             "KV-cache page pool occupancy by state",
+                             ("pool", "state"))
+
+    @staticmethod
+    def _occupancy_gauge():
+        from .. import monitor
+
+        return monitor.gauge("paddle_tpu_kv_page_occupancy_ratio",
+                             "fraction of the KV page pool in use",
+                             ("pool",))
+
+    def _publish_occupancy(self) -> None:
+        """Push pool occupancy into the monitor (host-side mutations only
+        happen in ensure/free_slot, so pushing there keeps the gauges
+        exact with zero per-token cost)."""
+        from .. import monitor
+
+        if not monitor.enabled():
+            return
+        free = len(self._free)
+        pages = self._pages_gauge()
+        pages.labels(pool=self.monitor_pool, state="free").set(free)
+        pages.labels(pool=self.monitor_pool,
+                     state="used").set(self.num_pages - free)
+        self._occupancy_gauge().labels(pool=self.monitor_pool).set(
+            1.0 - free / self.num_pages if self.num_pages else 0.0)
 
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
@@ -126,6 +164,7 @@ class PageAllocator:
             pid = self._free.pop(0)
             self.page_table[slot, len(owned)] = pid
             owned.append(pid)
+        self._publish_occupancy()
 
     def free_slot(self, slot: int) -> None:
         """Return the slot's pages to the pool (request retired)."""
@@ -133,6 +172,25 @@ class PageAllocator:
             self._free.append(pid)
         self._free.sort()
         self.page_table[slot, :] = -1
+        self._publish_occupancy()
+
+    def close(self) -> None:
+        """Retire this allocator's monitor series (idempotent). Without
+        this, a dropped engine's pool gauges would export their last
+        values forever and label cardinality would grow per engine."""
+        try:
+            pages = self._pages_gauge()
+            pages.remove(pool=self.monitor_pool, state="free")
+            pages.remove(pool=self.monitor_pool, state="used")
+            self._occupancy_gauge().remove(pool=self.monitor_pool)
+        except Exception:  # teardown-ordering safe
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class PagedKVCache(PageAllocator):

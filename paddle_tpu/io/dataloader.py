@@ -18,6 +18,7 @@ import itertools
 import multiprocessing as mp
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional
 
@@ -154,6 +155,12 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
+        from .. import monitor
+
+        # loader label: concurrent DataLoaders must not clobber one
+        # shared queue-depth gauge (same reason the KV gauges carry a
+        # pool label); the series is retired when iteration ends
+        self._monitor_id = monitor.instance_label("loader")
         self.dataset = dataset
         self.num_workers = max(0, int(num_workers))
         self.use_shared_memory = bool(use_shared_memory)
@@ -186,16 +193,76 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable_mode:
-            return self._iter_iterable()
+            return self._instrument(self._iter_iterable())
         if self.batch_sampler is None:
             # batch_size=None → sample-by-sample passthrough
-            return (self.collate_fn([self.dataset[i]])
-                    for i in range(len(self.dataset)))
+            return self._instrument(
+                self.collate_fn([self.dataset[i]])
+                for i in range(len(self.dataset)))
         if self.num_workers == 0:
-            return self._iter_single()
+            return self._instrument(self._iter_single())
         if self.use_shared_memory:
-            return self._iter_processes()
-        return self._iter_workers()
+            return self._instrument(self._iter_processes())
+        return self._instrument(self._iter_workers())
+
+    @staticmethod
+    def _depth_metric():
+        """The ONE declaration of the queue-depth gauge (bind and retire
+        must target the same registration)."""
+        from .. import monitor
+
+        return monitor.gauge(
+            "paddle_tpu_dataloader_queue_depth",
+            "prefetched batches in flight (producer lead over the "
+            "consumer) per live loader", ("loader",))
+
+    def _depth_gauge(self):
+        """Per-loader bound queue-depth gauge, or None when the monitor
+        is off."""
+        from .. import monitor
+
+        if not monitor.enabled():
+            return None
+        return self._depth_metric().labels(loader=self._monitor_id)
+
+    def _retire_depth_gauge(self, depth):
+        """Drop this loader's depth series when iteration ends so dead
+        loaders don't export a stale depth forever."""
+        if depth is None:
+            return
+        try:
+            self._depth_metric().remove(loader=self._monitor_id)
+        except Exception:
+            pass
+
+    def _instrument(self, it):
+        """Monitor shim: time spent blocked in ``next()`` is exactly the
+        step's input-starvation time (host work between batches is the
+        caller's). Off-monitor cost: one enabled() check per epoch."""
+        from .. import monitor
+
+        if not monitor.enabled():
+            return it
+        wait = monitor.histogram(
+            "paddle_tpu_dataloader_wait_seconds",
+            "time the consumer blocked waiting for the next batch "
+            "(input-pipeline starvation)")
+        batches = monitor.counter(
+            "paddle_tpu_dataloader_batches_total",
+            "batches delivered by DataLoader iterators")
+
+        def gen():
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                wait.observe(time.perf_counter() - t0)
+                batches.inc()
+                yield batch
+
+        return gen()
 
     def _iter_single(self):
         for indices in self.batch_sampler:
@@ -235,10 +302,13 @@ class DataLoader:
                                         self.collate_fn))
             futures.put(None)
 
+        depth = self._depth_gauge()
         t = threading.Thread(target=submitter, daemon=True)
         t.start()
         try:
             while True:
+                if depth is not None:
+                    depth.set(futures.qsize())
                 fut = futures.get()
                 if fut is None:
                     return
@@ -246,6 +316,7 @@ class DataLoader:
         finally:
             stop.set()
             pool.shutdown(wait=False, cancel_futures=True)
+            self._retire_depth_gauge(depth)
 
     def _start_method(self) -> str:
         """fork is cheapest, but forking after the JAX backend has live
@@ -336,6 +407,7 @@ class DataLoader:
             index_q.put((bidx, list(indices)))
             sent += 1
 
+        depth = self._depth_gauge()
         try:
             for _ in range(max_inflight):
                 if done_sending:
@@ -344,6 +416,8 @@ class DataLoader:
             reorder = {}
             nxt = 0
             while nxt < sent or not done_sending:
+                if depth is not None:
+                    depth.set(sent - nxt)
                 if nxt in reorder:
                     data, err = reorder.pop(nxt)
                 else:
@@ -389,6 +463,7 @@ class DataLoader:
             for q_ in (index_q, result_q):
                 q_.cancel_join_thread()
                 q_.close()
+            self._retire_depth_gauge(depth)
 
     def __call__(self):
         return self.__iter__()

@@ -124,7 +124,14 @@ class StaticFunction:
         if cached is None:
             meta: Dict[str, Any] = {}
             pure = self._make_pure(treedef, len(t_idx), const_leaves, training, meta)
-            cached = (jax.jit(pure), meta)
+            from .. import monitor
+
+            # monitored_jit: recompiles of a to_static program show up in
+            # paddle_tpu_jit_cache_miss_total{fn=<function name>}
+            cached = (monitor.monitored_jit(
+                pure,
+                name="to_static:" + getattr(self._raw_fn, "__name__",
+                                            "fn")), meta)
             self._jit_cache[ck] = cached
         jitted, meta = cached
 

@@ -1,0 +1,745 @@
+"""paddle_tpu.monitor — always-on, low-overhead runtime metrics.
+
+The profiler (``paddle_tpu.profiler``) answers "where did this traced
+window go" with spans; THIS package answers "what is the framework doing
+right now" with a process-wide metrics registry (reference analog: the
+profiler_statistic.py aggregate tables + Paddle's monitor/stat registry,
+paddle/fluid/platform/monitor.h StatRegistry — but pull-based and cheap
+enough to leave on in production serving).
+
+Three instrument kinds, all label-aware and lock-protected:
+
+- :class:`Counter` — monotonically increasing (ops dispatched, tokens
+  generated, jit cache misses);
+- :class:`Gauge` — point-in-time value, settable or computed at collect
+  time via :func:`register_callback` (HBM bytes, KV-page occupancy,
+  dataloader queue depth);
+- :class:`Histogram` — bucketed distribution with sum/count (op latency,
+  step time, dataloader wait, admission latency).
+
+Cost model: every mutating call checks one module-level bool first, so
+with ``FLAGS_enable_monitor`` off the instrumented hot paths pay a
+branch and nothing else — and the per-op hook is NOT installed at all
+(``core.op_hooks.op_span_hook`` stays ``None`` unless the profiler owns
+it). Collection (:func:`snapshot`, :func:`render_prometheus`,
+:func:`write_jsonl`) is pull-based: callback gauges (device memory,
+live-array bytes) are only evaluated when someone asks.
+
+Enable via ``FLAGS_enable_monitor=1`` in the environment,
+``paddle_tpu.set_flags({"FLAGS_enable_monitor": True})``, or
+:func:`enable` / :func:`disable` here.
+
+Export surfaces:
+
+- :func:`snapshot` — nested dict (name → type/help/samples);
+- :func:`render_prometheus` — Prometheus text exposition format 0.0.4;
+- :func:`write_jsonl` — one ``{"metric":…, "value":…, "labels":…}``
+  line per sample, the same shape as the ``BENCH_*.json`` trajectory
+  records, so bench tooling reads both;
+- :func:`start_http_server` — stdlib ThreadingHTTPServer serving
+  ``/metrics`` (Prometheus) and ``/metrics.json`` (snapshot).
+"""
+from __future__ import annotations
+
+import bisect
+import functools
+import itertools
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram",
+    "counter", "gauge", "histogram", "register_callback",
+    "enable", "disable", "enabled",
+    "snapshot", "render_prometheus", "write_jsonl", "reset",
+    "start_http_server", "monitored_jit", "instance_label",
+    "install_op_hook", "uninstall_op_hook",
+]
+
+from ..core import op_hooks as _op_hooks  # dependency-free leaf module
+
+_instance_counters: Dict[str, "itertools.count"] = {}
+_instance_lock = threading.Lock()
+
+
+def instance_label(prefix: str) -> str:
+    """Process-unique label value for one instrument-owning instance
+    (``pool0``, ``loader3``, ``engine1`` …) — the shared idiom for
+    gauges that would otherwise be clobbered across instances. Owners
+    should ``remove()`` their series when the instance retires."""
+    with _instance_lock:
+        c = _instance_counters.setdefault(prefix, itertools.count())
+        return f"{prefix}{next(c)}"
+
+_lock = threading.RLock()
+_REGISTRY: Dict[str, "_MetricBase"] = {}
+_CALLBACKS: Dict[str, Tuple[str, Callable[[], Any]]] = {}
+_enabled = False  # synced from FLAGS_enable_monitor below
+
+# default buckets span sub-µs op dispatch to multi-second compiles
+DEFAULT_BUCKETS = (
+    1e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def _label_key(labelnames: Sequence[str], labels: Dict[str, str]
+               ) -> Tuple[str, ...]:
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f"labels {sorted(labels)} do not match declared labelnames "
+            f"{sorted(labelnames)}")
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+class _MetricBase:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    # -- labels ------------------------------------------------------------
+    def labels(self, **labels):
+        return _Bound(self, _label_key(self.labelnames, labels))
+
+    def _unlabeled(self) -> Tuple[str, ...]:
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} declares labels {self.labelnames}; use "
+                f".labels(...)")
+        return ()
+
+    def remove(self, **labels) -> None:
+        """Drop one label combination's series (idempotent) — owners of
+        per-instance labels retire them here so dead instances don't
+        export stale values forever."""
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._values.pop(key, None)
+
+    def clear(self):
+        raise NotImplementedError
+
+
+class _Bound:
+    """A metric bound to one label-value combination; proxies the
+    mutators so call sites read ``m.labels(op="matmul").observe(dt)``."""
+
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, metric, key):
+        self._m = metric
+        self._key = key
+
+    def inc(self, amount: float = 1.0):
+        self._m._inc(self._key, amount)
+
+    def dec(self, amount: float = 1.0):
+        self._m._inc(self._key, -amount)
+
+    def set(self, value: float):
+        self._m._set(self._key, value)
+
+    def observe(self, value: float):
+        self._m._observe(self._key, value)
+
+    @property
+    def value(self):
+        return self._m._get(self._key)
+
+
+class Counter(_MetricBase):
+    kind = "counter"
+
+    def __init__(self, name, help_="", labelnames=()):
+        super().__init__(name, help_, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def _inc(self, key, amount):
+        if amount < 0:
+            # validate BEFORE the enabled fast-path: a negative inc is a
+            # call-site bug and must fail identically whether the
+            # monitor is on or off (not only once ops enable it)
+            raise ValueError(f"counter {self.name} cannot decrease")
+        if not _enabled:
+            return
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def inc(self, amount: float = 1.0):
+        self._inc(self._unlabeled(), amount)
+
+    def _get(self, key):
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    @property
+    def value(self) -> float:
+        return self._get(self._unlabeled())
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+    def _samples(self):
+        with self._lock:
+            return [(k, v) for k, v in self._values.items()]
+
+
+class Gauge(_MetricBase):
+    kind = "gauge"
+
+    def __init__(self, name, help_="", labelnames=()):
+        super().__init__(name, help_, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def _set(self, key, value):
+        if not _enabled:
+            return
+        with self._lock:
+            self._values[key] = float(value)
+
+    def _inc(self, key, amount):
+        if not _enabled:
+            return
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def set(self, value: float):
+        self._set(self._unlabeled(), value)
+
+    def inc(self, amount: float = 1.0):
+        self._inc(self._unlabeled(), amount)
+
+    def dec(self, amount: float = 1.0):
+        self._inc(self._unlabeled(), -amount)
+
+    def _get(self, key):
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    @property
+    def value(self) -> float:
+        return self._get(self._unlabeled())
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+    def _samples(self):
+        with self._lock:
+            return [(k, v) for k, v in self._values.items()]
+
+
+class Histogram(_MetricBase):
+    kind = "histogram"
+
+    def __init__(self, name, help_="", labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_, labelnames)
+        self.buckets = tuple(sorted(buckets))
+        # key -> [bucket_counts(list, len(buckets)+1 incl +Inf), sum, count]
+        self._values: Dict[Tuple[str, ...], list] = {}
+
+    def _observe(self, key, value):
+        if not _enabled:
+            return
+        value = float(value)
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                st = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._values[key] = st
+            # bisect over the sorted bounds: buckets[i-1] < v <= buckets[i]
+            st[0][bisect.bisect_left(self.buckets, value)] += 1
+            st[1] += value
+            st[2] += 1
+
+    def observe(self, value: float):
+        self._observe(self._unlabeled(), value)
+
+    def _get(self, key):
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                return {"count": 0, "sum": 0.0, "buckets": {}}
+            cum = 0
+            buckets = {}
+            for i, ub in enumerate(self.buckets):
+                cum += st[0][i]
+                buckets[ub] = cum
+            return {"count": st[2], "sum": st[1], "buckets": buckets}
+
+    @property
+    def value(self):
+        return self._get(self._unlabeled())
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+    def _samples(self):
+        with self._lock:
+            keys = list(self._values)
+        return [(k, self._get(k)) for k in keys]
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def _get_or_create(cls, name, help_, labelnames, **kw):
+    with _lock:
+        m = _REGISTRY.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            if tuple(labelnames) != m.labelnames:
+                raise ValueError(
+                    f"metric {name!r} registered with labelnames "
+                    f"{m.labelnames}, requested {tuple(labelnames)}")
+            return m
+        m = cls(name, help_, labelnames, **kw)
+        _REGISTRY[name] = m
+        return m
+
+
+def counter(name: str, help_: str = "", labelnames: Sequence[str] = ()
+            ) -> Counter:
+    return _get_or_create(Counter, name, help_, labelnames)
+
+
+def gauge(name: str, help_: str = "", labelnames: Sequence[str] = ()
+          ) -> Gauge:
+    return _get_or_create(Gauge, name, help_, labelnames)
+
+
+def histogram(name: str, help_: str = "", labelnames: Sequence[str] = (),
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return _get_or_create(Histogram, name, help_, labelnames,
+                          buckets=buckets)
+
+
+def register_callback(name: str, help_: str,
+                      fn: Callable[[], Any]) -> None:
+    """Register a pull-time gauge: ``fn`` runs at collect time and
+    returns either a scalar or a list of ``(labels_dict, value)``.
+    Exceptions inside ``fn`` drop that metric from the collection (a
+    broken probe must not take snapshot() down with it)."""
+    with _lock:
+        _CALLBACKS[name] = (help_, fn)
+
+
+def reset() -> None:
+    """Zero every registered metric's values (the metric objects and
+    callbacks stay registered — instrument modules hold references)."""
+    with _lock:
+        for m in _REGISTRY.values():
+            m.clear()
+
+
+# -- enable / disable -------------------------------------------------------
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _sync_enabled(value: bool) -> None:
+    """Flag push target (framework.flags.set_flags) — flips the fast-path
+    bool and installs/uninstalls the per-op hook."""
+    global _enabled
+    _enabled = bool(value)
+    if _enabled:
+        install_op_hook()
+    else:
+        uninstall_op_hook()
+
+
+def enable() -> None:
+    """Turn the monitor on (equivalent to
+    ``set_flags({"FLAGS_enable_monitor": True})``)."""
+    from ..framework.flags import set_flags
+
+    set_flags({"FLAGS_enable_monitor": True})
+
+
+def disable() -> None:
+    from ..framework.flags import set_flags
+
+    set_flags({"FLAGS_enable_monitor": False})
+
+
+# -- per-op instrumentation (core.op_hooks choke point) ---------------------
+
+_op_hist: Optional[Histogram] = None
+_op_children: Dict[str, _Bound] = {}  # op name -> bound series (fast path)
+_chained_prev: Optional[Callable[[str, int, int], None]] = None
+_in_chain = False  # True while _op_span_hook is reachable from the slot
+
+
+def _op_span_hook(name: str, start_ns: int, end_ns: int) -> None:
+    prev = _chained_prev
+    if _enabled:
+        if _op_hist is not None:
+            # cache the bound series per op: this runs on EVERY eager
+            # dispatch, so skip labels()'s set comparison + allocations
+            child = _op_children.get(name)
+            if child is None:
+                child = _op_children.setdefault(
+                    name, _op_hist.labels(op=name))
+            child.observe((end_ns - start_ns) / 1e9)
+    else:
+        # disabled but still dispatched: either a profiler stop()
+        # restored us into the slot after disable() couldn't reach it
+        # (self-evict now so the state converges to a hook-free slot),
+        # or we are still buried under a live profiler (forward only —
+        # don't pay an uninstall attempt per op until we CAN evict).
+        if _op_hooks.op_span_hook is _op_span_hook:
+            uninstall_op_hook()  # prev was captured above: event still
+    if prev is not None:         # reaches the chain below us
+        prev(name, start_ns, end_ns)
+
+
+def install_op_hook() -> None:
+    """Install the per-op latency histogram on the apply_op choke point,
+    chaining to whatever hook was already there (the profiler chains the
+    same way, so spans and histograms fan out from one dispatch).
+
+    Idempotent via ``_in_chain``: once our hook is reachable from the
+    slot — even buried under a profiler hook that captured it as its
+    prev — installing again must be a no-op, or we would chain to a
+    hook that already chains to us and every op dispatch would recurse
+    forever."""
+    global _op_hist, _chained_prev, _in_chain
+    from ..core import op_hooks
+
+    if _in_chain or op_hooks.op_span_hook is _op_span_hook:
+        return
+    if _op_hist is None:
+        _op_hist = histogram(
+            "paddle_tpu_op_latency_seconds",
+            "eager apply_op dispatch latency (host wall time) per op",
+            ("op",))
+    _chained_prev = op_hooks.skip_dead(op_hooks.op_span_hook)
+    op_hooks.op_span_hook = _op_span_hook
+    _in_chain = True
+
+
+def uninstall_op_hook() -> None:
+    """Remove the monitor hook when the slot is ours. If another
+    consumer (the profiler) installed on top of us, the CHAIN is left
+    intact — our hook no-ops while disabled, keeps forwarding to the
+    hook below, and a later enable() just flips the bool back
+    (``_in_chain`` stays True so no second copy is ever chained in)."""
+    global _chained_prev, _in_chain
+    from ..core import op_hooks
+
+    if op_hooks.op_span_hook is _op_span_hook:
+        # restore the chain below us, minus hooks from profiler windows
+        # that stopped while we sat on top of them (they are inert but
+        # restoring one would leave the slot non-None forever)
+        op_hooks.op_span_hook = op_hooks.skip_dead(_chained_prev)
+        _chained_prev = None
+        _in_chain = False
+
+
+# -- jit compile tracker ----------------------------------------------------
+
+
+def monitored_jit(fn: Optional[Callable] = None, *, name: Optional[str] = None,
+                  **jit_kwargs):
+    """``jax.jit`` wrapper that counts cache misses and compile seconds.
+
+    A miss is detected by the traced body actually running (jax only
+    re-enters the Python function when the (shape, dtype, static-arg)
+    signature is new); the wall time of that call — trace + lower +
+    compile — is charged to ``paddle_tpu_jit_compile_seconds_total``.
+    Cache hits pay one bool check over plain ``jax.jit``. Usable as a
+    decorator or called directly; ``name`` labels the metrics (defaults
+    to the function's __name__)."""
+    def wrap(fn):
+        import jax
+
+        label = name or getattr(fn, "__name__", "jit")
+        # thread-local: jax traces in the CALLING thread, so per-thread
+        # flags keep concurrent servers from cross-attributing misses
+        missed = threading.local()
+
+        @functools.wraps(fn)
+        def traced(*a, **k):
+            missed.flag = True
+            return fn(*a, **k)
+
+        jitted = jax.jit(traced, **jit_kwargs)
+
+        @functools.wraps(fn)
+        def call(*a, **k):
+            if not _enabled:
+                return jitted(*a, **k)
+            missed.flag = False
+            t0 = time.perf_counter()
+            out = jitted(*a, **k)
+            if missed.flag:
+                dt = time.perf_counter() - t0
+                counter("paddle_tpu_jit_cache_miss_total",
+                        "jit traces+compiles (cache misses) per entry "
+                        "point", ("fn",)).labels(fn=label).inc()
+                counter("paddle_tpu_jit_compile_seconds_total",
+                        "wall seconds spent tracing+compiling per entry "
+                        "point", ("fn",)).labels(fn=label).inc(dt)
+            return out
+
+        call._jitted = jitted  # escape hatch: .lower / cache inspection
+        return call
+
+    return wrap(fn) if fn is not None else wrap
+
+
+# -- built-in callback gauges: HBM / live arrays ----------------------------
+
+
+def _collect_memory():
+    """Device memory samples: XLA allocator stats per device (TPU/GPU).
+    ``memory_stats()`` is None on CPU backends — there the live-array
+    total stands in (kind="live_array_bytes"), so the metric is never
+    empty and dashboards work unchanged across backends."""
+    import jax
+
+    out = []
+    for d in jax.local_devices():
+        ms = d.memory_stats() or {}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in ms:
+                out.append(({"device": f"{d.platform}:{d.id}", "kind": k},
+                            float(ms[k])))
+    if not out:
+        out.append(({"device": "host", "kind": "live_array_bytes"},
+                    _collect_live_bytes()))
+    return out
+
+
+_live_bytes_memo = [0.0, -1.0]  # (value, monotonic ts)
+
+
+def _collect_live_bytes():
+    """Σ nbytes over live jax.Arrays, memoized for 200ms: one snapshot
+    evaluates this for both paddle_tpu_live_array_bytes and the CPU
+    hbm_bytes fallback, and the O(live arrays) walk should run once per
+    scrape, not once per metric."""
+    import jax
+
+    now = time.monotonic()
+    if now - _live_bytes_memo[1] > 0.2:
+        _live_bytes_memo[0] = float(
+            sum(a.nbytes for a in jax.live_arrays()))
+        _live_bytes_memo[1] = now
+    return _live_bytes_memo[0]
+
+
+register_callback(
+    "paddle_tpu_hbm_bytes",
+    "XLA allocator stats per local device (absent on CPU backends)",
+    _collect_memory)
+register_callback(
+    "paddle_tpu_live_array_bytes",
+    "total bytes of live jax.Arrays in this process (HBM high-water "
+    "proxy that also works on CPU)",
+    _collect_live_bytes)
+
+
+# -- collection / export ----------------------------------------------------
+
+
+def _callback_samples():
+    out = {}
+    with _lock:
+        cbs = list(_CALLBACKS.items())
+    for name, (help_, fn) in cbs:
+        try:
+            val = fn()
+        except Exception:
+            continue  # a broken probe must not break collection
+        if isinstance(val, (int, float)):
+            samples = [({}, float(val))]
+        else:
+            samples = [(dict(lbl), float(v)) for lbl, v in val]
+        out[name] = (help_, samples)
+    return out
+
+
+def snapshot() -> Dict[str, Any]:
+    """One coherent read of every metric: ``{"ts": …, "metrics": {name:
+    {"type", "help", "samples": [{"labels", …}]}}}``. Histograms carry
+    count/sum/mean and cumulative buckets per sample."""
+    metrics: Dict[str, Any] = {}
+    with _lock:
+        regs = list(_REGISTRY.items())
+    for name, m in regs:
+        samples = []
+        for key, val in m._samples():
+            labels = dict(zip(m.labelnames, key))
+            if m.kind == "histogram":
+                samples.append({
+                    "labels": labels, "count": val["count"],
+                    "sum": val["sum"],
+                    "mean": (val["sum"] / val["count"]
+                             if val["count"] else 0.0),
+                    "buckets": {str(k): v
+                                for k, v in val["buckets"].items()},
+                })
+            else:
+                samples.append({"labels": labels, "value": val})
+        metrics[name] = {"type": m.kind, "help": m.help,
+                         "samples": samples}
+    for name, (help_, samples) in _callback_samples().items():
+        metrics[name] = {
+            "type": "gauge", "help": help_,
+            "samples": [{"labels": lbl, "value": v}
+                        for lbl, v in samples],
+        }
+    return {"ts": time.time(), "metrics": metrics}
+
+
+def _prom_escape(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _prom_labels(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_prom_escape(v)}"' for k, v in labels.items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition format 0.0.4 of the full registry."""
+    snap = snapshot()
+    lines: List[str] = []
+    for name, meta in sorted(snap["metrics"].items()):
+        # HELP escaping per exposition format 0.0.4: \ and newline only
+        help_ = str(meta["help"]).replace("\\", r"\\").replace("\n",
+                                                               r"\n")
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {meta['type']}")
+        for s in meta["samples"]:
+            if meta["type"] == "histogram":
+                for le, n in s["buckets"].items():
+                    le_lbl = 'le="%s"' % le
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_prom_labels(s['labels'], le_lbl)} {n}")
+                inf_lbl = 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_prom_labels(s['labels'], inf_lbl)}"
+                    f" {s['count']}")
+                lines.append(f"{name}_sum{_prom_labels(s['labels'])}"
+                             f" {_fmt(s['sum'])}")
+                lines.append(f"{name}_count{_prom_labels(s['labels'])}"
+                             f" {s['count']}")
+            else:
+                lines.append(f"{name}{_prom_labels(s['labels'])}"
+                             f" {_fmt(s['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+_UNIT_SUFFIXES = (
+    ("_seconds_total", "s"), ("_seconds", "s"), ("_bytes", "bytes"),
+    ("_per_sec", "1/s"), ("_ratio", "ratio"), ("_total", "count"),
+)
+
+
+def _unit_for(name: str) -> Optional[str]:
+    for suffix, unit in _UNIT_SUFFIXES:
+        if name.endswith(suffix):
+            return unit
+    return None
+
+
+def write_jsonl(path: str, extra: Optional[Dict[str, Any]] = None) -> int:
+    """Append one JSON line per sample to ``path`` — the same
+    ``{"metric": …, "value": …, "unit": …}`` record shape the BENCH_*
+    trajectory uses, plus ``labels`` and the snapshot timestamp.
+    Histograms emit their count/sum/mean. Returns lines written."""
+    snap = snapshot()
+    n = 0
+    with open(path, "a") as f:
+        for name, meta in sorted(snap["metrics"].items()):
+            for s in meta["samples"]:
+                rec: Dict[str, Any] = {"metric": name, "ts": snap["ts"]}
+                if meta["type"] == "histogram":
+                    rec["value"] = s["mean"]
+                    rec["count"] = s["count"]
+                    rec["sum"] = s["sum"]
+                else:
+                    rec["value"] = s["value"]
+                unit = _unit_for(name)
+                if unit:
+                    rec["unit"] = unit
+                if s["labels"]:
+                    rec["labels"] = s["labels"]
+                if extra:
+                    rec.update(extra)
+                f.write(json.dumps(rec) + "\n")
+                n += 1
+    return n
+
+
+def start_http_server(port: int = 0, addr: str = "127.0.0.1"):
+    """Serve ``/metrics`` (Prometheus text) and ``/metrics.json``
+    (snapshot) on a daemon thread; returns the server (its bound port is
+    ``server.server_address[1]`` — port=0 picks a free one)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.startswith("/metrics.json"):
+                body = json.dumps(snapshot()).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/metrics"):
+                body = render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # no access-log spam on stderr
+            pass
+
+    server = ThreadingHTTPServer((addr, port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="paddle_tpu-monitor-http")
+    t.start()
+    return server
+
+
+# -- flag sync (import-time): FLAGS_enable_monitor may already be set via
+#    the environment; importing the monitor honors it ------------------------
+def _init_from_flags():
+    from ..framework.flags import get_flags
+
+    _sync_enabled(get_flags("FLAGS_enable_monitor")["FLAGS_enable_monitor"])
+
+
+_init_from_flags()

@@ -358,15 +358,23 @@ class TCPStore:
         return buf.raw[: out_len.value]
 
     def add(self, key: str, amount: int = 1) -> int:
+        if amount < 0:
+            # counters in this store are nonnegative BY CONTRACT: ADD's
+            # result rides the status channel, and the error space below
+            # is only distinguishable from counter values because real
+            # counts can never be negative. A negative amount could walk
+            # a counter into [-4, -1] and masquerade as an IO error.
+            raise ValueError(
+                f"TCPStore.add amount must be nonnegative, got {amount} "
+                "(counters start at 0 and only grow; negative results "
+                "are reserved for transport errors)")
         with self._mu:
             rc = int(self._lib.ts_add(self._c, key.encode(), amount))
         if rc < 0 and rc >= -4:
-            # ADD's result rides the status channel, so transport/server
-            # errors (-2 io, -3 over-cap key, -4 server exception) are
-            # only distinguishable because counters in this store are
-            # nonnegative (they start at 0; barrier/rank users add
-            # positive amounts). Returning them as counts would hand
-            # barrier code a bogus rank.
+            # transport/server errors (-2 io, -3 over-cap key, -4 server
+            # exception) — distinguishable from counts because counters
+            # are nonnegative (enforced above). Returning them as counts
+            # would hand barrier code a bogus rank.
             k = key if len(key) <= 64 else key[:61] + "..."
             raise OSError(f"TCPStore add({k!r}) failed: rc={rc}")
         return rc

@@ -108,6 +108,17 @@ def _sublane_plan(d: int, dtype, interpret: bool):
     ``PADDLE_TPU_FLASH_SUBLANE_FORCE=1`` applies the plan in interpret
     mode too — that is how the CPU suite exercises the pad/kpad numerics
     the device path will run.
+
+    PROCESS-LIFETIME BINDING: the env var is read at TRACE time and the
+    chosen mode is frozen into the cached jit program for each
+    (shape, dtype) signature. Changing ``PADDLE_TPU_FLASH_SUBLANE``
+    after a shape has compiled silently has NO effect on that shape for
+    the rest of the process, and two modes cannot coexist for the same
+    shape — set the env var before the first flash call and leave it.
+    When the monitor is enabled, every selection is recorded as
+    ``paddle_tpu_flash_sublane_mode_total{mode=...}`` so a mid-process
+    mismatch between the env var and the compiled programs is visible
+    in the metrics instead of silent.
     """
     force = os.environ.get("PADDLE_TPU_FLASH_SUBLANE_FORCE") == "1"
     if ((interpret and not force) or d % 128 == 0
@@ -117,7 +128,24 @@ def _sublane_plan(d: int, dtype, interpret: bool):
     if mode not in ("pad", "kpad", "fp32"):
         raise ValueError(
             f"PADDLE_TPU_FLASH_SUBLANE={mode!r}: expected pad|kpad|fp32")
+    _record_sublane_mode(mode)
     return mode, -(-d // 128) * 128
+
+
+def _record_sublane_mode(mode: str) -> None:
+    """Publish the sublane plan frozen into this trace (monitor label;
+    runs at trace time only, never per step)."""
+    try:
+        from .. import monitor
+
+        if monitor.enabled():
+            monitor.counter(
+                "paddle_tpu_flash_sublane_mode_total",
+                "flash-attention sublane plans frozen into compiled "
+                "programs, by mode (process-lifetime env binding)",
+                ("mode",)).labels(mode=mode).inc()
+    except Exception:  # metrics must never break a kernel trace
+        pass
 
 
 def _pad_d(x, dpad: int):

@@ -117,6 +117,15 @@ def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
         be written at position seq_lens-1 via PagedKVCache.write_tokens)
     Returns [B, H, D].
     """
+    if pltpu is None:
+        # the grid spec below needs jax.experimental.pallas.tpu even in
+        # interpret mode; without it the failure would be an opaque
+        # AttributeError on the None module
+        raise NotImplementedError(
+            "paged_decode_mha requires jax.experimental.pallas.tpu "
+            "(scalar-prefetch grid spec), which this jax build does not "
+            "provide — install a jax with TPU Pallas support (the CPU "
+            "interpret path uses the same grid spec)")
     b, h, d = q.shape
     hkv = k_pool.shape[2]
     if h % hkv:

@@ -218,14 +218,37 @@ class Profiler:
     def _install_op_hook(self):
         from ..core import op_hooks
 
-        self._prev_op_hook = op_hooks.op_span_hook
-        op_hooks.op_span_hook = lambda name, start, end: _recorder.record(
-            f"op::{name}", start, end)
+        # skip over hooks from dead profiler windows (stranded in the
+        # chain because a consumer installed on top before their stop())
+        prev = op_hooks.skip_dead(op_hooks.op_span_hook)
+        self._prev_op_hook = prev
+
+        def hook(name, start, end):
+            if hook.armed:  # per-window flag: stranded hooks stay dead
+                _recorder.record(f"op::{name}", start, end)
+            if prev is not None:  # fan out (e.g. monitor's op histogram)
+                prev(name, start, end)
+
+        hook.armed = True
+        hook.prev_hook = prev
+        self._own_hook = hook
+        op_hooks.op_span_hook = hook
 
     def _remove_op_hook(self):
         from ..core import op_hooks
 
-        op_hooks.op_span_hook = self._prev_op_hook
+        hook = getattr(self, "_own_hook", None)
+        if hook is not None:
+            hook.armed = False  # dead even if stranded in the chain
+        if op_hooks.op_span_hook is hook:
+            # prune: with nested windows our saved prev may itself be a
+            # hook that died while we were on top of it
+            op_hooks.op_span_hook = op_hooks.skip_dead(self._prev_op_hook)
+        # else: someone (the monitor) installed on top AFTER we armed —
+        # restoring our saved prev would silently rip them out. Leave the
+        # chain; this hook forwards but never records again, and later
+        # installs prune it when they capture their prev.
+        self._own_hook = None
         self._prev_op_hook = None
 
     def stop(self):

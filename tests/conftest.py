@@ -32,6 +32,12 @@ if not _ON_DEVICE:
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# jax 0.4.x: shard_map still lives in jax.experimental; tests import the
+# graduated name (`from jax import shard_map`) possibly before paddle_tpu
+# — whose __init__ installs a kwarg-translating alias — so make sure the
+# alias exists before any test module is collected.
+import paddle_tpu  # noqa: E402,F401  (installs the jax.shard_map alias)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

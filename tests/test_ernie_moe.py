@@ -7,6 +7,10 @@ with the ep mesh axis.
 import numpy as np
 import pytest
 
+# minutes-scale multi-device/parity suite on the CPU backend:
+# rides the slow tier (run with -m slow), not tier-1
+pytestmark = pytest.mark.slow
+
 import jax
 import paddle_tpu as paddle
 from paddle_tpu.distributed.topology import build_mesh, set_mesh

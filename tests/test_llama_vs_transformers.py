@@ -8,6 +8,10 @@ the same oracle.
 import numpy as np
 import pytest
 
+# minutes-scale multi-device/parity suite on the CPU backend:
+# rides the slow tier (run with -m slow), not tier-1
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.models import LlamaForCausalLM
 from paddle_tpu.models.llama import LlamaConfig

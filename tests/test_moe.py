@@ -4,6 +4,10 @@ ample capacity, capacity drop behavior, gates, training, ep-mesh parity."""
 import numpy as np
 import pytest
 
+# minutes-scale multi-device/parity suite on the CPU backend:
+# rides the slow tier (run with -m slow), not tier-1
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import paddle_tpu as paddle

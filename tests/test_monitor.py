@@ -1,0 +1,395 @@
+"""paddle_tpu.monitor tests: registry semantics (counter/gauge/histogram,
+labels, reset), Prometheus/JSONL export round-trip, the instrumented
+choke points (op hook, dataloader, paged KV cache, Model.fit callback,
+jit tracker), and the disabled-flag zero-overhead contract (no per-op
+callable installed, mutators no-op)."""
+import json
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+
+
+@pytest.fixture()
+def mon():
+    """Enabled + clean registry; always disabled again afterwards so the
+    profiler suite's `op_span_hook is None` assertions stay true."""
+    monitor.enable()
+    monitor.reset()
+    yield monitor
+    monitor.reset()
+    monitor.disable()
+
+
+class TestRegistry:
+    def test_counter_inc_and_labels(self, mon):
+        c = mon.counter("t_requests_total", "test", ("route",))
+        c.labels(route="a").inc()
+        c.labels(route="a").inc(2)
+        c.labels(route="b").inc(5)
+        assert c.labels(route="a").value == 3
+        assert c.labels(route="b").value == 5
+
+    def test_counter_monotonic(self, mon):
+        c = mon.counter("t_mono_total", "test")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_unlabeled_vs_labeled_mismatch(self, mon):
+        c = mon.counter("t_lbl_total", "test", ("x",))
+        with pytest.raises(ValueError):
+            c.inc()  # declared labels, used bare
+        with pytest.raises(ValueError):
+            c.labels(wrong="v").inc()  # wrong label name
+
+    def test_gauge_set_inc_dec(self, mon):
+        g = mon.gauge("t_depth", "test")
+        g.set(4)
+        g.inc()
+        g.dec(2)
+        assert g.value == 3
+
+    def test_histogram_buckets_sum_count(self, mon):
+        h = mon.histogram("t_lat_seconds", "test", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        val = h.value
+        assert val["count"] == 5
+        assert val["sum"] == pytest.approx(56.05)
+        # cumulative: <=0.1 → 1, <=1.0 → 3, <=10.0 → 4 (+Inf implicit 5)
+        assert val["buckets"][0.1] == 1
+        assert val["buckets"][1.0] == 3
+        assert val["buckets"][10.0] == 4
+
+    def test_get_or_create_returns_same_object(self, mon):
+        a = mon.counter("t_same_total", "test")
+        b = mon.counter("t_same_total", "other help ignored")
+        assert a is b
+
+    def test_kind_conflict_raises(self, mon):
+        mon.counter("t_conflict", "test")
+        with pytest.raises(TypeError):
+            mon.gauge("t_conflict", "test")
+
+    def test_labelnames_conflict_raises(self, mon):
+        mon.counter("t_lblconf_total", "test", ("a",))
+        with pytest.raises(ValueError):
+            mon.counter("t_lblconf_total", "test", ("b",))
+
+    def test_reset_zeroes_but_keeps_registration(self, mon):
+        c = mon.counter("t_reset_total", "test")
+        c.inc(7)
+        mon.reset()
+        assert c.value == 0
+        c.inc()  # the same object keeps working after reset
+        assert c.value == 1
+
+
+class TestDisabled:
+    def test_mutators_noop_when_disabled(self, mon):
+        c = mon.counter("t_off_total", "test")
+        g = mon.gauge("t_off_g", "test")
+        h = mon.histogram("t_off_h", "test")
+        monitor.disable()
+        c.inc()
+        g.set(9)
+        h.observe(1.0)
+        monitor.enable()
+        assert c.value == 0
+        assert g.value == 0
+        assert h.value["count"] == 0
+
+    def test_no_op_hook_when_disabled(self):
+        from paddle_tpu.core import op_hooks
+
+        monitor.disable()
+        assert op_hooks.op_span_hook is None
+        paddle.matmul(paddle.ones([4, 4]), paddle.ones([4, 4]))
+        assert op_hooks.op_span_hook is None
+
+    def test_flag_toggles_hook(self):
+        from paddle_tpu.core import op_hooks
+
+        paddle.set_flags({"FLAGS_enable_monitor": True})
+        try:
+            assert monitor.enabled()
+            assert op_hooks.op_span_hook is not None
+        finally:
+            paddle.set_flags({"FLAGS_enable_monitor": False})
+        assert not monitor.enabled()
+        assert op_hooks.op_span_hook is None
+
+
+class TestOpHook:
+    def test_op_latency_histogram_records(self, mon):
+        paddle.matmul(paddle.ones([8, 8]), paddle.ones([8, 8]))
+        snap = mon.snapshot()["metrics"]
+        samples = snap["paddle_tpu_op_latency_seconds"]["samples"]
+        mm = [s for s in samples if s["labels"]["op"] == "matmul"]
+        assert mm and mm[0]["count"] >= 1
+        assert mm[0]["sum"] > 0
+
+
+class TestJitTracker:
+    def test_cache_miss_counting(self, mon):
+        import jax.numpy as jnp
+
+        f = monitor.monitored_jit(lambda x: x + 1, name="t_f")
+        f(jnp.ones((2, 2)))
+        f(jnp.ones((2, 2)))       # cache hit: no new compile
+        f(jnp.ones((3, 3)))       # new shape: compile
+        snap = mon.snapshot()["metrics"]
+        miss = [s for s in
+                snap["paddle_tpu_jit_cache_miss_total"]["samples"]
+                if s["labels"]["fn"] == "t_f"]
+        assert miss[0]["value"] == 2
+        secs = [s for s in
+                snap["paddle_tpu_jit_compile_seconds_total"]["samples"]
+                if s["labels"]["fn"] == "t_f"]
+        assert secs[0]["value"] > 0
+
+
+PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'  # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r" [-+0-9.eEinfa]+$")                 # value (incl inf/nan)
+
+
+class TestExport:
+    def test_prometheus_text_parses(self, mon):
+        mon.counter("t_exp_total", "counts things", ("k",)).labels(
+            k="v 1").inc(3)
+        mon.gauge("t_exp_gauge", "a gauge").set(2.5)
+        mon.histogram("t_exp_seconds", "a histogram",
+                      buckets=(1.0,)).observe(0.5)
+        text = mon.render_prometheus()
+        seen_types = {}
+        for line in text.strip().splitlines():
+            if line.startswith("# TYPE"):
+                _, _, name, kind = line.split()
+                seen_types[name] = kind
+                continue
+            if line.startswith("#"):
+                continue
+            assert PROM_LINE.match(line), f"unparseable line: {line!r}"
+        assert seen_types["t_exp_total"] == "counter"
+        assert seen_types["t_exp_gauge"] == "gauge"
+        assert seen_types["t_exp_seconds"] == "histogram"
+        assert 't_exp_total{k="v 1"} 3' in text
+        # histogram contract: bucket lines + _sum + _count
+        assert 't_exp_seconds_bucket{le="1.0"} 1' in text
+        assert 't_exp_seconds_bucket{le="+Inf"} 1' in text
+        assert "t_exp_seconds_count 1" in text
+
+    def test_snapshot_shape(self, mon):
+        mon.counter("t_snap_total", "test").inc(2)
+        snap = mon.snapshot()
+        assert "ts" in snap
+        m = snap["metrics"]["t_snap_total"]
+        assert m["type"] == "counter"
+        assert m["samples"][0]["value"] == 2
+        # built-in callback gauge works on every backend
+        live = snap["metrics"]["paddle_tpu_live_array_bytes"]
+        assert live["samples"][0]["value"] >= 0
+
+    def test_jsonl_roundtrip(self, mon, tmp_path):
+        mon.counter("t_jsonl_total", "test", ("who",)).labels(
+            who="me").inc(4)
+        mon.histogram("t_jsonl_seconds", "test").observe(0.25)
+        path = str(tmp_path / "snap.jsonl")
+        n = mon.write_jsonl(path, extra={"run": "r1"})
+        assert n > 0
+        recs = [json.loads(line) for line in open(path)]
+        assert all("metric" in r and "ts" in r for r in recs)
+        ctr = [r for r in recs if r["metric"] == "t_jsonl_total"][0]
+        assert ctr["value"] == 4
+        assert ctr["labels"] == {"who": "me"}
+        assert ctr["unit"] == "count"
+        assert ctr["run"] == "r1"
+        hist = [r for r in recs if r["metric"] == "t_jsonl_seconds"][0]
+        assert hist["count"] == 1
+        assert hist["value"] == pytest.approx(0.25)  # mean
+        assert hist["unit"] == "s"
+
+    def test_monitor_report_cli_renders(self, mon, tmp_path):
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "tools"))
+        try:
+            import monitor_report
+        finally:
+            sys.path.pop(0)
+        mon.gauge("t_cli_bytes", "test").set(123)
+        path = str(tmp_path / "snap.jsonl")
+        mon.write_jsonl(path)
+        with open(path) as f:
+            records = monitor_report.load_jsonl(f)
+        out = monitor_report.render(records, filter_="t_cli")
+        assert "t_cli_bytes" in out and "123" in out
+
+    def test_http_server_endpoints(self, mon):
+        from urllib.request import urlopen
+
+        mon.counter("t_http_total", "test").inc()
+        server = mon.start_http_server(port=0)
+        try:
+            port = server.server_address[1]
+            with urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+                text = r.read().decode()
+            assert "t_http_total 1" in text
+            with urlopen(f"http://127.0.0.1:{port}/metrics.json") as r:
+                snap = json.load(r)
+            assert snap["metrics"]["t_http_total"]["samples"][0][
+                "value"] == 1
+        finally:
+            server.shutdown()
+
+
+class TestDataLoaderGauges:
+    def _loader(self, n=12, batch_size=4, **kw):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return np.full((4,), i, np.float32)
+
+        return DataLoader(DS(), batch_size=batch_size, **kw)
+
+    def test_wait_histogram_and_batch_counter(self, mon):
+        batches = list(self._loader())
+        assert len(batches) == 3
+        snap = mon.snapshot()["metrics"]
+        wait = snap["paddle_tpu_dataloader_wait_seconds"]["samples"][0]
+        assert wait["count"] == 3
+        total = snap["paddle_tpu_dataloader_batches_total"]["samples"][0]
+        assert total["value"] == 3
+
+    def test_thread_workers_report_queue_depth(self, mon):
+        batches = list(self._loader(num_workers=2,
+                                    use_shared_memory=False))
+        assert len(batches) == 3
+        snap = mon.snapshot()["metrics"]
+        assert "paddle_tpu_dataloader_queue_depth" in snap
+        wait = snap["paddle_tpu_dataloader_wait_seconds"]["samples"][0]
+        assert wait["count"] == 3
+
+    def test_disabled_records_nothing(self):
+        monitor.disable()
+        monitor.reset()
+        list(self._loader())
+        snap = monitor.snapshot()["metrics"]
+        m = snap.get("paddle_tpu_dataloader_batches_total")
+        assert m is None or not m["samples"]
+
+
+class TestPagedCacheGauges:
+    def test_occupancy_follows_ensure_and_free(self, mon):
+        from paddle_tpu.inference.paged_cache import PageAllocator
+
+        alloc = PageAllocator(num_pages=8, page_size=4, max_batch=2,
+                              max_pages=4)
+        pool = alloc.monitor_pool
+        pages = mon.gauge("paddle_tpu_kv_pages", "", ("pool", "state"))
+        assert pages.labels(pool=pool, state="free").value == 8
+        alloc.ensure(0, 10)  # 3 pages
+        assert pages.labels(pool=pool, state="free").value == 5
+        assert pages.labels(pool=pool, state="used").value == 3
+        occ = mon.gauge("paddle_tpu_kv_page_occupancy_ratio", "",
+                        ("pool",))
+        assert occ.labels(pool=pool).value == pytest.approx(3 / 8)
+        alloc.free_slot(0)
+        assert pages.labels(pool=pool, state="free").value == 8
+        assert occ.labels(pool=pool).value == 0.0
+
+    def test_two_pools_publish_independently(self, mon):
+        from paddle_tpu.inference.paged_cache import PageAllocator
+
+        a = PageAllocator(num_pages=8, page_size=4, max_batch=2,
+                          max_pages=4)
+        b = PageAllocator(num_pages=4, page_size=4, max_batch=2,
+                          max_pages=2)
+        a.ensure(0, 8)   # 2 of 8 pages
+        b.ensure(0, 4)   # 1 of 4 pages
+        occ = mon.gauge("paddle_tpu_kv_page_occupancy_ratio", "",
+                        ("pool",))
+        assert occ.labels(pool=a.monitor_pool).value == pytest.approx(
+            2 / 8)
+        assert occ.labels(pool=b.monitor_pool).value == pytest.approx(
+            1 / 4)
+
+
+@pytest.mark.slow
+class TestEndToEndAcceptance:
+    """ISSUE acceptance: snapshot() carries step throughput, jit compile
+    count, HBM bytes, dataloader wait, and KV-page occupancy after a
+    small Model.fit + paged-decode run on the CPU backend."""
+
+    def test_fit_and_paged_decode_populate_snapshot(self, mon):
+        from paddle_tpu import nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.io import Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                return (rng.randn(8).astype(np.float32),
+                        rng.randn(2).astype(np.float32))
+
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Linear(16, 2))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=opt.SGD(learning_rate=0.01,
+                              parameters=net.parameters()),
+            loss=nn.MSELoss())
+        model.fit(DS(), batch_size=4, epochs=1, verbose=0)
+
+        from paddle_tpu.inference.generation import (
+            GenerationConfig, PagedContinuousBatchingEngine)
+        from paddle_tpu.models import LlamaForCausalLM, llama_config
+
+        cfg = llama_config("tiny", num_hidden_layers=1)
+        eng = PagedContinuousBatchingEngine(
+            LlamaForCausalLM(cfg), max_batch=2, num_pages=16,
+            page_size=8, max_pages=8)
+        outs = eng.serve([np.array([[1, 2, 3]], np.int32),
+                          np.array([[4, 5]], np.int32)],
+                         GenerationConfig(max_new_tokens=4),
+                         segment_steps=2)
+        assert all(o.shape == (4,) for o in outs)
+
+        snap = mon.snapshot()["metrics"]
+        required = (
+            "paddle_tpu_train_throughput_samples_per_sec",  # throughput
+            "paddle_tpu_train_step_seconds",
+            "paddle_tpu_jit_cache_miss_total",              # compiles
+            "paddle_tpu_hbm_bytes",                         # HBM
+            "paddle_tpu_live_array_bytes",                  # HBM proxy
+            "paddle_tpu_dataloader_wait_seconds",           # starvation
+            "paddle_tpu_kv_page_occupancy_ratio",           # paged KV
+            "paddle_tpu_kv_admission_seconds",
+            "paddle_tpu_generated_tokens_total",
+        )
+        for name in required:
+            assert name in snap and snap[name]["samples"], name
+        tokens = snap["paddle_tpu_generated_tokens_total"]["samples"][0]
+        assert tokens["value"] >= 8  # 2 requests x 4 new tokens
+        req = {s["labels"]["event"]: s["value"]
+               for s in snap["paddle_tpu_requests_total"]["samples"]}
+        assert req == {"admitted": 2, "finished": 2}
+        # the whole registry still exports cleanly after a real run
+        text = mon.render_prometheus()
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                assert PROM_LINE.match(line), line

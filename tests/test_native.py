@@ -99,6 +99,21 @@ class TestTCPStore:
         client.close()
         master.close()
 
+    def test_add_rejects_negative_amount(self):
+        """Counters are nonnegative by contract — ADD's negative return
+        space is reserved for transport errors, so a negative amount
+        must be refused client-side before it can corrupt a counter
+        into the error range."""
+        master = native.TCPStore(is_master=True)
+        try:
+            assert master.add("nctr", 3) == 3
+            with pytest.raises(ValueError):
+                master.add("nctr", -1)
+            # the refused add did not touch the counter
+            assert master.add("nctr", 0) == 3
+        finally:
+            master.close()
+
     def test_barrier_pattern(self):
         """The reference's init_parallel_env barrier (parallel.py:1101):
         every rank add()s then wait()s for the count key."""

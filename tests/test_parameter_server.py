@@ -259,6 +259,84 @@ class TestFleetRoleFlow:
         assert "trainer 1 ok" in outs[2] + outs[3]
 
 
+class TestAutoPlaneFallback:
+    """PADDLE_PS_DATA_PLANE=auto when the native build is unavailable:
+    python-plane fallback ONLY for a local single-node group; every
+    other shape keeps the loud mixed-plane error."""
+
+    def _role_maker(self, eps, trainers=1, monkeypatch=None):
+        import paddle_tpu.distributed.fleet as fleet
+
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", eps)
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", str(trainers))
+        monkeypatch.delenv("POD_IP", raising=False)
+        return fleet.PaddleCloudRoleMaker(is_collective=False)
+
+    @pytest.fixture()
+    def fleet_auto_unavailable(self, monkeypatch):
+        """fleet with the native-build probe forced to 'unavailable' and
+        the plane env unset (auto)."""
+        import paddle_tpu.distributed.fleet as fleet
+
+        monkeypatch.delenv("PADDLE_PS_DATA_PLANE", raising=False)
+        monkeypatch.setattr(fleet._ps_plane, "_auto", "unavailable")
+        saved = fleet._fleet_state.get("role_maker")
+        yield fleet
+        fleet._fleet_state["role_maker"] = saved
+        fleet._ps_plane._auto = None
+
+    def test_local_single_node_falls_back_with_warning(
+            self, fleet_auto_unavailable, monkeypatch):
+        fleet = fleet_auto_unavailable
+        fleet._fleet_state["role_maker"] = self._role_maker(
+            "127.0.0.1:7001", monkeypatch=monkeypatch)
+        with pytest.warns(RuntimeWarning, match="python plane"):
+            srv_cls, _ = fleet._ps_plane()
+        assert "Native" not in srv_cls.__name__
+
+    def test_remote_single_server_still_raises(
+            self, fleet_auto_unavailable, monkeypatch):
+        fleet = fleet_auto_unavailable
+        fleet._fleet_state["role_maker"] = self._role_maker(
+            "some-remote-host.example:7001", monkeypatch=monkeypatch)
+        with pytest.raises(RuntimeError, match="native data plane"):
+            fleet._ps_plane()
+
+    def test_multi_trainer_still_raises(self, fleet_auto_unavailable,
+                                        monkeypatch):
+        fleet = fleet_auto_unavailable
+        fleet._fleet_state["role_maker"] = self._role_maker(
+            "127.0.0.1:7001", trainers=4, monkeypatch=monkeypatch)
+        with pytest.raises(RuntimeError, match="native data plane"):
+            fleet._ps_plane()
+
+    def test_multi_server_still_raises(self, fleet_auto_unavailable,
+                                       monkeypatch):
+        fleet = fleet_auto_unavailable
+        fleet._fleet_state["role_maker"] = self._role_maker(
+            "127.0.0.1:7001,127.0.0.1:7002", monkeypatch=monkeypatch)
+        with pytest.raises(RuntimeError, match="native data plane"):
+            fleet._ps_plane()
+
+    def test_malformed_empty_host_still_raises(
+            self, fleet_auto_unavailable, monkeypatch):
+        fleet = fleet_auto_unavailable
+        fleet._fleet_state["role_maker"] = self._role_maker(
+            ":7001", monkeypatch=monkeypatch)
+        with pytest.raises(RuntimeError, match="native data plane"):
+            fleet._ps_plane()
+
+    def test_hostname_counts_as_local(self, fleet_auto_unavailable,
+                                      monkeypatch):
+        fleet = fleet_auto_unavailable
+        fleet._fleet_state["role_maker"] = self._role_maker(
+            f"{socket.gethostname()}:7001", monkeypatch=monkeypatch)
+        with pytest.warns(RuntimeWarning, match="python plane"):
+            srv_cls, _ = fleet._ps_plane()
+        assert "Native" not in srv_cls.__name__
+
+
 class TestSaveRestore:
     def test_init_server_dirname_restores_tables(self, tmp_path,
                                                  monkeypatch):

@@ -15,6 +15,10 @@ Reference: meta_parallel/pipeline_parallel.py:372 (forward outputs held)
 import numpy as np
 import pytest
 
+# minutes-scale multi-device/parity suite on the CPU backend:
+# rides the slow tier (run with -m slow), not tier-1
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding

@@ -8,6 +8,10 @@ numerics stay identical to the serial model.
 import numpy as np
 import pytest
 
+# minutes-scale multi-device/parity suite on the CPU backend:
+# rides the slow tier (run with -m slow), not tier-1
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding

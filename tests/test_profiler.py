@@ -77,6 +77,105 @@ class TestCapture:
         p.stop()
         assert not p._events
 
+    def test_op_hook_fans_out_to_monitor(self):
+        """The apply_op choke point serves BOTH consumers at once: the
+        profiler records spans and chains to the monitor's histogram
+        hook installed underneath it."""
+        from paddle_tpu import monitor
+        from paddle_tpu.core import op_hooks
+
+        monitor.enable()
+        monitor.reset()
+        try:
+            p = Profiler()
+            p.start()
+            paddle.matmul(paddle.ones([8, 8]), paddle.ones([8, 8]))
+            p.stop()
+            # profiler saw the span...
+            assert any(e[0] == "op::matmul" for e in p._events)
+            # ...the profiler restored the monitor hook on stop...
+            assert op_hooks.op_span_hook is not None
+            # ...and the monitor histogram got the same dispatch
+            snap = monitor.snapshot()["metrics"]
+            samples = snap["paddle_tpu_op_latency_seconds"]["samples"]
+            mm = [s for s in samples if s["labels"]["op"] == "matmul"]
+            assert mm and mm[0]["count"] >= 1
+        finally:
+            monitor.reset()
+            monitor.disable()
+        assert op_hooks.op_span_hook is None
+
+    def test_reenable_under_profiler_does_not_cycle(self):
+        """Re-installing the monitor hook while a profiler hook (whose
+        chained prev IS the monitor hook) owns the slot must be a no-op
+        — chaining a second copy would recurse on every dispatch."""
+        from paddle_tpu import monitor
+
+        monitor.enable()
+        try:
+            p = Profiler()
+            p.start()
+            monitor.enable()   # idempotent re-enable mid-window
+            monitor.disable()  # can't leave the chain (profiler on top)
+            monitor.enable()   # ...and must not chain a second copy
+            paddle.tanh(paddle.ones([4]))  # RecursionError if cyclic
+            p.stop()
+            paddle.tanh(paddle.ones([4]))
+        finally:
+            monitor.disable()
+        from paddle_tpu.core import op_hooks
+
+        assert op_hooks.op_span_hook is None
+
+    def test_stranded_hook_does_not_double_count_later_windows(self):
+        """A profiler window that stops while the monitor sits on top
+        strands its hook in the chain; it must stay DEAD in later
+        windows (no duplicate spans) and be pruned when the monitor
+        restores the slot."""
+        from paddle_tpu import monitor
+        from paddle_tpu.core import op_hooks
+
+        p1 = Profiler()
+        p1.start()
+        monitor.enable()   # installs on top of p1's hook
+        try:
+            p1.stop()      # p1's hook is stranded under the monitor
+            p2 = Profiler()
+            p2.start()
+            paddle.matmul(paddle.ones([4, 4]), paddle.ones([4, 4]))
+            p2.stop()
+            names = [e[0] for e in p2._events]
+            assert names.count("op::matmul") == 1, names
+        finally:
+            monitor.disable()
+        # restore skipped the dead stranded hook: slot is empty again
+        assert op_hooks.op_span_hook is None
+
+    def test_profiler_stop_preserves_monitor_enabled_after_start(self):
+        """Monitor enabled AFTER the profiler armed: stop() must not rip
+        the monitor hook out of the slot (it only restores when the slot
+        still holds its own hook)."""
+        from paddle_tpu import monitor
+        from paddle_tpu.core import op_hooks
+
+        p = Profiler()
+        p.start()
+        monitor.enable()
+        monitor.reset()
+        try:
+            p.stop()
+            paddle.matmul(paddle.ones([4, 4]), paddle.ones([4, 4]))
+            snap = monitor.snapshot()["metrics"]
+            mm = [s for s in
+                  snap["paddle_tpu_op_latency_seconds"]["samples"]
+                  if s["labels"]["op"] == "matmul"]
+            assert mm and mm[0]["count"] >= 1
+        finally:
+            monitor.reset()
+            monitor.disable()
+        # disable() prunes the stranded dead profiler hook on restore
+        assert op_hooks.op_span_hook is None
+
 
 class TestExport:
     def test_chrome_trace_format(self, tmp_path):

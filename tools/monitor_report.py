@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Pretty-print paddle_tpu.monitor snapshots.
+
+Reads either a JSONL file written by ``monitor.write_jsonl()`` (or the
+BENCH_* trajectory — same record shape) or a live ``/metrics.json``
+endpoint started with ``monitor.start_http_server()``, and prints the
+latest value per (metric, labels) as an aligned table.
+
+Usage::
+
+    python tools/monitor_report.py run.jsonl            # file
+    python tools/monitor_report.py -                    # stdin
+    python tools/monitor_report.py --url http://127.0.0.1:8080
+    python tools/monitor_report.py run.jsonl --filter kv_   # substring
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e12:
+            return str(int(v))
+        if abs(v) >= 1e6 or (0 < abs(v) < 1e-3):
+            return f"{v:.4g}"
+        return f"{v:.4f}"
+    return str(v)
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+def load_jsonl(stream) -> List[dict]:
+    """Parse JSONL records, keeping the LATEST record per
+    (metric, labels) — a trajectory file holds many snapshots."""
+    latest: Dict[Tuple, dict] = {}
+    for line in stream:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # bench logs interleave free text with records
+        if "metric" not in rec:
+            continue
+        key = (rec["metric"],
+               tuple(sorted((rec.get("labels") or {}).items())))
+        latest[key] = rec
+    return [latest[k] for k in sorted(latest)]
+
+
+def load_snapshot(snap: dict) -> List[dict]:
+    """Flatten a monitor.snapshot() dict into jsonl-shaped records."""
+    out = []
+    for name, meta in sorted(snap.get("metrics", {}).items()):
+        for s in meta.get("samples", []):
+            rec = {"metric": name, "labels": s.get("labels") or {}}
+            if meta.get("type") == "histogram":
+                rec["value"] = s.get("mean", 0.0)
+                rec["count"] = s.get("count")
+                rec["sum"] = s.get("sum")
+            else:
+                rec["value"] = s.get("value")
+            out.append(rec)
+    return out
+
+
+def render(records: List[dict], filter_: str = "") -> str:
+    rows = []
+    for rec in records:
+        name = rec["metric"]
+        if filter_ and filter_ not in name:
+            continue
+        extra = ""
+        if "count" in rec and rec["count"] is not None:
+            extra = (f"n={rec['count']}"
+                     + (f" sum={_fmt_value(rec['sum'])}"
+                        if rec.get("sum") is not None else ""))
+        rows.append((name + _fmt_labels(rec.get("labels") or {}),
+                     _fmt_value(rec.get("value")),
+                     rec.get("unit", ""), extra))
+    if not rows:
+        return "(no metrics)"
+    w0 = max(len(r[0]) for r in rows)
+    w1 = max(len(r[1]) for r in rows)
+    lines = [f"{'METRIC':<{w0}}  {'VALUE':>{w1}}  UNIT",
+             "-" * (w0 + w1 + 12)]
+    for name, val, unit, extra in rows:
+        line = f"{name:<{w0}}  {val:>{w1}}  {unit}"
+        if extra:
+            line += f"  ({extra})"
+        lines.append(line.rstrip())
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", default=None,
+                    help="JSONL file from monitor.write_jsonl(), or '-' "
+                         "for stdin")
+    ap.add_argument("--url", default=None,
+                    help="base URL of a monitor HTTP endpoint (fetches "
+                         "<url>/metrics.json)")
+    ap.add_argument("--filter", default="", dest="filter_",
+                    metavar="SUBSTR", help="only metrics containing SUBSTR")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        from urllib.request import urlopen
+
+        url = args.url.rstrip("/")
+        if not url.endswith("/metrics.json"):
+            url += "/metrics.json"
+        with urlopen(url, timeout=10) as resp:
+            records = load_snapshot(json.load(resp))
+    elif args.path == "-" or args.path is None:
+        records = load_jsonl(sys.stdin)
+    else:
+        with open(args.path) as f:
+            records = load_jsonl(f)
+
+    print(render(records, args.filter_))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
