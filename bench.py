@@ -622,16 +622,16 @@ def ragged_bench():
             eng.add_request(
                 rng.randint(0, cfg_m.vocab_size, (n,)).astype(np.int32),
                 gcfg)
-        seg = eng._segment_fn(steps, gcfg)
+        seg = eng._segment_fn(steps)
         args = (eng.params, eng.last, eng.lens, eng.done_dev,
-                eng.active_dev, eng.caches)
+                eng.active_dev, eng.samp, eng.caches)
         key = jax.random.PRNGKey(0)
         out = seg(*args, key)                      # compile + warm
         _ = float(jnp.sum(out[0]))
         eng.caches = out[4]
         t0 = time.perf_counter()
         out = seg(eng.params, out[1], out[2], out[3], eng.active_dev,
-                  eng.caches, key)
+                  eng.samp, eng.caches, key)
         _ = float(jnp.sum(out[0]))
         dt = time.perf_counter() - t0
         return B * steps / dt

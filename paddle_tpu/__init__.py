@@ -106,6 +106,7 @@ _LAZY_SUBMODULES = {
     "optimizer",
     "profiler",
     "regularizer",
+    "serving",
     "sparse",
     "static",
     "utils",

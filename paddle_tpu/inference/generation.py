@@ -30,16 +30,59 @@ __all__ = ["GenerationConfig", "CausalLMEngine",
 
 
 class GenerationConfig:
+    """Per-request decoding parameters.
+
+    Validated at CONSTRUCTION: in online serving a config arrives from
+    the network per request, and a malformed one must be rejected at
+    admission (an HTTP 400), never crash a shared decode segment that
+    other requests are riding in.
+    """
+
     def __init__(self, max_new_tokens: int = 64, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, do_sample: bool = False,
                  eos_token_id: Optional[int] = None, seed: int = 0):
-        self.max_new_tokens = max_new_tokens
-        self.temperature = temperature
-        self.top_k = top_k
-        self.top_p = top_p
-        self.do_sample = do_sample
-        self.eos_token_id = eos_token_id
-        self.seed = seed
+        INT32_MAX = 2 ** 31 - 1   # engine state is int32 on device; a
+        #                           larger value must fail HERE, not
+        #                           leak a slot mid-admission
+        if (isinstance(max_new_tokens, bool)
+                or not isinstance(max_new_tokens, (int, np.integer))
+                or not 1 <= max_new_tokens <= INT32_MAX):
+            raise ValueError(
+                f"max_new_tokens must be an int in [1, 2**31), got "
+                f"{max_new_tokens!r}")
+        if not (isinstance(temperature, (int, float, np.floating))
+                and temperature > 0):
+            # `not (x > 0)` also rejects NaN
+            raise ValueError(
+                f"temperature must be > 0, got {temperature!r}")
+        if (isinstance(top_k, bool)
+                or not isinstance(top_k, (int, np.integer))
+                or not 0 <= top_k <= INT32_MAX):
+            raise ValueError(
+                f"top_k must be an int in [0, 2**31) (0 disables), got "
+                f"{top_k!r}")
+        if not (isinstance(top_p, (int, float, np.floating))
+                and 0 < top_p <= 1):
+            raise ValueError(
+                f"top_p must satisfy 0 < top_p <= 1, got {top_p!r}")
+        if eos_token_id is not None and (
+                isinstance(eos_token_id, bool)
+                or not isinstance(eos_token_id, (int, np.integer))
+                or not 0 <= eos_token_id <= INT32_MAX):
+            raise ValueError(
+                f"eos_token_id must be an int in [0, 2**31) or None, "
+                f"got {eos_token_id!r}")
+        if isinstance(seed, bool) or not isinstance(seed,
+                                                   (int, np.integer)):
+            raise ValueError(f"seed must be an int, got {seed!r}")
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.do_sample = bool(do_sample)
+        self.eos_token_id = (None if eos_token_id is None
+                             else int(eos_token_id))
+        self.seed = int(seed)
 
 
 def _sample(logits, key, cfg: GenerationConfig):
@@ -60,6 +103,58 @@ def _sample(logits, key, cfg: GenerationConfig):
                          keepdims=True)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _sample_rows(logits, key, samp):
+    """Per-ROW next-token choice from [B, V] logits: every sampling
+    parameter (greedy-vs-sample, temperature, top-k, top-p, eos) is a
+    per-slot device VECTOR installed at admission, not a trace constant
+    — so ONE compiled segment program serves any mix of per-request
+    GenerationConfigs (the continuous-batching engines' online form;
+    the old cfg-keyed specialization recompiled per distinct config).
+
+    Greedy rows reduce to the exact argmax `_sample` computes, so mixed
+    batches keep bitwise greedy parity with the dense engine. Rows with
+    top_k == 0 / top_p == 1.0 skip those filters (same gating as
+    `_sample`'s `if` branches, expressed as masks).
+
+    Each row draws from its OWN noise stream: the request's seed (a
+    per-slot vector) is folded into the shared per-step key, so a
+    request's sampled trajectory depends on ITS GenerationConfig.seed,
+    not on which other requests share the batch."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def drawn(_):
+        scaled = (logits.astype(jnp.float32)
+                  / jnp.maximum(samp["temp"], 1e-6)[:, None])
+        desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        k_eff = jnp.clip(samp["top_k"], 1, vocab)
+        kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
+        scaled = jnp.where((samp["top_k"] > 0)[:, None] & (scaled < kth),
+                           -jnp.inf, scaled)
+        # top-p runs over the top-k-FILTERED logits (_sample's order)
+        desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(desc2, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < samp["top_p"][:, None]
+        cutoff = jnp.min(jnp.where(keep, desc2, jnp.inf), axis=-1,
+                         keepdims=True)
+        scaled = jnp.where(
+            (samp["top_p"] < 1.0)[:, None] & (scaled < cutoff),
+            -jnp.inf, scaled)
+        keys = jax.vmap(lambda s: jax.random.fold_in(key, s))(
+            samp["seed"])
+        return jax.vmap(jax.random.categorical)(keys, scaled) \
+            .astype(jnp.int32)
+
+    # all-greedy batches (the do_sample=False default) skip the whole
+    # sort/softmax/cumsum pipeline at RUNTIME — lax.cond on a traced
+    # scalar executes one branch, so the single-program property holds
+    # while a greedy segment pays only the argmax
+    sampled = jax.lax.cond(jnp.any(samp["sample"]), drawn,
+                           lambda _: greedy, None)
+    return jnp.where(samp["sample"], sampled, greedy)
 
 
 def _prompt_ids(prompt):
@@ -278,6 +373,7 @@ class CausalLMEngine:
         ctx.append(out[0])
         pos = plen                      # tokens the CACHE holds
         forwards = 1                    # the prefill
+        extra = 0                       # emitted tokens beyond 1/forward
         eos = cfg.eos_token_id
         verify = self._spec_verify_fn(draft_k + 1)
         ngrams = _NgramIndex(ngram_max)
@@ -294,12 +390,14 @@ class CausalLMEngine:
             while m < draft_k and int(greedy[m]) == draft[m]:
                 m += 1
             accepted = draft[:m] + [int(greedy[m])]
+            before = len(out)
             for t in accepted:
                 out.append(t)
                 ctx.append(t)
                 if (len(out) >= cfg.max_new_tokens
                         or (eos is not None and t == eos)):
                     break
+            extra += len(out) - before - 1
             # cache gained [out_prev_last, accepted drafts]; the final
             # accepted token is the model's own pick, not yet cached
             pos += 1 + m
@@ -324,6 +422,14 @@ class CausalLMEngine:
         out = out[:budget]
         self.last_spec_stats = {"forwards": forwards,
                                 "tokens": len(out),
+                                # emitted draft/bonus tokens beyond the
+                                # one-per-forward floor: with eos=None
+                                # tokens == forwards + accepted exactly,
+                                # so speedup bars can be DERIVED from
+                                # the measured acceptance instead of
+                                # hard-coding an environment-dependent
+                                # tokens/forward threshold
+                                "accepted_draft_tokens": extra,
                                 "tokens_per_forward":
                                     len(out) / max(forwards, 1)}
         return np.concatenate([ids, np.asarray([out], np.int32)], axis=1)
@@ -347,7 +453,9 @@ class ContinuousBatchingEngine:
       pool), and finished rows are retired between segments — new work
       starts without waiting for the longest running request;
     - one compiled segment program serves every slot occupancy pattern
-      (slot ids and lengths are traced values, not shapes).
+      AND every mix of per-request GenerationConfigs (slot ids, lengths
+      and sampling parameters are traced values, not shapes or trace
+      constants — see ``_sample_rows``).
 
     Usage::
 
@@ -368,10 +476,22 @@ class ContinuousBatchingEngine:
         self.last = jnp.zeros((max_batch,), jnp.int32)
         self.done_dev = jnp.zeros((max_batch,), bool)
         self.active_dev = jnp.zeros((max_batch,), bool)
+        # per-slot SAMPLING vectors (see _sample_rows): each request's
+        # GenerationConfig is installed into its slot at admission, so
+        # one segment program serves mixed configs — eos -1 means none
+        self.samp = {
+            "temp": jnp.ones((max_batch,), jnp.float32),
+            "top_k": jnp.zeros((max_batch,), jnp.int32),
+            "top_p": jnp.ones((max_batch,), jnp.float32),
+            "sample": jnp.zeros((max_batch,), bool),
+            "eos": jnp.full((max_batch,), -1, jnp.int32),
+            "seed": jnp.zeros((max_batch,), jnp.int32),
+        }
         self._free = list(range(max_batch))
         self._slot_req = {}            # slot -> request id
         self._tokens = {}              # request id -> [generated ids]
         self._budget = {}              # request id -> remaining tokens
+        self._cfg = {}                 # request id -> GenerationConfig
         self._finished = {}            # request id -> np.ndarray
         self._next_req = 0
         self._segments_run = 0         # PRNG stream position for sampling
@@ -393,20 +513,30 @@ class ContinuousBatchingEngine:
         self._admit = monitor.monitored_jit(admit, name="cb_admit",
                                             donate_argnums=(0,))
 
-        def admit_state(lens, last, done, active, slot, plen, first,
-                        tok_done):
-            # one program for the four per-slot scalars — admission sits
-            # in the latency-critical gap between decode segments, and
-            # four separate .at[].set dispatches cost four host→device
-            # round-trips where this costs one
+        def admit_state(lens, last, done, active, samp, slot, plen,
+                        first, tok_done, temp, top_k, top_p, do_samp,
+                        eos, seed):
+            # one program for the per-slot scalars AND the request's
+            # sampling parameters — admission sits in the
+            # latency-critical gap between decode segments, and separate
+            # .at[].set dispatches would each cost a host→device
+            # round-trip where this costs one
+            samp = {
+                "temp": samp["temp"].at[slot].set(temp),
+                "top_k": samp["top_k"].at[slot].set(top_k),
+                "top_p": samp["top_p"].at[slot].set(top_p),
+                "sample": samp["sample"].at[slot].set(do_samp),
+                "eos": samp["eos"].at[slot].set(eos),
+                "seed": samp["seed"].at[slot].set(seed),
+            }
             return (lens.at[slot].set(plen),
                     last.at[slot].set(first),
                     done.at[slot].set(tok_done),
-                    active.at[slot].set(True))
+                    active.at[slot].set(True), samp)
 
         self._admit_state = monitor.monitored_jit(
             admit_state, name="cb_admit_state",
-            donate_argnums=(0, 1, 2, 3))
+            donate_argnums=(0, 1, 2, 3, 4))
         self._segment_cache = {}
 
     def _make_caches(self):
@@ -440,9 +570,30 @@ class ContinuousBatchingEngine:
         next inter-segment gap instead of raising mid-loop."""
         return True
 
+    def free_slots(self) -> int:
+        """Number of free cache slots right now. Public capacity probe
+        (with :meth:`can_admit`) for serving schedulers — callers must
+        not reach into the private ``_free`` list."""
+        return len(self._free)
+
+    def can_admit(self, prompt_len: int, cfg: GenerationConfig) -> bool:
+        """Non-raising admission probe: True iff ``add_request`` with a
+        ``prompt_len``-token prompt and ``cfg`` would succeed RIGHT NOW
+        (a free slot exists, the request fits ``max_len``, and — paged —
+        the page pool can reserve its worst case).
+
+        Contract: schedulers consult THIS and treat False as "defer to
+        the next inter-segment gap" (or reject with backpressure);
+        ``add_request`` raising is the programmer-error path for callers
+        that skipped the probe, not a control-flow signal."""
+        return (bool(self._free)
+                and prompt_len + cfg.max_new_tokens <= self.max_len
+                and self._can_admit(prompt_len, cfg))
+
     def add_request(self, prompt_ids, cfg: GenerationConfig) -> int:
         """Prefill one request into a free slot; returns the request id.
-        Raises if no slot is free (call decode_segment / collect first)."""
+        Raises if no slot is free (call decode_segment / collect first)
+        — probe :meth:`can_admit` to defer instead of catching."""
         if not self._free:
             raise RuntimeError("no free slot; drain with decode_segment()")
         t0 = time.perf_counter()
@@ -456,22 +607,36 @@ class ContinuousBatchingEngine:
             raise RuntimeError(
                 "page pool exhausted; drain with decode_segment()")
         slot = self._free.pop(0)
-        rid = self._next_req
-        self._next_req += 1
-        last_logits = self._admit_cache(slot, ids, plen, cfg)
-        key = jax.random.PRNGKey(cfg.seed + rid)
-        first = _sample(last_logits, key, cfg)[0]
-        tok_done = (jnp.asarray(False) if cfg.eos_token_id is None
-                    else first == cfg.eos_token_id)
-        # the four per-slot scalars update in ONE jitted program (shared
-        # by the dense and paged engines) instead of four dispatches
-        self.lens, self.last, self.done_dev, self.active_dev = \
-            self._admit_state(self.lens, self.last, self.done_dev,
-                              self.active_dev, jnp.int32(slot),
-                              jnp.int32(plen), first, tok_done)
+        try:
+            rid = self._next_req
+            self._next_req += 1
+            last_logits = self._admit_cache(slot, ids, plen, cfg)
+            key = jax.random.PRNGKey(cfg.seed + rid)
+            first = _sample(last_logits, key, cfg)[0]
+            tok_done = (jnp.asarray(False) if cfg.eos_token_id is None
+                        else first == cfg.eos_token_id)
+            # the per-slot scalars AND the request's sampling parameters
+            # update in ONE jitted program (shared by the dense and
+            # paged engines) instead of separate dispatches
+            eos = -1 if cfg.eos_token_id is None else cfg.eos_token_id
+            (self.lens, self.last, self.done_dev, self.active_dev,
+             self.samp) = self._admit_state(
+                self.lens, self.last, self.done_dev, self.active_dev,
+                self.samp, jnp.int32(slot), jnp.int32(plen), first,
+                tok_done, jnp.float32(cfg.temperature),
+                jnp.int32(cfg.top_k), jnp.float32(cfg.top_p),
+                jnp.asarray(cfg.do_sample), jnp.int32(eos),
+                jnp.int32(cfg.seed % (2 ** 31)))
+        except BaseException:
+            # a failed admission must not leak capacity: the popped
+            # slot (and, paged, any page reservation _admit_cache made)
+            # goes back to the pool before the error propagates
+            self._abort_admit(slot)
+            raise
         self._slot_req[slot] = rid
         self._tokens[rid] = [int(first)]
         self._budget[rid] = cfg.max_new_tokens - 1
+        self._cfg[rid] = cfg
         if bool(tok_done) or self._budget[rid] <= 0:
             self._retire(slot)
         if monitor.enabled():
@@ -501,37 +666,78 @@ class ContinuousBatchingEngine:
         self.caches = self._admit(self.caches, mini, jnp.int32(slot))
         return last_logits
 
-    def _retire(self, slot):
+    def _abort_admit(self, slot: int) -> None:
+        """Undo a failed admission's capacity claim (slot back to the
+        free list; the paged override also releases pages)."""
+        self._free.append(slot)
+        self._free.sort()
+
+    def _retire(self, slot, event: str = "finished"):
         rid = self._slot_req.pop(slot)
         self._finished[rid] = np.asarray(self._tokens.pop(rid), np.int32)
         del self._budget[rid]
+        self._cfg.pop(rid, None)
         self.active_dev = self.active_dev.at[slot].set(False)
+        # drop the slot's sampled flag so an all-greedy batch regains
+        # the _sample_rows fast path once sampled requests retire
+        self.samp["sample"] = self.samp["sample"].at[slot].set(False)
         self._free.append(slot)
         self._free.sort()
         if monitor.enabled():
             monitor.counter(
                 "paddle_tpu_requests_total",
                 "serving requests by lifecycle event",
-                ("event",)).labels(event="finished").inc()
+                ("event",)).labels(event=event).inc()
 
-    def _segment_fn(self, n_steps: int, cfg: GenerationConfig):
-        key_cfg = (n_steps, cfg.do_sample, cfg.temperature, cfg.top_k,
-                   cfg.top_p, cfg.eos_token_id)
-        if key_cfg not in self._segment_cache:
+    def cancel_request(self, rid: int):
+        """Cancel an ACTIVE request and reclaim its capacity: the slot
+        (and, paged, its pages) returns to the pool immediately and the
+        request never appears in ``collect_finished()``. Returns the
+        partial tokens generated so far (np.int32), or None when ``rid``
+        is not active (unknown, already finished, or already cancelled).
+
+        Call only from the thread driving the engine, BETWEEN decode
+        segments — the serving scheduler applies user ``cancel()`` flags
+        at the next inter-segment gap, which is what keeps cancelled
+        slots from leaking mid-segment."""
+        slot = next((s for s, r in self._slot_req.items() if r == rid),
+                    None)
+        if slot is None:
+            return None
+        out = np.asarray(self._tokens[rid], np.int32)
+        self._retire(slot, event="cancelled")
+        self._finished.pop(rid, None)
+        return out
+
+    def partial_tokens(self, rid: int, start: int = 0):
+        """Copy of the tokens generated so far for an ACTIVE request,
+        from position ``start`` (the token-streaming hook: schedulers
+        pass the count they already pushed so each inter-segment gap
+        copies one segment's delta, not the whole growing history), or
+        None when ``rid`` is not active."""
+        toks = self._tokens.get(rid)
+        return None if toks is None else list(toks[start:])
+
+    def _segment_fn(self, n_steps: int):
+        # keyed on n_steps ALONE: sampling parameters ride as per-slot
+        # device vectors (_sample_rows), so a server facing arbitrary
+        # per-request GenerationConfigs never recompiles the segment
+        if n_steps not in self._segment_cache:
             max_len = self.max_len
 
-            def segment(params, last, lens, done, active, caches, key):
+            def segment(params, last, lens, done, active, samp, caches,
+                        key):
                 def step(carry, _):
                     last, lens, done, caches, key = carry
                     live = active & ~done & (lens < max_len)
                     logits, caches = self._fwd_ragged(
                         params, last[:, None], caches, lens, live)
                     key, sub = jax.random.split(key)
-                    nxt = _sample(logits[:, 0], sub, cfg)
+                    nxt = _sample_rows(logits[:, 0], sub, samp)
                     nxt = jnp.where(live, nxt, last)
                     lens = lens + live.astype(jnp.int32)
-                    if cfg.eos_token_id is not None:
-                        done = done | (live & (nxt == cfg.eos_token_id))
+                    done = done | (live & (samp["eos"] >= 0)
+                                   & (nxt == samp["eos"]))
                     done = done | (lens >= max_len)
                     return (nxt, lens, done, caches, key), nxt
 
@@ -541,34 +747,46 @@ class ContinuousBatchingEngine:
                 return (jnp.swapaxes(toks, 0, 1), last, lens, done,
                         caches)
 
-            self._segment_cache[key_cfg] = monitor.monitored_jit(
-                segment, name="cb_segment", donate_argnums=(5,))
-        return self._segment_cache[key_cfg]
+            self._segment_cache[n_steps] = monitor.monitored_jit(
+                segment, name="cb_segment", donate_argnums=(6,))
+        return self._segment_cache[n_steps]
 
-    def decode_segment(self, n_steps: int, cfg: GenerationConfig):
+    def decode_segment(self, n_steps: int,
+                       cfg: Optional[GenerationConfig] = None):
         """Run ``n_steps`` ragged decode steps over the current slots;
         collect per-request tokens and retire finished requests. Returns
-        the number of still-active requests."""
+        the number of still-active requests.
+
+        Each request decodes under ITS OWN GenerationConfig (installed
+        at ``add_request``) — including its seed, which every sampling
+        step folds into the per-row noise key, so a request's sampled
+        trajectory is a function of its own config, not of its
+        batchmates. ``cfg`` is optional and only seeds the segment's
+        SHARED base stream (back-compat with the one-config ``serve()``
+        driver — omitted, the base stream is seeded from 0)."""
         if not self._slot_req:
             return 0
         t0 = time.perf_counter()
         # every segment must draw fresh sampling noise even when no
         # request was admitted in between — fold in a segment counter
         self._segments_run += 1
-        key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed),
-                                 self._segments_run)
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.seed if cfg is not None else 0),
+            self._segments_run)
         toks, self.last, self.lens, self.done_dev, self.caches = \
-            self._segment_fn(n_steps, cfg)(
+            self._segment_fn(n_steps)(
                 self.params, self.last, self.lens, self.done_dev,
-                self.active_dev, self.caches, key)
+                self.active_dev, self.samp, self.caches, key)
         toks = np.asarray(toks)
         done = np.asarray(self.done_dev)
         emitted = 0
         for slot, rid in list(self._slot_req.items()):
+            rcfg = self._cfg[rid]
             take = min(self._budget[rid], n_steps)
             seq = toks[slot, :take].tolist()
-            if cfg.eos_token_id is not None and cfg.eos_token_id in seq:
-                seq = seq[:seq.index(cfg.eos_token_id) + 1]
+            if (rcfg.eos_token_id is not None
+                    and rcfg.eos_token_id in seq):
+                seq = seq[:seq.index(rcfg.eos_token_id) + 1]
             self._tokens[rid].extend(int(t) for t in seq)
             self._budget[rid] -= len(seq)
             emitted += len(seq)
@@ -721,11 +939,16 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.caches = (new_pools, pt)
         return last_logits
 
-    def _retire(self, slot):
-        super()._retire(slot)
+    def _abort_admit(self, slot: int) -> None:
+        super()._abort_admit(slot)
+        self.alloc.free_slot(slot)   # release any reserved pages
+
+    def _retire(self, slot, event: str = "finished"):
+        super()._retire(slot, event)
         self.alloc.free_slot(slot)
 
-    def decode_segment(self, n_steps: int, cfg: GenerationConfig):
+    def decode_segment(self, n_steps: int,
+                       cfg: Optional[GenerationConfig] = None):
         if not self._slot_req:
             return 0
         # admission reserved every running request's worst case, so no

@@ -54,7 +54,8 @@ __all__ = [
     "counter", "gauge", "histogram", "register_callback",
     "enable", "disable", "enabled",
     "snapshot", "render_prometheus", "write_jsonl", "reset",
-    "start_http_server", "monitored_jit", "instance_label",
+    "start_http_server", "http_payload", "monitored_jit",
+    "instance_label",
     "install_op_hook", "uninstall_op_hook",
 ]
 
@@ -661,6 +662,8 @@ def render_prometheus() -> str:
 _UNIT_SUFFIXES = (
     ("_seconds_total", "s"), ("_seconds", "s"), ("_bytes", "bytes"),
     ("_per_sec", "1/s"), ("_ratio", "ratio"), ("_total", "count"),
+    # serving-layer families (queue depth / in-flight request gauges)
+    ("_depth", "reqs"), ("_requests", "reqs"),
 )
 
 
@@ -700,6 +703,20 @@ def write_jsonl(path: str, extra: Optional[Dict[str, Any]] = None) -> int:
     return n
 
 
+def http_payload(path: str) -> Optional[Tuple[bytes, str]]:
+    """(body, content_type) for the monitor's HTTP endpoints —
+    ``/metrics.json`` (snapshot) and ``/metrics`` (Prometheus text) —
+    or None for any other path. The ONE place the export payloads are
+    built; every front-end (:func:`start_http_server`, the serving
+    package's HTTP server) serves these bytes."""
+    if path.startswith("/metrics.json"):
+        return json.dumps(snapshot()).encode(), "application/json"
+    if path.startswith("/metrics"):
+        return (render_prometheus().encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
+    return None
+
+
 def start_http_server(port: int = 0, addr: str = "127.0.0.1"):
     """Serve ``/metrics`` (Prometheus text) and ``/metrics.json``
     (snapshot) on a daemon thread; returns the server (its bound port is
@@ -708,16 +725,12 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1"):
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.startswith("/metrics.json"):
-                body = json.dumps(snapshot()).encode()
-                ctype = "application/json"
-            elif self.path.startswith("/metrics"):
-                body = render_prometheus().encode()
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-            else:
+            payload = http_payload(self.path)
+            if payload is None:
                 self.send_response(404)
                 self.end_headers()
                 return
+            body, ctype = payload
             self.send_response(200)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))

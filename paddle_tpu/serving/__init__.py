@@ -1,0 +1,54 @@
+"""paddle_tpu.serving — online continuous-batching serving layer.
+
+The reference ships a dedicated inference/serving capability layer
+(``paddle/fluid/inference`` + the server stack above AnalysisPredictor);
+our reproduction's engines (`paddle_tpu.inference.generation`) stop at a
+stepwise API — ``add_request`` / ``decode_segment`` /
+``collect_finished`` — plus a synchronous batch ``serve()``. THIS
+package is the first layer a real client can talk to:
+
+- :class:`~paddle_tpu.serving.queue.RequestQueue` — bounded, priority-
+  and deadline-aware admission queue (backpressure: a full queue rejects
+  with reason, the HTTP 429 path);
+- :class:`~paddle_tpu.serving.queue.RequestHandle` — per-request
+  blocking ``result()``, incremental token ``stream()`` iterator, and
+  ``cancel()`` (the slot — and its KV pages — is reclaimed at the next
+  inter-segment gap, not leaked);
+- :class:`~paddle_tpu.serving.scheduler.Server` — the scheduler thread
+  that owns an engine and drives Orca-style iteration-level scheduling:
+  admit in the inter-segment gap via the engine's public capacity probe
+  (``can_admit`` / ``free_slots``), decode one jitted segment, stream
+  new tokens, retire finished/cancelled/expired work;
+- :func:`~paddle_tpu.serving.http.serve_http` — stdlib HTTP front-end
+  (``POST /generate`` with chunked ndjson streaming, ``GET /healthz``,
+  and the monitor package's ``/metrics`` exporters).
+
+Quick start::
+
+    import paddle_tpu.serving as serving
+    from paddle_tpu.inference.generation import (
+        GenerationConfig, PagedContinuousBatchingEngine)
+
+    eng = PagedContinuousBatchingEngine(model, max_batch=4,
+                                        num_pages=64, page_size=16,
+                                        max_pages=32)
+    srv = serving.Server(eng, max_queue=64, segment_steps=8)
+    httpd = serving.serve_http(srv, port=8000)
+
+    h = srv.submit(prompt_ids, GenerationConfig(max_new_tokens=64))
+    for tok in h.stream():
+        ...
+"""
+from .http import serve_http
+from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
+                    RUNNING, DeadlineExpired, QueueFull,
+                    RequestCancelled, RequestFailed, RequestHandle,
+                    RequestQueue, RequestRejected)
+from .scheduler import Server
+
+__all__ = [
+    "Server", "serve_http", "RequestHandle", "RequestQueue",
+    "RequestRejected", "QueueFull", "RequestCancelled",
+    "DeadlineExpired", "RequestFailed",
+    "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED", "FAILED",
+]

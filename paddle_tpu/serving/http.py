@@ -1,0 +1,275 @@
+"""Stdlib HTTP front-end for :class:`~paddle_tpu.serving.Server`.
+
+The reference exposes its inference capability through an RPC/HTTP
+server above the predictor; this is our equivalent — intentionally
+stdlib-only (``http.server``), because the serving story must work in
+the bare container the engine runs in.
+
+Routes:
+
+- ``POST /generate`` — JSON body::
+
+      {"prompt": [1, 2, 3],          # token ids (required)
+       "max_new_tokens": 64, "temperature": 1.0, "top_k": 0,
+       "top_p": 1.0, "do_sample": false, "eos_token_id": null,
+       "seed": 0,                     # GenerationConfig fields
+       "priority": 0, "timeout_s": null,   # admission deadline
+       "stream": false}
+
+  Non-streaming: one JSON response
+  ``{"request_id", "tokens", "n_tokens", "ttft_s"}``.
+  Streaming (``"stream": true``): chunked ``application/x-ndjson`` —
+  one ``{"token": id}`` line per generated token AS IT ARRIVES (tokens
+  reach the client segment-by-segment, long before completion), then a
+  final ``{"done": true, "status": ..., "n_tokens": ...}`` line.
+
+  Status codes are the backpressure contract: 400 malformed request
+  (GenerationConfig validation / prompt that can never fit), 429 queue
+  full (with ``Retry-After``), 503 draining/shutdown, 504 admission
+  deadline expired.
+
+- ``GET /healthz`` — ``{"status": "ok"|"draining", "queue_depth",
+  "free_slots", "active_requests"}`` (load balancers drain on
+  non-"ok").
+
+- ``GET /metrics`` / ``GET /metrics.json`` — the monitor package's
+  Prometheus / JSON exporters, same payloads as
+  ``monitor.start_http_server`` (one scrape endpoint per serving
+  process).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from .. import monitor
+from ..inference.generation import GenerationConfig
+from .queue import (DeadlineExpired, RequestCancelled, RequestFailed,
+                    RequestRejected)
+
+__all__ = ["serve_http"]
+
+_CFG_FIELDS = ("max_new_tokens", "temperature", "top_k", "top_p",
+               "do_sample", "eos_token_id", "seed")
+
+# a /generate body is token ids + a dozen scalars; 8 MB is orders of
+# magnitude above any real request, and an unbounded Content-Length
+# would let one request buffer arbitrary bytes into the process that
+# holds the model and KV pool
+MAX_BODY_BYTES = 8 << 20
+
+
+def _parse_request(body: dict):
+    prompt = body.get("prompt")
+    if (not isinstance(prompt, list) or not prompt
+            or not all(isinstance(t, int) and not isinstance(t, bool)
+                       and 0 <= t < 2**31 for t in prompt)):
+        raise ValueError(
+            "'prompt' must be a non-empty list of int32 token ids")
+    cfg_kw = {k: body[k] for k in _CFG_FIELDS if k in body}
+    try:
+        cfg = GenerationConfig(**cfg_kw)
+    except ValueError:
+        raise
+    except Exception as e:   # e.g. TypeError from a null/list field
+        raise ValueError(f"bad GenerationConfig field: {e}") from e
+    priority = body.get("priority", 0)
+    if not isinstance(priority, int) or isinstance(priority, bool):
+        raise ValueError(f"'priority' must be an int, got {priority!r}")
+    timeout_s = body.get("timeout_s")
+    if timeout_s is not None and (
+            isinstance(timeout_s, bool)
+            or not isinstance(timeout_s, (int, float))
+            or not timeout_s > 0):
+        raise ValueError(
+            f"'timeout_s' must be a positive number or null, got "
+            f"{timeout_s!r}")
+    return prompt, cfg, priority, timeout_s, bool(body.get("stream"))
+
+
+def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
+    """Serve ``server`` over HTTP on a daemon thread; returns the
+    ``ThreadingHTTPServer`` (bound port: ``httpd.server_address[1]``;
+    ``port=0`` picks a free one). Stop with ``httpd.shutdown()``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import numpy as np
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # -- helpers ---------------------------------------------------------
+        def _json(self, code: int, obj: dict,
+                  headers: Optional[dict] = None) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _chunk(self, data: bytes) -> None:
+            self.wfile.write(f"{len(data):X}\r\n".encode())
+            self.wfile.write(data)
+            self.wfile.write(b"\r\n")
+            self.wfile.flush()
+
+        # -- routes ----------------------------------------------------------
+        def do_GET(self):
+            if self.path.startswith("/healthz"):
+                eng = server.engine
+                status = server.status
+                self._json(200 if status in ("ok", "draining") else 503,
+                           {
+                    "status": status,
+                    "queue_depth": server.queue.depth,
+                    "free_slots": eng.free_slots(),
+                    "active_requests": server.num_active(),
+                })
+            elif (payload := monitor.http_payload(self.path)) is not None:
+                body, ctype = payload
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if not self.path.startswith("/generate"):
+                # body NOT consumed: drop the connection after replying
+                # or keep-alive would parse the body as the next request
+                self.close_connection = True
+                self._json(404, {"error": f"no route {self.path}"},
+                           headers={"Connection": "close"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                if n < 0:
+                    # rfile.read(-1) would block until the client closes
+                    # the socket, pinning a handler thread
+                    self.close_connection = True
+                    self._json(400, {"error": "negative Content-Length"},
+                               headers={"Connection": "close"})
+                    return
+                if n > MAX_BODY_BYTES:
+                    self.close_connection = True
+                    self._json(413, {"error":
+                                     f"body exceeds {MAX_BODY_BYTES} "
+                                     "bytes"},
+                               headers={"Connection": "close"})
+                    return
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                prompt, cfg, priority, timeout_s, stream = \
+                    _parse_request(body)
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            try:
+                handle = server.submit(
+                    np.asarray(prompt, np.int32), cfg,
+                    priority=priority, timeout_s=timeout_s)
+            except RequestRejected as e:
+                if e.reason == "queue_full":
+                    self._json(429, {"error": str(e),
+                                     "reason": e.reason},
+                               headers={"Retry-After": "1"})
+                else:   # draining / shutdown
+                    self._json(503, {"error": str(e),
+                                     "reason": e.reason})
+                return
+            except ValueError as e:   # can never fit the engine
+                self._json(400, {"error": str(e)})
+                return
+            if stream:
+                self._stream_response(handle)
+            else:
+                self._block_response(handle)
+
+        def _block_response(self, handle) -> None:
+            try:
+                toks = handle.result()
+            except DeadlineExpired as e:
+                self._json(504, {"error": str(e), "request_id": handle.id})
+                return
+            except (RequestCancelled, RequestFailed) as e:
+                self._json(500, {"error": str(e), "request_id": handle.id})
+                return
+            ttft = (None if handle.first_token_ts is None
+                    else handle.first_token_ts - handle.submit_ts)
+            self._json(200, {"request_id": handle.id,
+                             "tokens": [int(t) for t in toks],
+                             "n_tokens": len(toks), "ttft_s": ttft})
+
+        def _stream_response(self, handle) -> None:
+            # the status line is deferred until the FIRST token (or a
+            # terminal state) exists: a request that expires or fails
+            # before emitting anything still gets its real 504/500,
+            # not a 200 that then apologizes in the trailer
+            it = handle.stream()
+            first = None
+            try:
+                first = next(it)
+            except StopIteration:
+                pass              # zero-token terminal (e.g. cancelled)
+            except DeadlineExpired as e:
+                self._json(504, {"error": str(e),
+                                 "request_id": handle.id})
+                return
+            except RequestFailed as e:
+                self._json(500, {"error": str(e),
+                                 "request_id": handle.id})
+                return
+            n = 0
+            status = "finished"
+            try:
+                # header writes sit INSIDE the broken-pipe guard: a
+                # client that disconnected while waiting for its first
+                # token must trigger the cancel below, not strand a
+                # decoding slot behind an unhandled socket error
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                if first is not None:
+                    self._chunk(json.dumps({"token": int(first)})
+                                .encode() + b"\n")
+                    n += 1
+                    for tok in it:
+                        self._chunk(json.dumps({"token": int(tok)})
+                                    .encode() + b"\n")
+                        n += 1
+                if handle.status == "cancelled":
+                    status = "cancelled"
+            except DeadlineExpired:
+                status = "expired"
+            except RequestFailed as e:
+                status = f"failed: {e}"
+            except (BrokenPipeError, ConnectionResetError):
+                # client went away mid-stream: reclaim the slot
+                handle.cancel()
+                return
+            try:
+                self._chunk(json.dumps(
+                    {"done": True, "status": status, "n_tokens": n,
+                     "request_id": handle.id}).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def log_message(self, *args):   # no access-log spam on stderr
+            pass
+
+    httpd = ThreadingHTTPServer((addr, port), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="paddle_tpu-serving-http")
+    t.start()
+    return httpd

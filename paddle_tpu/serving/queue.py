@@ -1,0 +1,284 @@
+"""Request queue + per-request handles for the online serving layer.
+
+The reference server stack sits above AnalysisPredictor and owns the
+request lifecycle (accept → queue → schedule → stream → finish); this
+module is the lifecycle half of our equivalent: a bounded, priority- and
+deadline-aware :class:`RequestQueue` feeding the scheduler, and a
+:class:`RequestHandle` the client holds — blocking ``result()``, an
+incremental token-``stream()`` iterator, and ``cancel()``.
+
+Thread model: clients (HTTP handler threads, user threads) touch ONLY
+the handle's public surface and ``RequestQueue.put``; every state
+transition (admit, push tokens, finish, expire) is driven by the single
+scheduler thread, so the engine itself never needs a lock.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "RequestHandle", "RequestQueue", "RequestRejected", "QueueFull",
+    "RequestCancelled", "DeadlineExpired", "RequestFailed",
+    "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED", "FAILED",
+]
+
+# handle lifecycle states
+QUEUED = "queued"
+RUNNING = "running"
+FINISHED = "finished"
+CANCELLED = "cancelled"
+EXPIRED = "expired"
+FAILED = "failed"
+_TERMINAL = (FINISHED, CANCELLED, EXPIRED, FAILED)
+
+
+class RequestRejected(RuntimeError):
+    """Backpressure rejection at submit time (the HTTP layer maps this
+    to 429/503). ``reason`` is machine-readable; the message says what
+    the client should do about it."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+class QueueFull(RequestRejected):
+    """The bounded request queue is at capacity — retry later (429)."""
+
+    def __init__(self, max_size: int):
+        super().__init__(
+            "queue_full",
+            f"request queue full ({max_size} waiting); retry later")
+
+
+class RequestCancelled(RuntimeError):
+    """``result()`` on a request that was cancelled; partial tokens stay
+    readable via ``handle.tokens_so_far()``."""
+
+
+class DeadlineExpired(RuntimeError):
+    """``result()`` on a request whose deadline passed before admission."""
+
+
+class RequestFailed(RuntimeError):
+    """``result()`` on a request the scheduler could never run (e.g. a
+    prompt that cannot ever fit the engine's page pool)."""
+
+
+class RequestHandle:
+    """One submitted request's client-side handle.
+
+    - ``result(timeout)`` blocks for the full generated ids (prompt NOT
+      included, matching ``engine.serve()``), raising
+      :class:`RequestCancelled` / :class:`DeadlineExpired` /
+      :class:`RequestFailed` on the non-finish terminals;
+    - ``stream(timeout)`` / iteration yields token ids INCREMENTALLY as
+      decode segments emit them — the first token arrives long before
+      the request finishes (that gap is the TTFT the bench reports);
+    - ``cancel()`` flags the request; the scheduler retires its slot at
+      the next inter-segment gap (capacity is reclaimed, not leaked).
+
+    ``submit_ts`` / ``first_token_ts`` / ``finish_ts`` are
+    ``time.monotonic()`` stamps the serving metrics (TTFT, TPOT) are
+    derived from.
+    """
+
+    def __init__(self, req_id: int, prompt, prompt_len: int, cfg,
+                 priority: int = 0, deadline: Optional[float] = None,
+                 on_cancel: Optional[Callable[["RequestHandle"], None]]
+                 = None):
+        self.id = req_id
+        self.prompt = prompt
+        self.prompt_len = prompt_len
+        self.cfg = cfg
+        self.priority = priority
+        self.deadline = deadline          # absolute time.monotonic()
+        self.engine_rid: Optional[int] = None
+        self.submit_ts = time.monotonic()
+        self.first_token_ts: Optional[float] = None
+        self.finish_ts: Optional[float] = None
+        self._cv = threading.Condition()
+        self._tokens: List[int] = []
+        self._n_pushed = 0   # scheduler-thread bookkeeping: tokens the
+        #                      scheduler has already pushed, so each
+        #                      segment pushes a delta (O(new tokens),
+        #                      not a re-copy of the whole history)
+        self._status = QUEUED
+        self._error: Optional[BaseException] = None
+        self._cancel_requested = False
+        self._on_cancel = on_cancel
+
+    # -- client surface ------------------------------------------------------
+    @property
+    def status(self) -> str:
+        with self._cv:
+            return self._status
+
+    @property
+    def done(self) -> bool:
+        with self._cv:
+            return self._status in _TERMINAL
+
+    def cancel(self) -> None:
+        """Request cancellation (idempotent). A queued request is dropped
+        at the next admission pass; a running request's slot (and pages)
+        is retired at the next inter-segment gap."""
+        with self._cv:
+            if self._status in _TERMINAL:
+                return
+            self._cancel_requested = True
+        if self._on_cancel is not None:
+            self._on_cancel(self)
+
+    def tokens_so_far(self) -> List[int]:
+        with self._cv:
+            return list(self._tokens)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until terminal; returns generated ids [n] (np.int32).
+        Raises TimeoutError if ``timeout`` elapses first."""
+        with self._cv:
+            if not self._cv.wait_for(
+                    lambda: self._status in _TERMINAL, timeout):
+                raise TimeoutError(
+                    f"request {self.id} not finished within {timeout}s")
+            status, err = self._status, self._error
+            toks = np.asarray(self._tokens, np.int32)
+        if status == FINISHED:
+            return toks
+        if status == CANCELLED:
+            raise RequestCancelled(
+                f"request {self.id} cancelled after {len(toks)} tokens")
+        if status == EXPIRED:
+            raise DeadlineExpired(
+                f"request {self.id} deadline expired before admission")
+        raise RequestFailed(str(err)) from err
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield generated token ids as they arrive; returns when the
+        request reaches a terminal state (a CANCELLED stream simply ends
+        after the partial tokens). ``timeout`` bounds each wait for the
+        NEXT token, not the whole stream; expiry raises TimeoutError.
+        EXPIRED/FAILED terminals re-raise like ``result()``."""
+        sent = 0
+        while True:
+            with self._cv:
+                if not self._cv.wait_for(
+                        lambda: (len(self._tokens) > sent
+                                 or self._status in _TERMINAL), timeout):
+                    raise TimeoutError(
+                        f"request {self.id}: no token within {timeout}s")
+                chunk = self._tokens[sent:]
+                status, err = self._status, self._error
+            for t in chunk:
+                yield t
+            sent += len(chunk)
+            if status in _TERMINAL and sent == len(self.tokens_so_far()):
+                if status == EXPIRED:
+                    raise DeadlineExpired(
+                        f"request {self.id} deadline expired before "
+                        "admission")
+                if status == FAILED:
+                    raise RequestFailed(str(err)) from err
+                return
+
+    __iter__ = stream
+
+    # -- scheduler surface (single scheduler thread) -------------------------
+    def _push(self, tokens) -> bool:
+        """Append newly generated tokens; returns True when these are
+        the request's FIRST tokens (TTFT edge)."""
+        if not tokens:
+            return False
+        with self._cv:
+            first = not self._tokens
+            if first:
+                self.first_token_ts = time.monotonic()
+            self._tokens.extend(int(t) for t in tokens)
+            self._cv.notify_all()
+            return first
+
+    def _finish(self, status: str,
+                error: Optional[BaseException] = None) -> None:
+        with self._cv:
+            if self._status in _TERMINAL:
+                return
+            self._status = status
+            self._error = error
+            self.finish_ts = time.monotonic()
+            self._cv.notify_all()
+
+    def _mark_running(self, engine_rid: int) -> None:
+        with self._cv:
+            self.engine_rid = engine_rid
+            self._status = RUNNING
+
+
+class RequestQueue:
+    """Bounded priority queue of :class:`RequestHandle` (lower
+    ``priority`` value = served first; FIFO within a priority).
+
+    ``put`` applies BACKPRESSURE: a full queue raises :class:`QueueFull`
+    (reject-with-reason — the 429 path) instead of growing without
+    bound while the engine falls behind. Cancelled and deadline-expired
+    entries are reaped at pop time and handed back to the scheduler for
+    finalization — an expired request never admits.
+    """
+
+    def __init__(self, max_size: int):
+        if max_size < 1:
+            raise ValueError(f"max_size must be >= 1, got {max_size}")
+        self.max_size = max_size
+        self._lock = threading.Lock()
+        self._heap: List[Tuple[int, int, RequestHandle]] = []
+        self._seq = itertools.count()
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def put(self, handle: RequestHandle) -> None:
+        with self._lock:
+            if len(self._heap) >= self.max_size:
+                raise QueueFull(self.max_size)
+            heapq.heappush(self._heap,
+                           (handle.priority, next(self._seq), handle))
+
+    def reap(self, now: float) -> List[RequestHandle]:
+        """Remove every cancelled/expired entry (anywhere in the queue,
+        not just the head — a deep queue must not hold dead entries
+        against ``max_size``) and return them for finalization."""
+        with self._lock:
+            dead = [h for _, _, h in self._heap
+                    if h._cancel_requested
+                    or (h.deadline is not None and now >= h.deadline)]
+            if dead:
+                gone = set(id(h) for h in dead)
+                self._heap = [e for e in self._heap
+                              if id(e[2]) not in gone]
+                heapq.heapify(self._heap)
+            return dead
+
+    def pop_if(self, pred: Callable[[RequestHandle], bool]
+               ) -> Optional[RequestHandle]:
+        """Pop and return the head iff ``pred(head)`` — the scheduler's
+        admission probe (no head-of-line bypass: requests admit in
+        priority/FIFO order, like ``engine.serve()``'s pending list)."""
+        with self._lock:
+            if self._heap and pred(self._heap[0][2]):
+                return heapq.heappop(self._heap)[2]
+            return None
+
+    def drain_all(self) -> List[RequestHandle]:
+        """Remove and return everything (shutdown path)."""
+        with self._lock:
+            out = [h for _, _, h in self._heap]
+            self._heap = []
+            return out

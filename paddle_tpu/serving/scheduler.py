@@ -1,0 +1,417 @@
+"""Online continuous-batching scheduler: :class:`Server`.
+
+The reference stack drives its engine from a server loop above
+AnalysisPredictor; here a dedicated scheduler THREAD owns a
+``ContinuousBatchingEngine`` / ``PagedContinuousBatchingEngine`` and
+drives the stepwise API (``add_request`` / ``decode_segment`` /
+``collect_finished``) in an Orca-style iteration loop:
+
+    gap:   apply cancellations → reap expired → admit from the queue
+           (capacity probed via the engine's public ``can_admit`` /
+           ``free_slots`` — never by catching add_request's RuntimeError)
+    step:  one jitted decode segment over every occupied slot
+    drain: stream new tokens to handles, finish retired requests
+
+Admission happens only in the inter-segment gap, so a transiently full
+pool defers work instead of failing it; cancellation retires the slot in
+the same gap, so the pool is reclaimed, never leaked. Backpressure is
+the bounded queue: ``submit`` on a full queue raises
+:class:`~paddle_tpu.serving.queue.QueueFull` (the HTTP layer's 429).
+
+Thread model: the engine is touched by the scheduler thread ONLY (jax
+tracing included). ``submit``/``cancel``/``drain``/``shutdown`` are
+thread-safe entry points that communicate through the queue, handle
+flags, and a wake event.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .. import monitor
+from ..inference.generation import GenerationConfig, _prompt_len
+from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QueueFull,
+                    RequestHandle, RequestQueue, RequestRejected)
+
+__all__ = ["Server"]
+
+
+class Server:
+    """Thread-driven online server over a continuous-batching engine.
+
+    Usage::
+
+        eng = PagedContinuousBatchingEngine(model, max_batch=4,
+                                            num_pages=64, page_size=16,
+                                            max_pages=32)
+        srv = Server(eng, max_queue=64, segment_steps=8)
+        h = srv.submit(prompt_ids, GenerationConfig(max_new_tokens=64))
+        for tok in h.stream():      # tokens arrive segment by segment
+            ...
+        srv.shutdown()
+
+    ``submit`` rejects (raises) when the queue is full or the server is
+    draining — the reject-with-reason backpressure contract; a request
+    whose prompt can NEVER fit the engine fails fast with ValueError.
+    ``drain()`` stops admission of new submissions and waits for
+    in-flight + queued work to finish; ``shutdown()`` optionally drains,
+    then cancels whatever remains and stops the thread.
+    """
+
+    def __init__(self, engine, max_queue: int = 64,
+                 segment_steps: int = 8,
+                 idle_wait_s: float = 0.02, start: bool = True):
+        self.engine = engine
+        self.segment_steps = segment_steps
+        self.idle_wait_s = idle_wait_s
+        self.queue = RequestQueue(max_queue)
+        # per-server label: concurrent servers (multi-model processes)
+        # publish their serving metrics side by side
+        self.monitor_server = monitor.instance_label("server")
+        self._wake = threading.Event()
+        self._idle_cv = threading.Condition()
+        self._lock = threading.Lock()     # submit/lifecycle flags
+        self._next_id = 0
+        self._active = {}                 # engine rid -> RequestHandle
+        self._admitting = False           # True between queue pop and
+        #                                   _active insert (drain must
+        #                                   not miss that window)
+        self._draining = False
+        self._stopping = False
+        self._fatal: Optional[BaseException] = None
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"paddle_tpu-serving-{self.monitor_server}")
+        if start:
+            self._thread.start()
+
+    # -- client surface ------------------------------------------------------
+    def submit(self, prompt, cfg: Optional[GenerationConfig] = None,
+               priority: int = 0,
+               timeout_s: Optional[float] = None) -> RequestHandle:
+        """Enqueue one request; returns its :class:`RequestHandle`.
+
+        ``cfg`` is the request's OWN GenerationConfig (validated at
+        construction — malformed configs never reach a shared decode
+        segment); ``priority`` orders admission (lower first);
+        ``timeout_s`` sets an admission deadline — a request still
+        queued when it passes is EXPIRED, never admitted.
+
+        Raises :class:`RequestRejected` (reason ``queue_full`` /
+        ``draining`` / ``shutdown``) for backpressure, ValueError for a
+        prompt that could never fit the engine."""
+        cfg = cfg or GenerationConfig()
+        plen = _prompt_len(prompt)
+        if plen + cfg.max_new_tokens > self.engine.max_len:
+            raise ValueError(
+                f"prompt({plen}) + max_new_tokens({cfg.max_new_tokens}) "
+                f"exceeds engine max_len({self.engine.max_len})")
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        # the put happens under the SAME lock as the stopping check:
+        # otherwise a submit racing shutdown() could enqueue after the
+        # scheduler's final queue drain and strand the handle QUEUED
+        # forever (no thread left to ever finish it)
+        with self._lock:
+            if self._stopping or self._stopped.is_set():
+                # covers clean shutdown AND a scheduler that died on an
+                # exception — either way nobody will ever pop the queue
+                self._count("rejected_shutdown")
+                raise RequestRejected(
+                    "shutdown",
+                    "server is shut down"
+                    + (f" (scheduler died: {self._fatal!r})"
+                       if self._fatal is not None else ""))
+            if self._draining:
+                self._count("rejected_draining")
+                raise RequestRejected(
+                    "draining",
+                    "server is draining; not accepting new requests")
+            handle = RequestHandle(self._next_id, prompt, plen, cfg,
+                                   priority, deadline,
+                                   on_cancel=self._on_cancel)
+            self._next_id += 1
+            try:
+                self.queue.put(handle)
+            except QueueFull:
+                self._count("rejected_queue_full")
+                raise
+        self._count("queued")
+        self._depth_gauge()
+        self._wake.set()
+        return handle
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop accepting NEW submissions, let queued + in-flight
+        requests run to completion. Returns True when everything
+        finished (False on timeout; the server keeps draining)."""
+        with self._lock:
+            self._draining = True
+        self._wake.set()
+        with self._idle_cv:
+            return self._idle_cv.wait_for(
+                lambda: (self.queue.depth == 0 and not self._active
+                         and not self._admitting)
+                or self._stopped.is_set(), timeout)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the scheduler. ``drain=True`` finishes outstanding work
+        first (bounded by ``timeout``); whatever remains afterwards —
+        or everything, with ``drain=False`` — is cancelled BY THE
+        SCHEDULER THREAD on its way out (the engine is never touched
+        from the caller's thread — a segment still in flight, e.g. a
+        long first compile, finishes before cleanup runs)."""
+        t0 = time.monotonic()
+        if drain:
+            self.drain(timeout)
+        with self._lock:
+            self._stopping = True
+            self._draining = True
+        self._wake.set()
+        # ``timeout`` bounds the WHOLE call: the stop-wait gets what the
+        # drain left over, not a second full helping
+        if timeout is None:
+            self._stopped.wait(60.0)
+        else:
+            self._stopped.wait(max(0.0, timeout
+                                   - (time.monotonic() - t0)))
+        try:
+            self._queue_depth_gauge().remove(server=self.monitor_server)
+            self._active_gauge().remove(server=self.monitor_server)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self.shutdown(drain=False)
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def num_active(self) -> int:
+        return len(self._active)
+
+    # -- monitor helpers -----------------------------------------------------
+    @staticmethod
+    def _requests_counter():
+        return monitor.counter(
+            "paddle_tpu_serving_requests_total",
+            "serving-layer requests by lifecycle event "
+            "(queued/completed/cancelled/expired/failed/rejected_*)",
+            ("server", "event"))
+
+    @staticmethod
+    def _queue_depth_gauge():
+        return monitor.gauge(
+            "paddle_tpu_serving_queue_depth",
+            "requests waiting for admission, per server", ("server",))
+
+    @staticmethod
+    def _active_gauge():
+        return monitor.gauge(
+            "paddle_tpu_serving_active_requests",
+            "requests currently occupying engine slots, per server",
+            ("server",))
+
+    @staticmethod
+    def _ttft_hist():
+        return monitor.histogram(
+            "paddle_tpu_serving_ttft_seconds",
+            "time to first token: submit() to the first generated "
+            "token reaching the handle", ("server",))
+
+    @staticmethod
+    def _tpot_hist():
+        return monitor.histogram(
+            "paddle_tpu_serving_tpot_seconds",
+            "time per output token after the first (decode cadence): "
+            "(finish - first_token) / (n_tokens - 1)", ("server",))
+
+    def _count(self, event: str) -> None:
+        if monitor.enabled():
+            self._requests_counter().labels(
+                server=self.monitor_server, event=event).inc()
+
+    def _depth_gauge(self) -> None:
+        if monitor.enabled():
+            self._queue_depth_gauge().labels(
+                server=self.monitor_server).set(self.queue.depth)
+            self._active_gauge().labels(
+                server=self.monitor_server).set(len(self._active))
+
+    # -- scheduler loop (single thread) --------------------------------------
+    def _on_cancel(self, handle: RequestHandle) -> None:
+        self._wake.set()
+
+    def _loop(self) -> None:
+        err: Optional[BaseException] = None
+        try:
+            while True:
+                with self._lock:
+                    stopping = self._stopping
+                if stopping:
+                    break
+                self._gap()
+                if self._active:
+                    self.engine.decode_segment(self.segment_steps)
+                    self._collect()
+                else:
+                    with self._idle_cv:
+                        self._idle_cv.notify_all()
+                    self._wake.wait(self.idle_wait_s)
+                    self._wake.clear()
+        except BaseException as e:     # noqa: BLE001 - must not hang clients
+            err = e
+        finally:
+            # terminal cleanup runs HERE, in the engine-owning thread:
+            # a dead loop must never strand handles in a non-terminal
+            # state (clients block in result()/stream() forever) or
+            # leave drain() waiting on a condition nobody will signal.
+            self._finalize(err)
+            self._stopped.set()
+            with self._idle_cv:
+                self._idle_cv.notify_all()
+
+    @property
+    def status(self) -> str:
+        """``ok`` / ``draining`` / ``failed`` (scheduler died on an
+        exception) / ``stopped`` — what ``/healthz`` reports."""
+        if self._fatal is not None:
+            return "failed"
+        if self._stopped.is_set():
+            return "stopped"
+        return "draining" if self.draining else "ok"
+
+    def _finalize(self, err: Optional[BaseException]) -> None:
+        fail = err is not None
+        with self._lock:
+            # close the submit door BEFORE draining (on the crash path
+            # _stopping is still False here — without this a racing
+            # submit could enqueue after the final drain and strand its
+            # handle QUEUED forever)
+            self._stopping = True
+            self._fatal = err
+        wrapped = (RuntimeError(f"serving scheduler died: {err!r}")
+                   if fail else None)
+        for h in self.queue.drain_all():
+            h._finish(FAILED if fail else CANCELLED, wrapped)
+            self._count("failed" if fail else "cancelled")
+        for rid, h in list(self._active.items()):
+            if not fail:
+                # engine state is coherent on a clean stop — reclaim
+                try:
+                    self.engine.cancel_request(rid)
+                except Exception:
+                    pass
+            h._finish(FAILED if fail else CANCELLED, wrapped)
+            self._count("failed" if fail else "cancelled")
+        self._active.clear()
+
+    def _gap(self) -> None:
+        """The inter-segment gap: cancellations first (they free
+        capacity), then expiry reaping, then admission while the
+        engine's capacity probe allows."""
+        # 1. cancellations of RUNNING requests retire their slots
+        for rid, h in list(self._active.items()):
+            if h._cancel_requested:
+                toks = self.engine.cancel_request(rid)
+                del self._active[rid]
+                if toks is not None:
+                    self._push_delta(h, list(toks[h._n_pushed:]))
+                h._finish(CANCELLED)
+                self._count("cancelled")
+        # 2. cancelled/expired queue entries never admit
+        for h in self.queue.reap(time.monotonic()):
+            if h._cancel_requested:
+                h._finish(CANCELLED)
+                self._count("cancelled")
+            else:
+                h._finish(EXPIRED)
+                self._count("expired")
+        # 3. admission: probe, never catch — deferral is the scheduler
+        #    path, add_request raising is the programmer-error path.
+        #    _admitting covers the whole pop→_active window (set BEFORE
+        #    the pop): a timed drain() must never see "queue empty, no
+        #    actives" while a request is mid-admission (prefill can be
+        #    seconds on a first compile).
+        self._admitting = True
+        try:
+            while True:
+                h = self.queue.pop_if(
+                    lambda h: self.engine.can_admit(h.prompt_len,
+                                                    h.cfg))
+                if h is None:
+                    # head (if any) does not fit RIGHT NOW. With the
+                    # engine completely idle it can never fit — fail it
+                    # loudly instead of wedging the queue forever. The
+                    # pop re-checks the probe under the queue lock: a
+                    # racing submit may have put a NEW, admittable head
+                    # in front, which must not be the one failed.
+                    if (self.queue.depth and not self._active
+                            and self.engine.free_slots()
+                            == self.engine.max_batch):
+                        bad = self.queue.pop_if(
+                            lambda h: not self.engine.can_admit(
+                                h.prompt_len, h.cfg))
+                        if bad is not None:
+                            bad._finish(FAILED, RuntimeError(
+                                f"request {bad.id} (prompt_len="
+                                f"{bad.prompt_len}, max_new_tokens="
+                                f"{bad.cfg.max_new_tokens}) can never "
+                                "be admitted: engine capacity (page "
+                                "pool / max_len) is too small even "
+                                "when idle"))
+                            self._count("failed")
+                        continue
+                    break
+                try:
+                    rid = self.engine.add_request(h.prompt, h.cfg)
+                except Exception as e:  # pragma: no cover - probe skew
+                    h._finish(FAILED, e)
+                    self._count("failed")
+                    continue
+                h._mark_running(rid)
+                self._active[rid] = h
+                # admission prefill already sampled the first token:
+                # push it now — the TTFT edge for the handle's stream
+                toks = self.engine.partial_tokens(rid)
+                if toks is not None:
+                    self._push_delta(h, toks)
+        finally:
+            self._admitting = False
+        self._depth_gauge()
+
+    def _push_delta(self, h: RequestHandle, toks) -> None:
+        """Push newly generated tokens (scheduler thread only);
+        ``_n_pushed`` keeps each gap's copy O(delta), and the first
+        push is the TTFT observation."""
+        h._n_pushed += len(toks)
+        if h._push(toks) and monitor.enabled():
+            self._ttft_hist().labels(server=self.monitor_server).observe(
+                h.first_token_ts - h.submit_ts)
+
+    def _collect(self) -> None:
+        """Post-segment: finish retired requests, stream deltas for the
+        still-running ones."""
+        for rid, seq in self.engine.collect_finished().items():
+            h = self._active.pop(rid, None)
+            if h is None:      # foreign request (user drove the engine)
+                continue
+            self._push_delta(h, list(seq[h._n_pushed:]))
+            h._finish(FINISHED)
+            self._count("completed")
+            if monitor.enabled():
+                n = len(seq)
+                if h.first_token_ts is not None and n > 1:
+                    self._tpot_hist().labels(
+                        server=self.monitor_server).observe(
+                        (h.finish_ts - h.first_token_ts) / (n - 1))
+        for rid, h in list(self._active.items()):
+            delta = self.engine.partial_tokens(rid, h._n_pushed)
+            if delta:
+                self._push_delta(h, delta)
+        self._depth_gauge()

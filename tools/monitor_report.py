@@ -12,6 +12,7 @@ Usage::
     python tools/monitor_report.py -                    # stdin
     python tools/monitor_report.py --url http://127.0.0.1:8080
     python tools/monitor_report.py run.jsonl --filter kv_   # substring
+    python tools/monitor_report.py --url ... --serving  # serving view
 """
 from __future__ import annotations
 
@@ -73,10 +74,26 @@ def load_snapshot(snap: dict) -> List[dict]:
     return out
 
 
-def render(records: List[dict], filter_: str = "") -> str:
+# the serving metric families (scheduler + engine admission + KV pool)
+# --serving selects: one flag shows the whole online-serving picture
+SERVING_FAMILIES = (
+    "paddle_tpu_serving_",              # queue depth, TTFT, TPOT, events
+    "paddle_tpu_requests_total",        # engine lifecycle events
+    "paddle_tpu_generated_tokens_total",
+    "paddle_tpu_decode_tokens_per_sec",
+    "paddle_tpu_kv_admission_seconds",
+    "paddle_tpu_kv_page_occupancy_ratio",
+)
+
+
+def render(records: List[dict], filter_: str = "",
+           serving: bool = False) -> str:
     rows = []
     for rec in records:
         name = rec["metric"]
+        if serving and not any(name.startswith(f)
+                               for f in SERVING_FAMILIES):
+            continue
         if filter_ and filter_ not in name:
             continue
         extra = ""
@@ -111,6 +128,10 @@ def main(argv=None) -> int:
                          "<url>/metrics.json)")
     ap.add_argument("--filter", default="", dest="filter_",
                     metavar="SUBSTR", help="only metrics containing SUBSTR")
+    ap.add_argument("--serving", action="store_true",
+                    help="only the online-serving families (queue depth, "
+                         "TTFT, TPOT, request events, tokens/sec, KV "
+                         "admission + occupancy)")
     args = ap.parse_args(argv)
 
     if args.url:
@@ -127,7 +148,7 @@ def main(argv=None) -> int:
         with open(args.path) as f:
             records = load_jsonl(f)
 
-    print(render(records, args.filter_))
+    print(render(records, args.filter_, serving=args.serving))
     return 0
 
 

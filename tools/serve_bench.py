@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Open-loop load generator for paddle_tpu.serving.
+
+Drives a :class:`paddle_tpu.serving.Server` (in-process toy model by
+default, or a remote HTTP endpoint via ``--url``) with Poisson arrivals
+at ``--rate`` req/s and reports the serving-latency metrics PERF.md
+defines:
+
+- **TTFT** (time to first token): submit → first generated token at the
+  client. Queueing + admission prefill + the first decode-segment share.
+- **TPOT** (time per output token): (last token - first token) /
+  (n_tokens - 1) per request — the steady decode cadence a streaming
+  client observes.
+- **throughput**: total generated tokens / wall time of the whole run.
+
+OPEN loop: arrival times are drawn up front from the Poisson process
+and each request is submitted at its scheduled time regardless of how
+many are still in flight — closed-loop generators (wait-for-completion)
+hide queueing collapse, which is exactly what the backpressure path
+must be measured under. Rejected submissions (queue full) are counted,
+not retried.
+
+Usage::
+
+    python tools/serve_bench.py --rate 16 --requests 64
+    python tools/serve_bench.py --url http://127.0.0.1:8000 --rate 8
+    python tools/serve_bench.py --monitor-out run.jsonl   # + monitor dump
+
+Output: one human table plus BENCH-shaped JSON records
+(``{"metric": ..., "value": ..., "unit": ...}``) on stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+# runnable as `python tools/serve_bench.py` from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _percentile(xs, q):
+    if not xs:
+        return float("nan")
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1))))
+    return xs[i]
+
+
+class _Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ttft = []
+        self.tpot = []
+        self.e2e = []
+        self.tokens = 0
+        self.rejected = 0
+        self.failed = 0
+
+    def record(self, ttft, tpot, e2e, n_tokens):
+        with self.lock:
+            if ttft is not None:
+                self.ttft.append(ttft)
+            if tpot is not None:
+                self.tpot.append(tpot)
+            self.e2e.append(e2e)
+            self.tokens += n_tokens
+
+    def reject(self):
+        with self.lock:
+            self.rejected += 1
+
+    def fail(self):
+        with self.lock:
+            self.failed += 1
+
+
+def _drive_inproc(server, prompt, cfg, stats):
+    from paddle_tpu.serving import RequestRejected
+
+    t0 = time.monotonic()
+    try:
+        handle = server.submit(prompt, cfg)
+    except RequestRejected:
+        stats.reject()
+        return
+    first = last = None
+    n = 0
+    try:
+        for _tok in handle.stream(timeout=120):
+            now = time.monotonic()
+            if first is None:
+                first = now
+            last = now
+            n += 1
+    except Exception:
+        stats.fail()
+        return
+    if handle.status != "finished":
+        stats.fail()
+        return
+    end = time.monotonic()
+    stats.record(None if first is None else first - t0,
+                 None if (n < 2 or first is None) else (last - first)
+                 / (n - 1),
+                 end - t0, n)
+
+
+def _drive_http(url, prompt, cfg_body, stats):
+    import http.client
+    from urllib.parse import urlsplit
+
+    parts = urlsplit(url)
+    t0 = time.monotonic()
+    try:
+        conn = http.client.HTTPConnection(parts.hostname, parts.port,
+                                          timeout=120)
+        body = dict(cfg_body)
+        body["prompt"] = [int(t) for t in prompt]
+        body["stream"] = True
+        conn.request("POST", "/generate", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status == 429 or resp.status == 503:
+            stats.reject()
+            return
+        if resp.status != 200:
+            stats.fail()
+            return
+        first = last = None
+        n = 0
+        ok = False
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            rec = json.loads(line)
+            if "token" in rec:
+                now = time.monotonic()
+                if first is None:
+                    first = now
+                last = now
+                n += 1
+            elif rec.get("done"):
+                ok = rec.get("status") == "finished"
+        conn.close()
+    except Exception:
+        stats.fail()
+        return
+    if not ok:
+        stats.fail()
+        return
+    end = time.monotonic()
+    stats.record(None if first is None else first - t0,
+                 None if (n < 2 or first is None) else (last - first)
+                 / (n - 1),
+                 end - t0, n)
+
+
+def _build_toy_server(args):
+    import numpy as np  # noqa: F401
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.generation import (
+        PagedContinuousBatchingEngine)
+    from paddle_tpu.models import LlamaForCausalLM, llama_config
+    from paddle_tpu.serving import Server
+
+    paddle.seed(0)
+    cfg = llama_config("tiny", num_hidden_layers=args.layers)
+    model = LlamaForCausalLM(cfg)
+    eng = PagedContinuousBatchingEngine(
+        model, max_batch=args.max_batch, num_pages=args.num_pages,
+        page_size=args.page_size, max_pages=args.max_pages)
+    return Server(eng, max_queue=args.max_queue,
+                  segment_steps=args.segment_steps), cfg.vocab_size
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="HTTP endpoint (default: in-process toy model)")
+    ap.add_argument("--rate", type=float, default=8.0,
+                    help="mean arrival rate, requests/s (Poisson)")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--prompt-len", default="4:24", metavar="LO:HI",
+                    help="uniform prompt-length range")
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    # in-process toy engine knobs
+    ap.add_argument("--layers", type=int, default=1)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--num-pages", type=int, default=48)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--max-pages", type=int, default=16)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--segment-steps", type=int, default=4)
+    ap.add_argument("--monitor-out", default=None, metavar="JSONL",
+                    help="also dump the in-process monitor registry "
+                         "(in-process mode only)")
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    lo, hi = (int(x) for x in args.prompt_len.split(":"))
+    server = None
+    vocab = 256
+    if args.url is None:
+        from paddle_tpu import monitor
+        monitor.enable()
+        server, vocab = _build_toy_server(args)
+
+    # open loop: the full arrival schedule is drawn BEFORE driving
+    arrivals, t = [], 0.0
+    for _ in range(args.requests):
+        t += rng.expovariate(args.rate)
+        arrivals.append(t)
+    prompts = [[rng.randrange(vocab) for _ in range(rng.randint(lo, hi))]
+               for _ in range(args.requests)]
+
+    stats = _Stats()
+    threads = []
+    t_start = time.monotonic()
+    for i, (at, prompt) in enumerate(zip(arrivals, prompts)):
+        delay = t_start + at - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        if args.url is None:
+            from paddle_tpu.inference.generation import GenerationConfig
+            import numpy as np
+
+            cfg = GenerationConfig(max_new_tokens=args.max_new)
+            th = threading.Thread(
+                target=_drive_inproc,
+                args=(server, np.asarray(prompt, np.int32), cfg, stats))
+        else:
+            th = threading.Thread(
+                target=_drive_http,
+                args=(args.url, prompt,
+                      {"max_new_tokens": args.max_new}, stats))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    wall = time.monotonic() - t_start
+
+    done = len(stats.e2e)
+    print(f"\n{done}/{args.requests} completed, "
+          f"{stats.rejected} rejected, {stats.failed} failed, "
+          f"{stats.tokens} tokens in {wall:.2f}s "
+          f"({stats.tokens / wall:.1f} tok/s)\n")
+    rows = [("ttft", stats.ttft, "s"), ("tpot", stats.tpot, "s"),
+            ("e2e_latency", stats.e2e, "s")]
+    print(f"{'METRIC':<14}{'p50':>10}{'p90':>10}{'p99':>10}")
+    for name, xs, _u in rows:
+        print(f"{name:<14}"
+              f"{_percentile(xs, 50):>10.4f}"
+              f"{_percentile(xs, 90):>10.4f}"
+              f"{_percentile(xs, 99):>10.4f}")
+    print()
+    for name, xs, unit in rows:
+        if not xs:
+            continue   # NaN is not valid JSON; the table above shows it
+        for q in (50, 90, 99):
+            print(json.dumps({"metric": f"serve_{name}_p{q}",
+                              "value": round(_percentile(xs, q), 6),
+                              "unit": unit}))
+    print(json.dumps({"metric": "serve_throughput",
+                      "value": round(stats.tokens / wall, 2),
+                      "unit": "tokens/s"}))
+    print(json.dumps({"metric": "serve_rejected",
+                      "value": stats.rejected, "unit": "count"}))
+
+    if server is not None:
+        if args.monitor_out:
+            from paddle_tpu import monitor
+            n = monitor.write_jsonl(args.monitor_out)
+            print(f"wrote {n} monitor samples to {args.monitor_out}")
+        server.shutdown(drain=False)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
