@@ -12,6 +12,7 @@ not once per token.
 from __future__ import annotations
 
 import functools
+import heapq
 import time
 from typing import Optional
 
@@ -26,7 +27,85 @@ from ..nn.functional_call import substituted_state
 
 __all__ = ["GenerationConfig", "CausalLMEngine",
            "ContinuousBatchingEngine",
-           "PagedContinuousBatchingEngine"]
+           "PagedContinuousBatchingEngine", "prefill_buckets_for"]
+
+
+def prefill_buckets_for(spec, max_len: int, floor: int = 16):
+    """Normalize a ``prefill_buckets`` engine knob to a sorted tuple of
+    pad targets, or None (bucketing disabled — exact-length prefill, one
+    compiled program per distinct prompt length).
+
+    ``"auto"`` (the engines' default) gives powers of two from ``floor``
+    up to ``max_len`` — O(log max_len) prefill programs instead of
+    O(#distinct prompt lengths); an explicit sequence is deduped/sorted
+    and always extended to cover ``max_len`` (every admissible prompt
+    must land in SOME bucket)."""
+    if spec is None:
+        return None
+    if spec == "auto":
+        out = []
+        b = int(floor)
+        if b < 1:
+            raise ValueError(f"bucket floor must be >= 1, got {floor}")
+        while b < max_len:
+            out.append(b)
+            b *= 2
+        out.append(max_len)
+        return tuple(out)
+    out = sorted({int(b) for b in spec})
+    if not out or out[0] < 1:
+        raise ValueError(f"prefill_buckets must be positive ints, got "
+                         f"{spec!r}")
+    if out[-1] > max_len:
+        raise ValueError(
+            f"prefill bucket {out[-1]} exceeds max_len={max_len}")
+    if out[-1] < max_len:
+        out.append(max_len)
+    return tuple(out)
+
+
+def _normalize_prefill_chunk(prefill_chunk, max_len: int):
+    """Validate the ``prefill_chunk`` engine knob (shared by all
+    engines). ``max_len`` must be a multiple of the chunk: chunks start
+    at multiples of C, so divisibility is exactly what guarantees every
+    (padded) chunk window [pos, pos+C) stays inside the cache — an
+    overhanging final chunk would be CLAMPED by dynamic_update_slice
+    and silently overwrite earlier prompt KV."""
+    if prefill_chunk is None:
+        return None
+    if isinstance(prefill_chunk, bool) or not isinstance(
+            prefill_chunk, (int, np.integer)) or prefill_chunk < 1:
+        raise ValueError(
+            f"prefill_chunk must be a positive int or None, got "
+            f"{prefill_chunk!r}")
+    if max_len % int(prefill_chunk) != 0:
+        raise ValueError(
+            f"max_len({max_len}) must be a multiple of "
+            f"prefill_chunk({int(prefill_chunk)}) — a final chunk "
+            "overhanging the cache would clamp and corrupt earlier KV")
+    return int(prefill_chunk)
+
+
+def _bucket_for(buckets, plen: int) -> int:
+    """Smallest bucket >= plen (buckets sorted, last == max_len)."""
+    for b in buckets:
+        if b >= plen:
+            return b
+    return buckets[-1]
+
+
+def _pad_ids(ids: np.ndarray, width: int) -> np.ndarray:
+    """Right-pad [B, plen] token ids to [B, width] (pad id 0). Padded
+    prefill is numerically identical to exact prefill: causal masking
+    means no REAL query position ever attends a pad key, the engines
+    read logits at the true last position (not -1), and the garbage KV
+    the pad tail writes past plen is masked by every decode read (all
+    decode attention is length-masked) and overwritten as the sequence
+    grows."""
+    plen = ids.shape[1]
+    if plen >= width:
+        return ids
+    return np.pad(ids, ((0, 0), (0, width - plen)))
 
 
 class GenerationConfig:
@@ -211,22 +290,41 @@ class CausalLMEngine:
         out_ids = eng.generate(prompt_ids, GenerationConfig(max_new_tokens=64))
     """
 
-    def __init__(self, model, max_batch: int, max_len: int):
+    def __init__(self, model, max_batch: int, max_len: int,
+                 prefill_buckets="auto",
+                 prefill_chunk: Optional[int] = None):
         self.model = model
         self.max_batch = max_batch
         self.max_len = max_len
+        self.prefill_buckets = prefill_buckets_for(prefill_buckets,
+                                                   max_len)
+        self.prefill_chunk = _normalize_prefill_chunk(prefill_chunk,
+                                                      max_len)
         self.params = {k: p.value for k, p in model.named_parameters()}
 
-        def prefill(params, ids, caches):
+        def prefill(params, ids, caches, last_idx):
             logits, caches = self._fwd(params, ids, caches, 0)
-            return logits[:, -1], caches
+            return logits[:, last_idx], caches
 
-        # one jitted prefill: jax.jit's own cache already specializes per
-        # prompt-length/batch shape. decode stays keyed by GenerationConfig
-        # because the config is *trace-static* (branching on do_sample/eos),
-        # not shape-derived.
+        # jax.jit's own cache specializes per ids shape — with bucketing
+        # the prompt is padded to one of O(log max_len) widths, so the
+        # compiled prefill program count is bounded by len(buckets)
+        # instead of #distinct prompt lengths. last_idx (the true last
+        # prompt position) is a traced value, not a shape. decode stays
+        # keyed by GenerationConfig because the config is *trace-static*
+        # (branching on do_sample/eos), not shape-derived.
         self._prefill = monitor.monitored_jit(prefill, name="lm_prefill",
                                               donate_argnums=(2,))
+
+        def prefill_chunk_fn(params, ids, caches, pos, last_idx):
+            # pos is TRACED: one compiled program serves every chunk of
+            # every prompt (llama routes traced-offset prefill through
+            # ops.pallas.prefix_chunk_attention)
+            logits, caches = self._fwd(params, ids, caches, pos)
+            return logits[:, last_idx], caches
+
+        self._prefill_chunk = monitor.monitored_jit(
+            prefill_chunk_fn, name="lm_prefill_chunk", donate_argnums=(2,))
         self._decode_cache = {}
 
     # -- pure functions -------------------------------------------------------
@@ -239,8 +337,30 @@ class CausalLMEngine:
         return (logits.value if isinstance(logits, Tensor) else logits,
                 caches)
 
-    def _prefill_fn(self, prompt_len: int):
-        return self._prefill
+    def _run_prefill(self, ids: np.ndarray, caches):
+        """Bounded-compile prefill dispatch: chunked for prompts longer
+        than ``prefill_chunk`` (fixed-shape chunks at traced offsets —
+        ONE compiled program reused for every chunk), else padded up to
+        the covering bucket. Returns (last-position logits [B, V],
+        caches)."""
+        plen = ids.shape[1]
+        C = self.prefill_chunk
+        if C is not None and plen > C:
+            pos = 0
+            while pos < plen:
+                chunk = ids[:, pos:pos + C]
+                r = chunk.shape[1]
+                if r < C:       # only the FINAL chunk may be partial
+                    chunk = _pad_ids(chunk, C)
+                last_logits, caches = self._prefill_chunk(
+                    self.params, chunk, caches, jnp.int32(pos),
+                    jnp.int32(r - 1))
+                pos += C
+            return last_logits, caches
+        width = (plen if self.prefill_buckets is None
+                 else _bucket_for(self.prefill_buckets, plen))
+        return self._prefill(self.params, _pad_ids(ids, width), caches,
+                             jnp.int32(plen - 1))
 
     def _decode_fn(self, n_steps: int, cfg: GenerationConfig):
         key_cfg = (n_steps, cfg.do_sample, cfg.temperature, cfg.top_k,
@@ -291,7 +411,7 @@ class CausalLMEngine:
                 f"prompt({plen}) + max_new_tokens({cfg.max_new_tokens}) "
                 f"exceeds engine max_len({self.max_len})")
         caches = self.model.init_cache(b, self.max_len)
-        last_logits, caches = self._prefill_fn(plen)(self.params, ids, caches)
+        last_logits, caches = self._run_prefill(ids, caches)
         key = jax.random.PRNGKey(cfg.seed)
         key, sub = jax.random.split(key)
         first = _sample(last_logits, sub, cfg)
@@ -366,8 +486,7 @@ class CausalLMEngine:
                 f"prompt({plen}) + max_new_tokens({cfg.max_new_tokens}) "
                 f"exceeds engine max_len({self.max_len})")
         caches = self.model.init_cache(1, self.max_len)
-        last_logits, caches = self._prefill_fn(plen)(self.params, ids,
-                                                     caches)
+        last_logits, caches = self._run_prefill(ids, caches)
         ctx = [int(t) for t in ids[0]]
         out = [int(np.argmax(np.asarray(last_logits[0])))]
         ctx.append(out[0])
@@ -435,6 +554,30 @@ class CausalLMEngine:
         return np.concatenate([ids, np.asarray([out], np.int32)], axis=1)
 
 
+class _ChunkedAdmission:
+    """Host-side state of one in-flight CHUNKED admission. The slot (and,
+    paged, the request's worst-case pages) is already claimed; ``mini``
+    accumulates the prompt's KV chunk by chunk until the final chunk
+    installs it and the request goes live under ``rid``. Drive with
+    ``engine.admit_chunk``; reclaim with ``engine.abort_admit``."""
+
+    __slots__ = ("rid", "slot", "ids", "plen", "cfg", "mini", "off",
+                 "t0", "closed", "chunks_done", "last_logits")
+
+    def __init__(self, rid, slot, ids, plen, cfg, mini):
+        self.rid = rid
+        self.slot = slot
+        self.ids = ids
+        self.plen = plen
+        self.cfg = cfg
+        self.mini = mini
+        self.off = 0
+        self.t0 = time.perf_counter()
+        self.closed = False
+        self.chunks_done = 0
+        self.last_logits = None
+
+
 class ContinuousBatchingEngine:
     """Ragged / continuous batching decode service.
 
@@ -455,7 +598,15 @@ class ContinuousBatchingEngine:
     - one compiled segment program serves every slot occupancy pattern
       AND every mix of per-request GenerationConfigs (slot ids, lengths
       and sampling parameters are traced values, not shapes or trace
-      constants — see ``_sample_rows``).
+      constants — see ``_sample_rows``);
+    - prefill compiles are BOUNDED: prompts pad to ``prefill_buckets``
+      (default powers of two — len(buckets) compiled prefill programs,
+      not one per distinct prompt length, all pre-compilable via
+      :meth:`warmup`), and prompts longer than ``prefill_chunk`` can
+      admit chunk-by-chunk across inter-segment gaps
+      (:meth:`begin_admit` / :meth:`admit_chunk`) so one long prompt
+      never monopolizes the gap. Both are numerically exact — see
+      PERF.md "Prefill cost".
 
     Usage::
 
@@ -463,10 +614,16 @@ class ContinuousBatchingEngine:
         outs = eng.serve([ids1, ids2, ...], GenerationConfig(...))
     """
 
-    def __init__(self, model, max_batch: int, max_len: int):
+    def __init__(self, model, max_batch: int, max_len: int,
+                 prefill_buckets="auto",
+                 prefill_chunk: Optional[int] = None):
         self.model = model
         self.max_batch = max_batch
         self.max_len = max_len
+        self.prefill_buckets = prefill_buckets_for(prefill_buckets,
+                                                   max_len)
+        self.prefill_chunk = _normalize_prefill_chunk(prefill_chunk,
+                                                      max_len)
         # engine label: concurrent engines (multi-model serving) publish
         # throughput side by side; retired via close()/__del__
         self._monitor_engine = monitor.instance_label("engine")
@@ -496,12 +653,25 @@ class ContinuousBatchingEngine:
         self._next_req = 0
         self._segments_run = 0         # PRNG stream position for sampling
 
-        def prefill_one(params, ids, mini):
+        def prefill_one(params, ids, mini, last_idx):
+            # last_idx (the true last prompt position of a BUCKET-padded
+            # prompt) is traced: compiled programs are keyed per bucket
+            # width, not per prompt length
             logits, mini = self._fwd_prefill(params, ids, mini)
-            return logits[:, -1], mini
+            return logits[:, last_idx], mini
 
         self._prefill = monitor.monitored_jit(
             prefill_one, name="cb_prefill", donate_argnums=(2,))
+
+        def prefill_chunk_fn(params, ids, mini, pos, last_idx):
+            # traced offset -> ops.pallas.prefix_chunk_attention: ONE
+            # compiled program serves every chunk of every admission
+            logits, mini = self._fwd_prefill(params, ids, mini, pos)
+            return logits[:, last_idx], mini
+
+        self._prefill_chunk = monitor.monitored_jit(
+            prefill_chunk_fn, name="cb_prefill_chunk",
+            donate_argnums=(2,))
 
         def admit(caches, mini, slot):
             return jax.tree.map(
@@ -544,12 +714,12 @@ class ContinuousBatchingEngine:
         [max_batch, max_len] slabs with page pools."""
         return self.model.init_cache(self.max_batch, self.max_len)
 
-    def _fwd_prefill(self, params, ids, caches):
+    def _fwd_prefill(self, params, ids, caches, pos=0):
         from ..core.autograd import no_grad
 
         with substituted_state(self.model, params), no_grad():
             logits, caches = self.model.forward_with_cache(
-                Tensor(ids), caches, 0)
+                Tensor(ids), caches, pos)
         return (logits.value if isinstance(logits, Tensor) else logits,
                 caches)
 
@@ -606,33 +776,50 @@ class ContinuousBatchingEngine:
         if not self._can_admit(plen, cfg):
             raise RuntimeError(
                 "page pool exhausted; drain with decode_segment()")
-        slot = self._free.pop(0)
+        slot = heapq.heappop(self._free)
         try:
             rid = self._next_req
             self._next_req += 1
             last_logits = self._admit_cache(slot, ids, plen, cfg)
-            key = jax.random.PRNGKey(cfg.seed + rid)
-            first = _sample(last_logits, key, cfg)[0]
-            tok_done = (jnp.asarray(False) if cfg.eos_token_id is None
-                        else first == cfg.eos_token_id)
-            # the per-slot scalars AND the request's sampling parameters
-            # update in ONE jitted program (shared by the dense and
-            # paged engines) instead of separate dispatches
-            eos = -1 if cfg.eos_token_id is None else cfg.eos_token_id
-            (self.lens, self.last, self.done_dev, self.active_dev,
-             self.samp) = self._admit_state(
-                self.lens, self.last, self.done_dev, self.active_dev,
-                self.samp, jnp.int32(slot), jnp.int32(plen), first,
-                tok_done, jnp.float32(cfg.temperature),
-                jnp.int32(cfg.top_k), jnp.float32(cfg.top_p),
-                jnp.asarray(cfg.do_sample), jnp.int32(eos),
-                jnp.int32(cfg.seed % (2 ** 31)))
+            first, tok_done = self._sample_first(rid, last_logits, cfg)
+            self._install_state(slot, plen, first, tok_done, cfg)
         except BaseException:
             # a failed admission must not leak capacity: the popped
             # slot (and, paged, any page reservation _admit_cache made)
             # goes back to the pool before the error propagates
             self._abort_admit(slot)
             raise
+        return self._register(slot, rid, first, tok_done, cfg, t0)
+
+    def _sample_first(self, rid: int, last_logits, cfg):
+        """Sample the admission's first token from the prompt's
+        last-position logits."""
+        key = jax.random.PRNGKey(cfg.seed + rid)
+        first = _sample(last_logits, key, cfg)[0]
+        tok_done = (jnp.asarray(False) if cfg.eos_token_id is None
+                    else first == cfg.eos_token_id)
+        return first, tok_done
+
+    def _install_state(self, slot: int, plen: int, first, tok_done,
+                       cfg) -> None:
+        """Install the request's per-slot scalars AND sampling parameters
+        in ONE jitted program (shared by the dense and paged engines)
+        instead of separate dispatches."""
+        eos = -1 if cfg.eos_token_id is None else cfg.eos_token_id
+        (self.lens, self.last, self.done_dev, self.active_dev,
+         self.samp) = self._admit_state(
+            self.lens, self.last, self.done_dev, self.active_dev,
+            self.samp, jnp.int32(slot), jnp.int32(plen), first,
+            tok_done, jnp.float32(cfg.temperature),
+            jnp.int32(cfg.top_k), jnp.float32(cfg.top_p),
+            jnp.asarray(cfg.do_sample), jnp.int32(eos),
+            jnp.int32(cfg.seed % (2 ** 31)))
+
+    def _register(self, slot: int, rid: int, first, tok_done, cfg,
+                  t0: float) -> int:
+        """Host-side bookkeeping tail of a completed admission (one-shot
+        or chunked): record the request, retire degenerate ones, count
+        metrics. Runs OUTSIDE the abort guard — no device call left."""
         self._slot_req[slot] = rid
         self._tokens[rid] = [int(first)]
         self._budget[rid] = cfg.max_new_tokens - 1
@@ -656,21 +843,56 @@ class ContinuousBatchingEngine:
                 "(admission first-token + decode segments)").inc()
         return rid
 
+    # -- bounded-compile prefill helpers -------------------------------------
+    def _prefill_width(self, plen: int) -> int:
+        """Pad target for a plen-token prompt (plen itself when
+        bucketing is disabled)."""
+        if self.prefill_buckets is None:
+            return plen
+        return _bucket_for(self.prefill_buckets, plen)
+
+    def _count_prefill(self, bucket) -> None:
+        if monitor.enabled():
+            monitor.counter(
+                "paddle_tpu_prefill_requests_total",
+                "admission prefills by engine and padded bucket width "
+                "('chunked' = chunked admission)",
+                ("engine", "bucket")).labels(
+                engine=self._monitor_engine, bucket=str(bucket)).inc()
+
+    def _run_prefill(self, ids, plen: int, mini):
+        """Pad the prompt to its bucket and run the one-shot prefill
+        program; returns (last-position logits [1, V], mini)."""
+        width = self._prefill_width(plen)
+        self._count_prefill(width if self.prefill_buckets is not None
+                            else "exact")
+        return self._prefill(self.params, _pad_ids(ids, width), mini,
+                             jnp.int32(plen - 1))
+
     def _admit_cache(self, slot: int, ids, plen: int, cfg):
         """Cache-layout hook: prefill the prompt and install its KV into
         slot's cache; returns the prompt's last-position logits. The
         dense base scatters a max_len mini cache; the paged subclass
-        reserves pages and scatters a prompt-sized one."""
+        reserves pages and scatters a bucket-sized one."""
         mini = self.model.init_cache(1, self.max_len)
-        last_logits, mini = self._prefill(self.params, ids, mini)
-        self.caches = self._admit(self.caches, mini, jnp.int32(slot))
+        last_logits, mini = self._run_prefill(ids, plen, mini)
+        self._install_mini(slot, mini, plen)
         return last_logits
+
+    def _reserve_admit(self, slot: int, plen: int, cfg) -> None:
+        """Claim everything (beyond the slot) the admission will need UP
+        FRONT — the paged override reserves the worst-case pages — so a
+        chunked admission can never fail for capacity halfway through."""
+
+    def _install_mini(self, slot: int, mini, plen: int) -> None:
+        """Install a prefilled mini cache into ``slot``'s share of the
+        pool (dense: scatter the max_len slab row)."""
+        self.caches = self._admit(self.caches, mini, jnp.int32(slot))
 
     def _abort_admit(self, slot: int) -> None:
         """Undo a failed admission's capacity claim (slot back to the
         free list; the paged override also releases pages)."""
-        self._free.append(slot)
-        self._free.sort()
+        heapq.heappush(self._free, slot)
 
     def _retire(self, slot, event: str = "finished"):
         rid = self._slot_req.pop(slot)
@@ -681,8 +903,10 @@ class ContinuousBatchingEngine:
         # drop the slot's sampled flag so an all-greedy batch regains
         # the _sample_rows fast path once sampled requests retire
         self.samp["sample"] = self.samp["sample"].at[slot].set(False)
-        self._free.append(slot)
-        self._free.sort()
+        # heap, not append+sort: retire/abort run in the latency-critical
+        # inter-segment gap, and admission must stay deterministic
+        # (lowest free slot first) without an O(n log n) sort per event
+        heapq.heappush(self._free, slot)
         if monitor.enabled():
             monitor.counter(
                 "paddle_tpu_requests_total",
@@ -717,6 +941,175 @@ class ContinuousBatchingEngine:
         None when ``rid`` is not active."""
         toks = self._tokens.get(rid)
         return None if toks is None else list(toks[start:])
+
+    # -- chunked admission (host-driven, one chunk per inter-segment gap) ----
+    def begin_admit(self, prompt_ids, cfg: GenerationConfig):
+        """Start a CHUNKED admission: claim the slot AND (paged) the
+        request's worst-case pages up front — the existing
+        ``_can_admit``/``_abort_admit`` contract, so a partial admission
+        can never leak capacity or fail for capacity halfway through —
+        then return the admission object. The caller (the serving
+        scheduler's gap) drives ONE fixed-shape prefill chunk per
+        :meth:`admit_chunk` call, interleaving decode segments between
+        chunks so a long prompt never monopolizes the gap.
+
+        Raises like ``add_request`` when the request cannot be admitted
+        RIGHT NOW (probe :meth:`can_admit` first) and RuntimeError when
+        the engine was built without ``prefill_chunk``."""
+        if self.prefill_chunk is None:
+            raise RuntimeError(
+                "chunked admission needs an engine built with "
+                "prefill_chunk=<tokens>")
+        if not self._free:
+            raise RuntimeError("no free slot; drain with decode_segment()")
+        ids = _prompt_ids(prompt_ids)
+        plen = ids.shape[1]
+        if plen + cfg.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt({plen}) + max_new_tokens({cfg.max_new_tokens}) "
+                f"exceeds engine max_len({self.max_len})")
+        if not self._can_admit(plen, cfg):
+            raise RuntimeError(
+                "page pool exhausted; drain with decode_segment()")
+        slot = heapq.heappop(self._free)
+        try:
+            self._reserve_admit(slot, plen, cfg)
+            # chunk programs are keyed on the FIXED (chunk, max_len)
+            # shapes, so every chunked admission shares one compiled
+            # program (the paged engine pays a transient dense mini slab
+            # for the admission's lifetime — same slab the dense engine
+            # always uses)
+            mini = self.model.init_cache(1, self.max_len)
+        except BaseException:
+            self._abort_admit(slot)
+            raise
+        rid = self._next_req
+        self._next_req += 1
+        self._count_prefill("chunked")
+        return _ChunkedAdmission(rid, slot, ids, plen, cfg, mini)
+
+    def admit_chunk(self, adm: _ChunkedAdmission) -> bool:
+        """Run ONE fixed-shape prefill chunk of an admission started
+        with :meth:`begin_admit`. Returns True when the admission
+        completed — the request is live in its slot under ``adm.rid``
+        (its first token is in ``partial_tokens``). On ANY failure the
+        claimed capacity is reclaimed and the admission is closed."""
+        if adm.closed:
+            raise RuntimeError("admission already completed or aborted")
+        C = self.prefill_chunk
+        try:
+            chunk = adm.ids[:, adm.off:adm.off + C]
+            r = chunk.shape[1]
+            last = adm.off + r >= adm.plen
+            if r < C:       # only the FINAL chunk may be partial
+                chunk = _pad_ids(chunk, C)
+            adm.last_logits, adm.mini = self._prefill_chunk(
+                self.params, chunk, adm.mini, jnp.int32(adm.off),
+                jnp.int32(r - 1))
+            adm.off += C
+            adm.chunks_done += 1
+            if monitor.enabled():
+                monitor.counter(
+                    "paddle_tpu_prefill_chunks_total",
+                    "fixed-shape prefill chunks run by chunked "
+                    "admissions", ("engine",)).labels(
+                    engine=self._monitor_engine).inc()
+            if not last:
+                return False
+            self._install_mini(adm.slot, adm.mini, adm.plen)
+            first, tok_done = self._sample_first(adm.rid,
+                                                 adm.last_logits,
+                                                 adm.cfg)
+            self._install_state(adm.slot, adm.plen, first, tok_done,
+                                adm.cfg)
+        except BaseException:
+            adm.closed = True
+            self._abort_admit(adm.slot)
+            raise
+        adm.closed = True
+        self._register(adm.slot, adm.rid, first, tok_done, adm.cfg,
+                       adm.t0)
+        return True
+
+    def abort_admit(self, adm: _ChunkedAdmission) -> None:
+        """Abandon an in-flight chunked admission (client cancelled mid
+        prefill): the slot and any page reservation return to the pool.
+        Idempotent; the admission is closed either way."""
+        if adm.closed:
+            return
+        adm.closed = True
+        self._abort_admit(adm.slot)
+
+    # -- warmup (off the request path) ---------------------------------------
+    def warmup(self, segment_steps: Optional[int] = None):
+        """Pre-compile every program a request can hit on the serving
+        path — one prefill per bucket, the chunked-prefill program, the
+        cache-install and slot-state programs, and (when
+        ``segment_steps`` is given) the decode segment — so no user
+        request ever pays an XLA compile inside the latency-critical
+        gap. Compile time lands on the existing ``monitored_jit``
+        counters (``paddle_tpu_jit_cache_miss_total`` /
+        ``jit_compile_seconds_total``). Only valid on an IDLE engine;
+        returns {program_name: seconds}.
+        """
+        if self._slot_req:
+            raise RuntimeError("warmup() needs an idle engine")
+        t_all = time.perf_counter()
+        out = {}
+        # with bucketing DISABLED prompt lengths (and so prefill
+        # programs) are unbounded — warmup cannot cover them, so it
+        # warms only the length-independent programs
+        widths = self.prefill_buckets or ()
+        for w in widths:
+            t0 = time.perf_counter()
+            ids = np.zeros((1, w), np.int32)
+            mini = self._warmup_mini(w)
+            _, mini = self._prefill(self.params, ids, mini,
+                                    jnp.int32(w - 1))
+            # also warms the per-bucket cache-install program; slot 0 is
+            # free, so the zero-prompt KV it scatters is dead weight the
+            # next admission overwrites (paged: dropped — no pages
+            # mapped)
+            self._install_mini(0, mini, w)
+            out[f"prefill_{w}"] = time.perf_counter() - t0
+        if self.prefill_chunk is not None:
+            t0 = time.perf_counter()
+            mini = self.model.init_cache(1, self.max_len)
+            self._prefill_chunk(self.params,
+                                np.zeros((1, self.prefill_chunk),
+                                         np.int32),
+                                mini, jnp.int32(0), jnp.int32(0))
+            out["prefill_chunk"] = time.perf_counter() - t0
+        # slot-state install program (values match the initial state,
+        # except the active flag — reset below)
+        t0 = time.perf_counter()
+        self._install_state(0, 0, jnp.int32(0), jnp.asarray(False),
+                            GenerationConfig(max_new_tokens=1))
+        self.active_dev = self.active_dev.at[0].set(False)
+        out["admit_state"] = time.perf_counter() - t0
+        if segment_steps is not None:
+            # with every slot inactive the segment is a semantic no-op
+            # (live rows mask to nothing), so running it only compiles
+            t0 = time.perf_counter()
+            key = jax.random.PRNGKey(0)
+            (_, self.last, self.lens, self.done_dev, self.caches) = \
+                self._segment_fn(segment_steps)(
+                    self.params, self.last, self.lens, self.done_dev,
+                    self.active_dev, self.samp, self.caches, key)
+            out[f"segment_{segment_steps}"] = time.perf_counter() - t0
+        out["total"] = time.perf_counter() - t_all
+        if monitor.enabled():
+            monitor.gauge(
+                "paddle_tpu_prefill_warmup_seconds",
+                "wall seconds engine.warmup() spent pre-compiling the "
+                "serving-path programs", ("engine",)).labels(
+                engine=self._monitor_engine).set(out["total"])
+        return out
+
+    def _warmup_mini(self, width: int):
+        """Mini cache matching what an admission of a width-token prompt
+        allocates (dense: the max_len slab; paged: bucket-sized)."""
+        return self.model.init_cache(1, self.max_len)
 
     def _segment_fn(self, n_steps: int):
         # keyed on n_steps ALONE: sampling parameters ride as per-slot
@@ -819,6 +1212,16 @@ class ContinuousBatchingEngine:
                 engine=self._monitor_engine)
         except Exception:
             pass
+        # per-engine prefill series retire with the engine too, else a
+        # dropped engine's label values accumulate in the registry (the
+        # bucket dimension is open-ended, so retire by engine label)
+        for name in ("paddle_tpu_prefill_requests_total",
+                     "paddle_tpu_prefill_chunks_total",
+                     "paddle_tpu_prefill_warmup_seconds"):
+            try:
+                monitor.remove_series(name, engine=self._monitor_engine)
+            except Exception:
+                pass
         alloc = getattr(self, "alloc", None)
         if alloc is not None:
             alloc.close()
@@ -885,7 +1288,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     """
 
     def __init__(self, model, max_batch: int, num_pages: int,
-                 page_size: int, max_pages: int):
+                 page_size: int, max_pages: int,
+                 prefill_buckets="auto",
+                 prefill_chunk: Optional[int] = None):
         from .paged_cache import PageAllocator
 
         self.num_pages = num_pages
@@ -893,7 +1298,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.alloc = PageAllocator(num_pages, page_size, max_batch,
                                    max_pages)
         super().__init__(model, max_batch,
-                         max_len=max_pages * page_size)
+                         max_len=max_pages * page_size,
+                         prefill_buckets=prefill_buckets,
+                         prefill_chunk=prefill_chunk)
 
     def _make_caches(self):
         return (self.model.init_paged_cache(self.num_pages,
@@ -919,25 +1326,41 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         return self.alloc.can_fit(probe, self._reserved(prompt_len, cfg))
 
     def _admit_cache(self, slot: int, ids, plen: int, cfg):
+        # prefill into a dense mini cache sized to the prompt's BUCKET
+        # (no max_len slab — the pool is the whole point; the bucket
+        # keys the compiled program count to O(len(buckets))), then
+        # scatter the prompt's KV rows into freshly reserved pages
+        mini = self.model.init_cache(1, self._prefill_width(plen))
+        last_logits, mini = self._run_prefill(ids, plen, mini)
+        self._reserve_admit(slot, plen, cfg)
+        self._install_mini(slot, mini, plen)
+        return last_logits
+
+    def _reserve_admit(self, slot: int, plen: int, cfg) -> None:
+        self.alloc.ensure(slot, self._reserved(plen, cfg))
+
+    def _install_mini(self, slot: int, mini, plen: int) -> None:
         from .paged_cache import write_tokens
 
-        # prefill into a dense mini cache sized to the PROMPT (no
-        # max_len slab — the pool is the whole point), then scatter the
-        # prompt's KV rows into freshly reserved pages
-        mini = self.model.init_cache(1, plen)
-        last_logits, mini = self._prefill(self.params, ids, mini)
-        self.alloc.ensure(slot, self._reserved(plen, cfg))
+        # scatter bucket-width rows (fixed shapes per bucket — the
+        # scatter program count stays O(len(buckets)), not O(#plens)):
+        # rows past plen land on reserved-but-unwritten positions the
+        # decode mask hides and decode writes overwrite, or on unmapped
+        # pages where write_tokens drops them
+        width = min(self._prefill_width(plen), mini[0][0].shape[1])
         pt = jnp.asarray(self.alloc.page_table)
-        slots_v = jnp.full((plen,), slot, jnp.int32)
-        pos_v = jnp.arange(plen, dtype=jnp.int32)
+        slots_v = jnp.full((width,), slot, jnp.int32)
+        pos_v = jnp.arange(width, dtype=jnp.int32)
         pools, _ = self.caches
         new_pools = []
         for (kp, vp), (mk, mv) in zip(pools, mini):
-            kp, vp = write_tokens(kp, vp, pt, slots_v, pos_v, mk[0],
-                                  mv[0])
+            kp, vp = write_tokens(kp, vp, pt, slots_v, pos_v,
+                                  mk[0, :width], mv[0, :width])
             new_pools.append((kp, vp))
         self.caches = (new_pools, pt)
-        return last_logits
+
+    def _warmup_mini(self, width: int):
+        return self.model.init_cache(1, width)
 
     def _abort_admit(self, slot: int) -> None:
         super()._abort_admit(slot)

@@ -20,6 +20,7 @@ ride inside compiled segment programs.
 from __future__ import annotations
 
 import functools
+import heapq
 from typing import Dict, List
 
 import jax
@@ -161,7 +162,10 @@ class PageAllocator:
                 f"{len(self._free)} free — drain finished requests or "
                 "grow num_pages")
         for _ in range(need):
-            pid = self._free.pop(0)
+            # heap pop (lowest page id first): ensure/free run in the
+            # latency-critical inter-segment gap — a list pop(0) is O(n)
+            # per page and the free() re-sort O(n log n) per retirement
+            pid = heapq.heappop(self._free)
             self.page_table[slot, len(owned)] = pid
             owned.append(pid)
         self._publish_occupancy()
@@ -169,8 +173,7 @@ class PageAllocator:
     def free_slot(self, slot: int) -> None:
         """Return the slot's pages to the pool (request retired)."""
         for pid in self._owned.pop(slot, []):
-            self._free.append(pid)
-        self._free.sort()
+            heapq.heappush(self._free, pid)
         self.page_table[slot, :] = -1
         self._publish_occupancy()
 

@@ -174,15 +174,14 @@ class LlamaAttention(Layer):
 
                 ctx = _flash(qh, kh, vh, causal=True)
             else:
-                # chunked prefill at a traced offset: masked SDPA over the
-                # written prefix of the cache
-                from ..nn.functional.flash_attention import _sdpa_ref
+                # chunked prefill / spec-verify at a traced offset: the
+                # online-softmax prefix attention shares its reduction
+                # structure with the one-shot flash fallback, so chunked
+                # and padded-bucket prefill reproduce single-shot prefill
+                # bitwise (ops/pallas.prefix_chunk_attention)
+                from ..ops.pallas import prefix_chunk_attention
 
-                sq_pos = pos + jnp.arange(s)
-                kv_pos = jnp.arange(kc.shape[1])
-                mask = (kv_pos[None, :] <= sq_pos[:, None])
-                ctx = _sdpa_ref(qh, kc, vc,
-                                mask=mask[None, None], causal=False)
+                ctx = prefix_chunk_attention(qh, kc, vc, pos)
             return ctx.reshape(b, s, self.num_heads * hd), kc, vc
 
         ctx, kc, vc = apply_op(attend, q, k, v, k_cache, v_cache,

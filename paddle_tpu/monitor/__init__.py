@@ -54,6 +54,7 @@ __all__ = [
     "counter", "gauge", "histogram", "register_callback",
     "enable", "disable", "enabled",
     "snapshot", "render_prometheus", "write_jsonl", "reset",
+    "remove_series",
     "start_http_server", "http_payload", "monitored_jit",
     "instance_label",
     "install_op_hook", "uninstall_op_hook",
@@ -345,6 +346,27 @@ def reset() -> None:
     with _lock:
         for m in _REGISTRY.values():
             m.clear()
+
+
+def remove_series(name: str, **match) -> int:
+    """Drop every label combination of metric ``name`` whose labels
+    include ``match`` as a subset (idempotent; unknown metrics are a
+    no-op). The instance-retirement idiom for metrics with OPEN label
+    dimensions — an engine owning ``{engine=engineN, bucket=*}`` series
+    can't enumerate the bucket values it emitted, so it retires by the
+    ``engine`` label alone. Returns the number of series removed."""
+    with _lock:
+        metric = _REGISTRY.get(name)
+    if metric is None:
+        return 0
+    removed = 0
+    with metric._lock:
+        for key in list(metric._values):
+            labels = dict(zip(metric.labelnames, key))
+            if all(labels.get(k) == v for k, v in match.items()):
+                metric._values.pop(key, None)
+                removed += 1
+    return removed
 
 
 # -- enable / disable -------------------------------------------------------

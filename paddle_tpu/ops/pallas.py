@@ -19,7 +19,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention", "paged_attention", "grouped_matmul"]
+__all__ = ["flash_attention", "paged_attention", "grouped_matmul",
+           "prefix_chunk_attention"]
 
 
 def _on_tpu() -> bool:
@@ -30,9 +31,15 @@ def _on_tpu() -> bool:
 
 
 def _chunked_attention(q, k, v, causal: bool, sm_scale: float,
-                       chunk: int = 512):
+                       chunk: int = 512, q_offset=None):
     """Memory-efficient attention fallback: online-softmax over key chunks
-    (the flash-attention recurrence expressed in XLA; no [S,S] buffer)."""
+    (the flash-attention recurrence expressed in XLA; no [S,S] buffer).
+
+    ``q_offset`` (a traced int32, or None) switches the causal mask to
+    ABSOLUTE positions: query row i sits at position ``q_offset + i`` and
+    attends keys at ``kpos <= q_offset + i`` — the chunked-prefill form,
+    where q is one fixed-shape chunk of a prompt and k/v are the whole
+    (partially written) KV cache."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nchunk = max(1, (sk + chunk - 1) // chunk)
@@ -54,7 +61,11 @@ def _chunked_attention(q, k, v, causal: bool, sm_scale: float,
         s = s.astype(jnp.float32)
         kpos = idx * csize + jnp.arange(csize)
         valid = kpos < sk
-        if causal:
+        if q_offset is not None:
+            # absolute-position causal: the chunked-prefill mask
+            valid = valid[None, :] & (
+                q_offset + qpos[:, None] >= kpos[None, :])
+        elif causal:
             # bottom-right alignment (queries end at the last key): the
             # decode-with-KV-cache convention, matching _sdpa_ref's
             # tril(k=sk-sq) — query i attends keys <= i + (sk - sq)
@@ -132,6 +143,39 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: float = None,
             kt = jnp.repeat(kt, rep, axis=1)
             vt = jnp.repeat(vt, rep, axis=1)
         out = _chunked_attention(qt, kt, vt, causal, scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def prefix_chunk_attention(q, k_cache, v_cache, pos, sm_scale: float = None):
+    """Chunked/padded-prefill attention: queries at ABSOLUTE positions
+    ``[pos, pos+S)`` attend causally over the written prefix of a KV
+    cache (the chunk's own K/V already written at ``[pos, pos+S)``).
+
+    q: [B, S, H, D]; k/v_cache: [B, W, Hkv, D] (GQA allowed); pos: traced
+    int32 scalar. Returns [B, S, H, D] in q's dtype.
+
+    This is the SAME online-softmax recurrence as the one-shot
+    ``flash_attention`` fallback — masked-out cache columns contribute
+    exact float zeros to every reduction — so at cache widths within one
+    key chunk (<= 512) a prompt prefilled in fixed-shape chunks at traced
+    offsets, or padded up to a length bucket, reproduces single-shot
+    prefill logits and KV BITWISE (beyond one chunk the key-chunk
+    boundaries differ between widths and identity degrades to ~1-ulp).
+    The serving engines' bounded-compile prefill rides on this: one
+    compiled program per (chunk shape, cache width), reused at every
+    offset, instead of one per distinct prompt length.
+    """
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2)          # [B, H, S, D]
+    kt = jnp.swapaxes(k_cache, 1, 2)
+    vt = jnp.swapaxes(v_cache, 1, 2)
+    if kt.shape[1] != qt.shape[1]:      # GQA fallback: materialize groups
+        rep = qt.shape[1] // kt.shape[1]
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    out = _chunked_attention(qt, kt, vt, causal=False, sm_scale=scale,
+                             q_offset=pos)
     return jnp.swapaxes(out, 1, 2)
 
 

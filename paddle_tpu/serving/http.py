@@ -28,9 +28,11 @@ Routes:
   full (with ``Retry-After``), 503 draining/shutdown, 504 admission
   deadline expired.
 
-- ``GET /healthz`` — ``{"status": "ok"|"draining", "queue_depth",
+- ``GET /healthz`` — ``{"status": "warming"|"ok"|"draining", "queue_depth",
   "free_slots", "active_requests"}`` (load balancers drain on
-  non-"ok").
+  non-"ok"). HTTP 200 only for "ok"/"draining": a ``Server(warmup=True)``
+  still pre-compiling its prefill buckets reports "warming" with 503 —
+  the readiness gate — while submissions already queue.
 
 - ``GET /metrics`` / ``GET /metrics.json`` — the monitor package's
   Prometheus / JSON exporters, same payloads as

@@ -6,9 +6,14 @@ AnalysisPredictor; here a dedicated scheduler THREAD owns a
 drives the stepwise API (``add_request`` / ``decode_segment`` /
 ``collect_finished``) in an Orca-style iteration loop:
 
-    gap:   apply cancellations → reap expired → admit from the queue
-           (capacity probed via the engine's public ``can_admit`` /
-           ``free_slots`` — never by catching add_request's RuntimeError)
+    gap:   apply cancellations → advance an in-flight CHUNKED admission
+           by ONE fixed-shape prefill chunk → reap expired → admit from
+           the queue (capacity probed via the engine's public
+           ``can_admit`` / ``free_slots`` — never by catching
+           add_request's RuntimeError); prompts longer than the engine's
+           ``prefill_chunk`` admit chunk-by-chunk across gaps, so a long
+           prompt never monopolizes the gap and running requests' TPOT
+           stays flat
     step:  one jitted decode segment over every occupied slot
     drain: stream new tokens to handles, finish retired requests
 
@@ -57,14 +62,26 @@ class Server:
     ``drain()`` stops admission of new submissions and waits for
     in-flight + queued work to finish; ``shutdown()`` optionally drains,
     then cancels whatever remains and stops the thread.
+
+    ``warmup=True`` pre-compiles every serving-path program
+    (``engine.warmup``: all prefill buckets, the chunked-prefill
+    program, the decode segment) in the scheduler thread before the
+    loop starts — no user request ever pays an XLA compile.
+    ``status``/``/healthz`` report ``warming`` until done (submissions
+    queue meanwhile); gate traffic on :meth:`wait_ready`. When the
+    engine was built with ``prefill_chunk``, prompts longer than the
+    chunk admit one fixed-shape chunk per inter-segment gap with decode
+    segments interleaved — a long prompt never stalls running requests.
     """
 
     def __init__(self, engine, max_queue: int = 64,
                  segment_steps: int = 8,
-                 idle_wait_s: float = 0.02, start: bool = True):
+                 idle_wait_s: float = 0.02, start: bool = True,
+                 warmup: bool = False):
         self.engine = engine
         self.segment_steps = segment_steps
         self.idle_wait_s = idle_wait_s
+        self.warmup = warmup
         self.queue = RequestQueue(max_queue)
         # per-server label: concurrent servers (multi-model processes)
         # publish their serving metrics side by side
@@ -77,9 +94,14 @@ class Server:
         self._admitting = False           # True between queue pop and
         #                                   _active insert (drain must
         #                                   not miss that window)
+        self._adm = None                  # in-flight chunked admission:
+        #                                   (engine admission, handle) —
+        #                                   advanced ONE chunk per gap
         self._draining = False
         self._stopping = False
         self._fatal: Optional[BaseException] = None
+        self._ready = threading.Event()   # warmup done (set immediately
+        #                                   when warmup=False)
         self._stopped = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -153,7 +175,7 @@ class Server:
         with self._idle_cv:
             return self._idle_cv.wait_for(
                 lambda: (self.queue.depth == 0 and not self._active
-                         and not self._admitting)
+                         and not self._admitting and self._adm is None)
                 or self._stopped.is_set(), timeout)
 
     def shutdown(self, drain: bool = True,
@@ -250,13 +272,23 @@ class Server:
     def _loop(self) -> None:
         err: Optional[BaseException] = None
         try:
+            if self.warmup:
+                # pre-compile every serving-path program IN the engine-
+                # owning thread, off the request path: no user request
+                # ever pays an XLA compile. /healthz reports "warming"
+                # until this finishes (submissions queue meanwhile).
+                self.engine.warmup(self.segment_steps)
+            self._ready.set()
             while True:
                 with self._lock:
                     stopping = self._stopping
                 if stopping:
                     break
                 self._gap()
-                if self._active:
+                if self._active or self._adm is not None:
+                    # with only a chunked admission in flight the
+                    # segment is a fast no-op and the loop spins
+                    # straight back into _gap for the next chunk
                     self.engine.decode_segment(self.segment_steps)
                     self._collect()
                 else:
@@ -272,19 +304,33 @@ class Server:
             # state (clients block in result()/stream() forever) or
             # leave drain() waiting on a condition nobody will signal.
             self._finalize(err)
+            # unblock wait_ready() even when WARMUP itself died — the
+            # fatal status is already recorded, and `status` reports
+            # failed/stopped before it ever consults _ready
+            self._ready.set()
             self._stopped.set()
             with self._idle_cv:
                 self._idle_cv.notify_all()
 
     @property
     def status(self) -> str:
-        """``ok`` / ``draining`` / ``failed`` (scheduler died on an
-        exception) / ``stopped`` — what ``/healthz`` reports."""
+        """``warming`` (pre-compiling, not ready for traffic — requests
+        still queue) / ``ok`` / ``draining`` / ``failed`` (scheduler
+        died on an exception) / ``stopped`` — what ``/healthz``
+        reports."""
         if self._fatal is not None:
             return "failed"
         if self._stopped.is_set():
             return "stopped"
+        if not self._ready.is_set():
+            return "warming"
         return "draining" if self.draining else "ok"
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until warmup finished (immediately True when
+        ``warmup=False``). Also returns when the scheduler DIED during
+        warmup — check :attr:`status` (``"failed"``) before serving."""
+        return self._ready.wait(timeout)
 
     def _finalize(self, err: Optional[BaseException]) -> None:
         fail = err is not None
@@ -297,6 +343,16 @@ class Server:
             self._fatal = err
         wrapped = (RuntimeError(f"serving scheduler died: {err!r}")
                    if fail else None)
+        if self._adm is not None:
+            adm, h = self._adm
+            self._adm = None
+            if not fail:
+                try:    # engine coherent on a clean stop — reclaim
+                    self.engine.abort_admit(adm)
+                except Exception:
+                    pass
+            h._finish(FAILED if fail else CANCELLED, wrapped)
+            self._count("failed" if fail else "cancelled")
         for h in self.queue.drain_all():
             h._finish(FAILED if fail else CANCELLED, wrapped)
             self._count("failed" if fail else "cancelled")
@@ -313,8 +369,10 @@ class Server:
 
     def _gap(self) -> None:
         """The inter-segment gap: cancellations first (they free
-        capacity), then expiry reaping, then admission while the
-        engine's capacity probe allows."""
+        capacity), then ONE chunk of any in-flight chunked admission
+        (bounded gap work — decode segments run between chunks), then
+        expiry reaping, then admission while the engine's capacity
+        probe allows."""
         # 1. cancellations of RUNNING requests retire their slots
         for rid, h in list(self._active.items()):
             if h._cancel_requested:
@@ -324,6 +382,37 @@ class Server:
                     self._push_delta(h, list(toks[h._n_pushed:]))
                 h._finish(CANCELLED)
                 self._count("cancelled")
+        # 1b. advance the in-flight chunked admission by ONE fixed-shape
+        #     chunk (or abandon it if its client cancelled / its
+        #     admission deadline passed — chunked admission spans many
+        #     gaps, so queue.reap alone no longer covers the whole wait
+        #     for admission): admission work per gap stays bounded no
+        #     matter how long the prompt
+        if self._adm is not None:
+            adm, h = self._adm
+            expired = (h.deadline is not None
+                       and time.monotonic() >= h.deadline)
+            if h._cancel_requested or expired:
+                self._adm = None
+                self.engine.abort_admit(adm)
+                h._finish(CANCELLED if h._cancel_requested else EXPIRED)
+                self._count("cancelled" if h._cancel_requested
+                            else "expired")
+            else:
+                try:
+                    finished = self.engine.admit_chunk(adm)
+                except Exception as e:
+                    self._adm = None
+                    h._finish(FAILED, e)
+                    self._count("failed")
+                else:
+                    if finished:
+                        self._adm = None
+                        h._mark_running(adm.rid)
+                        self._active[adm.rid] = h
+                        toks = self.engine.partial_tokens(adm.rid)
+                        if toks is not None:
+                            self._push_delta(h, toks)
         # 2. cancelled/expired queue entries never admit
         for h in self.queue.reap(time.monotonic()):
             if h._cancel_requested:
@@ -339,11 +428,22 @@ class Server:
         #    actives" while a request is mid-admission (prefill can be
         #    seconds on a first compile).
         self._admitting = True
+        chunk = getattr(self.engine, "prefill_chunk", None)
+
+        def admittable(h) -> bool:
+            if not self.engine.can_admit(h.prompt_len, h.cfg):
+                return False
+            if (chunk is not None and h.prompt_len > chunk
+                    and self._adm is not None):
+                # one chunked admission at a time: a second long prompt
+                # defers until the in-flight one completes (its slot and
+                # pages are already claimed, so capacity stays honest)
+                return False
+            return True
+
         try:
             while True:
-                h = self.queue.pop_if(
-                    lambda h: self.engine.can_admit(h.prompt_len,
-                                                    h.cfg))
+                h = self.queue.pop_if(admittable)
                 if h is None:
                     # head (if any) does not fit RIGHT NOW. With the
                     # engine completely idle it can never fit — fail it
@@ -368,6 +468,18 @@ class Server:
                             self._count("failed")
                         continue
                     break
+                if chunk is not None and h.prompt_len > chunk:
+                    # long prompt: claim capacity now, prefill one
+                    # fixed-shape chunk per gap (decode segments run in
+                    # between) instead of one monopolizing prefill
+                    try:
+                        adm = self.engine.begin_admit(h.prompt, h.cfg)
+                    except Exception as e:  # pragma: no cover - skew
+                        h._finish(FAILED, e)
+                        self._count("failed")
+                        continue
+                    self._adm = (adm, h)
+                    continue
                 try:
                     rid = self.engine.add_request(h.prompt, h.cfg)
                 except Exception as e:  # pragma: no cover - probe skew

@@ -83,6 +83,7 @@ SERVING_FAMILIES = (
     "paddle_tpu_decode_tokens_per_sec",
     "paddle_tpu_kv_admission_seconds",
     "paddle_tpu_kv_page_occupancy_ratio",
+    "paddle_tpu_prefill_",              # bucket/chunk admissions, warmup
 )
 
 

@@ -25,6 +25,12 @@ Usage::
     python tools/serve_bench.py --rate 16 --requests 64
     python tools/serve_bench.py --url http://127.0.0.1:8000 --rate 8
     python tools/serve_bench.py --monitor-out run.jsonl   # + monitor dump
+    # bucketing A/B (PERF.md prefill-cost methodology): lognormal
+    # prompt mix, report compiled prefill programs alongside TTFT/TPOT
+    python tools/serve_bench.py --prompt-dist lognormal --prompt-len 4:96 \
+        --warmup --prefill-chunk 32
+    python tools/serve_bench.py --prompt-dist lognormal --prompt-len 4:96 \
+        --prefill-buckets none
 
 Output: one human table plus BENCH-shaped JSON records
 (``{"metric": ..., "value": ..., "unit": ...}``) on stdout.
@@ -174,11 +180,56 @@ def _build_toy_server(args):
     paddle.seed(0)
     cfg = llama_config("tiny", num_hidden_layers=args.layers)
     model = LlamaForCausalLM(cfg)
+    if args.prefill_buckets == "auto":
+        buckets = "auto"
+    elif args.prefill_buckets in ("none", "off"):
+        buckets = None
+    else:
+        buckets = [int(x) for x in args.prefill_buckets.split(",")]
     eng = PagedContinuousBatchingEngine(
         model, max_batch=args.max_batch, num_pages=args.num_pages,
-        page_size=args.page_size, max_pages=args.max_pages)
-    return Server(eng, max_queue=args.max_queue,
-                  segment_steps=args.segment_steps), cfg.vocab_size
+        page_size=args.page_size, max_pages=args.max_pages,
+        prefill_buckets=buckets, prefill_chunk=args.prefill_chunk)
+    srv = Server(eng, max_queue=args.max_queue,
+                 segment_steps=args.segment_steps, warmup=args.warmup)
+    srv.wait_ready()   # warmup compiles are NOT part of the measured run
+    return srv, cfg.vocab_size
+
+
+def _draw_len(rng, dist: str, lo: int, hi: int) -> int:
+    """One prompt length from the configured distribution. lognormal is
+    the realistic serving shape (many short, a long tail) — the mix that
+    exposes per-length prefill recompiles, which uniform draws over a
+    narrow range can hide."""
+    if dist == "lognormal":
+        import math
+
+        mu = (math.log(lo) + math.log(hi)) / 2.0
+        sigma = max((math.log(hi) - math.log(lo)) / 4.0, 1e-6)
+        return min(hi, max(lo, int(round(rng.lognormvariate(mu, sigma)))))
+    return rng.randint(lo, hi)
+
+
+def _prefill_program_stats():
+    """Compiled-prefill-program counts + compile seconds from the live
+    monitor registry (in-process mode): the bucketing win in numbers."""
+    from paddle_tpu import monitor
+
+    snap = monitor.snapshot()["metrics"]
+
+    def by_fn(name):
+        out = {}
+        for s in snap.get(name, {}).get("samples", []):
+            out[s["labels"]["fn"]] = s["value"]
+        return out
+
+    misses = by_fn("paddle_tpu_jit_cache_miss_total")
+    secs = by_fn("paddle_tpu_jit_compile_seconds_total")
+    prefill_fns = ("cb_prefill", "cb_prefill_chunk")
+    return (sum(int(misses.get(f, 0)) for f in prefill_fns),
+            sum(secs.get(f, 0.0) for f in prefill_fns),
+            sum(int(v) for v in misses.values()),
+            sum(secs.values()))
 
 
 def main(argv=None) -> int:
@@ -189,7 +240,12 @@ def main(argv=None) -> int:
                     help="mean arrival rate, requests/s (Poisson)")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--prompt-len", default="4:24", metavar="LO:HI",
-                    help="uniform prompt-length range")
+                    help="prompt-length range")
+    ap.add_argument("--prompt-dist", choices=("uniform", "lognormal"),
+                    default="uniform",
+                    help="prompt-length distribution over LO:HI "
+                         "(lognormal = realistic many-short/long-tail "
+                         "serving mix)")
     ap.add_argument("--max-new", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
     # in-process toy engine knobs
@@ -200,6 +256,16 @@ def main(argv=None) -> int:
     ap.add_argument("--max-pages", type=int, default=16)
     ap.add_argument("--max-queue", type=int, default=64)
     ap.add_argument("--segment-steps", type=int, default=4)
+    ap.add_argument("--prefill-buckets", default="auto",
+                    metavar="auto|none|N,N,...",
+                    help="prefill length buckets ('none' = exact-length "
+                         "prefill, one compile per distinct length)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked-prefill chunk size (tokens); prompts "
+                         "longer than this admit one chunk per gap")
+    ap.add_argument("--warmup", action="store_true",
+                    help="pre-compile all prefill buckets + the segment "
+                         "program before the measured run")
     ap.add_argument("--monitor-out", default=None, metavar="JSONL",
                     help="also dump the in-process monitor registry "
                          "(in-process mode only)")
@@ -219,7 +285,8 @@ def main(argv=None) -> int:
     for _ in range(args.requests):
         t += rng.expovariate(args.rate)
         arrivals.append(t)
-    prompts = [[rng.randrange(vocab) for _ in range(rng.randint(lo, hi))]
+    prompts = [[rng.randrange(vocab)
+                for _ in range(_draw_len(rng, args.prompt_dist, lo, hi))]
                for _ in range(args.requests)]
 
     stats = _Stats()
@@ -274,6 +341,21 @@ def main(argv=None) -> int:
                       "unit": "tokens/s"}))
     print(json.dumps({"metric": "serve_rejected",
                       "value": stats.rejected, "unit": "count"}))
+    if server is not None:
+        # the bucketing win in the methodology: how many prefill
+        # programs this run compiled (and what that cost) — bounded by
+        # len(buckets)+1 with bucketing on, O(#distinct lengths) off
+        pre_n, pre_s, all_n, all_s = _prefill_program_stats()
+        n_lens = len({len(p) for p in prompts})
+        print(f"prefill programs compiled: {pre_n} "
+              f"({pre_s:.2f}s) for {n_lens} distinct prompt lengths; "
+              f"all jit programs: {all_n} ({all_s:.2f}s)")
+        print(json.dumps({"metric": "serve_prefill_programs",
+                          "value": pre_n, "unit": "count"}))
+        print(json.dumps({"metric": "serve_prefill_compile_seconds",
+                          "value": round(pre_s, 4), "unit": "s"}))
+        print(json.dumps({"metric": "serve_distinct_prompt_lens",
+                          "value": n_lens, "unit": "count"}))
 
     if server is not None:
         if args.monitor_out:
