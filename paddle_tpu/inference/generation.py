@@ -27,7 +27,72 @@ from ..nn.functional_call import substituted_state
 
 __all__ = ["GenerationConfig", "CausalLMEngine",
            "ContinuousBatchingEngine",
-           "PagedContinuousBatchingEngine", "prefill_buckets_for"]
+           "PagedContinuousBatchingEngine", "prefill_buckets_for",
+           "RequestFault", "EngineFault", "classify_fault",
+           "REQUEST_SITES"]
+
+
+# -- fault taxonomy (serving-path blast-radius classification) ---------------
+#
+# At serving scale faults are routine inputs, not exceptional shutdowns.
+# The scheduler needs to know, for every exception an engine call
+# raises, how much state it poisons — that is the whole containment
+# contract:
+#
+# - REQUEST-scoped: one request's admission went wrong (malformed
+#   prompt the model chokes on, a prefill error). The engine's abort
+#   guards already reclaimed the slot/pages, device state for everyone
+#   else is coherent — fail THAT request with its cause, keep serving.
+# - ENGINE-scoped: device state is suspect (an XLA/device error inside
+#   a decode segment that mutates every slot's cache). The engine must
+#   be rebuilt (`reset_state`) and in-flight requests replayed.
+# - FATAL: process-level signals (KeyboardInterrupt/SystemExit) that
+#   must never be swallowed by a recovery loop.
+
+class RequestFault(RuntimeError):
+    """A fault scoped to ONE request: fail that request with its cause
+    and keep serving everyone else (the engine's device state is
+    coherent — admission abort guards reclaimed any claimed capacity).
+    Raise this from model/engine code running single-request work (the
+    admission/prefill/chunk seams, where the scheduler knows which
+    request is in flight). At a BATCH-wide seam (a decode segment over
+    every slot) there is no single request to attribute it to, so a
+    supervisor must still treat it as engine-scoped there."""
+
+
+class EngineFault(RuntimeError):
+    """A fault that poisons the ENGINE's device state (e.g. a device
+    error mid decode segment): the supervisor must rebuild state
+    (:meth:`ContinuousBatchingEngine.reset_state`) and replay in-flight
+    requests from their stored prompt + tokens emitted so far."""
+
+
+# seams where an unclassified exception defaults to request scope: the
+# engine was doing single-request work behind an abort guard, so shared
+# device state was never touched
+REQUEST_SITES = frozenset({"admit", "prefill", "chunk"})
+
+
+def classify_fault(exc: BaseException, site: str = "decode") -> str:
+    """Blast radius of ``exc`` raised at serving seam ``site``:
+    ``"request"`` / ``"engine"`` / ``"fatal"``.
+
+    Explicit :class:`RequestFault` / :class:`EngineFault` win over the
+    site default; anything unclassified is request-scoped at the
+    single-request seams (:data:`REQUEST_SITES` — admission work runs
+    behind abort guards that reclaim capacity) and engine-scoped at the
+    batch-wide ones (``decode``, ``collect``). Caveat for supervisors:
+    a ``"request"`` verdict is only ACTIONABLE where a single request
+    is in flight — at a batch-wide seam there is nobody to pin it on,
+    so the serving scheduler escalates any non-fatal fault there to
+    engine recovery regardless of this verdict."""
+    if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+        return "fatal"
+    if isinstance(exc, EngineFault):
+        return "engine"
+    if isinstance(exc, RequestFault):
+        return "request"
+    return "request" if site in REQUEST_SITES else "engine"
 
 
 def prefill_buckets_for(spec, max_len: int, floor: int = 16):
@@ -628,23 +693,7 @@ class ContinuousBatchingEngine:
         # throughput side by side; retired via close()/__del__
         self._monitor_engine = monitor.instance_label("engine")
         self.params = {k: p.value for k, p in model.named_parameters()}
-        self.caches = self._make_caches()
-        self.lens = jnp.zeros((max_batch,), jnp.int32)
-        self.last = jnp.zeros((max_batch,), jnp.int32)
-        self.done_dev = jnp.zeros((max_batch,), bool)
-        self.active_dev = jnp.zeros((max_batch,), bool)
-        # per-slot SAMPLING vectors (see _sample_rows): each request's
-        # GenerationConfig is installed into its slot at admission, so
-        # one segment program serves mixed configs — eos -1 means none
-        self.samp = {
-            "temp": jnp.ones((max_batch,), jnp.float32),
-            "top_k": jnp.zeros((max_batch,), jnp.int32),
-            "top_p": jnp.ones((max_batch,), jnp.float32),
-            "sample": jnp.zeros((max_batch,), bool),
-            "eos": jnp.full((max_batch,), -1, jnp.int32),
-            "seed": jnp.zeros((max_batch,), jnp.int32),
-        }
-        self._free = list(range(max_batch))
+        self._init_decode_state()
         self._slot_req = {}            # slot -> request id
         self._tokens = {}              # request id -> [generated ids]
         self._budget = {}              # request id -> remaining tokens
@@ -708,6 +757,32 @@ class ContinuousBatchingEngine:
             admit_state, name="cb_admit_state",
             donate_argnums=(0, 1, 2, 3, 4))
         self._segment_cache = {}
+
+    def _init_decode_state(self) -> None:
+        """Fresh device-side decode state: caches, per-slot scalars,
+        the per-slot SAMPLING vectors (see ``_sample_rows`` — each
+        request's GenerationConfig is installed into its slot at
+        admission, so one segment program serves mixed configs; eos -1
+        means none), and the free-slot heap. ONE definition shared by
+        ``__init__`` and ``reset_state`` — a supervised restart must
+        rebuild exactly what construction builds, so a new per-slot
+        vector added here can never be forgotten on the recovery
+        path."""
+        mb = self.max_batch
+        self.caches = self._make_caches()
+        self.lens = jnp.zeros((mb,), jnp.int32)
+        self.last = jnp.zeros((mb,), jnp.int32)
+        self.done_dev = jnp.zeros((mb,), bool)
+        self.active_dev = jnp.zeros((mb,), bool)
+        self.samp = {
+            "temp": jnp.ones((mb,), jnp.float32),
+            "top_k": jnp.zeros((mb,), jnp.int32),
+            "top_p": jnp.ones((mb,), jnp.float32),
+            "sample": jnp.zeros((mb,), bool),
+            "eos": jnp.full((mb,), -1, jnp.int32),
+            "seed": jnp.zeros((mb,), jnp.int32),
+        }
+        self._free = list(range(mb))
 
     def _make_caches(self):
         """Cache layout hook — the paged subclass replaces the dense
@@ -941,6 +1016,39 @@ class ContinuousBatchingEngine:
         None when ``rid`` is not active."""
         toks = self._tokens.get(rid)
         return None if toks is None else list(toks[start:])
+
+    # -- supervised recovery (host-driven, engine-owning thread only) --------
+    def reset_state(self) -> None:
+        """Drop EVERY request and rebuild the engine's device-side
+        decode state from scratch: fresh caches, lengths, done/active
+        flags, per-slot sampling vectors, and a full free-slot list
+        (paged: the whole page pool). Compiled programs are KEPT — after
+        an engine-scoped fault (:class:`EngineFault`, a device error mid
+        ``decode_segment``) the device arrays are suspect but the jitted
+        programs are not, so a supervised restart pays device re-init
+        plus replay prefills, never a recompile.
+
+        In-flight requests are forgotten, not finished: the caller (the
+        serving scheduler's recovery path) owns replaying them from
+        their stored prompt + tokens emitted so far. ``_next_req`` is
+        NOT reset — request ids stay unique across restarts, so a stale
+        pre-restart rid can never alias a replayed request."""
+        # drop the old pool BEFORE the rebuild allocates the new one:
+        # both alive at once would double peak KV HBM at the exact
+        # moment (device-fault recovery, pool sized near capacity) a
+        # second pool cannot fit
+        self.caches = None
+        self._init_decode_state()
+        self._slot_req.clear()
+        self._tokens.clear()
+        self._budget.clear()
+        self._cfg.clear()
+        self._finished.clear()
+        if monitor.enabled():
+            monitor.counter(
+                "paddle_tpu_requests_total",
+                "serving requests by lifecycle event",
+                ("event",)).labels(event="engine_reset").inc()
 
     # -- chunked admission (host-driven, one chunk per inter-segment gap) ----
     def begin_admit(self, prompt_ids, cfg: GenerationConfig):
@@ -1369,6 +1477,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _retire(self, slot, event: str = "finished"):
         super()._retire(slot, event)
         self.alloc.free_slot(slot)
+
+    def reset_state(self) -> None:
+        # every slot's pages go back to the pool BEFORE the base rebuild
+        # reads alloc.page_table into the fresh cache tuple — a restart
+        # must leave zero pages leaked no matter what the fault
+        # interrupted
+        for slot in range(self.max_batch):
+            self.alloc.free_slot(slot)
+        super().reset_state()
 
     def decode_segment(self, n_steps: int,
                        cfg: Optional[GenerationConfig] = None):

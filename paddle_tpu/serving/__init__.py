@@ -23,6 +23,20 @@ package is the first layer a real client can talk to:
   (``POST /generate`` with chunked ndjson streaming, ``GET /healthz``,
   and the monitor package's ``/metrics`` exporters).
 
+Fault isolation (see README "Failure modes & recovery"): faults are
+classified by blast radius
+(:class:`~paddle_tpu.inference.generation.RequestFault` /
+:class:`~paddle_tpu.inference.generation.EngineFault` /
+:func:`~paddle_tpu.inference.generation.classify_fault`, re-exported
+here) — a request-scoped fault fails ONLY that request with its cause;
+an engine-scoped one triggers supervised recovery
+(``engine.reset_state()`` + replay of in-flight requests, bounded by
+``Server(max_restarts=..., max_replays=...)``); a stalled step is
+caught by the ``stall_timeout_s`` watchdog and surfaces as the
+``degraded`` status (healthz 503, submissions reject with reason).
+``paddle_tpu.testing.faults`` is the deterministic injection harness
+the chaos suite drives all of this with.
+
 Quick start::
 
     import paddle_tpu.serving as serving
@@ -39,6 +53,8 @@ Quick start::
     for tok in h.stream():
         ...
 """
+from ..inference.generation import (EngineFault, RequestFault,
+                                    classify_fault)
 from .http import serve_http
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
                     RUNNING, DeadlineExpired, QueueFull,
@@ -50,5 +66,6 @@ __all__ = [
     "Server", "serve_http", "RequestHandle", "RequestQueue",
     "RequestRejected", "QueueFull", "RequestCancelled",
     "DeadlineExpired", "RequestFailed",
+    "RequestFault", "EngineFault", "classify_fault",
     "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED", "FAILED",
 ]

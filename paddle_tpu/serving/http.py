@@ -25,14 +25,22 @@ Routes:
 
   Status codes are the backpressure contract: 400 malformed request
   (GenerationConfig validation / prompt that can never fit), 429 queue
-  full (with ``Retry-After``), 503 draining/shutdown, 504 admission
-  deadline expired.
+  full (with ``Retry-After``), 503 draining/degraded/shutdown, 504
+  admission deadline expired. A FAILED server (scheduler died) and a
+  DEGRADED one (stalled step, mid-recovery) both reject immediately
+  with 503 and a machine-readable ``reason``
+  (``shutdown``/``degraded``) — a request must never queue into a
+  server that may never drain it.
 
-- ``GET /healthz`` — ``{"status": "warming"|"ok"|"draining", "queue_depth",
-  "free_slots", "active_requests"}`` (load balancers drain on
-  non-"ok"). HTTP 200 only for "ok"/"draining": a ``Server(warmup=True)``
-  still pre-compiling its prefill buckets reports "warming" with 503 —
-  the readiness gate — while submissions already queue.
+- ``GET /healthz`` — ``{"status": "warming"|"ok"|"degraded"|"draining"
+  |"failed"|"stopped", "queue_depth", "free_slots",
+  "active_requests", "restarts"}`` (load balancers drain on
+  non-"ok"). HTTP 200 only for "ok"/"draining"; everything else is
+  503: "warming" is the readiness gate (a ``Server(warmup=True)``
+  still pre-compiling — submissions already queue), "degraded" is the
+  stall-watchdog / mid-recovery signal, "failed" means the scheduler
+  died (body carries the status; ``restarts`` counts supervised
+  engine recoveries so far).
 
 - ``GET /metrics`` / ``GET /metrics.json`` — the monitor package's
   Prometheus / JSON exporters, same payloads as
@@ -130,6 +138,7 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                     "queue_depth": server.queue.depth,
                     "free_slots": eng.free_slots(),
                     "active_requests": server.num_active(),
+                    "restarts": getattr(server, "restarts", 0),
                 })
             elif (payload := monitor.http_payload(self.path)) is not None:
                 body, ctype = payload
@@ -182,7 +191,7 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                     self._json(429, {"error": str(e),
                                      "reason": e.reason},
                                headers={"Retry-After": "1"})
-                else:   # draining / shutdown
+                else:   # draining / degraded / shutdown (failed server)
                     self._json(503, {"error": str(e),
                                      "reason": e.reason})
                 return

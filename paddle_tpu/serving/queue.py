@@ -67,8 +67,11 @@ class DeadlineExpired(RuntimeError):
 
 
 class RequestFailed(RuntimeError):
-    """``result()`` on a request the scheduler could never run (e.g. a
-    prompt that cannot ever fit the engine's page pool)."""
+    """``result()`` on a request that FAILED: one the scheduler could
+    never run (e.g. a prompt that cannot ever fit the engine's page
+    pool), one whose admission hit a request-scoped fault (the cause
+    rides in the message; everyone else kept serving), or one that
+    exceeded its replay budget across engine restarts."""
 
 
 class RequestHandle:
@@ -113,6 +116,14 @@ class RequestHandle:
         self._error: Optional[BaseException] = None
         self._cancel_requested = False
         self._on_cancel = on_cancel
+        # supervised-recovery bookkeeping (scheduler thread only):
+        # _replays counts engine restarts this request survived (each
+        # re-prefills prompt + tokens emitted so far; bounded by the
+        # server's max_replays); _engine_base is the handle-side token
+        # count at the LAST replay admission — the engine's token list
+        # restarts at 0 there, so engine index = handle index - base
+        self._replays = 0
+        self._engine_base = 0
 
     # -- client surface ------------------------------------------------------
     @property

@@ -7,13 +7,14 @@ drives the stepwise API (``add_request`` / ``decode_segment`` /
 ``collect_finished``) in an Orca-style iteration loop:
 
     gap:   apply cancellations → advance an in-flight CHUNKED admission
-           by ONE fixed-shape prefill chunk → reap expired → admit from
-           the queue (capacity probed via the engine's public
-           ``can_admit`` / ``free_slots`` — never by catching
-           add_request's RuntimeError); prompts longer than the engine's
-           ``prefill_chunk`` admit chunk-by-chunk across gaps, so a long
-           prompt never monopolizes the gap and running requests' TPOT
-           stays flat
+           by ONE fixed-shape prefill chunk → reap expired → re-admit
+           REPLAYS surviving an engine restart → admit from the queue
+           (capacity probed via the engine's public ``can_admit`` /
+           ``free_slots`` — never by catching add_request's
+           RuntimeError); prompts longer than the engine's
+           ``prefill_chunk`` admit chunk-by-chunk across gaps, so a
+           long prompt never monopolizes the gap and running requests'
+           TPOT stays flat
     step:  one jitted decode segment over every occupied slot
     drain: stream new tokens to handles, finish retired requests
 
@@ -23,10 +24,35 @@ the same gap, so the pool is reclaimed, never leaked. Backpressure is
 the bounded queue: ``submit`` on a full queue raises
 :class:`~paddle_tpu.serving.queue.QueueFull` (the HTTP layer's 429).
 
+FAULT ISOLATION (the blast-radius contract — at serving scale faults
+are routine inputs, not exceptional shutdowns):
+
+- a REQUEST-scoped fault (malformed prompt the engine chokes on, a
+  prefill error — :func:`~paddle_tpu.inference.generation.classify_fault`)
+  finishes ONLY that handle as FAILED with its cause; the engine's
+  admission abort guards already reclaimed the slot and pages, and the
+  loop keeps serving everyone else;
+- an ENGINE-scoped fault (a device error inside ``decode_segment``)
+  triggers SUPERVISED RECOVERY: exponential backoff, then
+  ``engine.reset_state()`` rebuilds device state (compiled programs
+  kept), and every in-flight request REPLAYS — re-prefilling
+  ``prompt + tokens emitted so far`` through the same bucketed/chunked
+  admission machinery, continuing exactly where it left off (bitwise
+  for greedy requests; sampled requests continue on a fresh noise
+  stream). Restarts are bounded by ``max_restarts`` (server lifetime)
+  and per-request replays by ``max_replays``; past either bound the
+  fatal ``_finalize`` path fails what remains, loudly;
+- a STALL (a wedged step that can't announce itself) is caught by the
+  watchdog thread: ``stall_timeout_s`` without a loop heartbeat flips
+  ``status``/``/healthz`` to ``degraded`` (503) until the loop beats
+  again.
+
 Thread model: the engine is touched by the scheduler thread ONLY (jax
-tracing included). ``submit``/``cancel``/``drain``/``shutdown`` are
-thread-safe entry points that communicate through the queue, handle
-flags, and a wake event.
+tracing included) — recovery and replay run there too. The watchdog
+thread only reads the heartbeat and flips flags.
+``submit``/``cancel``/``drain``/``shutdown`` are thread-safe entry
+points that communicate through the queue, handle flags, and a wake
+event.
 """
 from __future__ import annotations
 
@@ -34,12 +60,29 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 from .. import monitor
-from ..inference.generation import GenerationConfig, _prompt_len
+from ..inference.generation import (GenerationConfig, _prompt_ids,
+                                    _prompt_len, classify_fault)
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QueueFull,
                     RequestHandle, RequestQueue, RequestRejected)
 
 __all__ = ["Server"]
+
+
+class _EngineFaultSignal(Exception):
+    """Internal: an engine-scoped fault crossing from a guarded seam to
+    the loop's recovery handler (never escapes the Server). ``handle``
+    rides along when a specific request's admission triggered it — that
+    request joins the replay set instead of being stranded."""
+
+    def __init__(self, site: str, cause: BaseException,
+                 handle: Optional[RequestHandle] = None):
+        super().__init__(f"engine fault at {site}: {cause!r}")
+        self.site = site
+        self.cause = cause
+        self.handle = handle
 
 
 class Server:
@@ -57,11 +100,12 @@ class Server:
         srv.shutdown()
 
     ``submit`` rejects (raises) when the queue is full or the server is
-    draining — the reject-with-reason backpressure contract; a request
-    whose prompt can NEVER fit the engine fails fast with ValueError.
-    ``drain()`` stops admission of new submissions and waits for
-    in-flight + queued work to finish; ``shutdown()`` optionally drains,
-    then cancels whatever remains and stops the thread.
+    draining/degraded — the reject-with-reason backpressure contract; a
+    request whose prompt can NEVER fit the engine fails fast with
+    ValueError. ``drain()`` stops admission of new submissions and
+    waits for in-flight + queued work to finish; ``shutdown()``
+    optionally drains, then cancels whatever remains and stops the
+    thread.
 
     ``warmup=True`` pre-compiles every serving-path program
     (``engine.warmup``: all prefill buckets, the chunked-prefill
@@ -72,16 +116,58 @@ class Server:
     engine was built with ``prefill_chunk``, prompts longer than the
     chunk admit one fixed-shape chunk per inter-segment gap with decode
     segments interleaved — a long prompt never stalls running requests.
+
+    Fault-isolation knobs:
+
+    - ``max_restarts`` — supervised engine restarts the server will
+      attempt over its LIFETIME before an engine-scoped fault falls
+      through to the fatal path (like a supervisor's restart
+      intensity);
+    - ``restart_backoff_s`` / ``restart_backoff_max_s`` — exponential
+      backoff before restart *n* sleeps
+      ``min(restart_backoff_s * 2**(n-1), restart_backoff_max_s)``;
+    - ``max_replays`` — engine restarts any ONE request may survive;
+      past it the request fails with the fault as its cause;
+    - ``stall_timeout_s`` — arm the stall watchdog (None = off): a
+      scheduler step exceeding it flips status to ``degraded`` until
+      the loop beats again. Without ``warmup=True`` the first request's
+      XLA compiles run inside a step — set the timeout above worst-case
+      compile time, or warm up. The watchdog never arms during warmup.
     """
 
     def __init__(self, engine, max_queue: int = 64,
                  segment_steps: int = 8,
                  idle_wait_s: float = 0.02, start: bool = True,
-                 warmup: bool = False):
+                 warmup: bool = False,
+                 max_restarts: int = 3,
+                 restart_backoff_s: float = 0.05,
+                 restart_backoff_max_s: float = 2.0,
+                 max_replays: int = 2,
+                 stall_timeout_s: Optional[float] = None):
+        if stall_timeout_s is not None and stall_timeout_s <= 0:
+            raise ValueError(
+                f"stall_timeout_s must be > 0 or None, got "
+                f"{stall_timeout_s!r}")
+        if stall_timeout_s is not None \
+                and stall_timeout_s < 2 * idle_wait_s:
+            # an IDLE loop only beats every idle_wait_s (the _wake
+            # wait), so a timeout at/below that cadence would flap a
+            # perfectly healthy idle server into degraded
+            raise ValueError(
+                f"stall_timeout_s({stall_timeout_s}) must be >= twice "
+                f"idle_wait_s({idle_wait_s}) — the idle loop only "
+                "beats once per idle_wait_s")
+        if max_restarts < 0 or max_replays < 0:
+            raise ValueError("max_restarts/max_replays must be >= 0")
         self.engine = engine
         self.segment_steps = segment_steps
         self.idle_wait_s = idle_wait_s
         self.warmup = warmup
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_backoff_max_s = restart_backoff_max_s
+        self.max_replays = max_replays
+        self.stall_timeout_s = stall_timeout_s
         self.queue = RequestQueue(max_queue)
         # per-server label: concurrent servers (multi-model processes)
         # publish their serving metrics side by side
@@ -91,12 +177,31 @@ class Server:
         self._lock = threading.Lock()     # submit/lifecycle flags
         self._next_id = 0
         self._active = {}                 # engine rid -> RequestHandle
-        self._admitting = False           # True between queue pop and
-        #                                   _active insert (drain must
-        #                                   not miss that window)
+        self._admitting = False           # True for the whole inter-
+        #                                   segment gap and recovery:
+        #                                   handles pass through locals
+        #                                   there, and drain must not
+        #                                   miss those windows
         self._adm = None                  # in-flight chunked admission:
         #                                   (engine admission, handle) —
         #                                   advanced ONE chunk per gap
+        self._replay = []                 # handles surviving an engine
+        #                                   restart, awaiting
+        #                                   re-admission (replay)
+        self._faulted = False             # True while a handle rides an
+        #                                   in-flight fault signal
+        #                                   (between its seam and
+        #                                   _recover) — drain must not
+        #                                   report done in that window
+        self._restarts = 0
+        self._fault_counts = {}           # (kind, site) -> n, host-side
+        #                                   (monitor-independent; see
+        #                                   fault_stats())
+        self._recovery_s = []
+        self._degraded_reason: Optional[str] = None   # under _lock
+        self._stall_flag = False          # degraded BY the watchdog
+        self._beat = time.monotonic()     # loop heartbeat the watchdog
+        #                                   reads (float store: atomic)
         self._draining = False
         self._stopping = False
         self._fatal: Optional[BaseException] = None
@@ -106,6 +211,12 @@ class Server:
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"paddle_tpu-serving-{self.monitor_server}")
+        self._watchdog = None
+        if stall_timeout_s is not None:
+            self._watchdog = threading.Thread(
+                target=self._watch, daemon=True,
+                name=f"paddle_tpu-serving-watchdog-"
+                     f"{self.monitor_server}")
         if start:
             self._thread.start()
 
@@ -122,8 +233,11 @@ class Server:
         queued when it passes is EXPIRED, never admitted.
 
         Raises :class:`RequestRejected` (reason ``queue_full`` /
-        ``draining`` / ``shutdown``) for backpressure, ValueError for a
-        prompt that could never fit the engine."""
+        ``draining`` / ``degraded`` / ``shutdown``) for backpressure,
+        ValueError for a prompt that could never fit the engine. A
+        degraded server (stalled step, mid-recovery) rejects
+        IMMEDIATELY with the reason instead of queueing into a server
+        that may never drain."""
         cfg = cfg or GenerationConfig()
         plen = _prompt_len(prompt)
         if plen + cfg.max_new_tokens > self.engine.max_len:
@@ -151,6 +265,12 @@ class Server:
                 raise RequestRejected(
                     "draining",
                     "server is draining; not accepting new requests")
+            if self._degraded_reason is not None:
+                self._count("rejected_degraded")
+                raise RequestRejected(
+                    "degraded",
+                    f"server is degraded ({self._degraded_reason}); "
+                    "not accepting new requests")
             handle = RequestHandle(self._next_id, prompt, plen, cfg,
                                    priority, deadline,
                                    on_cancel=self._on_cancel)
@@ -167,15 +287,17 @@ class Server:
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Stop accepting NEW submissions, let queued + in-flight
-        requests run to completion. Returns True when everything
-        finished (False on timeout; the server keeps draining)."""
+        requests run to completion (replays included). Returns True
+        when everything finished (False on timeout; the server keeps
+        draining)."""
         with self._lock:
             self._draining = True
         self._wake.set()
         with self._idle_cv:
             return self._idle_cv.wait_for(
                 lambda: (self.queue.depth == 0 and not self._active
-                         and not self._admitting and self._adm is None)
+                         and not self._admitting and self._adm is None
+                         and not self._replay and not self._faulted)
                 or self._stopped.is_set(), timeout)
 
     def shutdown(self, drain: bool = True,
@@ -200,11 +322,33 @@ class Server:
         else:
             self._stopped.wait(max(0.0, timeout
                                    - (time.monotonic() - t0)))
+        if not self._stopped.is_set():
+            # the loop is still wedged (the stall scenario): leave the
+            # per-server series alone — a live scheduler/watchdog tick
+            # would just re-create anything removed here, and the
+            # series still describe a real, running (if sick) server
+            return
+        if self._watchdog is not None and self._watchdog.is_alive():
+            # a watchdog tick racing the removal below would re-create
+            # the degraded/fault series; it exits within one poll
+            # period of _stopped
+            self._watchdog.join(timeout=2.0)
         try:
             self._queue_depth_gauge().remove(server=self.monitor_server)
             self._active_gauge().remove(server=self.monitor_server)
         except Exception:
             pass
+        # per-server fault/recovery series retire with the server (the
+        # site dimension is open-ended; a dropped server must not
+        # export its last degraded flag forever)
+        for name in ("paddle_tpu_serving_faults_total",
+                     "paddle_tpu_serving_restarts_total",
+                     "paddle_tpu_serving_degraded",
+                     "paddle_tpu_serving_recovery_seconds"):
+            try:
+                monitor.remove_series(name, server=self.monitor_server)
+            except Exception:
+                pass
 
     def close(self) -> None:
         self.shutdown(drain=False)
@@ -216,6 +360,24 @@ class Server:
 
     def num_active(self) -> int:
         return len(self._active)
+
+    @property
+    def restarts(self) -> int:
+        """Supervised engine restarts so far (lifetime count the
+        ``max_restarts`` bound applies to)."""
+        return self._restarts
+
+    def fault_stats(self) -> dict:
+        """Host-side fault/recovery accounting, monitor-independent
+        (the chaos bench reads this even with the monitor off):
+        ``{"faults": {(kind, site): n}, "restarts": n,
+        "recovery_s": [per-restart wall seconds],
+        "degraded": reason-or-None}``."""
+        with self._lock:
+            return {"faults": dict(self._fault_counts),
+                    "restarts": self._restarts,
+                    "recovery_s": list(self._recovery_s),
+                    "degraded": self._degraded_reason}
 
     # -- monitor helpers -----------------------------------------------------
     @staticmethod
@@ -253,6 +415,36 @@ class Server:
             "time per output token after the first (decode cadence): "
             "(finish - first_token) / (n_tokens - 1)", ("server",))
 
+    @staticmethod
+    def _faults_counter():
+        return monitor.counter(
+            "paddle_tpu_serving_faults_total",
+            "serving-path faults by blast-radius kind "
+            "(request/engine/stall) and detection site",
+            ("server", "kind", "site"))
+
+    @staticmethod
+    def _restarts_counter():
+        return monitor.counter(
+            "paddle_tpu_serving_restarts_total",
+            "supervised engine restarts: device state rebuilt, "
+            "in-flight requests replayed", ("server",))
+
+    @staticmethod
+    def _degraded_gauge():
+        return monitor.gauge(
+            "paddle_tpu_serving_degraded",
+            "1 while the server is degraded (stalled step or "
+            "mid-recovery), else 0", ("server",))
+
+    @staticmethod
+    def _recovery_hist():
+        return monitor.histogram(
+            "paddle_tpu_serving_recovery_seconds",
+            "engine recovery wall time: fault caught -> backoff + "
+            "state rebuilt + in-flight requests requeued for replay",
+            ("server",))
+
     def _count(self, event: str) -> None:
         if monitor.enabled():
             self._requests_counter().labels(
@@ -265,12 +457,70 @@ class Server:
             self._active_gauge().labels(
                 server=self.monitor_server).set(len(self._active))
 
+    def _count_fault(self, kind: str, site: str) -> None:
+        # called from the scheduler thread AND the watchdog — the host
+        # dict needs the lock, the monitor counter has its own
+        with self._lock:
+            key = (kind, site)
+            self._fault_counts[key] = self._fault_counts.get(key, 0) + 1
+        if monitor.enabled():
+            self._faults_counter().labels(
+                server=self.monitor_server, kind=kind, site=site).inc()
+
+    def _set_degraded(self, reason: str, stall: bool = False) -> None:
+        with self._lock:
+            self._degraded_reason = reason
+            self._stall_flag = stall
+        if monitor.enabled():
+            self._degraded_gauge().labels(
+                server=self.monitor_server).set(1)
+
+    def _clear_degraded(self, stall_only: bool = False) -> None:
+        with self._lock:
+            if stall_only and not self._stall_flag:
+                return
+            self._degraded_reason = None
+            self._stall_flag = False
+        if monitor.enabled():
+            self._degraded_gauge().labels(
+                server=self.monitor_server).set(0)
+
+    # -- stall watchdog (its own thread; flags only, never the engine) -------
+    def _watch(self) -> None:
+        """Detect a wedged scheduler step: ``stall_timeout_s`` without
+        a loop heartbeat flips status to ``degraded`` (healthz 503) and
+        counts a ``stall`` fault — a hung device call can't announce
+        itself, so somebody else has to. Clears as soon as the loop
+        beats again. Never arms during warmup (compiles are not
+        stalls), and never overwrites a recovery's degraded reason."""
+        period = min(max(self.stall_timeout_s / 4.0, 0.005), 1.0)
+        while not self._stopped.wait(period):
+            if not self._ready.is_set():
+                continue
+            age = time.monotonic() - self._beat
+            with self._lock:
+                stalled = self._stall_flag
+                degraded = self._degraded_reason is not None
+            if age > self.stall_timeout_s:
+                if not degraded:
+                    self._count_fault("stall", "loop")
+                    self._set_degraded(
+                        f"scheduler step stalled > "
+                        f"{self.stall_timeout_s}s", stall=True)
+            elif stalled:
+                self._clear_degraded(stall_only=True)
+
     # -- scheduler loop (single thread) --------------------------------------
     def _on_cancel(self, handle: RequestHandle) -> None:
         self._wake.set()
 
     def _loop(self) -> None:
         err: Optional[BaseException] = None
+        if self._watchdog is not None and not self._watchdog.is_alive():
+            try:
+                self._watchdog.start()
+            except RuntimeError:   # already started once
+                pass
         try:
             if self.warmup:
                 # pre-compile every serving-path program IN the engine-
@@ -278,24 +528,39 @@ class Server:
                 # ever pays an XLA compile. /healthz reports "warming"
                 # until this finishes (submissions queue meanwhile).
                 self.engine.warmup(self.segment_steps)
+            self._beat = time.monotonic()
             self._ready.set()
             while True:
                 with self._lock:
                     stopping = self._stopping
                 if stopping:
                     break
-                self._gap()
-                if self._active or self._adm is not None:
-                    # with only a chunked admission in flight the
-                    # segment is a fast no-op and the loop spins
-                    # straight back into _gap for the next chunk
-                    self.engine.decode_segment(self.segment_steps)
-                    self._collect()
-                else:
-                    with self._idle_cv:
-                        self._idle_cv.notify_all()
-                    self._wake.wait(self.idle_wait_s)
-                    self._wake.clear()
+                # heartbeat the watchdog reads: one "step" is
+                # gap + decode segment + collect
+                self._beat = time.monotonic()
+                try:
+                    self._gap()
+                    if self._active or self._adm is not None:
+                        # with only a chunked admission in flight the
+                        # segment is a fast no-op and the loop spins
+                        # straight back into _gap for the next chunk
+                        self._guard(
+                            "decode",
+                            lambda: self.engine.decode_segment(
+                                self.segment_steps))
+                        self._guard("collect", self._collect)
+                    else:
+                        with self._idle_cv:
+                            self._idle_cv.notify_all()
+                        self._wake.wait(self.idle_wait_s)
+                        self._wake.clear()
+                except _EngineFaultSignal as sig:
+                    if not self._recover(sig):
+                        raise RuntimeError(
+                            f"engine fault at {sig.site} with the "
+                            f"restart budget exhausted "
+                            f"(max_restarts={self.max_restarts}): "
+                            f"{sig.cause!r}") from sig.cause
         except BaseException as e:     # noqa: BLE001 - must not hang clients
             err = e
         finally:
@@ -315,15 +580,21 @@ class Server:
     @property
     def status(self) -> str:
         """``warming`` (pre-compiling, not ready for traffic — requests
-        still queue) / ``ok`` / ``draining`` / ``failed`` (scheduler
-        died on an exception) / ``stopped`` — what ``/healthz``
-        reports."""
+        still queue) / ``ok`` / ``degraded`` (stalled step or
+        mid-recovery; submissions reject with reason) / ``draining`` /
+        ``failed`` (scheduler died on an exception) / ``stopped`` —
+        what ``/healthz`` reports (only ``ok``/``draining`` are HTTP
+        200)."""
         if self._fatal is not None:
             return "failed"
         if self._stopped.is_set():
             return "stopped"
         if not self._ready.is_set():
             return "warming"
+        with self._lock:
+            degraded = self._degraded_reason is not None
+        if degraded:
+            return "degraded"
         return "draining" if self.draining else "ok"
 
     def wait_ready(self, timeout: Optional[float] = None) -> bool:
@@ -353,6 +624,12 @@ class Server:
                     pass
             h._finish(FAILED if fail else CANCELLED, wrapped)
             self._count("failed" if fail else "cancelled")
+        for h in self._replay:
+            # replays never reached the rebuilt engine — no capacity to
+            # reclaim, just a terminal state so result() can't hang
+            h._finish(FAILED if fail else CANCELLED, wrapped)
+            self._count("failed" if fail else "cancelled")
+        self._replay = []
         for h in self.queue.drain_all():
             h._finish(FAILED if fail else CANCELLED, wrapped)
             self._count("failed" if fail else "cancelled")
@@ -367,19 +644,313 @@ class Server:
             self._count("failed" if fail else "cancelled")
         self._active.clear()
 
+    # -- fault containment ---------------------------------------------------
+    def _guard(self, site: str, fn):
+        """Run one engine-touching step at a BATCH-wide seam
+        (decode/collect/cancel): any non-fatal exception becomes an
+        engine-scoped fault signal — there is no single request to
+        contain it to, and the shared device state is suspect."""
+        try:
+            return fn()
+        except _EngineFaultSignal:
+            raise
+        except Exception as e:
+            if classify_fault(e, site) == "fatal":  # future-proofing;
+                raise                               # fatal is Base-only
+            self._count_fault("engine", site)
+            self._faulted = True   # drain-visible until _recover ends
+            raise _EngineFaultSignal(site, e) from e
+
+    def _contain(self, h: RequestHandle, exc: Exception,
+                 site: str) -> None:
+        """Fault containment at a REQUEST-scoped seam (admission /
+        chunk): classify the blast radius. A request-scoped fault
+        finishes ONLY this handle as FAILED with its cause — the
+        engine's abort guards already reclaimed the slot and pages —
+        and the caller keeps serving everyone else. An engine-scoped
+        one escalates to the loop's recovery handler with the
+        triggering handle riding along for replay."""
+        kind = classify_fault(exc, site)
+        if kind == "fatal":
+            raise exc
+        self._count_fault(kind, site)
+        if kind == "request":
+            h._finish(FAILED, exc)
+            self._count("failed")
+            return
+        # the handle now rides ONLY inside the signal until _recover
+        # parks it — flag the window so a timed drain() can't report
+        # "everything finished" while it unwinds
+        self._faulted = True
+        raise _EngineFaultSignal(site, exc, h) from exc
+
+    def _recover(self, sig: _EngineFaultSignal) -> bool:
+        """Supervised engine recovery (scheduler thread): back off
+        exponentially, rebuild device state (``engine.reset_state`` —
+        compiled programs survive), and requeue every in-flight request
+        for REPLAY from its stored prompt + tokens emitted so far.
+        Requests past their ``max_replays`` budget fail with the fault
+        as cause; cancel-requested ones finish CANCELLED. Returns False
+        when the lifetime ``max_restarts`` budget is exhausted, and
+        RAISES (carrying the rebuild error) when ``reset_state`` itself
+        fails — either way the caller falls through to the fatal
+        ``_finalize`` path with an honest diagnosis."""
+        try:
+            return self._recover_inner(sig)
+        finally:
+            # every exit parked the signal's handle somewhere a
+            # finalizer or the next gap reaches — the drain-visibility
+            # window the seams flagged is over
+            self._faulted = False
+
+    def _recover_inner(self, sig: _EngineFaultSignal) -> bool:
+        if self._restarts >= self.max_restarts:
+            # the triggering handle may live in NO collection yet (an
+            # admission-seam fault pops it from the queue first) — park
+            # it where the fatal _finalize will fail it, never strand it
+            if sig.handle is not None:
+                self._replay.append(sig.handle)
+            return False
+        self._restarts += 1      # counts ATTEMPTED-and-allowed restarts
+        t0 = time.monotonic()
+        self._set_degraded(
+            f"recovering from engine fault at {sig.site}: "
+            f"{sig.cause!r}")
+        if monitor.enabled():
+            self._restarts_counter().labels(
+                server=self.monitor_server).inc()
+        # _admitting makes the whole recovery window visible to a timed
+        # drain(): handles leave _active/_adm below and only land back
+        # in _replay at the end — without this a drain timing out
+        # mid-recovery would report "everything finished"
+        self._admitting = True
+        try:
+            # snapshot in-flight work BEFORE touching the engine: its
+            # device state is suspect, so no cancel_request/abort_admit
+            # — reset_state reclaims every slot and page wholesale
+            inflight = []
+            if sig.handle is not None:
+                inflight.append(sig.handle)
+            if self._adm is not None:
+                _, h = self._adm
+                self._adm = None
+                inflight.append(h)
+            inflight.extend(self._active.values())
+            self._active.clear()
+            # transient device faults (preemption, collective timeout)
+            # need breathing room before the rebuild retries the device
+            # — but the backoff must stay interruptible: a shutdown
+            # racing a fault storm cannot wait out 2s sleeps
+            end = time.monotonic() + min(
+                self.restart_backoff_s * (2 ** (self._restarts - 1)),
+                self.restart_backoff_max_s)
+            while True:
+                with self._lock:
+                    stopping = self._stopping
+                rem = end - time.monotonic()
+                if stopping or rem <= 0:
+                    break
+                time.sleep(min(0.05, rem))
+            if stopping:
+                # shutdown won the race: park the in-flight handles for
+                # the loop's exit cleanup (clean stop → CANCELLED,
+                # crash → FAILED; never stranded) — but still rebuild
+                # best-effort: the engine is CALLER-owned and outlives
+                # this server, so a raced stop must not hand back an
+                # engine with poisoned device state and leaked
+                # slots/pages (reset is cheap — no compiles)
+                self._replay.extend(inflight)
+                self._clear_degraded()
+                try:
+                    self.engine.reset_state()
+                except Exception:
+                    pass
+                return True
+            try:
+                self.engine.reset_state()
+            except Exception as rebuild_err:
+                # the rebuild itself failed — nothing left to try. The
+                # snapshotted handles were already pulled out of
+                # _active/_adm; park them in _replay so the fatal
+                # _finalize reaches every one (result() must never
+                # hang), drop the stale "recovering" degraded reason
+                # (the terminal status is "failed", not failed-but-
+                # mid-recovery), and DIAGNOSE honestly: the fatal error
+                # must carry the rebuild failure, not claim a restart
+                # budget that was never exhausted
+                self._replay.extend(inflight)
+                self._clear_degraded()
+                self._count_fault("engine", "reset")
+                raise RuntimeError(
+                    f"engine rebuild (reset_state) failed during "
+                    f"recovery from the {sig.site} fault "
+                    f"{sig.cause!r}: {rebuild_err!r}") from rebuild_err
+            for h in inflight:
+                if h._cancel_requested:
+                    h._finish(CANCELLED)
+                    self._count("cancelled")
+                    continue
+                h._replays += 1
+                if h._replays > self.max_replays:
+                    h._finish(FAILED, RuntimeError(
+                        f"request {h.id} exceeded its replay budget "
+                        f"(max_replays={self.max_replays}) across "
+                        f"engine restarts; last fault at {sig.site}: "
+                        f"{sig.cause!r}"))
+                    self._count("failed")
+                else:
+                    self._replay.append(h)
+        finally:
+            self._admitting = False
+        dt = time.monotonic() - t0
+        with self._lock:
+            self._recovery_s.append(dt)
+        if monitor.enabled():
+            self._recovery_hist().labels(
+                server=self.monitor_server).observe(dt)
+        # refresh the heartbeat BEFORE dropping the degraded flag: the
+        # beat is stale by the whole recovery (backoff included), and a
+        # watchdog tick landing between the clear and the loop's next
+        # beat would record a phantom stall
+        self._beat = time.monotonic()
+        self._clear_degraded()
+        self._depth_gauge()
+        return True
+
+    # -- admission helpers ---------------------------------------------------
+    def _start_admission(self, h: RequestHandle, ids, cfg,
+                         plen: int) -> bool:
+        """Admit one request NOW (capacity already probed): one-shot,
+        or begin a chunked admission for prompts longer than the
+        engine's ``prefill_chunk``. Returns True when the request is
+        live (or its chunked admission is in flight); False when a
+        request-scoped fault failed the handle (capacity reclaimed by
+        the engine's abort guards). Engine-scoped faults escalate via
+        :meth:`_contain`."""
+        chunk = getattr(self.engine, "prefill_chunk", None)
+        if chunk is not None and plen > chunk:
+            # long prompt: claim capacity now, prefill one fixed-shape
+            # chunk per gap (decode segments run in between) instead of
+            # one monopolizing prefill
+            try:
+                adm = self.engine.begin_admit(ids, cfg)
+            except Exception as e:
+                self._contain(h, e, "admit")
+                return False
+            self._adm = (adm, h)
+            return True
+        try:
+            rid = self.engine.add_request(ids, cfg)
+        except Exception as e:
+            self._contain(h, e, "admit")
+            return False
+        h._mark_running(rid)
+        self._active[rid] = h
+        # admission prefill already sampled the first token: push it
+        # now — the TTFT edge for the handle's stream
+        toks = self.engine.partial_tokens(rid)
+        if toks is not None:
+            self._push_delta(h, toks)
+        return True
+
+    def _admit_replays(self) -> None:
+        """Re-admit requests surviving an engine restart, FIRST (before
+        new queue work): they already held capacity when the fault hit,
+        and a replay reserves exactly what the original did
+        (prompt + full budget), so the rebuilt engine always has room —
+        at worst a replay longer than ``prefill_chunk`` waits its turn
+        behind the single in-flight chunked admission.
+
+        A replay re-prefills ``prompt + tokens emitted so far`` (the
+        bucketed/chunked machinery treats it like any prompt) with the
+        budget reduced by what was already emitted. Greedy replay is
+        bitwise-identical to the uninterrupted decode (causal prefill
+        of the same prefix); sampled requests continue on a fresh noise
+        stream. NO deadline check: the admission deadline was already
+        met the first time the request admitted. Deferral is O(1) —
+        the O(plen) replay-prompt build only happens on the gap that
+        actually admits."""
+        pending, self._replay = self._replay, []
+        still = []
+        chunk = getattr(self.engine, "prefill_chunk", None)
+        # drain visibility: the caller (_gap) holds _admitting for its
+        # whole body, covering the window where handles live only in
+        # these locals
+        try:
+            while pending:
+                h = pending.pop(0)
+                if h._cancel_requested:
+                    h._finish(CANCELLED)
+                    self._count("cancelled")
+                    continue
+                n_toks = h._n_pushed    # == len(h._tokens): scheduler-
+                #                         thread bookkeeping, O(1)
+                remaining = h.cfg.max_new_tokens - n_toks
+                if remaining < 1:
+                    # fully emitted before the fault (retirement raced
+                    # the crash) — it is simply finished
+                    h._finish(FINISHED)
+                    self._count("completed")
+                    continue
+                plen = h.prompt_len + n_toks
+                if (chunk is not None and plen > chunk
+                        and self._adm is not None):
+                    still.append(h)     # waits behind the in-flight
+                    continue            # chunked admission
+                # every config field carries over verbatim (vars(), not
+                # a hand-written field list — a field added to
+                # GenerationConfig later must not silently reset to its
+                # default on replay); only the budget shrinks
+                kw = dict(vars(h.cfg))
+                kw["max_new_tokens"] = remaining
+                rcfg = GenerationConfig(**kw)
+                if not self.engine.can_admit(plen, rcfg):
+                    still.append(h)
+                    continue
+                ids = np.concatenate(
+                    [_prompt_ids(h.prompt)[0],
+                     np.asarray(h.tokens_so_far(), np.int32)]) \
+                    if n_toks else _prompt_ids(h.prompt)[0]
+                # the engine's token list restarts at 0 for the
+                # replayed rid; handle-side indices keep counting from
+                # the full history
+                h._engine_base = n_toks
+                self._start_admission(h, ids, rcfg, plen)
+        finally:
+            # an engine-fault signal mid-iteration leaves the
+            # unprocessed tail (and the deferred ones) queued for the
+            # next recovery/gap — nothing is stranded or duplicated
+            self._replay = still + pending + self._replay
+
     def _gap(self) -> None:
         """The inter-segment gap: cancellations first (they free
         capacity), then ONE chunk of any in-flight chunked admission
         (bounded gap work — decode segments run between chunks), then
-        expiry reaping, then admission while the engine's capacity
-        probe allows."""
+        expiry reaping, then replay re-admissions, then admission while
+        the engine's capacity probe allows.
+
+        ``_admitting`` is held for the WHOLE gap: at several points a
+        handle lives only in locals (mid-admission, mid-replay, the
+        chunk-abort window) and a timed ``drain()`` must never see
+        "queue empty, nothing active" through one of them."""
+        self._admitting = True
+        try:
+            self._gap_body()
+        finally:
+            self._admitting = False
+        self._depth_gauge()
+
+    def _gap_body(self) -> None:
         # 1. cancellations of RUNNING requests retire their slots
         for rid, h in list(self._active.items()):
             if h._cancel_requested:
-                toks = self.engine.cancel_request(rid)
+                toks = self._guard(
+                    "cancel",
+                    lambda rid=rid: self.engine.cancel_request(rid))
                 del self._active[rid]
                 if toks is not None:
-                    self._push_delta(h, list(toks[h._n_pushed:]))
+                    self._push_delta(
+                        h, list(toks[h._n_pushed - h._engine_base:]))
                 h._finish(CANCELLED)
                 self._count("cancelled")
         # 1b. advance the in-flight chunked admission by ONE fixed-shape
@@ -390,21 +961,36 @@ class Server:
         #     matter how long the prompt
         if self._adm is not None:
             adm, h = self._adm
-            expired = (h.deadline is not None
+            # the deadline is an ADMISSION deadline: a chunked REPLAY
+            # (_engine_base > 0 — the request already admitted once and
+            # emitted tokens) met it the first time and must not expire
+            # mid-recovery
+            expired = (h.deadline is not None and h._engine_base == 0
                        and time.monotonic() >= h.deadline)
             if h._cancel_requested or expired:
                 self._adm = None
-                self.engine.abort_admit(adm)
                 h._finish(CANCELLED if h._cancel_requested else EXPIRED)
                 self._count("cancelled" if h._cancel_requested
                             else "expired")
+                # the handle is terminal first: if the abort itself
+                # faults, recovery reclaims capacity wholesale and the
+                # client is not stranded behind the engine's health
+                self._guard("cancel",
+                            lambda: self.engine.abort_admit(adm))
             else:
                 try:
                     finished = self.engine.admit_chunk(adm)
                 except Exception as e:
                     self._adm = None
-                    h._finish(FAILED, e)
-                    self._count("failed")
+                    # admit_chunk aborts itself on ITS failures, but a
+                    # fault at the call seam (injection, wrapper bug)
+                    # leaves the claim open — abort_admit is idempotent,
+                    # so reclaim unconditionally before containment
+                    try:
+                        self.engine.abort_admit(adm)
+                    except Exception:
+                        pass   # engine-scoped path: reset reclaims all
+                    self._contain(h, e, "chunk")
                 else:
                     if finished:
                         self._adm = None
@@ -421,13 +1007,23 @@ class Server:
             else:
                 h._finish(EXPIRED)
                 self._count("expired")
-        # 3. admission: probe, never catch — deferral is the scheduler
-        #    path, add_request raising is the programmer-error path.
-        #    _admitting covers the whole pop→_active window (set BEFORE
-        #    the pop): a timed drain() must never see "queue empty, no
-        #    actives" while a request is mid-admission (prefill can be
+        # 2b. replays surviving an engine restart re-admit before new
+        #     queue work (their capacity claim predates the fault)
+        if self._replay:
+            self._admit_replays()
+        if self._replay:
+            # replays still pending (e.g. waiting behind the single
+            # chunked admission): do NOT admit new queue work this gap
+            # — fresh traffic would claim the pages/slots the replays'
+            # pre-fault reservations are owed, starving them behind
+            # arrivals that keep refilling the pool
+            return
+        # 3. admission: probe, never catch capacity — deferral is the
+        #    scheduler path, add_request raising is the programmer-error
+        #    path; a raise that happens anyway is a FAULT and goes
+        #    through containment (_contain). The caller's _admitting
+        #    span covers the whole pop→_active window (prefill can be
         #    seconds on a first compile).
-        self._admitting = True
         chunk = getattr(self.engine, "prefill_chunk", None)
 
         def admittable(h) -> bool:
@@ -441,61 +1037,33 @@ class Server:
                 return False
             return True
 
-        try:
-            while True:
-                h = self.queue.pop_if(admittable)
-                if h is None:
-                    # head (if any) does not fit RIGHT NOW. With the
-                    # engine completely idle it can never fit — fail it
-                    # loudly instead of wedging the queue forever. The
-                    # pop re-checks the probe under the queue lock: a
-                    # racing submit may have put a NEW, admittable head
-                    # in front, which must not be the one failed.
-                    if (self.queue.depth and not self._active
-                            and self.engine.free_slots()
-                            == self.engine.max_batch):
-                        bad = self.queue.pop_if(
-                            lambda h: not self.engine.can_admit(
-                                h.prompt_len, h.cfg))
-                        if bad is not None:
-                            bad._finish(FAILED, RuntimeError(
-                                f"request {bad.id} (prompt_len="
-                                f"{bad.prompt_len}, max_new_tokens="
-                                f"{bad.cfg.max_new_tokens}) can never "
-                                "be admitted: engine capacity (page "
-                                "pool / max_len) is too small even "
-                                "when idle"))
-                            self._count("failed")
-                        continue
-                    break
-                if chunk is not None and h.prompt_len > chunk:
-                    # long prompt: claim capacity now, prefill one
-                    # fixed-shape chunk per gap (decode segments run in
-                    # between) instead of one monopolizing prefill
-                    try:
-                        adm = self.engine.begin_admit(h.prompt, h.cfg)
-                    except Exception as e:  # pragma: no cover - skew
-                        h._finish(FAILED, e)
+        while True:
+            h = self.queue.pop_if(admittable)
+            if h is None:
+                # head (if any) does not fit RIGHT NOW. With the
+                # engine completely idle it can never fit — fail it
+                # loudly instead of wedging the queue forever. The
+                # pop re-checks the probe under the queue lock: a
+                # racing submit may have put a NEW, admittable head
+                # in front, which must not be the one failed.
+                if (self.queue.depth and not self._active
+                        and self.engine.free_slots()
+                        == self.engine.max_batch):
+                    bad = self.queue.pop_if(
+                        lambda h: not self.engine.can_admit(
+                            h.prompt_len, h.cfg))
+                    if bad is not None:
+                        bad._finish(FAILED, RuntimeError(
+                            f"request {bad.id} (prompt_len="
+                            f"{bad.prompt_len}, max_new_tokens="
+                            f"{bad.cfg.max_new_tokens}) can never "
+                            "be admitted: engine capacity (page "
+                            "pool / max_len) is too small even "
+                            "when idle"))
                         self._count("failed")
-                        continue
-                    self._adm = (adm, h)
                     continue
-                try:
-                    rid = self.engine.add_request(h.prompt, h.cfg)
-                except Exception as e:  # pragma: no cover - probe skew
-                    h._finish(FAILED, e)
-                    self._count("failed")
-                    continue
-                h._mark_running(rid)
-                self._active[rid] = h
-                # admission prefill already sampled the first token:
-                # push it now — the TTFT edge for the handle's stream
-                toks = self.engine.partial_tokens(rid)
-                if toks is not None:
-                    self._push_delta(h, toks)
-        finally:
-            self._admitting = False
-        self._depth_gauge()
+                break
+            self._start_admission(h, h.prompt, h.cfg, h.prompt_len)
 
     def _push_delta(self, h: RequestHandle, toks) -> None:
         """Push newly generated tokens (scheduler thread only);
@@ -508,22 +1076,26 @@ class Server:
 
     def _collect(self) -> None:
         """Post-segment: finish retired requests, stream deltas for the
-        still-running ones."""
+        still-running ones. Engine-side token indices are offset by a
+        replayed handle's ``_engine_base`` (tokens emitted before the
+        last restart live only handle-side)."""
         for rid, seq in self.engine.collect_finished().items():
             h = self._active.pop(rid, None)
             if h is None:      # foreign request (user drove the engine)
                 continue
-            self._push_delta(h, list(seq[h._n_pushed:]))
+            self._push_delta(
+                h, list(seq[h._n_pushed - h._engine_base:]))
             h._finish(FINISHED)
             self._count("completed")
             if monitor.enabled():
-                n = len(seq)
+                n = len(seq) + h._engine_base
                 if h.first_token_ts is not None and n > 1:
                     self._tpot_hist().labels(
                         server=self.monitor_server).observe(
                         (h.finish_ts - h.first_token_ts) / (n - 1))
         for rid, h in list(self._active.items()):
-            delta = self.engine.partial_tokens(rid, h._n_pushed)
+            delta = self.engine.partial_tokens(
+                rid, h._n_pushed - h._engine_base)
             if delta:
                 self._push_delta(h, delta)
         self._depth_gauge()

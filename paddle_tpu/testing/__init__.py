@@ -1,0 +1,13 @@
+"""paddle_tpu.testing — deterministic test harnesses.
+
+Production robustness features need reproducible misbehavior to test
+against; this package holds the harnesses that create it. Today:
+:mod:`~paddle_tpu.testing.faults` — deterministic, site-named fault
+injection at the serving-path seams (admission, prefill, chunked
+prefill, decode segment, collect), driving the chaos suite
+``tests/test_serving_faults.py`` and ``tools/serve_bench.py``'s
+``--fault-rate`` chaos knobs.
+"""
+from .faults import SITES, FaultPlan, FaultyEngine, InjectedFault
+
+__all__ = ["SITES", "FaultPlan", "FaultyEngine", "InjectedFault"]

@@ -489,8 +489,10 @@ class TestServerOnline:
         """If the loop dies (engine bug, XLA error), every outstanding
         handle must reach a terminal state — clients blocked in
         result() would otherwise hang forever — and healthz-facing
-        status must say 'failed'."""
-        srv, eng, cfg = _server(segment_steps=2)
+        status must say 'failed'. max_restarts=0 disables supervised
+        recovery so the first engine fault IS the death (the recovery
+        path has its own suite: test_serving_faults.py)."""
+        srv, eng, cfg = _server(segment_steps=2, max_restarts=0)
         try:
             def boom(*a, **kw):
                 raise RuntimeError("injected engine fault")

@@ -1,0 +1,729 @@
+"""Chaos suite for the fault-isolated serving path (ISSUE 4).
+
+Covers the blast-radius contract end to end on CPU, driven by the
+deterministic injection harness (`paddle_tpu.testing.faults`):
+
+- per-request CONTAINMENT: a fault injected at a request-scoped seam
+  (admission call, prefill inside the abort guard, chunked-prefill
+  chunk) fails ONLY the poisoned request with its cause; concurrent
+  requests complete with token parity vs a fault-free run, and after
+  drain the slot heap and page free-list show zero leaked capacity;
+- supervised ENGINE RECOVERY: an engine-scoped fault during
+  ``decode_segment`` triggers reset + replay (re-prefill of
+  prompt + generated) within ``max_restarts``; greedy in-flight
+  requests finish with IDENTICAL final tokens; per-request
+  ``max_replays`` and server ``max_restarts`` budgets both enforce,
+  the latter falling through to the fatal path (prompt terminal
+  states, never hangs);
+- the STALL WATCHDOG: an injected hang flips ``/healthz`` to
+  ``degraded`` (503) within ``stall_timeout_s`` and clears when the
+  loop beats again; a degraded server rejects submissions with reason;
+- satellites: client-disconnect reclaim (BrokenPipe mid-stream →
+  cancel → slot AND pages back), failed/degraded HTTP surfacing,
+  shutdown/drain during warmup and submit-after-crash returning
+  promptly, monitor fault/restart/degraded export, and the
+  serve_bench chaos soak (slow tier).
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.inference.generation import (CausalLMEngine, EngineFault,
+                                             GenerationConfig,
+                                             PagedContinuousBatchingEngine,
+                                             RequestFault, classify_fault)
+from paddle_tpu.serving import (RequestCancelled, RequestFailed,
+                                RequestRejected, Server, serve_http)
+from paddle_tpu.testing.faults import (SITES, FaultPlan, FaultyEngine,
+                                       InjectedFault)
+
+
+def tiny_model(layers=1, seed=0):
+    paddle.seed(seed)
+    from paddle_tpu.models import LlamaForCausalLM, llama_config
+    cfg = llama_config("tiny", num_hidden_layers=layers)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def paged_engine(model, max_batch=3, num_pages=24, page_size=8,
+                 max_pages=8, **kw):
+    return PagedContinuousBatchingEngine(
+        model, max_batch=max_batch, num_pages=num_pages,
+        page_size=page_size, max_pages=max_pages, **kw)
+
+
+def faulty_server(plan=None, model_layers=1, **kw):
+    """(server, RAW engine, model cfg) — the engine is wrapped in a
+    FaultyEngine when a plan is given; capacity assertions go against
+    the raw engine."""
+    model, cfg = tiny_model(layers=model_layers)
+    eng_keys = ("max_batch", "num_pages", "page_size", "max_pages",
+                "prefill_buckets", "prefill_chunk")
+    eng_kw = {k: kw.pop(k) for k in list(kw) if k in eng_keys}
+    raw = paged_engine(model, **eng_kw)
+    eng = FaultyEngine(raw, plan) if plan is not None else raw
+    return Server(eng, **kw), raw, cfg
+
+
+@pytest.fixture()
+def mon():
+    monitor.enable()
+    monitor.reset()
+    yield monitor
+    monitor.reset()
+    monitor.disable()
+
+
+def _greedy(n):
+    return GenerationConfig(max_new_tokens=n, eos_token_id=None)
+
+
+def _oracle(model, prompts, maxes, max_len=64):
+    """Expected greedy tokens per prompt via the dense engine (bitwise
+    parity with the continuous-batching engines is established by the
+    existing suites)."""
+    dense = CausalLMEngine(model, max_batch=1, max_len=max_len)
+    return [dense.generate(p[None], _greedy(m))[0, len(p):]
+            for p, m in zip(prompts, maxes)]
+
+
+def _assert_no_leaks(eng):
+    assert eng.free_slots() == eng.max_batch
+    assert eng.alloc.free_pages == eng.num_pages
+
+
+class TestTaxonomy:
+    def test_classify_fault(self):
+        assert classify_fault(RequestFault("x"), "decode") == "request"
+        assert classify_fault(EngineFault("x"), "admit") == "engine"
+        for site in ("admit", "prefill", "chunk"):
+            assert classify_fault(RuntimeError("x"), site) == "request"
+        for site in ("decode", "collect", "cancel"):
+            assert classify_fault(RuntimeError("x"), site) == "engine"
+        assert classify_fault(KeyboardInterrupt(), "admit") == "fatal"
+        assert classify_fault(SystemExit(), "decode") == "fatal"
+
+
+class TestFaultPlan:
+    def test_nth_and_times_deterministic(self):
+        plan = FaultPlan()
+        plan.raise_at("decode", nth=2, times=2)
+        plan.fire("decode")                    # call 1: clean
+        with pytest.raises(InjectedFault, match="call 2"):
+            plan.fire("decode")
+        with pytest.raises(InjectedFault):
+            plan.fire("decode")
+        plan.fire("decode")                    # rule retired
+        assert [(s, n) for s, n, _ in plan.injected] == [
+            ("decode", 2), ("decode", 3)]
+        assert plan.calls["decode"] == 4
+
+    def test_sites_are_independent_and_validated(self):
+        plan = FaultPlan().raise_at("admit", nth=1)
+        plan.fire("decode")                    # other seams untouched
+        with pytest.raises(InjectedFault):
+            plan.fire("admit")
+        with pytest.raises(ValueError, match="unknown site"):
+            plan.raise_at("nope")
+        assert set(SITES) == {"admit", "prefill", "chunk", "decode",
+                              "collect"}
+
+    def test_hang_bounded_and_releasable(self):
+        plan = FaultPlan().hang_at("decode", nth=1, seconds=30)
+        t = threading.Timer(0.05, plan.release_hangs)
+        t.start()
+        t0 = time.monotonic()
+        plan.fire("decode")                    # returns once released
+        assert time.monotonic() - t0 < 5
+        t.join()
+
+    def test_custom_exception_passthrough(self):
+        plan = FaultPlan().raise_at("decode",
+                                    exc=EngineFault("device lost"))
+        with pytest.raises(EngineFault, match="device lost"):
+            plan.fire("decode")
+
+
+class TestEngineReset:
+    def test_reset_state_reclaims_everything_and_still_serves(self):
+        """reset_state (the recovery hook) must rebuild to a state
+        indistinguishable from fresh: full slot heap and page pool, no
+        collectables, and subsequent greedy decode identical."""
+        model, cfg = tiny_model()
+        eng = paged_engine(model, max_batch=2, num_pages=12)
+        rng = np.random.RandomState(0)
+        p = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        want = _oracle(model, [p], [5])[0]
+        eng.add_request(p, _greedy(30))
+        eng.add_request(rng.randint(0, cfg.vocab_size, (4,))
+                        .astype(np.int32), _greedy(30))
+        eng.decode_segment(2)
+        eng.reset_state()
+        _assert_no_leaks(eng)
+        assert eng.collect_finished() == {}
+        rid = eng.add_request(p, _greedy(5))
+        while eng.decode_segment(4):
+            pass
+        np.testing.assert_array_equal(eng.collect_finished()[rid], want)
+        _assert_no_leaks(eng)
+
+
+class TestRequestContainment:
+    def test_prefill_fault_fails_one_alone_with_parity(self, mon):
+        """A fault INSIDE the second admission's prefill (capacity
+        already claimed) fails only that request with its cause; the
+        neighbours finish with token parity vs a fault-free run and
+        nothing leaks."""
+        model, _ = tiny_model()
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, 100, (n,)).astype(np.int32)
+                   for n in (5, 7, 4)]
+        want = _oracle(model, [prompts[0], prompts[2]], [8, 6])
+
+        plan = FaultPlan().raise_at("prefill", nth=2)
+        srv, eng, cfg = faulty_server(plan, max_batch=3,
+                                      segment_steps=2)
+        try:
+            h1 = srv.submit(prompts[0], _greedy(8))
+            h2 = srv.submit(prompts[1], _greedy(8))
+            h3 = srv.submit(prompts[2], _greedy(6))
+            with pytest.raises(RequestFailed, match="injected fault"):
+                h2.result(timeout=120)
+            np.testing.assert_array_equal(h1.result(timeout=120),
+                                          want[0])
+            np.testing.assert_array_equal(h3.result(timeout=120),
+                                          want[1])
+            # the loop kept serving: no restart, status stays ok
+            assert srv.restarts == 0
+            assert srv.status == "ok"
+            fs = srv.fault_stats()
+            # the prefill raise surfaces at the admission seam
+            assert fs["faults"] == {("request", "admit"): 1}
+            assert srv.drain(timeout=120)
+            _assert_no_leaks(eng)
+            # monitor export (before shutdown retires the series)
+            snap = monitor.snapshot()["metrics"]
+            s = snap["paddle_tpu_serving_faults_total"]["samples"][0]
+            assert s["labels"]["kind"] == "request"
+            assert s["labels"]["site"] == "admit"
+            assert s["value"] == 1
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_admit_seam_fault_fails_one_alone(self):
+        """A fault at the admission CALL seam (before any capacity is
+        claimed) — same containment, zero leak."""
+        plan = FaultPlan().raise_at("admit", nth=1)
+        srv, eng, cfg = faulty_server(plan, max_batch=2,
+                                      segment_steps=2)
+        try:
+            h1 = srv.submit(np.arange(4, dtype=np.int32), _greedy(4))
+            with pytest.raises(RequestFailed, match="injected fault"):
+                h1.result(timeout=120)
+            h2 = srv.submit(np.arange(5, dtype=np.int32), _greedy(4))
+            assert len(h2.result(timeout=120)) == 4
+            assert srv.drain(timeout=120)
+            _assert_no_leaks(eng)
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_chunk_fault_fails_long_request_alone(self, mon):
+        """A fault on the SECOND chunk of a chunked admission fails the
+        long request only (admit_chunk's abort guard reclaims the
+        up-front slot + worst-case pages); a concurrent short request
+        completes with parity."""
+        model, _ = tiny_model()
+        rng = np.random.RandomState(2)
+        long_p = rng.randint(0, 100, (20,)).astype(np.int32)
+        short_p = rng.randint(0, 100, (4,)).astype(np.int32)
+        want = _oracle(model, [short_p], [6])[0]
+
+        plan = FaultPlan().raise_at("chunk", nth=2)
+        srv, eng, cfg = faulty_server(
+            plan, max_batch=2, num_pages=24, page_size=8, max_pages=8,
+            prefill_chunk=8, segment_steps=2)
+        try:
+            hl = srv.submit(long_p, _greedy(6))
+            hs = srv.submit(short_p, _greedy(6))
+            with pytest.raises(RequestFailed, match="injected fault"):
+                hl.result(timeout=120)
+            np.testing.assert_array_equal(hs.result(timeout=120), want)
+            assert srv.fault_stats()["faults"] == {
+                ("request", "chunk"): 1}
+            assert srv.drain(timeout=120)
+            _assert_no_leaks(eng)
+        finally:
+            srv.shutdown(drain=False)
+
+
+class TestEngineRecovery:
+    def test_decode_fault_recovers_with_identical_tokens(self, mon):
+        """An EngineFault mid-serving triggers ONE supervised restart;
+        both in-flight greedy requests replay (re-prefill of
+        prompt + generated) and finish with final tokens identical to
+        a fault-free run; zero leaked capacity after drain."""
+        model, _ = tiny_model()
+        rng = np.random.RandomState(3)
+        p1 = rng.randint(0, 100, (6,)).astype(np.int32)
+        p2 = rng.randint(0, 100, (9,)).astype(np.int32)
+        want = _oracle(model, [p1, p2], [10, 7])
+
+        plan = FaultPlan().raise_at(
+            "decode", nth=2, exc=EngineFault("injected device loss"))
+        srv, eng, cfg = faulty_server(plan, max_batch=2,
+                                      segment_steps=2,
+                                      restart_backoff_s=0.01)
+        try:
+            h1 = srv.submit(p1, _greedy(10))
+            h2 = srv.submit(p2, _greedy(7))
+            np.testing.assert_array_equal(h1.result(timeout=120),
+                                          want[0])
+            np.testing.assert_array_equal(h2.result(timeout=120),
+                                          want[1])
+            assert srv.restarts == 1
+            fs = srv.fault_stats()
+            assert fs["faults"] == {("engine", "decode"): 1}
+            assert len(fs["recovery_s"]) == 1
+            assert fs["degraded"] is None and srv.status == "ok"
+            # at most one replay each, and the server still serves
+            assert h1._replays <= 1 and h2._replays <= 1
+            h3 = srv.submit(p1, _greedy(3))
+            assert len(h3.result(timeout=120)) == 3
+            assert srv.drain(timeout=120)
+            _assert_no_leaks(eng)
+            # monitor export (before shutdown retires the series)
+            snap = monitor.snapshot()["metrics"]
+            restarts = snap["paddle_tpu_serving_restarts_total"][
+                "samples"]
+            assert restarts[0]["value"] == 1
+            assert "paddle_tpu_serving_recovery_seconds" in snap
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_engine_fault_during_admission_replays_request(self):
+        """An EngineFault raised at the ADMISSION seam escalates to
+        recovery with the triggering request riding along — it replays
+        after the reset instead of being stranded."""
+        plan = FaultPlan().raise_at(
+            "admit", nth=1, exc=EngineFault("admission device loss"))
+        srv, eng, cfg = faulty_server(plan, max_batch=2,
+                                      segment_steps=2,
+                                      restart_backoff_s=0.01)
+        try:
+            h = srv.submit(np.arange(5, dtype=np.int32), _greedy(4))
+            assert len(h.result(timeout=120)) == 4
+            assert srv.restarts == 1
+            assert h._replays == 1
+            assert srv.drain(timeout=120)
+            _assert_no_leaks(eng)
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_chunked_replay_rides_chunked_admission(self):
+        """A replay whose prompt + generated exceeds prefill_chunk
+        re-admits CHUNKED (one fixed-shape chunk per gap) and still
+        finishes with the fault-free greedy tokens."""
+        model, _ = tiny_model()
+        rng = np.random.RandomState(4)
+        long_p = rng.randint(0, 100, (20,)).astype(np.int32)
+        want = _oracle(model, [long_p], [10])[0]
+
+        # decode calls 1-2 are the no-op segments interleaved with the
+        # 3-chunk admission; the fault lands mid-decode, with tokens
+        # already emitted, so the replay prompt (20 + generated) is
+        # longer than the chunk and takes the chunked path
+        plan = FaultPlan().raise_at(
+            "decode", nth=5, exc=EngineFault("mid-decode loss"))
+        srv, eng, cfg = faulty_server(
+            plan, max_batch=2, num_pages=24, page_size=8, max_pages=8,
+            prefill_chunk=8, segment_steps=2, restart_backoff_s=0.01)
+        try:
+            h = srv.submit(long_p, _greedy(10))
+            np.testing.assert_array_equal(h.result(timeout=120), want)
+            assert srv.restarts == 1
+            assert h._replays == 1
+            assert srv.drain(timeout=120)
+            _assert_no_leaks(eng)
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_replay_budget_fails_request_server_survives(self):
+        """Two consecutive engine faults with max_replays=1: the
+        in-flight request exceeds ITS replay budget and fails with the
+        fault as cause, but the SERVER recovers and serves new work."""
+        plan = FaultPlan().raise_at(
+            "decode", nth=1, times=2, exc=EngineFault("flaky device"))
+        srv, eng, cfg = faulty_server(plan, max_batch=2,
+                                      segment_steps=2, max_replays=1,
+                                      restart_backoff_s=0.01)
+        try:
+            h = srv.submit(np.arange(5, dtype=np.int32), _greedy(6))
+            with pytest.raises(RequestFailed,
+                               match="exceeded its replay budget"):
+                h.result(timeout=120)
+            assert srv.restarts == 2
+            h2 = srv.submit(np.arange(4, dtype=np.int32), _greedy(3))
+            assert len(h2.result(timeout=120)) == 3
+            assert srv.status in ("ok", "draining")
+            assert srv.drain(timeout=120)
+            _assert_no_leaks(eng)
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_rebuild_failure_fails_inflight_never_hangs(self):
+        """If reset_state() ITSELF raises during recovery, the
+        snapshotted in-flight handles must still reach terminal FAILED
+        (parked for the fatal _finalize) — clients must never hang —
+        and the degraded flag must not survive into the failed state."""
+        plan = FaultPlan().raise_at(
+            "decode", nth=1, exc=EngineFault("device loss"))
+        srv, eng, cfg = faulty_server(plan, max_batch=2,
+                                      segment_steps=2,
+                                      restart_backoff_s=0.01)
+        try:
+            def broken_rebuild():
+                raise RuntimeError("rebuild also failed")
+            eng.reset_state = broken_rebuild
+            h = srv.submit(np.arange(4, dtype=np.int32), _greedy(6))
+            # the diagnosis must carry the REBUILD failure, not claim
+            # an exhausted restart budget (the budget wasn't)
+            with pytest.raises(RequestFailed, match="rebuild"):
+                h.result(timeout=120)
+            assert srv.status == "failed"
+            assert srv.fault_stats()["degraded"] is None
+            assert ("engine", "reset") in srv.fault_stats()["faults"]
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_admission_engine_fault_with_zero_restarts_terminal(self):
+        """max_restarts=0 + an EngineFault at the ADMISSION seam: the
+        triggering handle is in no collection yet (popped from the
+        queue) — it must still reach terminal FAILED, not be
+        stranded."""
+        plan = FaultPlan().raise_at(
+            "admit", nth=1, exc=EngineFault("admission device loss"))
+        srv, eng, cfg = faulty_server(plan, max_batch=2,
+                                      segment_steps=2, max_restarts=0)
+        try:
+            h = srv.submit(np.arange(4, dtype=np.int32), _greedy(4))
+            with pytest.raises(RequestFailed, match="scheduler died"):
+                h.result(timeout=120)
+            assert srv.status == "failed"
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_chunked_replay_ignores_admission_deadline(self):
+        """The admission deadline was met the first time the request
+        admitted; a chunked REPLAY crossing it mid-recovery (backoff
+        longer than the deadline) must complete, not EXPIRE."""
+        plan = FaultPlan().raise_at(
+            "decode", nth=3, exc=EngineFault("mid-decode loss"))
+        srv, eng, cfg = faulty_server(
+            plan, max_batch=2, num_pages=24, page_size=8, max_pages=8,
+            prefill_chunk=8, segment_steps=2, warmup=True,
+            restart_backoff_s=1.0)   # backoff alone outlives the ddl
+        try:
+            assert srv.wait_ready(timeout=300)
+            h = srv.submit(np.arange(12, dtype=np.int32) % 97,
+                           _greedy(8), timeout_s=0.8)
+            assert len(h.result(timeout=120)) == 8
+            assert srv.restarts == 1
+            assert h._replays == 1
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_restart_budget_falls_through_to_fatal(self):
+        """A persistent engine fault exhausts max_restarts and falls
+        through to the fatal path: handles reach terminal FAILED
+        promptly (no hung result()), status reads 'failed', and
+        submit-after-crash rejects immediately with the cause."""
+        plan = FaultPlan().raise_at(
+            "decode", nth=1, times=1000,
+            exc=EngineFault("persistent device loss"))
+        srv, eng, cfg = faulty_server(plan, max_batch=2,
+                                      segment_steps=2, max_restarts=1,
+                                      max_replays=100,
+                                      restart_backoff_s=0.01)
+        try:
+            h = srv.submit(np.arange(4, dtype=np.int32), _greedy(6))
+            with pytest.raises(RequestFailed, match="scheduler died"):
+                h.result(timeout=120)
+            assert srv.status == "failed"
+            assert srv.restarts == 1       # the one allowed restart
+            assert srv.wait_ready(timeout=10)
+            with pytest.raises(RequestRejected,
+                               match="scheduler died") as ei:
+                srv.submit(np.arange(3, dtype=np.int32), _greedy(2))
+            assert ei.value.reason == "shutdown"
+        finally:
+            srv.shutdown(drain=False)
+
+
+class TestStallWatchdog:
+    def test_timeout_below_idle_heartbeat_rejected(self):
+        """An idle loop only beats every idle_wait_s; a stall timeout
+        at/below that cadence would flap a healthy idle server into
+        degraded — rejected at construction."""
+        model, _ = tiny_model()
+        eng = paged_engine(model)
+        with pytest.raises(ValueError, match="idle_wait_s"):
+            Server(eng, idle_wait_s=0.02, stall_timeout_s=0.03,
+                   start=False)
+        with pytest.raises(ValueError, match="> 0"):
+            Server(eng, stall_timeout_s=0, start=False)
+
+
+    def test_hang_flips_healthz_degraded_then_recovers(self, mon):
+        """An injected hang in decode flips /healthz to degraded (503)
+        within stall_timeout_s; a degraded server rejects submissions
+        with reason; once the hang releases the status returns to ok
+        and the wedged request completes."""
+        plan = FaultPlan().hang_at("decode", nth=1, seconds=60)
+        srv, eng, cfg = faulty_server(plan, max_batch=2,
+                                      segment_steps=2,
+                                      stall_timeout_s=0.2)
+        httpd = serve_http(srv)
+        port = httpd.server_address[1]
+        from urllib.error import HTTPError
+        from urllib.request import Request, urlopen
+
+        def healthz():
+            try:
+                with urlopen(f"http://127.0.0.1:{port}/healthz",
+                             timeout=10) as r:
+                    return r.status, json.load(r)
+            except HTTPError as e:
+                return e.code, json.load(e)
+
+        try:
+            h = srv.submit(np.arange(4, dtype=np.int32), _greedy(4))
+            deadline = time.monotonic() + 30
+            code = body = None
+            while time.monotonic() < deadline:
+                code, body = healthz()
+                if body["status"] == "degraded":
+                    break
+                time.sleep(0.02)
+            assert body["status"] == "degraded", body
+            assert code == 503
+            assert ("stall", "loop") in srv.fault_stats()["faults"]
+            snap = monitor.snapshot()["metrics"]
+            deg = snap["paddle_tpu_serving_degraded"]["samples"][0]
+            assert deg["value"] == 1
+            # degraded rejects instead of queueing into a stalled loop
+            with pytest.raises(RequestRejected, match="degraded") as ei:
+                srv.submit(np.arange(3, dtype=np.int32), _greedy(2))
+            assert ei.value.reason == "degraded"
+            body_http = json.dumps({"prompt": [1, 2],
+                                    "max_new_tokens": 2}).encode()
+            with pytest.raises(HTTPError) as he:
+                urlopen(Request(f"http://127.0.0.1:{port}/generate",
+                                data=body_http), timeout=10)
+            assert he.value.code == 503
+            assert json.load(he.value)["reason"] == "degraded"
+            # release the hang: the loop beats, degraded clears, and
+            # the wedged request finishes
+            plan.release_hangs()
+            assert len(h.result(timeout=120)) == 4
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                code, body = healthz()
+                if body["status"] == "ok":
+                    break
+                time.sleep(0.02)
+            assert body["status"] == "ok" and code == 200
+        finally:
+            plan.release_hangs()
+            httpd.shutdown()
+            srv.shutdown(drain=False)
+
+
+class TestHTTPSatellites:
+    def test_client_disconnect_reclaims_slot_and_pages(self):
+        """BrokenPipeError mid-stream (serving/http.py cancel path):
+        the slot AND its KV pages must actually return to the pool at
+        the next gap — free-slot heap and page free-list back to full
+        after the disconnect drains."""
+        srv, eng, cfg = faulty_server(None, max_batch=2,
+                                      segment_steps=2)
+        httpd = serve_http(srv)
+        port = httpd.server_address[1]
+        try:
+            import http.client
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            conn.request("POST", "/generate", json.dumps(
+                {"prompt": [3, 1, 4], "max_new_tokens": 4000,
+                 "stream": True}),
+                {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            line = resp.readline()          # first streamed token
+            assert b"token" in line
+            # abrupt client disconnect mid-stream
+            conn.sock.close()
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if (eng.free_slots() == eng.max_batch
+                        and eng.alloc.free_pages == eng.num_pages):
+                    break
+                time.sleep(0.02)
+            _assert_no_leaks(eng)
+            # the server is still healthy for the next client
+            h = srv.submit(np.arange(3, dtype=np.int32), _greedy(3))
+            assert len(h.result(timeout=120)) == 3
+        finally:
+            httpd.shutdown()
+            srv.shutdown(drain=False)
+
+    def test_failed_server_healthz_503_and_reject(self):
+        """A failed (dead-scheduler) server: /healthz 503 with
+        status 'failed' in the body, and POST /generate rejects
+        immediately with a reason instead of queueing."""
+        plan = FaultPlan().raise_at(
+            "decode", nth=1, exc=EngineFault("boom"))
+        srv, eng, cfg = faulty_server(plan, max_batch=2,
+                                      segment_steps=2, max_restarts=0)
+        httpd = serve_http(srv)
+        port = httpd.server_address[1]
+        from urllib.error import HTTPError
+        from urllib.request import Request, urlopen
+        try:
+            h = srv.submit(np.arange(4, dtype=np.int32), _greedy(4))
+            with pytest.raises(RequestFailed):
+                h.result(timeout=120)
+            assert srv.status == "failed"
+            with pytest.raises(HTTPError) as ei:
+                urlopen(f"http://127.0.0.1:{port}/healthz", timeout=10)
+            assert ei.value.code == 503
+            body = json.load(ei.value)
+            assert body["status"] == "failed"
+            assert body["restarts"] == 0
+            with pytest.raises(HTTPError) as ei:
+                urlopen(Request(
+                    f"http://127.0.0.1:{port}/generate",
+                    data=json.dumps({"prompt": [1],
+                                     "max_new_tokens": 2}).encode()),
+                    timeout=10)
+            assert ei.value.code == 503
+            err = json.load(ei.value)
+            assert err["reason"] == "shutdown"
+            assert "scheduler died" in err["error"]
+        finally:
+            httpd.shutdown()
+            srv.shutdown(drain=False)
+
+
+class TestWarmupLifecycle:
+    def test_shutdown_during_warmup_returns_promptly(self):
+        """shutdown() issued while the server is still warming must
+        come back with every queued handle in a terminal state — no
+        hung result()/wait_ready() (builds on the PR 3 _ready-in-
+        finally fix)."""
+        srv, eng, cfg = faulty_server(None, max_batch=2,
+                                      segment_steps=2, warmup=True)
+        try:
+            # submissions queue while warming
+            h = srv.submit(np.arange(4, dtype=np.int32), _greedy(4))
+            srv.shutdown(drain=False, timeout=300)
+            assert srv.wait_ready(timeout=10)
+            assert srv.status == "stopped"
+            assert h.done and h.status == "cancelled"
+            with pytest.raises(RequestCancelled):
+                h.result(timeout=10)
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_drain_during_warmup_completes_queued(self):
+        """drain() issued mid-warmup waits for warmup + the queued
+        work, then returns True with everything finished."""
+        srv, eng, cfg = faulty_server(None, max_batch=2,
+                                      segment_steps=2, warmup=True)
+        try:
+            hs = [srv.submit(np.arange(n, dtype=np.int32) % 97,
+                             _greedy(4)) for n in (3, 5)]
+            assert srv.drain(timeout=600)
+            for h in hs:
+                assert h.status == "finished"
+                assert len(h.result(timeout=10)) == 4
+            _assert_no_leaks(eng)
+        finally:
+            srv.shutdown(drain=False)
+
+
+class TestTooling:
+    def test_monitor_report_serving_shows_fault_columns(self):
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "monitor_report", os.path.join(
+                os.path.dirname(__file__), "..", "tools",
+                "monitor_report.py"))
+        mr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mr)
+        records = [
+            {"metric": "paddle_tpu_serving_faults_total",
+             "labels": {"server": "server0", "kind": "engine",
+                        "site": "decode"}, "value": 2},
+            {"metric": "paddle_tpu_serving_restarts_total",
+             "labels": {"server": "server0"}, "value": 2},
+            {"metric": "paddle_tpu_serving_degraded",
+             "labels": {"server": "server0"}, "value": 0},
+            {"metric": "paddle_tpu_serving_recovery_seconds",
+             "labels": {"server": "server0"}, "value": 0.04,
+             "count": 2, "sum": 0.08},
+            {"metric": "paddle_tpu_something_else", "labels": {},
+             "value": 1},
+        ]
+        out = mr.render(records, serving=True)
+        assert "paddle_tpu_serving_faults_total" in out
+        assert "kind=engine" in out and "site=decode" in out
+        assert "paddle_tpu_serving_restarts_total" in out
+        assert "paddle_tpu_serving_degraded" in out
+        assert "paddle_tpu_serving_recovery_seconds" in out
+        assert "something_else" not in out
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_serve_bench_under_injected_faults(self, mon, capsys):
+        """The chaos soak: serve_bench drives open-loop load with
+        seeded engine faults injected at the decode seam; the run
+        completes, reports the fault/restart/recovery BENCH records,
+        and every arrival is accounted for (survived + failed +
+        rejected == requests)."""
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "serve_bench", os.path.join(
+                os.path.dirname(__file__), "..", "tools",
+                "serve_bench.py"))
+        sb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sb)
+        assert sb.main([
+            "--rate", "30", "--requests", "24", "--max-new", "8",
+            "--prompt-len", "3:12", "--fault-rate", "0.3",
+            "--fault-site", "decode", "--fault-kind", "engine",
+            "--max-restarts", "1000", "--restart-backoff", "0.01",
+            "--seed", "3"]) == 0
+        text = capsys.readouterr().out
+        recs = {}
+        for line in text.splitlines():
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            recs[r["metric"]] = r["value"]
+        assert "serve_faults_injected" in recs
+        assert "serve_restarts" in recs
+        assert recs["serve_requests_survived"] \
+            + recs["serve_requests_failed"] \
+            + recs["serve_rejected"] == 24
+        if recs["serve_restarts"]:
+            assert "serve_recovery_p50" in recs

@@ -75,9 +75,14 @@ def load_snapshot(snap: dict) -> List[dict]:
 
 
 # the serving metric families (scheduler + engine admission + KV pool)
-# --serving selects: one flag shows the whole online-serving picture
+# --serving selects: one flag shows the whole online-serving picture,
+# fault-isolation columns included (the paddle_tpu_serving_ prefix
+# covers faults_total{kind,site}, restarts_total, the degraded gauge,
+# and recovery_seconds alongside queue depth / TTFT / TPOT)
 SERVING_FAMILIES = (
-    "paddle_tpu_serving_",              # queue depth, TTFT, TPOT, events
+    "paddle_tpu_serving_",              # queue depth, TTFT, TPOT, events,
+    #                                     faults, restarts, degraded,
+    #                                     recovery
     "paddle_tpu_requests_total",        # engine lifecycle events
     "paddle_tpu_generated_tokens_total",
     "paddle_tpu_decode_tokens_per_sec",
@@ -132,7 +137,8 @@ def main(argv=None) -> int:
     ap.add_argument("--serving", action="store_true",
                     help="only the online-serving families (queue depth, "
                          "TTFT, TPOT, request events, tokens/sec, KV "
-                         "admission + occupancy)")
+                         "admission + occupancy, faults/restarts/"
+                         "degraded/recovery)")
     args = ap.parse_args(argv)
 
     if args.url:

@@ -31,9 +31,17 @@ Usage::
         --warmup --prefill-chunk 32
     python tools/serve_bench.py --prompt-dist lognormal --prompt-len 4:96 \
         --prefill-buckets none
+    # chaos soak (in-process only): inject seeded faults at the named
+    # serving seams and report survival/restart/recovery numbers — the
+    # fault-isolation acceptance run (README "Failure modes & recovery")
+    python tools/serve_bench.py --fault-rate 0.1 --fault-site decode \
+        --fault-kind engine --max-restarts 100
 
 Output: one human table plus BENCH-shaped JSON records
-(``{"metric": ..., "value": ..., "unit": ...}``) on stdout.
+(``{"metric": ..., "value": ..., "unit": ...}``) on stdout. Chaos runs
+add ``serve_faults_injected`` / ``serve_requests_survived`` /
+``serve_requests_failed`` / ``serve_restarts`` /
+``serve_recovery_p{50,90,99}``.
 """
 from __future__ import annotations
 
@@ -190,10 +198,43 @@ def _build_toy_server(args):
         model, max_batch=args.max_batch, num_pages=args.num_pages,
         page_size=args.page_size, max_pages=args.max_pages,
         prefill_buckets=buckets, prefill_chunk=args.prefill_chunk)
+    plan = None
+    if args.fault_rate > 0:
+        from paddle_tpu.inference.generation import EngineFault
+        from paddle_tpu.testing.faults import FaultPlan, FaultyEngine
+
+        plan = FaultPlan()
+        sites = [s.strip() for s in args.fault_site.split(",")
+                 if s.strip()]
+        if args.fault_kind == "request":
+            from paddle_tpu.inference.generation import REQUEST_SITES
+            batch_wide = [s for s in sites if s not in REQUEST_SITES]
+            if batch_wide:
+                # the scheduler escalates EVERY non-fatal fault at a
+                # batch-wide seam to engine recovery (no single request
+                # to pin it on) — a "request-kind" run there would
+                # silently measure restarts, not containment
+                print("warning: --fault-kind request at batch-wide "
+                      f"site(s) {batch_wide} is escalated to engine "
+                      "recovery; use admit/prefill/chunk to measure "
+                      "per-request containment", file=sys.stderr)
+        # engine-kind faults drive the supervised-recovery path;
+        # request-kind ones (site-default classification) drive
+        # per-request containment. A FACTORY, not an instance: every
+        # injection over a long soak must raise a fresh exception
+        exc = ((lambda: EngineFault("injected chaos fault"))
+               if args.fault_kind == "engine" else None)
+        plan.random_raises(sites, args.fault_rate, seed=args.seed,
+                           exc=exc)
+        eng = FaultyEngine(eng, plan)
     srv = Server(eng, max_queue=args.max_queue,
-                 segment_steps=args.segment_steps, warmup=args.warmup)
+                 segment_steps=args.segment_steps, warmup=args.warmup,
+                 max_restarts=args.max_restarts,
+                 max_replays=args.max_replays,
+                 restart_backoff_s=args.restart_backoff,
+                 stall_timeout_s=args.stall_timeout)
     srv.wait_ready()   # warmup compiles are NOT part of the measured run
-    return srv, cfg.vocab_size
+    return srv, cfg.vocab_size, plan
 
 
 def _draw_len(rng, dist: str, lo: int, hi: int) -> int:
@@ -266,6 +307,31 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup", action="store_true",
                     help="pre-compile all prefill buckets + the segment "
                          "program before the measured run")
+    # chaos knobs (in-process mode only; paddle_tpu.testing.faults)
+    ap.add_argument("--fault-rate", type=float, default=0.0,
+                    help="seeded per-call fault probability at each "
+                         "--fault-site seam (0 = chaos off)")
+    ap.add_argument("--fault-site", default="decode",
+                    metavar="SITE[,SITE...]",
+                    help="injection seams: admit, prefill, chunk, "
+                         "decode, collect")
+    ap.add_argument("--fault-kind", choices=("request", "engine"),
+                    default="engine",
+                    help="engine = EngineFault (supervised restart + "
+                         "replay); request = site-default "
+                         "classification (per-request containment at "
+                         "admission seams)")
+    ap.add_argument("--max-restarts", type=int, default=8,
+                    help="server lifetime engine-restart budget")
+    ap.add_argument("--max-replays", type=int, default=8,
+                    help="per-request replay budget across restarts "
+                         "(the Server default of 2 would fail "
+                         "long-lived requests on a long soak and "
+                         "corrupt the survival numbers)")
+    ap.add_argument("--restart-backoff", type=float, default=0.01,
+                    help="base of the exponential restart backoff (s)")
+    ap.add_argument("--stall-timeout", type=float, default=None,
+                    help="arm the stall watchdog (s; default off)")
     ap.add_argument("--monitor-out", default=None, metavar="JSONL",
                     help="also dump the in-process monitor registry "
                          "(in-process mode only)")
@@ -274,11 +340,16 @@ def main(argv=None) -> int:
     rng = random.Random(args.seed)
     lo, hi = (int(x) for x in args.prompt_len.split(":"))
     server = None
+    plan = None
     vocab = 256
     if args.url is None:
         from paddle_tpu import monitor
         monitor.enable()
-        server, vocab = _build_toy_server(args)
+        server, vocab, plan = _build_toy_server(args)
+    elif args.fault_rate > 0:
+        print("--fault-rate needs the in-process engine (no --url)",
+              file=sys.stderr)
+        return 2
 
     # open loop: the full arrival schedule is drawn BEFORE driving
     arrivals, t = [], 0.0
@@ -356,6 +427,31 @@ def main(argv=None) -> int:
                           "value": round(pre_s, 4), "unit": "s"}))
         print(json.dumps({"metric": "serve_distinct_prompt_lens",
                           "value": n_lens, "unit": "count"}))
+    if plan is not None:
+        # chaos accounting: what was injected, what survived, what the
+        # supervisor did about it (fault_stats is host-side — readable
+        # even with the monitor off)
+        fs = server.fault_stats()
+        rec = sorted(fs["recovery_s"])
+        print(f"chaos: {len(plan.injected)} faults injected "
+              f"({args.fault_kind} @ {args.fault_site}), "
+              f"{done} requests survived, {stats.failed} failed, "
+              f"{fs['restarts']} engine restarts")
+        print(json.dumps({"metric": "serve_faults_injected",
+                          "value": len(plan.injected),
+                          "unit": "count"}))
+        print(json.dumps({"metric": "serve_requests_survived",
+                          "value": done, "unit": "count"}))
+        print(json.dumps({"metric": "serve_requests_failed",
+                          "value": stats.failed, "unit": "count"}))
+        print(json.dumps({"metric": "serve_restarts",
+                          "value": fs["restarts"], "unit": "count"}))
+        for q in (50, 90, 99):
+            if rec:
+                print(json.dumps(
+                    {"metric": f"serve_recovery_p{q}",
+                     "value": round(_percentile(rec, q), 6),
+                     "unit": "s"}))
 
     if server is not None:
         if args.monitor_out:
