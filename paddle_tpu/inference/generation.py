@@ -29,7 +29,7 @@ __all__ = ["GenerationConfig", "CausalLMEngine",
            "ContinuousBatchingEngine",
            "PagedContinuousBatchingEngine", "prefill_buckets_for",
            "RequestFault", "EngineFault", "classify_fault",
-           "REQUEST_SITES"]
+           "REQUEST_SITES", "PagePoolExhausted", "ADMISSION_MODES"]
 
 
 # -- fault taxonomy (serving-path blast-radius classification) ---------------
@@ -71,6 +71,25 @@ class EngineFault(RuntimeError):
 # engine was doing single-request work behind an abort guard, so shared
 # device state was never touched
 REQUEST_SITES = frozenset({"admit", "prefill", "chunk"})
+
+# paged-engine admission policies (see PagedContinuousBatchingEngine)
+ADMISSION_MODES = ("reserved", "optimistic")
+
+
+class PagePoolExhausted(RuntimeError):
+    """Optimistic-mode page growth could not be satisfied in the
+    inter-segment gap even for the requests the caller chose to keep.
+
+    ``rids`` names the requests whose next-segment growth the pool
+    cannot cover. A serving scheduler never lets this surface — it
+    preempts victims in the gap until growth fits (or fails a request
+    that cannot fit even alone, with this as the typed cause); a bare
+    engine driver that ignores memory pressure sees it loudly from
+    ``decode_segment`` instead of silently corrupting KV."""
+
+    def __init__(self, rids, message: str):
+        super().__init__(message)
+        self.rids = list(rids)
 
 
 def classify_fault(exc: BaseException, site: str = "decode") -> str:
@@ -988,6 +1007,20 @@ class ContinuousBatchingEngine:
                 "serving requests by lifecycle event",
                 ("event",)).labels(event=event).inc()
 
+    def _evict_active(self, rid: int, event: str):
+        """Shared reclaim for the early-removal paths (cancel, preempt):
+        retire ``rid``'s slot — capacity back to the pool, request never
+        in ``collect_finished()`` — and return its partial tokens
+        (np.int32), or None when ``rid`` is not active."""
+        slot = next((s for s, r in self._slot_req.items() if r == rid),
+                    None)
+        if slot is None:
+            return None
+        out = np.asarray(self._tokens[rid], np.int32)
+        self._retire(slot, event=event)
+        self._finished.pop(rid, None)
+        return out
+
     def cancel_request(self, rid: int):
         """Cancel an ACTIVE request and reclaim its capacity: the slot
         (and, paged, its pages) returns to the pool immediately and the
@@ -999,14 +1032,7 @@ class ContinuousBatchingEngine:
         segments — the serving scheduler applies user ``cancel()`` flags
         at the next inter-segment gap, which is what keeps cancelled
         slots from leaking mid-segment."""
-        slot = next((s for s, r in self._slot_req.items() if r == rid),
-                    None)
-        if slot is None:
-            return None
-        out = np.asarray(self._tokens[rid], np.int32)
-        self._retire(slot, event="cancelled")
-        self._finished.pop(rid, None)
-        return out
+        return self._evict_active(rid, "cancelled")
 
     def partial_tokens(self, rid: int, start: int = 0):
         """Copy of the tokens generated so far for an ACTIVE request,
@@ -1345,32 +1371,99 @@ class ContinuousBatchingEngine:
         return out
 
     # -- convenience driver -------------------------------------------------
+    def grow_for_segment(self, n_steps: int):
+        """Pre-segment capacity hook: grow every live request's cache
+        coverage for the coming ``n_steps``-step segment and return the
+        request ids that could NOT be covered (the caller must preempt
+        victims before decoding). Dense slabs and reserved-mode paged
+        pools pre-cover the worst case, so the base is a no-op; the
+        paged engine's optimistic mode overrides it."""
+        return []
+
     def serve(self, prompts, cfg: Optional[GenerationConfig] = None,
               segment_steps: int = 8):
         """Continuous-batching driver: admits requests as slots free up,
         decoding in fixed segments. Returns generated ids (prompt NOT
-        included) in submission order."""
+        included) in submission order.
+
+        Under an optimistic-mode paged engine this driver handles KV
+        memory pressure the same way the serving scheduler does: each
+        inter-segment gap grows live mappings and, when the pool is
+        dry, preempts the YOUNGEST of its own requests (never the
+        oldest — forward progress) and re-queues ``prompt + generated``
+        with the budget reduced, so a tight pool degrades to lower
+        concurrency instead of raising away completed results (greedy
+        resume is bitwise-identical to an unpreempted run). Only a
+        request the pool cannot hold even alone still raises
+        :class:`PagePoolExhausted` — the same workload would fail
+        reserved-mode admission too."""
         cfg = cfg or GenerationConfig()
         pending = list(enumerate(prompts))
+        cfgs = {}      # idx -> replay cfg (budget reduced); else ``cfg``
+        prefix = {}    # idx -> tokens emitted before its preemption(s)
         order = {}
         results = {}
         foreign = {}   # requests admitted outside this serve() call
+
+        def _settle(idx, seq):
+            pre = prefix.pop(idx, None)
+            results[idx] = (seq if pre is None else np.concatenate(
+                [np.asarray(pre, np.int32), np.asarray(seq, np.int32)]))
+
         while len(results) < len(prompts):
             while pending and self._free:
-                nxt = _prompt_len(pending[0][1])
-                if not self._can_admit(nxt, cfg):
+                idx0, p0 = pending[0]
+                if not self._can_admit(_prompt_len(p0),
+                                       cfgs.get(idx0, cfg)):
                     if not self._slot_req:
                         # nothing active to drain: the request can NEVER
                         # fit — let add_request raise its loud error
                         idx, p = pending.pop(0)
-                        order[self.add_request(p, cfg)] = idx
+                        order[self.add_request(
+                            p, cfgs.get(idx, cfg))] = idx
                     break  # transient: defer to the next segment gap
                 idx, p = pending.pop(0)
-                order[self.add_request(p, cfg)] = idx
+                order[self.add_request(p, cfgs.get(idx, cfg))] = idx
+            # inter-segment gap: memory-pressure relief (see docstring)
+            while True:
+                short = self.grow_for_segment(segment_steps)
+                if not short:
+                    break
+                ours = sorted(r for r in self._slot_req.values()
+                              if r in order)
+                if len(ours) < 2:
+                    # our only (oldest-surviving) request, or a foreign
+                    # row we must not touch: decode_segment's guard
+                    # raises the loud typed error if it stays short
+                    break
+                toks = self.preempt_request(ours[-1])   # youngest
+                idx = order.pop(ours[-1])
+                pre = list(prefix.pop(idx, [])) + [int(t) for t in toks]
+                # budget against the ORIGINAL cfg: ``pre`` is the full
+                # emitted history, so measuring it against an earlier
+                # replay's already-reduced max_new_tokens would
+                # double-subtract the first preemption's prefix and
+                # silently truncate a twice-preempted request
+                remaining = cfg.max_new_tokens - len(pre)
+                if remaining < 1 or (cfg.eos_token_id is not None
+                                     and pre
+                                     and pre[-1] == cfg.eos_token_id):
+                    results[idx] = np.asarray(pre, np.int32)
+                    continue    # already finished: nothing to replay
+                prefix[idx] = pre
+                kw = dict(vars(cfg))
+                kw["max_new_tokens"] = remaining
+                cfgs[idx] = GenerationConfig(**kw)
+                # replays re-admit BEFORE new work (they held capacity
+                # when pressure hit); greedy re-prefill of the same
+                # prefix is bitwise-identical to the uninterrupted run
+                pending.insert(0, (idx, np.concatenate(
+                    [np.asarray(prompts[idx], np.int32).reshape(-1),
+                     np.asarray(pre, np.int32)])))
             self.decode_segment(segment_steps, cfg)
             for rid, seq in self.collect_finished().items():
                 if rid in order:
-                    results[order[rid]] = seq
+                    _settle(order.pop(rid), seq)
                 else:
                     foreign[rid] = seq
         # foreign requests finished during our segments stay collectable
@@ -1385,26 +1478,71 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     ``num_pages * page_size`` tokens total — the tokens in flight — not
     ``max_batch * max_len``, and any free page serves any slot.
 
-    Admission RESERVES a request's worst case (prompt + max_new_tokens,
-    capped at max_len) so a running request can never exhaust the pool
-    mid-decode; ``serve`` defers admission while the pool is
-    transiently full and raises only for requests that could never fit.
-    The page table lives host-side (numpy) and is shipped to the device
-    once per segment. Requires the model to implement
-    ``init_paged_cache`` / ``forward_decode_paged`` (llama does; see
+    Two ``admission_mode`` policies govern the page pool:
+
+    - ``"reserved"`` (default): admission RESERVES a request's worst
+      case (prompt + max_new_tokens, capped at max_len) so a running
+      request can never exhaust the pool mid-decode — safe, but
+      concurrency is capped by the worst case while most requests
+      finish early on EOS;
+    - ``"optimistic"`` (vLLM-style, Kwon et al. SOSP'23): admission
+      claims only the prompt's pages plus ONE page of headroom, and
+      the engine grows each live slot's mapping per inter-segment gap
+      (:meth:`grow_for_segment`, capped by the request's remaining
+      budget). When growth cannot be satisfied the CALLER must relieve
+      pressure — :meth:`preempt_request` reclaims a victim's slot and
+      pages exactly like ``cancel_request`` and returns its partial
+      tokens for replay (the serving scheduler parks the handle on its
+      replay list; greedy preempt-resume is bitwise-identical to an
+      unpreempted run). ``decode_segment`` re-checks growth and raises
+      :class:`PagePoolExhausted` if pressure was left unhandled —
+      never a silent dropped write. ``kv_watermark`` (fraction of the
+      pool, optimistic mode only) pauses NEW admissions while the pool
+      is already under pressure, so preemption is the fallback, not
+      the steady state.
+
+    ``serve`` defers admission while the pool is transiently full and
+    raises only for requests that could never fit. The page table
+    lives host-side (numpy) and is shipped to the device once per
+    segment. ``debug_pages=True`` runs the allocator's ``check()``
+    invariant validator at every gap and after every page operation.
+    Requires the model to implement ``init_paged_cache`` /
+    ``forward_decode_paged`` (llama does; see
     LlamaAttention.forward_decode_paged).
     """
 
     def __init__(self, model, max_batch: int, num_pages: int,
                  page_size: int, max_pages: int,
                  prefill_buckets="auto",
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 admission_mode: str = "reserved",
+                 kv_watermark: float = 0.9,
+                 debug_pages: bool = False):
         from .paged_cache import PageAllocator
 
+        if admission_mode not in ADMISSION_MODES:
+            raise ValueError(
+                f"admission_mode must be one of {ADMISSION_MODES}, got "
+                f"{admission_mode!r}")
+        if not (isinstance(kv_watermark, (int, float))
+                and 0 < kv_watermark <= 1):
+            raise ValueError(
+                f"kv_watermark must satisfy 0 < w <= 1 (fraction of "
+                f"the page pool), got {kv_watermark!r}")
+        self.admission_mode = admission_mode
+        self.kv_watermark = float(kv_watermark)
+        # segment count a clean grow_for_segment covered; decode_segment
+        # consumes it to skip its (device-syncing) exhaustion re-check
+        self._growth_stamp: Optional[int] = None
+        # (lens, done) host copies shared by every grow_for_segment call
+        # in ONE gap — relief that preempts k victims re-runs the grow
+        # loop k+1 times, but lens/done only change when a segment runs
+        # (decode) or a slot admits (_register), both of which clear it
+        self._gap_sync = None
         self.num_pages = num_pages
         self.page_size = page_size
         self.alloc = PageAllocator(num_pages, page_size, max_batch,
-                                   max_pages)
+                                   max_pages, debug=debug_pages)
         super().__init__(model, max_batch,
                          max_len=max_pages * page_size,
                          prefill_buckets=prefill_buckets,
@@ -1428,10 +1566,34 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _reserved(self, plen: int, cfg) -> int:
         return min(plen + cfg.max_new_tokens, self.max_len)
 
+    def _optimistic_claim(self, plen: int, cfg) -> int:
+        """Tokens an OPTIMISTIC admission claims up front: the prompt
+        plus one page of headroom (the first decode step writes at
+        position ``plen``, so bare-prompt coverage would force growth
+        before the very first segment), never more than the worst case
+        the reserved policy would take."""
+        return min(plen + self.page_size, self._reserved(plen, cfg))
+
     def _can_admit(self, prompt_len: int, cfg) -> bool:
         # any free slot owns zero pages, so capacity is slot-agnostic
         probe = self._free[0] if self._free else 0
-        return self.alloc.can_fit(probe, self._reserved(prompt_len, cfg))
+        if self.admission_mode == "reserved":
+            return self.alloc.can_fit(probe,
+                                      self._reserved(prompt_len, cfg))
+        claim = self._optimistic_claim(prompt_len, cfg)
+        if not self.alloc.can_fit(probe, claim):
+            return False
+        if self._slot_req:
+            # high watermark: while running requests already crowd the
+            # pool, pause NEW admissions before growth pressure forces
+            # a preemption — running work frees pages by finishing. An
+            # IDLE pool skips the watermark (a lone request must always
+            # be able to admit, or a big claim could wedge forever).
+            used_after = (self.alloc.used_pages
+                          + self.alloc.pages_for(claim))
+            if used_after > self.kv_watermark * self.num_pages:
+                return False
+        return True
 
     def _admit_cache(self, slot: int, ids, plen: int, cfg):
         # prefill into a dense mini cache sized to the prompt's BUCKET
@@ -1445,7 +1607,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         return last_logits
 
     def _reserve_admit(self, slot: int, plen: int, cfg) -> None:
-        self.alloc.ensure(slot, self._reserved(plen, cfg))
+        self.alloc.ensure(
+            slot, self._reserved(plen, cfg)
+            if self.admission_mode == "reserved"
+            else self._optimistic_claim(plen, cfg))
 
     def _install_mini(self, slot: int, mini, plen: int) -> None:
         from .paged_cache import write_tokens
@@ -1474,6 +1639,18 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         super()._abort_admit(slot)
         self.alloc.free_slot(slot)   # release any reserved pages
 
+    def _register(self, slot: int, rid: int, first, tok_done, cfg,
+                  t0: float) -> int:
+        # a new live slot may be under-covered for the next segment
+        # (optimistic claims stop at prompt + one page) — any growth
+        # stamp predating it is stale, as is the gap's (lens, done)
+        # snapshot (admission just wrote this slot's rows). Retire/free
+        # paths only RELEASE capacity and never un-cover or advance a
+        # surviving slot, so they keep both.
+        self._growth_stamp = None
+        self._gap_sync = None
+        return super()._register(slot, rid, first, tok_done, cfg, t0)
+
     def _retire(self, slot, event: str = "finished"):
         super()._retire(slot, event)
         self.alloc.free_slot(slot)
@@ -1485,14 +1662,105 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # interrupted
         for slot in range(self.max_batch):
             self.alloc.free_slot(slot)
+        self._growth_stamp = None
+        self._gap_sync = None
         super().reset_state()
+
+    # -- optimistic-mode memory pressure (host-side, between segments) -------
+    def grow_for_segment(self, n_steps: int):
+        """Grow every live slot's page mapping to cover the coming
+        ``n_steps``-step decode segment (optimistic mode; a no-op in
+        reserved mode, where admission pre-claimed the worst case).
+        Returns the request ids whose growth could NOT be satisfied —
+        the pool is dry and the caller must preempt victims (or accept
+        :class:`PagePoolExhausted` from ``decode_segment``).
+
+        OLDEST request first (ascending rid — admission order), so
+        pressure always lands on the youngest work: combined with a
+        scheduler that never preempts the oldest survivor, the head of
+        the line always makes forward progress and pressure can never
+        deadlock the loop. A row's target is capped by its remaining
+        budget: a segment emits at most ``min(n_steps, budget)`` kept
+        tokens, whose last cache write lands at position
+        ``len + min(n_steps, budget) - 1`` — device steps past the
+        budget write into (and read from) uncovered positions, but
+        every token they produce is discarded host-side at collection,
+        so capping is safe and saves pages. NO partial growth: a slot
+        either covers the full target or joins the short list —
+        partially covered steps would emit garbage tokens the host
+        KEEPS."""
+        if self.admission_mode != "optimistic" or not self._slot_req:
+            return []
+        if self._gap_sync is None:
+            self._gap_sync = (np.asarray(self.lens),
+                              np.asarray(self.done_dev))
+        lens, done = self._gap_sync
+        short = []
+        for slot, rid in sorted(self._slot_req.items(),
+                                key=lambda kv: kv[1]):
+            if bool(done[slot]):
+                continue       # frozen rows never write
+            target = min(int(lens[slot])
+                         + min(n_steps, self._budget[rid]),
+                         self.max_len)
+            if self.alloc.can_fit(slot, target):
+                self.alloc.ensure(slot, target)
+            else:
+                short.append(rid)
+        # a clean pass covers the coming segment: decode_segment(n_steps)
+        # may skip its re-check until the slot set changes (_register) or
+        # the segment runs (lens advance)
+        self._growth_stamp = n_steps if not short else None
+        return short
+
+    def preempt_request(self, rid: int, reason: str = "pressure"):
+        """Preempt an ACTIVE request under memory pressure: reclaim its
+        slot AND pages immediately (mirroring ``cancel_request``'s
+        reclaim) and return the partial tokens generated so far
+        (np.int32) — the caller owns parking them and replaying
+        ``prompt + tokens`` through normal admission later (greedy
+        replay is bitwise-identical to an unpreempted run; see the
+        serving scheduler's replay machinery). Returns None when
+        ``rid`` is not active. The request never appears in
+        ``collect_finished()``; the retirement event and the pool's
+        ``paddle_tpu_kv_preemptions_total{reason}`` counter record it.
+
+        Like ``cancel_request``: call only from the thread driving the
+        engine, BETWEEN decode segments."""
+        out = self._evict_active(rid, "preempted")
+        if out is not None:
+            self.alloc.count_preemption(reason)
+        return out
 
     def decode_segment(self, n_steps: int,
                        cfg: Optional[GenerationConfig] = None):
         if not self._slot_req:
             return 0
-        # admission reserved every running request's worst case, so no
-        # growth can fail here — just ship the current table
+        if self.admission_mode == "optimistic":
+            # final guard: a driver that skipped pressure relief must
+            # fail LOUDLY here, not let write_tokens silently drop KV
+            # writes past the mapped range and corrupt the request's
+            # decode. When the scheduler's gap already ran a clean
+            # grow_for_segment(n_steps) (stamp matches, slot set
+            # unchanged since), the re-check — two blocking device
+            # fetches + an O(active) allocator pass — is skipped; the
+            # stamp is single-shot because this segment advances lens
+            short = ([] if self._growth_stamp == n_steps
+                     else self.grow_for_segment(n_steps))
+            self._growth_stamp = None
+            self._gap_sync = None    # the segment advances lens/done
+            if short:
+                raise PagePoolExhausted(
+                    short,
+                    f"page pool exhausted in the inter-segment gap: "
+                    f"requests {short} cannot grow for the next "
+                    f"{n_steps}-step segment ({self.alloc.free_pages} "
+                    f"pages free) — preempt victims "
+                    f"(preempt_request) or grow num_pages")
+        # reserved mode: admission reserved every running request's
+        # worst case, so no growth can fail — just ship the table
+        if self.alloc.debug:
+            self.alloc.check()
         pools, _ = self.caches
         self.caches = (pools, jnp.asarray(self.alloc.page_table))
         return super().decode_segment(n_steps, cfg)

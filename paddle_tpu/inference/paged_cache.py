@@ -79,9 +79,17 @@ class PageAllocator:
     """
 
     def __init__(self, num_pages: int, page_size: int, max_batch: int,
-                 max_pages: int):
+                 max_pages: int, debug: bool = False):
         self.page_size = page_size
         self.num_pages = num_pages
+        # debug=True runs the full check() invariant validator after
+        # every mutating call (and the paged engine runs it once per
+        # inter-segment gap): a reclaim bug fails LOUDLY at the faulty
+        # op instead of silently scattering one request's KV into a
+        # neighbour's pages. O(num_pages) per call — test/chaos tool,
+        # not a production default.
+        self.debug = bool(debug)
+        self.preemptions = 0          # lifetime count, host-side
         # HOST-side numpy, mutated in place: ensure() runs for active
         # slots in the latency-critical gap between jitted segments, and
         # per-page jnp .at[].set updates would each be a device dispatch.
@@ -109,6 +117,19 @@ class PageAllocator:
                              "KV-cache page pool occupancy by state",
                              ("pool", "state"))
 
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of the pool in use right now (0.0 on an empty
+        pool) — the number admission watermarks and the serving
+        ``pressure`` surface read."""
+        if not self.num_pages:
+            return 0.0
+        return 1.0 - len(self._free) / self.num_pages
+
     @staticmethod
     def _occupancy_gauge():
         from .. import monitor
@@ -132,6 +153,67 @@ class PageAllocator:
                      state="used").set(self.num_pages - free)
         self._occupancy_gauge().labels(pool=self.monitor_pool).set(
             1.0 - free / self.num_pages if self.num_pages else 0.0)
+
+    @staticmethod
+    def _preempt_counter():
+        from .. import monitor
+
+        return monitor.counter(
+            "paddle_tpu_kv_preemptions_total",
+            "requests preempted to relieve KV page-pool memory "
+            "pressure, by reason (pressure = growth needed the pages; "
+            "unsatisfiable = could not fit even alone)",
+            ("pool", "reason"))
+
+    def count_preemption(self, reason: str = "pressure") -> None:
+        """Record one preemption against this pool (the engine's
+        ``preempt_request`` and the scheduler's admission-abort
+        preemption path both land here, so ``preemptions`` is the
+        pool-wide total whatever the victim's shape)."""
+        self.preemptions += 1
+        from .. import monitor
+
+        if monitor.enabled():
+            self._preempt_counter().labels(
+                pool=self.monitor_pool, reason=reason).inc()
+
+    def check(self) -> None:
+        """Invariant validator: the free list and the per-slot owned
+        pages must PARTITION ``range(num_pages)`` (no duplicates, no
+        losses, no foreign ids), and every ``page_table`` row must
+        mirror its slot's owned list exactly (owned prefix in order,
+        ``-1`` tail). Raises RuntimeError on the first violation —
+        called per-op under ``debug=True`` and once per gap by the
+        paged engine, so a reclaim bug (double free, leaked page,
+        stale table entry) fails loudly instead of corrupting a
+        neighbour's KV."""
+        owner = {}
+        for pid in self._free:
+            if pid in owner:
+                raise RuntimeError(
+                    f"page {pid} appears twice in the free list")
+            owner[pid] = "free"
+        for slot, pages in self._owned.items():
+            for pid in pages:
+                if pid in owner:
+                    raise RuntimeError(
+                        f"page {pid} owned by slot {slot} is also "
+                        f"{owner[pid]}")
+                owner[pid] = f"slot {slot}"
+        if set(owner) != set(range(self.num_pages)):
+            missing = sorted(set(range(self.num_pages)) - set(owner))
+            foreign = sorted(set(owner) - set(range(self.num_pages)))
+            raise RuntimeError(
+                f"free ∪ owned does not partition the pool: "
+                f"missing {missing}, foreign {foreign}")
+        for slot in range(self.page_table.shape[0]):
+            owned = self._owned.get(slot, [])
+            row = self.page_table[slot]
+            if (list(row[:len(owned)]) != list(owned)
+                    or not (row[len(owned):] == -1).all()):
+                raise RuntimeError(
+                    f"page_table row {slot} inconsistent with owned "
+                    f"pages {owned}: {row.tolist()}")
 
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
@@ -169,6 +251,8 @@ class PageAllocator:
             self.page_table[slot, len(owned)] = pid
             owned.append(pid)
         self._publish_occupancy()
+        if self.debug:
+            self.check()
 
     def free_slot(self, slot: int) -> None:
         """Return the slot's pages to the pool (request retired)."""
@@ -176,6 +260,8 @@ class PageAllocator:
             heapq.heappush(self._free, pid)
         self.page_table[slot, :] = -1
         self._publish_occupancy()
+        if self.debug:
+            self.check()
 
     def close(self) -> None:
         """Retire this allocator's monitor series (idempotent). Without
@@ -187,6 +273,14 @@ class PageAllocator:
             pages.remove(pool=self.monitor_pool, state="used")
             self._occupancy_gauge().remove(pool=self.monitor_pool)
         except Exception:  # teardown-ordering safe
+            pass
+        # the reason dimension is open-ended — retire by pool label
+        try:
+            from .. import monitor
+
+            monitor.remove_series("paddle_tpu_kv_preemptions_total",
+                                  pool=self.monitor_pool)
+        except Exception:
             pass
 
     def __del__(self):

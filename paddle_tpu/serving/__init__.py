@@ -37,6 +37,21 @@ caught by the ``stall_timeout_s`` watchdog and surfaces as the
 ``paddle_tpu.testing.faults`` is the deterministic injection harness
 the chaos suite drives all of this with.
 
+Memory pressure (README "Memory pressure"): with a paged engine in
+``admission_mode="optimistic"`` the pool admits on ACTUAL usage
+(prompt + one page, grown per gap) instead of the worst case; when
+growth outruns the pool the scheduler preempts victims — lowest
+priority first, then youngest, never the oldest survivor — and
+replays them later with their generated tokens intact (greedy
+preempt-resume is bitwise-identical). Rails:
+``Server(max_preemptions=...)`` fails a thrashing request with
+:class:`~paddle_tpu.serving.scheduler.PreemptionBudgetExceeded`, the
+engine's ``kv_watermark`` pauses new admissions under crowding, and a
+request the pool cannot hold even alone fails alone with
+:class:`~paddle_tpu.inference.generation.PagePoolExhausted` as its
+typed cause. ``Server.pressure()`` / the ``/healthz`` ``pressure``
+field expose occupancy, waiting-on-pages, and the preemption total.
+
 Quick start::
 
     import paddle_tpu.serving as serving
@@ -53,19 +68,20 @@ Quick start::
     for tok in h.stream():
         ...
 """
-from ..inference.generation import (EngineFault, RequestFault,
-                                    classify_fault)
+from ..inference.generation import (EngineFault, PagePoolExhausted,
+                                    RequestFault, classify_fault)
 from .http import serve_http
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
                     RUNNING, DeadlineExpired, QueueFull,
                     RequestCancelled, RequestFailed, RequestHandle,
                     RequestQueue, RequestRejected)
-from .scheduler import Server
+from .scheduler import PreemptionBudgetExceeded, Server
 
 __all__ = [
     "Server", "serve_http", "RequestHandle", "RequestQueue",
     "RequestRejected", "QueueFull", "RequestCancelled",
     "DeadlineExpired", "RequestFailed",
     "RequestFault", "EngineFault", "classify_fault",
+    "PagePoolExhausted", "PreemptionBudgetExceeded",
     "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED", "FAILED",
 ]

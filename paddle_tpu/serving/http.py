@@ -40,7 +40,11 @@ Routes:
   still pre-compiling — submissions already queue), "degraded" is the
   stall-watchdog / mid-recovery signal, "failed" means the scheduler
   died (body carries the status; ``restarts`` counts supervised
-  engine recoveries so far).
+  engine recoveries so far). A paged engine adds ``"pressure"``:
+  ``{"admission_mode", "occupancy", "free_pages",
+  "waiting_on_pages", "preemptions"}`` — the KV memory-pressure
+  surface that tells "degraded by memory pressure" (occupancy near
+  1.0, preemptions climbing) apart from the stall/fault reason.
 
 - ``GET /metrics`` / ``GET /metrics.json`` — the monitor package's
   Prometheus / JSON exporters, same payloads as
@@ -132,14 +136,24 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
             if self.path.startswith("/healthz"):
                 eng = server.engine
                 status = server.status
-                self._json(200 if status in ("ok", "draining") else 503,
-                           {
+                body = {
                     "status": status,
                     "queue_depth": server.queue.depth,
                     "free_slots": eng.free_slots(),
                     "active_requests": server.num_active(),
                     "restarts": getattr(server, "restarts", 0),
-                })
+                }
+                # paged engines report KV memory pressure (occupancy,
+                # requests parked waiting on pages, preemption total)
+                # so operators can tell "degraded by memory pressure"
+                # apart from the stall/fault degraded reason
+                pressure = getattr(server, "pressure", None)
+                if pressure is not None:
+                    pressure = pressure()
+                if pressure is not None:
+                    body["pressure"] = pressure
+                self._json(200 if status in ("ok", "draining") else 503,
+                           body)
             elif (payload := monitor.http_payload(self.path)) is not None:
                 body, ctype = payload
                 self.send_response(200)

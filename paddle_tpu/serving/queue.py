@@ -121,8 +121,11 @@ class RequestHandle:
         # re-prefills prompt + tokens emitted so far; bounded by the
         # server's max_replays); _engine_base is the handle-side token
         # count at the LAST replay admission — the engine's token list
-        # restarts at 0 there, so engine index = handle index - base
+        # restarts at 0 there, so engine index = handle index - base.
+        # _preempts counts memory-pressure preemptions (same replay
+        # machinery, separate budget: the server's max_preemptions)
         self._replays = 0
+        self._preempts = 0
         self._engine_base = 0
 
     # -- client surface ------------------------------------------------------
@@ -240,12 +243,25 @@ class RequestQueue:
     bound while the engine falls behind. Cancelled and deadline-expired
     entries are reaped at pop time and handed back to the scheduler for
     finalization — an expired request never admits.
+
+    ``age_after_s`` enables PRIORITY AGING: a waiting request's
+    effective priority improves by one level per ``age_after_s``
+    seconds queued, so under sustained high-priority load a
+    low-priority request is eventually served instead of starving
+    forever. Aging is applied in :meth:`reap` (the scheduler calls it
+    every inter-segment gap); FIFO order within an effective priority
+    is preserved. ``None`` (default) keeps strict static priority.
     """
 
-    def __init__(self, max_size: int):
+    def __init__(self, max_size: int,
+                 age_after_s: Optional[float] = None):
         if max_size < 1:
             raise ValueError(f"max_size must be >= 1, got {max_size}")
+        if age_after_s is not None and not age_after_s > 0:
+            raise ValueError(
+                f"age_after_s must be > 0 or None, got {age_after_s!r}")
         self.max_size = max_size
+        self.age_after_s = age_after_s
         self._lock = threading.Lock()
         self._heap: List[Tuple[int, int, RequestHandle]] = []
         self._seq = itertools.count()
@@ -265,8 +281,21 @@ class RequestQueue:
     def reap(self, now: float) -> List[RequestHandle]:
         """Remove every cancelled/expired entry (anywhere in the queue,
         not just the head — a deep queue must not hold dead entries
-        against ``max_size``) and return them for finalization."""
+        against ``max_size``) and return them for finalization. Also
+        applies priority AGING (``age_after_s``): entries whose waited
+        time crossed another aging step get their effective priority
+        bumped and the heap re-ordered."""
         with self._lock:
+            if self.age_after_s is not None:
+                aged = False
+                for i, (eff, seq, h) in enumerate(self._heap):
+                    new = h.priority - int(
+                        (now - h.submit_ts) / self.age_after_s)
+                    if new < eff:
+                        self._heap[i] = (new, seq, h)
+                        aged = True
+                if aged:
+                    heapq.heapify(self._heap)
             dead = [h for _, _, h in self._heap
                     if h._cancel_requested
                     or (h.deadline is not None and now >= h.deadline)]

@@ -45,7 +45,16 @@ are routine inputs, not exceptional shutdowns):
 - a STALL (a wedged step that can't announce itself) is caught by the
   watchdog thread: ``stall_timeout_s`` without a loop heartbeat flips
   ``status``/``/healthz`` to ``degraded`` (503) until the loop beats
-  again.
+  again;
+- KV MEMORY PRESSURE (paged engine, ``admission_mode="optimistic"``)
+  is a managed degradation mode, not a fault: each gap ends by growing
+  every live slot's page mapping for the coming segment, preempting
+  victims (lowest priority, then youngest — never the oldest
+  survivor) when the pool is dry; victims replay through normal
+  admission with their generated tokens intact, bounded per request
+  by ``max_preemptions``. ``pressure()``/``/healthz`` expose
+  occupancy, parked-waiting counts, and the preemption total so
+  operators can tell pressure degradation apart from faults.
 
 Thread model: the engine is touched by the scheduler thread ONLY (jax
 tracing included) — recovery and replay run there too. The watchdog
@@ -63,12 +72,21 @@ from typing import Optional
 import numpy as np
 
 from .. import monitor
-from ..inference.generation import (GenerationConfig, _prompt_ids,
+from ..inference.generation import (ADMISSION_MODES, GenerationConfig,
+                                    PagePoolExhausted, _prompt_ids,
                                     _prompt_len, classify_fault)
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QueueFull,
                     RequestHandle, RequestQueue, RequestRejected)
 
-__all__ = ["Server"]
+__all__ = ["Server", "PreemptionBudgetExceeded"]
+
+
+class PreemptionBudgetExceeded(RuntimeError):
+    """A request was preempted under KV memory pressure more than its
+    ``max_preemptions`` budget allows: it is THRASHING (admitted,
+    preempted, replayed, preempted again...) and is failed with this
+    typed cause instead of cycling through the pool forever. Clients
+    see it as the ``RequestFailed.__cause__`` of ``result()``."""
 
 
 class _EngineFaultSignal(Exception):
@@ -133,6 +151,29 @@ class Server:
       the loop beats again. Without ``warmup=True`` the first request's
       XLA compiles run inside a step — set the timeout above worst-case
       compile time, or warm up. The watchdog never arms during warmup.
+
+    Memory-pressure knobs (paged engine in ``optimistic`` admission
+    mode — see :class:`PagedContinuousBatchingEngine`):
+
+    - ``admission_mode`` — convenience mirror of the paged engine's
+      knob (``"reserved"``/``"optimistic"``; None leaves the engine's
+      own setting). In optimistic mode admission claims only the
+      prompt's pages + one page of headroom and slots GROW per gap;
+      when growth cannot be satisfied the scheduler PREEMPTS victims —
+      lowest priority first, then youngest, never the oldest surviving
+      request (guaranteed forward progress) — reclaiming their slot
+      and pages and parking the handle on the replay list, so it
+      re-admits through the normal bucketed/chunked prefill with its
+      generated tokens intact (greedy preempt-resume is
+      bitwise-identical to an unpreempted run);
+    - ``max_preemptions`` — memory-pressure preemptions any ONE
+      request may absorb; past it the request FAILS with
+      :class:`PreemptionBudgetExceeded` as its cause instead of
+      thrashing through the pool forever;
+    - ``age_after_s`` — queue priority aging (None = strict static
+      priority): a waiting request's effective priority improves one
+      level per ``age_after_s`` seconds queued, so low-priority work
+      cannot starve forever under sustained high-priority load.
     """
 
     def __init__(self, engine, max_queue: int = 64,
@@ -143,7 +184,10 @@ class Server:
                  restart_backoff_s: float = 0.05,
                  restart_backoff_max_s: float = 2.0,
                  max_replays: int = 2,
-                 stall_timeout_s: Optional[float] = None):
+                 stall_timeout_s: Optional[float] = None,
+                 max_preemptions: int = 5,
+                 admission_mode: Optional[str] = None,
+                 age_after_s: Optional[float] = None):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
                 f"stall_timeout_s must be > 0 or None, got "
@@ -159,6 +203,25 @@ class Server:
                 "beats once per idle_wait_s")
         if max_restarts < 0 or max_replays < 0:
             raise ValueError("max_restarts/max_replays must be >= 0")
+        if max_preemptions < 0:
+            raise ValueError("max_preemptions must be >= 0")
+        if admission_mode is not None:
+            # convenience mirror of the paged engine's knob: set it
+            # here (before the scheduler thread starts) instead of at
+            # engine construction. getattr/setattr so a FaultyEngine
+            # proxy routes to the wrapped engine.
+            if admission_mode not in ADMISSION_MODES:
+                raise ValueError(
+                    f"admission_mode must be one of {ADMISSION_MODES}, "
+                    f"got {admission_mode!r}")
+            if getattr(engine, "admission_mode", None) is None:
+                raise ValueError(
+                    "admission_mode needs a paged engine "
+                    "(PagedContinuousBatchingEngine)")
+            if getattr(engine, "_slot_req", None):
+                raise ValueError(
+                    "admission_mode can only be set on an idle engine")
+            engine.admission_mode = admission_mode
         self.engine = engine
         self.segment_steps = segment_steps
         self.idle_wait_s = idle_wait_s
@@ -167,8 +230,9 @@ class Server:
         self.restart_backoff_s = restart_backoff_s
         self.restart_backoff_max_s = restart_backoff_max_s
         self.max_replays = max_replays
+        self.max_preemptions = max_preemptions
         self.stall_timeout_s = stall_timeout_s
-        self.queue = RequestQueue(max_queue)
+        self.queue = RequestQueue(max_queue, age_after_s=age_after_s)
         # per-server label: concurrent servers (multi-model processes)
         # publish their serving metrics side by side
         self.monitor_server = monitor.instance_label("server")
@@ -198,6 +262,11 @@ class Server:
         #                                   (monitor-independent; see
         #                                   fault_stats())
         self._recovery_s = []
+        self._waiting_on_pages = 0        # preempted handles parked on
+        #                                   the replay list right now
+        #                                   (pressure surface; scheduler
+        #                                   thread writes, healthz reads
+        #                                   — an int store is atomic)
         self._degraded_reason: Optional[str] = None   # under _lock
         self._stall_flag = False          # degraded BY the watchdog
         self._beat = time.monotonic()     # loop heartbeat the watchdog
@@ -309,6 +378,12 @@ class Server:
         from the caller's thread — a segment still in flight, e.g. a
         long first compile, finishes before cleanup runs)."""
         t0 = time.monotonic()
+        if not self._thread.is_alive() and not self._stopped.is_set():
+            # never-started server (``start=False``): no loop will ever
+            # set _stopped — don't sit out the stop-wait below. (A
+            # FINISHED loop sets _stopped in its finally before the
+            # thread dies, so this cannot mask a real exit.)
+            self._stopped.set()
         if drain:
             self.drain(timeout)
         with self._lock:
@@ -344,7 +419,8 @@ class Server:
         for name in ("paddle_tpu_serving_faults_total",
                      "paddle_tpu_serving_restarts_total",
                      "paddle_tpu_serving_degraded",
-                     "paddle_tpu_serving_recovery_seconds"):
+                     "paddle_tpu_serving_recovery_seconds",
+                     "paddle_tpu_serving_kv_pressure"):
             try:
                 monitor.remove_series(name, server=self.monitor_server)
             except Exception:
@@ -379,13 +455,35 @@ class Server:
                     "recovery_s": list(self._recovery_s),
                     "degraded": self._degraded_reason}
 
+    def pressure(self):
+        """KV memory-pressure snapshot (None for a dense engine):
+        ``{"admission_mode", "occupancy", "free_pages",
+        "waiting_on_pages", "preemptions"}`` — what ``/healthz``
+        reports so an operator can tell "degraded by memory pressure"
+        (occupancy near 1.0, preemptions climbing, requests parked
+        waiting on pages) apart from the stall/fault ``degraded``
+        reason. Host-side and monitor-independent, like
+        :meth:`fault_stats`."""
+        alloc = getattr(self.engine, "alloc", None)
+        if alloc is None:
+            return None
+        return {
+            "admission_mode": getattr(self.engine, "admission_mode",
+                                      "reserved"),
+            "occupancy": round(alloc.occupancy, 4),
+            "free_pages": alloc.free_pages,
+            "waiting_on_pages": self._waiting_on_pages,
+            "preemptions": alloc.preemptions,
+        }
+
     # -- monitor helpers -----------------------------------------------------
     @staticmethod
     def _requests_counter():
         return monitor.counter(
             "paddle_tpu_serving_requests_total",
             "serving-layer requests by lifecycle event "
-            "(queued/completed/cancelled/expired/failed/rejected_*)",
+            "(queued/completed/cancelled/expired/failed/preempted/"
+            "rejected_*)",
             ("server", "event"))
 
     @staticmethod
@@ -445,6 +543,14 @@ class Server:
             "state rebuilt + in-flight requests requeued for replay",
             ("server",))
 
+    @staticmethod
+    def _pressure_gauge():
+        return monitor.gauge(
+            "paddle_tpu_serving_kv_pressure",
+            "requests preempted under KV memory pressure and parked "
+            "on the replay list, waiting for pages, per server",
+            ("server",))
+
     def _count(self, event: str) -> None:
         if monitor.enabled():
             self._requests_counter().labels(
@@ -456,6 +562,10 @@ class Server:
                 server=self.monitor_server).set(self.queue.depth)
             self._active_gauge().labels(
                 server=self.monitor_server).set(len(self._active))
+            if getattr(self.engine, "alloc", None) is not None:
+                self._pressure_gauge().labels(
+                    server=self.monitor_server).set(
+                    self._waiting_on_pages)
 
     def _count_fault(self, kind: str, site: str) -> None:
         # called from the scheduler thread AND the watchdog — the host
@@ -854,22 +964,30 @@ class Server:
         return True
 
     def _admit_replays(self) -> None:
-        """Re-admit requests surviving an engine restart, FIRST (before
-        new queue work): they already held capacity when the fault hit,
-        and a replay reserves exactly what the original did
-        (prompt + full budget), so the rebuilt engine always has room —
-        at worst a replay longer than ``prefill_chunk`` waits its turn
-        behind the single in-flight chunked admission.
+        """Re-admit requests surviving an engine restart OR a
+        memory-pressure preemption, FIRST (before new queue work): they
+        already held capacity when the fault/preemption hit. In
+        reserved mode a replay reserves exactly what the original did
+        (prompt + full budget), so the rebuilt engine always has room;
+        in optimistic mode the claim is prompt + one page and a replay
+        defers while the pool is crowded (new-queue admission stays
+        paused until every replay is back in — pressure victims are
+        owed their pages before fresh traffic). At worst a replay
+        longer than ``prefill_chunk`` waits its turn behind the single
+        in-flight chunked admission.
 
         A replay re-prefills ``prompt + tokens emitted so far`` (the
         bucketed/chunked machinery treats it like any prompt) with the
         budget reduced by what was already emitted. Greedy replay is
         bitwise-identical to the uninterrupted decode (causal prefill
         of the same prefix); sampled requests continue on a fresh noise
-        stream. NO deadline check: the admission deadline was already
-        met the first time the request admitted. Deferral is O(1) —
-        the O(plen) replay-prompt build only happens on the gap that
-        actually admits."""
+        stream. The admission deadline applies only to a handle that
+        never COMPLETED an admission (``engine_rid is None`` — a
+        pressure-abort of its in-flight chunked claim parked it here):
+        once a request admitted, the deadline was met and a replay
+        must not expire it. Deferral is O(1) — the O(plen)
+        replay-prompt build only happens on the gap that actually
+        admits."""
         pending, self._replay = self._replay, []
         still = []
         chunk = getattr(self.engine, "prefill_chunk", None)
@@ -882,6 +1000,11 @@ class Server:
                 if h._cancel_requested:
                     h._finish(CANCELLED)
                     self._count("cancelled")
+                    continue
+                if (h.engine_rid is None and h.deadline is not None
+                        and time.monotonic() >= h.deadline):
+                    h._finish(EXPIRED)
+                    self._count("expired")
                     continue
                 n_toks = h._n_pushed    # == len(h._tokens): scheduler-
                 #                         thread bookkeeping, O(1)
@@ -905,6 +1028,25 @@ class Server:
                 kw["max_new_tokens"] = remaining
                 rcfg = GenerationConfig(**kw)
                 if not self.engine.can_admit(plen, rcfg):
+                    if (not self._active and self._adm is None
+                            and self.engine.free_slots()
+                            == self.engine.max_batch):
+                        # the engine is completely IDLE and the replay
+                        # still cannot fit: prompt + generated has
+                        # outgrown what the pool can EVER hold (a
+                        # preempted request's replay prompt includes
+                        # every emitted token) — fail loudly with the
+                        # typed cause instead of deferring forever
+                        # against an empty engine
+                        h._finish(FAILED, PagePoolExhausted(
+                            [h.id],
+                            f"replay of request {h.id} "
+                            f"(prompt+generated={plen} tokens) can "
+                            f"never be admitted: engine capacity "
+                            f"(page pool / max_len) is too small "
+                            f"even when idle"))
+                        self._count("failed")
+                        continue
                     still.append(h)
                     continue
                 ids = np.concatenate(
@@ -932,10 +1074,17 @@ class Server:
         ``_admitting`` is held for the WHOLE gap: at several points a
         handle lives only in locals (mid-admission, mid-replay, the
         chunk-abort window) and a timed ``drain()`` must never see
-        "queue empty, nothing active" through one of them."""
+        "queue empty, nothing active" through one of them.
+
+        Pressure relief runs LAST (optimistic paged mode): every slot
+        the coming segment will write is grown now, preempting victims
+        if the pool is dry — so ``decode_segment``'s own exhaustion
+        guard (:class:`PagePoolExhausted`, an engine-scoped fault)
+        never fires under this scheduler."""
         self._admitting = True
         try:
             self._gap_body()
+            self._relieve_pressure()
         finally:
             self._admitting = False
         self._depth_gauge()
@@ -1064,6 +1213,148 @@ class Server:
                     continue
                 break
             self._start_admission(h, h.prompt, h.cfg, h.prompt_len)
+
+    # -- memory pressure (optimistic paged mode; scheduler thread) -----------
+    def _relieve_pressure(self) -> None:
+        """Resolve KV memory pressure in the gap (optimistic admission
+        mode only; a no-op otherwise): grow every live slot's page
+        mapping for the coming segment, and while the pool cannot
+        cover the growth, PREEMPT victims — lowest priority first
+        (highest priority value), then youngest (highest rid), NEVER
+        the oldest surviving request, so the head of the line always
+        makes forward progress and pressure can never deadlock or
+        livelock the loop. A preempted request's slot and pages are
+        reclaimed immediately (``engine.preempt_request``) and its
+        handle parks on the replay list — the SAME machinery as
+        engine-restart replay, so it re-admits through the normal
+        bucketed/chunked prefill with its generated tokens intact
+        (greedy preempt-resume is bitwise-identical to an unpreempted
+        run) — bounded per request by ``max_preemptions``. A request
+        the pool cannot cover even ALONE fails with
+        :class:`PagePoolExhausted` as its typed cause: a
+        request-scoped, CONTAINED event, not an engine-scoped fault
+        (full restart + replay of everyone)."""
+        eng = self.engine
+        if getattr(eng, "admission_mode", None) != "optimistic":
+            return
+        while True:
+            short = self._guard(
+                "pressure",
+                lambda: eng.grow_for_segment(self.segment_steps))
+            if not short:
+                break
+            # age is the HANDLE's submit time, not the engine rid: a
+            # replayed request re-admits under a fresh (higher) rid but
+            # keeps its seniority — preempting it again just because it
+            # was once a victim would be a thrash amplifier
+            oldest = (min(self._active,
+                          key=lambda r: (self._active[r].submit_ts,
+                                         self._active[r].id))
+                      if self._active else None)
+            cands = [r for r in self._active if r != oldest]
+            if cands:
+                victim = max(cands, key=lambda r:
+                             (self._active[r].priority,
+                              self._active[r].submit_ts,
+                              self._active[r].id))
+                self._preempt(victim, "pressure")
+                continue
+            if self._adm is not None:
+                # last capacity holder left: the in-flight chunked
+                # admission's page claim — abort it (reclaims slot AND
+                # pages) and park its handle; replay restarts the
+                # prefill from scratch through the same chunked path.
+                # The handle parks BEFORE the abort guard: if the
+                # abort itself faults, recovery finds it in _replay
+                # (reset_state reclaims capacity wholesale) instead of
+                # stranding it in a local
+                adm, h = self._adm
+                self._adm = None
+                alloc = getattr(eng, "alloc", None)
+                if alloc is not None:
+                    alloc.count_preemption("pressure")
+                self._park_preempted(h)
+                self._guard("cancel",
+                            lambda: eng.abort_admit(adm))
+                continue
+            # nothing left to preempt (only the oldest survivor can
+            # still be active): the short request cannot grow even
+            # with the pool to itself — preempt-and-replay would hit
+            # the same wall forever, so fail it with the typed cause
+            progressed = False
+            for rid in short:
+                toks = self._guard(
+                    "pressure",
+                    lambda rid=rid: eng.preempt_request(
+                        rid, reason="unsatisfiable"))
+                h = self._active.pop(rid, None)
+                if toks is None and h is None:
+                    continue       # foreign/stale rid: nothing owned
+                progressed = True
+                if h is None:
+                    continue       # foreign request (engine driven
+                #                    outside this server) — reclaimed
+                if toks is not None:
+                    self._push_delta(
+                        h, list(toks[h._n_pushed - h._engine_base:]))
+                h._finish(FAILED, PagePoolExhausted(
+                    [rid],
+                    f"request {h.id} cannot grow its KV mapping even "
+                    f"with the pool to itself (prompt+generated="
+                    f"{h.prompt_len + h._n_pushed} tokens, pool="
+                    f"{eng.num_pages}x{eng.page_size} tokens) — grow "
+                    f"num_pages or lower max_new_tokens"))
+                self._count("failed")
+            if not progressed:
+                # a short rid this scheduler does not own and cannot
+                # reclaim: let decode_segment's own exhaustion guard
+                # surface it rather than spin in the gap
+                break
+        self._waiting_on_pages = sum(
+            1 for h in self._replay if h._preempts > 0)
+
+    def _preempt(self, rid: int, reason: str) -> None:
+        """Preempt ONE active request: the engine reclaims its slot
+        and pages (``preempt_request`` — the same reclaim as cancel),
+        its tokens so far are pushed to the handle FIRST (the replay
+        prompt is prompt + ALL generated tokens — drop one and greedy
+        resume parity breaks), then the handle parks for replay."""
+        toks = self._guard(
+            "pressure",
+            lambda: self.engine.preempt_request(rid, reason))
+        h = self._active.pop(rid, None)
+        if h is None:
+            return
+        if toks is not None:
+            self._push_delta(
+                h, list(toks[h._n_pushed - h._engine_base:]))
+        self._park_preempted(h)
+
+    def _park_preempted(self, h: RequestHandle) -> None:
+        """Park a preempted handle on the replay list (next gap's
+        ``_admit_replays`` re-prefills prompt + generated through
+        normal admission), enforcing its ``max_preemptions`` budget:
+        past it the request is THRASHING (admitted, preempted,
+        replayed, preempted again...) and fails with
+        :class:`PreemptionBudgetExceeded` instead of cycling through
+        the pool forever. A cancel-requested handle finishes CANCELLED
+        (``_finish`` is idempotent — terminal exactly once)."""
+        if h._cancel_requested:
+            h._finish(CANCELLED)
+            self._count("cancelled")
+            return
+        h._preempts += 1
+        self._count("preempted")
+        if h._preempts > self.max_preemptions:
+            h._finish(FAILED, PreemptionBudgetExceeded(
+                f"request {h.id} preempted {h._preempts} times under "
+                f"KV memory pressure (max_preemptions="
+                f"{self.max_preemptions}): the pool is too small for "
+                f"this request mix — grow num_pages, lower "
+                f"kv_watermark, or raise max_preemptions"))
+            self._count("failed")
+            return
+        self._replay.append(h)
 
     def _push_delta(self, h: RequestHandle, toks) -> None:
         """Push newly generated tokens (scheduler thread only);

@@ -21,7 +21,12 @@ Sites (the seams a serving scheduler drives):
 - ``"decode"``  — ``decode_segment`` (the batch-wide seam: an injected
   :class:`~paddle_tpu.inference.generation.EngineFault` here drives the
   supervised-recovery path, a hang drives the stall watchdog);
-- ``"collect"`` — ``collect_finished``.
+- ``"collect"`` — ``collect_finished``;
+- ``"preempt"`` — ``preempt_request`` (the paged engine's
+  memory-pressure victim reclaim: a fault here hits the scheduler's
+  pressure-relief loop mid-preemption — the window where a victim's
+  slot/pages reclaim and its replay parking must stay atomic under
+  recovery).
 
 Determinism: every seam call increments a per-site counter under a
 lock, and rules fire on exact 1-based call indices (``nth``/``times``),
@@ -53,7 +58,7 @@ from typing import List, Optional, Sequence
 
 __all__ = ["SITES", "FaultPlan", "FaultyEngine", "InjectedFault"]
 
-SITES = ("admit", "prefill", "chunk", "decode", "collect")
+SITES = ("admit", "prefill", "chunk", "decode", "collect", "preempt")
 
 
 class InjectedFault(RuntimeError):
@@ -205,18 +210,31 @@ class FaultyEngine:
               "collect_finished": "collect"}
 
     def __init__(self, engine, plan: FaultPlan):
-        self._engine = engine
-        self.plan = plan
+        object.__setattr__(self, "_engine", engine)
+        object.__setattr__(self, "plan", plan)
         orig = engine._run_prefill
 
         def faulty_prefill(*a, **kw):
-            plan.fire("prefill")
+            self.plan.fire("prefill")
             return orig(*a, **kw)
 
         engine._run_prefill = faulty_prefill
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
+
+    def __setattr__(self, name, value):
+        # proxy-owned state stays on the proxy (reassigning ``plan``
+        # between scenarios must rearm the seams, not write a dead
+        # attribute onto the engine); every OTHER write routes to the
+        # wrapped engine (e.g. the Server's admission_mode convenience
+        # setter) — a proxy-local shadow would leave the inner engine
+        # on its old policy while reads through the proxy claimed
+        # otherwise
+        if name in ("plan", "_engine"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._engine, name, value)
 
     def add_request(self, *a, **kw):
         self.plan.fire("admit")
@@ -237,3 +255,7 @@ class FaultyEngine:
     def collect_finished(self, *a, **kw):
         self.plan.fire("collect")
         return self._engine.collect_finished(*a, **kw)
+
+    def preempt_request(self, *a, **kw):
+        self.plan.fire("preempt")
+        return self._engine.preempt_request(*a, **kw)

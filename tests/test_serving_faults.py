@@ -52,6 +52,10 @@ def tiny_model(layers=1, seed=0):
 
 def paged_engine(model, max_batch=3, num_pages=24, page_size=8,
                  max_pages=8, **kw):
+    # the whole chaos suite runs with the allocator's invariant
+    # validator armed: a reclaim bug on any abort/retire path fails
+    # loudly at the faulty op instead of corrupting a neighbour's KV
+    kw.setdefault("debug_pages", True)
     return PagedContinuousBatchingEngine(
         model, max_batch=max_batch, num_pages=num_pages,
         page_size=page_size, max_pages=max_pages, **kw)
@@ -131,7 +135,7 @@ class TestFaultPlan:
         with pytest.raises(ValueError, match="unknown site"):
             plan.raise_at("nope")
         assert set(SITES) == {"admit", "prefill", "chunk", "decode",
-                              "collect"}
+                              "collect", "preempt"}
 
     def test_hang_bounded_and_releasable(self):
         plan = FaultPlan().hang_at("decode", nth=1, seconds=30)
@@ -147,6 +151,28 @@ class TestFaultPlan:
                                     exc=EngineFault("device lost"))
         with pytest.raises(EngineFault, match="device lost"):
             plan.fire("decode")
+
+    def test_plan_reassignment_rearms_proxy_seams(self):
+        """``fe.plan = new_plan`` between scenarios must stay on the
+        PROXY and rearm every seam — including the engine-internal
+        prefill shadow — not forward to the wrapped engine as a dead
+        attribute while the seams keep firing the stale plan."""
+        model, cfg = tiny_model()
+        raw = paged_engine(model)
+        fe = FaultyEngine(raw, FaultPlan())
+        fe.decode_segment(1)                   # original plan: clean
+        fe.plan = FaultPlan().raise_at("decode", nth=1)
+        assert "plan" not in vars(raw)         # no dead engine attr
+        with pytest.raises(InjectedFault):
+            fe.decode_segment(1)
+        fe.plan = FaultPlan().raise_at("prefill", nth=1)
+        p = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (6,)).astype(np.int32)
+        with pytest.raises(InjectedFault):     # prefill shadow rearmed
+            fe.add_request(p, _greedy(4))
+        assert raw.free_slots() == raw.max_batch   # abort guard ran
+        assert raw.alloc.free_pages == raw.num_pages
+        raw.alloc.check()
 
 
 class TestEngineReset:

@@ -82,12 +82,16 @@ def load_snapshot(snap: dict) -> List[dict]:
 SERVING_FAMILIES = (
     "paddle_tpu_serving_",              # queue depth, TTFT, TPOT, events,
     #                                     faults, restarts, degraded,
-    #                                     recovery
+    #                                     recovery, kv_pressure
     "paddle_tpu_requests_total",        # engine lifecycle events
     "paddle_tpu_generated_tokens_total",
     "paddle_tpu_decode_tokens_per_sec",
     "paddle_tpu_kv_admission_seconds",
     "paddle_tpu_kv_page_occupancy_ratio",
+    "paddle_tpu_kv_pages",              # pool free/used by state
+    "paddle_tpu_kv_preemptions_total",  # memory-pressure preemptions
+    #                                     by reason (pressure /
+    #                                     unsatisfiable)
     "paddle_tpu_prefill_",              # bucket/chunk admissions, warmup
 )
 
@@ -137,8 +141,8 @@ def main(argv=None) -> int:
     ap.add_argument("--serving", action="store_true",
                     help="only the online-serving families (queue depth, "
                          "TTFT, TPOT, request events, tokens/sec, KV "
-                         "admission + occupancy, faults/restarts/"
-                         "degraded/recovery)")
+                         "admission + occupancy + preemptions/pressure, "
+                         "faults/restarts/degraded/recovery)")
     args = ap.parse_args(argv)
 
     if args.url:

@@ -36,6 +36,13 @@ Usage::
     # fault-isolation acceptance run (README "Failure modes & recovery")
     python tools/serve_bench.py --fault-rate 0.1 --fault-site decode \
         --fault-kind engine --max-restarts 100
+    # KV memory-pressure A/B (PERF.md utilization/throughput
+    # methodology): same pool, reserved vs optimistic admission —
+    # compare throughput + occupancy p50/p99 against the preemption
+    # count and the preempted-request latency penalty
+    python tools/serve_bench.py --num-pages 24 --admission-mode reserved
+    python tools/serve_bench.py --num-pages 24 --admission-mode optimistic \
+        --kv-watermark 0.9 --max-preemptions 10
 
 Output: one human table plus BENCH-shaped JSON records
 (``{"metric": ..., "value": ..., "unit": ...}``) on stdout. Chaos runs
@@ -72,17 +79,23 @@ class _Stats:
         self.ttft = []
         self.tpot = []
         self.e2e = []
+        self.e2e_preempted = []   # e2e of requests preempted >= once
+        #                           (in-process mode only) — the
+        #                           preemption latency penalty is the
+        #                           mean gap vs the unpreempted ones
         self.tokens = 0
         self.rejected = 0
         self.failed = 0
 
-    def record(self, ttft, tpot, e2e, n_tokens):
+    def record(self, ttft, tpot, e2e, n_tokens, preempted=False):
         with self.lock:
             if ttft is not None:
                 self.ttft.append(ttft)
             if tpot is not None:
                 self.tpot.append(tpot)
             self.e2e.append(e2e)
+            if preempted:
+                self.e2e_preempted.append(e2e)
             self.tokens += n_tokens
 
     def reject(self):
@@ -122,7 +135,8 @@ def _drive_inproc(server, prompt, cfg, stats):
     stats.record(None if first is None else first - t0,
                  None if (n < 2 or first is None) else (last - first)
                  / (n - 1),
-                 end - t0, n)
+                 end - t0, n,
+                 preempted=getattr(handle, "_preempts", 0) > 0)
 
 
 def _drive_http(url, prompt, cfg_body, stats):
@@ -197,7 +211,9 @@ def _build_toy_server(args):
     eng = PagedContinuousBatchingEngine(
         model, max_batch=args.max_batch, num_pages=args.num_pages,
         page_size=args.page_size, max_pages=args.max_pages,
-        prefill_buckets=buckets, prefill_chunk=args.prefill_chunk)
+        prefill_buckets=buckets, prefill_chunk=args.prefill_chunk,
+        admission_mode=args.admission_mode,
+        kv_watermark=args.kv_watermark)
     plan = None
     if args.fault_rate > 0:
         from paddle_tpu.inference.generation import EngineFault
@@ -231,6 +247,7 @@ def _build_toy_server(args):
                  segment_steps=args.segment_steps, warmup=args.warmup,
                  max_restarts=args.max_restarts,
                  max_replays=args.max_replays,
+                 max_preemptions=args.max_preemptions,
                  restart_backoff_s=args.restart_backoff,
                  stall_timeout_s=args.stall_timeout)
     srv.wait_ready()   # warmup compiles are NOT part of the measured run
@@ -307,6 +324,24 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup", action="store_true",
                     help="pre-compile all prefill buckets + the segment "
                          "program before the measured run")
+    # KV memory-pressure knobs (paged engine admission policy)
+    ap.add_argument("--admission-mode", choices=("reserved",
+                                                 "optimistic"),
+                    default="reserved",
+                    help="page-pool admission policy: reserved = "
+                         "worst-case pages claimed up front (safe, "
+                         "caps concurrency); optimistic = prompt + "
+                         "one page, grow per gap, preempt-and-replay "
+                         "under pressure (vLLM-style)")
+    ap.add_argument("--kv-watermark", type=float, default=0.9,
+                    help="optimistic mode: pause NEW admissions while "
+                         "pool occupancy would exceed this fraction "
+                         "(preemption stays the fallback, not the "
+                         "steady state)")
+    ap.add_argument("--max-preemptions", type=int, default=5,
+                    help="memory-pressure preemptions one request may "
+                         "absorb before it fails with "
+                         "PreemptionBudgetExceeded")
     # chaos knobs (in-process mode only; paddle_tpu.testing.faults)
     ap.add_argument("--fault-rate", type=float, default=0.0,
                     help="seeded per-call fault probability at each "
@@ -361,6 +396,22 @@ def main(argv=None) -> int:
                for _ in range(args.requests)]
 
     stats = _Stats()
+    # KV pool occupancy sampler (in-process paged engine): the
+    # utilization half of the reserved-vs-optimistic A/B — reserved
+    # mode's occupancy counts RESERVED pages (worst case held against
+    # the pool), optimistic mode's counts pages actually written
+    occ_samples = []
+    occ_stop = threading.Event()
+    occ_th = None
+    alloc = (getattr(server.engine, "alloc", None)
+             if server is not None else None)
+    if alloc is not None:
+        def _sample_occ():
+            while not occ_stop.wait(0.005):
+                occ_samples.append(alloc.occupancy)
+
+        occ_th = threading.Thread(target=_sample_occ, daemon=True)
+        occ_th.start()
     threads = []
     t_start = time.monotonic()
     for i, (at, prompt) in enumerate(zip(arrivals, prompts)):
@@ -385,6 +436,9 @@ def main(argv=None) -> int:
     for th in threads:
         th.join()
     wall = time.monotonic() - t_start
+    if occ_th is not None:
+        occ_stop.set()
+        occ_th.join(timeout=2.0)
 
     done = len(stats.e2e)
     print(f"\n{done}/{args.requests} completed, "
@@ -427,6 +481,43 @@ def main(argv=None) -> int:
                           "value": round(pre_s, 4), "unit": "s"}))
         print(json.dumps({"metric": "serve_distinct_prompt_lens",
                           "value": n_lens, "unit": "count"}))
+    if alloc is not None:
+        # memory-pressure accounting: the utilization/throughput A/B
+        # (PERF.md) reads these four — occupancy tells how much of the
+        # pool the policy actually used, preemptions + the latency
+        # penalty tell what the optimistic win cost in tail latency
+        occ50, occ99 = (_percentile(occ_samples, 50),
+                        _percentile(occ_samples, 99))
+        pre = alloc.preemptions
+        n_pre = len(stats.e2e_preempted)
+        print(f"kv pool [{args.admission_mode}]: occupancy "
+              f"p50={occ50:.3f} p99={occ99:.3f}, {pre} preemptions, "
+              f"{n_pre} requests preempted >= once")
+        if occ_samples:
+            print(json.dumps({"metric": "serve_kv_occupancy_p50",
+                              "value": round(occ50, 4),
+                              "unit": "ratio"}))
+            print(json.dumps({"metric": "serve_kv_occupancy_p99",
+                              "value": round(occ99, 4),
+                              "unit": "ratio"}))
+        print(json.dumps({"metric": "serve_kv_preemptions",
+                          "value": pre, "unit": "count"}))
+        print(json.dumps({"metric": "serve_preempted_requests",
+                          "value": n_pre, "unit": "count"}))
+        n_clean = len(stats.e2e) - n_pre
+        if n_pre and n_clean:
+            penalty = (sum(stats.e2e_preempted) / n_pre
+                       - (sum(stats.e2e) - sum(stats.e2e_preempted))
+                       / n_clean)
+            print(json.dumps(
+                {"metric": "serve_preempted_latency_penalty",
+                 "value": round(penalty, 6), "unit": "s"}))
+        if plan is None:
+            # chaos runs emit these below from fault accounting
+            print(json.dumps({"metric": "serve_requests_survived",
+                              "value": done, "unit": "count"}))
+            print(json.dumps({"metric": "serve_requests_failed",
+                              "value": stats.failed, "unit": "count"}))
     if plan is not None:
         # chaos accounting: what was injected, what survived, what the
         # supervisor did about it (fault_stats is host-side — readable
