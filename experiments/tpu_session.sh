@@ -89,6 +89,20 @@ STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py decode
 # on TPU, so unlike the CPU fallback the wall number should track the
 # tokens/forward ratio)
 STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py spec
+# 6c. FIRST on-chip online-serving records (every serve_bench number so
+#     far is CPU-tiny): the prefix-caching A/B is the highest-value
+#     serving pair — TTFT p50/p99 + serve_kv_occupancy +
+#     serve_prefix_hit_rate, cold then warm (PERF.md "Automatic prefix
+#     caching" methodology; 11.2x TTFT p50 on CPU tiny — the on-chip
+#     ratio decides whether the cache defaults on for serving configs)
+STEP_TIMEOUT=2400 run python tools/serve_bench.py --shared-prefix-len 448 \
+    --cache-prefixes off --num-pages 320 --max-pages 64 --page-size 8 \
+    --requests 16 --rate 4 --max-new 8 --segment-steps 2 \
+    --prompt-len 4:8 --layers 2 --prefill-chunk 64 --warmup
+STEP_TIMEOUT=2400 run python tools/serve_bench.py --shared-prefix-len 448 \
+    --cache-prefixes on --num-pages 320 --max-pages 64 --page-size 8 \
+    --requests 16 --rate 4 --max-new 8 --segment-steps 2 \
+    --prompt-len 4:8 --layers 2 --prefill-chunk 64 --warmup
 # 7. the remaining BASELINE.md configs — one window should produce the
 #    full config table (VERDICT r4 Missing #3). Expected budgets: each
 #    is a small model + cached-compile candidate; ~5-10 min warm,

@@ -648,14 +648,17 @@ class _ChunkedAdmission:
     __slots__ = ("rid", "slot", "ids", "plen", "cfg", "mini", "off",
                  "t0", "closed", "chunks_done", "last_logits")
 
-    def __init__(self, rid, slot, ids, plen, cfg, mini):
+    def __init__(self, rid, slot, ids, plen, cfg, mini, off=0):
         self.rid = rid
         self.slot = slot
         self.ids = ids
         self.plen = plen
         self.cfg = cfg
         self.mini = mini
-        self.off = 0
+        # chunk cursor; a prefix-cache hit starts it past the cached
+        # coverage (aligned down to a chunk boundary) so cached chunks
+        # never recompute
+        self.off = off
         self.t0 = time.perf_counter()
         self.closed = False
         self.chunks_done = 0
@@ -1107,20 +1110,28 @@ class ContinuousBatchingEngine:
                 "page pool exhausted; drain with decode_segment()")
         slot = heapq.heappop(self._free)
         try:
-            self._reserve_admit(slot, plen, cfg)
-            # chunk programs are keyed on the FIXED (chunk, max_len)
-            # shapes, so every chunked admission shares one compiled
-            # program (the paged engine pays a transient dense mini slab
-            # for the admission's lifetime — same slab the dense engine
-            # always uses)
-            mini = self.model.init_cache(1, self.max_len)
+            mini, start = self._begin_admit_cache(slot, ids, plen, cfg)
         except BaseException:
             self._abort_admit(slot)
             raise
         rid = self._next_req
         self._next_req += 1
         self._count_prefill("chunked")
-        return _ChunkedAdmission(rid, slot, ids, plen, cfg, mini)
+        return _ChunkedAdmission(rid, slot, ids, plen, cfg, mini,
+                                 off=start)
+
+    def _begin_admit_cache(self, slot: int, ids, plen: int, cfg):
+        """Claim a chunked admission's capacity and build its mini
+        cache; returns ``(mini, chunk_start)``. Base: reserve via
+        ``_reserve_admit`` and start chunking at 0 — chunk programs are
+        keyed on the FIXED (chunk, max_len) shapes, so every chunked
+        admission shares one compiled program (the paged engine pays a
+        transient dense mini slab for the admission's lifetime — same
+        slab the dense engine always uses). The paged prefix-cache
+        override maps cached prefix pages first and starts chunking
+        past them."""
+        self._reserve_admit(slot, plen, cfg)
+        return self.model.init_cache(1, self.max_len), 0
 
     def admit_chunk(self, adm: _ChunkedAdmission) -> bool:
         """Run ONE fixed-shape prefill chunk of an admission started
@@ -1231,6 +1242,7 @@ class ContinuousBatchingEngine:
                     self.params, self.last, self.lens, self.done_dev,
                     self.active_dev, self.samp, self.caches, key)
             out[f"segment_{segment_steps}"] = time.perf_counter() - t0
+        out.update(self._warmup_prefix())
         out["total"] = time.perf_counter() - t_all
         if monitor.enabled():
             monitor.gauge(
@@ -1244,6 +1256,11 @@ class ContinuousBatchingEngine:
         """Mini cache matching what an admission of a width-token prompt
         allocates (dense: the max_len slab; paged: bucket-sized)."""
         return self.model.init_cache(1, self.max_len)
+
+    def _warmup_prefix(self) -> dict:
+        """Pre-compile the prefix-cache warm-admission programs (paged
+        engine with ``prefix_cache=True``; no-op otherwise)."""
+        return {}
 
     def _segment_fn(self, n_steps: int):
         # keyed on n_steps ALONE: sampling parameters ride as per-slot
@@ -1501,13 +1518,36 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
       is already under pressure, so preemption is the fallback, not
       the steady state.
 
+    ``prefix_cache=True`` turns on AUTOMATIC PREFIX CACHING (vLLM-style
+    content-addressable pages; RadixAttention generalizes the same
+    reuse to a tree): admission hashes the prompt in page_size-token
+    blocks, maps already-resident blocks READ-ONLY into the new slot's
+    page table (refcount++ — prefill and page claiming skip them; only
+    the uncached tail runs through the bucketed/chunked prefill at a
+    traced offset), and the first write into a shared page — a
+    divergent suffix mid-block, or decode appending into a
+    partially-filled shared tail page — goes through host-side
+    COPY-ON-WRITE in the inter-segment gap: claim a fresh page, copy
+    the pool rows, swap the table entry. Retirement decrements
+    refcounts instead of freeing; fully-released cached pages park in
+    an LRU free-but-indexed state the pool reclaims on demand, so
+    cache capacity is whatever the pool isn't actively using. Shared
+    pages (refcount > 1) are never preemption victims — preempting a
+    request releases only ITS references. Warm-prefix admissions are
+    bitwise-identical (greedy) to cold runs: the gathered prefix KV is
+    the very KV the original prefill wrote, and the tail rides the
+    same traced-offset program chunked admission already proves
+    bitwise-equal to one-shot prefill.
+
     ``serve`` defers admission while the pool is transiently full and
     raises only for requests that could never fit. The page table
     lives host-side (numpy) and is shipped to the device once per
     segment. ``debug_pages=True`` runs the allocator's ``check()``
-    invariant validator at every gap and after every page operation.
-    Requires the model to implement ``init_paged_cache`` /
-    ``forward_decode_paged`` (llama does; see
+    invariant validator at every gap and after every page operation,
+    plus a per-gap write-coverage assert (no live slot's length past
+    its mapped pages, no imminent write into a shared page — the
+    forgotten-CoW / silent-drop net). Requires the model to implement
+    ``init_paged_cache`` / ``forward_decode_paged`` (llama does; see
     LlamaAttention.forward_decode_paged).
     """
 
@@ -1517,7 +1557,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  prefill_chunk: Optional[int] = None,
                  admission_mode: str = "reserved",
                  kv_watermark: float = 0.9,
-                 debug_pages: bool = False):
+                 debug_pages: bool = False,
+                 prefix_cache: bool = False):
         from .paged_cache import PageAllocator
 
         if admission_mode not in ADMISSION_MODES:
@@ -1531,6 +1572,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 f"the page pool), got {kv_watermark!r}")
         self.admission_mode = admission_mode
         self.kv_watermark = float(kv_watermark)
+        self.prefix_cache = bool(prefix_cache)
+        # slot -> warm-admission info ({"ids","c_map","hashes","saved"})
+        # staged between the admission's prefill and its cache install;
+        # popped by _install_mini / _abort_admit
+        self._prefix_stash = {}
         # segment count a clean grow_for_segment covered; decode_segment
         # consumes it to skip its (device-syncing) exhaustion re-check
         self._growth_stamp: Optional[int] = None
@@ -1542,7 +1588,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.num_pages = num_pages
         self.page_size = page_size
         self.alloc = PageAllocator(num_pages, page_size, max_batch,
-                                   max_pages, debug=debug_pages)
+                                   max_pages, debug=debug_pages,
+                                   prefix_cache=prefix_cache)
         super().__init__(model, max_batch,
                          max_len=max_pages * page_size,
                          prefill_buckets=prefill_buckets,
@@ -1575,7 +1622,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         return min(plen + self.page_size, self._reserved(plen, cfg))
 
     def _can_admit(self, prompt_len: int, cfg) -> bool:
-        # any free slot owns zero pages, so capacity is slot-agnostic
+        # any free slot owns zero pages, so capacity is slot-agnostic.
+        # Prefix caching never tightens this probe: a warm admission
+        # claims at most what a cold one would (shared pages count as
+        # coverage), and when the pool cannot also spare the one
+        # copy-on-write page a partial-block hit needs, admission
+        # DEGRADES the hit to full blocks instead of demanding more
+        # (so a request whose worst case exactly fills the pool still
+        # admits). can_admit saying yes must mean add_request cannot
+        # raise for capacity.
         probe = self._free[0] if self._free else 0
         if self.admission_mode == "reserved":
             return self.alloc.can_fit(probe,
@@ -1595,16 +1650,125 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 return False
         return True
 
+    def _lookup_degraded(self, slot: int, ids, plen: int, cfg):
+        """Shared warm-admission preamble (one-shot AND chunked):
+        longest resident cached prefix, degraded to full blocks when
+        the pool cannot spare the partial page's CoW."""
+        pids, c_map, hashes = self.alloc.lookup_prefix(ids[0])
+        pids, c_map = self._degrade_partial_hit(slot, plen, cfg,
+                                                pids, c_map)
+        return pids, c_map, hashes
+
     def _admit_cache(self, slot: int, ids, plen: int, cfg):
-        # prefill into a dense mini cache sized to the prompt's BUCKET
-        # (no max_len slab — the pool is the whole point; the bucket
-        # keys the compiled program count to O(len(buckets))), then
-        # scatter the prompt's KV rows into freshly reserved pages
+        if self.prefix_cache:
+            pids, c_map, hashes = self._lookup_degraded(slot, ids,
+                                                        plen, cfg)
+            self._prefix_stash[slot] = {
+                "ids": ids, "c_map": c_map, "hashes": hashes,
+                "saved": min(c_map, plen - 1)}
+            if c_map > 0:
+                return self._admit_cache_warm(slot, ids, plen, cfg,
+                                              pids, c_map)
+        # COLD path: prefill into a dense mini cache sized to the
+        # prompt's BUCKET (no max_len slab — the pool is the whole
+        # point; the bucket keys the compiled program count to
+        # O(len(buckets))), then scatter the prompt's KV rows into
+        # freshly reserved pages
         mini = self.model.init_cache(1, self._prefill_width(plen))
         last_logits, mini = self._run_prefill(ids, plen, mini)
         self._reserve_admit(slot, plen, cfg)
         self._install_mini(slot, mini, plen)
         return last_logits
+
+    def _degrade_partial_hit(self, slot: int, plen: int, cfg, pids,
+                             c_map: int):
+        """A partial-block hit (coverage ending mid-page) maps a page
+        the request must copy-on-write before its first write — one
+        page BEYOND its normal claim. When the pool cannot spare it,
+        DEGRADE the hit to full blocks (drop the partial page) rather
+        than demand extra capacity: a request whose worst case exactly
+        fills the pool must still admit, cache or no cache."""
+        ps = self.page_size
+        if not pids or c_map % ps == 0:
+            return pids, c_map
+        claim = (self._reserved(plen, cfg)
+                 if self.admission_mode == "reserved"
+                 else self._optimistic_claim(plen, cfg))
+        if self.alloc.can_fit(slot, claim + ps):
+            return pids, c_map
+        return pids[:-1], (c_map // ps) * ps
+
+    def _admit_cache_warm(self, slot: int, ids, plen: int, cfg, pids,
+                          c_map: int):
+        """Prefix-cache hit admission: gather the cached prefix KV from
+        the resident pages (a pure copy — bitwise what the original
+        prefill wrote), prefill ONLY the uncached tail at a traced
+        offset through the shared chunk program, then map the cached
+        pages read-only and install the tail. At least the LAST prompt
+        token always recomputes — its logits seed the first sampled
+        token — even when the whole prompt is resident (its KV write
+        is simply masked out then)."""
+        # compute start: everything below is served from cache; cap at
+        # plen-1 so the last position's logits exist
+        c_cmp = min(c_map, plen - 1)
+        wt = (plen - c_cmp if self.prefill_buckets is None
+              else _bucket_for(self.prefill_buckets, plen - c_cmp))
+        # the tail chunk writes mini rows [c_cmp, c_cmp+wt) — pull the
+        # compute start DOWN when the bucket would overhang max_len
+        # (the fwd's dynamic_update_slice clamps, which would corrupt
+        # cached rows); recomputing a few extra cached positions is
+        # value-neutral (their installs are masked out) and keeps the
+        # program keyed on wt alone
+        c_cmp = min(c_cmp, self.max_len - wt)
+        # tokens-saved is the compute actually skipped ([0, c_cmp)),
+        # not the raw coverage — the clamp above shrinks it
+        self._prefix_stash[slot]["saved"] = c_cmp
+        tail = plen - c_cmp
+        mini = self.model.init_cache(1, self.max_len)
+        mini = self._gather_mini(mini, pids)
+        self._count_prefill("warm")
+        tail_ids = _pad_ids(ids[:, c_cmp:], wt)
+        last_logits, mini = self._prefill_chunk(
+            self.params, tail_ids, mini, jnp.int32(c_cmp),
+            jnp.int32(tail - 1))
+        self.alloc.map_shared(slot, pids)
+        self._reserve_admit(slot, plen, cfg)
+        self._install_mini(slot, mini, plen)
+        return last_logits
+
+    def _gather_mini(self, mini, pids):
+        """Copy the resident pages into the head of a max_len-width
+        dense mini cache (per layer) — the cached-prefix KV the tail
+        prefill attends over. The page vector is padded to the FULL
+        page-table row width so every warm admission shares one
+        compiled gather program (junk rows for the ``-1`` tail sit
+        past the cached coverage, overwritten or masked)."""
+        from .paged_cache import gather_pages
+
+        row = np.full((self.alloc.page_table.shape[1],), -1, np.int32)
+        row[:len(pids)] = pids
+        pages = jnp.asarray(row)
+        pools, _ = self.caches
+        out = []
+        for (kp, vp), (mk, mv) in zip(pools, mini):
+            mk, mv = gather_pages(kp, vp, pages, mk, mv)
+            out.append((mk, mv))
+        return out
+
+    def _cow_page(self, slot: int, page_idx: int) -> None:
+        """Host-side copy-on-write of one shared page in the
+        inter-segment gap: claim a fresh page (allocator bookkeeping),
+        copy the pool rows on device, swap the table entry (shipped at
+        the next segment)."""
+        from .paged_cache import copy_page
+
+        old, new = self.alloc.cow(slot, page_idx)
+        pools, pt = self.caches
+        new_pools = []
+        for kp, vp in pools:
+            kp, vp = copy_page(kp, vp, jnp.int32(old), jnp.int32(new))
+            new_pools.append((kp, vp))
+        self.caches = (new_pools, pt)
 
     def _reserve_admit(self, slot: int, plen: int, cfg) -> None:
         self.alloc.ensure(
@@ -1615,28 +1779,153 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _install_mini(self, slot: int, mini, plen: int) -> None:
         from .paged_cache import write_tokens
 
-        # scatter bucket-width rows (fixed shapes per bucket — the
-        # scatter program count stays O(len(buckets)), not O(#plens)):
-        # rows past plen land on reserved-but-unwritten positions the
-        # decode mask hides and decode writes overwrite, or on unmapped
-        # pages where write_tokens drops them
-        width = min(self._prefill_width(plen), mini[0][0].shape[1])
+        info = (self._prefix_stash.pop(slot, None)
+                if self.prefix_cache else None)
+        if info is not None and info["c_map"] > 0:
+            self._install_mini_warm(slot, mini, plen, info)
+        else:
+            # COLD scatter: bucket-width rows (fixed shapes per bucket
+            # — the scatter program count stays O(len(buckets)), not
+            # O(#plens)): rows past plen land on reserved-but-unwritten
+            # positions the decode mask hides and decode writes
+            # overwrite, or on unmapped pages where write_tokens drops
+            # them
+            width = min(self._prefill_width(plen), mini[0][0].shape[1])
+            pt = jnp.asarray(self.alloc.page_table)
+            slots_v = jnp.full((width,), slot, jnp.int32)
+            pos_v = jnp.arange(width, dtype=jnp.int32)
+            pools, _ = self.caches
+            new_pools = []
+            for (kp, vp), (mk, mv) in zip(pools, mini):
+                kp, vp = write_tokens(kp, vp, pt, slots_v, pos_v,
+                                      mk[0, :width], mv[0, :width])
+                new_pools.append((kp, vp))
+            self.caches = (new_pools, pt)
+        if info is not None:
+            # a cold admission POPULATES the cache; a warm one extends
+            # it — either way the prompt's fully-written private blocks
+            # become future hits
+            ps = self.page_size
+            self.alloc.register_blocks(
+                slot, info["hashes"], info["ids"][0],
+                info["c_map"] // ps, plen // ps)
+            if info["c_map"] > 0:
+                self.alloc.count_prefix_hit(info["saved"])
+
+    def _install_mini_warm(self, slot: int, mini, plen: int,
+                           info) -> None:
+        """Install a warm admission's UNCACHED suffix: copy-on-write
+        the shared page the first write would land in (divergent
+        suffix mid-block — or, fully-cached prompts, the partial tail
+        page decode will append into), then scatter exactly the rows
+        ``[c_map, plen)``. Shared pages are never written: positions
+        below the cached coverage are masked out of the scatter, and
+        the garbage tail past ``plen`` lands only in private headroom
+        pages or drops on unmapped ones."""
+        from .paged_cache import scatter_rows
+
+        ps = self.page_size
+        c_map = info["c_map"]
+        # first position this slot will EVER write: the uncached
+        # suffix's start, or (fully cached) decode's first append
+        p0 = c_map if c_map < plen else plen
+        if p0 % ps and self.alloc.needs_cow(slot, p0):
+            self._cow_page(slot, p0 // ps)
         pt = jnp.asarray(self.alloc.page_table)
-        slots_v = jnp.full((width,), slot, jnp.int32)
-        pos_v = jnp.arange(width, dtype=jnp.int32)
-        pools, _ = self.caches
-        new_pools = []
-        for (kp, vp), (mk, mv) in zip(pools, mini):
-            kp, vp = write_tokens(kp, vp, pt, slots_v, pos_v,
-                                  mk[0, :width], mv[0, :width])
-            new_pools.append((kp, vp))
-        self.caches = (new_pools, pt)
+        if c_map < plen:
+            mini_len = mini[0][0].shape[1]
+            width = (plen - c_map if self.prefill_buckets is None
+                     else _bucket_for(self.prefill_buckets,
+                                      plen - c_map))
+            width = min(width, mini_len)
+            pools, _ = self.caches
+            new_pools = []
+            for (kp, vp), (mk, mv) in zip(pools, mini):
+                kp, vp = scatter_rows(
+                    kp, vp, pt, jnp.int32(slot), jnp.int32(c_map),
+                    jnp.int32(plen), mk, mv, width=width)
+                new_pools.append((kp, vp))
+            self.caches = (new_pools, pt)
+        else:
+            pools, _ = self.caches
+            self.caches = (pools, pt)
 
     def _warmup_mini(self, width: int):
         return self.model.init_cache(1, width)
 
+    def _begin_admit_cache(self, slot: int, ids, plen: int, cfg):
+        if not self.prefix_cache:
+            return super()._begin_admit_cache(slot, ids, plen, cfg)
+        pids, c_map, hashes = self._lookup_degraded(slot, ids, plen,
+                                                    cfg)
+        C = self.prefill_chunk
+        # chunk windows must stay C-aligned (an overhanging window
+        # would clamp and corrupt earlier KV), so the cursor starts at
+        # the cached coverage aligned DOWN — the [start, c_map) sliver
+        # recomputes but its writes are masked out at install
+        start = (min(c_map, plen - 1) // C) * C
+        self._prefix_stash[slot] = {"ids": ids, "c_map": c_map,
+                                    "hashes": hashes, "saved": start}
+        self.alloc.map_shared(slot, pids)
+        self._reserve_admit(slot, plen, cfg)
+        # copy-on-write the partial shared page EAGERLY, while the
+        # claim is atomic with the reservation — install runs gaps
+        # later, and the spare page must not be stolen by growth or
+        # another admission in between
+        p0 = c_map if c_map < plen else plen
+        if p0 % self.page_size and self.alloc.needs_cow(slot, p0):
+            self._cow_page(slot, p0 // self.page_size)
+        mini = self.model.init_cache(1, self.max_len)
+        if pids:
+            # full cached coverage gathered (fixed-shape program);
+            # rows the chunks recompute from `start` just overwrite
+            # their gathered copies with bitwise-identical values
+            mini = self._gather_mini(mini, pids)
+        return mini, start
+
+    def _warmup_prefix(self) -> dict:
+        """Pre-compile every program a WARM admission can hit — the
+        page gather, the CoW page copy, and one tail-prefill + masked
+        scatter per prefill bucket — so the first cache hit never pays
+        an XLA compile inside the latency-critical gap. All calls are
+        value-neutral: nothing is mapped, every scatter row is masked
+        out (limit 0), and the page-0 self-copy happens before any
+        request owns it."""
+        if not self.prefix_cache:
+            return {}
+        from .paged_cache import copy_page, scatter_rows
+
+        out = {}
+        t0 = time.perf_counter()
+        mini = self._gather_mini(self.model.init_cache(1, self.max_len),
+                                 [])
+        pools, pt = self.caches
+        new_pools = []
+        for kp, vp in pools:
+            kp, vp = copy_page(kp, vp, jnp.int32(0), jnp.int32(0))
+            new_pools.append((kp, vp))
+        self.caches = (new_pools, pt)
+        out["prefix_gather_copy"] = time.perf_counter() - t0
+        pt_dev = jnp.asarray(self.alloc.page_table)
+        for w in (self.prefill_buckets or ()):
+            t0 = time.perf_counter()
+            _, mini = self._prefill_chunk(
+                self.params, np.zeros((1, w), np.int32), mini,
+                jnp.int32(0), jnp.int32(0))
+            pools, _ = self.caches
+            new_pools = []
+            for (kp, vp), (mk, mv) in zip(pools, mini):
+                kp, vp = scatter_rows(kp, vp, pt_dev, jnp.int32(0),
+                                      jnp.int32(0), jnp.int32(0),
+                                      mk, mv, width=w)
+                new_pools.append((kp, vp))
+            self.caches = (new_pools, pt)
+            out[f"prefix_warm_{w}"] = time.perf_counter() - t0
+        return out
+
     def _abort_admit(self, slot: int) -> None:
         super()._abort_admit(slot)
+        self._prefix_stash.pop(slot, None)
         self.alloc.free_slot(slot)   # release any reserved pages
 
     def _register(self, slot: int, rid: int, first, tok_done, cfg,
@@ -1662,6 +1951,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # interrupted
         for slot in range(self.max_batch):
             self.alloc.free_slot(slot)
+        # the pools are rebuilt from zeros below: every cached block's
+        # KV is gone, so the content index must go with it (parked
+        # pages return to the free heap)
+        self.alloc.clear_prefix_index()
+        self._prefix_stash.clear()
         self._growth_stamp = None
         self._gap_sync = None
         super().reset_state()
@@ -1754,13 +2048,27 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     short,
                     f"page pool exhausted in the inter-segment gap: "
                     f"requests {short} cannot grow for the next "
-                    f"{n_steps}-step segment ({self.alloc.free_pages} "
-                    f"pages free) — preempt victims "
-                    f"(preempt_request) or grow num_pages")
+                    f"{n_steps}-step segment "
+                    f"({self.alloc.available_pages} pages reclaimable) "
+                    f"— preempt victims (preempt_request) or grow "
+                    f"num_pages")
         # reserved mode: admission reserved every running request's
         # worst case, so no growth can fail — just ship the table
         if self.alloc.debug:
             self.alloc.check()
+            # write_tokens drops out-of-mapping writes SILENTLY (one
+            # compiled program) and a forgotten copy-on-write would
+            # mutate a shared page other requests read — both surface
+            # as wrong tokens far downstream. Under debug_pages the gap
+            # re-asserts, per live slot, that the live length is inside
+            # the mapped pages and the imminent write lands in a
+            # private page.
+            lens = np.asarray(self.lens)
+            done = np.asarray(self.done_dev)
+            for slot in self._slot_req:
+                if bool(done[slot]):
+                    continue
+                self.alloc.check_coverage(slot, int(lens[slot]))
         pools, _ = self.caches
         self.caches = (pools, jnp.asarray(self.alloc.page_table))
         return super().decode_segment(n_steps, cfg)

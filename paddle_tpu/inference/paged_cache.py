@@ -9,7 +9,19 @@ table (ops/paged_attention.py consumes both), so:
   ``max_batch * max_len`` — with skewed lengths the pool can be a
   fraction of the dense slabs;
 - any free page serves any slot: no fragmentation, admission between
-  decode segments allocates pages for at most one segment of growth.
+  decode segments allocates pages for at most one segment of growth;
+- with ``prefix_cache=True`` full pages of prompt KV become
+  CONTENT-ADDRESSABLE and shareable (vLLM-style automatic prefix
+  caching, Kwon et al. SOSP'23): every page carries a REFCOUNT, full
+  prompt blocks are indexed by a chain hash (hash of the block's
+  tokens + the previous block's hash, token-verified on match so a
+  hash collision can never alias KV), a new request maps already
+  resident blocks read-only instead of re-prefilling them, and the
+  first write into a shared page goes through host-side COPY-ON-WRITE
+  (:meth:`PageAllocator.cow`). Fully released cached pages PARK in an
+  LRU free-but-indexed state — still a cache hit, but reclaimed on
+  demand when the pool needs pages — so cache capacity is whatever the
+  pool is not actively using.
 
 Split of responsibilities (mirrors the engine's host/device split):
 page ALLOCATION is host-side Python between jitted segments (the free
@@ -20,15 +32,31 @@ ride inside compiled segment programs.
 from __future__ import annotations
 
 import functools
+import hashlib
 import heapq
-from typing import Dict, List
+from collections import OrderedDict
+from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["PageAllocator", "PagedKVCache", "write_tokens",
-           "gather_dense"]
+           "gather_dense", "scatter_rows", "copy_page", "gather_pages"]
+
+# chain-hash root: the "parent" of a prompt's first block
+_ROOT = b"\x00" * 16
+
+
+def _block_hash(parent: bytes, tokens: np.ndarray) -> bytes:
+    """Chain hash of one page_size-token prompt block: a function of
+    the block's tokens AND the whole prefix before it (via ``parent``),
+    so equal blocks at different prefixes never alias. 128-bit blake2b
+    — and matches are token-verified anyway, so a collision can
+    degrade a hit, never corrupt KV."""
+    return hashlib.blake2b(
+        parent + np.ascontiguousarray(tokens, np.int32).tobytes(),
+        digest_size=16).digest()
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -45,7 +73,12 @@ def write_tokens(k_pool, v_pool, page_table, slots, positions, k_new,
     Writes whose position has NO mapped page (table entry -1 — caller
     forgot ``ensure``) are DROPPED, never wrapped onto another
     sequence's page (JAX scatter would wrap the -1 to the last pool
-    row otherwise).
+    row otherwise). That drop is SILENT by design (one compiled
+    program), which is why the paged engine's per-gap ``debug_pages``
+    check also asserts no slot's live length extends past its mapped
+    pages — a forgotten ensure() or copy-on-write surfaces there
+    loudly instead of as wrong tokens far downstream
+    (:meth:`PageAllocator.check_coverage`).
     """
     ps = k_pool.shape[1]
     pages = page_table[slots, positions // ps]        # [N]
@@ -57,6 +90,72 @@ def write_tokens(k_pool, v_pool, page_table, slots, positions, k_new,
     v_pool = v_pool.at[pages, offs].set(v_new.astype(v_pool.dtype),
                                         mode="drop")
     return k_pool, v_pool
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("width",))
+def scatter_rows(k_pool, v_pool, page_table, slot, start, limit,
+                 mini_k, mini_v, *, width):
+    """Masked variant of :func:`write_tokens` for ONE slot: scatter
+    ``width`` consecutive mini-cache rows starting at TRACED position
+    ``start``, dropping rows outside ``[start, limit)``. The
+    prefix-cache install path uses this to write exactly the UNCACHED
+    suffix of a warm prompt — positions below the cached coverage must
+    never be re-written (their pages are shared read-only), and the
+    fixed-width garbage tail past the prompt must never land in a
+    shared page either. Programs are keyed on the STATIC ``width``
+    (one per prefill bucket) and the pool/mini shapes — never on the
+    offsets, so admissions with different cached coverage share one
+    compiled program."""
+    L = mini_k.shape[1]
+    ps = k_pool.shape[1]
+    # clamp the slice base so [base, base+width) stays inside the mini
+    # (rows pulled in below `start` by the clamp are masked back out)
+    base = jnp.clip(start, 0, L - width)
+    pos = base + jnp.arange(width, dtype=jnp.int32)
+    valid = (pos >= start) & (pos < limit)
+    pages = page_table[slot, pos // ps]                      # [width]
+    pages = jnp.where(valid & (pages >= 0), pages, k_pool.shape[0])
+    offs = pos % ps
+    k_new = jax.lax.dynamic_slice_in_dim(mini_k[0], base, width, axis=0)
+    v_new = jax.lax.dynamic_slice_in_dim(mini_v[0], base, width, axis=0)
+    k_pool = k_pool.at[pages, offs].set(k_new.astype(k_pool.dtype),
+                                        mode="drop")
+    v_pool = v_pool.at[pages, offs].set(v_new.astype(v_pool.dtype),
+                                        mode="drop")
+    return k_pool, v_pool
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def copy_page(k_pool, v_pool, src, dst):
+    """Copy one page's rows src -> dst inside the pools (the device
+    half of copy-on-write; src/dst are traced scalars, so every CoW in
+    the process shares ONE compiled program per pool shape)."""
+    k_pool = k_pool.at[dst].set(k_pool[src])
+    v_pool = v_pool.at[dst].set(v_pool[src])
+    return k_pool, v_pool
+
+
+@functools.partial(jax.jit, donate_argnums=(3, 4))
+def gather_pages(k_pool, v_pool, pages, mini_k, mini_v):
+    """Gather whole pages from the pools into the head of a dense mini
+    cache (``mini[:, :len(pages)*page_size] = pool[pages]``): the warm
+    admission path materializes the CACHED prefix KV this way — a pure
+    copy, bitwise-identical to what the original prefill wrote — so the
+    uncached tail can prefill against it at a traced offset. Callers
+    pass a FIXED-width page vector (a full page-table row, ``-1``
+    padded — clamped to page 0 here) so every warm admission shares
+    ONE compiled program per pool shape; the junk rows gathered for
+    unmapped entries sit past the cached coverage, where the tail
+    prefill overwrites them or the causal/length mask hides them."""
+    idx = jnp.maximum(pages, 0)
+    uk = k_pool[idx].reshape(1, -1, *k_pool.shape[2:])
+    uv = v_pool[idx].reshape(1, -1, *v_pool.shape[2:])
+    mini_k = jax.lax.dynamic_update_slice_in_dim(
+        mini_k, uk.astype(mini_k.dtype), 0, axis=1)
+    mini_v = jax.lax.dynamic_update_slice_in_dim(
+        mini_v, uv.astype(mini_v.dtype), 0, axis=1)
+    return mini_k, mini_v
 
 
 @jax.jit
@@ -76,10 +175,20 @@ class PageAllocator:
     all slots; ``max_pages`` bounds one sequence's length. Allocation
     (``ensure``) and free (``free_slot``) are host-side between
     segments; reads/writes are the pure functions above.
+
+    Every page carries a REFCOUNT (the number of slot-row appearances
+    referencing it). Without ``prefix_cache`` every page's refcount is
+    0 or 1 and the allocator behaves exactly like the pre-sharing one.
+    With ``prefix_cache=True`` pages also move through a content index
+    (see the module docstring): a page is in exactly ONE of three
+    states — FREE (``_free`` heap), PARKED (refcount 0 but still
+    indexed; an LRU of reclaimable cache hits), or REFERENCED
+    (refcount >= 1, appearing in that many slot rows).
     """
 
     def __init__(self, num_pages: int, page_size: int, max_batch: int,
-                 max_pages: int, debug: bool = False):
+                 max_pages: int, debug: bool = False,
+                 prefix_cache: bool = False):
         self.page_size = page_size
         self.num_pages = num_pages
         # debug=True runs the full check() invariant validator after
@@ -89,6 +198,7 @@ class PageAllocator:
         # neighbour's pages. O(num_pages) per call — test/chaos tool,
         # not a production default.
         self.debug = bool(debug)
+        self.prefix_cache = bool(prefix_cache)
         self.preemptions = 0          # lifetime count, host-side
         # HOST-side numpy, mutated in place: ensure() runs for active
         # slots in the latency-critical gap between jitted segments, and
@@ -98,6 +208,21 @@ class PageAllocator:
         self.page_table = np.full((max_batch, max_pages), -1, np.int32)
         self._free: List[int] = list(range(num_pages))
         self._owned: Dict[int, List[int]] = {}
+        self._ref: Dict[int, int] = {}         # pid -> refcount (>=1)
+        self._shared = 0                       # pages with refcount > 1
+        # prefix index (prefix_cache): chain hash <-> resident page
+        self._index: Dict[bytes, int] = {}     # hash -> pid
+        self._hash_of: Dict[int, bytes] = {}   # pid -> hash
+        self._tok_of: Dict[int, np.ndarray] = {}   # pid -> block tokens
+        self._parent_of: Dict[int, bytes] = {}     # pid -> parent hash
+        self._next: Dict[bytes, set] = {}      # parent hash -> {pid}
+        # refcount-0 indexed pages, LRU order (oldest evicted first)
+        self._parked: "OrderedDict[int, bytes]" = OrderedDict()
+        # host-side prefix-cache accounting (monitor-independent)
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
+        self.cow_copies = 0
         # pool label so several allocators (multi-model serving) publish
         # side by side instead of clobbering one process-global gauge
         from .. import monitor
@@ -105,9 +230,35 @@ class PageAllocator:
         self.monitor_pool = monitor.instance_label("pool")
         self._publish_occupancy()
 
+    # -- capacity accounting --------------------------------------------------
     @property
     def free_pages(self) -> int:
+        """Strictly free pages (unindexed). Parked cache pages are NOT
+        counted here — see :attr:`available_pages` for what an
+        admission can actually claim."""
         return len(self._free)
+
+    @property
+    def cached_pages(self) -> int:
+        """Refcount-0 pages parked in the prefix LRU: resident cache
+        hits the pool reclaims on demand."""
+        return len(self._parked)
+
+    @property
+    def available_pages(self) -> int:
+        """Pages an allocation can claim right now: strictly free plus
+        LRU-parked (a parked page is evicted from the index and reused
+        the moment the pool needs it)."""
+        return len(self._free) + len(self._parked)
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages referenced by MORE than one slot row right now — the
+        sharing multiplier the prefix cache buys. Maintained
+        incrementally on the 1<->2 refcount crossings (publish runs in
+        the latency-critical gap; an O(pool) scan there would not);
+        ``check()`` recomputes and cross-validates it."""
+        return self._shared
 
     @staticmethod
     def _pages_gauge():
@@ -119,16 +270,19 @@ class PageAllocator:
 
     @property
     def used_pages(self) -> int:
-        return self.num_pages - len(self._free)
+        """Pages REFERENCED by at least one slot (parked cache pages
+        are reclaimable, so they count as capacity, not use)."""
+        return self.num_pages - len(self._free) - len(self._parked)
 
     @property
     def occupancy(self) -> float:
-        """Fraction of the pool in use right now (0.0 on an empty
-        pool) — the number admission watermarks and the serving
-        ``pressure`` surface read."""
+        """Fraction of the pool actually referenced right now (0.0 on
+        an empty pool) — the number admission watermarks and the
+        serving ``pressure`` surface read. LRU-parked cache pages are
+        reclaimable and do not count."""
         if not self.num_pages:
             return 0.0
-        return 1.0 - len(self._free) / self.num_pages
+        return self.used_pages / self.num_pages
 
     @staticmethod
     def _occupancy_gauge():
@@ -138,10 +292,19 @@ class PageAllocator:
                              "fraction of the KV page pool in use",
                              ("pool",))
 
+    @staticmethod
+    def _shared_gauge():
+        from .. import monitor
+
+        return monitor.gauge(
+            "paddle_tpu_kv_shared_pages",
+            "pages referenced by more than one slot (prefix-cache "
+            "sharing)", ("pool",))
+
     def _publish_occupancy(self) -> None:
         """Push pool occupancy into the monitor (host-side mutations only
-        happen in ensure/free_slot, so pushing there keeps the gauges
-        exact with zero per-token cost)."""
+        happen in ensure/free_slot/map_shared/cow, so pushing there
+        keeps the gauges exact with zero per-token cost)."""
         from .. import monitor
 
         if not monitor.enabled():
@@ -150,9 +313,14 @@ class PageAllocator:
         pages = self._pages_gauge()
         pages.labels(pool=self.monitor_pool, state="free").set(free)
         pages.labels(pool=self.monitor_pool,
-                     state="used").set(self.num_pages - free)
+                     state="used").set(self.used_pages)
+        if self.prefix_cache:
+            pages.labels(pool=self.monitor_pool,
+                         state="cached").set(len(self._parked))
+            self._shared_gauge().labels(pool=self.monitor_pool).set(
+                self.shared_pages)
         self._occupancy_gauge().labels(pool=self.monitor_pool).set(
-            1.0 - free / self.num_pages if self.num_pages else 0.0)
+            self.occupancy)
 
     @staticmethod
     def _preempt_counter():
@@ -164,6 +332,25 @@ class PageAllocator:
             "pressure, by reason (pressure = growth needed the pages; "
             "unsatisfiable = could not fit even alone)",
             ("pool", "reason"))
+
+    @staticmethod
+    def _prefix_hits_counter():
+        from .. import monitor
+
+        return monitor.counter(
+            "paddle_tpu_kv_prefix_hits_total",
+            "admissions that mapped at least one cached prompt-prefix "
+            "page instead of re-prefilling it", ("pool",))
+
+    @staticmethod
+    def _prefix_saved_counter():
+        from .. import monitor
+
+        return monitor.counter(
+            "paddle_tpu_kv_prefix_tokens_saved_total",
+            "prompt tokens whose prefill compute was skipped because "
+            "their KV was already resident (prefix-cache hits)",
+            ("pool",))
 
     def count_preemption(self, reason: str = "pressure") -> None:
         """Record one preemption against this pool (the engine's
@@ -177,35 +364,107 @@ class PageAllocator:
             self._preempt_counter().labels(
                 pool=self.monitor_pool, reason=reason).inc()
 
+    def count_prefix_hit(self, tokens_saved: int) -> None:
+        """Record one prefix-cache hit and the prompt tokens whose
+        prefill compute it skipped (the engine calls this once per warm
+        admission, AFTER the shared mapping succeeded)."""
+        self.prefix_hits += 1
+        self.prefix_tokens_saved += int(tokens_saved)
+        from .. import monitor
+
+        if monitor.enabled():
+            self._prefix_hits_counter().labels(
+                pool=self.monitor_pool).inc()
+            if tokens_saved:
+                self._prefix_saved_counter().labels(
+                    pool=self.monitor_pool).inc(int(tokens_saved))
+
+    # -- invariant validator --------------------------------------------------
     def check(self) -> None:
-        """Invariant validator: the free list and the per-slot owned
-        pages must PARTITION ``range(num_pages)`` (no duplicates, no
-        losses, no foreign ids), and every ``page_table`` row must
-        mirror its slot's owned list exactly (owned prefix in order,
-        ``-1`` tail). Raises RuntimeError on the first violation —
-        called per-op under ``debug=True`` and once per gap by the
-        paged engine, so a reclaim bug (double free, leaked page,
-        stale table entry) fails loudly instead of corrupting a
-        neighbour's KV."""
+        """Invariant validator for the sharing era: every page must be
+        in exactly ONE of free / parked / referenced, and the
+        partition is by REFCOUNT ACCOUNTING — a page may appear in
+        multiple slots' rows iff its refcount equals the appearance
+        count; LRU-parked pages are indexed-but-reclaimable and appear
+        in no row; every ``page_table`` row must mirror its slot's
+        owned list exactly (owned prefix in order, ``-1`` tail); and
+        the prefix index must be internally consistent. Raises
+        RuntimeError on the first violation — called per-op under
+        ``debug=True`` and once per gap by the paged engine, so a
+        refcount leak, double free, or stale table entry fails loudly
+        instead of corrupting a neighbour's KV."""
         owner = {}
         for pid in self._free:
             if pid in owner:
                 raise RuntimeError(
                     f"page {pid} appears twice in the free list")
             owner[pid] = "free"
+        for pid in self._parked:
+            if pid in owner:
+                raise RuntimeError(
+                    f"page {pid} parked in the prefix LRU is also "
+                    f"{owner[pid]}")
+            if pid not in self._hash_of:
+                raise RuntimeError(
+                    f"page {pid} parked in the prefix LRU but not "
+                    f"indexed")
+            if self._ref.get(pid, 0):
+                raise RuntimeError(
+                    f"page {pid} parked with refcount "
+                    f"{self._ref[pid]} (must be 0)")
+            owner[pid] = "parked"
+        appear: Dict[int, int] = {}
         for slot, pages in self._owned.items():
             for pid in pages:
-                if pid in owner:
-                    raise RuntimeError(
-                        f"page {pid} owned by slot {slot} is also "
-                        f"{owner[pid]}")
-                owner[pid] = f"slot {slot}"
+                appear[pid] = appear.get(pid, 0) + 1
+        for pid, n in appear.items():
+            if pid in owner:
+                raise RuntimeError(
+                    f"page {pid} referenced by a slot is also "
+                    f"{owner[pid]}")
+            r = self._ref.get(pid, 0)
+            if r != n:
+                raise RuntimeError(
+                    f"page {pid} appears in {n} slot row(s) but its "
+                    f"refcount is {r} — sharing is legal only with a "
+                    f"matching refcount (double-own / refcount leak)")
+            owner[pid] = f"referenced(x{n})"
+        for pid, r in self._ref.items():
+            if appear.get(pid, 0) != r:
+                raise RuntimeError(
+                    f"page {pid} has refcount {r} but appears in "
+                    f"{appear.get(pid, 0)} slot row(s) (refcount leak)")
+        shared = sum(1 for r in self._ref.values() if r > 1)
+        if shared != self._shared:
+            raise RuntimeError(
+                f"incremental shared-page counter {self._shared} "
+                f"disagrees with the pool ({shared} pages with "
+                f"refcount > 1)")
         if set(owner) != set(range(self.num_pages)):
             missing = sorted(set(range(self.num_pages)) - set(owner))
             foreign = sorted(set(owner) - set(range(self.num_pages)))
             raise RuntimeError(
-                f"free ∪ owned does not partition the pool: "
-                f"missing {missing}, foreign {foreign}")
+                f"free ∪ parked ∪ referenced does not partition the "
+                f"pool: missing {missing}, foreign {foreign}")
+        for h, pid in self._index.items():
+            if self._hash_of.get(pid) != h:
+                raise RuntimeError(
+                    f"prefix index maps {h.hex()} -> page {pid} but "
+                    f"the page's hash is "
+                    f"{self._hash_of.get(pid) and self._hash_of[pid].hex()}")
+        for pid, h in self._hash_of.items():
+            if self._index.get(h) != pid:
+                raise RuntimeError(
+                    f"page {pid} hashed but not (or differently) "
+                    f"indexed")
+            if pid not in self._tok_of or pid not in self._parent_of:
+                raise RuntimeError(
+                    f"indexed page {pid} missing token/parent records")
+            if (self._ref.get(pid, 0) == 0
+                    and pid not in self._parked):
+                raise RuntimeError(
+                    f"page {pid} indexed with refcount 0 but not "
+                    f"parked (index leak)")
         for slot in range(self.page_table.shape[0]):
             owned = self._owned.get(slot, [])
             row = self.page_table[slot]
@@ -215,17 +474,102 @@ class PageAllocator:
                     f"page_table row {slot} inconsistent with owned "
                     f"pages {owned}: {row.tolist()}")
 
+    def check_coverage(self, slot: int, live_len: int,
+                       write_ahead: int = 1) -> None:
+        """Per-gap hardening against :func:`write_tokens`' silent drop
+        (and a forgotten copy-on-write): ``slot``'s live length must
+        not extend past its mapped pages, and the page the next decode
+        write lands in must be PRIVATE (refcount 1, unindexed) —
+        otherwise the write would either be dropped silently or mutate
+        a shared/indexed page other requests read. The paged engine
+        calls this for every live slot per gap under ``debug_pages``."""
+        owned = self._owned.get(slot, [])
+        if self.pages_for(live_len) > len(owned):
+            raise RuntimeError(
+                f"slot {slot}: live length {live_len} extends past its "
+                f"{len(owned)} mapped page(s) — a KV write was (or "
+                f"would be) silently dropped (forgot ensure()/CoW?)")
+        max_len = self.page_size * self.page_table.shape[1]
+        for pos in range(live_len, min(live_len + write_ahead, max_len)):
+            # unmapped growth is the optimistic-mode grow/exhaustion
+            # path's job, not a CoW bug — needs_cow returns False there
+            if self.needs_cow(slot, pos):
+                raise RuntimeError(
+                    f"slot {slot}: next decode write at position {pos} "
+                    f"lands in shared/indexed page "
+                    f"{owned[pos // self.page_size]} — missing "
+                    f"copy-on-write")
+
+    def needs_cow(self, slot: int, pos: int) -> bool:
+        """True when the page mapped at token position ``pos`` of
+        ``slot`` is shared (refcount > 1) or indexed — a write there
+        must go through :meth:`cow` first. False for private pages and
+        unmapped positions (growth is ``ensure``'s job, not CoW's)."""
+        owned = self._owned.get(slot, [])
+        idx = pos // self.page_size
+        if idx >= len(owned):
+            return False
+        pid = owned[idx]
+        return self._ref.get(pid, 0) > 1 or pid in self._hash_of
+
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 
     def can_fit(self, slot: int, n_tokens: int) -> bool:
         have = len(self._owned.get(slot, []))
-        return self.pages_for(n_tokens) - have <= len(self._free)
+        return (self.pages_for(n_tokens) - have
+                <= len(self._free) + len(self._parked))
+
+    def _claim_page(self) -> int:
+        """One fresh private page: from the free heap, else by evicting
+        the LRU-oldest parked cache page (its index entries drop — a
+        future lookup simply misses)."""
+        if self._free:
+            # heap pop (lowest page id first): ensure/free run in the
+            # latency-critical inter-segment gap — a list pop(0) is O(n)
+            # per page and the free() re-sort O(n log n) per retirement
+            return heapq.heappop(self._free)
+        if self._parked:
+            pid, _h = self._parked.popitem(last=False)
+            self._unindex(pid)
+            return pid
+        raise RuntimeError("page pool exhausted")
+
+    def _unindex(self, pid: int) -> None:
+        h = self._hash_of.pop(pid, None)
+        if h is not None and self._index.get(h) == pid:
+            del self._index[h]
+        self._tok_of.pop(pid, None)
+        parent = self._parent_of.pop(pid, None)
+        if parent is not None:
+            kids = self._next.get(parent)
+            if kids is not None:
+                kids.discard(pid)
+                if not kids:
+                    del self._next[parent]
+
+    def _release_ref(self, pid: int) -> None:
+        """Drop one reference; at zero the page parks (still indexed)
+        or returns to the free heap."""
+        n = self._ref.get(pid, 0) - 1
+        if n == 1:
+            self._shared -= 1
+        if n > 0:
+            self._ref[pid] = n
+            return
+        self._ref.pop(pid, None)
+        if pid in self._hash_of:
+            self._parked[pid] = self._hash_of[pid]
+            self._parked.move_to_end(pid)
+        else:
+            heapq.heappush(self._free, pid)
 
     def ensure(self, slot: int, n_tokens: int) -> None:
-        """Grow ``slot``'s mapping to cover ``n_tokens`` positions.
-        Raises RuntimeError when the pool is exhausted — the engine's
-        admission control treats that like 'no free slot' and drains."""
+        """Grow ``slot``'s mapping to cover ``n_tokens`` positions with
+        PRIVATE pages (already-mapped pages — shared prefix ones
+        included — count toward coverage). Raises RuntimeError when the
+        pool is exhausted — the engine's admission control treats that
+        like 'no free slot' and drains."""
         owned = self._owned.setdefault(slot, [])
         target = self.pages_for(n_tokens)
         if target > self.page_table.shape[1]:
@@ -238,16 +582,14 @@ class PageAllocator:
         need = target - len(owned)
         if need <= 0:
             return
-        if need > len(self._free):
+        if need > len(self._free) + len(self._parked):
             raise RuntimeError(
                 f"page pool exhausted: slot {slot} needs {need} pages, "
-                f"{len(self._free)} free — drain finished requests or "
-                "grow num_pages")
+                f"{len(self._free) + len(self._parked)} reclaimable — "
+                "drain finished requests or grow num_pages")
         for _ in range(need):
-            # heap pop (lowest page id first): ensure/free run in the
-            # latency-critical inter-segment gap — a list pop(0) is O(n)
-            # per page and the free() re-sort O(n log n) per retirement
-            pid = heapq.heappop(self._free)
+            pid = self._claim_page()
+            self._ref[pid] = 1
             self.page_table[slot, len(owned)] = pid
             owned.append(pid)
         self._publish_occupancy()
@@ -255,10 +597,162 @@ class PageAllocator:
             self.check()
 
     def free_slot(self, slot: int) -> None:
-        """Return the slot's pages to the pool (request retired)."""
+        """Release the slot's references (request retired): private
+        pages return to the pool; shared pages survive for their other
+        referents; indexed pages with no referent left park in the
+        prefix LRU (still a cache hit, reclaimable on demand)."""
         for pid in self._owned.pop(slot, []):
-            heapq.heappush(self._free, pid)
+            self._release_ref(pid)
         self.page_table[slot, :] = -1
+        self._publish_occupancy()
+        if self.debug:
+            self.check()
+
+    # -- prefix cache (content-addressable shared pages) ----------------------
+    def lookup_prefix(self, tokens) -> Tuple[List[int], int, List[bytes]]:
+        """Longest resident cached prefix of ``tokens`` (1-D int ids).
+
+        Walks the full-block chain hash (token-verified per block),
+        then tries ONE partial block: an indexed child of the last
+        matched chain point whose leading tokens extend the match
+        (divergent-suffix / mid-tail sharing — the page the caller must
+        copy-on-write before its first write). Returns
+        ``(pids, coverage, hashes)``: the resident pages to map
+        read-only in order, the token coverage they provide
+        (``<= len(tokens)``), and the full-block chain hashes (for
+        registering the blocks the caller will prefill). Touches the
+        LRU order of parked hits; claims no references —
+        :meth:`map_shared` does."""
+        self.prefix_lookups += 1
+        toks = np.ascontiguousarray(
+            np.asarray(tokens).reshape(-1), np.int32)
+        ps = self.page_size
+        nfull = len(toks) // ps
+        hashes: List[bytes] = []
+        h = _ROOT
+        for b in range(nfull):
+            h = _block_hash(h, toks[b * ps:(b + 1) * ps])
+            hashes.append(h)
+        pids: List[int] = []
+        matched = 0
+        while matched < nfull:
+            pid = self._index.get(hashes[matched])
+            if pid is None or not np.array_equal(
+                    self._tok_of[pid], toks[matched * ps:
+                                            (matched + 1) * ps]):
+                break
+            pids.append(pid)
+            matched += 1
+        cov = matched * ps
+        rem = toks[cov:]
+        if len(rem):
+            parent = hashes[matched - 1] if matched else _ROOT
+            best, best_m = None, 0
+            for pid in self._next.get(parent, ()):
+                bt = self._tok_of.get(pid)
+                if bt is None:
+                    continue
+                lim = min(len(rem), ps)
+                m = 0
+                while m < lim and int(bt[m]) == int(rem[m]):
+                    m += 1
+                if m > best_m:
+                    best, best_m = pid, m
+            if best is not None and best_m > 0:
+                pids.append(best)
+                cov += best_m
+        for pid in pids:
+            if pid in self._parked:
+                self._parked.move_to_end(pid)
+        return pids, cov, hashes
+
+    def map_shared(self, slot: int, pids: List[int]) -> None:
+        """Map resident cached pages read-only into an EMPTY slot's
+        table (refcount++ each; parked pages leave the LRU but stay
+        indexed). Prefill and page claiming skip the coverage these
+        provide; the first write into any of them must go through
+        :meth:`cow`."""
+        if self._owned.get(slot):
+            raise RuntimeError(
+                f"map_shared needs an empty slot, slot {slot} already "
+                f"owns {len(self._owned[slot])} page(s)")
+        if not pids:
+            return
+        owned = self._owned.setdefault(slot, [])
+        for pid in pids:
+            self._parked.pop(pid, None)
+            n = self._ref.get(pid, 0) + 1
+            if n == 2:
+                self._shared += 1
+            self._ref[pid] = n
+            self.page_table[slot, len(owned)] = pid
+            owned.append(pid)
+        self._publish_occupancy()
+        if self.debug:
+            self.check()
+
+    def cow(self, slot: int, page_idx: int) -> Tuple[int, int]:
+        """Copy-on-write bookkeeping for ``slot``'s page at
+        ``page_idx``: claim a fresh private page, swap the table entry,
+        release the old reference (the shared original survives for its
+        other referents / stays parked-indexed). Returns
+        ``(old_pid, new_pid)`` — the caller owns the device-side row
+        copy (:func:`copy_page`) BEFORE any write to the new page."""
+        owned = self._owned[slot]
+        old = owned[page_idx]
+        new = self._claim_page()
+        self._ref[new] = 1
+        owned[page_idx] = new
+        self.page_table[slot, page_idx] = new
+        self._release_ref(old)
+        self.cow_copies += 1
+        self._publish_occupancy()
+        if self.debug:
+            self.check()
+        return old, new
+
+    def register_blocks(self, slot: int, hashes: List[bytes], tokens,
+                        start_block: int, end_block: int) -> None:
+        """Index ``slot``'s fully-written prompt blocks
+        ``[start_block, end_block)`` under their chain hashes so future
+        admissions can map them read-only. Only PRIVATE pages
+        (refcount 1, unindexed) register; an already-taken hash keeps
+        its first page (first writer wins — both hold identical KV)."""
+        if not self.prefix_cache:
+            return
+        owned = self._owned.get(slot, [])
+        toks = np.ascontiguousarray(
+            np.asarray(tokens).reshape(-1), np.int32)
+        ps = self.page_size
+        for b in range(start_block, end_block):
+            if b >= len(owned) or b >= len(hashes):
+                break
+            pid = owned[b]
+            h = hashes[b]
+            if (h in self._index or pid in self._hash_of
+                    or self._ref.get(pid, 0) != 1):
+                continue
+            self._index[h] = pid
+            self._hash_of[pid] = h
+            self._tok_of[pid] = toks[b * ps:(b + 1) * ps].copy()
+            parent = hashes[b - 1] if b else _ROOT
+            self._parent_of[pid] = parent
+            self._next.setdefault(parent, set()).add(pid)
+        if self.debug:
+            self.check()
+
+    def clear_prefix_index(self) -> None:
+        """Drop the whole content index and return parked pages to the
+        free heap (engine ``reset_state``: the pools are rebuilt from
+        zeros, so every cached block's KV is gone)."""
+        for pid in list(self._parked):
+            heapq.heappush(self._free, pid)
+        self._parked.clear()
+        self._index.clear()
+        self._hash_of.clear()
+        self._tok_of.clear()
+        self._parent_of.clear()
+        self._next.clear()
         self._publish_occupancy()
         if self.debug:
             self.check()
@@ -271,15 +765,20 @@ class PageAllocator:
             pages = self._pages_gauge()
             pages.remove(pool=self.monitor_pool, state="free")
             pages.remove(pool=self.monitor_pool, state="used")
+            if self.prefix_cache:
+                pages.remove(pool=self.monitor_pool, state="cached")
             self._occupancy_gauge().remove(pool=self.monitor_pool)
         except Exception:  # teardown-ordering safe
             pass
-        # the reason dimension is open-ended — retire by pool label
+        # open-ended label dimensions — retire by pool label
         try:
             from .. import monitor
 
-            monitor.remove_series("paddle_tpu_kv_preemptions_total",
-                                  pool=self.monitor_pool)
+            for name in ("paddle_tpu_kv_preemptions_total",
+                         "paddle_tpu_kv_prefix_hits_total",
+                         "paddle_tpu_kv_prefix_tokens_saved_total",
+                         "paddle_tpu_kv_shared_pages"):
+                monitor.remove_series(name, pool=self.monitor_pool)
         except Exception:
             pass
 

@@ -44,7 +44,10 @@ Routes:
   ``{"admission_mode", "occupancy", "free_pages",
   "waiting_on_pages", "preemptions"}`` — the KV memory-pressure
   surface that tells "degraded by memory pressure" (occupancy near
-  1.0, preemptions climbing) apart from the stall/fault reason.
+  1.0, preemptions climbing) apart from the stall/fault reason; with
+  the prefix cache on it also carries ``prefix_cache``,
+  ``cached_pages``, ``shared_pages``, ``prefix_hits``,
+  ``prefix_lookups``, and ``prefix_tokens_saved``.
 
 - ``GET /metrics`` / ``GET /metrics.json`` — the monitor package's
   Prometheus / JSON exporters, same payloads as

@@ -462,12 +462,16 @@ class Server:
         reports so an operator can tell "degraded by memory pressure"
         (occupancy near 1.0, preemptions climbing, requests parked
         waiting on pages) apart from the stall/fault ``degraded``
-        reason. Host-side and monitor-independent, like
+        reason. With the prefix cache on the dict also carries
+        ``{"prefix_cache": True, "cached_pages", "shared_pages",
+        "prefix_hits", "prefix_lookups", "prefix_tokens_saved"}``
+        (parked pages are reclaimable capacity, not occupancy).
+        Host-side and monitor-independent, like
         :meth:`fault_stats`."""
         alloc = getattr(self.engine, "alloc", None)
         if alloc is None:
             return None
-        return {
+        out = {
             "admission_mode": getattr(self.engine, "admission_mode",
                                       "reserved"),
             "occupancy": round(alloc.occupancy, 4),
@@ -475,6 +479,20 @@ class Server:
             "waiting_on_pages": self._waiting_on_pages,
             "preemptions": alloc.preemptions,
         }
+        if getattr(alloc, "prefix_cache", False):
+            # prefix-cache surface: parked pages are reclaimable
+            # capacity (free + cached = what admission can claim),
+            # shared counts the refcount>1 multiplier, hits/saved are
+            # lifetime totals
+            out.update({
+                "prefix_cache": True,
+                "cached_pages": alloc.cached_pages,
+                "shared_pages": alloc.shared_pages,
+                "prefix_hits": alloc.prefix_hits,
+                "prefix_lookups": alloc.prefix_lookups,
+                "prefix_tokens_saved": alloc.prefix_tokens_saved,
+            })
+        return out
 
     # -- monitor helpers -----------------------------------------------------
     @staticmethod

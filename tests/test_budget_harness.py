@@ -12,6 +12,13 @@ import subprocess
 import sys
 import time
 
+import pytest
+
+# wall-clock-bound by design (children sleep out real timeout budgets):
+# rides the slow tier (run with -m slow), not tier-1 — moved when the
+# prefix-cache suite (round 11) pushed tier-1 against its 870s timeout
+pytestmark = pytest.mark.slow
+
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "experiments"))
 

@@ -19,6 +19,12 @@ from jax.sharding import PartitionSpec as P
 from paddle_tpu.distributed.sequence_parallel import ring_attention
 from paddle_tpu.distributed.topology import build_mesh, set_mesh
 
+# minutes-scale compile-only memory-analysis proofs (3 tests, ~45s of
+# 256k-1M-token compiles): rides the slow tier (run with -m slow), not
+# tier-1 — moved when the prefix-cache suite (round 11) pushed tier-1
+# against its 870s timeout
+pytestmark = pytest.mark.slow
+
 
 def _compiled(seq, sp, b=1, h=8, d=128, causal=True, dtype=jnp.bfloat16,
               block=1024):

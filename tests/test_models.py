@@ -8,6 +8,12 @@ import pytest
 
 import paddle_tpu as paddle
 
+# The compile-heavy classes (vision zoo sweep, GPT fit loop, remat
+# grad sweep) ride the slow tier — moved when the prefix-cache suite
+# (round 11) pushed tier-1 against its 870s timeout. A GPT forward
+# smoke and the op-tail checks stay tier-1 so a model-path regression
+# still fails the default run.
+
 
 class TestGPT:
     def _model(self):
@@ -27,6 +33,7 @@ class TestGPT:
         loss = m(paddle.to_tensor(ids), paddle.to_tensor(labels))
         assert np.isfinite(float(loss.numpy()))
 
+    @pytest.mark.slow
     def test_train_step_reduces_loss(self):
         from paddle_tpu import optimizer as opt
 
@@ -45,6 +52,7 @@ class TestGPT:
         assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 class TestVisionZoo:
     @pytest.mark.parametrize("name", ["mobilenet_v2", "squeezenet1_0",
                                       "vgg11", "alexnet"])
@@ -93,6 +101,7 @@ class TestOpTail2:
             np.float32), atol=1e-4)
 
 
+@pytest.mark.slow
 class TestRematPolicies:
     """remat="attn_out" (save_only_these_names over the flash output,
     llama_functional._remat_policy) must be grad-exact vs full remat and

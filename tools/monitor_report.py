@@ -92,6 +92,10 @@ SERVING_FAMILIES = (
     "paddle_tpu_kv_preemptions_total",  # memory-pressure preemptions
     #                                     by reason (pressure /
     #                                     unsatisfiable)
+    "paddle_tpu_kv_prefix_",            # prefix-cache hits_total and
+    #                                     tokens_saved_total per pool
+    "paddle_tpu_kv_shared_pages",       # refcount>1 pages (sharing
+    #                                     multiplier) per pool
     "paddle_tpu_prefill_",              # bucket/chunk admissions, warmup
 )
 

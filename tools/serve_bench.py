@@ -43,6 +43,13 @@ Usage::
     python tools/serve_bench.py --num-pages 24 --admission-mode reserved
     python tools/serve_bench.py --num-pages 24 --admission-mode optimistic \
         --kv-watermark 0.9 --max-preemptions 10
+    # automatic prefix caching A/B (PERF.md prefix-caching
+    # methodology): every request shares a 64-token system prompt —
+    # compare TTFT p50/p99, serve_kv_occupancy, and
+    # serve_prefix_hit_rate / serve_prefill_tokens_saved across the
+    # two runs
+    python tools/serve_bench.py --shared-prefix-len 64 --cache-prefixes off
+    python tools/serve_bench.py --shared-prefix-len 64 --cache-prefixes on
 
 Output: one human table plus BENCH-shaped JSON records
 (``{"metric": ..., "value": ..., "unit": ...}``) on stdout. Chaos runs
@@ -213,7 +220,8 @@ def _build_toy_server(args):
         page_size=args.page_size, max_pages=args.max_pages,
         prefill_buckets=buckets, prefill_chunk=args.prefill_chunk,
         admission_mode=args.admission_mode,
-        kv_watermark=args.kv_watermark)
+        kv_watermark=args.kv_watermark,
+        prefix_cache=(args.cache_prefixes == "on"))
     plan = None
     if args.fault_rate > 0:
         from paddle_tpu.inference.generation import EngineFault
@@ -342,6 +350,20 @@ def main(argv=None) -> int:
                     help="memory-pressure preemptions one request may "
                          "absorb before it fails with "
                          "PreemptionBudgetExceeded")
+    # prefix-cache A/B knobs (PERF.md prefix-caching methodology)
+    ap.add_argument("--shared-prefix-len", type=int, default=0,
+                    metavar="N",
+                    help="prepend the SAME N seeded tokens to every "
+                         "prompt (a shared system prompt); the "
+                         "per-request tail still draws from "
+                         "--prompt-len. A/B this against "
+                         "--cache-prefixes on|off")
+    ap.add_argument("--cache-prefixes", choices=("on", "off"),
+                    default="off",
+                    help="enable the paged engine's automatic prefix "
+                         "cache (refcounted copy-on-write shared KV "
+                         "pages): warm admissions map resident prompt "
+                         "blocks instead of re-prefilling them")
     # chaos knobs (in-process mode only; paddle_tpu.testing.faults)
     ap.add_argument("--fault-rate", type=float, default=0.0,
                     help="seeded per-call fault probability at each "
@@ -391,8 +413,15 @@ def main(argv=None) -> int:
     for _ in range(args.requests):
         t += rng.expovariate(args.rate)
         arrivals.append(t)
-    prompts = [[rng.randrange(vocab)
-                for _ in range(_draw_len(rng, args.prompt_dist, lo, hi))]
+    # the shared system prompt is drawn ONCE (seeded) so every request
+    # carries an identical N-token head — the prefix-cache A/B's load
+    # shape; the per-request tail keeps the configured distribution
+    shared_prefix = [rng.randrange(vocab)
+                     for _ in range(args.shared_prefix_len)]
+    prompts = [shared_prefix
+               + [rng.randrange(vocab)
+                  for _ in range(_draw_len(rng, args.prompt_dist,
+                                           lo, hi))]
                for _ in range(args.requests)]
 
     stats = _Stats()
@@ -518,6 +547,32 @@ def main(argv=None) -> int:
                               "value": done, "unit": "count"}))
             print(json.dumps({"metric": "serve_requests_failed",
                               "value": stats.failed, "unit": "count"}))
+        if args.shared_prefix_len > 0 or getattr(alloc, "prefix_cache",
+                                                 False):
+            # prefix-cache A/B: hit rate over lookups (cache off: both
+            # zero — the cold column), prefill tokens whose compute a
+            # warm admission skipped, shared-page high-water via the
+            # pressure surface. Read alongside ttft_p50/p99 and
+            # kv_occupancy above — the win is TTFT down AND occupancy
+            # down at matched load
+            hits = getattr(alloc, "prefix_hits", 0)
+            looks = getattr(alloc, "prefix_lookups", 0)
+            saved = getattr(alloc, "prefix_tokens_saved", 0)
+            rate = hits / looks if looks else 0.0
+            print(f"prefix cache [{args.cache_prefixes}]: "
+                  f"{hits}/{looks} warm admissions "
+                  f"(hit rate {rate:.3f}), {saved} prefill tokens "
+                  f"saved, {getattr(alloc, 'cow_copies', 0)} CoW "
+                  f"copies, {getattr(alloc, 'cached_pages', 0)} pages "
+                  f"parked at exit")
+            print(json.dumps({"metric": "serve_prefix_hit_rate",
+                              "value": round(rate, 4),
+                              "unit": "ratio"}))
+            print(json.dumps({"metric": "serve_prefill_tokens_saved",
+                              "value": saved, "unit": "tokens"}))
+            print(json.dumps({"metric": "serve_prefix_cow_copies",
+                              "value": getattr(alloc, "cow_copies", 0),
+                              "unit": "count"}))
     if plan is not None:
         # chaos accounting: what was injected, what survived, what the
         # supervisor did about it (fault_stats is host-side — readable
