@@ -103,6 +103,17 @@ STEP_TIMEOUT=2400 run python tools/serve_bench.py --shared-prefix-len 448 \
     --cache-prefixes on --num-pages 320 --max-pages 64 --page-size 8 \
     --requests 16 --rate 4 --max-new 8 --segment-steps 2 \
     --prompt-len 4:8 --layers 2 --prefill-chunk 64 --warmup
+# 6d. on-TPU SPECULATIVE SERVING A/B (first hardware numbers for the
+#     batched spec path — every spec-serving number so far is CPU-tiny
+#     and CPU is compute-bound, so its wall ratio is honestly <1x;
+#     decode on TPU is HBM-bound, so serve_spec_tokens_per_forward
+#     should convert into the TPOT ratio here. One invocation runs
+#     both arms on identical load; read serve_tpot_p50_plain/_spec,
+#     serve_spec_tokens_per_forward, serve_spec_acceptance_rate)
+STEP_TIMEOUT=2400 run python tools/serve_bench.py --spec-ab --draft-k 6 \
+    --repeat-unit 4 --layers 2 --prompt-len 28:32 --max-new 32 \
+    --rate 8 --requests 16 --num-pages 64 --max-pages 16 --page-size 8 \
+    --warmup
 # 7. the remaining BASELINE.md configs — one window should produce the
 #    full config table (VERDICT r4 Missing #3). Expected budgets: each
 #    is a small model + cached-compile candidate; ~5-10 min warm,

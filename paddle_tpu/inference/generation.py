@@ -24,12 +24,14 @@ import jax.numpy as jnp
 from .. import monitor
 from ..core.tensor import Tensor
 from ..nn.functional_call import substituted_state
+from .ngram import NgramIndex, NgramProposer
 
 __all__ = ["GenerationConfig", "CausalLMEngine",
            "ContinuousBatchingEngine",
            "PagedContinuousBatchingEngine", "prefill_buckets_for",
            "RequestFault", "EngineFault", "classify_fault",
-           "REQUEST_SITES", "PagePoolExhausted", "ADMISSION_MODES"]
+           "REQUEST_SITES", "PagePoolExhausted", "ADMISSION_MODES",
+           "NgramProposer"]
 
 
 # -- fault taxonomy (serving-path blast-radius classification) ---------------
@@ -203,7 +205,9 @@ class GenerationConfig:
 
     def __init__(self, max_new_tokens: int = 64, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, do_sample: bool = False,
-                 eos_token_id: Optional[int] = None, seed: int = 0):
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 speculative: bool = False,
+                 draft_k: Optional[int] = None):
         INT32_MAX = 2 ** 31 - 1   # engine state is int32 on device; a
         #                           larger value must fail HERE, not
         #                           leak a slot mid-admission
@@ -238,6 +242,15 @@ class GenerationConfig:
         if isinstance(seed, bool) or not isinstance(seed,
                                                    (int, np.integer)):
             raise ValueError(f"seed must be an int, got {seed!r}")
+        if draft_k is not None and (
+                isinstance(draft_k, bool)
+                or not isinstance(draft_k, (int, np.integer))
+                or not 1 <= draft_k <= 256):
+            # 256 is far above any useful draft window; an absurd value
+            # must fail at admission, not compile an absurd program
+            raise ValueError(
+                f"draft_k must be an int in [1, 256] or None "
+                f"(engine default), got {draft_k!r}")
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -246,6 +259,14 @@ class GenerationConfig:
         self.eos_token_id = (None if eos_token_id is None
                              else int(eos_token_id))
         self.seed = int(seed)
+        # speculative decoding opt-in (continuous-batching engines
+        # built with draft_k > 0): greedy requests propose/verify
+        # n-gram drafts per segment step; sampled requests fall back to
+        # plain decode (lossless acceptance needs the argmax target).
+        # draft_k caps THIS request's draft window (None = the
+        # engine's).
+        self.speculative = bool(speculative)
+        self.draft_k = None if draft_k is None else int(draft_k)
 
 
 def _sample(logits, key, cfg: GenerationConfig):
@@ -333,35 +354,10 @@ def _prompt_len(prompt) -> int:
     return _prompt_ids(prompt).shape[1]
 
 
-class _NgramIndex:
-    """Incremental prompt-lookup index: maps each n-gram (n <=
-    ngram_max) to the continuation start of its most recent occurrence.
-    Registration lags one position behind the context tail so the
-    current suffix never matches itself; amortized O(ngram_max) per
-    appended token (a fresh linear scan per proposal would be O(L) of
-    host work per verify step — the latency this path exists to cut)."""
-
-    def __init__(self, ngram_max: int):
-        self.n_max = ngram_max
-        self.maps = {n: {} for n in range(1, ngram_max + 1)}
-        self._reg = 0          # grams ending before this index are in
-
-    def _register_upto(self, ctx, end):
-        for j in range(self._reg, end):
-            for n in range(1, min(self.n_max, j + 1) + 1):
-                self.maps[n][tuple(ctx[j - n + 1:j + 1])] = j + 1
-        self._reg = max(self._reg, end)
-
-    def propose(self, ctx, k: int):
-        L = len(ctx)
-        self._register_upto(ctx, L - 1)   # exclude the current tail
-        for n in range(min(self.n_max, L - 1), 0, -1):
-            start = self.maps[n].get(tuple(ctx[L - n:]))
-            if start is not None:
-                cont = ctx[start:start + k]
-                if cont:
-                    return (cont + [cont[-1]] * (k - len(cont)))[:k]
-        return [ctx[-1]] * k
+# back-compat alias: the n-gram machinery lives in inference/ngram.py
+# now (shared by the offline generate_speculative path and the batched
+# serving engines' per-slot proposers)
+_NgramIndex = NgramIndex
 
 
 class CausalLMEngine:
@@ -571,19 +567,21 @@ class CausalLMEngine:
                 f"exceeds engine max_len({self.max_len})")
         caches = self.model.init_cache(1, self.max_len)
         last_logits, caches = self._run_prefill(ids, caches)
-        ctx = [int(t) for t in ids[0]]
         out = [int(np.argmax(np.asarray(last_logits[0])))]
-        ctx.append(out[0])
+        # per-sequence proposer state (inference/ngram.py): context =
+        # prompt + every emitted token, extended incrementally — the
+        # SAME unit the batched serving engines keep per slot
+        prop = NgramProposer([int(t) for t in ids[0]] + [out[0]],
+                             draft_k, ngram_max)
         pos = plen                      # tokens the CACHE holds
         forwards = 1                    # the prefill
         extra = 0                       # emitted tokens beyond 1/forward
         eos = cfg.eos_token_id
         verify = self._spec_verify_fn(draft_k + 1)
-        ngrams = _NgramIndex(ngram_max)
         while (len(out) < cfg.max_new_tokens
                and (eos is None or out[-1] != eos)
                and pos + 1 + draft_k <= self.max_len):
-            draft = ngrams.propose(ctx, draft_k)
+            draft = prop.propose()
             inp = np.asarray([[out[-1]] + draft], np.int32)
             logits, caches = verify(self.params, inp, caches,
                                     jnp.int32(pos))
@@ -596,7 +594,7 @@ class CausalLMEngine:
             before = len(out)
             for t in accepted:
                 out.append(t)
-                ctx.append(t)
+                prop.extend([t])
                 if (len(out) >= cfg.max_new_tokens
                         or (eos is not None and t == eos)):
                     break
@@ -614,7 +612,7 @@ class CausalLMEngine:
                                  caches, jnp.int32(pos))
             forwards += 1
             out.append(int(np.argmax(np.asarray(logits[0, 0]))))
-            ctx.append(out[-1])
+            prop.extend([out[-1]])
             pos += 1
         # generate() always emits the prefill token, even at budget 0
         budget = max(cfg.max_new_tokens, 1)
@@ -703,7 +701,14 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, max_batch: int, max_len: int,
                  prefill_buckets="auto",
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 draft_k: int = 0, ngram_max: int = 3):
+        if (isinstance(draft_k, bool)
+                or not isinstance(draft_k, (int, np.integer))
+                or not 0 <= draft_k <= 256):
+            raise ValueError(
+                f"draft_k must be an int in [0, 256] (0 disables "
+                f"speculative decoding), got {draft_k!r}")
         self.model = model
         self.max_batch = max_batch
         self.max_len = max_len
@@ -711,6 +716,22 @@ class ContinuousBatchingEngine:
                                                    max_len)
         self.prefill_chunk = _normalize_prefill_chunk(prefill_chunk,
                                                       max_len)
+        # speculative decoding (per-slot capability): draft_k > 0
+        # widens the decode path with ONE extra compiled program (the
+        # (draft_k+1)-token verify step) that spec-opted slots ride;
+        # plain/sampled slots share it at 1 token/step. 0 = the spec
+        # path never compiles and decode_segment is exactly the plain
+        # scan.
+        self.draft_k = int(draft_k)
+        self.ngram_max = int(ngram_max)
+        self._spec = {}                # rid -> NgramProposer (spec rows)
+        # engine-lifetime host accounting (serve_bench / spec_stats):
+        # proposed/accepted draft tokens, verify forwards, per-slot
+        # participations (slot_steps), tokens emitted (spec segments
+        # only)
+        self._spec_totals = {"proposed": 0, "accepted": 0,
+                             "forwards": 0, "slot_steps": 0,
+                             "emitted": 0}
         # engine label: concurrent engines (multi-model serving) publish
         # throughput side by side; retired via close()/__del__
         self._monitor_engine = monitor.instance_label("engine")
@@ -756,7 +777,7 @@ class ContinuousBatchingEngine:
 
         def admit_state(lens, last, done, active, samp, slot, plen,
                         first, tok_done, temp, top_k, top_p, do_samp,
-                        eos, seed):
+                        eos, seed, spec_k):
             # one program for the per-slot scalars AND the request's
             # sampling parameters — admission sits in the
             # latency-critical gap between decode segments, and separate
@@ -769,6 +790,7 @@ class ContinuousBatchingEngine:
                 "sample": samp["sample"].at[slot].set(do_samp),
                 "eos": samp["eos"].at[slot].set(eos),
                 "seed": samp["seed"].at[slot].set(seed),
+                "spec_k": samp["spec_k"].at[slot].set(spec_k),
             }
             return (lens.at[slot].set(plen),
                     last.at[slot].set(first),
@@ -803,6 +825,10 @@ class ContinuousBatchingEngine:
             "sample": jnp.zeros((mb,), bool),
             "eos": jnp.full((mb,), -1, jnp.int32),
             "seed": jnp.zeros((mb,), jnp.int32),
+            # per-slot draft window (0 = plain decode): the widened
+            # verify step caps each row's acceptance at ITS spec_k, so
+            # one compiled program serves any spec/plain/sampled mix
+            "spec_k": jnp.zeros((mb,), jnp.int32),
         }
         self._free = list(range(mb))
 
@@ -886,7 +912,22 @@ class ContinuousBatchingEngine:
             # goes back to the pool before the error propagates
             self._abort_admit(slot)
             raise
+        self._init_spec(rid, ids, first, cfg)
         return self._register(slot, rid, first, tok_done, cfg, t0)
+
+    def _init_spec(self, rid: int, ids, first, cfg) -> None:
+        """Create the request's host-side n-gram proposer (speculative
+        rows only), seeded with prompt + the admission's first token.
+        A replayed/preempted request re-admits ``prompt + generated``
+        as its prompt, so the proposer rebuilds with full context —
+        the index is a pure function of it. Runs BEFORE ``_register``
+        so an immediately-retired request's proposer is popped by
+        ``_retire``, never leaked."""
+        k = self._spec_k_for(cfg)
+        if k > 0:
+            self._spec[rid] = NgramProposer(
+                [int(t) for t in ids[0]] + [int(first)], k,
+                self.ngram_max)
 
     def _sample_first(self, rid: int, last_logits, cfg):
         """Sample the admission's first token from the prompt's
@@ -896,6 +937,19 @@ class ContinuousBatchingEngine:
         tok_done = (jnp.asarray(False) if cfg.eos_token_id is None
                     else first == cfg.eos_token_id)
         return first, tok_done
+
+    def _spec_k_for(self, cfg) -> int:
+        """Draft window for a request under ``cfg`` (0 = plain decode):
+        needs an engine built with ``draft_k > 0``, a ``speculative``
+        opt-in, and a GREEDY request — sampled rows fall back to plain
+        decode (lossless acceptance needs the argmax target). The
+        request's own ``draft_k`` caps the engine's (never widens it —
+        the verify program's width is the engine's compile key)."""
+        if (not self.draft_k or not getattr(cfg, "speculative", False)
+                or cfg.do_sample):
+            return 0
+        k = getattr(cfg, "draft_k", None)
+        return self.draft_k if k is None else min(int(k), self.draft_k)
 
     def _install_state(self, slot: int, plen: int, first, tok_done,
                        cfg) -> None:
@@ -910,7 +964,8 @@ class ContinuousBatchingEngine:
             tok_done, jnp.float32(cfg.temperature),
             jnp.int32(cfg.top_k), jnp.float32(cfg.top_p),
             jnp.asarray(cfg.do_sample), jnp.int32(eos),
-            jnp.int32(cfg.seed % (2 ** 31)))
+            jnp.int32(cfg.seed % (2 ** 31)),
+            jnp.int32(self._spec_k_for(cfg)))
 
     def _register(self, slot: int, rid: int, first, tok_done, cfg,
                   t0: float) -> int:
@@ -996,6 +1051,7 @@ class ContinuousBatchingEngine:
         self._finished[rid] = np.asarray(self._tokens.pop(rid), np.int32)
         del self._budget[rid]
         self._cfg.pop(rid, None)
+        self._spec.pop(rid, None)
         self.active_dev = self.active_dev.at[slot].set(False)
         # drop the slot's sampled flag so an all-greedy batch regains
         # the _sample_rows fast path once sampled requests retire
@@ -1072,6 +1128,7 @@ class ContinuousBatchingEngine:
         self._tokens.clear()
         self._budget.clear()
         self._cfg.clear()
+        self._spec.clear()
         self._finished.clear()
         if monitor.enabled():
             monitor.counter(
@@ -1172,6 +1229,7 @@ class ContinuousBatchingEngine:
             self._abort_admit(adm.slot)
             raise
         adm.closed = True
+        self._init_spec(adm.rid, adm.ids, first, adm.cfg)
         self._register(adm.slot, adm.rid, first, tok_done, adm.cfg,
                        adm.t0)
         return True
@@ -1242,6 +1300,19 @@ class ContinuousBatchingEngine:
                     self.params, self.last, self.lens, self.done_dev,
                     self.active_dev, self.samp, self.caches, key)
             out[f"segment_{segment_steps}"] = time.perf_counter() - t0
+        if self.draft_k:
+            # the widened speculative verify step: with every slot
+            # inactive (live mask all-False) acceptance is 0 and every
+            # KV write drops, so running it only compiles
+            t0 = time.perf_counter()
+            mb = self.max_batch
+            (_, _, self.last, self.lens, self.caches) = \
+                self._spec_step_fn()(
+                    self.params, self.last, self.lens, self.active_dev,
+                    self.samp, self.caches, jax.random.PRNGKey(0),
+                    jnp.zeros((mb, self.draft_k), jnp.int32),
+                    jnp.zeros((mb,), bool), jnp.zeros((mb,), jnp.int32))
+            out[f"spec_step_{self.draft_k}"] = time.perf_counter() - t0
         out.update(self._warmup_prefix())
         out["total"] = time.perf_counter() - t_all
         if monitor.enabled():
@@ -1295,6 +1366,230 @@ class ContinuousBatchingEngine:
                 segment, name="cb_segment", donate_argnums=(6,))
         return self._segment_cache[n_steps]
 
+    # -- batched speculative decoding (per-slot capability) ------------------
+    def _fwd_spec(self, params, inp, caches, lens, live):
+        """W-token verify forward at per-row offsets (cache-layout
+        hook; the paged subclass routes through the page pool)."""
+        from ..core.autograd import no_grad
+
+        with substituted_state(self.model, params), no_grad():
+            logits, caches = self.model.forward_decode_spec(
+                Tensor(inp), caches, lens, live)
+        return (logits.value if isinstance(logits, Tensor) else logits,
+                caches)
+
+    def _spec_step_fn(self):
+        """ONE compiled speculative verify step, keyed on the engine's
+        ``draft_k`` alone: every slot — speculating, plain greedy, or
+        sampled — rides the same program.
+
+        Each row's input window is ``[last, d_0..d_{k-1}]`` (W = k+1
+        positions at its own offset). The forward writes all W K/V
+        rows and returns logits per position; position i's greedy
+        token g_i was computed from the true prefix whenever the
+        drafts matched up to i, so the emitted tokens are ALWAYS
+        ``g_0..g_{n_acc-1}`` — the model's own greedy continuation —
+        and acceptance only decides HOW MANY are sound:
+
+        - ``m`` = leading draft/greedy matches, capped per row at its
+          ``spec_k`` (0 for plain rows → exactly one token per step);
+        - ``n_acc = min(m + 1, lim - lens)`` — ``lim`` is the host's
+          per-row absolute cap (budget + page coverage + max_len), so
+          accepted tokens always have VALID cache writes behind them
+          (writes past coverage/max_len are dropped; the positions
+          whose logits they'd poison are exactly the capped-away
+          ones);
+        - sampled rows take ``_sample_rows`` on position 0 and force
+          ``n_acc = 1`` (their spec_k is 0).
+
+        Rejected-draft K/V past ``lens + n_acc`` is stale by the same
+        convention the offline path documents: every read is
+        length-masked and later writes overwrite it."""
+        key_ = ("spec_step", self.draft_k)
+        if key_ not in self._segment_cache:
+            k = self.draft_k
+
+            def spec_step(params, last, lens, active, samp, caches,
+                          key, drafts, live_in, lim):
+                b = last.shape[0]
+                live = live_in & active & (lens < self.max_len)
+                inp = jnp.concatenate([last[:, None], drafts], axis=1)
+                logits, caches = self._fwd_spec(params, inp, caches,
+                                                lens, live)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                key, sub = jax.random.split(key)
+                g0 = jnp.where(samp["sample"],
+                               _sample_rows(logits[:, 0], sub, samp),
+                               greedy[:, 0])
+                toks = jnp.concatenate([g0[:, None], greedy[:, 1:]],
+                                       axis=1)            # [B, W]
+                iw = jnp.arange(k, dtype=jnp.int32)[None]
+                match = ((drafts == greedy[:, :k])
+                         & (iw < samp["spec_k"][:, None]))
+                m = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
+                                        axis=1), axis=1)
+                n_acc = jnp.minimum(m + 1,
+                                    jnp.maximum(lim - lens, 0))
+                n_acc = jnp.where(live, n_acc, 0)
+                new_last = jnp.where(
+                    n_acc > 0,
+                    toks[jnp.arange(b), jnp.maximum(n_acc - 1, 0)],
+                    last)
+                return toks, n_acc, new_last, lens + n_acc, caches
+
+            self._segment_cache[key_] = monitor.monitored_jit(
+                spec_step, name="cb_spec_step", donate_argnums=(5,))
+        return self._segment_cache[key_]
+
+    def _coverage_limit(self, slot: int) -> int:
+        """Absolute position this slot's cache writes are valid up to
+        (dense slabs: the whole cache; the paged engine reports the
+        slot's mapped pages) — the spec step's per-row acceptance cap,
+        so a window reaching past grown coverage degrades to fewer
+        accepted tokens, never to reads of dropped writes."""
+        return self.max_len
+
+    @staticmethod
+    def _spec_tokens_counter():
+        return monitor.counter(
+            "paddle_tpu_spec_draft_tokens_total",
+            "speculative-decode draft tokens by engine and outcome "
+            "(proposed = host n-gram drafts sent to verification; "
+            "accepted = drafts the model's own greedy continuation "
+            "confirmed — acceptance rate is accepted/proposed)",
+            ("engine", "outcome"))
+
+    def spec_stats(self) -> dict:
+        """Engine-lifetime speculative-decoding accounting, host-side
+        and monitor-independent: proposed/accepted draft tokens,
+        verify forwards, slot participations, tokens emitted (spec
+        segments only — plain segments keep their 1/step cadence).
+
+        ``tokens_per_forward`` is PER-SLOT — ``emitted / slot_steps``,
+        one slot's tokens per verify forward it rode (1.0 = plain
+        cadence; the batch-level tokens/forward would conflate batch
+        size with speculation). At B=1 it reduces to the offline
+        path's ``tokens/forwards`` metric."""
+        t = dict(self._spec_totals)
+        t["acceptance_rate"] = (t["accepted"] / t["proposed"]
+                                if t["proposed"] else 0.0)
+        t["tokens_per_forward"] = (t["emitted"] / t["slot_steps"]
+                                   if t["slot_steps"] else 0.0)
+        return t
+
+    def _decode_segment_spec(self, n_steps: int,
+                             cfg: Optional[GenerationConfig] = None):
+        """Speculative decode segment: ``n_steps`` verify steps of the
+        ONE compiled ``_spec_step_fn`` program, with the host loop in
+        between — propose fresh drafts from each slot's proposer,
+        read back acceptance, stream/cut per slot (budget, eos)
+        exactly like the plain path's collection does.
+
+        The host round-trip per verify step is the price of host-side
+        proposers; each forward yields up to ``spec_k + 1`` tokens for
+        accepting rows, which is the trade this path exists to make
+        (decode is HBM-bound on TPU, so accepted tokens/forward ≈ wall
+        speedup there). Plain and sampled slots ride along at one
+        token per step — a mixed batch never splits programs."""
+        t0 = time.perf_counter()
+        k = self.draft_k
+        mb = self.max_batch
+        fn = self._spec_step_fn()
+        lens_h = np.asarray(self.lens).copy()
+        done_h = np.asarray(self.done_dev)
+        emitted = {rid: [] for rid in self._slot_req.values()}
+        finished = set()
+        base = jax.random.PRNGKey(cfg.seed if cfg is not None else 0)
+        forwards = 0
+        proposed = accepted = slot_steps = 0
+        for _ in range(n_steps):
+            drafts = np.zeros((mb, k), np.int32)
+            live = np.zeros((mb,), bool)
+            lim = np.zeros((mb,), np.int32)
+            for slot, rid in self._slot_req.items():
+                if rid in finished or bool(done_h[slot]):
+                    continue
+                rem = self._budget[rid] - len(emitted[rid])
+                if rem <= 0 or int(lens_h[slot]) >= self.max_len:
+                    continue
+                live[slot] = True
+                lim[slot] = min(int(lens_h[slot]) + rem,
+                                self._coverage_limit(slot),
+                                self.max_len)
+                prop = self._spec.get(rid)
+                if prop is not None:
+                    d = prop.propose()
+                    drafts[slot, :len(d)] = d
+                    proposed += prop.k
+            if not live.any():
+                break
+            slot_steps += int(live.sum())
+            # fresh noise per verify step, like the plain scan's
+            # per-step key split (sampled rows fold their own seed in)
+            self._segments_run += 1
+            key = jax.random.fold_in(base, self._segments_run)
+            toks, n_acc, self.last, self.lens, self.caches = fn(
+                self.params, self.last, self.lens, self.active_dev,
+                self.samp, self.caches, key, jnp.asarray(drafts),
+                jnp.asarray(live), jnp.asarray(lim))
+            forwards += 1
+            toks_h = np.asarray(toks)
+            acc_h = np.asarray(n_acc)
+            for slot, rid in self._slot_req.items():
+                if not live[slot]:
+                    continue
+                na = int(acc_h[slot])
+                lens_h[slot] += na
+                seq = toks_h[slot, :na].tolist()
+                rcfg = self._cfg[rid]
+                if (rcfg.eos_token_id is not None
+                        and rcfg.eos_token_id in seq):
+                    # eos mid-accepted-draft: truncate host-side and
+                    # finish the request — the stale device tail past
+                    # eos dies with the slot's retirement
+                    seq = seq[:seq.index(rcfg.eos_token_id) + 1]
+                    finished.add(rid)
+                emitted[rid].extend(int(t) for t in seq)
+                prop = self._spec.get(rid)
+                if prop is not None:
+                    prop.extend(seq)
+                    acc = max(len(seq) - 1, 0)
+                    prop.accepted += acc
+                    accepted += acc
+        # collection: mirror the plain path's budget/eos retirement
+        total = 0
+        for slot, rid in list(self._slot_req.items()):
+            seq = emitted.get(rid, [])
+            self._tokens[rid].extend(seq)
+            self._budget[rid] -= len(seq)
+            total += len(seq)
+            if (self._budget[rid] <= 0 or rid in finished
+                    or bool(done_h[slot])):
+                self._retire(slot)
+        self._spec_totals["proposed"] += proposed
+        self._spec_totals["accepted"] += accepted
+        self._spec_totals["forwards"] += forwards
+        self._spec_totals["slot_steps"] += slot_steps
+        self._spec_totals["emitted"] += total
+        if monitor.enabled():
+            dt = time.perf_counter() - t0
+            monitor.counter(
+                "paddle_tpu_generated_tokens_total",
+                "tokens generated by the continuous-batching engines "
+                "(admission first-token + decode segments)").inc(total)
+            self._tokens_per_sec_gauge().labels(
+                engine=self._monitor_engine).set(
+                total / dt if dt > 0 else 0.0)
+            if proposed:
+                c = self._spec_tokens_counter()
+                c.labels(engine=self._monitor_engine,
+                         outcome="proposed").inc(proposed)
+                # inc(0) still creates the series: the acceptance rate
+                # stays derivable (accepted/proposed) even at 0
+                c.labels(engine=self._monitor_engine,
+                         outcome="accepted").inc(accepted)
+        return len(self._slot_req)
+
     def decode_segment(self, n_steps: int,
                        cfg: Optional[GenerationConfig] = None):
         """Run ``n_steps`` ragged decode steps over the current slots;
@@ -1310,6 +1605,11 @@ class ContinuousBatchingEngine:
         driver — omitted, the base stream is seeded from 0)."""
         if not self._slot_req:
             return 0
+        if self._spec:
+            # at least one live slot is speculating: the whole batch
+            # rides the ONE widened verify program (plain/sampled rows
+            # at 1 token/step) — host proposers need the per-step loop
+            return self._decode_segment_spec(n_steps, cfg)
         t0 = time.perf_counter()
         # every segment must draw fresh sampling noise even when no
         # request was admitted in between — fold in a segment counter
@@ -1368,7 +1668,8 @@ class ContinuousBatchingEngine:
         # bucket dimension is open-ended, so retire by engine label)
         for name in ("paddle_tpu_prefill_requests_total",
                      "paddle_tpu_prefill_chunks_total",
-                     "paddle_tpu_prefill_warmup_seconds"):
+                     "paddle_tpu_prefill_warmup_seconds",
+                     "paddle_tpu_spec_draft_tokens_total"):
             try:
                 monitor.remove_series(name, engine=self._monitor_engine)
             except Exception:
@@ -1558,7 +1859,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  admission_mode: str = "reserved",
                  kv_watermark: float = 0.9,
                  debug_pages: bool = False,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 draft_k: int = 0, ngram_max: int = 3):
         from .paged_cache import PageAllocator
 
         if admission_mode not in ADMISSION_MODES:
@@ -1593,7 +1895,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         super().__init__(model, max_batch,
                          max_len=max_pages * page_size,
                          prefill_buckets=prefill_buckets,
-                         prefill_chunk=prefill_chunk)
+                         prefill_chunk=prefill_chunk,
+                         draft_k=draft_k, ngram_max=ngram_max)
 
     def _make_caches(self):
         return (self.model.init_paged_cache(self.num_pages,
@@ -1609,6 +1912,27 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 Tensor(tok), pools, pt, lens, live)
         return (logits.value if isinstance(logits, Tensor) else logits,
                 (pools, pt))
+
+    def _fwd_spec(self, params, inp, caches, lens, live):
+        from ..core.autograd import no_grad
+
+        pools, pt = caches
+        with substituted_state(self.model, params), no_grad():
+            logits, pools = self.model.forward_decode_spec_paged(
+                Tensor(inp), pools, pt, lens, live)
+        return (logits.value if isinstance(logits, Tensor) else logits,
+                (pools, pt))
+
+    def _coverage_limit(self, slot: int) -> int:
+        # the spec step may only ACCEPT tokens whose KV writes landed
+        # in mapped pages — cap each row's acceptance at its grown
+        # coverage (writes past it are dropped by the sentinel)
+        return min(self.alloc.covered_tokens(slot), self.max_len)
+
+    def _spec_k_of(self, rid: int) -> int:
+        """Host-side draft window of an ACTIVE request (0 = plain)."""
+        prop = self._spec.get(rid)
+        return 0 if prop is None else prop.k
 
     def _reserved(self, plen: int, cfg) -> int:
         return min(plen + cfg.max_new_tokens, self.max_len)
@@ -1994,8 +2318,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                 key=lambda kv: kv[1]):
             if bool(done[slot]):
                 continue       # frozen rows never write
+            # a SPECULATING row can accept up to spec_k+1 tokens per
+            # verify step, so its per-segment growth target scales by
+            # its window width (still budget-capped: acceptance never
+            # outruns the tokens the host will keep). Draft-scratch
+            # writes past the target drop harmlessly — the spec step
+            # caps acceptance at the grown coverage.
+            w = self._spec_k_of(rid) + 1
             target = min(int(lens[slot])
-                         + min(n_steps, self._budget[rid]),
+                         + min(n_steps * w, self._budget[rid]),
                          self.max_len)
             if self.alloc.can_fit(slot, target):
                 self.alloc.ensure(slot, target)
@@ -2065,10 +2396,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             # private page.
             lens = np.asarray(self.lens)
             done = np.asarray(self.done_dev)
-            for slot in self._slot_req:
+            for slot, rid in self._slot_req.items():
                 if bool(done[slot]):
                     continue
-                self.alloc.check_coverage(slot, int(lens[slot]))
+                # a speculating row's imminent writes span its whole
+                # draft window, not just the next position — the
+                # shared-page (missing-CoW) net must cover all of it
+                self.alloc.check_coverage(
+                    slot, int(lens[slot]),
+                    write_ahead=1 + self._spec_k_of(rid))
         pools, _ = self.caches
         self.caches = (pools, jnp.asarray(self.alloc.page_table))
         return super().decode_segment(n_steps, cfg)

@@ -1,0 +1,98 @@
+"""Prompt-lookup n-gram draft proposer (speculative decoding).
+
+The draft source for LOSSLESS n-gram speculative decoding (prompt
+lookup): continue the longest recent-suffix match found earlier in the
+context. Extracted from ``generate_speculative`` so the OFFLINE path
+(:meth:`CausalLMEngine.generate_speculative`) and the BATCHED serving
+path (per-slot proposers inside the continuous-batching engines'
+speculative decode segments) share one tested unit instead of two
+copies of the suffix-match logic.
+
+Two layers:
+
+- :class:`NgramIndex` — the incremental n-gram -> continuation index
+  over a token list the caller owns;
+- :class:`NgramProposer` — per-SEQUENCE state (the context list + its
+  index): seed it with the prompt, ``extend()`` it with each accepted
+  token as decoding streams, ``propose()`` drafts. This is the object
+  the serving engines keep per request id; a preempted/replayed request
+  simply rebuilds it from ``prompt + generated`` (the index is a pure
+  function of the context).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+__all__ = ["NgramIndex", "NgramProposer"]
+
+
+class NgramIndex:
+    """Incremental prompt-lookup index: maps each n-gram (n <=
+    ngram_max) to the continuation start of its most recent occurrence.
+    Registration lags one position behind the context tail so the
+    current suffix never matches itself; amortized O(ngram_max) per
+    appended token (a fresh linear scan per proposal would be O(L) of
+    host work per verify step — the latency this path exists to cut)."""
+
+    def __init__(self, ngram_max: int):
+        if not isinstance(ngram_max, (int, np.integer)) or ngram_max < 1:
+            raise ValueError(
+                f"ngram_max must be a positive int, got {ngram_max!r}")
+        self.n_max = int(ngram_max)
+        self.maps = {n: {} for n in range(1, self.n_max + 1)}
+        self._reg = 0          # grams ending before this index are in
+
+    def _register_upto(self, ctx, end):
+        for j in range(self._reg, end):
+            for n in range(1, min(self.n_max, j + 1) + 1):
+                self.maps[n][tuple(ctx[j - n + 1:j + 1])] = j + 1
+        self._reg = max(self._reg, end)
+
+    def propose(self, ctx, k: int):
+        """Up to ``k`` draft tokens continuing the longest recent
+        suffix of ``ctx`` seen earlier in ``ctx`` (padded with the last
+        draft — or the tail token on a total miss — to exactly k)."""
+        L = len(ctx)
+        self._register_upto(ctx, L - 1)   # exclude the current tail
+        for n in range(min(self.n_max, L - 1), 0, -1):
+            start = self.maps[n].get(tuple(ctx[L - n:]))
+            if start is not None:
+                cont = ctx[start:start + k]
+                if cont:
+                    return (cont + [cont[-1]] * (k - len(cont)))[:k]
+        return [ctx[-1]] * k
+
+
+class NgramProposer:
+    """One sequence's draft proposer: context (prompt + every accepted
+    token so far) plus its :class:`NgramIndex`, updated INCREMENTALLY
+    as tokens stream — the serving engines call ``extend()`` with each
+    segment step's accepted tokens and ``propose()`` once per verify
+    forward, so per-step host work stays O(ngram_max * k), independent
+    of the context length."""
+
+    def __init__(self, tokens, draft_k: int, ngram_max: int = 3):
+        if not isinstance(draft_k, (int, np.integer)) or draft_k < 1:
+            raise ValueError(
+                f"draft_k must be a positive int, got {draft_k!r}")
+        self.k = int(draft_k)
+        self.ctx: List[int] = [int(t) for t in np.asarray(tokens)
+                               .reshape(-1)]
+        self._index = NgramIndex(ngram_max)
+        # host-side accounting the engines aggregate per segment
+        self.proposed = 0
+        self.accepted = 0
+
+    def extend(self, tokens) -> None:
+        """Append accepted tokens to the context (the index registers
+        them lazily at the next ``propose``)."""
+        self.ctx.extend(int(t) for t in tokens)
+
+    def propose(self, k=None) -> List[int]:
+        """Draft ``k`` (default: this proposer's ``draft_k``) tokens
+        from the current context."""
+        k = self.k if k is None else int(k)
+        self.proposed += k
+        return self._index.propose(self.ctx, k)

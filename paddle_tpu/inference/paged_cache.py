@@ -515,6 +515,12 @@ class PageAllocator:
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 
+    def covered_tokens(self, slot: int) -> int:
+        """Token positions ``slot``'s mapped pages cover (writes past
+        this are silently dropped by :func:`write_tokens` — the
+        speculative verify step caps per-row acceptance here)."""
+        return len(self._owned.get(slot, [])) * self.page_size
+
     def can_fit(self, slot: int, n_tokens: int) -> bool:
         have = len(self._owned.get(slot, []))
         return (self.pages_for(n_tokens) - have

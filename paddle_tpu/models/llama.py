@@ -239,6 +239,122 @@ class LlamaAttention(Layer):
         val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
         return self.o_proj(ctx), (val(kc), val(vc))
 
+    def forward_decode_spec(self, x, cos_full, sin_full, cache, lens,
+                            live):
+        """Speculative VERIFY step over the dense ragged cache: W query
+        positions per row at per-row offsets (x: [B, W, h]; position i
+        of row b sits at absolute position ``lens[b] + i``).
+
+        The serving form of the offline spec-verify forward: all W
+        tokens' K/V are written at their per-row positions first
+        (writes of dead rows or positions past max_len are DROPPED via
+        an out-of-range sentinel, so the step stays one compiled
+        program), then each query position runs the SAME
+        ``gqa_decode_attention`` call the one-token ragged step uses,
+        with its own length ``lens + i + 1`` — so position i attends
+        exactly the history a sequential decode would have, and when
+        the input tokens match the greedy continuation the logits are
+        BITWISE what ``forward_decode_ragged`` would have produced one
+        token at a time. Rejected drafts leave stale KV past the
+        accepted length; every read is length-masked and later writes
+        overwrite it (the offline path's documented convention).
+        """
+        b, w = x.shape[0], x.shape[1]
+        hd = self.config.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        kc0, vc0 = cache
+
+        def attend(qv, kv, vv, kc, vc):
+            max_len = kc.shape[1]
+            pos = lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None]
+            idx = jnp.minimum(pos, max_len - 1)
+            c = cos_full[idx][:, :, None, :]   # [B, W, 1, d2] per row
+            s = sin_full[idx][:, :, None, :]
+            qh = apply_rotary_emb(qv.reshape(b, w, self.num_heads, hd),
+                                  c, s)
+            kh = apply_rotary_emb(kv.reshape(b, w, self.kv_heads, hd),
+                                  c, s)
+            vh = vv.reshape(b, w, self.kv_heads, hd)
+            ar = jnp.arange(b)
+            # dead rows / positions past the cache -> sentinel row
+            # index, dropped (NOT clamped: a clamp would overwrite the
+            # last valid cell with draft garbage)
+            tgt = jnp.where(live[:, None] & (pos < max_len), pos,
+                            max_len)
+            kc = kc.at[ar[:, None], tgt].set(kh.astype(kc.dtype),
+                                             mode="drop")
+            vc = vc.at[ar[:, None], tgt].set(vh.astype(vc.dtype),
+                                             mode="drop")
+            from ..ops._decode import gqa_decode_attention
+
+            lv = live.astype(jnp.int32)
+            # one masked decode attention per window position (W is
+            # small and static — the unroll shares the compiled step):
+            # position i's length is lens + i + 1, exactly the
+            # sequential decode's, so acceptance-matched positions
+            # reduce bitwise-identically to the one-token path
+            ctx = jnp.stack(
+                [gqa_decode_attention(qh[:, i], kc, vc,
+                                      lens + lv * (i + 1))
+                 for i in range(w)], axis=1)       # [B, W, Hq, hd]
+            return ctx.reshape(b, w, self.num_heads * hd), kc, vc
+
+        ctx, kc, vc = apply_op(attend, q, k, v, kc0, vc0,
+                               op_name="spec_attention")
+        val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
+        return self.o_proj(ctx), (val(kc), val(vc))
+
+    def forward_decode_spec_paged(self, x, cos_full, sin_full, cache,
+                                  page_table, lens, live):
+        """Paged twin of :meth:`forward_decode_spec`: W per-row query
+        positions over the shared page pool. Writes to dead rows,
+        unmapped pages, or positions past the table width are DROPPED
+        (the ``write_tokens`` sentinel convention), so a draft window
+        reaching past a slot's grown coverage degrades to fewer
+        accepted tokens instead of corrupting a neighbour's page."""
+        b, w = x.shape[0], x.shape[1]
+        hd = self.config.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        kp0, vp0 = cache
+
+        def attend(qv, kv, vv, kp, vp):
+            ps = kp.shape[1]
+            max_len = page_table.shape[1] * ps
+            pos = lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None]
+            idx = jnp.minimum(pos, max_len - 1)
+            c = cos_full[idx][:, :, None, :]
+            sn = sin_full[idx][:, :, None, :]
+            qh = apply_rotary_emb(qv.reshape(b, w, self.num_heads, hd),
+                                  c, sn)
+            kh = apply_rotary_emb(kv.reshape(b, w, self.kv_heads, hd),
+                                  c, sn)
+            vh = vv.reshape(b, w, self.kv_heads, hd)
+            ar = jnp.arange(b)
+            page = page_table[ar[:, None], idx // ps]       # [B, W]
+            ok = live[:, None] & (page >= 0) & (pos < max_len)
+            page = jnp.where(ok, page, kp.shape[0])
+            kp = kp.at[page, idx % ps].set(kh.astype(kp.dtype),
+                                           mode="drop")
+            vp = vp.at[page, idx % ps].set(vh.astype(vp.dtype),
+                                           mode="drop")
+            from ..ops.paged_attention import paged_decode_mha
+
+            lv = live.astype(jnp.int32)
+            ctx = jnp.stack(
+                [paged_decode_mha(qh[:, i], kp, vp, page_table,
+                                  lens + lv * (i + 1))
+                 for i in range(w)], axis=1)
+            return ctx.reshape(b, w, self.num_heads * hd), kp, vp
+
+        ctx, kp, vp = apply_op(attend, q, k, v, kp0, vp0,
+                               op_name="spec_paged_attention")
+        val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
+        return self.o_proj(ctx), (val(kp), val(vp))
+
     def forward_decode_paged(self, x, cos_full, sin_full, cache,
                              page_table, lens, live):
         """Paged decode step: like forward_decode_ragged but the KV cache
@@ -368,6 +484,24 @@ class LlamaDecoderLayer(Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
 
+    def forward_decode_spec(self, x, cos_full, sin_full, cache, lens,
+                            live):
+        attn, cache = self.self_attn.forward_decode_spec(
+            self.input_layernorm(x), cos_full, sin_full, cache, lens,
+            live)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
+    def forward_decode_spec_paged(self, x, cos_full, sin_full, cache,
+                                  page_table, lens, live):
+        attn, cache = self.self_attn.forward_decode_spec_paged(
+            self.input_layernorm(x), cos_full, sin_full, cache,
+            page_table, lens, live)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
 
 class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
@@ -460,6 +594,40 @@ class LlamaModel(Layer):
             new_caches.append(cache)
         return self.norm(x), new_caches
 
+    def forward_decode_spec(self, input_ids, caches, lens, live):
+        """Speculative verify step (dense ragged cache): input_ids
+        [B, W] at per-row offsets ``lens`` — see
+        LlamaAttention.forward_decode_spec."""
+        cfg = self.config
+        x = self.embed_tokens(input_ids)
+        max_len = caches[0][0].shape[1]
+        cos_full, sin_full = _rope_cos_sin(
+            max_len, cfg.head_dim, cfg.rope_theta,
+            x.value.dtype if isinstance(x, Tensor) else x.dtype)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            x, cache = layer.forward_decode_spec(
+                x, cos_full, sin_full, cache, lens, live)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
+
+    def forward_decode_spec_paged(self, input_ids, caches, page_table,
+                                  lens, live):
+        """Speculative verify step over the page pool — see
+        LlamaAttention.forward_decode_spec_paged."""
+        cfg = self.config
+        x = self.embed_tokens(input_ids)
+        max_len = page_table.shape[1] * caches[0][0].shape[1]
+        cos_full, sin_full = _rope_cos_sin(
+            max_len, cfg.head_dim, cfg.rope_theta,
+            x.value.dtype if isinstance(x, Tensor) else x.dtype)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            x, cache = layer.forward_decode_spec_paged(
+                x, cos_full, sin_full, cache, page_table, lens, live)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
+
 
 class LlamaForCausalLM(Layer):
     IGNORE_INDEX = -100
@@ -523,5 +691,20 @@ class LlamaForCausalLM(Layer):
         """(logits [B, 1, V], new_caches) — paged decode step (page-pool
         KV; see LlamaAttention.forward_decode_paged)."""
         hidden, caches = self.model.forward_decode_paged(
+            input_ids, caches, page_table, lens, live)
+        return self.logits(hidden), caches
+
+    def forward_decode_spec(self, input_ids, caches, lens, live):
+        """(logits [B, W, V], new_caches) — batched speculative verify
+        step at per-row offsets (dense ragged cache)."""
+        hidden, caches = self.model.forward_decode_spec(
+            input_ids, caches, lens, live)
+        return self.logits(hidden), caches
+
+    def forward_decode_spec_paged(self, input_ids, caches, page_table,
+                                  lens, live):
+        """(logits [B, W, V], new_caches) — batched speculative verify
+        step over the page pool."""
+        hidden, caches = self.model.forward_decode_spec_paged(
             input_ids, caches, page_table, lens, live)
         return self.logits(hidden), caches

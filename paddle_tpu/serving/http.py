@@ -13,6 +13,7 @@ Routes:
        "max_new_tokens": 64, "temperature": 1.0, "top_k": 0,
        "top_p": 1.0, "do_sample": false, "eos_token_id": null,
        "seed": 0,                     # GenerationConfig fields
+       "speculative": false, "draft_k": null,  # spec-decode opt-in
        "priority": 0, "timeout_s": null,   # admission deadline
        "stream": false}
 
@@ -68,7 +69,8 @@ from .queue import (DeadlineExpired, RequestCancelled, RequestFailed,
 __all__ = ["serve_http"]
 
 _CFG_FIELDS = ("max_new_tokens", "temperature", "top_k", "top_p",
-               "do_sample", "eos_token_id", "seed")
+               "do_sample", "eos_token_id", "seed", "speculative",
+               "draft_k")
 
 # a /generate body is token ids + a dozen scalars; 8 MB is orders of
 # magnitude above any real request, and an unbounded Content-Length

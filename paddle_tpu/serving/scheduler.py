@@ -174,6 +174,17 @@ class Server:
       priority): a waiting request's effective priority improves one
       level per ``age_after_s`` seconds queued, so low-priority work
       cannot starve forever under sustained high-priority load.
+
+    Speculative-decoding knobs (engines built with ``draft_k > 0`` —
+    see :class:`ContinuousBatchingEngine`):
+
+    - ``draft_k`` — convenience mirror of the engine's draft-window
+      knob (None leaves the engine's own setting); set it before
+      ``warmup`` so the widened verify program pre-compiles;
+    - ``speculative`` — True makes speculation the server DEFAULT for
+      every eligible request (greedy; sampled requests always decode
+      plain). Individual requests opt in/out via
+      ``GenerationConfig.speculative`` regardless.
     """
 
     def __init__(self, engine, max_queue: int = 64,
@@ -187,7 +198,9 @@ class Server:
                  stall_timeout_s: Optional[float] = None,
                  max_preemptions: int = 5,
                  admission_mode: Optional[str] = None,
-                 age_after_s: Optional[float] = None):
+                 age_after_s: Optional[float] = None,
+                 draft_k: Optional[int] = None,
+                 speculative: bool = False):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
                 f"stall_timeout_s must be > 0 or None, got "
@@ -222,6 +235,33 @@ class Server:
                 raise ValueError(
                     "admission_mode can only be set on an idle engine")
             engine.admission_mode = admission_mode
+        if draft_k is not None:
+            # convenience mirror of the engine's speculative-decoding
+            # knob (see ContinuousBatchingEngine draft_k): set before
+            # the scheduler thread starts so warmup pre-compiles the
+            # widened verify program. getattr/setattr so a FaultyEngine
+            # proxy routes to the wrapped engine.
+            if (isinstance(draft_k, bool) or not isinstance(draft_k, int)
+                    or not 0 <= draft_k <= 256):
+                raise ValueError(
+                    f"draft_k must be an int in [0, 256], got "
+                    f"{draft_k!r}")
+            if getattr(engine, "draft_k", None) is None:
+                raise ValueError(
+                    "draft_k needs a continuous-batching engine")
+            if getattr(engine, "_slot_req", None):
+                raise ValueError(
+                    "draft_k can only be set on an idle engine")
+            engine.draft_k = draft_k
+        if speculative and not getattr(engine, "draft_k", 0):
+            raise ValueError(
+                "speculative=True needs an engine built with "
+                "draft_k > 0 (or pass Server(draft_k=...))")
+        # speculative=True makes speculation the server DEFAULT: every
+        # eligible (greedy, not explicitly opted) request decodes
+        # speculatively — the per-request GenerationConfig.speculative
+        # flag still opts individual requests in on a False server
+        self.speculative = bool(speculative)
         self.engine = engine
         self.segment_steps = segment_steps
         self.idle_wait_s = idle_wait_s
@@ -308,6 +348,13 @@ class Server:
         IMMEDIATELY with the reason instead of queueing into a server
         that may never drain."""
         cfg = cfg or GenerationConfig()
+        if (self.speculative and not cfg.do_sample
+                and not cfg.speculative):
+            # server-level default opt-in: copy, never mutate the
+            # caller's config (vars() so future fields carry over)
+            kw = dict(vars(cfg))
+            kw["speculative"] = True
+            cfg = GenerationConfig(**kw)
         plen = _prompt_len(prompt)
         if plen + cfg.max_new_tokens > self.engine.max_len:
             raise ValueError(

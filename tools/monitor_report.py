@@ -97,6 +97,11 @@ SERVING_FAMILIES = (
     "paddle_tpu_kv_shared_pages",       # refcount>1 pages (sharing
     #                                     multiplier) per pool
     "paddle_tpu_prefill_",              # bucket/chunk admissions, warmup
+    "paddle_tpu_spec_",                 # speculative-decode draft tokens
+    #                                     {engine,outcome=proposed|
+    #                                     accepted} — per-engine
+    #                                     acceptance rate is
+    #                                     accepted/proposed
 )
 
 

@@ -50,6 +50,12 @@ Usage::
     # two runs
     python tools/serve_bench.py --shared-prefix-len 64 --cache-prefixes off
     python tools/serve_bench.py --shared-prefix-len 64 --cache-prefixes on
+    # speculative-decoding A/B (PERF.md spec-serving methodology):
+    # repetitive prompts (the accepting case) through the SAME load
+    # twice — plain then speculative — reporting serve_tpot_*_plain /
+    # _spec, serve_spec_tokens_per_forward and the acceptance rate
+    python tools/serve_bench.py --spec-ab --draft-k 6 --repeat-unit 4 \
+        --prompt-len 16:24 --max-new 24 --warmup
 
 Output: one human table plus BENCH-shaped JSON records
 (``{"metric": ..., "value": ..., "unit": ...}``) on stdout. Chaos runs
@@ -197,7 +203,14 @@ def _drive_http(url, prompt, cfg_body, stats):
                  end - t0, n)
 
 
-def _build_toy_server(args):
+# the in-process toy preset's vocab: prompts are drawn BEFORE any
+# server exists (so A/B arms replay identical load), and _run_arm
+# asserts this against the model the server was actually built with —
+# a drifting preset must fail loudly, not clamp token ids silently
+_TOY_VOCAB = 256
+
+
+def _build_toy_server(args, speculative: bool = False):
     import numpy as np  # noqa: F401
 
     import paddle_tpu as paddle
@@ -221,7 +234,8 @@ def _build_toy_server(args):
         prefill_buckets=buckets, prefill_chunk=args.prefill_chunk,
         admission_mode=args.admission_mode,
         kv_watermark=args.kv_watermark,
-        prefix_cache=(args.cache_prefixes == "on"))
+        prefix_cache=(args.cache_prefixes == "on"),
+        draft_k=(args.draft_k if speculative else 0))
     plan = None
     if args.fault_rate > 0:
         from paddle_tpu.inference.generation import EngineFault
@@ -257,7 +271,8 @@ def _build_toy_server(args):
                  max_replays=args.max_replays,
                  max_preemptions=args.max_preemptions,
                  restart_backoff_s=args.restart_backoff,
-                 stall_timeout_s=args.stall_timeout)
+                 stall_timeout_s=args.stall_timeout,
+                 speculative=speculative)
     srv.wait_ready()   # warmup compiles are NOT part of the measured run
     return srv, cfg.vocab_size, plan
 
@@ -364,6 +379,25 @@ def main(argv=None) -> int:
                          "cache (refcounted copy-on-write shared KV "
                          "pages): warm admissions map resident prompt "
                          "blocks instead of re-prefilling them")
+    # speculative-decoding knobs (in-process mode; PERF.md spec-serving
+    # methodology)
+    ap.add_argument("--speculative", choices=("on", "off"),
+                    default="off",
+                    help="serve every greedy request speculatively "
+                         "(per-slot n-gram proposers verified inside "
+                         "the one widened decode-segment program)")
+    ap.add_argument("--draft-k", type=int, default=6,
+                    help="draft window (tokens proposed per verify "
+                         "forward) when speculation is on")
+    ap.add_argument("--spec-ab", action="store_true",
+                    help="A/B mode: run the SAME load twice — plain "
+                         "then speculative — and report serve_tpot_* "
+                         "per arm plus the spec speedup ratio")
+    ap.add_argument("--repeat-unit", type=int, default=0, metavar="N",
+                    help="build each prompt by tiling a seeded N-token "
+                         "unit (self-repetitive text — the n-gram "
+                         "proposer's accepting case; 0 = fully random "
+                         "prompts, the adversarial floor)")
     # chaos knobs (in-process mode only; paddle_tpu.testing.faults)
     ap.add_argument("--fault-rate", type=float, default=0.0,
                     help="seeded per-call fault probability at each "
@@ -396,33 +430,77 @@ def main(argv=None) -> int:
 
     rng = random.Random(args.seed)
     lo, hi = (int(x) for x in args.prompt_len.split(":"))
-    server = None
-    plan = None
-    vocab = 256
-    if args.url is None:
-        from paddle_tpu import monitor
-        monitor.enable()
-        server, vocab, plan = _build_toy_server(args)
-    elif args.fault_rate > 0:
-        print("--fault-rate needs the in-process engine (no --url)",
-              file=sys.stderr)
+    if args.url is not None and (args.fault_rate > 0 or args.spec_ab
+                                 or args.speculative == "on"):
+        print("--fault-rate/--speculative/--spec-ab need the "
+              "in-process engine (no --url)", file=sys.stderr)
         return 2
 
-    # open loop: the full arrival schedule is drawn BEFORE driving
+    # open loop: the full arrival schedule AND every prompt are drawn
+    # BEFORE any server exists, so the --spec-ab arms replay IDENTICAL
+    # load
     arrivals, t = [], 0.0
     for _ in range(args.requests):
         t += rng.expovariate(args.rate)
         arrivals.append(t)
+    vocab = _TOY_VOCAB     # asserted against the model in _run_arm
     # the shared system prompt is drawn ONCE (seeded) so every request
     # carries an identical N-token head — the prefix-cache A/B's load
     # shape; the per-request tail keeps the configured distribution
     shared_prefix = [rng.randrange(vocab)
                      for _ in range(args.shared_prefix_len)]
+
+    def _body(n):
+        # --repeat-unit: self-repetitive prompt bodies (the n-gram
+        # proposer's accepting case); each prompt tiles its OWN seeded
+        # unit so prompts stay distinct across requests
+        if args.repeat_unit > 0 and n > 0:
+            u = [rng.randrange(vocab)
+                 for _ in range(min(args.repeat_unit, n))]
+            return (u * (n // len(u) + 1))[:n]
+        return [rng.randrange(vocab) for _ in range(n)]
+
     prompts = [shared_prefix
-               + [rng.randrange(vocab)
-                  for _ in range(_draw_len(rng, args.prompt_dist,
-                                           lo, hi))]
+               + _body(_draw_len(rng, args.prompt_dist, lo, hi))
                for _ in range(args.requests)]
+
+    arms = ([("plain", False), ("spec", True)] if args.spec_ab
+            else [("", args.speculative == "on")])
+    res = {}
+    for arm, spec_on in arms:
+        res[arm] = _run_arm(args, arm, spec_on, prompts, arrivals)
+    if args.spec_ab:
+        # the A/B verdict: decode cadence and throughput, spec over
+        # plain, on the identical replayed load
+        a, b = res["plain"], res["spec"]
+        if a.get("tpot_p50") and b.get("tpot_p50"):
+            print(json.dumps({"metric": "serve_spec_tpot_p50_speedup",
+                              "value": round(a["tpot_p50"]
+                                             / b["tpot_p50"], 3),
+                              "unit": "x (plain/spec)"}))
+        if a.get("throughput") and b.get("throughput"):
+            print(json.dumps(
+                {"metric": "serve_spec_throughput_speedup",
+                 "value": round(b["throughput"] / a["throughput"], 3),
+                 "unit": "x (spec/plain)"}))
+    return 0
+
+
+def _run_arm(args, arm: str, spec_on: bool, prompts, arrivals) -> dict:
+    """Build one server (in-process mode), drive the pre-drawn load
+    through it, print the table + BENCH records (metric names suffixed
+    ``_<arm>`` in A/B mode), shut down. Returns the numbers the A/B
+    verdict needs."""
+    sfx = f"_{arm}" if arm else ""
+    server = None
+    plan = None
+    if args.url is None:
+        from paddle_tpu import monitor
+        monitor.enable()
+        monitor.reset()    # per-arm program/compile counters
+        server, vocab, plan = _build_toy_server(args, spec_on)
+        assert vocab == _TOY_VOCAB, \
+            f"toy model vocab {vocab} != {_TOY_VOCAB} the prompts used"
 
     stats = _Stats()
     # KV pool occupancy sampler (in-process paged engine): the
@@ -470,7 +548,7 @@ def main(argv=None) -> int:
         occ_th.join(timeout=2.0)
 
     done = len(stats.e2e)
-    print(f"\n{done}/{args.requests} completed, "
+    print(f"\n[{arm or 'run'}] {done}/{args.requests} completed, "
           f"{stats.rejected} rejected, {stats.failed} failed, "
           f"{stats.tokens} tokens in {wall:.2f}s "
           f"({stats.tokens / wall:.1f} tok/s)\n")
@@ -487,13 +565,13 @@ def main(argv=None) -> int:
         if not xs:
             continue   # NaN is not valid JSON; the table above shows it
         for q in (50, 90, 99):
-            print(json.dumps({"metric": f"serve_{name}_p{q}",
+            print(json.dumps({"metric": f"serve_{name}_p{q}{sfx}",
                               "value": round(_percentile(xs, q), 6),
                               "unit": unit}))
-    print(json.dumps({"metric": "serve_throughput",
+    print(json.dumps({"metric": f"serve_throughput{sfx}",
                       "value": round(stats.tokens / wall, 2),
                       "unit": "tokens/s"}))
-    print(json.dumps({"metric": "serve_rejected",
+    print(json.dumps({"metric": f"serve_rejected{sfx}",
                       "value": stats.rejected, "unit": "count"}))
     if server is not None:
         # the bucketing win in the methodology: how many prefill
@@ -504,11 +582,11 @@ def main(argv=None) -> int:
         print(f"prefill programs compiled: {pre_n} "
               f"({pre_s:.2f}s) for {n_lens} distinct prompt lengths; "
               f"all jit programs: {all_n} ({all_s:.2f}s)")
-        print(json.dumps({"metric": "serve_prefill_programs",
+        print(json.dumps({"metric": f"serve_prefill_programs{sfx}",
                           "value": pre_n, "unit": "count"}))
-        print(json.dumps({"metric": "serve_prefill_compile_seconds",
+        print(json.dumps({"metric": f"serve_prefill_compile_seconds{sfx}",
                           "value": round(pre_s, 4), "unit": "s"}))
-        print(json.dumps({"metric": "serve_distinct_prompt_lens",
+        print(json.dumps({"metric": f"serve_distinct_prompt_lens{sfx}",
                           "value": n_lens, "unit": "count"}))
     if alloc is not None:
         # memory-pressure accounting: the utilization/throughput A/B
@@ -523,15 +601,15 @@ def main(argv=None) -> int:
               f"p50={occ50:.3f} p99={occ99:.3f}, {pre} preemptions, "
               f"{n_pre} requests preempted >= once")
         if occ_samples:
-            print(json.dumps({"metric": "serve_kv_occupancy_p50",
+            print(json.dumps({"metric": f"serve_kv_occupancy_p50{sfx}",
                               "value": round(occ50, 4),
                               "unit": "ratio"}))
-            print(json.dumps({"metric": "serve_kv_occupancy_p99",
+            print(json.dumps({"metric": f"serve_kv_occupancy_p99{sfx}",
                               "value": round(occ99, 4),
                               "unit": "ratio"}))
-        print(json.dumps({"metric": "serve_kv_preemptions",
+        print(json.dumps({"metric": f"serve_kv_preemptions{sfx}",
                           "value": pre, "unit": "count"}))
-        print(json.dumps({"metric": "serve_preempted_requests",
+        print(json.dumps({"metric": f"serve_preempted_requests{sfx}",
                           "value": n_pre, "unit": "count"}))
         n_clean = len(stats.e2e) - n_pre
         if n_pre and n_clean:
@@ -539,13 +617,13 @@ def main(argv=None) -> int:
                        - (sum(stats.e2e) - sum(stats.e2e_preempted))
                        / n_clean)
             print(json.dumps(
-                {"metric": "serve_preempted_latency_penalty",
+                {"metric": f"serve_preempted_latency_penalty{sfx}",
                  "value": round(penalty, 6), "unit": "s"}))
         if plan is None:
             # chaos runs emit these below from fault accounting
-            print(json.dumps({"metric": "serve_requests_survived",
+            print(json.dumps({"metric": f"serve_requests_survived{sfx}",
                               "value": done, "unit": "count"}))
-            print(json.dumps({"metric": "serve_requests_failed",
+            print(json.dumps({"metric": f"serve_requests_failed{sfx}",
                               "value": stats.failed, "unit": "count"}))
         if args.shared_prefix_len > 0 or getattr(alloc, "prefix_cache",
                                                  False):
@@ -565,14 +643,38 @@ def main(argv=None) -> int:
                   f"saved, {getattr(alloc, 'cow_copies', 0)} CoW "
                   f"copies, {getattr(alloc, 'cached_pages', 0)} pages "
                   f"parked at exit")
-            print(json.dumps({"metric": "serve_prefix_hit_rate",
+            print(json.dumps({"metric": f"serve_prefix_hit_rate{sfx}",
                               "value": round(rate, 4),
                               "unit": "ratio"}))
-            print(json.dumps({"metric": "serve_prefill_tokens_saved",
+            print(json.dumps({"metric": f"serve_prefill_tokens_saved{sfx}",
                               "value": saved, "unit": "tokens"}))
-            print(json.dumps({"metric": "serve_prefix_cow_copies",
+            print(json.dumps({"metric": f"serve_prefix_cow_copies{sfx}",
                               "value": getattr(alloc, "cow_copies", 0),
                               "unit": "count"}))
+    spec_stats = (getattr(server.engine, "spec_stats", None)
+                  if server is not None else None)
+    if spec_stats is not None and getattr(server.engine, "draft_k", 0):
+        # speculative-decoding accounting (spec arm / --speculative
+        # on): accepted-tokens-per-forward is the number that converts
+        # into TPOT on HBM-bound hardware; acceptance rate says how
+        # well the n-gram proposer fit this load. CPU-tiny runs
+        # measure the MECHANISM (the host proposer round-trip
+        # dominates there), not the speedup — see PERF.md.
+        ss = spec_stats()
+        print(f"speculative [draft_k={args.draft_k}]: "
+              f"{ss['emitted']} tokens / {ss['slot_steps']} slot-"
+              f"forwards ({ss['forwards']} verify steps) = "
+              f"{ss['tokens_per_forward']:.2f} tok/fwd per slot, "
+              f"acceptance {ss['accepted']}/{ss['proposed']} "
+              f"= {ss['acceptance_rate']:.3f}")
+        print(json.dumps({"metric": f"serve_spec_tokens_per_forward{sfx}",
+                          "value": round(ss["tokens_per_forward"], 4),
+                          "unit": "tokens/forward"}))
+        print(json.dumps({"metric": f"serve_spec_acceptance_rate{sfx}",
+                          "value": round(ss["acceptance_rate"], 4),
+                          "unit": "ratio"}))
+        print(json.dumps({"metric": f"serve_spec_draft_tokens{sfx}",
+                          "value": ss["proposed"], "unit": "tokens"}))
     if plan is not None:
         # chaos accounting: what was injected, what survived, what the
         # supervisor did about it (fault_stats is host-side — readable
@@ -583,29 +685,36 @@ def main(argv=None) -> int:
               f"({args.fault_kind} @ {args.fault_site}), "
               f"{done} requests survived, {stats.failed} failed, "
               f"{fs['restarts']} engine restarts")
-        print(json.dumps({"metric": "serve_faults_injected",
+        print(json.dumps({"metric": f"serve_faults_injected{sfx}",
                           "value": len(plan.injected),
                           "unit": "count"}))
-        print(json.dumps({"metric": "serve_requests_survived",
+        print(json.dumps({"metric": f"serve_requests_survived{sfx}",
                           "value": done, "unit": "count"}))
-        print(json.dumps({"metric": "serve_requests_failed",
+        print(json.dumps({"metric": f"serve_requests_failed{sfx}",
                           "value": stats.failed, "unit": "count"}))
-        print(json.dumps({"metric": "serve_restarts",
+        print(json.dumps({"metric": f"serve_restarts{sfx}",
                           "value": fs["restarts"], "unit": "count"}))
         for q in (50, 90, 99):
             if rec:
                 print(json.dumps(
-                    {"metric": f"serve_recovery_p{q}",
+                    {"metric": f"serve_recovery_p{q}{sfx}",
                      "value": round(_percentile(rec, q), 6),
                      "unit": "s"}))
 
     if server is not None:
         if args.monitor_out:
             from paddle_tpu import monitor
-            n = monitor.write_jsonl(args.monitor_out)
-            print(f"wrote {n} monitor samples to {args.monitor_out}")
+            path = args.monitor_out + sfx
+            n = monitor.write_jsonl(path)
+            print(f"wrote {n} monitor samples to {path}")
         server.shutdown(drain=False)
-    return 0
+    return {
+        "tpot_p50": (_percentile(stats.tpot, 50) if stats.tpot
+                     else None),
+        "ttft_p50": (_percentile(stats.ttft, 50) if stats.ttft
+                     else None),
+        "throughput": (stats.tokens / wall if wall > 0 else None),
+    }
 
 
 if __name__ == "__main__":
