@@ -114,6 +114,21 @@ STEP_TIMEOUT=2400 run python tools/serve_bench.py --spec-ab --draft-k 6 \
     --repeat-unit 4 --layers 2 --prompt-len 28:32 --max-new 32 \
     --rate 8 --requests 16 --num-pages 64 --max-pages 16 --page-size 8 \
     --warmup
+# 6e. on-TPU TRACE CAPTURE + tracing-overhead A/B (first hardware
+#     numbers for paddle_tpu.tracing): the Chrome-trace artifact gives
+#     the first real per-phase TTFT decomposition on-chip
+#     (serve_ttft_queue/prefill/gap_p50 — CPU-tiny gap shares are
+#     prefill-dominated and say nothing about HBM-bound decode), and
+#     the --trace-ab serve_trace_tpot_overhead record decides whether
+#     tracing can default ON for serving configs (target: <= 1.02x).
+#     Commit experiments/serve_trace_tpu.json with the session log.
+STEP_TIMEOUT=2400 run python tools/serve_bench.py \
+    --trace-out experiments/serve_trace_tpu.json --layers 2 \
+    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
+    --num-pages 64 --max-pages 16 --page-size 8 --warmup
+STEP_TIMEOUT=2400 run python tools/serve_bench.py --trace-ab --layers 2 \
+    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
+    --num-pages 64 --max-pages 16 --page-size 8 --warmup
 # 7. the remaining BASELINE.md configs — one window should produce the
 #    full config table (VERDICT r4 Missing #3). Expected budgets: each
 #    is a small model + cached-compile candidate; ~5-10 min warm,

@@ -42,6 +42,7 @@ define_flag("FLAGS_flash_head_batched", False)    # BSHD-native flash (opt-in un
 define_flag("FLAGS_use_autotune", True)            # kernel autotune cache (ops/autotune.py)
 define_flag("FLAGS_log_level", 0)
 define_flag("FLAGS_enable_monitor", False)         # paddle_tpu.monitor metrics registry
+define_flag("FLAGS_enable_trace", False)           # paddle_tpu.tracing request recorder
 
 
 def get_flags(flags: Union[str, List[str]]):
@@ -63,3 +64,7 @@ def set_flags(flags: Dict[str, Any]):
         from ..monitor import _sync_enabled
 
         _sync_enabled(bool(flags["FLAGS_enable_monitor"]))
+    if "FLAGS_enable_trace" in flags:
+        from ..tracing import _sync_enabled as _sync_trace
+
+        _sync_trace(bool(flags["FLAGS_enable_trace"]))

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import monitor
+from .. import tracing as trace
 from ..core.tensor import Tensor
 from ..nn.functional_call import substituted_state
 from .ngram import NgramIndex, NgramProposer
@@ -1018,6 +1019,11 @@ class ContinuousBatchingEngine:
         width = self._prefill_width(plen)
         self._count_prefill(width if self.prefill_buckets is not None
                             else "exact")
+        if trace.enabled():
+            # the bucket CHOICE is the observable that explains a
+            # prefill's latency class (compiled-program width)
+            trace.event("engine.prefill", engine=self._monitor_engine,
+                        plen=plen, bucket=width)
         return self._prefill(self.params, _pad_ids(ids, width), mini,
                              jnp.int32(plen - 1))
 
@@ -1588,6 +1594,16 @@ class ContinuousBatchingEngine:
                 # stays derivable (accepted/proposed) even at 0
                 c.labels(engine=self._monitor_engine,
                          outcome="accepted").inc(accepted)
+        if trace.enabled():
+            # per-segment speculative accounting: acceptance explains
+            # why a segment's emitted count beat (or matched) its
+            # verify-forward count
+            trace.record(
+                "engine.spec_segment",
+                dur_ns=int((time.perf_counter() - t0) * 1e9),
+                engine=self._monitor_engine, steps=n_steps,
+                forwards=forwards, proposed=proposed,
+                accepted=accepted, emitted=total)
         return len(self._slot_req)
 
     def decode_segment(self, n_steps: int,
@@ -1610,6 +1626,7 @@ class ContinuousBatchingEngine:
             # rides the ONE widened verify program (plain/sampled rows
             # at 1 token/step) — host proposers need the per-step loop
             return self._decode_segment_spec(n_steps, cfg)
+        n_live = len(self._slot_req)
         t0 = time.perf_counter()
         # every segment must draw fresh sampling noise even when no
         # request was admitted in between — fold in a segment counter
@@ -1646,6 +1663,12 @@ class ContinuousBatchingEngine:
             self._tokens_per_sec_gauge().labels(
                 engine=self._monitor_engine).set(
                 emitted / dt if dt > 0 else 0.0)
+        if trace.enabled():
+            trace.record(
+                "engine.segment",
+                dur_ns=int((time.perf_counter() - t0) * 1e9),
+                engine=self._monitor_engine, steps=n_steps,
+                active=n_live, emitted=emitted)
         return len(self._slot_req)
 
     @staticmethod
@@ -2051,6 +2074,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         mini = self.model.init_cache(1, self.max_len)
         mini = self._gather_mini(mini, pids)
         self._count_prefill("warm")
+        if trace.enabled():
+            trace.event("engine.prefill", engine=self._monitor_engine,
+                        plen=plen, bucket="warm", cached=c_cmp)
         tail_ids = _pad_ids(ids[:, c_cmp:], wt)
         last_logits, mini = self._prefill_chunk(
             self.params, tail_ids, mini, jnp.int32(c_cmp),
@@ -2336,6 +2362,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # may skip its re-check until the slot set changes (_register) or
         # the segment runs (lens advance)
         self._growth_stamp = n_steps if not short else None
+        if short and trace.enabled():
+            # ENGINE rids (not serving trace keys): the pool could not
+            # cover these rows' growth — the preemptions that follow in
+            # the flight ring are this event's consequence
+            trace.event("engine.grow_short",
+                        engine=self._monitor_engine,
+                        engine_rids=tuple(short),
+                        free_pages=self.alloc.free_pages)
         return short
 
     def preempt_request(self, rid: int, reason: str = "pressure"):

@@ -370,6 +370,11 @@ class PageAllocator:
         admission, AFTER the shared mapping succeeded)."""
         self.prefix_hits += 1
         self.prefix_tokens_saved += int(tokens_saved)
+        from .. import tracing as _trace
+
+        if _trace.enabled():
+            _trace.event("prefix.hit", pool=self.monitor_pool,
+                         tokens_saved=int(tokens_saved))
         from .. import monitor
 
         if monitor.enabled():
@@ -538,6 +543,14 @@ class PageAllocator:
         if self._parked:
             pid, _h = self._parked.popitem(last=False)
             self._unindex(pid)
+            from .. import tracing as _trace
+
+            if _trace.enabled():
+                # LRU eviction of a parked cache page: future lookups
+                # for its block will MISS — the event that explains a
+                # hit-rate drop under pool pressure
+                _trace.event("prefix.evict", pool=self.monitor_pool,
+                             page=pid)
             return pid
         raise RuntimeError("page pool exhausted")
 
@@ -567,6 +580,11 @@ class PageAllocator:
         if pid in self._hash_of:
             self._parked[pid] = self._hash_of[pid]
             self._parked.move_to_end(pid)
+            from .. import tracing as _trace
+
+            if _trace.enabled():
+                _trace.event("prefix.park", pool=self.monitor_pool,
+                             page=pid)
         else:
             heapq.heappush(self._free, pid)
 
@@ -712,6 +730,11 @@ class PageAllocator:
         self.page_table[slot, page_idx] = new
         self._release_ref(old)
         self.cow_copies += 1
+        from .. import tracing as _trace
+
+        if _trace.enabled():
+            _trace.event("prefix.cow", pool=self.monitor_pool,
+                         slot=slot, old=old, new=new)
         self._publish_occupancy()
         if self.debug:
             self.check()

@@ -22,7 +22,30 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SortedKeys", "SummaryView"]
+           "SortedKeys", "SummaryView", "write_chrome_trace"]
+
+
+def write_chrome_trace(path: str, events: List[dict],
+                       other: Optional[Dict[str, Any]] = None) -> str:
+    """Shared catapult-JSON writer (reference chrometracing_logger.cc
+    contract: ``ph=X`` complete events with ts/dur in µs,
+    ``displayTimeUnit: ms``). ``events`` are pre-built traceEvent
+    dicts; the profiler's span export and the request-lifecycle
+    recorder (``paddle_tpu.tracing`` — both its chrome export and its
+    flight-recorder dumps) all write through here, so every trace
+    artifact this framework produces opens in chrome://tracing and
+    Perfetto alike. ``other`` lands under ``otherData`` (the flight
+    recorder records its dump reason there). Returns ``path``."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    doc: Dict[str, Any] = {"traceEvents": events,
+                           "displayTimeUnit": "ms"}
+    if other:
+        doc["otherData"] = other
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
 
 
 class ProfilerState(Enum):
@@ -294,13 +317,7 @@ class Profiler:
                 "ts": start / 1e3, "dur": (end - start) / 1e3,
                 "pid": os.getpid(), "tid": tid,
             })
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump({"traceEvents": events,
-                       "displayTimeUnit": "ms"}, f)
-        return path
+        return write_chrome_trace(path, events)
 
     def summary(self, sorted_by: SortedKeys = SortedKeys.CPUTotal,
                 op_detail: bool = True, thread_sep: bool = False,

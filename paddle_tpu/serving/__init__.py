@@ -52,6 +52,16 @@ request the pool cannot hold even alone fails alone with
 typed cause. ``Server.pressure()`` / the ``/healthz`` ``pressure``
 field expose occupancy, waiting-on-pages, and the preemption total.
 
+Tracing & flight recorder (README "Tracing & flight recorder"): with
+``FLAGS_enable_trace`` on, every lifecycle seam records a structured
+event into ``paddle_tpu.tracing``'s bounded ring — read one request's
+timeline via ``RequestHandle.timeline()`` /
+``Server.request_timeline(rid)`` / HTTP ``GET /trace?rid=``, export
+Chrome-trace/Perfetto JSON, and collect the automatic flight-recorder
+dumps (engine faults, watchdog ``degraded`` flips, preemption storms)
+from ``Server.fault_stats()["flight_dumps"]`` or ``/healthz``'s
+``flight_dump`` field.
+
 Quick start::
 
     import paddle_tpu.serving as serving

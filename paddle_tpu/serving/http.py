@@ -54,6 +54,16 @@ Routes:
   Prometheus / JSON exporters, same payloads as
   ``monitor.start_http_server`` (one scrape endpoint per serving
   process).
+
+- ``GET /trace?rid=N`` — one request's ordered lifecycle timeline
+  (``paddle_tpu.tracing``; ``rid`` is the public ``request_id`` the
+  ``/generate`` response carried): queue → admit (bucket) → segments →
+  (preempt → replay …) → finish, as JSON event dicts. Without ``rid``
+  returns the newest buffered events (bounded). 404 with a reason
+  while ``FLAGS_enable_trace`` is off — there is no recorder to read.
+  When the flight recorder has fired (engine fault / stall / preemption
+  storm), ``/healthz`` carries the newest dump path as
+  ``flight_dump``.
 """
 from __future__ import annotations
 
@@ -62,6 +72,7 @@ import threading
 from typing import Optional
 
 from .. import monitor
+from .. import tracing as trace
 from ..inference.generation import GenerationConfig
 from .queue import (DeadlineExpired, RequestCancelled, RequestFailed,
                     RequestRejected)
@@ -157,8 +168,16 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                     pressure = pressure()
                 if pressure is not None:
                     body["pressure"] = pressure
+                # flight-recorder surface: the newest black-box dump
+                # path, so whoever watches health knows where the
+                # postmortem evidence landed
+                dumps = getattr(server, "flight_dumps", None)
+                if dumps:
+                    body["flight_dump"] = dumps[-1]
                 self._json(200 if status in ("ok", "draining") else 503,
                            body)
+            elif self.path.startswith("/trace"):
+                self._trace_response()
             elif (payload := monitor.http_payload(self.path)) is not None:
                 body, ctype = payload
                 self.send_response(200)
@@ -168,6 +187,31 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                 self.wfile.write(body)
             else:
                 self._json(404, {"error": f"no route {self.path}"})
+
+        def _trace_response(self) -> None:
+            from urllib.parse import parse_qs, urlsplit
+
+            if not trace.enabled():
+                self._json(404, {
+                    "error": "tracing disabled — enable with "
+                             "FLAGS_enable_trace=1 / "
+                             "paddle_tpu.tracing.enable()"})
+                return
+            q = parse_qs(urlsplit(self.path).query)
+            rid = q.get("rid", [None])[0]
+            if rid is None:
+                evs = trace.events(limit=256)
+                self._json(200, {"events": evs, "n": len(evs)})
+                return
+            try:
+                rid_i = int(rid)
+            except ValueError:
+                self._json(400, {"error": f"rid must be an int "
+                                          f"request id, got {rid!r}"})
+                return
+            self._json(200, {
+                "request_id": rid_i,
+                "events": server.request_timeline(rid_i)})
 
         def do_POST(self):
             if not self.path.startswith("/generate"):

@@ -22,6 +22,8 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from .. import tracing as trace
+
 __all__ = [
     "RequestHandle", "RequestQueue", "RequestRejected", "QueueFull",
     "RequestCancelled", "DeadlineExpired", "RequestFailed",
@@ -127,6 +129,11 @@ class RequestHandle:
         self._replays = 0
         self._preempts = 0
         self._engine_base = 0
+        # trace key (paddle_tpu.tracing): the serving scheduler stamps
+        # "<server_label>:<id>" at submit so concurrent servers' request
+        # ids never collide in the process-wide ring; a bare handle
+        # (tests driving the queue directly) traces under its raw id
+        self._trace_rid = None
 
     # -- client surface ------------------------------------------------------
     @property
@@ -153,6 +160,19 @@ class RequestHandle:
     def tokens_so_far(self) -> List[int]:
         with self._cv:
             return list(self._tokens)
+
+    def timeline(self) -> List[dict]:
+        """This request's ordered trace-event timeline (see
+        ``paddle_tpu.tracing``): queue → admit → segments →
+        (preempt → replay …) → finish, assembled on demand from the
+        process-wide ring. Requires tracing to have been ENABLED while
+        the request ran (``FLAGS_enable_trace``); returns ``[]``
+        otherwise, and may be partial for a long-finished request (the
+        ring is bounded). The timeline is keyed by the HANDLE id, not
+        the engine rid, so it survives preempt-replay and engine
+        restarts."""
+        return trace.timeline(self._trace_rid if self._trace_rid
+                              is not None else self.id)
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block until terminal; returns generated ids [n] (np.int32).
@@ -216,7 +236,14 @@ class RequestHandle:
                 self.first_token_ts = time.monotonic()
             self._tokens.extend(int(t) for t in tokens)
             self._cv.notify_all()
-            return first
+        if first and trace.enabled():
+            # the TTFT edge: serve_bench's trace-derived decomposition
+            # splits submit->here into queue + prefill + gap shares
+            trace.event("first_token",
+                        rid=(self._trace_rid if self._trace_rid
+                             is not None else self.id),
+                        n=len(tokens))
+        return first
 
     def _finish(self, status: str,
                 error: Optional[BaseException] = None) -> None:
@@ -226,7 +253,17 @@ class RequestHandle:
             self._status = status
             self._error = error
             self.finish_ts = time.monotonic()
+            n = len(self._tokens)
             self._cv.notify_all()
+        if trace.enabled():
+            # one choke point covers EVERY terminal (finished /
+            # cancelled / expired / failed) — the timeline's last event
+            attrs = {"status": status, "n_tokens": n}
+            if error is not None:
+                attrs["error"] = repr(error)
+            trace.event("finish",
+                        rid=(self._trace_rid if self._trace_rid
+                             is not None else self.id), **attrs)
 
     def _mark_running(self, engine_rid: int) -> None:
         with self._cv:

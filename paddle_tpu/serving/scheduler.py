@@ -72,6 +72,7 @@ from typing import Optional
 import numpy as np
 
 from .. import monitor
+from .. import tracing as trace
 from ..inference.generation import (ADMISSION_MODES, GenerationConfig,
                                     PagePoolExhausted, _prompt_ids,
                                     _prompt_len, classify_fault)
@@ -185,7 +186,29 @@ class Server:
       every eligible request (greedy; sampled requests always decode
       plain). Individual requests opt in/out via
       ``GenerationConfig.speculative`` regardless.
+
+    Tracing & flight recorder (``paddle_tpu.tracing``, enabled via
+    ``FLAGS_enable_trace``): every lifecycle seam the scheduler drives
+    records a structured event keyed by the request — queue
+    enqueue/dequeue/expire, the admission span (with the prefill
+    bucket) and each chunked-prefill chunk, gap and pressure-relief
+    spans, decode segments (with the live request set), preempt /
+    replay / restart / backoff, and fault classification. Read one
+    request's ordered timeline via ``handle.timeline()`` /
+    :meth:`request_timeline` / HTTP ``GET /trace?rid=``. The scheduler
+    AUTO-DUMPS the trace ring (the flight recorder) on engine-scoped
+    faults, watchdog ``degraded`` flips, and preemption storms
+    (>= ``STORM_PREEMPTS`` preemptions within ``STORM_WINDOW_S``
+    seconds); dump paths surface in :meth:`fault_stats` under
+    ``flight_dumps`` and as ``/healthz``'s ``flight_dump`` field.
     """
+
+    # preemption-storm flight-dump trigger: this many preemptions
+    # inside the sliding window dumps the ring once (re-arming after a
+    # full window) — thrashing under KV pressure is a postmortem-worthy
+    # state even though no single preemption is a fault
+    STORM_PREEMPTS = 8
+    STORM_WINDOW_S = 5.0
 
     def __init__(self, engine, max_queue: int = 64,
                  segment_steps: int = 8,
@@ -298,6 +321,13 @@ class Server:
         #                                   _recover) — drain must not
         #                                   report done in that window
         self._restarts = 0
+        self._flight_dumps = []           # flight-recorder dump paths
+        #                                   (under _lock; fault_stats /
+        #                                   healthz read them)
+        self._preempt_ts = []             # recent preemption stamps for
+        #                                   the storm trigger (scheduler
+        #                                   thread only)
+        self._last_storm_dump = -1e18
         self._fault_counts = {}           # (kind, site) -> n, host-side
         #                                   (monitor-independent; see
         #                                   fault_stats())
@@ -390,6 +420,10 @@ class Server:
             handle = RequestHandle(self._next_id, prompt, plen, cfg,
                                    priority, deadline,
                                    on_cancel=self._on_cancel)
+            # the trace key pairs the server label with the request id:
+            # concurrent servers in one process restart their ids at 0,
+            # and the process-wide ring must not merge their timelines
+            handle._trace_rid = f"{self.monitor_server}:{handle.id}"
             self._next_id += 1
             try:
                 self.queue.put(handle)
@@ -397,6 +431,10 @@ class Server:
                 self._count("rejected_queue_full")
                 raise
         self._count("queued")
+        if trace.enabled():
+            trace.event("queue.enqueue", rid=handle._trace_rid,
+                        plen=plen, priority=priority,
+                        depth=self.queue.depth)
         self._depth_gauge()
         self._wake.set()
         return handle
@@ -460,14 +498,21 @@ class Server:
             self._active_gauge().remove(server=self.monitor_server)
         except Exception:
             pass
-        # per-server fault/recovery series retire with the server (the
-        # site dimension is open-ended; a dropped server must not
-        # export its last degraded flag forever)
+        # per-server series retire with the server (the event/site
+        # dimensions are open-ended; a dropped server must not export
+        # its last degraded flag — or its lifecycle counters and
+        # latency histograms — forever). The requests/ttft/tpot
+        # families were the leak tests/test_monitor.py's
+        # TestSeriesRetirement caught when it generalized the PR 3-7
+        # hand-fixes into one regression.
         for name in ("paddle_tpu_serving_faults_total",
                      "paddle_tpu_serving_restarts_total",
                      "paddle_tpu_serving_degraded",
                      "paddle_tpu_serving_recovery_seconds",
-                     "paddle_tpu_serving_kv_pressure"):
+                     "paddle_tpu_serving_kv_pressure",
+                     "paddle_tpu_serving_requests_total",
+                     "paddle_tpu_serving_ttft_seconds",
+                     "paddle_tpu_serving_tpot_seconds"):
             try:
                 monitor.remove_series(name, server=self.monitor_server)
             except Exception:
@@ -495,12 +540,49 @@ class Server:
         (the chaos bench reads this even with the monitor off):
         ``{"faults": {(kind, site): n}, "restarts": n,
         "recovery_s": [per-restart wall seconds],
-        "degraded": reason-or-None}``."""
+        "degraded": reason-or-None,
+        "flight_dumps": [flight-recorder dump paths]}`` (dumps are
+        written on engine-scoped faults, watchdog ``degraded`` flips,
+        and preemption storms — empty unless ``FLAGS_enable_trace`` was
+        on when the trigger fired)."""
         with self._lock:
             return {"faults": dict(self._fault_counts),
                     "restarts": self._restarts,
                     "recovery_s": list(self._recovery_s),
-                    "degraded": self._degraded_reason}
+                    "degraded": self._degraded_reason,
+                    "flight_dumps": list(self._flight_dumps)}
+
+    @property
+    def flight_dumps(self):
+        """Flight-recorder dump paths written so far (newest last)."""
+        with self._lock:
+            return list(self._flight_dumps)
+
+    def request_timeline(self, request_id: int):
+        """Ordered trace-event timeline for one of THIS server's
+        requests by its public id (what ``/generate`` returned as
+        ``request_id``) — the ``GET /trace?rid=`` surface. Same
+        contract as ``RequestHandle.timeline()``: needs
+        ``FLAGS_enable_trace`` on while the request ran, may be partial
+        for old requests (bounded ring)."""
+        return trace.timeline(f"{self.monitor_server}:{request_id}")
+
+    def _flight_dump(self, reason: str):
+        """Write a flight-recorder dump (no-op while tracing is off —
+        no black box was recording) and remember its path for
+        ``fault_stats``/healthz. Never raises: the dump is postmortem
+        evidence, and failing to write it must not worsen the fault
+        being recorded."""
+        if not trace.enabled():
+            return None
+        try:
+            path = trace.dump(reason)
+        except Exception:
+            return None
+        if path is not None:
+            with self._lock:
+                self._flight_dumps.append(path)
+        return path
 
     def pressure(self):
         """KV memory-pressure snapshot (None for a dense engine):
@@ -641,6 +723,12 @@ class Server:
         if monitor.enabled():
             self._faults_counter().labels(
                 server=self.monitor_server, kind=kind, site=site).inc()
+        # one choke point gives every fault classification a trace
+        # event BEFORE any flight dump fires — the dump's final events
+        # name the faulting site
+        if trace.enabled():
+            trace.event("fault", kind=kind, site=site,
+                        server=self.monitor_server)
 
     def _set_degraded(self, reason: str, stall: bool = False) -> None:
         with self._lock:
@@ -682,6 +770,10 @@ class Server:
                     self._set_degraded(
                         f"scheduler step stalled > "
                         f"{self.stall_timeout_s}s", stall=True)
+                    # the wedged scheduler thread can't dump its own
+                    # black box — the watchdog does it (the ring's own
+                    # lock makes the cross-thread read safe)
+                    self._flight_dump("stall")
             elif stalled:
                 self._clear_degraded(stall_only=True)
 
@@ -719,10 +811,20 @@ class Server:
                         # with only a chunked admission in flight the
                         # segment is a fast no-op and the loop spins
                         # straight back into _gap for the next chunk
-                        self._guard(
-                            "decode",
-                            lambda: self.engine.decode_segment(
-                                self.segment_steps))
+                        sp = trace.NULL_SPAN
+                        if trace.enabled() and self._active:
+                            # batch-wide event: carries the live
+                            # request set so each one's timeline()
+                            # includes its segments
+                            sp = trace.span(
+                                "segment", steps=self.segment_steps,
+                                rids=tuple(h._trace_rid for h
+                                           in self._active.values()))
+                        with sp:
+                            self._guard(
+                                "decode",
+                                lambda: self.engine.decode_segment(
+                                    self.segment_steps))
                         self._guard("collect", self._collect)
                     else:
                         with self._idle_cv:
@@ -780,6 +882,13 @@ class Server:
 
     def _finalize(self, err: Optional[BaseException]) -> None:
         fail = err is not None
+        if fail:
+            # the scheduler is dying on an exception: capture the black
+            # box BEFORE the handles get their terminal states
+            if trace.enabled():
+                trace.event("fatal", server=self.monitor_server,
+                            cause=repr(err))
+            self._flight_dump("scheduler_fatal")
         with self._lock:
             # close the submit door BEFORE draining (on the crash path
             # _stopping is still False here — without this a racing
@@ -870,6 +979,12 @@ class Server:
         RAISES (carrying the rebuild error) when ``reset_state`` itself
         fails — either way the caller falls through to the fatal
         ``_finalize`` path with an honest diagnosis."""
+        # the flight recorder fires FIRST, before any recovery work
+        # mutates state: the dump is "what the engine was doing in the
+        # seconds before the fault", and it must be written even when
+        # the restart budget is already exhausted (the seam's
+        # _count_fault event naming the site is already in the ring)
+        self._flight_dump(f"engine_fault_{sig.site}")
         try:
             return self._recover_inner(sig)
         finally:
@@ -919,13 +1034,15 @@ class Server:
             end = time.monotonic() + min(
                 self.restart_backoff_s * (2 ** (self._restarts - 1)),
                 self.restart_backoff_max_s)
-            while True:
-                with self._lock:
-                    stopping = self._stopping
-                rem = end - time.monotonic()
-                if stopping or rem <= 0:
-                    break
-                time.sleep(min(0.05, rem))
+            with trace.span("backoff", site=sig.site,
+                            restart=self._restarts):
+                while True:
+                    with self._lock:
+                        stopping = self._stopping
+                    rem = end - time.monotonic()
+                    if stopping or rem <= 0:
+                        break
+                    time.sleep(min(0.05, rem))
             if stopping:
                 # shutdown won the race: park the in-flight handles for
                 # the loop's exit cleanup (clean stop → CANCELLED,
@@ -943,6 +1060,10 @@ class Server:
                 return True
             try:
                 self.engine.reset_state()
+                if trace.enabled():
+                    trace.event("restart", site=sig.site,
+                                restarts=self._restarts,
+                                inflight=len(inflight))
             except Exception as rebuild_err:
                 # the rebuild itself failed — nothing left to try. The
                 # snapshotted handles were already pulled out of
@@ -983,6 +1104,9 @@ class Server:
         if monitor.enabled():
             self._recovery_hist().labels(
                 server=self.monitor_server).observe(dt)
+        if trace.enabled():
+            trace.record("recover", dur_ns=int(dt * 1e9), site=sig.site,
+                         restarts=self._restarts)
         # refresh the heartbeat BEFORE dropping the degraded flag: the
         # beat is stale by the whole recovery (backoff included), and a
         # watchdog tick landing between the clear and the loop's next
@@ -1007,18 +1131,32 @@ class Server:
             # long prompt: claim capacity now, prefill one fixed-shape
             # chunk per gap (decode segments run in between) instead of
             # one monopolizing prefill
+            sp = trace.NULL_SPAN
+            if trace.enabled():
+                sp = trace.span("admit.begin", rid=h._trace_rid,
+                                plen=plen, chunk=chunk,
+                                replay=h._engine_base > 0)
+            with sp:
+                try:
+                    adm = self.engine.begin_admit(ids, cfg)
+                except Exception as e:
+                    self._contain(h, e, "admit")
+                    return False
+            self._adm = (adm, h)
+            return True
+        sp = trace.NULL_SPAN
+        if trace.enabled():
+            wfn = getattr(self.engine, "_prefill_width", None)
+            sp = trace.span("admit", rid=h._trace_rid, plen=plen,
+                            bucket=(wfn(plen) if wfn is not None
+                                    else plen),
+                            replay=h._engine_base > 0)
+        with sp:
             try:
-                adm = self.engine.begin_admit(ids, cfg)
+                rid = self.engine.add_request(ids, cfg)
             except Exception as e:
                 self._contain(h, e, "admit")
                 return False
-            self._adm = (adm, h)
-            return True
-        try:
-            rid = self.engine.add_request(ids, cfg)
-        except Exception as e:
-            self._contain(h, e, "admit")
-            return False
         h._mark_running(rid)
         self._active[rid] = h
         # admission prefill already sampled the first token: push it
@@ -1122,6 +1260,13 @@ class Server:
                 # replayed rid; handle-side indices keep counting from
                 # the full history
                 h._engine_base = n_toks
+                if trace.enabled():
+                    # re-admission after an engine restart OR a
+                    # memory-pressure preemption: the timeline shows
+                    # replay -> admit(replay=True) -> segments
+                    trace.event("replay", rid=h._trace_rid,
+                                emitted=n_toks, replays=h._replays,
+                                preempts=h._preempts)
                 self._start_admission(h, ids, rcfg, plen)
         finally:
             # an engine-fault signal mid-iteration leaves the
@@ -1147,8 +1292,14 @@ class Server:
         guard (:class:`PagePoolExhausted`, an engine-scoped fault)
         never fires under this scheduler."""
         self._admitting = True
+        # the gap span only when there is WORK: an idle loop gaps ~50x/s
+        # and would drown the flight ring in empty spans
+        busy = bool(trace.enabled()
+                    and (self._active or self._adm is not None
+                         or self._replay or self.queue.depth))
         try:
-            self._gap_body()
+            with (trace.span("gap") if busy else trace.NULL_SPAN):
+                self._gap_body()
             self._relieve_pressure()
         finally:
             self._admitting = False
@@ -1192,8 +1343,13 @@ class Server:
                 self._guard("cancel",
                             lambda: self.engine.abort_admit(adm))
             else:
+                sp = trace.NULL_SPAN
+                if trace.enabled():
+                    sp = trace.span("prefill_chunk", rid=h._trace_rid,
+                                    off=getattr(adm, "off", None))
                 try:
-                    finished = self.engine.admit_chunk(adm)
+                    with sp:
+                        finished = self.engine.admit_chunk(adm)
                 except Exception as e:
                     self._adm = None
                     # admit_chunk aborts itself on ITS failures, but a
@@ -1210,11 +1366,17 @@ class Server:
                         self._adm = None
                         h._mark_running(adm.rid)
                         self._active[adm.rid] = h
+                        if trace.enabled():
+                            trace.event("admit.done", rid=h._trace_rid,
+                                        chunked=True)
                         toks = self.engine.partial_tokens(adm.rid)
                         if toks is not None:
                             self._push_delta(h, toks)
         # 2. cancelled/expired queue entries never admit
         for h in self.queue.reap(time.monotonic()):
+            if trace.enabled():
+                trace.event("queue.expire", rid=h._trace_rid,
+                            cancelled=h._cancel_requested)
             if h._cancel_requested:
                 h._finish(CANCELLED)
                 self._count("cancelled")
@@ -1277,6 +1439,10 @@ class Server:
                         self._count("failed")
                     continue
                 break
+            if trace.enabled():
+                trace.event("queue.dequeue", rid=h._trace_rid,
+                            wait_s=round(
+                                time.monotonic() - h.submit_ts, 6))
             self._start_admission(h, h.prompt, h.cfg, h.prompt_len)
 
     # -- memory pressure (optimistic paged mode; scheduler thread) -----------
@@ -1302,6 +1468,14 @@ class Server:
         eng = self.engine
         if getattr(eng, "admission_mode", None) != "optimistic":
             return
+        sp = trace.NULL_SPAN
+        if trace.enabled() and (self._active or self._adm is not None):
+            sp = trace.span("gap.pressure", active=len(self._active))
+        with sp:
+            self._relieve_pressure_body()
+
+    def _relieve_pressure_body(self) -> None:
+        eng = self.engine
         while True:
             short = self._guard(
                 "pressure",
@@ -1410,6 +1584,28 @@ class Server:
             return
         h._preempts += 1
         self._count("preempted")
+        if trace.enabled():
+            trace.event("preempt", rid=h._trace_rid,
+                        preempts=h._preempts, emitted=h._n_pushed)
+        # preemption-STORM flight trigger: no single preemption is a
+        # fault, but a thrashing pool is exactly the state a postmortem
+        # needs the black box for (scheduler thread only)
+        now = time.monotonic()
+        self._preempt_ts.append(now)
+        cut = now - self.STORM_WINDOW_S
+        while self._preempt_ts and self._preempt_ts[0] < cut:
+            self._preempt_ts.pop(0)
+        if (len(self._preempt_ts) >= self.STORM_PREEMPTS
+                and now - self._last_storm_dump > self.STORM_WINDOW_S):
+            if trace.enabled():
+                trace.event("preempt.storm",
+                            count=len(self._preempt_ts),
+                            window_s=self.STORM_WINDOW_S)
+            # re-arm only on a WRITTEN dump: a storm trip with tracing
+            # off must not burn the window and suppress the first real
+            # dump after an operator enables tracing mid-storm
+            if self._flight_dump("preemption_storm") is not None:
+                self._last_storm_dump = now
         if h._preempts > self.max_preemptions:
             h._finish(FAILED, PreemptionBudgetExceeded(
                 f"request {h.id} preempted {h._preempts} times under "

@@ -56,6 +56,8 @@ import random
 import threading
 from typing import List, Optional, Sequence
 
+from .. import tracing as trace
+
 __all__ = ["SITES", "FaultPlan", "FaultyEngine", "InjectedFault"]
 
 SITES = ("admit", "prefill", "chunk", "decode", "collect", "preempt")
@@ -174,6 +176,12 @@ class FaultPlan:
             rule.fired += 1
             self.injected.append((site, n, rule.action))
             action, exc, seconds = rule.action, rule.exc, rule.seconds
+        if trace.enabled():
+            # injections are part of the story a flight dump tells: a
+            # chaos postmortem must distinguish injected faults from
+            # organic ones
+            trace.event("fault.injected", site=site, call=n,
+                        action=action)
         if action == "hang":
             # outside the lock: a hung scheduler must not also wedge
             # every other seam's bookkeeping

@@ -1,7 +1,14 @@
 """Test harness config: force an 8-device virtual CPU platform BEFORE jax
 import so multi-chip sharding tests run anywhere (driver parity: the judge's
-dryrun uses xla_force_host_platform_device_count the same way)."""
+dryrun uses xla_force_host_platform_device_count the same way).
+
+Also hosts the tier-1 WALL-TIME BUDGET guard (bottom of this file): a
+full `-m 'not slow'` run that exceeds ~800s fails loudly with the
+move-to-slow-tier playbook instead of silently drifting into the
+driver's 870s kill."""
 import os
+import sys
+import time
 
 # PADDLE_TPU_TESTS_ON_DEVICE=1 runs the suite on the REAL accelerator
 # (experiments/tpu_session.sh uses it for on-chip kernel parity — the
@@ -49,3 +56,64 @@ def _fixed_seed():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+
+
+# -- tier-1 wall-time budget guard -------------------------------------------
+# The tier-1 suite runs under a hard 870s driver timeout (ROADMAP.md);
+# blowing it kills the run at rc=124 with NO per-test attribution, and
+# PRs 1 and 6 each burned review cycles rediscovering that the fix is
+# moving minutes-scale suites to the slow tier (`pytestmark =
+# pytest.mark.slow`, run via `-m slow`). This guard fails the suite
+# LOUDLY at ~800s — while everything still passes and the slow culprit
+# is attributable via --durations — instead of letting the next PR
+# drift into the silent 870s cliff. Scope: only full tier-1-shaped runs
+# (a `not slow` markexpr over a substantial collection); tune/disable
+# via PADDLE_TPU_TIER1_BUDGET_S (0 = off).
+_TIER1_BUDGET_S = float(os.environ.get("PADDLE_TPU_TIER1_BUDGET_S",
+                                       "800"))
+_TIER1_MIN_TESTS = int(os.environ.get("PADDLE_TPU_TIER1_MIN_TESTS",
+                                      "400"))  # skip -k slices / files
+_session_t0 = None
+
+
+def _is_tier1_run(session) -> bool:
+    markexpr = getattr(session.config.option, "markexpr", "") or ""
+    return ("not slow" in markexpr
+            and getattr(session, "testscollected", 0)
+            >= _TIER1_MIN_TESTS)
+
+
+def pytest_sessionstart(session):
+    global _session_t0
+    _session_t0 = time.monotonic()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _session_t0 is None or _TIER1_BUDGET_S <= 0:
+        return
+    wall = time.monotonic() - _session_t0
+    if not _is_tier1_run(session):
+        return
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    line = (f"tier-1 wall time: {wall:.0f}s "
+            f"(budget {_TIER1_BUDGET_S:.0f}s, driver timeout 870s)")
+    if wall <= _TIER1_BUDGET_S:
+        if tr is not None:
+            tr.write_line(line)
+        return
+    msg = (
+        f"\n{'=' * 72}\n"
+        f"TIER-1 WALL-TIME BUDGET EXCEEDED: {line}\n"
+        f"The driver kills this suite at 870s (rc=124, no per-test\n"
+        f"attribution). Move the slow culprits to the slow tier\n"
+        f"(`pytestmark = pytest.mark.slow`, run via `-m slow`) — the\n"
+        f"PR 1 / PR 6 precedent — before the next PR hits the cliff.\n"
+        f"Find them with: pytest --durations=25 -m 'not slow'.\n"
+        f"Tune/disable via PADDLE_TPU_TIER1_BUDGET_S (0 = off).\n"
+        f"{'=' * 72}")
+    if tr is not None:
+        tr.write_line(msg, red=True, bold=True)
+    else:
+        print(msg, file=sys.stderr)
+    if session.exitstatus == 0:
+        session.exitstatus = 1

@@ -326,6 +326,60 @@ class TestPagedCacheGauges:
             1 / 4)
 
 
+class TestSeriesRetirement:
+    def test_no_per_instance_series_survive_close_and_shutdown(
+            self, mon):
+        """ONE regression for the remove_series hardening PRs 3-7 each
+        re-fixed by hand: after ``Server.shutdown()`` + engine
+        ``close()``, the registry must hold ZERO series labeled with
+        any of the retired instances' labels (server=..., engine=...,
+        pool=...) — whatever metric family they rode in on. A metric
+        added later with a forgotten retirement fails HERE instead of
+        in a future PR's review cycle."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.generation import (
+            GenerationConfig, PagedContinuousBatchingEngine)
+        from paddle_tpu.models import LlamaForCausalLM, llama_config
+        from paddle_tpu.serving import Server
+
+        paddle.seed(0)
+        cfg = llama_config("tiny", num_hidden_layers=1)
+        model = LlamaForCausalLM(cfg)
+        eng = PagedContinuousBatchingEngine(
+            model, max_batch=2, num_pages=16, page_size=4,
+            max_pages=8, prefix_cache=True)
+        srv = Server(eng, segment_steps=4)
+        labels = {"server": srv.monitor_server,
+                  "engine": eng._monitor_engine,
+                  "pool": eng.alloc.monitor_pool}
+        h = srv.submit(np.arange(1, 7, dtype=np.int32),
+                       GenerationConfig(max_new_tokens=4,
+                                        eos_token_id=None))
+        h.result(timeout=120)
+
+        def instance_series():
+            leaked = []
+            for name, meta in monitor.snapshot()["metrics"].items():
+                for s in meta["samples"]:
+                    for k, v in labels.items():
+                        if s["labels"].get(k) == v:
+                            leaked.append((name, s["labels"]))
+            return leaked
+
+        # the run exercised the instrumented paths: the instances ARE
+        # exporting series before retirement (else the assert below
+        # would pass vacuously)
+        assert instance_series(), "no per-instance series were created"
+        srv.shutdown()
+        eng.close()
+        leaked = instance_series()
+        assert leaked == [], (
+            f"per-instance series survived shutdown+close (add them "
+            f"to the owner's retirement list): {leaked}")
+
+
 @pytest.mark.slow
 class TestEndToEndAcceptance:
     """ISSUE acceptance: snapshot() carries step throughput, jit compile

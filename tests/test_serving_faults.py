@@ -715,6 +715,139 @@ class TestTooling:
         assert "something_else" not in out
 
 
+class TestFlightRecorder:
+    """Chaos-suite wiring for the flight recorder (ISSUE 9): an
+    engine-scoped fault must leave a black-box dump behind, its final
+    events must NAME the faulting site, and the dump path must surface
+    through ``fault_stats()`` and ``/healthz``."""
+
+    @pytest.fixture()
+    def tr(self, tmp_path):
+        from paddle_tpu import tracing
+        tracing.clear()
+        tracing.enable(dump_dir=str(tmp_path))
+        yield tracing
+        tracing.disable()
+        tracing.clear()
+
+    def test_engine_fault_dumps_and_names_site(self, tr):
+        plan = FaultPlan().raise_at("decode", nth=2,
+                                    exc=EngineFault("injected"))
+        srv, raw, mcfg = faulty_server(plan, restart_backoff_s=0.01,
+                                       segment_steps=4)
+        try:
+            prompts = [np.arange(1, 7, dtype=np.int32) + i
+                       for i in range(2)]
+            hs = [srv.submit(p, _greedy(10)) for p in prompts]
+            for h in hs:
+                h.result(timeout=180)
+            fs = srv.fault_stats()
+            assert fs["restarts"] == 1
+            assert fs["flight_dumps"], \
+                "engine fault produced no flight-recorder dump"
+            path = fs["flight_dumps"][-1]
+            doc = json.load(open(path))
+            assert doc["otherData"]["reason"] == "engine_fault_decode"
+            # the final events name the faulting site: the seam's
+            # fault-classification event AND the injection marker
+            faults = [e for e in doc["traceEvents"]
+                      if e["name"] == "fault"]
+            assert faults and faults[-1]["args"]["site"] == "decode"
+            assert faults[-1]["args"]["kind"] == "engine"
+            inject = [e for e in doc["traceEvents"]
+                      if e["name"] == "fault.injected"]
+            assert inject and inject[-1]["args"]["site"] == "decode"
+            # ... and the dump path reaches /healthz
+            httpd = serve_http(srv, port=0)
+            try:
+                port = httpd.server_address[1]
+                from urllib.request import urlopen
+                body = json.loads(urlopen(
+                    f"http://127.0.0.1:{port}/healthz",
+                    timeout=10).read())
+                assert body["flight_dump"] == path
+            finally:
+                httpd.shutdown()
+        finally:
+            srv.shutdown()
+        _assert_no_leaks(raw)
+
+    def test_restart_backoff_replay_traced(self, tr):
+        """The recovery trail lands in the ring AFTER the dump: the
+        next dump (or a live /trace read) shows backoff -> restart ->
+        replay -> re-admit for the surviving request."""
+        from paddle_tpu import tracing
+        plan = FaultPlan().raise_at("decode", nth=2,
+                                    exc=EngineFault("injected"))
+        srv, raw, _ = faulty_server(plan, restart_backoff_s=0.01,
+                                    segment_steps=4)
+        try:
+            h = srv.submit(np.arange(1, 7, dtype=np.int32),
+                           _greedy(10))
+            h.result(timeout=180)
+            ph = [e["phase"] for e in h.timeline()]
+            i = ph.index
+            assert i("replay") < ph.index("admit", i("replay"))
+            names = [e["phase"] for e in tracing.events()]
+            assert "backoff" in names and "restart" in names \
+                and "recover" in names
+            j = names.index
+            assert j("backoff") < j("restart") < j("recover")
+        finally:
+            srv.shutdown()
+        _assert_no_leaks(raw)
+
+    def test_no_dump_when_tracing_disabled(self):
+        from paddle_tpu import tracing
+        assert not tracing.enabled()
+        plan = FaultPlan().raise_at("decode", nth=2,
+                                    exc=EngineFault("injected"))
+        srv, raw, _ = faulty_server(plan, restart_backoff_s=0.01,
+                                    segment_steps=4)
+        try:
+            h = srv.submit(np.arange(1, 7, dtype=np.int32),
+                           _greedy(10))
+            h.result(timeout=180)
+            fs = srv.fault_stats()
+            assert fs["restarts"] == 1
+            # no recorder armed -> no black box, honestly empty
+            assert fs["flight_dumps"] == []
+            assert h.timeline() == []
+        finally:
+            srv.shutdown()
+        _assert_no_leaks(raw)
+
+    def test_preemption_storm_dumps_once(self, tr):
+        """The storm trigger fires on preemption DENSITY (not any
+        single preemption) and re-arms only after a full window —
+        driven synthetically through _park_preempted so the test does
+        not depend on pool-thrash timing."""
+        import types
+
+        from paddle_tpu.serving.queue import RequestHandle
+        srv = Server(types.SimpleNamespace(max_len=64), start=False)
+        srv.STORM_PREEMPTS = 3
+        try:
+            for k in range(3):
+                h = RequestHandle(k, np.arange(3), 3, _greedy(4))
+                h._trace_rid = f"{srv.monitor_server}:{k}"
+                srv._park_preempted(h)
+            dumps = srv.fault_stats()["flight_dumps"]
+            assert len(dumps) == 1
+            doc = json.load(open(dumps[0]))
+            assert doc["otherData"]["reason"] == "preemption_storm"
+            storm = [e for e in doc["traceEvents"]
+                     if e["name"] == "preempt.storm"]
+            assert storm and storm[-1]["args"]["count"] == 3
+            # within the same window a 4th preemption does NOT re-dump
+            h = RequestHandle(9, np.arange(3), 3, _greedy(4))
+            h._trace_rid = f"{srv.monitor_server}:9"
+            srv._park_preempted(h)
+            assert len(srv.fault_stats()["flight_dumps"]) == 1
+        finally:
+            srv.shutdown(drain=False)
+
+
 @pytest.mark.slow
 class TestChaosSoak:
     def test_serve_bench_under_injected_faults(self, mon, capsys):

@@ -1,0 +1,339 @@
+"""Request-lifecycle tracing + flight recorder suite (ISSUE 9).
+
+Covers ``paddle_tpu.tracing`` end to end on CPU:
+
+- the RECORDER: near-zero disabled path (no events, shared null span),
+  bounded ring with tail-preserving reconfiguration, begin-time-ordered
+  timelines keyed by rid (batch-wide ``rids`` events fan out to every
+  carried request), Chrome-trace export through the profiler's shared
+  writer, flight dumps with reason metadata, flag sync
+  (``FLAGS_enable_trace``);
+- SERVER integration: a request's timeline shows
+  queue → admit (with the prefill bucket) → segments → finish in
+  order; chunked admissions record one event per prefill chunk; THE
+  acceptance scenario — a preempted-and-replayed request's timeline
+  shows queue → admit → segments → preempt → replay → admit → finish,
+  surviving the engine-rid change;
+- the HTTP debug surface: ``GET /trace?rid=`` returns the timeline,
+  bare ``/trace`` the newest events, and a disabled recorder is an
+  honest 404;
+- the serve_bench TTFT decomposition (queue/prefill/gap shares sum to
+  the server-side TTFT) and the ``monitor_report --trace`` phase
+  table / slowest-requests view.
+
+The flight-recorder triggers (engine fault / stall / preemption storm)
+are exercised where the faults are injected — the chaos suite
+(``tests/test_serving_faults.py`` ``TestFlightRecorder``); the
+monitor-registry retirement regression lives in ``tests/test_monitor.py``.
+"""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import tracing as trace
+from paddle_tpu.inference.generation import (GenerationConfig,
+                                             PagedContinuousBatchingEngine)
+from paddle_tpu.serving import Server, serve_http
+
+_MODEL = None
+
+
+def tiny_model():
+    """ONE tiny llama shared by the whole module (jit programs are
+    keyed on shapes — same page_size/bucket shapes below keep the
+    suite to a handful of compiles)."""
+    global _MODEL
+    if _MODEL is None:
+        paddle.seed(0)
+        from paddle_tpu.models import LlamaForCausalLM, llama_config
+        cfg = llama_config("tiny", num_hidden_layers=1)
+        _MODEL = (LlamaForCausalLM(cfg), cfg)
+    return _MODEL
+
+
+def paged_engine(model, max_batch=4, num_pages=64, page_size=4,
+                 max_pages=8, **kw):
+    kw.setdefault("debug_pages", True)
+    return PagedContinuousBatchingEngine(
+        model, max_batch=max_batch, num_pages=num_pages,
+        page_size=page_size, max_pages=max_pages, **kw)
+
+
+def _greedy(n):
+    return GenerationConfig(max_new_tokens=n, eos_token_id=None)
+
+
+def _prompts(cfg, n, plen=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, (plen,)).astype(np.int32)
+            for _ in range(n)]
+
+
+@pytest.fixture()
+def tr(tmp_path):
+    """Tracing armed for one test, ring cleared both ways, dumps into
+    the test's tmp dir."""
+    trace.clear()
+    trace.enable(dump_dir=str(tmp_path))
+    yield trace
+    trace.disable()
+    trace.clear()
+    trace.configure(capacity=trace.DEFAULT_CAPACITY)
+
+
+class TestRecorder:
+    def test_disabled_is_noop(self):
+        trace.disable()
+        trace.clear()
+        trace.event("x", rid=1)
+        trace.record("y", rid=1, dur_ns=100)
+        assert trace.events() == []
+        # the disabled span is THE shared null object: no allocation
+        assert trace.span("z", rid=1) is trace.NULL_SPAN
+        with trace.span("z"):
+            pass
+        assert trace.events() == []
+        # no black box was recording -> no dump to write
+        assert trace.dump("whatever") is None
+
+    def test_flag_sync(self):
+        paddle.set_flags({"FLAGS_enable_trace": True})
+        assert trace.enabled()
+        paddle.set_flags({"FLAGS_enable_trace": False})
+        assert not trace.enabled()
+        trace.enable()
+        assert trace.enabled()
+        assert paddle.get_flags("FLAGS_enable_trace")[
+            "FLAGS_enable_trace"]
+        trace.disable()
+
+    def test_ring_bound_and_reconfigure(self, tr):
+        trace.configure(capacity=4)
+        for i in range(10):
+            trace.event("e", rid=i)
+        evs = trace.events()
+        assert [e["rid"] for e in evs] == [6, 7, 8, 9]
+        # shrinking keeps the newest tail
+        trace.configure(capacity=2)
+        assert [e["rid"] for e in trace.events()] == [8, 9]
+        with pytest.raises(ValueError):
+            trace.configure(capacity=0)
+
+    def test_timeline_order_and_rids_fanout(self, tr):
+        trace.event("queue.enqueue", rid="s:1")
+        with trace.span("admit", rid="s:1", plen=6, bucket=8):
+            pass
+        # batch-wide event carrying both requests
+        trace.record("segment", dur_ns=1000, rids=("s:1", "s:2"),
+                     steps=4)
+        trace.event("finish", rid="s:2", status="finished")
+        t1 = trace.timeline("s:1")
+        assert [e["phase"] for e in t1] == ["queue.enqueue", "admit",
+                                           "segment"]
+        assert t1[1]["bucket"] == 8 and t1[1]["dur_ns"] >= 0
+        t2 = trace.timeline("s:2")
+        assert [e["phase"] for e in t2] == ["segment", "finish"]
+        # timelines sort by BEGIN time even though spans land in the
+        # ring at their end
+        assert all(a["ts_ns"] <= b["ts_ns"]
+                   for a, b in zip(t1, t1[1:]))
+
+    def test_export_chrome_and_dump(self, tr, tmp_path):
+        trace.event("queue.enqueue", rid="s:1", depth=2)
+        trace.record("admit", rid="s:1", dur_ns=2_000_000, bucket=16)
+        p = trace.export_chrome(str(tmp_path / "t.json"))
+        doc = json.load(open(p))
+        assert doc["displayTimeUnit"] == "ms"
+        evs = doc["traceEvents"]
+        assert {e["name"] for e in evs} == {"queue.enqueue", "admit"}
+        span = next(e for e in evs if e["name"] == "admit")
+        assert span["ph"] == "X" and abs(span["dur"] - 2000) < 1
+        assert span["args"]["rid"] == "s:1"
+        inst = next(e for e in evs if e["name"] == "queue.enqueue")
+        assert inst["ph"] == "i" and inst["args"]["depth"] == 2
+        # the flight dump carries its reason and lands in dump_dir
+        d = trace.dump("unit reason")
+        assert os.path.dirname(d) == str(tmp_path)
+        doc2 = json.load(open(d))
+        assert doc2["otherData"]["reason"] == "unit reason"
+        assert len(doc2["traceEvents"]) == 2
+
+
+class TestServerTimeline:
+    def test_lifecycle_order_and_bucket(self, tr):
+        model, mcfg = tiny_model()
+        eng = paged_engine(model)
+        srv = Server(eng, segment_steps=4)
+        hs = [srv.submit(p, _greedy(8)) for p in _prompts(mcfg, 2)]
+        for h in hs:
+            h.result(timeout=120)
+        tl = hs[0].timeline()
+        ph = [e["phase"] for e in tl]
+        i = ph.index
+        assert (i("queue.enqueue") < i("queue.dequeue") < i("admit")
+                < i("segment") < i("finish"))
+        admit = tl[i("admit")]
+        assert admit["plen"] == 6 and admit["bucket"] == 16  # 6 -> 16
+        assert not admit["replay"]
+        assert tl[i("finish")]["status"] == "finished"
+        # server-side lookup by PUBLIC request id matches the handle's
+        assert srv.request_timeline(hs[0].id) == tl
+        # the two requests' timelines are distinct but share segments
+        tl2 = hs[1].timeline()
+        assert tl2[0]["rid"] != tl[0]["rid"]
+        # engine-level prefill events recorded the bucket choice too
+        assert any(e["phase"] == "engine.prefill" and e["bucket"] == 16
+                   for e in trace.events())
+        srv.shutdown()
+
+    def test_chunked_admission_traces_each_chunk(self, tr):
+        model, mcfg = tiny_model()
+        eng = paged_engine(model, num_pages=64, max_pages=16,
+                           prefill_chunk=8)
+        srv = Server(eng, segment_steps=4)
+        p = _prompts(mcfg, 1, plen=20)[0]
+        h = srv.submit(p, _greedy(6))
+        h.result(timeout=120)
+        ph = [e["phase"] for e in h.timeline()]
+        assert "admit.begin" in ph
+        # 20 tokens @ chunk 8 -> 3 chunks, each its own gap event
+        assert ph.count("prefill_chunk") == 3
+        assert "admit.done" in ph
+        assert (ph.index("admit.begin")
+                < ph.index("prefill_chunk")
+                < ph.index("admit.done") < ph.index("finish"))
+        srv.shutdown()
+
+    def test_preempted_and_replayed_timeline(self, tr):
+        """THE acceptance scenario: a preempted-and-replayed request's
+        timeline shows queue → admit → segments → preempt → replay →
+        (re-)admit → finish IN ORDER, keyed by the handle id — the
+        engine rid changed at replay and the timeline must not care."""
+        model, mcfg = tiny_model()
+        prompts = _prompts(mcfg, 4)
+        # 4 x (6 + 20) tokens = 28 worst-case pages; 14 forces pressure
+        eng = paged_engine(model, num_pages=14,
+                           admission_mode="optimistic",
+                           kv_watermark=1.0)
+        srv = Server(eng, segment_steps=4, max_preemptions=50)
+        hs = [srv.submit(p, _greedy(20)) for p in prompts]
+        for h in hs:
+            h.result(timeout=180)
+        assert eng.alloc.preemptions >= 1
+        victims = [h for h in hs if h._preempts > 0]
+        assert victims
+        h = victims[0]
+        ph = [e["phase"] for e in h.timeline()]
+        i = ph.index
+        assert (i("queue.enqueue") < i("admit") < i("preempt")
+                < i("replay") < i("finish"))
+        # a decode segment ran between the first admission and the
+        # preemption, and the replay re-admitted (a SECOND admit, with
+        # replay=True, after the replay marker)
+        assert "segment" in ph[i("admit"):i("preempt")]
+        admits = [j for j, p_ in enumerate(ph) if p_ == "admit"]
+        assert len(admits) >= 2 and admits[-1] > i("replay")
+        tl = h.timeline()
+        assert tl[admits[-1]]["replay"] is True
+        assert tl[i("finish")]["status"] == "finished"
+        srv.shutdown()
+
+    def test_http_trace_endpoint(self, tr):
+        model, mcfg = tiny_model()
+        eng = paged_engine(model)
+        srv = Server(eng, segment_steps=4)
+        httpd = serve_http(srv, port=0)
+        port = httpd.server_address[1]
+        try:
+            h = srv.submit(_prompts(mcfg, 1)[0], _greedy(6))
+            h.result(timeout=120)
+            doc = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trace?rid={h.id}",
+                timeout=10))
+            assert doc["request_id"] == h.id
+            phases = [e["phase"] for e in doc["events"]]
+            assert "admit" in phases and phases[-1] == "finish"
+            # bare /trace: the newest buffered events
+            doc2 = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trace", timeout=10))
+            assert doc2["n"] > 0
+            # malformed rid is a 400, not a crash
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace?rid=abc",
+                    timeout=10)
+            assert ei.value.code == 400
+            # disabled recorder is an honest 404 with the enable hint
+            trace.disable()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace?rid={h.id}",
+                    timeout=10)
+            assert ei.value.code == 404
+            assert "FLAGS_enable_trace" in json.load(ei.value)["error"]
+        finally:
+            httpd.shutdown()
+            srv.shutdown()
+
+
+def _tools():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    try:
+        import monitor_report
+        import serve_bench
+    finally:
+        sys.path.pop(0)
+    return serve_bench, monitor_report
+
+
+class TestToolViews:
+    def test_ttft_decomposition_sums_to_ttft(self, tr):
+        """The serve_bench decomposition's three shares sum to the
+        server-side TTFT per request (synthetic events with known
+        spacing)."""
+        serve_bench, _ = _tools()
+        import time as _t
+
+        t0 = _t.perf_counter_ns()
+        with trace._lock:   # hand-build deterministic timestamps
+            trace._ring.append((t0, 0, "s:1", "queue.enqueue", None))
+            trace._ring.append((t0 + 10_000_000, 0, "s:1",
+                                "queue.dequeue", None))
+            trace._ring.append((t0 + 10_000_000, 30_000_000, "s:1",
+                                "admit", None))
+            trace._ring.append((t0 + 50_000_000, 0, "s:1",
+                                "first_token", None))
+        # a preempted request's REPLAY re-admission lands after the
+        # first token (ring order is end-time order) and must NOT
+        # inflate the prefill share
+        with trace._lock:
+            trace._ring.append((t0 + 90_000_000, 40_000_000, "s:1",
+                                "admit", {"replay": True}))
+        qs, ps, gs = serve_bench._ttft_decomposition()
+        assert qs == [pytest.approx(0.010)]
+        assert ps == [pytest.approx(0.030)]
+        assert gs == [pytest.approx(0.010)]       # 50 - 10 - 30 ms
+
+    def test_monitor_report_trace_view(self, tr, tmp_path):
+        _, monitor_report = _tools()
+        model, mcfg = tiny_model()
+        eng = paged_engine(model)
+        srv = Server(eng, segment_steps=4)
+        hs = [srv.submit(p, _greedy(6)) for p in _prompts(mcfg, 2)]
+        for h in hs:
+            h.result(timeout=120)
+        srv.shutdown()
+        p = trace.export_chrome(str(tmp_path / "run.json"))
+        out = monitor_report.render_trace(json.load(open(p)), top=2)
+        assert "PHASE" in out and "admit" in out and "segment" in out
+        assert "top 2 slowest requests" in out
+        assert "dominant:" in out
+        # the CLI route works end to end
+        assert monitor_report.main(["--trace", p, "--top", "1"]) == 0

@@ -13,6 +13,10 @@ Usage::
     python tools/monitor_report.py --url http://127.0.0.1:8080
     python tools/monitor_report.py run.jsonl --filter kv_   # substring
     python tools/monitor_report.py --url ... --serving  # serving view
+    # request-lifecycle trace view (a paddle_tpu.tracing chrome-JSON
+    # export or flight-recorder dump): per-phase latency table +
+    # the top-K slowest requests with their dominant phase
+    python tools/monitor_report.py --trace serve_trace.json --top 5
 """
 from __future__ import annotations
 
@@ -137,6 +141,79 @@ def render(records: List[dict], filter_: str = "",
     return "\n".join(lines)
 
 
+def _percentile(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1))))
+    return xs[i]
+
+
+def render_trace(doc: dict, top: int = 5) -> str:
+    """Per-phase latency table + top-K slowest requests for a
+    ``paddle_tpu.tracing`` chrome-JSON export / flight-recorder dump.
+
+    Phases aggregate every event by name (span durations in seconds;
+    instants count only); requests aggregate by the ``rid`` each event
+    carries (batch-wide segment events fan out to every entry of their
+    ``rids`` list). A request's latency is the span of its events
+    (first begin to last end), and its DOMINANT phase is the one with
+    the largest summed span duration — the "which phase ate the time"
+    answer for the slow tail."""
+    evs = doc.get("traceEvents", [])
+    other = doc.get("otherData") or {}
+    phases: Dict[str, List[float]] = {}
+    reqs: Dict[str, dict] = {}
+    for e in evs:
+        name = e.get("name", "?")
+        ts = float(e.get("ts", 0.0)) / 1e6       # µs -> s
+        dur = float(e.get("dur", 0.0)) / 1e6
+        phases.setdefault(name, []).append(dur)
+        args = e.get("args") or {}
+        rids = []
+        if args.get("rid") is not None:
+            rids.append(args["rid"])
+        for r in (args.get("rids") or []):
+            rids.append(r)
+        for r in rids:
+            d = reqs.setdefault(
+                str(r), {"t0": ts, "t1": ts + dur, "by": {}})
+            d["t0"] = min(d["t0"], ts)
+            d["t1"] = max(d["t1"], ts + dur)
+            d["by"][name] = d["by"].get(name, 0.0) + dur
+    lines = []
+    if other.get("reason"):
+        lines.append(f"flight-recorder dump: reason="
+                     f"{other['reason']!r} pid={other.get('pid')}")
+    if not phases:
+        lines.append("(no trace events)")
+        return "\n".join(lines)
+    w = max(len(n) for n in phases)
+    lines.append(f"{'PHASE':<{w}}  {'COUNT':>6}  {'p50(s)':>10}"
+                 f"  {'p99(s)':>10}")
+    lines.append("-" * (w + 32))
+    for name in sorted(phases, key=lambda n: -sum(phases[n])):
+        xs = phases[name]
+        lines.append(f"{name:<{w}}  {len(xs):>6}"
+                     f"  {_percentile(xs, 50):>10.5f}"
+                     f"  {_percentile(xs, 99):>10.5f}")
+    slow = sorted(reqs.items(), key=lambda kv: -(kv[1]["t1"]
+                                                 - kv[1]["t0"]))[:top]
+    if slow:
+        lines.append("")
+        lines.append(f"top {len(slow)} slowest requests:")
+        for rid, d in slow:
+            total = d["t1"] - d["t0"]
+            if d["by"]:
+                dom, ddur = max(d["by"].items(), key=lambda kv: kv[1])
+                share = ddur / total if total > 0 else 0.0
+                lines.append(f"  {rid:<16} {total:>9.4f}s  dominant: "
+                             f"{dom} ({ddur:.4f}s, {share:.0%})")
+            else:
+                lines.append(f"  {rid:<16} {total:>9.4f}s")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=None,
@@ -152,8 +229,19 @@ def main(argv=None) -> int:
                          "TTFT, TPOT, request events, tokens/sec, KV "
                          "admission + occupancy + preemptions/pressure, "
                          "faults/restarts/degraded/recovery)")
+    ap.add_argument("--trace", default=None, metavar="JSON",
+                    help="render a paddle_tpu.tracing chrome-JSON "
+                         "export / flight-recorder dump instead: "
+                         "per-phase p50/p99 table + the --top slowest "
+                         "requests with their dominant phase")
+    ap.add_argument("--top", type=int, default=5,
+                    help="slowest-requests rows in the --trace view")
     args = ap.parse_args(argv)
 
+    if args.trace:
+        with open(args.trace) as f:
+            print(render_trace(json.load(f), top=args.top))
+        return 0
     if args.url:
         from urllib.request import urlopen
 

@@ -56,6 +56,14 @@ Usage::
     # _spec, serve_spec_tokens_per_forward and the acceptance rate
     python tools/serve_bench.py --spec-ab --draft-k 6 --repeat-unit 4 \
         --prompt-len 16:24 --max-new 24 --warmup
+    # request-lifecycle tracing (PERF.md tracing methodology): capture
+    # a Chrome-trace/Perfetto file of the whole run and report the
+    # trace-derived TTFT decomposition (queue vs prefill vs gap share)
+    python tools/serve_bench.py --trace-out /tmp/serve_trace.json --warmup
+    # tracing-overhead A/B: IDENTICAL load twice — trace off then on —
+    # reporting serve_tpot_* per arm plus serve_trace_tpot_overhead
+    # (the "near-zero when disabled / cheap when on" claim, measured)
+    python tools/serve_bench.py --trace-ab --warmup
 
 Output: one human table plus BENCH-shaped JSON records
 (``{"metric": ..., "value": ..., "unit": ...}``) on stdout. Chaos runs
@@ -426,14 +434,31 @@ def main(argv=None) -> int:
     ap.add_argument("--monitor-out", default=None, metavar="JSONL",
                     help="also dump the in-process monitor registry "
                          "(in-process mode only)")
+    # request-lifecycle tracing knobs (paddle_tpu.tracing; in-process)
+    ap.add_argument("--trace-out", default=None, metavar="JSON",
+                    help="enable FLAGS_enable_trace for the run and "
+                         "write the Chrome-trace/Perfetto JSON of the "
+                         "whole run here (also reports the "
+                         "trace-derived TTFT decomposition records)")
+    ap.add_argument("--trace-ab", action="store_true",
+                    help="A/B mode: run the SAME load twice — tracing "
+                         "off then on — and report serve_tpot_* per "
+                         "arm plus serve_trace_tpot_overhead (the "
+                         "tracing-overhead record PERF.md quotes)")
     args = ap.parse_args(argv)
 
     rng = random.Random(args.seed)
     lo, hi = (int(x) for x in args.prompt_len.split(":"))
     if args.url is not None and (args.fault_rate > 0 or args.spec_ab
-                                 or args.speculative == "on"):
-        print("--fault-rate/--speculative/--spec-ab need the "
-              "in-process engine (no --url)", file=sys.stderr)
+                                 or args.speculative == "on"
+                                 or args.trace_out or args.trace_ab):
+        print("--fault-rate/--speculative/--spec-ab/--trace-out/"
+              "--trace-ab need the in-process engine (no --url)",
+              file=sys.stderr)
+        return 2
+    if args.spec_ab and args.trace_ab:
+        print("--spec-ab and --trace-ab are separate A/Bs; run them "
+              "one at a time", file=sys.stderr)
         return 2
 
     # open loop: the full arrival schedule AND every prompt are drawn
@@ -464,11 +489,34 @@ def main(argv=None) -> int:
                + _body(_draw_len(rng, args.prompt_dist, lo, hi))
                for _ in range(args.requests)]
 
-    arms = ([("plain", False), ("spec", True)] if args.spec_ab
-            else [("", args.speculative == "on")])
+    spec_def = args.speculative == "on"
+    trace_def = args.trace_out is not None
+    if args.spec_ab:
+        arms = [("plain", False, trace_def), ("spec", True, trace_def)]
+    elif args.trace_ab:
+        arms = [("traceoff", spec_def, False),
+                ("traceon", spec_def, True)]
+    else:
+        arms = [("", spec_def, trace_def)]
     res = {}
-    for arm, spec_on in arms:
-        res[arm] = _run_arm(args, arm, spec_on, prompts, arrivals)
+    for arm, spec_on, trace_on in arms:
+        res[arm] = _run_arm(args, arm, spec_on, trace_on, prompts,
+                            arrivals)
+    if args.trace_ab:
+        # the overhead verdict: decode cadence with the recorder on vs
+        # off, on identical replayed load — the number that justifies
+        # leaving tracing available in production serving
+        a, b = res["traceoff"], res["traceon"]
+        if a.get("tpot_p50") and b.get("tpot_p50"):
+            print(json.dumps({"metric": "serve_trace_tpot_overhead",
+                              "value": round(b["tpot_p50"]
+                                             / a["tpot_p50"], 3),
+                              "unit": "x (on/off)"}))
+        if a.get("throughput") and b.get("throughput"):
+            print(json.dumps(
+                {"metric": "serve_trace_throughput_ratio",
+                 "value": round(b["throughput"] / a["throughput"], 3),
+                 "unit": "x (on/off)"}))
     if args.spec_ab:
         # the A/B verdict: decode cadence and throughput, spec over
         # plain, on the identical replayed load
@@ -486,7 +534,50 @@ def main(argv=None) -> int:
     return 0
 
 
-def _run_arm(args, arm: str, spec_on: bool, prompts, arrivals) -> dict:
+def _ttft_decomposition():
+    """Split each finished request's TTFT into its trace-derived phase
+    shares: queue wait (enqueue -> dequeue), admission prefill (the
+    admit/chunk span durations), and the remainder — scheduler gap +
+    the first decode segment's share. Returns (queue, prefill, gap)
+    second-lists over the requests whose enqueue AND first token are
+    still in the bounded ring."""
+    from paddle_tpu import tracing
+
+    per = {}
+    for e in tracing.events():
+        rid, ph = e.get("rid"), e["phase"]
+        if rid is None:
+            continue
+        d = per.setdefault(rid, {})
+        if ph == "queue.enqueue":
+            d["enq"] = e["ts_ns"]
+        elif ph == "queue.dequeue" and "deq" not in d:
+            d["deq"] = e["ts_ns"]
+        elif ph in ("admit", "admit.begin", "prefill_chunk"):
+            # only spans BEFORE the first token count toward TTFT: a
+            # preempted request's replay re-admission happens after it
+            # and must not inflate the prefill share (ring insertion
+            # order is end-time order, so the gate below is exact —
+            # the first admission's span lands before first_token)
+            if "first" not in d:
+                d["admit"] = d.get("admit", 0) + e["dur_ns"]
+        elif ph == "first_token" and "first" not in d:
+            d["first"] = e["ts_ns"]
+    qs, ps, gs = [], [], []
+    for d in per.values():
+        if "enq" not in d or "first" not in d:
+            continue
+        ttft = (d["first"] - d["enq"]) / 1e9
+        q = max((d.get("deq", d["enq"]) - d["enq"]) / 1e9, 0.0)
+        p = d.get("admit", 0) / 1e9
+        qs.append(q)
+        ps.append(p)
+        gs.append(max(ttft - q - p, 0.0))
+    return qs, ps, gs
+
+
+def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
+             arrivals) -> dict:
     """Build one server (in-process mode), drive the pre-drawn load
     through it, print the table + BENCH records (metric names suffixed
     ``_<arm>`` in A/B mode), shut down. Returns the numbers the A/B
@@ -495,9 +586,15 @@ def _run_arm(args, arm: str, spec_on: bool, prompts, arrivals) -> dict:
     server = None
     plan = None
     if args.url is None:
-        from paddle_tpu import monitor
+        from paddle_tpu import monitor, tracing
         monitor.enable()
         monitor.reset()    # per-arm program/compile counters
+        tracing.clear()    # per-arm ring (the off arm must not export
+        #                    the on arm's leftovers)
+        if trace_on:
+            tracing.enable()
+        else:
+            tracing.disable()
         server, vocab, plan = _build_toy_server(args, spec_on)
         assert vocab == _TOY_VOCAB, \
             f"toy model vocab {vocab} != {_TOY_VOCAB} the prompts used"
@@ -701,6 +798,30 @@ def _run_arm(args, arm: str, spec_on: bool, prompts, arrivals) -> dict:
                      "value": round(_percentile(rec, q), 6),
                      "unit": "s"}))
 
+    if server is not None and trace_on:
+        # trace-derived TTFT decomposition: WHICH phase ate the time.
+        # queue = submit->dequeue, prefill = the admission span(s),
+        # gap = the remainder (scheduler gap + first segment share) —
+        # the three sum to the server-side TTFT per request
+        qs, ps, gs = _ttft_decomposition()
+        if qs:
+            print(f"ttft decomposition (n={len(qs)}): queue p50 "
+                  f"{_percentile(qs, 50):.4f}s, prefill p50 "
+                  f"{_percentile(ps, 50):.4f}s, gap p50 "
+                  f"{_percentile(gs, 50):.4f}s")
+            for name, xs in (("queue", qs), ("prefill", ps),
+                             ("gap", gs)):
+                print(json.dumps(
+                    {"metric": f"serve_ttft_{name}_p50{sfx}",
+                     "value": round(_percentile(xs, 50), 6),
+                     "unit": "s"}))
+        if args.trace_out:
+            from paddle_tpu import tracing
+            tpath = args.trace_out + sfx
+            tracing.export_chrome(tpath)
+            print(f"wrote trace to {tpath} (open in chrome://tracing "
+                  f"or ui.perfetto.dev; tools/monitor_report.py "
+                  f"--trace {tpath} for the phase table)")
     if server is not None:
         if args.monitor_out:
             from paddle_tpu import monitor
@@ -708,6 +829,10 @@ def _run_arm(args, arm: str, spec_on: bool, prompts, arrivals) -> dict:
             n = monitor.write_jsonl(path)
             print(f"wrote {n} monitor samples to {path}")
         server.shutdown(drain=False)
+        if trace_on:
+            from paddle_tpu import tracing
+            tracing.disable()   # in-process callers (slow-tier tests)
+            #                     must not inherit a live recorder
     return {
         "tpot_p50": (_percentile(stats.tpot, 50) if stats.tpot
                      else None),
