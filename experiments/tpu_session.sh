@@ -129,6 +129,24 @@ STEP_TIMEOUT=2400 run python tools/serve_bench.py \
 STEP_TIMEOUT=2400 run python tools/serve_bench.py --trace-ab --layers 2 \
     --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
     --num-pages 64 --max-pages 16 --page-size 8 --warmup
+# 6f. on-TPU MULTI-REPLICA serve_bench (first hardware numbers for the
+#     serving.Router fleet tier, after the 6e trace capture): 3
+#     replica Servers on one chip (small pools so three engines fit),
+#     replica 0 killed mid-run — read serve_fleet_survival_rate (must
+#     stay 1.0), serve_failover_count, serve_failover_latency_p99,
+#     serve_breaker_opens, and compare the 1-replica arm's TTFT
+#     collapse vs the 3-replica arm (PERF.md "Fleet survival under
+#     replica loss"; CPU-tiny reference: TTFT p50 3.62s -> 1.49s).
+#     On-chip the rebuild window includes device reinit, so the
+#     1-replica arm honestly shows the outage the CPU run understates.
+STEP_TIMEOUT=2400 run python tools/serve_bench.py --router --replicas 1 \
+    --kill-replica-at 2 --layers 2 --prompt-len 4:16 --max-new 12 \
+    --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
+    --seed 3
+STEP_TIMEOUT=2400 run python tools/serve_bench.py --router --replicas 3 \
+    --kill-replica-at 2 --layers 2 --prompt-len 4:16 --max-new 12 \
+    --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
+    --seed 3
 # 7. the remaining BASELINE.md configs — one window should produce the
 #    full config table (VERDICT r4 Missing #3). Expected budgets: each
 #    is a small model + cached-compile candidate; ~5-10 min warm,

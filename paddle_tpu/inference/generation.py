@@ -870,6 +870,26 @@ class ContinuousBatchingEngine:
         not reach into the private ``_free`` list."""
         return len(self._free)
 
+    def load(self) -> dict:
+        """Host-side load snapshot: ``{"free_slots", "active_slots",
+        "max_batch"}`` plus, paged, ``{"free_pages", "total_pages",
+        "occupancy"}``. Everything is host bookkeeping already
+        maintained between segments — NO device sync, no HTTP, no lock
+        beyond what the ints themselves need — so a health endpoint or
+        a replica router can read it at any time, including while the
+        scheduler thread is deep inside a decode segment. Consumed by
+        ``Server.load()``/``/healthz`` and the router's least-loaded
+        replica selection."""
+        out = {"free_slots": len(self._free),
+               "active_slots": len(self._slot_req),
+               "max_batch": self.max_batch}
+        alloc = getattr(self, "alloc", None)
+        if alloc is not None:
+            out["free_pages"] = alloc.free_pages
+            out["total_pages"] = alloc.num_pages
+            out["occupancy"] = round(alloc.occupancy, 4)
+        return out
+
     def can_admit(self, prompt_len: int, cfg: GenerationConfig) -> bool:
         """Non-raising admission probe: True iff ``add_request`` with a
         ``prompt_len``-token prompt and ``cfg`` would succeed RIGHT NOW

@@ -52,6 +52,20 @@ request the pool cannot hold even alone fails alone with
 typed cause. ``Server.pressure()`` / the ``/healthz`` ``pressure``
 field expose occupancy, waiting-on-pages, and the preemption total.
 
+Fleet serving (README "Fleet serving"): :class:`Router` spreads
+requests over N replica Servers built from a :class:`ReplicaSpec` —
+health- and load-aware routing off each replica's lock-light
+``Server.load()`` snapshot, per-replica circuit breakers (open /
+half-open probe / close), FAILOVER REPLAY (a request whose replica
+dies or degrades mid-flight resubmits elsewhere as prompt + streamed
+tokens; greedy failover is bitwise-identical, the
+:class:`RouterHandle` keeps one stable rid and one uninterrupted
+``stream()``; bounded by ``max_failovers`` →
+:class:`FailoverBudgetExceeded`), supervised replica restarts with
+exponential backoff, and ``drain(i)`` / ``rolling_restart()`` for
+zero-downtime rollouts. ``serve_http(router)`` serves the same routes
+with fleet-aggregated ``/healthz``.
+
 Tracing & flight recorder (README "Tracing & flight recorder"): with
 ``FLAGS_enable_trace`` on, every lifecycle seam records a structured
 event into ``paddle_tpu.tracing``'s bounded ring — read one request's
@@ -85,6 +99,8 @@ from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
                     RUNNING, DeadlineExpired, QueueFull,
                     RequestCancelled, RequestFailed, RequestHandle,
                     RequestQueue, RequestRejected)
+from .router import (FailoverBudgetExceeded, FleetUnavailable,
+                     ReplicaSpec, Router, RouterHandle)
 from .scheduler import PreemptionBudgetExceeded, Server
 
 __all__ = [
@@ -93,5 +109,7 @@ __all__ = [
     "DeadlineExpired", "RequestFailed",
     "RequestFault", "EngineFault", "classify_fault",
     "PagePoolExhausted", "PreemptionBudgetExceeded",
+    "Router", "ReplicaSpec", "RouterHandle",
+    "FailoverBudgetExceeded", "FleetUnavailable",
     "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED", "FAILED",
 ]

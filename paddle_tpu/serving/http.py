@@ -33,15 +33,22 @@ Routes:
   (``shutdown``/``degraded``) — a request must never queue into a
   server that may never drain it.
 
-- ``GET /healthz`` — ``{"status": "warming"|"ok"|"degraded"|"draining"
-  |"failed"|"stopped", "queue_depth", "free_slots",
-  "active_requests", "restarts"}`` (load balancers drain on
-  non-"ok"). HTTP 200 only for "ok"/"draining"; everything else is
-  503: "warming" is the readiness gate (a ``Server(warmup=True)``
-  still pre-compiling — submissions already queue), "degraded" is the
-  stall-watchdog / mid-recovery signal, "failed" means the scheduler
-  died (body carries the status; ``restarts`` counts supervised
-  engine recoveries so far). A paged engine adds ``"pressure"``:
+- ``GET /healthz`` — the server's ``load()`` snapshot, verbatim (ONE
+  lock-light host-side read shared with the replica router):
+  ``{"status": "warming"|"ok"|"degraded"|"draining"|"failed"
+  |"stopped", "healthy", "queue_depth", "free_slots",
+  "active_requests", "active_slots", "max_batch", "restarts"[,
+  "free_pages", "total_pages", "occupancy"]}``. The HTTP code follows
+  ``healthy``: 200 for "ok"/"draining", 503 otherwise — "warming" is
+  the readiness gate (a ``Server(warmup=True)`` still pre-compiling —
+  submissions already queue), "degraded" is the stall-watchdog /
+  mid-recovery signal, "failed" means the scheduler died
+  (``restarts`` counts supervised engine recoveries so far). Fronting
+  a :class:`~paddle_tpu.serving.router.Router`, the same route serves
+  the FLEET snapshot — per-replica states, circuit-breaker status,
+  restart counts, flight-dump paths — and stays 200 while at least
+  one replica routes (one dead replica degrades a fleet, it does not
+  fail it). A paged engine adds ``"pressure"``:
   ``{"admission_mode", "occupancy", "free_pages",
   "waiting_on_pages", "preemptions"}`` — the KV memory-pressure
   surface that tells "degraded by memory pressure" (occupancy near
@@ -150,32 +157,19 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
         # -- routes ----------------------------------------------------------
         def do_GET(self):
             if self.path.startswith("/healthz"):
-                eng = server.engine
-                status = server.status
-                body = {
-                    "status": status,
-                    "queue_depth": server.queue.depth,
-                    "free_slots": eng.free_slots(),
-                    "active_requests": server.num_active(),
-                    "restarts": getattr(server, "restarts", 0),
-                }
-                # paged engines report KV memory pressure (occupancy,
-                # requests parked waiting on pages, preemption total)
-                # so operators can tell "degraded by memory pressure"
-                # apart from the stall/fault degraded reason
-                pressure = getattr(server, "pressure", None)
-                if pressure is not None:
-                    pressure = pressure()
-                if pressure is not None:
-                    body["pressure"] = pressure
-                # flight-recorder surface: the newest black-box dump
-                # path, so whoever watches health knows where the
-                # postmortem evidence landed
-                dumps = getattr(server, "flight_dumps", None)
-                if dumps:
-                    body["flight_dump"] = dumps[-1]
-                self._json(200 if status in ("ok", "draining") else 503,
-                           body)
+                # ONE host-side snapshot serves both a single Server
+                # and a Router fleet: ``load()`` carries status, queue
+                # depth, slot/page capacity, the KV-pressure block, the
+                # newest flight-recorder dump path — and, for a Router,
+                # the per-replica states + circuit-breaker status. The
+                # ``healthy`` verdict inside it decides 200 vs 503
+                # (Server: status ok/draining; Router: >= 1 routable
+                # replica — a fleet with one dead replica still takes
+                # traffic, and its healthz still names the casualty).
+                body = server.load()
+                healthy = body.get(
+                    "healthy", body.get("status") in ("ok", "draining"))
+                self._json(200 if healthy else 503, body)
             elif self.path.startswith("/trace"):
                 self._trace_response()
             elif (payload := monitor.http_payload(self.path)) is not None:

@@ -132,8 +132,14 @@ class RequestHandle:
         # trace key (paddle_tpu.tracing): the serving scheduler stamps
         # "<server_label>:<id>" at submit so concurrent servers' request
         # ids never collide in the process-wide ring; a bare handle
-        # (tests driving the queue directly) traces under its raw id
+        # (tests driving the queue directly) traces under its raw id.
+        # _trace_ttft: whether THIS handle's first push is the
+        # client-visible TTFT edge — False for a replica-inner handle
+        # living under a router-supplied rid (the RouterHandle emits
+        # the one true first_token; a failover resubmit's first push
+        # is mid-stream, not a TTFT edge)
         self._trace_rid = None
+        self._trace_ttft = True
 
     # -- client surface ------------------------------------------------------
     @property
@@ -199,7 +205,13 @@ class RequestHandle:
         request reaches a terminal state (a CANCELLED stream simply ends
         after the partial tokens). ``timeout`` bounds each wait for the
         NEXT token, not the whole stream; expiry raises TimeoutError.
-        EXPIRED/FAILED terminals re-raise like ``result()``."""
+        EXPIRED/FAILED terminals re-raise like ``result()``.
+
+        A raised TimeoutError ENDS the generator (Python generator
+        semantics — a later ``next()`` returns StopIteration, it does
+        not resume the wait): poll-style consumers should call
+        ``stream()`` again, or read ``tokens_so_far()``/``status``
+        directly the way the router's relay does."""
         sent = 0
         while True:
             with self._cv:
@@ -236,7 +248,7 @@ class RequestHandle:
                 self.first_token_ts = time.monotonic()
             self._tokens.extend(int(t) for t in tokens)
             self._cv.notify_all()
-        if first and trace.enabled():
+        if first and self._trace_ttft and trace.enabled():
             # the TTFT edge: serve_bench's trace-derived decomposition
             # splits submit->here into queue + prefill + gap shares
             trace.event("first_token",

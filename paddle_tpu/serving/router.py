@@ -1,0 +1,1266 @@
+"""Fleet-level fault tolerance: a health-aware replica router.
+
+Everything below this module is ONE ``serving.Server`` on one engine —
+survivable on its own (supervised recovery, pressure degradation, the
+stall watchdog), but a wedged or restarting engine still stalls every
+request in the process. THIS module is the scale-out half of the
+millions-of-users shape: a :class:`Router` owns N replicas (in-process
+:class:`~paddle_tpu.serving.scheduler.Server` instances built from a
+:class:`ReplicaSpec` factory — the same seam later fronts remote HTTP
+replicas) and turns "engine fault → backoff + replay" into "engine
+fault → traffic shifts, users never notice":
+
+- **health- and load-aware routing** — every pick reads each
+  replica's lock-light load-snapshot fields (the same host-side reads
+  ``Server.load()``/``/healthz`` report: status, queue depth, active
+  slots, free pages; no HTTP, no device sync) and routes to the
+  least-loaded replica whose status is ``ok`` — ``warming``,
+  ``degraded``, ``failed``, draining and restarting replicas are
+  excluded before a request ever touches them;
+- **per-replica circuit breakers** — ``breaker_threshold`` consecutive
+  submit/request failures OPEN the breaker (routing skips the replica
+  — no more hammering a dying engine while its own watchdog is still
+  counting down); after an exponential backoff the breaker goes
+  HALF-OPEN and admits exactly ONE probe request: success closes it,
+  failure re-opens with the backoff doubled;
+- **failover replay** — a request whose replica dies or degrades
+  mid-flight is resubmitted to a healthy replica as
+  ``prompt + tokens already streamed`` with the budget reduced by what
+  the client already has, so greedy failover is BITWISE-identical to
+  an unfaulted run (the same bar as the in-engine replay of PR 4: a
+  causal re-prefill of the same prefix). The router-level
+  :class:`RouterHandle` keeps ONE stable request id and ONE
+  uninterrupted ``stream()`` across replicas — the client never sees
+  the seam. Bounded by ``max_failovers``; past it the request fails
+  with :class:`FailoverBudgetExceeded` as its typed cause;
+- **replica supervision** — a monitor thread restarts crashed/failed
+  replicas from their spec with exponential backoff, bounded by
+  ``max_replica_restarts`` per replica (past it the replica is DEAD
+  and the fleet serves on what remains); :meth:`Router.drain` /
+  :meth:`Router.restart_replica` / :meth:`Router.rolling_restart`
+  drain ONE replica at a time while the rest serve — the fleet-level
+  analogue of ``engine.reset_state()``;
+- **one front door** — ``serve_http(router)`` proxies
+  ``POST /generate`` (streaming preserved across failover — the ndjson
+  stream rides the RouterHandle, not any one replica), aggregates
+  fleet ``GET /healthz`` (per-replica states + breaker status +
+  flight-dump paths via :meth:`Router.load`), and exports fleet
+  ``/metrics`` (the monitor registry is process-wide — every replica's
+  series plus the router's own land on one scrape endpoint).
+
+Thread model: ``submit`` spawns one daemon PUMP thread per request
+that owns that request's routing (pick replica → submit → relay the
+inner stream → fail over); the monitor thread only restarts replicas
+and never touches a live request; breaker/replica state transitions
+all happen under the router lock. Replica ``Server`` objects keep
+their own scheduler threads — the router never touches an engine.
+
+What counts against a replica (breaker + failover): submit rejections
+for REPLICA reasons (degraded / shutdown), an inner handle that FAILED
+with an engine-side cause, a replica that cancelled the request on its
+way down, and a replica observed ``degraded``/``failed`` mid-stream.
+What does NOT: request-scoped verdicts that would fail identically on
+any replica of the same spec — a prompt that can never fit
+(``ValueError`` / :class:`PagePoolExhausted`) fails the request, not
+the replica.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import monitor
+from .. import tracing as trace
+from ..inference.generation import (GenerationConfig, PagePoolExhausted,
+                                    _prompt_ids, _prompt_len)
+from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, _TERMINAL,
+                    RequestFailed, RequestHandle, RequestRejected)
+from .scheduler import PreemptionBudgetExceeded, Server
+
+__all__ = ["Router", "ReplicaSpec", "RouterHandle",
+           "FailoverBudgetExceeded", "FleetUnavailable",
+           "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN"]
+
+# circuit-breaker states (the `paddle_tpu_router_breaker_state` gauge
+# exports the numeric value; `load()` exports the name)
+BREAKER_CLOSED = 0
+BREAKER_HALF_OPEN = 1
+BREAKER_OPEN = 2
+_BREAKER_NAMES = {BREAKER_CLOSED: "closed",
+                  BREAKER_HALF_OPEN: "half_open",
+                  BREAKER_OPEN: "open"}
+
+
+class FailoverBudgetExceeded(RuntimeError):
+    """A request failed over more than ``max_failovers`` times: every
+    replica it landed on died under it. Clients see it as the
+    ``RequestFailed.__cause__`` of ``result()`` — a typed terminal
+    failure, not an endless migration."""
+
+
+class FleetUnavailable(RuntimeError):
+    """No replica can ever serve this request again: every replica is
+    permanently dead (its ``max_replica_restarts`` budget exhausted).
+    Distinct from a transient all-busy/all-restarting state, which the
+    router WAITS through."""
+
+
+class ReplicaSpec:
+    """Recipe for building one replica: an ``engine_factory`` callable
+    (returns a fresh engine each call) plus the ``Server(...)``
+    keyword arguments every build uses. The factory must build a
+    fresh MODEL per replica too — replica scheduler threads trace jit
+    programs concurrently, and the engines' ``substituted_state``
+    parameter swap is per-model, not thread-safe across sharers; seed
+    the construction (``paddle.seed(k)`` before each build) and the
+    deterministic init gives every replica bitwise-identical weights,
+    which is what makes greedy failover exact. The same seam later
+    fronts remote HTTP replicas: anything with
+    ``build() -> Server-shaped object`` routes."""
+
+    def __init__(self, engine_factory, server_kwargs: Optional[dict]
+                 = None):
+        if not callable(engine_factory):
+            raise ValueError("engine_factory must be callable "
+                             f"(got {engine_factory!r})")
+        self.engine_factory = engine_factory
+        self.server_kwargs = dict(server_kwargs or {})
+
+    def build(self) -> Server:
+        """Build (and start) one fresh replica Server."""
+        return Server(self.engine_factory(), **self.server_kwargs)
+
+
+class RouterHandle(RequestHandle):
+    """One router-level request: the SAME client surface as
+    :class:`RequestHandle` (``result()`` / ``stream()`` / ``cancel()``
+    / ``timeline()``), but the request id, the token stream, and the
+    trace timeline are all ROUTER-scoped — they survive any number of
+    replica failovers underneath. ``replica`` is the index currently
+    (or last) serving it; ``failovers`` counts migrations."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._inner: Optional[RequestHandle] = None   # current replica
+        #                                               handle (pump)
+        self._failovers = 0
+        self._ever_admitted = False   # once True the admission
+        #                               deadline no longer applies to
+        #                               resubmits (it was met once —
+        #                               same contract as PR 4 replay)
+        self.replica: Optional[int] = None
+
+    @property
+    def failovers(self) -> int:
+        return self._failovers
+
+    def cancel(self) -> None:
+        """Cancel the request (idempotent): flags the router pump AND
+        forwards to whichever replica currently runs it, so the slot
+        (and pages) there reclaim at its next gap."""
+        super().cancel()            # sets the flag + wakes the pump
+        inner = self._inner
+        if inner is not None:
+            inner.cancel()
+
+
+class _Replica:
+    """Router-side record for one replica slot (all mutable state is
+    guarded by the router lock)."""
+
+    __slots__ = ("index", "spec", "server", "breaker", "failures",
+                 "opens", "open_until", "backoff_mult", "probing",
+                 "restarts", "deliberate_restarts", "restart_at",
+                 "draining", "dead")
+
+    def __init__(self, index: int, spec: ReplicaSpec, server):
+        self.index = index
+        self.spec = spec
+        self.server = server
+        self.breaker = BREAKER_CLOSED
+        self.failures = 0          # consecutive failures (reset on
+        #                            success)
+        self.opens = 0             # lifetime breaker-open count
+        self.open_until = 0.0
+        self.backoff_mult = 1.0    # doubles per consecutive open,
+        #                            resets when the breaker closes
+        self.probing = False       # half-open: one probe in flight
+        self.restarts = 0          # supervised restarts consumed
+        #                            (the max_replica_restarts budget)
+        self.deliberate_restarts = 0   # rolling-restart rebuilds
+        #                                (budget-exempt: operator-run)
+        self.restart_at: Optional[float] = None   # backoff deadline
+        #                            while a restart is pending
+        self.draining = False      # deliberately excluded (drain /
+        #                            rolling restart)
+        self.dead = False          # restart budget exhausted
+
+    # both helpers mutate breaker/supervision state: caller holds the
+    # router lock
+    def reset_health(self, server=None) -> None:
+        """Back to a clean routable state (fresh build / deliberate
+        restart): failures forgotten, breaker closed, no probe, no
+        pending restart."""
+        if server is not None:
+            self.server = server
+        self.failures = 0
+        self.breaker = BREAKER_CLOSED
+        self.backoff_mult = 1.0
+        self.probing = False
+        self.restart_at = None
+        self.dead = False
+
+    def mark_dead(self) -> None:
+        """Restart budget exhausted: permanently out of rotation,
+        breaker pinned open."""
+        self.dead = True
+        self.breaker = BREAKER_OPEN
+        self.open_until = float("inf")
+        self.restart_at = None
+
+
+class Router:
+    """Front tier spreading requests over N replica Servers.
+
+    Usage::
+
+        model = LlamaForCausalLM(cfg)          # ONE model, N engines
+        spec = ReplicaSpec(
+            lambda: PagedContinuousBatchingEngine(
+                model, max_batch=4, num_pages=64, page_size=16,
+                max_pages=32),
+            server_kwargs={"segment_steps": 8})
+        router = Router(spec, replicas=3)
+        h = router.submit(prompt_ids, GenerationConfig(max_new_tokens=64))
+        for tok in h.stream():     # uninterrupted even if a replica dies
+            ...
+        router.shutdown()
+
+    Knobs:
+
+    - ``max_failovers`` — replica migrations any ONE request may
+      survive; past it: :class:`FailoverBudgetExceeded`;
+    - ``breaker_threshold`` / ``breaker_backoff_s`` /
+      ``breaker_backoff_max_s`` — consecutive failures before a
+      replica's breaker OPENs, and the (exponential, capped) backoff
+      before its half-open probe;
+    - ``max_replica_restarts`` / ``replica_backoff_s`` /
+      ``replica_backoff_max_s`` — supervised restarts per replica and
+      their exponential backoff; past the budget the replica is DEAD;
+    - ``monitor_interval_s`` — supervisor poll period (detection
+      latency for a crashed replica is at most one period + the
+      backoff);
+    - ``degraded_poll_s`` — how often a pump waiting on a silent
+      replica re-checks its health (a replica observed ``degraded`` /
+      ``failed`` mid-stream is abandoned and the request fails over);
+    - ``retry_wait_s`` — pump back-off while NO replica is routable
+      (all warming/restarting/open): the request waits instead of
+      failing, bounded by its own deadline and by the fleet going
+      permanently dead.
+    """
+
+    def __init__(self,
+                 specs: Union[ReplicaSpec, Sequence[ReplicaSpec]],
+                 replicas: Optional[int] = None, *,
+                 max_failovers: int = 2,
+                 breaker_threshold: int = 3,
+                 breaker_backoff_s: float = 0.25,
+                 breaker_backoff_max_s: float = 8.0,
+                 max_replica_restarts: int = 3,
+                 replica_backoff_s: float = 0.05,
+                 replica_backoff_max_s: float = 2.0,
+                 monitor_interval_s: float = 0.05,
+                 degraded_poll_s: float = 0.25,
+                 retry_wait_s: float = 0.02,
+                 start: bool = True):
+        if isinstance(specs, ReplicaSpec):
+            n = 1 if replicas is None else replicas
+            if n < 1:
+                raise ValueError(f"replicas must be >= 1, got {n}")
+            specs = [specs] * n
+        else:
+            specs = list(specs)
+            if replicas is not None and replicas != len(specs):
+                raise ValueError(
+                    f"replicas={replicas} contradicts the {len(specs)} "
+                    "specs passed; give one spec + replicas=N, or a "
+                    "list of specs")
+            if not specs:
+                raise ValueError("need at least one ReplicaSpec")
+        if max_failovers < 0 or max_replica_restarts < 0:
+            raise ValueError(
+                "max_failovers/max_replica_restarts must be >= 0")
+        if breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1, got "
+                f"{breaker_threshold}")
+        for name, v in (("breaker_backoff_s", breaker_backoff_s),
+                        ("replica_backoff_s", replica_backoff_s),
+                        ("monitor_interval_s", monitor_interval_s),
+                        ("degraded_poll_s", degraded_poll_s),
+                        ("retry_wait_s", retry_wait_s)):
+            if not v > 0:
+                raise ValueError(f"{name} must be > 0, got {v!r}")
+        self.max_failovers = max_failovers
+        self.breaker_threshold = breaker_threshold
+        self.breaker_backoff_s = breaker_backoff_s
+        self.breaker_backoff_max_s = breaker_backoff_max_s
+        self.max_replica_restarts = max_replica_restarts
+        self.replica_backoff_s = replica_backoff_s
+        self.replica_backoff_max_s = replica_backoff_max_s
+        self.monitor_interval_s = monitor_interval_s
+        self.degraded_poll_s = degraded_poll_s
+        self.retry_wait_s = retry_wait_s
+        self.monitor_router = monitor.instance_label("router")
+        # one spec shared by every replica: a capacity verdict
+        # (ValueError / PagePoolExhausted) from one replica holds for
+        # all of them; a heterogeneous list must try each spec before
+        # declaring a request unservable
+        self._homogeneous = all(s is specs[0] for s in specs)
+        self._lock = threading.Lock()
+        self._idle_cv = threading.Condition()
+        self._next_id = 0
+        self._handles: set = set()        # live RouterHandles (pumps
+        #                                   remove on terminal)
+        self._failovers_total = 0
+        self._draining = False
+        self._stopping = False
+        self._stop_evt = threading.Event()
+        # building a replica compiles nothing by itself (Server warmup
+        # is a spec knob) but does allocate device state — build them
+        # serially, before any thread exists, so a constructor failure
+        # leaves nothing half-started
+        self._replicas: List[_Replica] = []
+        try:
+            for i, spec in enumerate(specs):
+                if not isinstance(spec, ReplicaSpec):
+                    raise ValueError(
+                        f"specs[{i}] is not a ReplicaSpec: {spec!r}")
+                self._replicas.append(_Replica(i, spec, spec.build()))
+        except BaseException:
+            for rep in self._replicas:
+                try:
+                    rep.server.shutdown(drain=False, timeout=5.0)
+                except Exception:
+                    pass
+            raise
+        for rep in self._replicas:
+            self._breaker_metric(rep)
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name=f"paddle_tpu-router-monitor-{self.monitor_router}")
+        if start:
+            self._monitor_thread.start()
+
+    # -- client surface ------------------------------------------------------
+    def submit(self, prompt, cfg: Optional[GenerationConfig] = None,
+               priority: int = 0,
+               timeout_s: Optional[float] = None) -> RouterHandle:
+        """Route one request into the fleet; returns its
+        :class:`RouterHandle`. Raises
+        :class:`~paddle_tpu.serving.queue.RequestRejected` (reason
+        ``draining`` / ``shutdown`` / ``unavailable`` — the last only
+        when EVERY replica is permanently dead), ValueError for a
+        prompt that can never fit the replica engines. A fleet that is
+        merely busy/restarting ACCEPTS the request — the pump waits
+        for a routable replica (bounded by ``timeout_s``)."""
+        cfg = cfg or GenerationConfig()
+        plen = _prompt_len(prompt)
+        with self._lock:
+            if self._stopping:
+                raise RequestRejected("shutdown",
+                                      "router is shut down")
+            if self._draining:
+                raise RequestRejected(
+                    "draining",
+                    "router is draining; not accepting new requests")
+            if all(rep.dead for rep in self._replicas):
+                raise RequestRejected(
+                    "unavailable",
+                    "every replica is permanently dead "
+                    "(max_replica_restarts exhausted fleet-wide)")
+            # same-spec replicas share max_len: fail a can-never-fit
+            # prompt fast, before a pump cycles it through the fleet
+            max_len = max(getattr(rep.server.engine, "max_len", 1 << 30)
+                          for rep in self._replicas if not rep.dead)
+            if plen + cfg.max_new_tokens > max_len:
+                raise ValueError(
+                    f"prompt({plen}) + max_new_tokens"
+                    f"({cfg.max_new_tokens}) exceeds replica "
+                    f"max_len({max_len})")
+            deadline = (None if timeout_s is None
+                        else time.monotonic() + timeout_s)
+            h = RouterHandle(self._next_id, prompt, plen, cfg,
+                             priority, deadline)
+            h._trace_rid = f"{self.monitor_router}:{h.id}"
+            self._next_id += 1
+            self._handles.add(h)
+        pump = threading.Thread(
+            target=self._run_request, args=(h,), daemon=True,
+            name=f"paddle_tpu-router-pump-{self.monitor_router}-{h.id}")
+        pump.start()
+        return h
+
+    def request_timeline(self, request_id: int):
+        """One router request's ordered trace timeline by its public id
+        — spans BOTH replicas across a failover (the router stamps its
+        stable rid into every replica submit). Same contract as
+        ``RequestHandle.timeline()``."""
+        return trace.timeline(f"{self.monitor_router}:{request_id}")
+
+    def num_active(self) -> int:
+        """Router-level in-flight requests (pumps not yet terminal)."""
+        with self._lock:
+            return len(self._handles)
+
+    @property
+    def failovers(self) -> int:
+        """Total failovers performed over the router's lifetime."""
+        with self._lock:
+            return self._failovers_total
+
+    @property
+    def status(self) -> str:
+        """``ok`` (every replica routable) / ``degraded`` (some — or
+        transiently all — replicas down while the fleet lives:
+        restarting/warming/breaker-open replicas come back) /
+        ``failed`` (every replica PERMANENTLY dead — restart budgets
+        exhausted, nothing will ever route again) / ``draining`` /
+        ``stopped``. The HTTP 200/503 verdict is the separate
+        ``load()["healthy"]`` flag: >= 1 replica routable right
+        now."""
+        return self.load()["status"]
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until every (non-dead) replica finished warmup."""
+        end = (None if timeout is None
+               else time.monotonic() + timeout)
+        for rep in list(self._replicas):
+            t = (None if end is None
+                 else max(0.0, end - time.monotonic()))
+            if not rep.server.wait_ready(t):
+                return False
+        return True
+
+    def load(self) -> dict:
+        """The FLEET health/load snapshot — what ``/healthz`` serves
+        (the router quacks like a Server to ``serve_http``): top-level
+        ``{"status", "healthy", "router", "replicas": [...],
+        "queue_depth", "active_requests", "free_slots",
+        "inflight_requests", "failovers", "breaker_opens"}`` with one
+        entry per replica carrying its state (``dead`` / ``restarting``
+        / ``draining`` / the Server's own status), its breaker
+        ``{"state", "failures", "opens"}``, supervised ``restarts``,
+        its ``Server.load()`` numbers, and its flight-recorder dump
+        paths. ``healthy`` (the HTTP 200 verdict) is ">= 1 routable
+        replica and not stopping" — one dead replica degrades the
+        fleet, it does not fail it."""
+        with self._lock:
+            reps = list(self._replicas)
+            stopping = self._stopping
+            draining = self._draining
+            inflight = len(self._handles)
+            failovers = self._failovers_total
+        now = time.monotonic()
+        entries = []
+        routable = agg_q = agg_a = agg_f = opens = 0
+        for rep in reps:
+            try:
+                snap = rep.server.load()
+            except Exception:   # mid-swap / torn replica: skip numbers
+                snap = {"status": "unknown"}
+            if rep.dead:
+                state = "dead"
+            elif rep.restart_at is not None:
+                state = "restarting"
+            elif rep.draining:
+                state = "draining"
+            else:
+                state = snap["status"]
+            breaker = rep.breaker
+            if breaker == BREAKER_OPEN and now >= rep.open_until:
+                breaker = BREAKER_HALF_OPEN   # display-only: the next
+                #                               pick makes it official
+            entry = {
+                "replica": rep.index,
+                "status": state,
+                "breaker": {"state": _BREAKER_NAMES[breaker],
+                            "failures": rep.failures,
+                            "opens": rep.opens},
+                "restarts": rep.restarts,
+                "deliberate_restarts": rep.deliberate_restarts,
+                "load": {k: snap[k] for k in
+                         ("queue_depth", "active_requests",
+                          "free_slots", "free_pages", "occupancy")
+                         if k in snap},
+            }
+            dumps = []
+            try:
+                dumps = rep.server.flight_dumps
+            except Exception:
+                pass
+            if dumps:
+                entry["flight_dumps"] = dumps
+            entries.append(entry)
+            opens += rep.opens
+            if not rep.dead:
+                # queued/active work is real wherever it sits (a
+                # draining replica still finishes its requests) — but
+                # a dead server's finalizer reclaimed everything, so
+                # counting it would be phantom load
+                agg_q += snap.get("queue_depth", 0)
+                agg_a += snap.get("active_requests", 0)
+            if state == "ok" and breaker != BREAKER_OPEN:
+                routable += 1
+                # advertised capacity is ROUTABLE capacity only: a
+                # dead/draining/restarting/walled-off replica's free
+                # slots can't serve new traffic, and an autoscaler
+                # reading the aggregate must not see them
+                agg_f += snap.get("free_slots", 0)
+        if stopping:
+            status = "stopped"
+        elif all(r.dead for r in reps):
+            status = "failed"
+        elif draining:
+            status = "draining"
+        elif routable == len(reps):
+            status = "ok"
+        else:
+            # routable == 0 but not all dead reads "degraded", not
+            # "failed": restarting/warming/breaker-open replicas come
+            # back on their own (an all-warming fleet at boot is not
+            # an outage) — `healthy` carries the take-no-traffic fact
+            status = "degraded"
+        healthy = (not stopping and routable >= 1
+                   and not all(r.dead for r in reps))
+        return {"status": status, "healthy": healthy,
+                "router": self.monitor_router, "replicas": entries,
+                "queue_depth": agg_q, "active_requests": agg_a,
+                "free_slots": agg_f, "inflight_requests": inflight,
+                "failovers": failovers, "breaker_opens": opens}
+
+    # -- drain / rolling restart ---------------------------------------------
+    def drain(self, index: Optional[int] = None,
+              timeout: Optional[float] = None) -> bool:
+        """``drain()`` — FLEET drain: stop accepting new submissions
+        and wait for every in-flight router handle to reach a terminal
+        state (replays and failovers included). ``drain(i)`` — drain
+        ONE replica while the rest serve: exclude it from routing,
+        then ``Server.drain`` it (its queued + active requests run to
+        completion). A drained replica stays excluded until
+        :meth:`restart_replica` rebuilds it — ``Server.drain`` is
+        one-way, which is exactly the rolling-restart contract.
+        Returns True when everything finished in time."""
+        if index is not None:
+            rep = self._replicas[index]
+            with self._lock:
+                rep.draining = True
+            if trace.enabled():
+                trace.event("replica.drain", replica=index,
+                            router=self.monitor_router)
+            return rep.server.drain(timeout)
+        with self._lock:
+            self._draining = True
+        with self._idle_cv:
+            return self._idle_cv.wait_for(
+                lambda: not self._handles, timeout)
+
+    def restart_replica(self, index: int,
+                        timeout: Optional[float] = None,
+                        drain: bool = True) -> bool:
+        """Deliberately restart ONE replica: drain it (in-flight work
+        finishes; routing already excludes it), shut the old Server
+        down, build a fresh one from the spec, wait for its warmup,
+        and put it back in rotation with a CLOSED breaker. Returns the
+        drain verdict (True = nothing was cut short). The supervisor
+        thread ignores replicas mid-deliberate-restart, so the two
+        never fight over one slot."""
+        rep = self._replicas[index]
+        with self._lock:
+            # fence the supervisor off this slot for the WHOLE
+            # deliberate restart — with drain=False nothing else would
+            # set the flag, and a supervisor tick observing the old
+            # server "stopped" mid-swap would burn a supervised-restart
+            # budget unit and race-build a duplicate server
+            rep.draining = True
+        drained = self.drain(index, timeout) if drain else True
+        old = rep.server
+        try:
+            old.shutdown(drain=False, timeout=timeout)
+        except Exception:
+            pass
+        new = rep.spec.build()
+        new.wait_ready(timeout)
+        with self._lock:
+            # the operator's restart WINS a race against a concurrent
+            # supervisor install (possible with drain=False, where the
+            # draining flag never fenced the supervisor off) — but the
+            # interloper server must be stopped, not silently leaked
+            interloper = rep.server if rep.server is not old else None
+            rep.reset_health(server=new)
+            rep.draining = False
+            rep.deliberate_restarts += 1
+        if interloper is not None:
+            try:
+                interloper.shutdown(drain=False, timeout=5.0)
+            except Exception:
+                pass
+        self._breaker_metric(rep)
+        if monitor.enabled():
+            self._restarts_counter().labels(
+                router=self.monitor_router,
+                replica=str(index)).inc()
+        if trace.enabled():
+            trace.event("replica.restart", replica=index,
+                        deliberate=True, router=self.monitor_router)
+        return drained
+
+    def rolling_restart(self, timeout: Optional[float] = None) -> bool:
+        """Restart every replica ONE AT A TIME (drain → rebuild →
+        ready → next) while the rest keep serving — config/weight
+        rollouts without a maintenance window. Returns True when every
+        per-replica drain completed cleanly."""
+        if trace.enabled():
+            trace.event("rolling_restart", router=self.monitor_router,
+                        replicas=len(self._replicas))
+        ok = True
+        for i in range(len(self._replicas)):
+            ok = self.restart_replica(i, timeout) and ok
+        return ok
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the fleet: optionally drain (bounded by ``timeout``),
+        then stop the supervisor, shut every replica down (their
+        finalizers cancel whatever remains — pumps observe it and
+        finish their handles), and retire the router's metric
+        series."""
+        if drain:
+            self.drain(timeout=timeout)
+        with self._lock:
+            self._stopping = True
+            self._draining = True
+        self._stop_evt.set()
+        if self._monitor_thread.is_alive():
+            self._monitor_thread.join(timeout=5.0)
+        for rep in self._replicas:
+            try:
+                rep.server.shutdown(drain=False, timeout=timeout)
+            except Exception:
+                pass
+        # pumps unwind on their cancelled/failed inner handles; give
+        # them a bounded window so no handle is left non-terminal
+        with self._idle_cv:
+            self._idle_cv.wait_for(lambda: not self._handles, 10.0)
+        with self._lock:
+            leftovers = list(self._handles)
+        for h in leftovers:   # belt and braces: a wedged pump must not
+            #                   strand its client
+            h._finish(CANCELLED)
+        for name in ("paddle_tpu_router_requests_total",
+                     "paddle_tpu_router_failovers_total",
+                     "paddle_tpu_router_breaker_state",
+                     "paddle_tpu_router_replica_restarts_total"):
+            try:
+                monitor.remove_series(name, router=self.monitor_router)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self.shutdown(drain=False)
+
+    # -- monitor helpers -----------------------------------------------------
+    @staticmethod
+    def _requests_counter():
+        return monitor.counter(
+            "paddle_tpu_router_requests_total",
+            "router-level requests by replica and outcome "
+            "(completed/failed/cancelled/expired/failover — one "
+            "terminal count per request plus one per migration; "
+            "per-attempt backpressure lives on the replicas' "
+            "serving_requests_total{event=rejected_*})",
+            ("router", "replica", "outcome"))
+
+    @staticmethod
+    def _failovers_counter():
+        return monitor.counter(
+            "paddle_tpu_router_failovers_total",
+            "requests migrated to another replica after their replica "
+            "died or degraded mid-flight", ("router",))
+
+    @staticmethod
+    def _breaker_gauge():
+        return monitor.gauge(
+            "paddle_tpu_router_breaker_state",
+            "per-replica circuit breaker: 0 closed, 1 half-open, "
+            "2 open", ("router", "replica"))
+
+    @staticmethod
+    def _restarts_counter():
+        return monitor.counter(
+            "paddle_tpu_router_replica_restarts_total",
+            "replica Servers rebuilt from their spec (supervised "
+            "crash recovery + deliberate rolling restarts)",
+            ("router", "replica"))
+
+    def _count(self, outcome: str, replica) -> None:
+        if monitor.enabled():
+            self._requests_counter().labels(
+                router=self.monitor_router,
+                replica=("none" if replica is None else str(replica)),
+                outcome=outcome).inc()
+
+    def _breaker_metric(self, rep: _Replica) -> None:
+        if monitor.enabled():
+            self._breaker_gauge().labels(
+                router=self.monitor_router,
+                replica=str(rep.index)).set(rep.breaker)
+
+    # -- breaker transitions (router lock) -----------------------------------
+    def _replica_failure(self, rep: _Replica, srv, err,
+                         probe: bool) -> None:
+        """Record one replica-attributed failure: bump the consecutive
+        count, OPEN the breaker at the threshold (or immediately on a
+        failed half-open probe, with the backoff doubled). Failures
+        against an already-replaced Server are dropped — they must not
+        trip the fresh replica's breaker."""
+        with self._lock:
+            if rep.server is not srv:
+                return
+            if probe:
+                rep.probing = False
+            rep.failures += 1
+            opened = False
+            if (rep.breaker != BREAKER_OPEN
+                    and (probe
+                         or rep.failures >= self.breaker_threshold)):
+                if rep.breaker == BREAKER_HALF_OPEN:
+                    rep.backoff_mult *= 2.0
+                rep.breaker = BREAKER_OPEN
+                rep.opens += 1
+                backoff = min(
+                    self.breaker_backoff_s * rep.backoff_mult,
+                    self.breaker_backoff_max_s)
+                rep.open_until = time.monotonic() + backoff
+                opened = True
+        if opened:
+            self._breaker_metric(rep)
+            if trace.enabled():
+                trace.event("breaker", replica=rep.index, state="open",
+                            failures=rep.failures, cause=repr(err),
+                            router=self.monitor_router)
+
+    def _clear_probe(self, rep: _Replica, srv, probe: bool) -> None:
+        """Release a half-open probe slot on a verdict that is neither
+        replica-success nor replica-failure (user cancel, deadline
+        expiry, request-scoped terminal): the breaker stays HALF-OPEN
+        and the NEXT request becomes the new probe — without this the
+        abandoned probe would block every future pick forever. Same
+        server-identity guard as the other transition helpers: a
+        STALE probe's late verdict must not clear the slot a fresh
+        server's probe currently holds (two concurrent probes would
+        double the load on a recovering replica)."""
+        if not probe:
+            return
+        with self._lock:
+            if rep.server is srv:
+                rep.probing = False
+
+    def _replica_success(self, rep: _Replica, srv,
+                         probe: bool) -> None:
+        """A request made real progress on the replica (first token or
+        completion): reset the consecutive-failure count and CLOSE a
+        half-open breaker (the probe succeeded)."""
+        with self._lock:
+            if rep.server is not srv:
+                return
+            if probe:
+                rep.probing = False
+            rep.failures = 0
+            closed = rep.breaker != BREAKER_CLOSED
+            rep.breaker = BREAKER_CLOSED
+            rep.backoff_mult = 1.0
+        if closed:
+            self._breaker_metric(rep)
+            if trace.enabled():
+                trace.event("breaker", replica=rep.index,
+                            state="closed",
+                            router=self.monitor_router)
+
+    # -- routing -------------------------------------------------------------
+    def _acquire(self, exclude, hard=frozenset()):
+        """Pick the least-loaded routable replica: status ``ok``
+        (warming/degraded/failed/draining/restarting/dead excluded),
+        breaker not OPEN (an elapsed OPEN transitions to HALF-OPEN
+        here and admits this caller as its ONE probe). ``exclude``
+        skips the replica a failure just came from — unless it is the
+        only candidate; ``hard`` (replicas this request can NEVER fit
+        — heterogeneous fleets) is skipped unconditionally. Returns
+        ``(rep, server, probe)`` or ``(None, None, False)``."""
+        now = time.monotonic()
+        flipped = []
+        with self._lock:
+            cands = []
+            for rep in self._replicas:
+                if rep.index in hard:
+                    continue
+                if rep.dead or rep.draining or rep.restart_at is not None:
+                    continue
+                if rep.breaker == BREAKER_OPEN:
+                    if now < rep.open_until:
+                        continue
+                    rep.breaker = BREAKER_HALF_OPEN
+                    flipped.append(rep)
+                    half = True
+                else:
+                    half = rep.breaker == BREAKER_HALF_OPEN
+                if half and rep.probing:
+                    continue   # one probe at a time
+                cands.append((rep, half))
+            picks = [(r, hf) for r, hf in cands
+                     if r.index not in exclude] or cands
+            best = None
+            best_score = None
+            best_half = False
+            for rep, half in picks:
+                # the same host-side fields Server.load() reports,
+                # read directly: this runs per candidate per pick
+                # (and on every waiting pump's retry tick) under the
+                # router lock — materializing the whole /healthz
+                # payload here would serialize healthy routing behind
+                # the spin
+                srv2 = rep.server
+                try:
+                    if srv2.status != "ok":
+                        continue
+                    alloc = getattr(srv2.engine, "alloc", None)
+                    # least-loaded: what's queued + what's decoding
+                    # now; free pages break ties toward the roomier
+                    # KV pool
+                    score = (srv2.queue.depth + srv2.num_active(),
+                             -(alloc.free_pages if alloc is not None
+                               else 0))
+                except Exception:
+                    continue
+                if best_score is None or score < best_score:
+                    best, best_score, best_half = rep, score, half
+            if best is not None and best_half:
+                best.probing = True
+            srv = best.server if best is not None else None
+        for rep in flipped:   # gauge reflects the OPEN -> HALF_OPEN
+            #                   flip even for candidates not picked
+            self._breaker_metric(rep)
+            if trace.enabled():
+                trace.event("breaker", replica=rep.index,
+                            state="half_open",
+                            router=self.monitor_router)
+        if best is None:
+            return None, None, False
+        return best, srv, best_half
+
+    def _all_dead(self) -> bool:
+        with self._lock:
+            return all(rep.dead for rep in self._replicas)
+
+    def _live_indices(self) -> set:
+        with self._lock:
+            return {rep.index for rep in self._replicas
+                    if not rep.dead}
+
+    # -- the per-request pump ------------------------------------------------
+    def _run_request(self, h: RouterHandle) -> None:
+        try:
+            self._pump(h)
+        except BaseException as e:   # noqa: BLE001 - client must not hang
+            h._finish(FAILED, e)
+            self._count("failed", h.replica)
+        finally:
+            with self._lock:
+                self._handles.discard(h)
+            with self._idle_cv:
+                self._idle_cv.notify_all()
+
+    def _pump(self, h: RouterHandle) -> None:
+        """Own one request end to end: pick a replica, submit
+        ``prompt + tokens streamed so far`` with the remaining budget,
+        relay the inner stream into the router handle, and on a
+        replica-attributed failure park nothing — fail over
+        immediately (bounded by ``max_failovers``). Greedy failover is
+        bitwise-identical to an unfaulted run: the resubmit is a
+        causal re-prefill of the exact emitted prefix, the same
+        argument (and test bar) as the in-engine replay."""
+        last_err = None
+        exclude: set = set()
+        nofit: set = set()   # replicas whose CAPACITY verdict said
+        #                      this request can never fit there
+        #                      (heterogeneous fleets: per-spec, not
+        #                      per-fleet)
+        while True:
+            with self._lock:
+                stopping = self._stopping
+            if stopping or h._cancel_requested:
+                h._finish(CANCELLED)
+                self._count("cancelled", h.replica)
+                return
+            if (h.deadline is not None and not h._ever_admitted
+                    and time.monotonic() >= h.deadline):
+                h._finish(EXPIRED)
+                self._count("expired", h.replica)
+                return
+            done = h.tokens_so_far()
+            remaining = h.cfg.max_new_tokens - len(done)
+            if remaining < 1:   # budget fully streamed before the
+                #                 failover landed: simply finished
+                h._finish(FINISHED)
+                self._count("completed", h.replica)
+                return
+            rep, srv, probe = self._acquire(exclude,
+                                            hard=frozenset(nofit))
+            if rep is None:
+                if self._all_dead():
+                    h._finish(FAILED, FleetUnavailable(
+                        f"request {h.id}: every replica is permanently "
+                        f"dead (last error: {last_err!r})"))
+                    self._count("failed", h.replica)
+                    return
+                if nofit and self._live_indices() <= nofit:
+                    # every replica that could ever come back has
+                    # already given a capacity verdict: terminal
+                    h._finish(FAILED, last_err or RequestFailed(
+                        f"request {h.id} fits no replica"))
+                    self._count("failed", h.replica)
+                    return
+                # transient: all replicas warming / restarting /
+                # breaker-open — wait, bounded by the deadline check
+                # at the top of the loop
+                time.sleep(self.retry_wait_s)
+                exclude = set()
+                continue
+            ids = (np.concatenate(
+                [_prompt_ids(h.prompt)[0],
+                 np.asarray(done, np.int32)])
+                if done else h.prompt)
+            kw = dict(vars(h.cfg))
+            kw["max_new_tokens"] = remaining
+            rcfg = GenerationConfig(**kw)
+            # admission deadline: only until the FIRST successful
+            # admission (PR 4/5 replay semantics — met once is met)
+            t_s = None
+            if h.deadline is not None and not h._ever_admitted:
+                t_s = max(h.deadline - time.monotonic(), 1e-3)
+            try:
+                inner = srv.submit(ids, rcfg, priority=h.priority,
+                                   timeout_s=t_s,
+                                   trace_rid=h._trace_rid)
+            except RequestRejected as e:
+                # replica-attributed only when the REPLICA is the
+                # problem; queue_full is load, not sickness — routing
+                # just looks elsewhere
+                if e.reason in ("degraded", "shutdown"):
+                    self._replica_failure(rep, srv, e, probe)
+                else:
+                    self._clear_probe(rep, srv, probe)
+                last_err = e
+                exclude = {rep.index}
+                # NOT counted on the router requests counter: every
+                # other outcome there is per-request-terminal, and a
+                # waiting pump retries ~50x/s — the replica's own
+                # serving_requests_total{event=rejected_*} already
+                # counts backpressure per attempt
+                # a rejection (queue_full on every replica, say) must
+                # not busy-spin the pump: one retry tick of backoff
+                time.sleep(self.retry_wait_s)
+                continue
+            except ValueError as e:   # capacity verdict: this request
+                #                       can never fit THIS replica
+                self._clear_probe(rep, srv, probe)
+                if self._homogeneous:
+                    # same spec everywhere: the verdict is fleet-wide
+                    h._finish(FAILED, e)
+                    self._count("failed", rep.index)
+                    return
+                nofit.add(rep.index)
+                last_err = e
+                continue   # a larger-spec replica may still hold it;
+                #            the no-replica branch above terminals
+                #            once every live replica has said no
+            except Exception as e:    # server died mid-submit
+                self._replica_failure(rep, srv, e, probe)
+                last_err = e
+                exclude = {rep.index}
+                continue
+            h._inner = inner
+            h.replica = rep.index
+            if h._cancel_requested:
+                inner.cancel()
+            if trace.enabled():
+                trace.event("route", rid=h._trace_rid,
+                            replica=rep.index,
+                            failovers=h._failovers,
+                            resubmit=bool(done),
+                            router=self.monitor_router)
+            verdict, err = self._relay(h, rep, srv, inner, probe)
+            if verdict == "finished":
+                h._finish(FINISHED)
+                self._count("completed", rep.index)
+                return
+            if verdict == "cancelled":
+                self._clear_probe(rep, srv, probe)
+                h._finish(CANCELLED)
+                self._count("cancelled", rep.index)
+                return
+            if verdict == "expired":
+                self._clear_probe(rep, srv, probe)
+                h._finish(EXPIRED)
+                self._count("expired", rep.index)
+                return
+            if verdict == "terminal":
+                self._clear_probe(rep, srv, probe)
+                if not self._homogeneous:
+                    # per-replica capacity verdict (PagePoolExhausted
+                    # is pool-size-dependent): a roomier spec may
+                    # still serve the request
+                    nofit.add(rep.index)
+                    last_err = err
+                    continue
+                h._finish(FAILED, err)
+                self._count("failed", rep.index)
+                return
+            # verdict == "failover" (the replica died/degraded under a
+            # live request — breaker-accountable) or "overload" (a
+            # pressure verdict: migrate, but the replica stays in good
+            # standing). Both consume the failover budget: a request
+            # bouncing between pressured pools must still terminate,
+            # and FailoverBudgetExceeded chains the pressure cause.
+            if verdict == "overload":
+                self._clear_probe(rep, srv, probe)
+            else:
+                self._replica_failure(rep, srv, err, probe)
+            with self._lock:
+                stopping = self._stopping
+            if stopping or h._cancel_requested:
+                continue   # loop head finishes it CANCELLED (a fleet
+                #            shutdown is not a failover)
+            h._failovers += 1
+            with self._lock:
+                self._failovers_total += 1
+            self._count("failover", rep.index)
+            if monitor.enabled():
+                self._failovers_counter().labels(
+                    router=self.monitor_router).inc()
+            if trace.enabled():
+                trace.event("failover", rid=h._trace_rid,
+                            replica=rep.index, n=h._failovers,
+                            emitted=len(h.tokens_so_far()),
+                            cause=repr(err),
+                            router=self.monitor_router)
+            if h._failovers > self.max_failovers:
+                h._finish(FAILED, FailoverBudgetExceeded(
+                    f"request {h.id} failed over {h._failovers} times "
+                    f"(max_failovers={self.max_failovers}); last "
+                    f"replica fault: {err!r}"))
+                self._count("failed", rep.index)
+                return
+            last_err = err
+            exclude = {rep.index}
+
+    @staticmethod
+    def _wait_progress(inner, sent: int, timeout: float):
+        """Wait (bounded) for the inner handle to grow past ``sent``
+        tokens or reach a terminal state; returns
+        ``(delta, status, err)`` read atomically under the handle's
+        condition — at a terminal state the delta IS everything that
+        remains, so a failover's resubmit prefix is never torn."""
+        with inner._cv:
+            inner._cv.wait_for(
+                lambda: (len(inner._tokens) > sent
+                         or inner._status in _TERMINAL), timeout)
+            return (list(inner._tokens[sent:]), inner._status,
+                    inner._error)
+
+    def _relay(self, h: RouterHandle, rep: _Replica, srv, inner,
+               probe: bool):
+        """Relay one inner handle's tokens into the router handle.
+        Returns ``(verdict, err)`` with verdict one of ``finished`` /
+        ``cancelled`` (user cancel) / ``expired`` / ``terminal``
+        (request-scoped failure any replica would repeat) /
+        ``failover`` (replica-attributed — resubmit elsewhere)."""
+        sent = 0
+        got_any = False
+        while True:
+            delta, status, err = self._wait_progress(
+                inner, sent, self.degraded_poll_s)
+            if (inner.engine_rid is not None
+                    and h.engine_rid != inner.engine_rid):
+                # the replica COMPLETED this request's admission: the
+                # admission deadline is met (PR 4/5 replay semantics —
+                # met once is met), so a later failover must REPLAY,
+                # never expire, it — even if the replica dies between
+                # admission and the first token reaching the pump.
+                # The ROUTER handle goes RUNNING here too, tracking
+                # the CURRENT engine rid (same client surface as
+                # RequestHandle: status must not read "queued" while
+                # tokens stream)
+                h._ever_admitted = True
+                h._mark_running(inner.engine_rid)
+            if delta:
+                sent += len(delta)
+                h._push(delta)
+                h._n_pushed += len(delta)
+                if not got_any:
+                    got_any = True
+                    # first token = the replica admitted AND decoded:
+                    # the half-open probe's success signal (don't hold
+                    # the breaker hostage to a long generation)
+                    self._replica_success(rep, srv, probe)
+            if status == FINISHED:
+                self._replica_success(rep, srv, probe)
+                return "finished", None
+            if status == CANCELLED:
+                # either the user asked, or the replica cancelled it
+                # on its way down (shutdown finalizer) — the latter is
+                # a failover
+                if h._cancel_requested:
+                    return "cancelled", None
+                return "failover", RuntimeError(
+                    f"replica {rep.index} cancelled the request on "
+                    "its way down")
+            if status == EXPIRED:
+                return "expired", None
+            if status == FAILED:
+                if isinstance(err, (ValueError, PagePoolExhausted)):
+                    # request-scoped capacity verdict: identical
+                    # replicas would all repeat it — fail the request,
+                    # spare the fleet
+                    return "terminal", err
+                if isinstance(err, PreemptionBudgetExceeded):
+                    # a LOAD verdict, not sickness (the replica is
+                    # healthy, its pool is just thrashing): migrate
+                    # the request — another replica may have room —
+                    # but do NOT blame the breaker, or a pressured
+                    # fleet walls off its own healthy replicas and
+                    # cascades the load onto equally pressured peers
+                    return "overload", err
+                return "failover", (err if err is not None
+                                    else RequestFailed(
+                                        f"replica {rep.index} failed "
+                                        "the request"))
+            if not delta:
+                # a silent poll tick: re-check the replica's health
+                # instead of waiting on a corpse — this is how a
+                # DEGRADED (stalled) replica loses its live requests
+                # before its own watchdog even recovers
+                st = srv.status
+                if st in ("degraded", "failed", "stopped"):
+                    inner.cancel()   # if it un-wedges, reclaim there
+                    return "failover", RuntimeError(
+                        f"replica {rep.index} {st} mid-stream")
+                if h._cancel_requested:
+                    inner.cancel()
+
+    # -- replica supervision (monitor thread) --------------------------------
+    def _monitor_loop(self) -> None:
+        """Restart crashed/failed replicas from their spec with
+        exponential backoff. Detection: ``Server.status`` in
+        ``failed``/``stopped`` outside a deliberate drain/restart.
+        Budget: ``max_replica_restarts`` per replica; past it the
+        replica is DEAD (breaker pinned open, fleet serves on)."""
+        while not self._stop_evt.wait(self.monitor_interval_s):
+            for rep in list(self._replicas):
+                self._supervise(rep)
+
+    def _supervise(self, rep: _Replica) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if (self._stopping or rep.dead or rep.draining):
+                return
+            srv = rep.server
+            pending = rep.restart_at
+        if pending is None:
+            if srv.status not in ("failed", "stopped"):
+                return
+            with self._lock:
+                if rep.server is not srv or rep.draining:
+                    return
+                if rep.restarts >= self.max_replica_restarts:
+                    rep.mark_dead()
+                    self._breaker_metric(rep)
+                    if trace.enabled():
+                        trace.event(
+                            "replica.dead", replica=rep.index,
+                            restarts=rep.restarts,
+                            router=self.monitor_router)
+                    return
+                rep.restarts += 1
+                delay = self._backoff_delay(rep.restarts)
+                rep.restart_at = now + delay
+            if trace.enabled():
+                trace.event("replica.backoff", replica=rep.index,
+                            restarts=rep.restarts,
+                            delay_s=round(delay, 4),
+                            router=self.monitor_router)
+            return
+        if now < pending:
+            return
+        # backoff elapsed: rebuild OUTSIDE the lock (engine/device
+        # construction takes real time; routing must not block on it)
+        try:
+            try:
+                srv.shutdown(drain=False, timeout=2.0)
+            except Exception:
+                pass
+            new = rep.spec.build()
+        except Exception as e:
+            with self._lock:
+                if (rep.server is not srv or rep.draining
+                        or self._stopping):
+                    return   # the slot changed hands mid-build (a
+                    #          deliberate restart/shutdown): not ours
+                    #          to mark dead or re-schedule
+                if rep.restarts >= self.max_replica_restarts:
+                    rep.mark_dead()
+                else:
+                    rep.restarts += 1
+                    rep.restart_at = (time.monotonic()
+                                      + self._backoff_delay(
+                                          rep.restarts))
+            self._breaker_metric(rep)
+            if trace.enabled():
+                trace.event("replica.rebuild_failed",
+                            replica=rep.index, cause=repr(e),
+                            router=self.monitor_router)
+            return
+        with self._lock:
+            if rep.server is not srv or rep.draining or self._stopping:
+                stale = new   # a deliberate restart_replica (or a
+                #               shutdown) won the race while we built:
+                #               ITS server stays — ours must not
+                #               silently replace and leak it
+            else:
+                stale = None
+                rep.reset_health(server=new)
+        if stale is not None:
+            try:
+                stale.shutdown(drain=False, timeout=5.0)
+            except Exception:
+                pass
+            return
+        self._breaker_metric(rep)
+        if monitor.enabled():
+            self._restarts_counter().labels(
+                router=self.monitor_router,
+                replica=str(rep.index)).inc()
+        if trace.enabled():
+            trace.event("replica.restart", replica=rep.index,
+                        restarts=rep.restarts, deliberate=False,
+                        router=self.monitor_router)
+
+    def _backoff_delay(self, restarts: int) -> float:
+        """Exponential supervised-restart backoff before attempt
+        ``restarts`` (1-based), capped at
+        ``replica_backoff_max_s``."""
+        return min(self.replica_backoff_s * (2 ** (restarts - 1)),
+                   self.replica_backoff_max_s)

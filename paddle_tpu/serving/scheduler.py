@@ -362,7 +362,8 @@ class Server:
     # -- client surface ------------------------------------------------------
     def submit(self, prompt, cfg: Optional[GenerationConfig] = None,
                priority: int = 0,
-               timeout_s: Optional[float] = None) -> RequestHandle:
+               timeout_s: Optional[float] = None,
+               trace_rid: Optional[str] = None) -> RequestHandle:
         """Enqueue one request; returns its :class:`RequestHandle`.
 
         ``cfg`` is the request's OWN GenerationConfig (validated at
@@ -370,6 +371,11 @@ class Server:
         segment); ``priority`` orders admission (lower first);
         ``timeout_s`` sets an admission deadline — a request still
         queued when it passes is EXPIRED, never admitted.
+        ``trace_rid`` overrides the trace key this request's lifecycle
+        events are recorded under (default
+        ``<server_label>:<handle id>``) — the replica router passes its
+        OWN stable key here so one request's timeline stays whole
+        across a failover to a different replica.
 
         Raises :class:`RequestRejected` (reason ``queue_full`` /
         ``draining`` / ``degraded`` / ``shutdown``) for backpressure,
@@ -423,7 +429,15 @@ class Server:
             # the trace key pairs the server label with the request id:
             # concurrent servers in one process restart their ids at 0,
             # and the process-wide ring must not merge their timelines
-            handle._trace_rid = f"{self.monitor_server}:{handle.id}"
+            # (a router-supplied key replaces it so a failover's second
+            # replica keeps appending to the SAME timeline)
+            handle._trace_rid = (trace_rid if trace_rid is not None
+                                 else f"{self.monitor_server}:{handle.id}")
+            # under a router-supplied rid this handle is replica-inner
+            # plumbing: the ROUTER handle owns the one first_token
+            # (TTFT) edge — a failover resubmit's first push here is
+            # mid-stream, not a TTFT edge
+            handle._trace_ttft = trace_rid is None
             self._next_id += 1
             try:
                 self.queue.put(handle)
@@ -583,6 +597,47 @@ class Server:
             with self._lock:
                 self._flight_dumps.append(path)
         return path
+
+    def load(self) -> dict:
+        """ONE lock-light, host-side load/health snapshot — the single
+        source both ``/healthz`` and the replica router's least-loaded
+        selection consume (no HTTP hop, no device sync):
+
+        ``{"status", "healthy", "server", "queue_depth",
+        "active_requests", "restarts", "free_slots", "active_slots",
+        "max_batch"[, "free_pages", "total_pages", "occupancy"]
+        [, "pressure"][, "flight_dump"]}``
+
+        ``healthy`` is the HTTP readiness verdict (``status`` in
+        ``ok``/``draining`` — what ``/healthz`` turns into 200 vs 503).
+        Every field is host bookkeeping: the queue and status locks are
+        held only for single reads/writes, never across engine work, so
+        this NEVER blocks behind a slow (or wedged) scheduler step —
+        the property that lets a router keep routing around a sick
+        replica while its watchdog is still counting down."""
+        status = self.status
+        snap = {
+            "status": status,
+            "healthy": status in ("ok", "draining"),
+            "server": self.monitor_server,
+            "queue_depth": self.queue.depth,
+            # len() of a dict the scheduler thread mutates is a single
+            # atomic read — no lock, no torn state
+            "active_requests": len(self._active),
+            "restarts": self._restarts,
+        }
+        eload = getattr(self.engine, "load", None)
+        if eload is not None:
+            snap.update(eload())
+        else:   # minimal engines: keep the probe surface alive
+            snap["free_slots"] = self.engine.free_slots()
+        p = self.pressure()
+        if p is not None:
+            snap["pressure"] = p
+        with self._lock:
+            if self._flight_dumps:
+                snap["flight_dump"] = self._flight_dumps[-1]
+        return snap
 
     def pressure(self):
         """KV memory-pressure snapshot (None for a dense engine):

@@ -148,6 +148,43 @@ class FaultPlan:
                           rate=rate, seed=seed + i))
         return self
 
+    def kill(self, site: str = "decode", nth: int = 1, exc=None,
+             action: str = "raise",
+             seconds: float = 3600.0) -> "FaultPlan":
+        """REPLICA-KILL seam: from call ``nth`` (1-based; relative to
+        calls already made, so a mid-run ``plan.kill()`` fires on the
+        very next seam call) the replica is DEAD — every subsequent
+        call to ``site`` raises a fresh
+        :class:`~paddle_tpu.inference.generation.EngineFault` (default
+        ``exc``; pass a class/factory to change it). Behind a
+        ``Server(max_restarts=0)`` the first fault kills the replica's
+        scheduler; with restarts left, every recovery re-faults until
+        the budget exhausts — either way the replica ends ``failed``,
+        which is what a router's supervision and failover must absorb.
+        ``action="hang"`` is the WEDGED variant (each call blocks
+        ``seconds``, releasable via :meth:`release_hangs`) — drives
+        the watchdog-degraded path a router abandons without the
+        replica ever announcing failure.
+
+        Callable mid-run from any thread (the bench's
+        ``--kill-replica-at`` timer): the rule lands under the plan
+        lock like any other."""
+        if action not in ("raise", "hang"):
+            raise ValueError(
+                f"action must be 'raise' or 'hang', got {action!r}")
+        if exc is None and action == "raise":
+            from ..inference.generation import EngineFault
+            exc = (lambda: EngineFault(
+                f"replica killed (injected @ {site})"))
+        with self._lock:
+            # arm relative to the CURRENT call count: "kill now" means
+            # the next call, not the nth since the dawn of the plan
+            first = self.calls.get(site, 0) + nth
+            self._rules.append(
+                _Rule(site, first, 2 ** 31, action, exc,
+                      seconds=seconds))
+        return self
+
     def release_hangs(self) -> None:
         """End every in-flight (and future) hang immediately."""
         self._release.set()

@@ -5,7 +5,6 @@ worker PROCESSES fetch+collate in parallel, results return in sampler
 order, worker exceptions propagate, and Python-heavy (GIL-bound)
 transforms actually speed up — the thread pool cannot deliver that.
 """
-import functools
 import os
 import time
 
@@ -13,37 +12,10 @@ import numpy as np
 import pytest
 
 from paddle_tpu.io import DataLoader, Dataset
-
-
-def retry_under_load(fn, attempts=3):
-    """The multiprocess-worker tests are LOAD-flaky: they pass alone
-    but can time out or under-parallelize when the full tier-1 run has
-    every core busy (worker processes starve behind the suite). Retry
-    a couple of times with backoff; if the failure persists WHILE the
-    box is demonstrably overloaded, xfail with the evidence instead of
-    polluting the tier-1 signal — on an idle box the failure still
-    fails loudly (a real regression must not hide behind the load
-    excuse)."""
-
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        last = None
-        for attempt in range(attempts):
-            try:
-                return fn(*args, **kwargs)
-            except Exception as e:   # noqa: BLE001 - rethrown below
-                last = e
-                if attempt < attempts - 1:
-                    time.sleep(0.5 * (attempt + 1))
-        load = os.getloadavg()[0] if hasattr(os, "getloadavg") else 0.0
-        ncpu = os.cpu_count() or 1
-        if load > ncpu:
-            pytest.xfail(
-                f"load-flaky mp test failed {attempts}x under load "
-                f"(loadavg {load:.1f} > {ncpu} cpus): {last!r}")
-        raise last
-
-    return wrapper
+# the retry wrapper moved to paddle_tpu.testing so every wall-clock-
+# sensitive suite (mp dataloader, serving watchdog timing, the router
+# chaos tests) shares one load-flakiness policy
+from paddle_tpu.testing import retry_under_load
 
 
 class RangeDs(Dataset):

@@ -87,6 +87,10 @@ SERVING_FAMILIES = (
     "paddle_tpu_serving_",              # queue depth, TTFT, TPOT, events,
     #                                     faults, restarts, degraded,
     #                                     recovery, kv_pressure
+    "paddle_tpu_router_",               # fleet tier: requests by
+    #                                     {replica,outcome}, failovers,
+    #                                     breaker_state gauge, replica
+    #                                     restarts
     "paddle_tpu_requests_total",        # engine lifecycle events
     "paddle_tpu_generated_tokens_total",
     "paddle_tpu_decode_tokens_per_sec",

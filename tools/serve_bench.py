@@ -56,6 +56,12 @@ Usage::
     # _spec, serve_spec_tokens_per_forward and the acceptance rate
     python tools/serve_bench.py --spec-ab --draft-k 6 --repeat-unit 4 \
         --prompt-len 16:24 --max-new 24 --warmup
+    # fleet survival A/B (PERF.md fleet-survival methodology): the SAME
+    # load + fault plan (kill replica 0 at t=2s) through 1 replica vs 3
+    # — read serve_fleet_survival_rate, serve_failover_count,
+    # serve_failover_latency_p99, serve_breaker_opens across the runs
+    python tools/serve_bench.py --router --replicas 1 --kill-replica-at 2
+    python tools/serve_bench.py --router --replicas 3 --kill-replica-at 2
     # request-lifecycle tracing (PERF.md tracing methodology): capture
     # a Chrome-trace/Perfetto file of the whole run and report the
     # trace-derived TTFT decomposition (queue vs prefill vs gap share)
@@ -104,11 +110,16 @@ class _Stats:
         #                           (in-process mode only) — the
         #                           preemption latency penalty is the
         #                           mean gap vs the unpreempted ones
+        self.e2e_failover = []    # e2e of requests that failed over to
+        #                           another replica (--router mode) —
+        #                           serve_failover_latency_p99 is the
+        #                           tail a migrated request pays
         self.tokens = 0
         self.rejected = 0
         self.failed = 0
 
-    def record(self, ttft, tpot, e2e, n_tokens, preempted=False):
+    def record(self, ttft, tpot, e2e, n_tokens, preempted=False,
+               failover=False):
         with self.lock:
             if ttft is not None:
                 self.ttft.append(ttft)
@@ -117,6 +128,8 @@ class _Stats:
             self.e2e.append(e2e)
             if preempted:
                 self.e2e_preempted.append(e2e)
+            if failover:
+                self.e2e_failover.append(e2e)
             self.tokens += n_tokens
 
     def reject(self):
@@ -157,7 +170,8 @@ def _drive_inproc(server, prompt, cfg, stats):
                  None if (n < 2 or first is None) else (last - first)
                  / (n - 1),
                  end - t0, n,
-                 preempted=getattr(handle, "_preempts", 0) > 0)
+                 preempted=getattr(handle, "_preempts", 0) > 0,
+                 failover=getattr(handle, "_failovers", 0) > 0)
 
 
 def _drive_http(url, prompt, cfg_body, stats):
@@ -218,14 +232,16 @@ def _drive_http(url, prompt, cfg_body, stats):
 _TOY_VOCAB = 256
 
 
-def _build_toy_server(args, speculative: bool = False):
-    import numpy as np  # noqa: F401
-
+def _toy_engine(args, speculative: bool = False):
+    """Build one seeded toy engine from the CLI knobs — the ONE place
+    the engine kwargs live, shared by the single-server and router
+    builders (a knob added to one mode must not silently benchmark a
+    differently-configured engine in the other). Returns
+    (engine, vocab_size)."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.generation import (
         PagedContinuousBatchingEngine)
     from paddle_tpu.models import LlamaForCausalLM, llama_config
-    from paddle_tpu.serving import Server
 
     paddle.seed(0)
     cfg = llama_config("tiny", num_hidden_layers=args.layers)
@@ -244,6 +260,26 @@ def _build_toy_server(args, speculative: bool = False):
         kv_watermark=args.kv_watermark,
         prefix_cache=(args.cache_prefixes == "on"),
         draft_k=(args.draft_k if speculative else 0))
+    return eng, cfg.vocab_size
+
+
+def _toy_server_kwargs(args, max_restarts=None):
+    """Server knobs from the CLI — shared by both builders."""
+    return dict(
+        max_queue=args.max_queue, segment_steps=args.segment_steps,
+        warmup=args.warmup,
+        max_restarts=(args.max_restarts if max_restarts is None
+                      else max_restarts),
+        max_replays=args.max_replays,
+        max_preemptions=args.max_preemptions,
+        restart_backoff_s=args.restart_backoff,
+        stall_timeout_s=args.stall_timeout)
+
+
+def _build_toy_server(args, speculative: bool = False):
+    from paddle_tpu.serving import Server
+
+    eng, vocab = _toy_engine(args, speculative)
     plan = None
     if args.fault_rate > 0:
         from paddle_tpu.inference.generation import EngineFault
@@ -273,16 +309,60 @@ def _build_toy_server(args, speculative: bool = False):
         plan.random_raises(sites, args.fault_rate, seed=args.seed,
                            exc=exc)
         eng = FaultyEngine(eng, plan)
-    srv = Server(eng, max_queue=args.max_queue,
-                 segment_steps=args.segment_steps, warmup=args.warmup,
-                 max_restarts=args.max_restarts,
-                 max_replays=args.max_replays,
-                 max_preemptions=args.max_preemptions,
-                 restart_backoff_s=args.restart_backoff,
-                 stall_timeout_s=args.stall_timeout,
-                 speculative=speculative)
+    srv = Server(eng, speculative=speculative,
+                 **_toy_server_kwargs(args))
     srv.wait_ready()   # warmup compiles are NOT part of the measured run
-    return srv, cfg.vocab_size, plan
+    return srv, vocab, plan
+
+
+def _build_toy_router(args):
+    """Fleet mode (--replicas N / --router): a Router over N in-process
+    replica Servers built from one ReplicaSpec. Each replica gets its
+    OWN seeded model (deterministic init -> bitwise-identical weights
+    across the fleet, the property greedy failover parity rides on).
+    With --kill-replica-at T, the FIRST build of replica 0 is wrapped
+    in a FaultyEngine whose plan the timer kills mid-run; the
+    supervisor's rebuild comes up clean. Returns
+    (router, vocab, kill_fn)."""
+    from paddle_tpu.serving import ReplicaSpec, Router
+    from paddle_tpu.testing.faults import FaultPlan, FaultyEngine
+
+    kill_plan = FaultPlan()
+    builds = {"n": 0}
+    vocab = {}
+
+    def factory():
+        i = builds["n"]
+        builds["n"] += 1
+        eng, vocab["size"] = _toy_engine(args)
+        if i == 0 and args.kill_replica_at is not None:
+            return FaultyEngine(eng, kill_plan)
+        return eng
+
+    spec = ReplicaSpec(factory, server_kwargs=_toy_server_kwargs(
+        args,
+        # a killed replica must DIE (the router absorbs it), not spin
+        # its own restart budget against a permanent fault plan
+        max_restarts=(0 if args.kill_replica_at is not None
+                      else None)))
+    router = Router(spec, replicas=args.replicas,
+                    max_failovers=args.max_failovers,
+                    breaker_threshold=args.breaker_threshold,
+                    replica_backoff_s=args.replica_backoff,
+                    monitor_interval_s=0.05)
+    router.wait_ready()
+
+    fired = {"kill": False}
+
+    def kill_fn():
+        fired["kill"] = True
+        print(f"[chaos] killing replica 0 at t="
+              f"{args.kill_replica_at}s", file=sys.stderr)
+        kill_plan.kill("decode")
+
+    kill_fn.fired = fired
+    return router, vocab["size"], (
+        kill_fn if args.kill_replica_at is not None else None)
 
 
 def _draw_len(rng, dist: str, lo: int, hi: int) -> int:
@@ -406,6 +486,29 @@ def main(argv=None) -> int:
                          "unit (self-repetitive text — the n-gram "
                          "proposer's accepting case; 0 = fully random "
                          "prompts, the adversarial floor)")
+    # fleet knobs (--replicas N routes through paddle_tpu.serving.Router;
+    # PERF.md fleet-survival methodology)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="replica Servers behind a health-aware "
+                         "Router (>1 implies --router)")
+    ap.add_argument("--router", action="store_true",
+                    help="route through a Router even with 1 replica "
+                         "(measures the router's own overhead + the "
+                         "no-spare-capacity fault baseline)")
+    ap.add_argument("--kill-replica-at", type=float, default=None,
+                    metavar="T",
+                    help="kill replica 0 (permanent engine faults) T "
+                         "seconds into the measured run; its requests "
+                         "fail over, the supervisor rebuilds it")
+    ap.add_argument("--max-failovers", type=int, default=3,
+                    help="replica migrations one request may survive "
+                         "before FailoverBudgetExceeded")
+    ap.add_argument("--breaker-threshold", type=int, default=3,
+                    help="consecutive failures before a replica's "
+                         "circuit breaker opens")
+    ap.add_argument("--replica-backoff", type=float, default=0.25,
+                    help="base of the supervisor's exponential "
+                         "replica-restart backoff (s)")
     # chaos knobs (in-process mode only; paddle_tpu.testing.faults)
     ap.add_argument("--fault-rate", type=float, default=0.0,
                     help="seeded per-call fault probability at each "
@@ -459,6 +562,21 @@ def main(argv=None) -> int:
     if args.spec_ab and args.trace_ab:
         print("--spec-ab and --trace-ab are separate A/Bs; run them "
               "one at a time", file=sys.stderr)
+        return 2
+    if args.replicas < 1:
+        print("--replicas must be >= 1", file=sys.stderr)
+        return 2
+    args.router = args.router or args.replicas > 1
+    if args.router and (args.url is not None or args.fault_rate > 0
+                        or args.spec_ab or args.speculative == "on"):
+        print("--replicas/--router is in-process and drives its own "
+              "chaos (--kill-replica-at); it composes with neither "
+              "--url nor --fault-rate/--spec-ab/--speculative",
+              file=sys.stderr)
+        return 2
+    if args.kill_replica_at is not None and not args.router:
+        print("--kill-replica-at needs --router/--replicas > 1",
+              file=sys.stderr)
         return 2
 
     # open loop: the full arrival schedule AND every prompt are drawn
@@ -585,6 +703,7 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
     sfx = f"_{arm}" if arm else ""
     server = None
     plan = None
+    kill_fn = None
     if args.url is None:
         from paddle_tpu import monitor, tracing
         monitor.enable()
@@ -595,7 +714,10 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             tracing.enable()
         else:
             tracing.disable()
-        server, vocab, plan = _build_toy_server(args, spec_on)
+        if args.router:
+            server, vocab, kill_fn = _build_toy_router(args)
+        else:
+            server, vocab, plan = _build_toy_server(args, spec_on)
         assert vocab == _TOY_VOCAB, \
             f"toy model vocab {vocab} != {_TOY_VOCAB} the prompts used"
 
@@ -607,8 +729,9 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
     occ_samples = []
     occ_stop = threading.Event()
     occ_th = None
-    alloc = (getattr(server.engine, "alloc", None)
-             if server is not None else None)
+    eng = getattr(server, "engine", None)   # a Router has replicas,
+    #                                         not one engine
+    alloc = getattr(eng, "alloc", None) if eng is not None else None
     if alloc is not None:
         def _sample_occ():
             while not occ_stop.wait(0.005):
@@ -617,7 +740,12 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
         occ_th = threading.Thread(target=_sample_occ, daemon=True)
         occ_th.start()
     threads = []
+    kill_timer = None
     t_start = time.monotonic()
+    if kill_fn is not None:
+        kill_timer = threading.Timer(args.kill_replica_at, kill_fn)
+        kill_timer.daemon = True
+        kill_timer.start()
     for i, (at, prompt) in enumerate(zip(arrivals, prompts)):
         delay = t_start + at - time.monotonic()
         if delay > 0:
@@ -640,6 +768,16 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
     for th in threads:
         th.join()
     wall = time.monotonic() - t_start
+    if kill_timer is not None:
+        # a run that drained before T must not (a) leave the timer to
+        # fire into a later A/B arm, or (b) silently report a
+        # NO-FAULT run as the fault-plan arm
+        kill_timer.cancel()
+        if not kill_fn.fired["kill"]:
+            print(f"warning: --kill-replica-at {args.kill_replica_at} "
+                  "never fired (the run finished first) — the fleet "
+                  "records below reflect an UNFAULTED run; lower the "
+                  "kill time or raise --requests", file=sys.stderr)
     if occ_th is not None:
         occ_stop.set()
         occ_th.join(timeout=2.0)
@@ -748,9 +886,9 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             print(json.dumps({"metric": f"serve_prefix_cow_copies{sfx}",
                               "value": getattr(alloc, "cow_copies", 0),
                               "unit": "count"}))
-    spec_stats = (getattr(server.engine, "spec_stats", None)
-                  if server is not None else None)
-    if spec_stats is not None and getattr(server.engine, "draft_k", 0):
+    spec_stats = (getattr(eng, "spec_stats", None)
+                  if eng is not None else None)
+    if spec_stats is not None and getattr(eng, "draft_k", 0):
         # speculative-decoding accounting (spec arm / --speculative
         # on): accepted-tokens-per-forward is the number that converts
         # into TPOT on HBM-bound hardware; acceptance rate says how
@@ -772,6 +910,46 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
                           "unit": "ratio"}))
         print(json.dumps({"metric": f"serve_spec_draft_tokens{sfx}",
                           "value": ss["proposed"], "unit": "tokens"}))
+    if server is not None and args.router:
+        # fleet accounting (PERF.md fleet-survival methodology): the
+        # survival rate over ACCEPTED requests is the headline — with
+        # spare replicas it should stay 1.0 through a replica kill;
+        # failover count/latency price the migrations, breaker opens
+        # count how often routing walled off a sick replica
+        snap = server.load()
+        accepted = args.requests - stats.rejected
+        survival = done / accepted if accepted else 0.0
+        per_rep = ", ".join(
+            f"r{e['replica']}:{e['status']}"
+            f"(breaker={e['breaker']['state']},"
+            f"restarts={e['restarts']})" for e in snap["replicas"])
+        print(f"fleet [{args.replicas} replicas]: survival "
+              f"{done}/{accepted} = {survival:.3f}, "
+              f"{snap['failovers']} failovers, "
+              f"{snap['breaker_opens']} breaker opens; {per_rep}")
+        print(json.dumps({"metric": f"serve_fleet_survival_rate{sfx}",
+                          "value": round(survival, 4),
+                          "unit": "ratio"}))
+        print(json.dumps({"metric": f"serve_failover_count{sfx}",
+                          "value": snap["failovers"],
+                          "unit": "count"}))
+        if stats.e2e_failover:
+            print(json.dumps(
+                {"metric": f"serve_failover_latency_p99{sfx}",
+                 "value": round(
+                     _percentile(stats.e2e_failover, 99), 6),
+                 "unit": "s"}))
+        print(json.dumps({"metric": f"serve_breaker_opens{sfx}",
+                          "value": snap["breaker_opens"],
+                          "unit": "count"}))
+        print(json.dumps({"metric": f"serve_replica_restarts{sfx}",
+                          "value": sum(e["restarts"]
+                                       for e in snap["replicas"]),
+                          "unit": "count"}))
+        print(json.dumps({"metric": f"serve_requests_survived{sfx}",
+                          "value": done, "unit": "count"}))
+        print(json.dumps({"metric": f"serve_requests_failed{sfx}",
+                          "value": stats.failed, "unit": "count"}))
     if plan is not None:
         # chaos accounting: what was injected, what survived, what the
         # supervisor did about it (fault_stats is host-side — readable
