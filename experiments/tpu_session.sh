@@ -147,6 +147,22 @@ STEP_TIMEOUT=2400 run python tools/serve_bench.py --router --replicas 3 \
     --kill-replica-at 2 --layers 2 --prompt-len 4:16 --max-new 12 \
     --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
     --seed 3
+# 6g. on-TPU QUANTIZED-KV serve_bench A/B (first hardware numbers for
+#     int8 KV pages, after the 6f fleet run): identical load through
+#     bf16 pools vs int8 pools at EQUAL HBM (the int8 arm gets 2x
+#     pages automatically). Decode on TPU is HBM-bandwidth-bound, so
+#     the halved page read bytes should convert into
+#     serve_kv_quant_tpot_speedup here (CPU-tiny measured 1.15x but is
+#     compute-bound — mechanism, not speedup); also read
+#     serve_kv_quant_capacity_ratio (expect ~1.94x vs bf16),
+#     serve_kv_occupancy_p99_int8 (~half the bf16 arm at matched
+#     load), and the bounded-numerics records
+#     serve_kv_quant_max_logit_div / serve_kv_quant_token_flips —
+#     on-chip bf16 pools make the bf16 arm's baseline real (the CPU
+#     arm stores f32).
+STEP_TIMEOUT=2400 run python tools/serve_bench.py --kv-ab --layers 2 \
+    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
+    --num-pages 64 --max-pages 16 --page-size 8 --warmup
 # 7. the remaining BASELINE.md configs — one window should produce the
 #    full config table (VERDICT r4 Missing #3). Expected budgets: each
 #    is a small model + cached-compile candidate; ~5-10 min warm,

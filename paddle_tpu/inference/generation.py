@@ -1903,7 +1903,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  kv_watermark: float = 0.9,
                  debug_pages: bool = False,
                  prefix_cache: bool = False,
+                 kv_dtype: str = "bf16",
                  draft_k: int = 0, ngram_max: int = 3):
+        from ..quantization.kv import KV_DTYPES
         from .paged_cache import PageAllocator
 
         if admission_mode not in ADMISSION_MODES:
@@ -1915,9 +1917,21 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             raise ValueError(
                 f"kv_watermark must satisfy 0 < w <= 1 (fraction of "
                 f"the page pool), got {kv_watermark!r}")
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got "
+                f"{kv_dtype!r}")
         self.admission_mode = admission_mode
         self.kv_watermark = float(kv_watermark)
         self.prefix_cache = bool(prefix_cache)
+        # KV page storage: "bf16" = the model's cache dtype, bitwise
+        # the pre-quantization path; "int8" stores pages int8 with
+        # per-(page, kv_head) running-absmax scales riding the page
+        # table — half the bytes per decode read, ~2x the pages at
+        # fixed HBM, correctness bar bounded-not-bitwise (see
+        # quantization.kv). Must be set before the base __init__
+        # builds the pools.
+        self.kv_dtype = kv_dtype
         # slot -> warm-admission info ({"ids","c_map","hashes","saved"})
         # staged between the admission's prefill and its cache install;
         # popped by _install_mini / _abort_admit
@@ -1934,17 +1948,128 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.page_size = page_size
         self.alloc = PageAllocator(num_pages, page_size, max_batch,
                                    max_pages, debug=debug_pages,
-                                   prefix_cache=prefix_cache)
+                                   prefix_cache=prefix_cache,
+                                   kv_dtype=kv_dtype)
         super().__init__(model, max_batch,
                          max_len=max_pages * page_size,
                          prefill_buckets=prefill_buckets,
                          prefill_chunk=prefill_chunk,
                          draft_k=draft_k, ngram_max=ngram_max)
+        self._measure_quant_savings()
+
+        def reset_scales(pools, mask):
+            # ONE fixed-shape program per pool shape: freshly claimed
+            # pages' scale rows (a previous owner's absmax leftovers)
+            # drop to the floor before any write — per-page dispatches
+            # or a count-shaped index vector would recompile per gap
+            from ..quantization.kv import KV_SCALE_FLOOR
+
+            out = []
+            for kp, vp, ks, vs in pools:
+                ks = jnp.where(mask[:, None], KV_SCALE_FLOOR, ks)
+                vs = jnp.where(mask[:, None], KV_SCALE_FLOOR, vs)
+                out.append((kp, vp, ks, vs))
+            return out
+
+        self._reset_scales = monitor.monitored_jit(
+            reset_scales, name="cb_reset_scales", donate_argnums=(0,))
 
     def _make_caches(self):
+        if self.kv_dtype == "int8":
+            try:
+                pools = self.model.init_paged_cache(
+                    self.num_pages, self.page_size, kv_dtype="int8")
+            except TypeError as e:
+                raise ValueError(
+                    f"kv_dtype='int8' needs a model whose "
+                    f"init_paged_cache accepts kv_dtype (llama does); "
+                    f"{type(self.model).__name__} does not") from e
+            return pools, jnp.asarray(self.alloc.page_table)
         return (self.model.init_paged_cache(self.num_pages,
                                             self.page_size),
                 jnp.asarray(self.alloc.page_table))
+
+    def _measure_quant_savings(self) -> None:
+        """Price the int8 layout from the REAL pool arrays: HBM bytes
+        per page a bf16 pool would need minus what the int8 pools +
+        scales actually take — the allocator counts it per claimed
+        page (``paddle_tpu_kv_quant_bytes_saved_total``)."""
+        if self.kv_dtype != "int8":
+            self.alloc.bytes_saved_per_page = 0
+            return
+        pools, _ = self.caches
+        base = quant = 0
+        for kp, vp, ks, vs in pools:
+            base += (kp.size + vp.size) * 2          # bf16 baseline
+            quant += (kp.nbytes + vp.nbytes + ks.nbytes + vs.nbytes)
+        self.alloc.bytes_saved_per_page = max(
+            (base - quant) // self.num_pages, 0)
+
+    def kv_page_cost(self) -> dict:
+        """HBM cost of one page under the current storage dtype:
+        ``{"bytes_per_page"}`` is the actual cost (scales included);
+        ``{"bf16_equiv_bytes_per_page"}`` prices the SAME page at
+        2 bytes/element — the production-baseline denominator for
+        serve_bench's effective-capacity record, independent of the
+        CPU test model's f32 cache dtype."""
+        pools, _ = self.caches
+        total = elems = 0
+        for entry in pools:
+            total += sum(a.nbytes for a in entry)
+            elems += entry[0].size + entry[1].size
+        return {"bytes_per_page": total // self.num_pages,
+                "bf16_equiv_bytes_per_page":
+                    2 * elems // self.num_pages}
+
+    def set_kv_dtype(self, kv_dtype: str) -> None:
+        """Swap the pool storage dtype on an IDLE engine (the
+        ``Server(kv_dtype=...)`` mirror hook): rebuilds the pools —
+        any cached prefix KV dies with them, so the content index
+        clears too — and keeps every compiled program (the other
+        dtype's variants stay cached; warmup covers the new ones)."""
+        from ..quantization.kv import KV_DTYPES
+
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got "
+                f"{kv_dtype!r}")
+        if kv_dtype == self.kv_dtype:
+            return
+        if self._slot_req:
+            raise RuntimeError(
+                "kv_dtype can only be changed on an idle engine")
+        # old pools dropped before the new ones allocate (reset_state's
+        # peak-HBM argument applies here too)
+        self.caches = None
+        self.alloc.clear_prefix_index()
+        self.alloc.set_kv_dtype(kv_dtype)
+        self.kv_dtype = kv_dtype
+        self._prefix_stash.clear()
+        self._growth_stamp = None
+        self._gap_sync = None
+        self.caches = self._make_caches()
+        self._measure_quant_savings()
+
+    def _flush_fresh_scales(self) -> None:
+        """Reset freshly claimed pages' scale rows to the floor (int8;
+        one masked fixed-shape program) — runs at the write choke
+        points (cache install, pre-segment) so no quantized store ever
+        runs absmax against a previous owner's scales."""
+        if self.kv_dtype != "int8":
+            return
+        fresh = self.alloc.take_fresh_scales()
+        if not fresh:
+            return
+        mask = np.zeros((self.num_pages,), bool)
+        mask[fresh] = True
+        pools, pt = self.caches
+        self.caches = (self._reset_scales(pools, jnp.asarray(mask)),
+                       pt)
+
+    def load(self) -> dict:
+        out = super().load()
+        out["kv_dtype"] = self.kv_dtype
+        return out
 
     def _fwd_ragged(self, params, tok, caches, lens, live):
         from ..core.autograd import no_grad
@@ -2113,13 +2238,22 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         page-table row width so every warm admission shares one
         compiled gather program (junk rows for the ``-1`` tail sit
         past the cached coverage, overwritten or masked)."""
-        from .paged_cache import gather_pages
+        from .paged_cache import gather_pages, gather_pages_q
 
         row = np.full((self.alloc.page_table.shape[1],), -1, np.int32)
         row[:len(pids)] = pids
         pages = jnp.asarray(row)
         pools, _ = self.caches
         out = []
+        if self.kv_dtype == "int8":
+            # dequantize whole resident pages into the float mini: the
+            # tail prefill attends over exactly the values the fused
+            # decode reads see, so warm and cold agree to quantization
+            # error, never to a format skew
+            for (kp, vp, ks, vs), (mk, mv) in zip(pools, mini):
+                mk, mv = gather_pages_q(kp, vp, ks, vs, pages, mk, mv)
+                out.append((mk, mv))
+            return out
         for (kp, vp), (mk, mv) in zip(pools, mini):
             mk, mv = gather_pages(kp, vp, pages, mk, mv)
             out.append((mk, mv))
@@ -2130,11 +2264,24 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         inter-segment gap: claim a fresh page (allocator bookkeeping),
         copy the pool rows on device, swap the table entry (shipped at
         the next segment)."""
-        from .paged_cache import copy_page
+        from .paged_cache import copy_page, copy_page_q
 
         old, new = self.alloc.cow(slot, page_idx)
         pools, pt = self.caches
         new_pools = []
+        if self.kv_dtype == "int8":
+            # the copy carries the page's SCALES with its rows (int8
+            # rows are meaningless under another page's scale); the
+            # note tells the allocator's scale accounting the copy
+            # happened — forgetting either fails check() loudly
+            for kp, vp, ks, vs in pools:
+                kp, vp, ks, vs = copy_page_q(kp, vp, ks, vs,
+                                             jnp.int32(old),
+                                             jnp.int32(new))
+                new_pools.append((kp, vp, ks, vs))
+            self.caches = (new_pools, pt)
+            self.alloc.note_scale_copied(new)
+            return
         for kp, vp in pools:
             kp, vp = copy_page(kp, vp, jnp.int32(old), jnp.int32(new))
             new_pools.append((kp, vp))
@@ -2147,8 +2294,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             else self._optimistic_claim(plen, cfg))
 
     def _install_mini(self, slot: int, mini, plen: int) -> None:
-        from .paged_cache import write_tokens
+        from .paged_cache import write_tokens, write_tokens_q
 
+        # int8: reset freshly claimed pages' scale rows BEFORE the
+        # quantized install runs its running absmax against them
+        self._flush_fresh_scales()
         info = (self._prefix_stash.pop(slot, None)
                 if self.prefix_cache else None)
         if info is not None and info["c_map"] > 0:
@@ -2166,10 +2316,22 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             pos_v = jnp.arange(width, dtype=jnp.int32)
             pools, _ = self.caches
             new_pools = []
-            for (kp, vp), (mk, mv) in zip(pools, mini):
-                kp, vp = write_tokens(kp, vp, pt, slots_v, pos_v,
-                                      mk[0, :width], mv[0, :width])
-                new_pools.append((kp, vp))
+            if self.kv_dtype == "int8":
+                # limit=plen: the pad tail past the prompt DROPS
+                # instead of ratcheting headroom pages' running absmax
+                # (their floor-reset scales already read stale rows
+                # as ~0)
+                for (kp, vp, ks, vs), (mk, mv) in zip(pools, mini):
+                    kp, vp, ks, vs = write_tokens_q(
+                        kp, vp, ks, vs, pt, slots_v, pos_v,
+                        mk[0, :width], mv[0, :width],
+                        limit=jnp.int32(plen))
+                    new_pools.append((kp, vp, ks, vs))
+            else:
+                for (kp, vp), (mk, mv) in zip(pools, mini):
+                    kp, vp = write_tokens(kp, vp, pt, slots_v, pos_v,
+                                          mk[0, :width], mv[0, :width])
+                    new_pools.append((kp, vp))
             self.caches = (new_pools, pt)
         if info is not None:
             # a cold admission POPULATES the cache; a warm one extends
@@ -2192,7 +2354,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         below the cached coverage are masked out of the scatter, and
         the garbage tail past ``plen`` lands only in private headroom
         pages or drops on unmapped ones."""
-        from .paged_cache import scatter_rows
+        from .paged_cache import scatter_rows, scatter_rows_q
 
         ps = self.page_size
         c_map = info["c_map"]
@@ -2210,11 +2372,23 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             width = min(width, mini_len)
             pools, _ = self.caches
             new_pools = []
-            for (kp, vp), (mk, mv) in zip(pools, mini):
-                kp, vp = scatter_rows(
-                    kp, vp, pt, jnp.int32(slot), jnp.int32(c_map),
-                    jnp.int32(plen), mk, mv, width=width)
-                new_pools.append((kp, vp))
+            if self.kv_dtype == "int8":
+                # masked-out rows drop from the quantized scatter too,
+                # so shared read-only pages keep rows AND scales; the
+                # CoW'd partial page's copied scales seed the running
+                # absmax for the suffix rows landing in it
+                for (kp, vp, ks, vs), (mk, mv) in zip(pools, mini):
+                    kp, vp, ks, vs = scatter_rows_q(
+                        kp, vp, ks, vs, pt, jnp.int32(slot),
+                        jnp.int32(c_map), jnp.int32(plen), mk, mv,
+                        width=width)
+                    new_pools.append((kp, vp, ks, vs))
+            else:
+                for (kp, vp), (mk, mv) in zip(pools, mini):
+                    kp, vp = scatter_rows(
+                        kp, vp, pt, jnp.int32(slot), jnp.int32(c_map),
+                        jnp.int32(plen), mk, mv, width=width)
+                    new_pools.append((kp, vp))
             self.caches = (new_pools, pt)
         else:
             pools, _ = self.caches
@@ -2260,20 +2434,33 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         an XLA compile inside the latency-critical gap. All calls are
         value-neutral: nothing is mapped, every scatter row is masked
         out (limit 0), and the page-0 self-copy happens before any
-        request owns it."""
-        if not self.prefix_cache:
-            return {}
-        from .paged_cache import copy_page, scatter_rows
-
+        request owns it. Under int8 the fresh-scale flush program
+        warms here too (all-False mask — a no-op write)."""
         out = {}
+        if self.kv_dtype == "int8":
+            t0 = time.perf_counter()
+            pools, pt = self.caches
+            self.caches = (self._reset_scales(
+                pools, jnp.zeros((self.num_pages,), bool)), pt)
+            out["reset_scales"] = time.perf_counter() - t0
+        if not self.prefix_cache:
+            return out
+        from .paged_cache import (copy_page, copy_page_q, scatter_rows,
+                                  scatter_rows_q)
+
+        quant = self.kv_dtype == "int8"
         t0 = time.perf_counter()
         mini = self._gather_mini(self.model.init_cache(1, self.max_len),
                                  [])
         pools, pt = self.caches
         new_pools = []
-        for kp, vp in pools:
-            kp, vp = copy_page(kp, vp, jnp.int32(0), jnp.int32(0))
-            new_pools.append((kp, vp))
+        for entry in pools:
+            if quant:
+                new_pools.append(copy_page_q(*entry, jnp.int32(0),
+                                             jnp.int32(0)))
+            else:
+                new_pools.append(copy_page(*entry, jnp.int32(0),
+                                           jnp.int32(0)))
         self.caches = (new_pools, pt)
         out["prefix_gather_copy"] = time.perf_counter() - t0
         pt_dev = jnp.asarray(self.alloc.page_table)
@@ -2284,11 +2471,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 jnp.int32(0), jnp.int32(0))
             pools, _ = self.caches
             new_pools = []
-            for (kp, vp), (mk, mv) in zip(pools, mini):
-                kp, vp = scatter_rows(kp, vp, pt_dev, jnp.int32(0),
-                                      jnp.int32(0), jnp.int32(0),
-                                      mk, mv, width=w)
-                new_pools.append((kp, vp))
+            for entry, (mk, mv) in zip(pools, mini):
+                if quant:
+                    new_pools.append(scatter_rows_q(
+                        *entry, pt_dev, jnp.int32(0), jnp.int32(0),
+                        jnp.int32(0), mk, mv, width=w))
+                else:
+                    new_pools.append(scatter_rows(
+                        *entry, pt_dev, jnp.int32(0), jnp.int32(0),
+                        jnp.int32(0), mk, mv, width=w))
             self.caches = (new_pools, pt)
             out[f"prefix_warm_{w}"] = time.perf_counter() - t0
         return out
@@ -2325,6 +2516,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # KV is gone, so the content index must go with it (parked
         # pages return to the free heap)
         self.alloc.clear_prefix_index()
+        # the fresh pools below start at floor scales: pending resets
+        # refer to arrays about to be dropped
+        self.alloc.take_fresh_scales()
         self._prefix_stash.clear()
         self._growth_stamp = None
         self._gap_sync = None
@@ -2437,10 +2631,19 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     f"({self.alloc.available_pages} pages reclaimable) "
                     f"— preempt victims (preempt_request) or grow "
                     f"num_pages")
+        # int8: pages the gap claimed (growth, reserves) get their
+        # scale rows floored before this segment's quantized writes
+        self._flush_fresh_scales()
         # reserved mode: admission reserved every running request's
         # worst case, so no growth can fail — just ship the table
         if self.alloc.debug:
             self.alloc.check()
+            if self.kv_dtype == "int8":
+                # device half of the scale invariants: every live
+                # page's scales finite and positive (layer 0 stands
+                # for all layers — one program writes them all)
+                pools, _ = self.caches
+                self.alloc.check_scales(pools[0][2], pools[0][3])
             # write_tokens drops out-of-mapping writes SILENTLY (one
             # compiled program) and a forgotten copy-on-write would
             # mutate a shared page other requests read — both surface

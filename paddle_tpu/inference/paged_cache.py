@@ -42,7 +42,9 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["PageAllocator", "PagedKVCache", "write_tokens",
-           "gather_dense", "scatter_rows", "copy_page", "gather_pages"]
+           "gather_dense", "scatter_rows", "copy_page", "gather_pages",
+           "write_tokens_q", "scatter_rows_q", "copy_page_q",
+           "gather_pages_q", "gather_dense_q"]
 
 # chain-hash root: the "parent" of a prompt's first block
 _ROOT = b"\x00" * 16
@@ -166,6 +168,122 @@ def gather_dense(pool, page_table, row):
         -1, *pool.shape[2:])
 
 
+# -- int8 pools (kv_dtype="int8"): quantize-on-store twins ------------------
+#
+# Same shapes, same page-table convention, same drop-sentinel semantics
+# as the functions above, but the pools are int8 and every page carries
+# a per-(page, kv_head) f32 running-absmax scale that rides the page
+# table exactly like the pages do: writes quantize on store and update
+# the scales (quantization.kv.quant_store_rows — growth re-quantizes
+# the page's existing rows, which is the bounded-not-bitwise part of
+# the int8 contract), copies/gathers carry scales so CoW and warm
+# prefix-cache admission stay pure page copies, and the paged
+# attention read dequantizes INSIDE the kernel so the HBM read is
+# int8 (ops/paged_attention.py).
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def write_tokens_q(k_pool, v_pool, k_scale, v_scale, page_table, slots,
+                   positions, k_new, v_new, limit=None):
+    """Quantizing :func:`write_tokens`: one new token per row into int8
+    pools, scales updated by running absmax. Unmapped positions drop —
+    rows, absmax contributions and all (a dropped write must not
+    inflate another page's scale).
+
+    ``limit`` (traced scalar, optional): rows at ``positions >= limit``
+    drop too. The unquantized install scatters its bucket-width pad
+    tail as ignorable garbage; quantized, those rows would RATCHET the
+    headroom pages' running absmax and cost real precision — and
+    freshly claimed pages' floor-reset scales already dequantize their
+    stale rows to ~0, so dropping the tail is strictly better."""
+    from ..quantization.kv import quant_store_rows
+
+    ps = k_pool.shape[1]
+    pages = page_table[slots, positions // ps]
+    ok = pages >= 0
+    if limit is not None:
+        ok = ok & (positions < limit)
+    pages = jnp.where(ok, pages, k_pool.shape[0])
+    offs = positions % ps
+    k_pool, k_scale = quant_store_rows(k_pool, k_scale, pages, offs,
+                                       k_new)
+    v_pool, v_scale = quant_store_rows(v_pool, v_scale, pages, offs,
+                                       v_new)
+    return k_pool, v_pool, k_scale, v_scale
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3),
+                   static_argnames=("width",))
+def scatter_rows_q(k_pool, v_pool, k_scale, v_scale, page_table, slot,
+                   start, limit, mini_k, mini_v, *, width):
+    """Quantizing :func:`scatter_rows`: the masked one-slot install of
+    a warm admission's uncached suffix. Masked-out rows (below the
+    cached coverage or past the prompt) drop entirely, so shared
+    read-only pages keep both their rows AND their scales untouched."""
+    from ..quantization.kv import quant_store_rows
+
+    L = mini_k.shape[1]
+    ps = k_pool.shape[1]
+    base = jnp.clip(start, 0, L - width)
+    pos = base + jnp.arange(width, dtype=jnp.int32)
+    valid = (pos >= start) & (pos < limit)
+    pages = page_table[slot, pos // ps]
+    pages = jnp.where(valid & (pages >= 0), pages, k_pool.shape[0])
+    offs = pos % ps
+    k_new = jax.lax.dynamic_slice_in_dim(mini_k[0], base, width, axis=0)
+    v_new = jax.lax.dynamic_slice_in_dim(mini_v[0], base, width, axis=0)
+    k_pool, k_scale = quant_store_rows(k_pool, k_scale, pages, offs,
+                                       k_new)
+    v_pool, v_scale = quant_store_rows(v_pool, v_scale, pages, offs,
+                                       v_new)
+    return k_pool, v_pool, k_scale, v_scale
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def copy_page_q(k_pool, v_pool, k_scale, v_scale, src, dst):
+    """Quantizing :func:`copy_page`: copy-on-write must carry the
+    page's SCALES with its rows — int8 rows are meaningless under
+    another page's scale, so a CoW that copied only rows would corrupt
+    the copy (the allocator's ``check()`` fails loudly on exactly that
+    under ``debug_pages=True``)."""
+    k_pool = k_pool.at[dst].set(k_pool[src])
+    v_pool = v_pool.at[dst].set(v_pool[src])
+    k_scale = k_scale.at[dst].set(k_scale[src])
+    v_scale = v_scale.at[dst].set(v_scale[src])
+    return k_pool, v_pool, k_scale, v_scale
+
+
+@functools.partial(jax.jit, donate_argnums=(5, 6))
+def gather_pages_q(k_pool, v_pool, k_scale, v_scale, pages, mini_k,
+                   mini_v):
+    """Quantizing :func:`gather_pages`: dequantize whole resident pages
+    into the head of a float mini cache (warm prefix admission — the
+    tail prefill attends over the DEQUANTIZED prefix KV, which is what
+    the fused-dequant decode reads see too, so warm and cold
+    admissions agree to quantization error, not to a format skew)."""
+    from ..quantization.kv import dequantize_page
+
+    idx = jnp.maximum(pages, 0)
+    uk = dequantize_page(k_pool[idx], k_scale[idx][:, None, :])
+    uv = dequantize_page(v_pool[idx], v_scale[idx][:, None, :])
+    uk = uk.reshape(1, -1, *k_pool.shape[2:])
+    uv = uv.reshape(1, -1, *v_pool.shape[2:])
+    mini_k = jax.lax.dynamic_update_slice_in_dim(
+        mini_k, uk.astype(mini_k.dtype), 0, axis=1)
+    mini_v = jax.lax.dynamic_update_slice_in_dim(
+        mini_v, uv.astype(mini_v.dtype), 0, axis=1)
+    return mini_k, mini_v
+
+
+@jax.jit
+def gather_dense_q(pool, scales, page_table, row):
+    """Dequantized :func:`gather_dense` (testing/debug)."""
+    from ..quantization.kv import dequantize_page
+
+    idx = jnp.maximum(page_table[row], 0)
+    return dequantize_page(pool[idx], scales[idx][:, None, :]).reshape(
+        -1, *pool.shape[2:])
+
+
 class PageAllocator:
     """Page-table + free-list bookkeeping, pool-agnostic: ONE allocator
     (one table) serves every layer's pools — the table maps logical
@@ -188,9 +306,33 @@ class PageAllocator:
 
     def __init__(self, num_pages: int, page_size: int, max_batch: int,
                  max_pages: int, debug: bool = False,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, kv_dtype: str = "bf16"):
+        from ..quantization.kv import KV_DTYPES
+
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got "
+                f"{kv_dtype!r}")
         self.page_size = page_size
         self.num_pages = num_pages
+        # int8 pools: host-side SCALE bookkeeping (the scale arrays
+        # themselves live on device next to the pools). _scaled holds
+        # the pages whose per-page scale rows are ESTABLISHED by
+        # protocol — reset-fresh by the engine's claim flush, or copied
+        # by a CoW — and check() enforces that every owned/parked page
+        # is in it (a CoW that forgot to copy its scale fails loudly).
+        # _fresh_scales queues newly claimed pages whose stale scale
+        # rows the engine must reset to the floor before any write
+        # (a previous owner's absmax must not ratchet a fresh page's
+        # precision down); the engine drains it via take_fresh_scales.
+        self.kv_dtype = kv_dtype
+        self._scaled: set = set()
+        self._fresh_scales: List[int] = []
+        # HBM bytes the int8 pools avoided for pages claimed so far
+        # (host-side total; the engine sets bytes_saved_per_page from
+        # the real pool array sizes, scale overhead subtracted)
+        self.bytes_saved_per_page = 0
+        self.quant_bytes_saved = 0
         # debug=True runs the full check() invariant validator after
         # every mutating call (and the paged engine runs it once per
         # inter-segment gap): a reclaim bug fails LOUDLY at the faulty
@@ -264,9 +406,13 @@ class PageAllocator:
     def _pages_gauge():
         from .. import monitor
 
+        # kv_dtype label: at fixed HBM an int8 pool holds ~2x the
+        # pages, so a pages number is only comparable WITH its storage
+        # dtype attached
         return monitor.gauge("paddle_tpu_kv_pages",
-                             "KV-cache page pool occupancy by state",
-                             ("pool", "state"))
+                             "KV-cache page pool occupancy by state "
+                             "and storage dtype",
+                             ("pool", "state", "kv_dtype"))
 
     @property
     def used_pages(self) -> int:
@@ -311,12 +457,13 @@ class PageAllocator:
             return
         free = len(self._free)
         pages = self._pages_gauge()
-        pages.labels(pool=self.monitor_pool, state="free").set(free)
-        pages.labels(pool=self.monitor_pool,
-                     state="used").set(self.used_pages)
+        pages.labels(pool=self.monitor_pool, state="free",
+                     kv_dtype=self.kv_dtype).set(free)
+        pages.labels(pool=self.monitor_pool, state="used",
+                     kv_dtype=self.kv_dtype).set(self.used_pages)
         if self.prefix_cache:
-            pages.labels(pool=self.monitor_pool,
-                         state="cached").set(len(self._parked))
+            pages.labels(pool=self.monitor_pool, state="cached",
+                         kv_dtype=self.kv_dtype).set(len(self._parked))
             self._shared_gauge().labels(pool=self.monitor_pool).set(
                 self.shared_pages)
         self._occupancy_gauge().labels(pool=self.monitor_pool).set(
@@ -363,6 +510,30 @@ class PageAllocator:
         if monitor.enabled():
             self._preempt_counter().labels(
                 pool=self.monitor_pool, reason=reason).inc()
+
+    @staticmethod
+    def _quant_saved_counter():
+        from .. import monitor
+
+        return monitor.counter(
+            "paddle_tpu_kv_quant_bytes_saved_total",
+            "HBM bytes avoided by storing claimed KV pages int8 "
+            "instead of the model cache dtype (per-page scale "
+            "overhead already subtracted)", ("pool",))
+
+    def _count_quant_claim(self) -> None:
+        """One page claimed under int8 storage: account the HBM bytes
+        the quantized layout avoided for it (host total + monitor
+        counter; ``bytes_saved_per_page`` is 0 until the engine
+        measures it from the real pools)."""
+        if self.kv_dtype != "int8" or not self.bytes_saved_per_page:
+            return
+        self.quant_bytes_saved += self.bytes_saved_per_page
+        from .. import monitor
+
+        if monitor.enabled():
+            self._quant_saved_counter().labels(
+                pool=self.monitor_pool).inc(self.bytes_saved_per_page)
 
     def count_prefix_hit(self, tokens_saved: int) -> None:
         """Record one prefix-cache hit and the prompt tokens whose
@@ -478,6 +649,32 @@ class PageAllocator:
                 raise RuntimeError(
                     f"page_table row {slot} inconsistent with owned "
                     f"pages {owned}: {row.tolist()}")
+        if self.kv_dtype == "int8":
+            # scale accounting (int8 pools): every page whose KV is
+            # readable — referenced by a slot or parked in the prefix
+            # LRU — must have ESTABLISHED scale rows (reset-fresh at
+            # claim, or copied by CoW); a page on the free heap must
+            # not (freed pages reset their scale bookkeeping). The
+            # canonical failure this catches: a copy-on-write that
+            # copied the page's rows but forgot its scales.
+            for pid, state in owner.items():
+                if state == "free":
+                    if pid in self._scaled:
+                        raise RuntimeError(
+                            f"free page {pid} still marked "
+                            f"scale-established (freed pages must "
+                            f"reset scale bookkeeping)")
+                elif pid not in self._scaled:
+                    raise RuntimeError(
+                        f"{state} page {pid} has no established "
+                        f"scales — a copy-on-write or install forgot "
+                        f"to carry the per-page scale rows")
+            for pid in self._fresh_scales:
+                if owner.get(pid) == "free" or pid >= self.num_pages:
+                    raise RuntimeError(
+                        f"fresh-scale queue holds page {pid} which is "
+                        f"{owner.get(pid, 'foreign')} — reset queue "
+                        f"out of sync with claims")
 
     def check_coverage(self, slot: int, live_len: int,
                        write_ahead: int = 1) -> None:
@@ -504,6 +701,35 @@ class PageAllocator:
                     f"lands in shared/indexed page "
                     f"{owned[pos // self.page_size]} — missing "
                     f"copy-on-write")
+            if (self.kv_dtype == "int8"
+                    and pos // self.page_size < len(owned)
+                    and owned[pos // self.page_size] not in self._scaled):
+                raise RuntimeError(
+                    f"slot {slot}: imminent int8 write at position "
+                    f"{pos} lands in page "
+                    f"{owned[pos // self.page_size]} whose scales were "
+                    f"never established (missing CoW scale copy or "
+                    f"claim reset)")
+
+    def check_scales(self, k_scale, v_scale) -> None:
+        """Device-side half of the int8 scale invariants (the paged
+        engine pulls one layer's scale arrays per gap under
+        ``debug_pages=True``): every owned/parked/shared page's scales
+        must be FINITE and positive — NaN/inf here means a quantized
+        store was fed garbage and every future dequant of the page is
+        poisoned."""
+        ks = np.asarray(k_scale)
+        vs = np.asarray(v_scale)
+        live = sorted(set().union(
+            *(set(p) for p in self._owned.values())) | set(self._parked))
+        for pid in live:
+            for name, arr in (("k", ks), ("v", vs)):
+                row = arr[pid]
+                if not np.all(np.isfinite(row)) or np.any(row <= 0):
+                    raise RuntimeError(
+                        f"page {pid}: non-finite/non-positive {name} "
+                        f"scale row {row.tolist()} — quantized store "
+                        f"fed garbage, dequant poisoned")
 
     def needs_cow(self, slot: int, pos: int) -> bool:
         """True when the page mapped at token position ``pos`` of
@@ -539,7 +765,7 @@ class PageAllocator:
             # heap pop (lowest page id first): ensure/free run in the
             # latency-critical inter-segment gap — a list pop(0) is O(n)
             # per page and the free() re-sort O(n log n) per retirement
-            return heapq.heappop(self._free)
+            return self._note_claim(heapq.heappop(self._free))
         if self._parked:
             pid, _h = self._parked.popitem(last=False)
             self._unindex(pid)
@@ -551,8 +777,42 @@ class PageAllocator:
                 # hit-rate drop under pool pressure
                 _trace.event("prefix.evict", pool=self.monitor_pool,
                              page=pid)
-            return pid
+            return self._note_claim(pid)
         raise RuntimeError("page pool exhausted")
+
+    def _note_claim(self, pid: int) -> int:
+        """Scale bookkeeping for a freshly claimed page (int8): its
+        device scale rows are a previous owner's leftovers, so it is
+        UN-established (``_scaled`` drop) and queued for the engine's
+        reset flush. ``ensure`` re-establishes it (the claim flush
+        covers it); ``cow`` instead pulls it off the fresh queue and
+        waits for :meth:`note_scale_copied`."""
+        if self.kv_dtype == "int8":
+            self._scaled.discard(pid)
+            self._fresh_scales.append(pid)
+            self._count_quant_claim()
+        return pid
+
+    def note_scale_copied(self, pid: int) -> None:
+        """The engine copied scale rows onto ``pid`` on device
+        (copy-on-write's second half): mark its scales established.
+        Under ``debug=True`` this is also where the post-CoW invariant
+        check runs — :meth:`cow` cannot check itself because its own
+        return value IS the copy instruction."""
+        if self.kv_dtype != "int8":
+            return
+        self._scaled.add(pid)
+        if self.debug:
+            self.check()
+
+    def take_fresh_scales(self) -> List[int]:
+        """Drain the queue of claimed-but-unreset pages (int8). The
+        engine calls this at its write choke points and resets the
+        listed pages' scale rows to the floor IN ONE fixed-shape masked
+        program before any quantized write — never per page, never a
+        shape-keyed recompile."""
+        out, self._fresh_scales = self._fresh_scales, []
+        return out
 
     def _unindex(self, pid: int) -> None:
         h = self._hash_of.pop(pid, None)
@@ -586,6 +846,15 @@ class PageAllocator:
                 _trace.event("prefix.park", pool=self.monitor_pool,
                              page=pid)
         else:
+            # freed pages reset their scale bookkeeping: whatever
+            # scale rows they carry belong to a dead owner (parked
+            # pages keep theirs — their KV stays readable). A claim
+            # freed before the engine's reset flush ran (aborted
+            # admission) also leaves the fresh queue — it re-queues on
+            # its next claim.
+            self._scaled.discard(pid)
+            if pid in self._fresh_scales:
+                self._fresh_scales.remove(pid)
             heapq.heappush(self._free, pid)
 
     def ensure(self, slot: int, n_tokens: int) -> None:
@@ -614,6 +883,11 @@ class PageAllocator:
         for _ in range(need):
             pid = self._claim_page()
             self._ref[pid] = 1
+            if self.kv_dtype == "int8":
+                # established by protocol: the claim sits on the fresh
+                # queue and the engine's flush resets its scale rows
+                # before any write lands in it
+                self._scaled.add(pid)
             self.page_table[slot, len(owned)] = pid
             owned.append(pid)
         self._publish_occupancy()
@@ -725,6 +999,13 @@ class PageAllocator:
         owned = self._owned[slot]
         old = owned[page_idx]
         new = self._claim_page()
+        if self.kv_dtype == "int8":
+            # NOT a fresh-reset page: the caller's device copy brings
+            # the SOURCE page's scales over (copy_page_q), and
+            # note_scale_copied marks it established. Until then the
+            # page is deliberately un-established so a forgotten scale
+            # copy fails the next check() loudly.
+            self._fresh_scales.remove(new)
         self._ref[new] = 1
         owned[page_idx] = new
         self.page_table[slot, page_idx] = new
@@ -736,7 +1017,10 @@ class PageAllocator:
             _trace.event("prefix.cow", pool=self.monitor_pool,
                          slot=slot, old=old, new=new)
         self._publish_occupancy()
-        if self.debug:
+        if self.debug and self.kv_dtype != "int8":
+            # int8 defers to note_scale_copied: between this return and
+            # the device copy the new page is legitimately in the
+            # not-yet-scaled state check() exists to reject
             self.check()
         return old, new
 
@@ -775,6 +1059,7 @@ class PageAllocator:
         free heap (engine ``reset_state``: the pools are rebuilt from
         zeros, so every cached block's KV is gone)."""
         for pid in list(self._parked):
+            self._scaled.discard(pid)
             heapq.heappush(self._free, pid)
         self._parked.clear()
         self._index.clear()
@@ -786,16 +1071,42 @@ class PageAllocator:
         if self.debug:
             self.check()
 
+    def set_kv_dtype(self, kv_dtype: str) -> None:
+        """Swap this pool's storage-dtype bookkeeping (the ENGINE owns
+        rebuilding the device pools — only call through its idle-only
+        ``set_kv_dtype``). Retires the old ``kv_dtype``-labeled gauge
+        points so the pages gauge never exports two dtypes for one
+        pool, and resets the scale bookkeeping (fresh pools start with
+        floor scales, nothing established or pending)."""
+        from ..quantization.kv import KV_DTYPES
+
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got "
+                f"{kv_dtype!r}")
+        if kv_dtype == self.kv_dtype:
+            return
+        self._retire_pages_gauge()
+        self.kv_dtype = kv_dtype
+        self._scaled.clear()
+        self._fresh_scales.clear()
+        self._publish_occupancy()
+
+    def _retire_pages_gauge(self) -> None:
+        try:
+            from .. import monitor
+
+            monitor.remove_series("paddle_tpu_kv_pages",
+                                  pool=self.monitor_pool)
+        except Exception:  # teardown-ordering safe
+            pass
+
     def close(self) -> None:
         """Retire this allocator's monitor series (idempotent). Without
         this, a dropped engine's pool gauges would export their last
         values forever and label cardinality would grow per engine."""
+        self._retire_pages_gauge()
         try:
-            pages = self._pages_gauge()
-            pages.remove(pool=self.monitor_pool, state="free")
-            pages.remove(pool=self.monitor_pool, state="used")
-            if self.prefix_cache:
-                pages.remove(pool=self.monitor_pool, state="cached")
             self._occupancy_gauge().remove(pool=self.monitor_pool)
         except Exception:  # teardown-ordering safe
             pass
@@ -806,7 +1117,8 @@ class PageAllocator:
             for name in ("paddle_tpu_kv_preemptions_total",
                          "paddle_tpu_kv_prefix_hits_total",
                          "paddle_tpu_kv_prefix_tokens_saved_total",
-                         "paddle_tpu_kv_shared_pages"):
+                         "paddle_tpu_kv_shared_pages",
+                         "paddle_tpu_kv_quant_bytes_saved_total"):
                 monitor.remove_series(name, pool=self.monitor_pool)
         except Exception:
             pass

@@ -319,9 +319,9 @@ class LlamaAttention(Layer):
         q = self.q_proj(x)
         k = self.k_proj(x)
         v = self.v_proj(x)
-        kp0, vp0 = cache
+        quant = len(cache) == 4   # (k, v, k_scale, v_scale) int8 pools
 
-        def attend(qv, kv, vv, kp, vp):
+        def _prep(qv, kv, vv, kp):
             ps = kp.shape[1]
             max_len = page_table.shape[1] * ps
             pos = lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None]
@@ -337,10 +337,14 @@ class LlamaAttention(Layer):
             page = page_table[ar[:, None], idx // ps]       # [B, W]
             ok = live[:, None] & (page >= 0) & (pos < max_len)
             page = jnp.where(ok, page, kp.shape[0])
-            kp = kp.at[page, idx % ps].set(kh.astype(kp.dtype),
-                                           mode="drop")
-            vp = vp.at[page, idx % ps].set(vh.astype(vp.dtype),
-                                           mode="drop")
+            return qh, kh, vh, page, idx % ps
+
+        def attend(qv, kv, vv, kp, vp):
+            qh, kh, vh, page, offs = _prep(qv, kv, vv, kp)
+            kp = kp.at[page, offs].set(kh.astype(kp.dtype),
+                                       mode="drop")
+            vp = vp.at[page, offs].set(vh.astype(vp.dtype),
+                                       mode="drop")
             from ..ops.paged_attention import paged_decode_mha
 
             lv = live.astype(jnp.int32)
@@ -350,9 +354,40 @@ class LlamaAttention(Layer):
                  for i in range(w)], axis=1)
             return ctx.reshape(b, w, self.num_heads * hd), kp, vp
 
-        ctx, kp, vp = apply_op(attend, q, k, v, kp0, vp0,
-                               op_name="spec_paged_attention")
+        def attend_q(qv, kv, vv, kp, vp, ks, vs):
+            # int8 pools: the W-wide draft-window writes quantize on
+            # store through the same running-absmax primitive the
+            # single-token step uses (rows flattened to [B*W] — rows
+            # landing in one page compose in the scatter-max), and
+            # every per-position read dequantizes inside the kernel
+            from ..ops.paged_attention import paged_decode_mha
+            from ..quantization.kv import quant_store_rows
+
+            qh, kh, vh, page, offs = _prep(qv, kv, vv, kp)
+            pf, of = page.reshape(-1), offs.reshape(-1)
+            kp, ks = quant_store_rows(kp, ks, pf, of,
+                                      kh.reshape(b * w, self.kv_heads,
+                                                 hd))
+            vp, vs = quant_store_rows(vp, vs, pf, of,
+                                      vh.reshape(b * w, self.kv_heads,
+                                                 hd))
+            lv = live.astype(jnp.int32)
+            ctx = jnp.stack(
+                [paged_decode_mha(qh[:, i], kp, vp, page_table,
+                                  lens + lv * (i + 1), ks, vs)
+                 for i in range(w)], axis=1)
+            return (ctx.reshape(b, w, self.num_heads * hd), kp, vp,
+                    ks, vs)
+
         val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
+        if quant:
+            ctx, kp, vp, ks, vs = apply_op(
+                attend_q, q, k, v, *cache,
+                op_name="spec_paged_attention")
+            return self.o_proj(ctx), (val(kp), val(vp), val(ks),
+                                      val(vs))
+        ctx, kp, vp = apply_op(attend, q, k, v, *cache,
+                               op_name="spec_paged_attention")
         return self.o_proj(ctx), (val(kp), val(vp))
 
     def forward_decode_paged(self, x, cos_full, sin_full, cache,
@@ -368,9 +403,9 @@ class LlamaAttention(Layer):
         q = self.q_proj(x)
         k = self.k_proj(x)
         v = self.v_proj(x)
-        kp0, vp0 = cache
+        quant = len(cache) == 4   # (k, v, k_scale, v_scale) int8 pools
 
-        def attend(qv, kv, vv, kp, vp):
+        def _prep(qv, kv, vv, kp):
             ps = kp.shape[1]
             idx = jnp.minimum(lens, page_table.shape[1] * ps - 1)
             c = cos_full[idx][:, None, None, :]
@@ -383,19 +418,45 @@ class LlamaAttention(Layer):
             page = page_table[jnp.arange(b), idx // ps]
             # dead rows / unmapped pages -> sentinel, dropped by scatter
             page = jnp.where(live & (page >= 0), page, kp.shape[0])
-            kp = kp.at[page, idx % ps].set(kh.astype(kp.dtype),
-                                           mode="drop")
-            vp = vp.at[page, idx % ps].set(vh.astype(vp.dtype),
-                                           mode="drop")
+            return qh, kh, vh, page, idx % ps
+
+        def attend(qv, kv, vv, kp, vp):
+            qh, kh, vh, page, offs = _prep(qv, kv, vv, kp)
+            kp = kp.at[page, offs].set(kh.astype(kp.dtype),
+                                       mode="drop")
+            vp = vp.at[page, offs].set(vh.astype(vp.dtype),
+                                       mode="drop")
             from ..ops.paged_attention import paged_decode_mha
 
             ctx = paged_decode_mha(qh, kp, vp, page_table,
                                    lens + live.astype(jnp.int32))
             return ctx.reshape(b, 1, self.num_heads * hd), kp, vp
 
-        ctx, kp, vp = apply_op(attend, q, k, v, kp0, vp0,
-                               op_name="paged_attention")
+        def attend_q(qv, kv, vv, kp, vp, ks, vs):
+            # int8 pools: quantize-on-store (running absmax rides the
+            # scale arrays), fused dequant in the read kernel — the
+            # decode-step HBM read is int8, the whole point on
+            # bandwidth-bound decode
+            from ..ops.paged_attention import paged_decode_mha
+            from ..quantization.kv import quant_store_rows
+
+            qh, kh, vh, page, offs = _prep(qv, kv, vv, kp)
+            kp, ks = quant_store_rows(kp, ks, page, offs, kh)
+            vp, vs = quant_store_rows(vp, vs, page, offs, vh)
+            ctx = paged_decode_mha(qh, kp, vp, page_table,
+                                   lens + live.astype(jnp.int32),
+                                   ks, vs)
+            return (ctx.reshape(b, 1, self.num_heads * hd), kp, vp,
+                    ks, vs)
+
         val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
+        if quant:
+            ctx, kp, vp, ks, vs = apply_op(
+                attend_q, q, k, v, *cache, op_name="paged_attention")
+            return self.o_proj(ctx), (val(kp), val(vp), val(ks),
+                                      val(vs))
+        ctx, kp, vp = apply_op(attend, q, k, v, *cache,
+                               op_name="paged_attention")
         return self.o_proj(ctx), (val(kp), val(vp))
 
     def forward(self, x, cos, sin, attn_mask=None):
@@ -570,12 +631,30 @@ class LlamaModel(Layer):
             new_caches.append(cache)
         return self.norm(x), new_caches
 
-    def init_paged_cache(self, num_pages: int, page_size: int):
+    def init_paged_cache(self, num_pages: int, page_size: int,
+                         kv_dtype: str = "bf16"):
         """Per-layer page POOLS (shared-table layout: one page_table,
-        inference/paged_cache.PageAllocator, serves every layer)."""
+        inference/paged_cache.PageAllocator, serves every layer).
+
+        ``kv_dtype="bf16"`` (default) stores pages in the model's
+        configured cache dtype — the bitwise pre-quantization layout.
+        ``"int8"`` returns 4-tuples ``(k, v, k_scale, v_scale)`` per
+        layer: int8 pools plus per-(page, kv_head) f32 running-absmax
+        scales (quantization.kv conventions) that every paged
+        decode/spec forward quantizes against on store and dequantizes
+        with inside the attention kernel."""
         cfg = self.config
-        dt = jnp.dtype(cfg.dtype) if cfg.dtype != "float32" else jnp.float32
         shape = (num_pages, page_size, cfg.kv_heads, cfg.head_dim)
+        if kv_dtype == "int8":
+            from ..quantization.kv import KV_SCALE_FLOOR
+
+            sshape = (num_pages, cfg.kv_heads)
+            return [(jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(shape, jnp.int8),
+                     jnp.full(sshape, KV_SCALE_FLOOR, jnp.float32),
+                     jnp.full(sshape, KV_SCALE_FLOOR, jnp.float32))
+                    for _ in range(cfg.num_hidden_layers)]
+        dt = jnp.dtype(cfg.dtype) if cfg.dtype != "float32" else jnp.float32
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in range(cfg.num_hidden_layers)]
 
@@ -683,8 +762,10 @@ class LlamaForCausalLM(Layer):
             input_ids, caches, lens, live)
         return self.logits(hidden), caches
 
-    def init_paged_cache(self, num_pages: int, page_size: int):
-        return self.model.init_paged_cache(num_pages, page_size)
+    def init_paged_cache(self, num_pages: int, page_size: int,
+                         kv_dtype: str = "bf16"):
+        return self.model.init_paged_cache(num_pages, page_size,
+                                           kv_dtype=kv_dtype)
 
     def forward_decode_paged(self, input_ids, caches, page_table, lens,
                              live):

@@ -23,6 +23,14 @@ costs O(its length).
 ``PagedKVCache`` (inference/paged_cache.py) owns the pool + free-list;
 this module is the pure compute.
 
+QUANTIZED pools (``kv_dtype="int8"`` serving): pass the per-(page,
+kv_head) absmax scale arrays and the kernel dequantizes AFTER the page
+DMA (``paddle_tpu.quantization.kv`` conventions) — decode's HBM read
+is half the bytes, which is the whole lever on bandwidth-bound decode.
+A jax build without ``jax.experimental.pallas.tpu`` (the grid spec
+needs it even in interpret mode) falls back to a pure-jnp dense-gather
+reference with the same math — CPU-compat, not a performance path.
+
 Relationship to ``ops/pallas.py::paged_attention``: that function wraps
 the STOCK ``jax.experimental.pallas.ops.tpu.paged_attention`` kernel
 (same pool/page-table layout) and is the TPU-only, tuned option; THIS
@@ -45,6 +53,8 @@ try:
 except Exception:  # pragma: no cover
     pltpu = None
 
+from ..quantization.kv import KV_QMAX as _KV_QMAX
+
 __all__ = ["paged_decode_mha"]
 
 
@@ -53,11 +63,16 @@ def _interpret() -> bool:
 
 
 def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                         acc_ref, m_ref, l_ref, *, scale, page_size):
+                         acc_ref, m_ref, l_ref, *, scale, page_size,
+                         ks_ref=None, vs_ref=None):
     """One (batch row, page) step of the online-softmax recurrence.
 
     ``pt_ref``/``len_ref`` are scalar-prefetched; the K/V blocks arriving
-    here were already DMA'd from the page the index map selected."""
+    here were already DMA'd from the page the index map selected. With
+    ``ks_ref``/``vs_ref`` bound (int8 pools) the K/V block is int8 and
+    the per-(page, kv_head) absmax scales dequantize it HERE, after the
+    DMA — the HBM read is half the bytes, which is the whole point on
+    bandwidth-bound decode."""
     ib, jp = pl.program_id(0), pl.program_id(1)
     npg = pl.num_programs(1)
 
@@ -76,6 +91,12 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32)            # [Hq, D]
         k = k_ref[0].astype(jnp.float32)            # [ps, Hkv, D]
         v = v_ref[0].astype(jnp.float32)
+        if ks_ref is not None:
+            # fused dequant (quantization.kv conventions): the scale
+            # block is this page's [Hkv] absmax row, selected by the
+            # same prefetched-table index map that aimed the K/V DMA
+            k = k * (ks_ref[0] * (1.0 / _KV_QMAX))[None, :, None]
+            v = v * (vs_ref[0] * (1.0 / _KV_QMAX))[None, :, None]
         g = q.shape[0] // k.shape[1]
         if g > 1:                                   # GQA: share KV heads
             k = jnp.repeat(k, g, axis=1)            # VMEM-local repeat
@@ -102,9 +123,46 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
+def _paged_decode_ref(q, k_pool, v_pool, page_table, seq_lens,
+                      k_scale=None, v_scale=None):
+    """Pure-jnp reference/fallback: gather each row's pages dense and
+    run a masked softmax. Used when this jax build lacks
+    ``jax.experimental.pallas.tpu`` (the grid spec below needs it even
+    in interpret mode) — numerically equivalent to the kernel (same
+    f32 math, plain instead of online softmax), NOT byte-identical,
+    and it materializes [B, max_pages*page_size] KV so it is a
+    CPU-compat path, not a performance one. Quantized pools dequant
+    here with the same ``quantization.kv`` conventions the fused
+    kernel uses."""
+    b, h, d = q.shape
+    hkv = k_pool.shape[2]
+    ps = k_pool.shape[1]
+    idx = jnp.maximum(page_table, 0)                 # [B, maxp]
+    k = k_pool[idx].astype(jnp.float32)              # [B, maxp, ps, Hkv, D]
+    v = v_pool[idx].astype(jnp.float32)
+    if k_scale is not None:
+        k = k * (k_scale[idx] / _KV_QMAX)[:, :, None, :, None]
+        v = v * (v_scale[idx] / _KV_QMAX)[:, :, None, :, None]
+    L = idx.shape[1] * ps
+    k = k.reshape(b, L, hkv, d)
+    v = v.reshape(b, L, hkv, d)
+    g = h // hkv
+    if g > 1:
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+    s = jnp.einsum("bhd,blhd->blh", q.astype(jnp.float32), k)
+    s = s * (1.0 / math.sqrt(d))
+    mask = (jnp.arange(L, dtype=jnp.int32)[None, :, None]
+            < seq_lens[:, None, None])
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=1)
+    p = jnp.where(mask, p, 0.0)
+    return jnp.einsum("blh,blhd->bhd", p, v).astype(q.dtype)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
-                     interpret=None):
+                     k_scale=None, v_scale=None, interpret=None):
     """Single-step decode attention over a paged KV pool.
 
     q: [B, Hq, D] (this step's query)
@@ -115,17 +173,21 @@ def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
         for the skipped DMA)
     seq_lens: [B] int32 valid lengths (the new token's k/v must already
         be written at position seq_lens-1 via PagedKVCache.write_tokens)
+    k_scale/v_scale: [num_pages, Hkv] f32 per-page-per-head absmax
+        scales for INT8 pools (quantization.kv conventions) — pass both
+        or neither. Dequant fuses into the kernel after the page DMA,
+        so the HBM read stays int8 (the bandwidth win quantized KV
+        exists for); the output is f32-accumulated either way.
     Returns [B, H, D].
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     if pltpu is None:
-        # the grid spec below needs jax.experimental.pallas.tpu even in
-        # interpret mode; without it the failure would be an opaque
-        # AttributeError on the None module
-        raise NotImplementedError(
-            "paged_decode_mha requires jax.experimental.pallas.tpu "
-            "(scalar-prefetch grid spec), which this jax build does not "
-            "provide — install a jax with TPU Pallas support (the CPU "
-            "interpret path uses the same grid spec)")
+        # the scalar-prefetch grid spec needs jax.experimental.pallas
+        # .tpu even in interpret mode — fall back to the dense-gather
+        # reference (same math, no paging win) instead of failing
+        return _paged_decode_ref(q, k_pool, v_pool, page_table,
+                                 seq_lens, k_scale, v_scale)
     b, h, d = q.shape
     hkv = k_pool.shape[2]
     if h % hkv:
@@ -140,14 +202,36 @@ def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
         # still issue a DMA — aim it at page 0 harmlessly
         return (jnp.maximum(pt[bi, pi], 0), 0, 0, 0)
 
+    def _page_scale(bi, pi, pt, _lens):
+        return (jnp.maximum(pt[bi, pi], 0), 0)
+
+    quant = k_scale is not None
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda bi, pi, pt, ln: (bi, 0, 0)),
+        pl.BlockSpec((1, page_size, hkv, d), _page),
+        pl.BlockSpec((1, page_size, hkv, d), _page),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [pl.BlockSpec((1, hkv), _page_scale),
+                     pl.BlockSpec((1, hkv), _page_scale)]
+        operands += [k_scale, v_scale]
+
+    def kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+        else:
+            ks_ref = vs_ref = None
+            o_ref, acc_ref, m_ref, l_ref = rest
+        _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref,
+                             o_ref, acc_ref, m_ref, l_ref, scale=scale,
+                             page_size=page_size, ks_ref=ks_ref,
+                             vs_ref=vs_ref)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, npages),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda bi, pi, pt, ln: (bi, 0, 0)),
-            pl.BlockSpec((1, page_size, hkv, d), _page),
-            pl.BlockSpec((1, page_size, hkv, d), _page),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d), lambda bi, pi, pt, ln: (bi, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, d), jnp.float32),
@@ -156,9 +240,8 @@ def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
         ],
     )
     return pl.pallas_call(
-        functools.partial(_paged_decode_kernel, scale=scale,
-                          page_size=page_size),
+        kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid_spec=grid_spec,
         interpret=it,
-    )(page_table, seq_lens, q, k_pool, v_pool)
+    )(page_table, seq_lens, q, *operands[1:])

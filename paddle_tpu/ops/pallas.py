@@ -184,7 +184,10 @@ def paged_attention(q, k_pages, v_pages, lengths, page_indices, **kw):
     masked_multihead_attention_kernel in fused_multi_transformer_op.cu.h:745).
     TPU: JAX Pallas paged_attention kernel. See also the framework's own
     ``ops/paged_attention.py::paged_decode_mha`` (same layout, runs in
-    interpret mode too, integrates with inference.PagedKVCache)."""
+    interpret mode too, integrates with inference.PagedKVCache).
+    Quantized (int8) pools are NOT supported here — the stock kernel
+    has no scale inputs; the serving engines' ``kv_dtype="int8"`` path
+    uses ``paged_decode_mha``'s fused dequant instead."""
     from jax.experimental.pallas.ops.tpu.paged_attention import (
         paged_attention as _pa)
 

@@ -300,3 +300,16 @@ def quanter(class_name: str):
 
 
 __all__ += ["BaseQuanter", "quanter"]
+
+# serving-side KV quantization math (int8 pages, per-page-per-head
+# absmax scales): ONE home shared by the paged-cache store helpers,
+# the fused-dequant attention kernel, and the A/B divergence harness
+# — and the intended import point for future weight-side int8 too
+from . import kv  # noqa: E402
+from .kv import (KV_DTYPES, KV_QMAX, KV_SCALE_FLOOR,  # noqa: E402,F401
+                 dequant_scale, dequantize_page, max_logit_divergence,
+                 quant_store_rows, quantize_page)
+
+__all__ += ["kv", "KV_DTYPES", "KV_QMAX", "KV_SCALE_FLOOR",
+            "dequant_scale", "quantize_page", "dequantize_page",
+            "quant_store_rows", "max_logit_divergence"]

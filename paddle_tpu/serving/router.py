@@ -119,7 +119,13 @@ class ReplicaSpec:
     deterministic init gives every replica bitwise-identical weights,
     which is what makes greedy failover exact. The same seam later
     fronts remote HTTP replicas: anything with
-    ``build() -> Server-shaped object`` routes."""
+    ``build() -> Server-shaped object`` routes.
+
+    Engine knobs mirror through ``server_kwargs`` — e.g.
+    ``server_kwargs={"kv_dtype": "int8"}`` builds every replica on
+    quantized KV pages (greedy failover replay stays exact across the
+    fleet: identical weights + identical quantization make every
+    replica's bounded numerics the SAME numerics)."""
 
     def __init__(self, engine_factory, server_kwargs: Optional[dict]
                  = None):

@@ -171,6 +171,13 @@ class Server:
       request may absorb; past it the request FAILS with
       :class:`PreemptionBudgetExceeded` as its cause instead of
       thrashing through the pool forever;
+    - ``kv_dtype`` — convenience mirror of the paged engine's KV
+      storage dtype (``"bf16"``/``"int8"``; None leaves the engine's
+      own setting). ``"int8"`` stores KV pages int8 with per-page
+      scales: half the decode read bytes, ~2x the pages at fixed HBM
+      — which directly lifts the optimistic-admission concurrency
+      ceiling — at a BOUNDED (not bitwise) numerics contract; the
+      swap rebuilds the pools, so it is idle-engine-only;
     - ``age_after_s`` — queue priority aging (None = strict static
       priority): a waiting request's effective priority improves one
       level per ``age_after_s`` seconds queued, so low-priority work
@@ -223,7 +230,8 @@ class Server:
                  admission_mode: Optional[str] = None,
                  age_after_s: Optional[float] = None,
                  draft_k: Optional[int] = None,
-                 speculative: bool = False):
+                 speculative: bool = False,
+                 kv_dtype: Optional[str] = None):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
                 f"stall_timeout_s must be > 0 or None, got "
@@ -258,6 +266,29 @@ class Server:
                 raise ValueError(
                     "admission_mode can only be set on an idle engine")
             engine.admission_mode = admission_mode
+        if kv_dtype is not None:
+            # convenience mirror of the paged engine's KV storage
+            # dtype (see PagedContinuousBatchingEngine kv_dtype):
+            # routed through the engine's idle-only set_kv_dtype hook
+            # — a dtype swap REBUILDS the pools, so a plain attribute
+            # set would silently serve bf16 pools labeled int8.
+            # Set before the scheduler thread starts so warmup
+            # pre-compiles the dtype's program variants.
+            from ..quantization.kv import KV_DTYPES
+
+            if kv_dtype not in KV_DTYPES:
+                raise ValueError(
+                    f"kv_dtype must be one of {KV_DTYPES}, got "
+                    f"{kv_dtype!r}")
+            set_fn = getattr(engine, "set_kv_dtype", None)
+            if set_fn is None:
+                raise ValueError(
+                    "kv_dtype needs a paged engine "
+                    "(PagedContinuousBatchingEngine)")
+            if getattr(engine, "_slot_req", None):
+                raise ValueError(
+                    "kv_dtype can only be set on an idle engine")
+            set_fn(kv_dtype)
         if draft_k is not None:
             # convenience mirror of the engine's speculative-decoding
             # knob (see ContinuousBatchingEngine draft_k): set before
@@ -658,11 +689,17 @@ class Server:
         out = {
             "admission_mode": getattr(self.engine, "admission_mode",
                                       "reserved"),
+            # storage dtype travels WITH the page numbers: at fixed
+            # HBM an int8 pool holds ~2x the pages, so occupancy /
+            # free_pages are only comparable dtype-attached
+            "kv_dtype": getattr(alloc, "kv_dtype", "bf16"),
             "occupancy": round(alloc.occupancy, 4),
             "free_pages": alloc.free_pages,
             "waiting_on_pages": self._waiting_on_pages,
             "preemptions": alloc.preemptions,
         }
+        if getattr(alloc, "kv_dtype", "bf16") == "int8":
+            out["kv_quant_bytes_saved"] = alloc.quant_bytes_saved
         if getattr(alloc, "prefix_cache", False):
             # prefix-cache surface: parked pages are reclaimable
             # capacity (free + cached = what admission can claim),

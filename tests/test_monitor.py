@@ -297,16 +297,21 @@ class TestPagedCacheGauges:
         alloc = PageAllocator(num_pages=8, page_size=4, max_batch=2,
                               max_pages=4)
         pool = alloc.monitor_pool
-        pages = mon.gauge("paddle_tpu_kv_pages", "", ("pool", "state"))
-        assert pages.labels(pool=pool, state="free").value == 8
+        # the pages gauge carries the storage dtype since quantized KV
+        # (int8 pools hold ~2x pages at fixed HBM, so a page count is
+        # only comparable with its dtype attached)
+        pages = mon.gauge("paddle_tpu_kv_pages", "",
+                          ("pool", "state", "kv_dtype"))
+        lab = dict(pool=pool, kv_dtype="bf16")
+        assert pages.labels(state="free", **lab).value == 8
         alloc.ensure(0, 10)  # 3 pages
-        assert pages.labels(pool=pool, state="free").value == 5
-        assert pages.labels(pool=pool, state="used").value == 3
+        assert pages.labels(state="free", **lab).value == 5
+        assert pages.labels(state="used", **lab).value == 3
         occ = mon.gauge("paddle_tpu_kv_page_occupancy_ratio", "",
                         ("pool",))
         assert occ.labels(pool=pool).value == pytest.approx(3 / 8)
         alloc.free_slot(0)
-        assert pages.labels(pool=pool, state="free").value == 8
+        assert pages.labels(state="free", **lab).value == 8
         assert occ.labels(pool=pool).value == 0.0
 
     def test_two_pools_publish_independently(self, mon):

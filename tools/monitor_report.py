@@ -96,7 +96,12 @@ SERVING_FAMILIES = (
     "paddle_tpu_decode_tokens_per_sec",
     "paddle_tpu_kv_admission_seconds",
     "paddle_tpu_kv_page_occupancy_ratio",
-    "paddle_tpu_kv_pages",              # pool free/used by state
+    "paddle_tpu_kv_pages",              # pool free/used by state +
+    #                                     kv_dtype (int8 pools hold ~2x
+    #                                     pages at fixed HBM)
+    "paddle_tpu_kv_quant_bytes_saved_total",  # int8 KV: HBM bytes the
+    #                                     quantized layout avoided for
+    #                                     claimed pages, per pool
     "paddle_tpu_kv_preemptions_total",  # memory-pressure preemptions
     #                                     by reason (pressure /
     #                                     unsatisfiable)

@@ -70,6 +70,14 @@ Usage::
     # reporting serve_tpot_* per arm plus serve_trace_tpot_overhead
     # (the "near-zero when disabled / cheap when on" claim, measured)
     python tools/serve_bench.py --trace-ab --warmup
+    # quantized-KV A/B (PERF.md quantized-KV methodology): IDENTICAL
+    # load through bf16 pools vs int8 pools AT EQUAL HBM (the int8 arm
+    # gets 2x --num-pages) — compare serve_kv_occupancy_* (halved at
+    # matched load = doubled capacity), serve_kv_quant_tpot_speedup,
+    # serve_kv_quant_capacity_ratio, and the bounded-numerics records
+    # serve_kv_quant_max_logit_div / serve_kv_quant_token_flips
+    python tools/serve_bench.py --kv-ab --warmup
+    python tools/serve_bench.py --kv-dtype int8   # single int8 run
 
 Output: one human table plus BENCH-shaped JSON records
 (``{"metric": ..., "value": ..., "unit": ...}``) on stdout. Chaos runs
@@ -259,6 +267,7 @@ def _toy_engine(args, speculative: bool = False):
         admission_mode=args.admission_mode,
         kv_watermark=args.kv_watermark,
         prefix_cache=(args.cache_prefixes == "on"),
+        kv_dtype=args.kv_dtype,
         draft_k=(args.draft_k if speculative else 0))
     return eng, cfg.vocab_size
 
@@ -548,6 +557,19 @@ def main(argv=None) -> int:
                          "off then on — and report serve_tpot_* per "
                          "arm plus serve_trace_tpot_overhead (the "
                          "tracing-overhead record PERF.md quotes)")
+    # quantized-KV knobs (paged engine int8 pages, quantization.kv)
+    ap.add_argument("--kv-dtype", choices=("bf16", "int8"),
+                    default="bf16",
+                    help="KV page storage dtype: int8 halves decode "
+                         "read bytes and doubles pages at fixed HBM "
+                         "(bounded, not bitwise, numerics)")
+    ap.add_argument("--kv-ab", action="store_true",
+                    help="A/B mode: run the SAME load twice — bf16 "
+                         "pools, then int8 pools with --num-pages "
+                         "DOUBLED (equal HBM) — and report per-arm "
+                         "records plus serve_kv_quant_tpot_speedup, "
+                         "serve_kv_quant_capacity_ratio and the "
+                         "bounded-numerics divergence probe")
     args = ap.parse_args(argv)
 
     rng = random.Random(args.seed)
@@ -559,9 +581,14 @@ def main(argv=None) -> int:
               "--trace-ab need the in-process engine (no --url)",
               file=sys.stderr)
         return 2
-    if args.spec_ab and args.trace_ab:
-        print("--spec-ab and --trace-ab are separate A/Bs; run them "
-              "one at a time", file=sys.stderr)
+    if sum([args.spec_ab, args.trace_ab, args.kv_ab]) > 1:
+        print("--spec-ab/--trace-ab/--kv-ab are separate A/Bs; run "
+              "them one at a time", file=sys.stderr)
+        return 2
+    if args.kv_ab and (args.url is not None or args.router
+                       or args.replicas > 1):
+        print("--kv-ab needs the single in-process engine (no --url, "
+              "no --router/--replicas)", file=sys.stderr)
         return 2
     if args.replicas < 1:
         print("--replicas must be >= 1", file=sys.stderr)
@@ -614,11 +641,24 @@ def main(argv=None) -> int:
     elif args.trace_ab:
         arms = [("traceoff", spec_def, False),
                 ("traceon", spec_def, True)]
+    elif args.kv_ab:
+        arms = [("bf16", spec_def, trace_def),
+                ("int8", spec_def, trace_def)]
     else:
         arms = [("", spec_def, trace_def)]
     res = {}
     for arm, spec_on, trace_on in arms:
-        res[arm] = _run_arm(args, arm, spec_on, trace_on, prompts,
+        arm_args = args
+        if args.kv_ab:
+            # EQUAL HBM across the arms: int8 pages cost half the
+            # bytes, so the int8 pool gets twice the pages — the
+            # capacity half of the quantization win, visible as
+            # halved serve_kv_occupancy at matched load
+            arm_args = argparse.Namespace(**vars(args))
+            arm_args.kv_dtype = arm
+            if arm == "int8":
+                arm_args.num_pages = 2 * args.num_pages
+        res[arm] = _run_arm(arm_args, arm, spec_on, trace_on, prompts,
                             arrivals)
     if args.trace_ab:
         # the overhead verdict: decode cadence with the recorder on vs
@@ -649,7 +689,76 @@ def main(argv=None) -> int:
                 {"metric": "serve_spec_throughput_speedup",
                  "value": round(b["throughput"] / a["throughput"], 3),
                  "unit": "x (spec/plain)"}))
+    if args.kv_ab:
+        # the quantization verdict on identical replayed load: decode
+        # cadence bf16/int8 (HBM-bound hardware converts the halved
+        # read bytes into TPOT; CPU-tiny measures the MECHANISM),
+        # effective page capacity at equal HBM from the REAL per-page
+        # byte costs (scale overhead included), and the bounded-
+        # numerics probe — max next-token logit divergence + greedy
+        # token flips on a fresh engine pair
+        a, b = res["bf16"], res["int8"]
+        if a.get("tpot_p50") and b.get("tpot_p50"):
+            print(json.dumps({"metric": "serve_kv_quant_tpot_speedup",
+                              "value": round(a["tpot_p50"]
+                                             / b["tpot_p50"], 3),
+                              "unit": "x (bf16/int8)"}))
+        if b.get("kv_page_cost"):
+            # effective page capacity at equal HBM vs the bf16
+            # PRODUCTION baseline (the toy model's f32 cache dtype
+            # must not inflate this): bf16-equivalent bytes over the
+            # int8 arm's actual per-page cost, scale overhead included
+            cost = b["kv_page_cost"]
+            print(json.dumps(
+                {"metric": "serve_kv_quant_capacity_ratio",
+                 "value": round(cost["bf16_equiv_bytes_per_page"]
+                                / cost["bytes_per_page"], 3),
+                 "unit": "x pages at equal HBM (vs bf16)"}))
+        div = _kv_quant_divergence(args, prompts)
+        print(f"kv quant numerics: max logit div "
+              f"{div['max_logit_div']:.4f} (mean "
+              f"{div['mean_logit_div']:.4f}), {div['token_flips']} "
+              f"greedy token flips over {div['tokens']} tokens")
+        print(json.dumps({"metric": "serve_kv_quant_max_logit_div",
+                          "value": round(div["max_logit_div"], 6),
+                          "unit": "logit"}))
+        print(json.dumps({"metric": "serve_kv_quant_token_flips",
+                          "value": div["token_flips"],
+                          "unit": "count"}))
     return 0
+
+
+def _kv_quant_divergence(args, prompts, n_prompts: int = 3,
+                         steps: int = 16):
+    """Bounded-numerics probe for the --kv-ab verdict: one fresh
+    bf16/int8 engine pair (identical seeded weights), the run's first
+    few prompts, stepwise next-token logit comparison through the REAL
+    store/read pipeline (quantization.kv.max_logit_divergence)."""
+    import argparse as _ap
+
+    from paddle_tpu.quantization.kv import max_logit_divergence
+
+    pa = _ap.Namespace(**vars(args))
+    pa.kv_dtype = "bf16"
+    pb = _ap.Namespace(**vars(args))
+    pb.kv_dtype = "int8"
+    eng_a, _ = _toy_engine(pa)
+    eng_b, _ = _toy_engine(pb)
+    import numpy as np
+
+    # prompt + probe steps must fit one sequence's max_len; with a
+    # tiny --max-pages the step count shrinks rather than the cap
+    # going negative and silently mis-slicing (or emptying) prompts
+    max_len = args.max_pages * args.page_size
+    steps = max(1, min(steps, max_len // 2))
+    cap = max(1, max_len - steps - 1)
+    use = [np.asarray(p[:cap], np.int32)
+           for p in prompts[:n_prompts]]
+    try:
+        return max_logit_divergence(eng_a, eng_b, use, steps=steps)
+    finally:
+        eng_a.close()
+        eng_b.close()
 
 
 def _ttft_decomposition():
@@ -732,6 +841,11 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
     eng = getattr(server, "engine", None)   # a Router has replicas,
     #                                         not one engine
     alloc = getattr(eng, "alloc", None) if eng is not None else None
+    # HBM cost per page under this arm's storage dtype (scales
+    # included) + its bf16-equivalent baseline — the --kv-ab
+    # capacity-ratio record divides these
+    bpp_fn = getattr(eng, "kv_page_cost", None)
+    kv_page_cost = bpp_fn() if callable(bpp_fn) else None
     if alloc is not None:
         def _sample_occ():
             while not occ_stop.wait(0.005):
@@ -860,6 +974,15 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
                               "value": done, "unit": "count"}))
             print(json.dumps({"metric": f"serve_requests_failed{sfx}",
                               "value": stats.failed, "unit": "count"}))
+        if getattr(alloc, "kv_dtype", "bf16") == "int8":
+            # quantized-KV accounting: bytes the int8 layout avoided
+            # for the pages this run claimed (scale overhead already
+            # netted out) — the capacity half of the quantization win
+            print(f"kv quant [int8]: {alloc.quant_bytes_saved} HBM "
+                  f"bytes saved across claimed pages")
+            print(json.dumps(
+                {"metric": f"serve_kv_quant_bytes_saved{sfx}",
+                 "value": alloc.quant_bytes_saved, "unit": "bytes"}))
         if args.shared_prefix_len > 0 or getattr(alloc, "prefix_cache",
                                                  False):
             # prefix-cache A/B: hit rate over lookups (cache off: both
@@ -1017,6 +1140,7 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
         "ttft_p50": (_percentile(stats.ttft, 50) if stats.ttft
                      else None),
         "throughput": (stats.tokens / wall if wall > 0 else None),
+        "kv_page_cost": kv_page_cost,
     }
 
 
