@@ -43,6 +43,14 @@ run() {
   echo "=== rc=$rc ===" | tee -a "$LOG"
 }
 
+# 0. PREFLIGHT: the invariant linter (~3s, CPU-only — no device claim).
+#    A TPU window must never burn minutes on a program that would
+#    recompile per request (PT001) or block its scheduler gap on host
+#    syncs (PT002): fail the serving-invariant gate HERE, before any
+#    chip time is spent. Like every step it logs-and-continues, but an
+#    unbaselined finding in the log taints the window's serving records.
+STEP_TIMEOUT=300 run python -m tools.lint paddle_tpu/ --summary
+
 # 1. QUICK kernel parity slice on real hardware (conftest escape
 #    hatch): the bench-path shapes (device_scale, d=64/128) plus the r5
 #    sub-lane modes (pad/kpad/fp32 — kpad's in-kernel concat is the one

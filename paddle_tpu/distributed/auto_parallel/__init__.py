@@ -187,6 +187,7 @@ class Engine:
         self._strategy = strategy or Strategy()
         self._step_fn = None
         self._eval_fn = None
+        self._predict_fn = None
         self._params = None
         self._opt_state = None
         self.history: List[float] = []
@@ -296,8 +297,14 @@ class Engine:
                   else DataLoader(test_data, batch_size=batch_size))
         params = self._params or {
             k: p.value for k, p in self._model.named_parameters()}
-        fn = jax.jit(lambda p, x: functional_call(
-            self._model, p, Tensor(x)))
+        # one forward program per Engine, not per predict() call: a
+        # fresh jax.jit wrapper owns a fresh trace cache, so rebuilding
+        # it here re-traced (and for new batch shapes re-compiled) the
+        # model on EVERY call (PT001)
+        if self._predict_fn is None:
+            self._predict_fn = jax.jit(lambda p, x: functional_call(
+                self._model, p, Tensor(x)))
+        fn = self._predict_fn
         outs = []
         for batch in loader:
             x = batch[0] if isinstance(batch, (list, tuple)) else batch

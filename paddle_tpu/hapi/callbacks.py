@@ -308,7 +308,11 @@ class MonitorCallback(Callback):
 
     def on_train_batch_end(self, step, logs=None):
         mon = self._monitor()
-        if mon is None or self._t0 is None:
+        # the flag gate is EXPLICIT at this per-batch seam (PT005):
+        # _monitor() already returns None while disabled, but the
+        # enabled() check keeps the near-zero-when-off contract visible
+        # (and correct even for a caller holding a stale module ref)
+        if mon is None or not mon.enabled() or self._t0 is None:
             return
         if self._fit_label is None:  # monitor enabled mid-session
             self._fit_label = mon.instance_label("fit")

@@ -870,7 +870,7 @@ class ContinuousBatchingEngine:
         not reach into the private ``_free`` list."""
         return len(self._free)
 
-    def load(self) -> dict:
+    def load(self) -> dict:  # lint: hot-path
         """Host-side load snapshot: ``{"free_slots", "active_slots",
         "max_batch"}`` plus, paged, ``{"free_pages", "total_pages",
         "occupancy"}``. Everything is host bookkeeping already
@@ -1074,6 +1074,8 @@ class ContinuousBatchingEngine:
 
     def _retire(self, slot, event: str = "finished"):
         rid = self._slot_req.pop(slot)
+        # lint: allow-host-sync(host-list copy: _tokens is python-side
+        # bookkeeping, no device read happens here)
         self._finished[rid] = np.asarray(self._tokens.pop(rid), np.int32)
         del self._budget[rid]
         self._cfg.pop(rid, None)
@@ -1503,6 +1505,7 @@ class ContinuousBatchingEngine:
                                    if t["slot_steps"] else 0.0)
         return t
 
+    # lint: hot-path
     def _decode_segment_spec(self, n_steps: int,
                              cfg: Optional[GenerationConfig] = None):
         """Speculative decode segment: ``n_steps`` verify steps of the
@@ -1521,7 +1524,11 @@ class ContinuousBatchingEngine:
         k = self.draft_k
         mb = self.max_batch
         fn = self._spec_step_fn()
+        # lint: allow-host-sync(one lens/done pull per SEGMENT: the
+        # host proposers need real lengths to place drafts; tracked
+        # incrementally below, not re-pulled per step)
         lens_h = np.asarray(self.lens).copy()
+        # lint: allow-host-sync(same once-per-segment pull as lens_h)
         done_h = np.asarray(self.done_dev)
         emitted = {rid: [] for rid in self._slot_req.values()}
         finished = set()
@@ -1559,7 +1566,11 @@ class ContinuousBatchingEngine:
                 self.samp, self.caches, key, jnp.asarray(drafts),
                 jnp.asarray(live), jnp.asarray(lim))
             forwards += 1
+            # lint: allow-host-sync(the per-verify-step readback IS
+            # the speculative path's documented price — host n-gram
+            # proposers must see acceptance before drafting again)
             toks_h = np.asarray(toks)
+            # lint: allow-host-sync(same per-verify-step readback)
             acc_h = np.asarray(n_acc)
             for slot, rid in self._slot_req.items():
                 if not live[slot]:
@@ -1626,6 +1637,7 @@ class ContinuousBatchingEngine:
                 accepted=accepted, emitted=total)
         return len(self._slot_req)
 
+    # lint: hot-path
     def decode_segment(self, n_steps: int,
                        cfg: Optional[GenerationConfig] = None):
         """Run ``n_steps`` ragged decode steps over the current slots;
@@ -1658,7 +1670,10 @@ class ContinuousBatchingEngine:
             self._segment_fn(n_steps)(
                 self.params, self.last, self.lens, self.done_dev,
                 self.active_dev, self.samp, self.caches, key)
+        # lint: allow-host-sync(collection itself: ONE readback per
+        # n_steps-step segment — tokens must reach handles/streams)
         toks = np.asarray(toks)
+        # lint: allow-host-sync(same once-per-segment collection pull)
         done = np.asarray(self.done_dev)
         emitted = 0
         for slot, rid in list(self._slot_req.items()):
@@ -2525,7 +2540,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         super().reset_state()
 
     # -- optimistic-mode memory pressure (host-side, between segments) -------
-    def grow_for_segment(self, n_steps: int):
+    def grow_for_segment(self, n_steps: int):  # lint: hot-path
         """Grow every live slot's page mapping to cover the coming
         ``n_steps``-step decode segment (optimistic mode; a no-op in
         reserved mode, where admission pre-claimed the worst case).
@@ -2550,6 +2565,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         if self.admission_mode != "optimistic" or not self._slot_req:
             return []
         if self._gap_sync is None:
+            # lint: allow-host-sync(ONE cached lens/done pull per gap —
+            # growth decisions need real lengths; decode_segment's
+            # re-check reuses this exact pull via _gap_sync)
             self._gap_sync = (np.asarray(self.lens),
                               np.asarray(self.done_dev))
         lens, done = self._gap_sync
@@ -2605,6 +2623,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self.alloc.count_preemption(reason)
         return out
 
+    # lint: hot-path
     def decode_segment(self, n_steps: int,
                        cfg: Optional[GenerationConfig] = None):
         if not self._slot_req:
@@ -2651,7 +2670,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             # re-asserts, per live slot, that the live length is inside
             # the mapped pages and the imminent write lands in a
             # private page.
+            # lint: allow-host-sync(debug_pages-only invariant check —
+            # never on the production path; the pull is the price of
+            # validating coverage before a silent-drop write)
             lens = np.asarray(self.lens)
+            # lint: allow-host-sync(same debug_pages-only pull)
             done = np.asarray(self.done_dev)
             for slot, rid in self._slot_req.items():
                 if bool(done[slot]):

@@ -347,18 +347,28 @@ class PageAllocator:
         # per-page jnp .at[].set updates would each be a device dispatch.
         # Consumers convert once per segment (jnp.asarray). -1 =
         # unmapped; the kernel clamps skipped entries to page 0.
+        # The mutable pool state below is OWNED by the engine-driving
+        # (scheduler) thread — no lock by design: every mutation runs
+        # between jitted segments, and the cross-thread readers
+        # (Server.load()/healthz pressure) only take atomic int/len
+        # snapshots. The guarded-by annotations document that ownership
+        # for PT004 (documented, not lock-enforced — see MIGRATING.md).
+        # guarded-by: scheduler-thread
         self.page_table = np.full((max_batch, max_pages), -1, np.int32)
+        # guarded-by: scheduler-thread
         self._free: List[int] = list(range(num_pages))
+        # guarded-by: scheduler-thread
         self._owned: Dict[int, List[int]] = {}
         self._ref: Dict[int, int] = {}         # pid -> refcount (>=1)
         self._shared = 0                       # pages with refcount > 1
         # prefix index (prefix_cache): chain hash <-> resident page
-        self._index: Dict[bytes, int] = {}     # hash -> pid
+        self._index: Dict[bytes, int] = {}     # guarded-by: scheduler-thread
         self._hash_of: Dict[int, bytes] = {}   # pid -> hash
         self._tok_of: Dict[int, np.ndarray] = {}   # pid -> block tokens
         self._parent_of: Dict[int, bytes] = {}     # pid -> parent hash
         self._next: Dict[bytes, set] = {}      # parent hash -> {pid}
         # refcount-0 indexed pages, LRU order (oldest evicted first)
+        # guarded-by: scheduler-thread
         self._parked: "OrderedDict[int, bytes]" = OrderedDict()
         # host-side prefix-cache accounting (monitor-independent)
         self.prefix_lookups = 0

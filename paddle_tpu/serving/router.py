@@ -328,12 +328,13 @@ class Router:
         self._homogeneous = all(s is specs[0] for s in specs)
         self._lock = threading.Lock()
         self._idle_cv = threading.Condition()
-        self._next_id = 0
-        self._handles: set = set()        # live RouterHandles (pumps
+        self._next_id = 0                 # guarded-by: self._lock
+        self._handles: set = set()        # guarded-by: self._lock
+        #                                   live RouterHandles (pumps
         #                                   remove on terminal)
-        self._failovers_total = 0
-        self._draining = False
-        self._stopping = False
+        self._failovers_total = 0         # guarded-by: self._lock
+        self._draining = False            # guarded-by: self._lock
+        self._stopping = False            # guarded-by: self._lock
         self._stop_evt = threading.Event()
         # building a replica compiles nothing by itself (Server warmup
         # is a spec knob) but does allocate device state — build them
@@ -451,7 +452,7 @@ class Router:
                 return False
         return True
 
-    def load(self) -> dict:
+    def load(self) -> dict:  # lint: hot-path
         """The FLEET health/load snapshot — what ``/healthz`` serves
         (the router quacks like a Server to ``serve_http``): top-level
         ``{"status", "healthy", "router", "replicas": [...],
@@ -571,6 +572,9 @@ class Router:
         with self._lock:
             self._draining = True
         with self._idle_cv:
+            # lint: allow-unlocked(atomic emptiness probe inside the
+            # cv predicate — re-evaluated on every notify; pumps hold
+            # _lock for the actual mutation and notify after)
             return self._idle_cv.wait_for(
                 lambda: not self._handles, timeout)
 
@@ -660,6 +664,8 @@ class Router:
         # pumps unwind on their cancelled/failed inner handles; give
         # them a bounded window so no handle is left non-terminal
         with self._idle_cv:
+            # lint: allow-unlocked(same atomic cv-predicate probe as
+            # drain(); the terminal sweep below re-reads under _lock)
             self._idle_cv.wait_for(lambda: not self._handles, 10.0)
         with self._lock:
             leftovers = list(self._handles)

@@ -333,7 +333,7 @@ class Server:
         self._wake = threading.Event()
         self._idle_cv = threading.Condition()
         self._lock = threading.Lock()     # submit/lifecycle flags
-        self._next_id = 0
+        self._next_id = 0                 # guarded-by: self._lock
         self._active = {}                 # engine rid -> RequestHandle
         self._admitting = False           # True for the whole inter-
         #                                   segment gap and recovery:
@@ -352,29 +352,32 @@ class Server:
         #                                   _recover) — drain must not
         #                                   report done in that window
         self._restarts = 0
+        # guarded-by: self._lock
         self._flight_dumps = []           # flight-recorder dump paths
-        #                                   (under _lock; fault_stats /
-        #                                   healthz read them)
+        #                                   (fault_stats / healthz
+        #                                   read them)
         self._preempt_ts = []             # recent preemption stamps for
         #                                   the storm trigger (scheduler
         #                                   thread only)
         self._last_storm_dump = -1e18
-        self._fault_counts = {}           # (kind, site) -> n, host-side
+        self._fault_counts = {}           # guarded-by: self._lock
+        #                                   (kind, site) -> n, host-side
         #                                   (monitor-independent; see
         #                                   fault_stats())
-        self._recovery_s = []
+        self._recovery_s = []             # guarded-by: self._lock
         self._waiting_on_pages = 0        # preempted handles parked on
         #                                   the replay list right now
         #                                   (pressure surface; scheduler
         #                                   thread writes, healthz reads
         #                                   — an int store is atomic)
-        self._degraded_reason: Optional[str] = None   # under _lock
-        self._stall_flag = False          # degraded BY the watchdog
+        self._degraded_reason: Optional[str] = None   # guarded-by: self._lock
+        self._stall_flag = False          # guarded-by: self._lock
+        #                                   (degraded BY the watchdog)
         self._beat = time.monotonic()     # loop heartbeat the watchdog
         #                                   reads (float store: atomic)
-        self._draining = False
-        self._stopping = False
-        self._fatal: Optional[BaseException] = None
+        self._draining = False            # guarded-by: self._lock
+        self._stopping = False            # guarded-by: self._lock
+        self._fatal: Optional[BaseException] = None   # guarded-by: self._lock
         self._ready = threading.Event()   # warmup done (set immediately
         #                                   when warmup=False)
         self._stopped = threading.Event()
@@ -629,7 +632,7 @@ class Server:
                 self._flight_dumps.append(path)
         return path
 
-    def load(self) -> dict:
+    def load(self) -> dict:  # lint: hot-path
         """ONE lock-light, host-side load/health snapshot — the single
         source both ``/healthz`` and the replica router's least-loaded
         selection consume (no HTTP hop, no device sync):
@@ -954,6 +957,9 @@ class Server:
         ``failed`` (scheduler died on an exception) / ``stopped`` —
         what ``/healthz`` reports (only ``ok``/``draining`` are HTTP
         200)."""
+        # lint: allow-unlocked(single atomic ref read; _fatal is
+        # written exactly once, on the scheduler's way out — a racing
+        # read sees None or the final value, never a torn state)
         if self._fatal is not None:
             return "failed"
         if self._stopped.is_set():
@@ -1344,6 +1350,8 @@ class Server:
                         continue
                     still.append(h)
                     continue
+                # lint: allow-host-sync(host-list copy, no device
+                # read: tokens_so_far() is the handle's python list)
                 ids = np.concatenate(
                     [_prompt_ids(h.prompt)[0],
                      np.asarray(h.tokens_so_far(), np.int32)]) \
@@ -1366,7 +1374,7 @@ class Server:
             # next recovery/gap — nothing is stranded or duplicated
             self._replay = still + pending + self._replay
 
-    def _gap(self) -> None:
+    def _gap(self) -> None:  # lint: hot-path
         """The inter-segment gap: cancellations first (they free
         capacity), then ONE chunk of any in-flight chunked admission
         (bounded gap work — decode segments run between chunks), then

@@ -1,0 +1,183 @@
+"""PT002 — blocking host sync reached from an annotated hot path
+(the never-block-the-gap / lock-light-snapshot bar, PR 2-10).
+
+Ground truth is the ``# lint: hot-path`` annotation on a def (the
+scheduler gap, the decode segments, ``Server.load()`` and the router's
+snapshot — MIGRATING.md "Static analysis annotations"). Hotness
+propagates through the INTRA-module call graph: ``self.method()`` and
+module-function calls reachable from a hot root are scanned too, so a
+sync hidden in a helper is still caught. Cross-module edges (the
+scheduler calling ``self.engine.decode_segment``) are NOT followed —
+the engine's hot entry points carry their own annotations.
+
+Flagged operations:
+
+- ``.item()`` — blocking device->host scalar read;
+- ``np.asarray(...)`` / ``np.array(...)`` — forces a device transfer
+  when handed a device array (and is flagged even for host inputs:
+  the reviewer writes the one-line reason, the lint can't know);
+- ``jax.device_get`` / ``block_until_ready`` — explicit syncs;
+- ``int(x)`` / ``float(x)`` where ``x`` mentions ``self.<attr>`` state
+  or a ``jnp.``/``jax.`` expression — scalar coercion of a device
+  value blocks on its computation (``bool`` is exempt: truthiness
+  checks on host dicts/flags are idiomatic and device bools reach the
+  host through ``np.asarray``, which is already flagged).
+
+Escape hatch (reason REQUIRED): ``# lint: allow-host-sync(<reason>)``
+on or above the flagged line — e.g. the decode segment's per-step
+draft readback, which is the documented price of host proposers.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, Module, class_chain, dotted_name
+
+_NP_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+             "onp.asarray", "onp.array"}
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+# int()/float() only: device-bool reads in this codebase go through
+# np.asarray (flagged above), while bool(self.<host dict/flag>) is an
+# idiomatic truthiness check that would drown the signal
+_COERCIONS = {"int", "float"}
+
+
+def _collect_defs(mod: Module):
+    """(module_functions, classes, methods[classname][name]) — nested
+    defs are excluded from the lookup tables (they are scanned as part
+    of their parent's body)."""
+    mod_fns: Dict[str, ast.FunctionDef] = {}
+    classes: Dict[str, ast.ClassDef] = {}
+    methods: Dict[str, Dict[str, ast.FunctionDef]] = {}
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod_fns[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            classes[node.name] = node
+            methods[node.name] = {
+                m.name: m for m in node.body
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    return mod_fns, classes, methods
+
+
+def _callees(fn: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(self-method names, bare function names) called in fn's body."""
+    self_calls: Set[str] = set()
+    bare_calls: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls")):
+            self_calls.add(f.attr)
+        elif isinstance(f, ast.Name):
+            bare_calls.add(f.id)
+    return self_calls, bare_calls
+
+
+def hot_functions(mod: Module) -> Dict[ast.AST, str]:
+    """Transitively hot defs -> the root annotation that made them hot."""
+    mod_fns, classes, methods = _collect_defs(mod)
+    roots: List[Tuple[ast.AST, Optional[str], str]] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and mod.ann.on_line(node.lineno, "hot-path") is not None:
+            cls = mod.enclosing_class(node)
+            roots.append((node, cls.name if cls else None,
+                          mod.qualname(node)))
+    hot: Dict[ast.AST, str] = {}
+    todo = [(fn, cls, root) for fn, cls, root in roots]
+    while todo:
+        fn, clsname, root = todo.pop()
+        if fn in hot:
+            continue
+        hot[fn] = root
+        self_calls, bare_calls = _callees(fn)
+        for name in bare_calls:
+            target = mod_fns.get(name)
+            if target is not None and target not in hot:
+                todo.append((target, None, root))
+        if clsname is None:
+            continue
+        mro = class_chain(classes[clsname], classes) \
+            if clsname in classes else []
+        for name in self_calls:
+            for c in mro:
+                target = methods.get(c.name, {}).get(name)
+                if target is not None:
+                    if target not in hot:
+                        # scan the resolved method in the CALLER's
+                        # class context so its own self-calls keep
+                        # resolving through the subclass first
+                        todo.append((target, clsname, root))
+                    break
+    return hot
+
+
+def _mentions_device_state(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in ("jnp", "jax"):
+            return True
+    return False
+
+
+def check_host_sync(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+    hot = hot_functions(mod)
+    if not hot:
+        return findings
+
+    def _flag(node, fn, detail, what):
+        esc = mod.directive_for(node, "allow-host-sync")
+        msg_extra = ""
+        if esc is not None:
+            if esc[1]:
+                return
+            msg_extra = (" [allow-host-sync present but a REASON is "
+                         "required: # lint: allow-host-sync(<why>)]")
+        root = hot[fn]
+        where = mod.qualname(fn)
+        via = "" if where == root else f" (reached from {root})"
+        findings.append(Finding(
+            checker="PT002", file=mod.rel, line=node.lineno,
+            message=f"{what} in hot path {where}(){via}{msg_extra}",
+            hint="hoist off the hot path, batch the read per gap, or "
+                 "annotate why it must block: "
+                 "# lint: allow-host-sync(<reason>)",
+            context=where, detail=detail))
+
+    for fn in hot:
+        # walk only this def's OWN body: nested defs found in the walk
+        # belong to fn (closures run as part of it) and are included
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = dotted_name(f)
+            if isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args:
+                _flag(node, fn, ".item()",
+                      "blocking .item() device read")
+            elif name in _NP_CALLS:
+                _flag(node, fn, name.split(".", 1)[0] + "." +
+                      name.split(".")[-1],
+                      f"{name}() host transfer")
+            elif name in _SYNC_CALLS or (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "block_until_ready"):
+                _flag(node, fn, "block_until_ready"
+                      if "block" in (name or f.attr)
+                      else name, f"explicit device sync "
+                      f"({name or f.attr})")
+            elif (isinstance(f, ast.Name) and f.id in _COERCIONS
+                    and len(node.args) == 1
+                    and _mentions_device_state(node.args[0])):
+                _flag(node, fn, f"{f.id}()",
+                      f"{f.id}() scalar coercion of device state")
+    return findings

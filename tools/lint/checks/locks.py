@@ -1,0 +1,150 @@
+"""PT004 — lock discipline for ``# guarded-by:`` fields (the threaded
+serving classes, PR 2/4/9).
+
+Declaration grammar (on or above the field's ``__init__`` assignment)::
+
+    self._flight_dumps = []        # guarded-by: self._lock
+    # guarded-by: self._lock
+    self._fault_counts = {}
+    self._free = []                # guarded-by: scheduler-thread
+
+Two forms:
+
+- ``self.<lock>`` — ENFORCED: every access of the field outside
+  ``__init__`` must sit lexically inside a ``with self.<lock>`` (or
+  ``with self.<lock>:``-containing multi-item with) in the same
+  function. Deliberate lock-free reads (an atomic snapshot of one int/
+  ref) carry ``# lint: allow-unlocked(<reason>)`` — the reason is the
+  review artifact.
+- anything else (e.g. ``scheduler-thread``) — DOCUMENTED ownership,
+  not statically enforceable: the checker validates the declaration
+  parses and otherwise stays quiet. It still fails a ``self.<lock>``
+  declaration whose lock attribute the class never creates (a typo'd
+  guard would otherwise enforce nothing).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..core import Finding, Module
+
+_SELF_LOCK_PREFIX = "self."
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'self.x' for Attribute(self, x), else ''."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    return ""
+
+
+def _declared_guards(mod: Module, cls: ast.ClassDef
+                     ) -> Dict[str, Tuple[str, int]]:
+    """attr name -> (guard expression text, declaration line)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        guard = mod.ann.guard_on_line(node.lineno)
+        if guard is None:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            attr = _self_attr(t)
+            if attr:
+                out[attr.split(".", 1)[1]] = (guard, node.lineno)
+    return out
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set:
+    """Attributes assigned anywhere in the class body ('self.x' names)
+    — used to validate that a declared lock exists."""
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                a = _self_attr(t)
+                if a:
+                    out.add(a)
+    return out
+
+
+def _within_lock(mod: Module, node: ast.AST, fn: ast.AST,
+                 lock_text: str) -> bool:
+    for a in mod.ancestors(node):
+        if a is fn:
+            break
+        if isinstance(a, ast.With):
+            for item in a.items:
+                try:
+                    if ast.unparse(item.context_expr) == lock_text:
+                        return True
+                except Exception:
+                    continue
+    return False
+
+
+def check_lock_discipline(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in [n for n in ast.walk(mod.tree)
+                if isinstance(n, ast.ClassDef)]:
+        guards = _declared_guards(mod, cls)
+        if not guards:
+            continue
+        attrs = _lock_attrs(cls)
+        enforced: Dict[str, str] = {}
+        for attr, (guard, decl_line) in guards.items():
+            if not guard.startswith(_SELF_LOCK_PREFIX):
+                continue    # documented thread-ownership form
+            if guard not in attrs:
+                findings.append(Finding(
+                    checker="PT004", file=mod.rel, line=decl_line,
+                    message=f"field {attr!r} declared guarded-by "
+                            f"{guard!r}, but {cls.name} never creates "
+                            f"{guard} — the guard enforces nothing",
+                    hint="fix the lock name in the annotation or "
+                         "create the lock in __init__",
+                    context=f"{cls.name}.{attr}", detail=f"decl:{attr}"))
+                continue
+            enforced[attr] = guard
+        if not enforced:
+            continue
+        for m in cls.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if m.name == "__init__":
+                continue    # construction precedes any second thread
+            for node in ast.walk(m):
+                attr = _self_attr(node)
+                if not attr:
+                    continue
+                name = attr.split(".", 1)[1]
+                lock = enforced.get(name)
+                if lock is None:
+                    continue
+                if _within_lock(mod, node, m, lock):
+                    continue
+                esc = mod.directive_for(node, "allow-unlocked")
+                extra = ""
+                if esc is not None:
+                    if esc[1]:
+                        continue
+                    extra = (" [allow-unlocked present but a REASON "
+                             "is required]")
+                kind = ("write" if isinstance(
+                    getattr(node, "ctx", None),
+                    (ast.Store, ast.Del)) else "read")
+                findings.append(Finding(
+                    checker="PT004", file=mod.rel, line=node.lineno,
+                    message=f"{kind} of {attr} (guarded-by {lock}) "
+                            f"outside `with {lock}` in "
+                            f"{cls.name}.{m.name}(){extra}",
+                    hint=f"wrap in `with {lock}:` or justify the "
+                         "lock-free access: "
+                         "# lint: allow-unlocked(<reason>)",
+                    context=f"{cls.name}.{m.name}", detail=name))
+    return findings

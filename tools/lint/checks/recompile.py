@@ -1,0 +1,313 @@
+"""PT001 — recompile hazard (the ONE-compiled-program bar, PR 2/3/10).
+
+The serving stack's whole latency story rests on "one compiled program
+serves any request mix": per-slot device vectors instead of per-config
+programs (PR 2), O(len(buckets)) prefill programs instead of
+O(#distinct prompt lengths) (PR 3), exactly one extra program variant
+per KV dtype (PR 10). The two ways this silently breaks:
+
+1. a ``jax.jit`` / ``monitored_jit`` callable CONSTRUCTED per call — a
+   fresh wrapper owns a fresh trace cache, so every invocation
+   re-traces (and usually re-compiles). Blessed idioms: module-level
+   construction, construction in a setup method (``__init__`` /
+   ``warmup`` / ``reset_state`` / ``_build*`` / ``_init*`` / ``_make*``
+   / ``setup*``) stored on ``self``, a keyed-cache store
+   (``self._cache[key] = jit(...)`` — one program per key BY DESIGN),
+   a memoized builder (``functools.lru_cache``/``cache``), or a builder
+   that returns the jitted callable to a caller who stores it.
+2. a Python-varying value traced as a regular argument: a wrapped
+   function whose parameter NAME says "per-call-varying Python scalar"
+   (``n_steps``, ``width``, ``draft_k``, ...) jitted without
+   ``static_argnames`` re-compiles per distinct value with no cache
+   bound and no cache-keyed intent recorded.
+
+Escape hatch: ``# lint: allow-recompile(<reason>)`` on (or above) the
+construction line, reason required.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Module, dotted_name
+
+#: last segment + optional prefix that makes a call a jit construction
+_JIT_LASTS = {"jit", "pjit", "monitored_jit"}
+_JIT_PREFIXES = {"jax", "monitor", "mon", "_monitor", "monitoring"}
+
+#: parameter names that (by this repo's conventions) carry per-call
+#: Python-varying scalars — tracing them re-compiles per distinct value
+STATIC_HINT_PARAMS = frozenset({
+    "n_steps", "num_steps", "nsteps", "steps", "segment_steps",
+    "width", "bucket", "chunk", "prefill_chunk", "draft_k",
+    "block_size", "page_size", "n_layers",
+})
+
+_SETUP_PREFIXES = ("_build", "_init", "_make", "setup", "warmup")
+_SETUP_NAMES = {"__init__", "reset_state", "warmup", "set_kv_dtype"}
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if parts[-1] not in _JIT_LASTS:
+        return False
+    return len(parts) == 1 or parts[0] in _JIT_PREFIXES
+
+
+def _is_setup(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    if fn.name in _SETUP_NAMES or fn.name.startswith(_SETUP_PREFIXES):
+        return True
+    for dec in fn.decorator_list:
+        d = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+        if d and d.split(".")[-1] in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+def _wrapped_params(mod: Module, call: ast.Call,
+                    scope) -> List[str]:
+    """Parameter names of the function the jit call wraps, when it is a
+    local/nested def or lambda we can resolve (else [])."""
+    if not call.args:
+        return []
+    target = call.args[0]
+    if isinstance(target, ast.Lambda):
+        return [a.arg for a in target.args.args]
+    if not isinstance(target, ast.Name):
+        return []
+    # nearest def with that name in the enclosing scope chain
+    scopes = []
+    if scope is not None:
+        scopes.append(scope)
+        scopes.extend(a for a in mod.ancestors(scope)
+                      if isinstance(a, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)))
+    scopes.append(mod.tree)
+    for s in scopes:
+        for stmt in ast.walk(s):
+            if (isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt.name == target.id):
+                return [a.arg for a in stmt.args.args]
+    return []
+
+
+def _has_static_kw(call: ast.Call) -> bool:
+    return any(kw.arg in ("static_argnames", "static_argnums")
+               for kw in call.keywords)
+
+
+def _assignment_shape(mod: Module, call: ast.Call) -> str:
+    """How the construction's value is consumed: 'subscript' (keyed
+    cache), 'self' (instance attr), 'local:<name>', 'return', 'call'
+    (immediately invoked), 'arg' (passed along), or 'other'."""
+    parent = mod.parent.get(call)
+    if isinstance(parent, ast.Call) and parent.func is call:
+        return "call"
+    node, cur = call, parent
+    while isinstance(cur, (ast.Tuple, ast.BinOp, ast.IfExp)):
+        node, cur = cur, mod.parent.get(cur)
+    if isinstance(cur, ast.Return):
+        return "return"
+    if isinstance(cur, ast.Call):
+        return "arg"
+    if isinstance(cur, (ast.Assign, ast.AnnAssign)):
+        targets = (cur.targets if isinstance(cur, ast.Assign)
+                   else [cur.target])
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                return "subscript"
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in ("self", "cls")):
+                return "self"
+            if isinstance(t, ast.Name):
+                return f"local:{t.id}"
+    return "other"
+
+
+def _local_called_or_cached(fn, name: str) -> str:
+    """For a local-assigned jit: 'called' when the name is invoked in
+    the same function (construct-and-call-per-invocation hazard),
+    'cached' when it is stored into a subscript/attribute or returned
+    (builder), else 'unused'."""
+    called = cached = False
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == name):
+            called = True
+        if isinstance(node, ast.Assign):
+            if any(isinstance(t, (ast.Subscript, ast.Attribute))
+                   for t in node.targets):
+                if any(isinstance(v, ast.Name) and v.id == name
+                       for v in ast.walk(node.value)):
+                    cached = True
+        if isinstance(node, ast.Return) and node.value is not None:
+            # returning the WRAPPER (bare name, possibly in a tuple)
+            # hands ownership to the caller; `return fn(x)` does not —
+            # the name nested under a Call is a per-invocation use
+            v = node.value
+            elems = [v] + (list(v.elts)
+                           if isinstance(v, ast.Tuple) else [])
+            if any(isinstance(e, ast.Name) and e.id == name
+                   for e in elems):
+                cached = True
+    if cached:
+        return "cached"
+    return "called" if called else "unused"
+
+
+def _lazy_init_guard(mod: Module, call: ast.Call) -> bool:
+    """True for the guarded lazy-init idiom: the jit is assigned to
+    ``self.X`` inside an ``if`` whose test mentions ``self.X`` (``if
+    self.X is None: self.X = jit(...)``) — constructed once, like a
+    keyed cache with one key."""
+    attr = None
+    cur = mod.parent.get(call)
+    while cur is not None and not isinstance(cur, (ast.Assign,
+                                                   ast.AnnAssign)):
+        cur = mod.parent.get(cur)
+    if cur is None:
+        return False
+    targets = (cur.targets if isinstance(cur, ast.Assign)
+               else [cur.target])
+    for t in targets:
+        if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id in ("self", "cls")):
+            attr = f"{t.value.id}.{t.attr}"
+    if attr is None:
+        return False
+    for a in mod.ancestors(call):
+        if isinstance(a, ast.If):
+            try:
+                if attr in ast.unparse(a.test):
+                    return True
+            except Exception:
+                continue
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+    return False
+
+
+def check_recompile_hazard(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def _flag(node, detail, message, hint):
+        esc = mod.directive_for(node, "allow-recompile")
+        if esc is not None:
+            if esc[1]:
+                return
+            message = ("allow-recompile requires a reason: "
+                       "# lint: allow-recompile(<why>)")
+        findings.append(Finding(
+            checker="PT001", file=mod.rel, line=node.lineno,
+            message=message, hint=hint,
+            context=mod.scope_qualname(node), detail=detail))
+
+    for node in ast.walk(mod.tree):
+        # -- decorator form: @jax.jit on a def nested inside a function
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            outer = mod.enclosing_function(node)
+            if outer is None:
+                continue
+            for dec in node.decorator_list:
+                base = dec.func if isinstance(dec, ast.Call) else dec
+                # @functools.partial(jax.jit, ...) nests jit as an arg
+                jitted = _is_jit_name(base) or (
+                    isinstance(dec, ast.Call)
+                    and any(_is_jit_name(a) for a in dec.args))
+                if jitted and not _is_setup(outer):
+                    _flag(node, f"jit-decorator:{node.name}",
+                          f"@jit-decorated local def {node.name!r} is "
+                          f"re-jitted every call of "
+                          f"{mod.scope_qualname(node)}() — a fresh "
+                          "wrapper re-traces per invocation",
+                          "hoist to module level, build once in a "
+                          "setup method, or store in a keyed cache")
+            continue
+        if not isinstance(node, ast.Call) or not _is_jit_name(node.func):
+            continue
+        fn = mod.enclosing_function(node)
+        wrapped = (dotted_name(node.args[0]) if node.args else None) \
+            or "lambda"
+        detail = f"jit:{wrapped}"
+        shape = _assignment_shape(mod, node)
+        in_loop = False
+        if fn is not None:
+            for a in mod.ancestors(node):
+                if a is fn:
+                    break
+                if isinstance(a, (ast.For, ast.While)):
+                    in_loop = True
+                    break
+
+        # -- sub-check 2: python-varying param traced without
+        #    static_argnames (applies wherever constructed, EXCEPT the
+        #    keyed-cache idiom where the key IS the static value)
+        if shape != "subscript" and not _has_static_kw(node):
+            params = set(_wrapped_params(mod, node, fn))
+            hits = sorted(params & STATIC_HINT_PARAMS)
+            if hits:
+                _flag(node, f"static:{wrapped}",
+                      f"jit of {wrapped!r} traces python-varying "
+                      f"parameter(s) {', '.join(hits)} without "
+                      "static_argnames — each distinct value "
+                      "re-compiles with no bound",
+                      "add static_argnames=(...) or key a program "
+                      "cache on the value")
+
+        if fn is None:
+            continue                     # module level: compiled once
+        if shape == "subscript":
+            continue                     # keyed cache: one program/key
+        if shape == "call":
+            _flag(node, detail,
+                  f"jit({wrapped}) constructed and immediately called "
+                  f"in {mod.scope_qualname(node)}() — re-traces on "
+                  "every invocation",
+                  "construct once (module level / setup method / "
+                  "functools.cache) and call the stored wrapper")
+            continue
+        if in_loop:
+            _flag(node, detail,
+                  f"jit({wrapped}) constructed inside a loop in "
+                  f"{mod.scope_qualname(node)}()",
+                  "hoist out of the loop or store into a keyed cache "
+                  "(cache[key] = jit(...))")
+            continue
+        if _is_setup(fn):
+            continue                     # setup method: built once
+        if shape == "self" and _lazy_init_guard(mod, node):
+            continue                     # `if self._fn is None:` cache
+        if shape == "self":
+            _flag(node, detail,
+                  f"jit({wrapped}) assigned to an instance attribute "
+                  f"in non-setup method {mod.scope_qualname(node)}() — "
+                  "re-constructed (and re-traced) per call",
+                  "move construction to __init__/warmup/reset_state "
+                  "or a _build*/_make* helper")
+            continue
+        if shape in ("return", "arg"):
+            continue                     # builder handing off ownership
+        if shape.startswith("local:"):
+            use = _local_called_or_cached(fn, shape.split(":", 1)[1])
+            if use == "called":
+                _flag(node, detail,
+                      f"jit({wrapped}) constructed into a local and "
+                      f"called in the same function "
+                      f"{mod.scope_qualname(node)}() — a fresh trace "
+                      "cache per invocation",
+                      "construct once (module level / setup method / "
+                      "keyed cache) and reuse the wrapper")
+            continue
+        _flag(node, detail,
+              f"jit({wrapped}) constructed in "
+              f"{mod.scope_qualname(node)}() without a visible "
+              "cache/return — likely re-constructed per call",
+              "store at module level, on self in a setup method, or "
+              "in a keyed cache")
+    return findings

@@ -1,0 +1,211 @@
+"""PT003 — instance-labeled monitor series must be retired (PR 8's
+leak class, moved to compile time).
+
+A Counter/Gauge/Histogram created with an INSTANCE label (``server``,
+``engine``, ``pool``, ``router``, ``loader``, ``fit`` — the
+``monitor.instance_label`` families) exports forever unless its owner
+retires it: a dropped engine's gauges keep their last values and label
+cardinality grows per instance. PR 8's TestSeriesRetirement caught 3
+real leaks at runtime; this checker demands the retirement STATICALLY:
+
+- the creating class must have a retirement root — a method named
+  ``close`` / ``shutdown`` / ``__del__`` / ``__exit__`` / ``stop`` /
+  ``_retire*``, or any method annotated ``# lint: retires-series`` —
+  from which (following intra-class ``self.`` calls) the metric is
+  retired;
+- "retired" means the metric NAME appears in a retirement-reachable
+  body (the ``for name in (...): monitor.remove_series(name, ...)``
+  idiom), or a helper whose body creates that metric is invoked there
+  as ``self._helper().remove(...)`` / ``monitor.remove_series`` with
+  the name resolved through the helper.
+
+Escape hatch (reason required): ``# lint: allow-series(<reason>)`` on
+the creation line — for series whose lifecycle genuinely is the
+process (e.g. the one process-wide op-latency histogram).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, Module, class_chain, dotted_name
+
+INSTANCE_LABELS = frozenset({
+    "server", "engine", "pool", "router", "loader", "fit", "replica"})
+_CTORS = {"counter", "gauge", "histogram"}
+_CTOR_PREFIXES = {"monitor", "mon", "_monitor", "monitoring"}
+_RETIRE_ROOTS = {"close", "shutdown", "__del__", "__exit__", "stop"}
+
+
+def _is_ctor(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if parts[-1] not in _CTORS:
+        return None
+    if len(parts) > 1 and parts[0] not in _CTOR_PREFIXES:
+        return None
+    return parts[-1]
+
+
+def _literal_strings(node: ast.AST) -> List[str]:
+    return [c.value for c in ast.walk(node)
+            if isinstance(c, ast.Constant) and isinstance(c.value, str)]
+
+
+def _labelnames(call: ast.Call) -> List[str]:
+    arg = None
+    if len(call.args) >= 3:
+        arg = call.args[2]
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            arg = kw.value
+    if arg is None:
+        return []
+    if isinstance(arg, (ast.Tuple, ast.List)):
+        return [e.value for e in arg.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _metric_name(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+def _class_methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _retirement_bodies(mod: Module, cls: ast.ClassDef,
+                       by_name: Dict[str, ast.ClassDef]) -> List[ast.AST]:
+    """Retirement roots of ``cls`` (searching base classes defined in
+    the same module too) expanded through intra-class self-calls."""
+    chain = class_chain(cls, by_name)
+    methods: Dict[str, ast.FunctionDef] = {}
+    for c in reversed(chain):           # subclass overrides win
+        methods.update(_class_methods(c))
+    roots = [m for name, m in methods.items()
+             if name in _RETIRE_ROOTS or name.startswith("_retire")
+             or mod.ann.on_line(m.lineno, "retires-series") is not None]
+    out, visited = [], set()
+    while roots:
+        m = roots.pop()
+        if id(m) in visited:
+            continue
+        visited.add(id(m))
+        out.append(m)
+        for node in ast.walk(m):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("self", "cls")):
+                target = methods.get(node.func.attr)
+                if target is not None:
+                    roots.append(target)
+    return out
+
+
+def _retired_names(mod: Module, bodies: List[ast.AST],
+                   helper_metrics: Dict[str, Set[str]]) -> Set[str]:
+    """Every metric name the retirement bodies reach: literal strings
+    anywhere in them (the name-tuple + remove_series idiom) plus the
+    metrics of ``self._helper()`` calls appearing there (the
+    ``self._gauge().remove(...)`` idiom)."""
+    names: Set[str] = set()
+    for body in bodies:
+        names.update(_literal_strings(body))
+        for node in ast.walk(body):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("self", "cls")):
+                names.update(helper_metrics.get(node.func.attr, ()))
+    return names
+
+
+def check_series_lifecycle(mod: Module) -> List[Finding]:
+    if "/monitor/" in "/" + mod.rel or mod.rel.endswith("monitor.py"):
+        return []   # the registry itself is not an instrument owner
+    findings: List[Finding] = []
+    by_name = {n.name: n for n in mod.tree.body
+               if isinstance(n, ast.ClassDef)}
+
+    # helper-name -> metric names created inside it (per class)
+    helper_metrics: Dict[str, Dict[str, Set[str]]] = {}
+    for cls in by_name.values():
+        table: Dict[str, Set[str]] = {}
+        for m in _class_methods(cls).values():
+            created = set()
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call) and _is_ctor(node):
+                    name = _metric_name(node)
+                    if name:
+                        created.add(name)
+            if created:
+                table[m.name] = created
+        helper_metrics[cls.name] = table
+
+    retired_cache: Dict[str, Set[str]] = {}
+
+    def retired_for(cls: ast.ClassDef) -> Set[str]:
+        if cls.name not in retired_cache:
+            bodies = _retirement_bodies(mod, cls, by_name)
+            # a ``self._helper().remove(...)`` may resolve through any
+            # class in the base chain; merging every class's helper
+            # table over-approximates harmlessly (names are unique)
+            helpers: Dict[str, Set[str]] = {}
+            for table in helper_metrics.values():
+                for k, v in table.items():
+                    helpers.setdefault(k, set()).update(v)
+            retired_cache[cls.name] = _retired_names(mod, bodies, helpers)
+        return retired_cache[cls.name]
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not _is_ctor(node):
+            continue
+        labels = set(_labelnames(node)) & INSTANCE_LABELS
+        if not labels:
+            continue
+        name = _metric_name(node)
+        if name is None:
+            continue
+        esc = mod.directive_for(node, "allow-series")
+        label_s = "/".join(sorted(labels))
+        cls = mod.enclosing_class(node)
+        if esc is not None and esc[1]:
+            continue
+        bad_esc = (" [allow-series present but a REASON is required]"
+                   if esc is not None else "")
+        if cls is None:
+            findings.append(Finding(
+                checker="PT003", file=mod.rel, line=node.lineno,
+                message=f"series {name!r} carries instance label(s) "
+                        f"{label_s} but is created outside a class — "
+                        f"no owner can retire it{bad_esc}",
+                hint="create it through an owning class with a "
+                     "close/shutdown retirement, or justify with "
+                     "# lint: allow-series(<reason>)",
+                context=mod.scope_qualname(node), detail=name))
+            continue
+        if name in retired_for(cls) and not bad_esc:
+            continue
+        findings.append(Finding(
+            checker="PT003", file=mod.rel, line=node.lineno,
+            message=f"instance-labeled series {name!r} ({label_s}) is "
+                    f"never retired by {cls.name}'s close/shutdown — "
+                    f"it exports forever after the instance "
+                    f"drops{bad_esc}",
+            hint=f"add monitor.remove_series({name!r}, "
+                 f"{sorted(labels)[0]}=...) to {cls.name}.close/"
+                 "shutdown (or a # lint: retires-series method), or "
+                 "justify with # lint: allow-series(<reason>)",
+            context=mod.scope_qualname(node), detail=name))
+    return findings
