@@ -171,6 +171,17 @@ STEP_TIMEOUT=2400 run python tools/serve_bench.py --router --replicas 3 \
 STEP_TIMEOUT=2400 run python tools/serve_bench.py --kv-ab --layers 2 \
     --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
     --num-pages 64 --max-pages 16 --page-size 8 --warmup
+# 6h. on-TPU MULTI-TENANT LoRA serve_bench A/B (after 6g): identical
+#     pre-drawn zipf load through base (K=0) vs 8 resident rank-4
+#     adapters — read serve_lora_tpot_overhead (CPU-tiny band was
+#     1.01-1.06x; on HBM-bound TPU decode the bank-gather read is the
+#     term to watch), serve_lora_mix_entropy (~2.17 bits expected),
+#     and confirm zero post-warmup compiles in the jit counters (the
+#     one-program-per-mix claim on hardware).
+STEP_TIMEOUT=2400 run python tools/serve_bench.py --lora-ab \
+    --adapter-dist zipf --layers 2 --prompt-len 8:24 --max-new 16 \
+    --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
+    --warmup
 # 7. the remaining BASELINE.md configs — one window should produce the
 #    full config table (VERDICT r4 Missing #3). Expected budgets: each
 #    is a small model + cached-compile candidate; ~5-10 min warm,

@@ -208,7 +208,8 @@ class GenerationConfig:
                  top_k: int = 0, top_p: float = 1.0, do_sample: bool = False,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  speculative: bool = False,
-                 draft_k: Optional[int] = None):
+                 draft_k: Optional[int] = None,
+                 adapter: Optional[str] = None):
         INT32_MAX = 2 ** 31 - 1   # engine state is int32 on device; a
         #                           larger value must fail HERE, not
         #                           leak a slot mid-admission
@@ -252,6 +253,14 @@ class GenerationConfig:
             raise ValueError(
                 f"draft_k must be an int in [1, 256] or None "
                 f"(engine default), got {draft_k!r}")
+        if adapter is not None and (not isinstance(adapter, str)
+                                    or not adapter
+                                    or len(adapter) > 256):
+            # a malformed adapter name must fail at config construction
+            # (the HTTP 400 path), never inside a shared decode segment
+            raise ValueError(
+                f"adapter must be a non-empty str (<= 256 chars) or "
+                f"None (base model), got {adapter!r}")
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -268,6 +277,11 @@ class GenerationConfig:
         # engine's).
         self.speculative = bool(speculative)
         self.draft_k = None if draft_k is None else int(draft_k)
+        # multi-tenant LoRA: the fine-tune this request decodes under
+        # (None = base model). Resolved to a bank index at admission —
+        # an unknown/unloading name fails THAT request at the admit
+        # seam (request-scoped), everyone else keeps serving.
+        self.adapter = adapter
 
 
 def _sample(logits, key, cfg: GenerationConfig):
@@ -703,13 +717,21 @@ class ContinuousBatchingEngine:
     def __init__(self, model, max_batch: int, max_len: int,
                  prefill_buckets="auto",
                  prefill_chunk: Optional[int] = None,
-                 draft_k: int = 0, ngram_max: int = 3):
+                 draft_k: int = 0, ngram_max: int = 3,
+                 lora_capacity: int = 0, lora_rank: int = 8,
+                 lora_targets=("q", "k", "v", "o")):
         if (isinstance(draft_k, bool)
                 or not isinstance(draft_k, (int, np.integer))
                 or not 0 <= draft_k <= 256):
             raise ValueError(
                 f"draft_k must be an int in [0, 256] (0 disables "
                 f"speculative decoding), got {draft_k!r}")
+        if (isinstance(lora_capacity, bool)
+                or not isinstance(lora_capacity, (int, np.integer))
+                or lora_capacity < 0):
+            raise ValueError(
+                f"lora_capacity must be an int >= 0 (0 disables "
+                f"multi-tenant LoRA), got {lora_capacity!r}")
         self.model = model
         self.max_batch = max_batch
         self.max_len = max_len
@@ -737,6 +759,38 @@ class ContinuousBatchingEngine:
         # throughput side by side; retired via close()/__del__
         self._monitor_engine = monitor.instance_label("engine")
         self.params = {k: p.value for k, p in model.named_parameters()}
+        # multi-tenant LoRA (lora_capacity > 0): an AdapterRegistry owns
+        # the stacked per-target factor bank ([L, K+1, r, d] per
+        # projection, index 0 = base model) plus hot load/unload; every
+        # serving program takes the bank as a jit ARGUMENT and gathers
+        # each slot's delta by its per-slot adapter_idx device vector —
+        # one compiled program serves any adapter mix, loads rewrite
+        # bank rows only (zero per-adapter compiles). 0 disables: the
+        # programs take an empty-dict bank and trace the exact
+        # pre-LoRA computation.
+        self.lora_capacity = int(lora_capacity)
+        self.adapters = None
+        if self.lora_capacity:
+            shapes_fn = getattr(model, "lora_shapes", None)
+            if shapes_fn is None:
+                raise ValueError(
+                    f"lora_capacity needs a model exposing "
+                    f"lora_shapes(targets) (llama does); "
+                    f"{type(model).__name__} does not")
+            num_layers, shapes = shapes_fn(tuple(lora_targets))
+            # lazy import: paddle_tpu.serving imports this module
+            from ..serving.adapters import AdapterRegistry
+
+            dtype = next(iter(self.params.values())).dtype
+            self.adapters = AdapterRegistry(
+                self.lora_capacity, lora_rank, tuple(lora_targets),
+                num_layers, shapes, dtype, self._monitor_engine)
+        # adapter-index bookkeeping around admissions: slot -> index
+        # while an admission is in flight (popped by _register /
+        # _abort_admit), rid -> index while the request lives (released
+        # by _retire). guarded-by: scheduler-thread
+        self._aidx_stash = {}
+        self._rid_aidx = {}
         self._init_decode_state()
         self._slot_req = {}            # slot -> request id
         self._tokens = {}              # request id -> [generated ids]
@@ -746,20 +800,30 @@ class ContinuousBatchingEngine:
         self._next_req = 0
         self._segments_run = 0         # PRNG stream position for sampling
 
-        def prefill_one(params, ids, mini, last_idx):
+        def prefill_one(params, ids, mini, last_idx, bank, aidx):
             # last_idx (the true last prompt position of a BUCKET-padded
             # prompt) is traced: compiled programs are keyed per bucket
-            # width, not per prompt length
-            logits, mini = self._fwd_prefill(params, ids, mini)
+            # width, not per prompt length. bank/aidx are the LoRA
+            # inputs (aidx traced — one program serves every adapter;
+            # an empty bank is trace-static and falls back to the
+            # exact pre-LoRA prefill)
+            lora = ((bank, jnp.full((ids.shape[0],), aidx, jnp.int32))
+                    if bank else None)
+            logits, mini = self._fwd_prefill(params, ids, mini,
+                                             lora=lora)
             return logits[:, last_idx], mini
 
         self._prefill = monitor.monitored_jit(
             prefill_one, name="cb_prefill", donate_argnums=(2,))
 
-        def prefill_chunk_fn(params, ids, mini, pos, last_idx):
+        def prefill_chunk_fn(params, ids, mini, pos, last_idx, bank,
+                             aidx):
             # traced offset -> ops.pallas.prefix_chunk_attention: ONE
             # compiled program serves every chunk of every admission
-            logits, mini = self._fwd_prefill(params, ids, mini, pos)
+            lora = ((bank, jnp.full((ids.shape[0],), aidx, jnp.int32))
+                    if bank else None)
+            logits, mini = self._fwd_prefill(params, ids, mini, pos,
+                                             lora=lora)
             return logits[:, last_idx], mini
 
         self._prefill_chunk = monitor.monitored_jit(
@@ -778,7 +842,7 @@ class ContinuousBatchingEngine:
 
         def admit_state(lens, last, done, active, samp, slot, plen,
                         first, tok_done, temp, top_k, top_p, do_samp,
-                        eos, seed, spec_k):
+                        eos, seed, spec_k, adapter):
             # one program for the per-slot scalars AND the request's
             # sampling parameters — admission sits in the
             # latency-critical gap between decode segments, and separate
@@ -792,6 +856,7 @@ class ContinuousBatchingEngine:
                 "eos": samp["eos"].at[slot].set(eos),
                 "seed": samp["seed"].at[slot].set(seed),
                 "spec_k": samp["spec_k"].at[slot].set(spec_k),
+                "adapter": samp["adapter"].at[slot].set(adapter),
             }
             return (lens.at[slot].set(plen),
                     last.at[slot].set(first),
@@ -830,6 +895,13 @@ class ContinuousBatchingEngine:
             # verify step caps each row's acceptance at ITS spec_k, so
             # one compiled program serves any spec/plain/sampled mix
             "spec_k": jnp.zeros((mb,), jnp.int32),
+            # per-slot LoRA adapter index (0 = base model — bank row 0
+            # is zeros, so the gathered delta is exactly 0.0): the
+            # weights half of the per-slot-vector invariant. Rides the
+            # samp dict so every program that takes the sampling
+            # vectors sees it without a signature fork; consumed only
+            # when a non-empty bank is passed alongside.
+            "adapter": jnp.zeros((mb,), jnp.int32),
         }
         self._free = list(range(mb))
 
@@ -838,21 +910,37 @@ class ContinuousBatchingEngine:
         [max_batch, max_len] slabs with page pools."""
         return self.model.init_cache(self.max_batch, self.max_len)
 
-    def _fwd_prefill(self, params, ids, caches, pos=0):
+    def _bank(self) -> dict:
+        """The LoRA factor bank to pass into the jitted serving
+        programs: the registry's live arrays (a load/unload swaps them
+        — same shapes, new data, no recompile), or ``{}`` when LoRA is
+        disabled (trace-static: the programs fall back to the exact
+        pre-LoRA computation)."""
+        return self.adapters.bank if self.adapters is not None else {}
+
+    def _fwd_prefill(self, params, ids, caches, pos=0, lora=None):
         from ..core.autograd import no_grad
 
         with substituted_state(self.model, params), no_grad():
-            logits, caches = self.model.forward_with_cache(
-                Tensor(ids), caches, pos)
+            if lora is None:
+                logits, caches = self.model.forward_with_cache(
+                    Tensor(ids), caches, pos)
+            else:
+                logits, caches = self.model.forward_with_cache(
+                    Tensor(ids), caches, pos, lora=lora)
         return (logits.value if isinstance(logits, Tensor) else logits,
                 caches)
 
-    def _fwd_ragged(self, params, tok, caches, lens, live):
+    def _fwd_ragged(self, params, tok, caches, lens, live, lora=None):
         from ..core.autograd import no_grad
 
         with substituted_state(self.model, params), no_grad():
-            logits, caches = self.model.forward_decode_ragged(
-                Tensor(tok), caches, lens, live)
+            if lora is None:
+                logits, caches = self.model.forward_decode_ragged(
+                    Tensor(tok), caches, lens, live)
+            else:
+                logits, caches = self.model.forward_decode_ragged(
+                    Tensor(tok), caches, lens, live, lora=lora)
         return (logits.value if isinstance(logits, Tensor) else logits,
                 caches)
 
@@ -888,6 +976,11 @@ class ContinuousBatchingEngine:
             out["free_pages"] = alloc.free_pages
             out["total_pages"] = alloc.num_pages
             out["occupancy"] = round(alloc.occupancy, 4)
+        if self.adapters is not None:
+            # registry snapshot (resident/draining names, capacity) —
+            # host dict reads only; the router's adapter-affinity
+            # scoring and /healthz both consume it
+            out["lora"] = self.adapters.resident()
         return out
 
     def can_admit(self, prompt_len: int, cfg: GenerationConfig) -> bool:
@@ -920,21 +1013,50 @@ class ContinuousBatchingEngine:
         if not self._can_admit(plen, cfg):
             raise RuntimeError(
                 "page pool exhausted; drain with decode_segment()")
+        aidx = self._acquire_adapter(cfg)
         slot = heapq.heappop(self._free)
+        self._aidx_stash[slot] = aidx
         try:
             rid = self._next_req
             self._next_req += 1
             last_logits = self._admit_cache(slot, ids, plen, cfg)
             first, tok_done = self._sample_first(rid, last_logits, cfg)
-            self._install_state(slot, plen, first, tok_done, cfg)
+            self._install_state(slot, plen, first, tok_done, cfg,
+                                aidx=aidx)
         except BaseException:
             # a failed admission must not leak capacity: the popped
-            # slot (and, paged, any page reservation _admit_cache made)
-            # goes back to the pool before the error propagates
+            # slot (and, paged, any page reservation _admit_cache made;
+            # LoRA, the adapter reference) goes back to the pool before
+            # the error propagates
             self._abort_admit(slot)
             raise
         self._init_spec(rid, ids, first, cfg)
         return self._register(slot, rid, first, tok_done, cfg, t0)
+
+    def _acquire_adapter(self, cfg) -> int:
+        """Resolve the request's adapter name to its bank index and
+        take a live reference (0 = base model, no reference). Raises
+        ValueError — a REQUEST-scoped verdict at the admission seam —
+        for an unknown/unloading name or an adapter request against an
+        engine built without ``lora_capacity``."""
+        name = getattr(cfg, "adapter", None)
+        if name is None:
+            return 0
+        if self.adapters is None:
+            raise ValueError(
+                f"request names adapter {name!r} but the engine was "
+                f"built without lora_capacity")
+        return self.adapters.acquire(name)
+
+    def _adapter_salt(self, slot: int) -> bytes:
+        """Prefix-cache chain salt for the admission in flight on
+        ``slot`` (b"" = base namespace): cached KV is a function of the
+        weights that produced it, so every adapter hashes its blocks in
+        its own namespace and a cross-adapter warm hit is structurally
+        impossible."""
+        if self.adapters is None:
+            return b""
+        return self.adapters.salt(self._aidx_stash.get(slot, 0))
 
     def _init_spec(self, rid: int, ids, first, cfg) -> None:
         """Create the request's host-side n-gram proposer (speculative
@@ -973,10 +1095,11 @@ class ContinuousBatchingEngine:
         return self.draft_k if k is None else min(int(k), self.draft_k)
 
     def _install_state(self, slot: int, plen: int, first, tok_done,
-                       cfg) -> None:
+                       cfg, aidx: int = 0) -> None:
         """Install the request's per-slot scalars AND sampling parameters
-        in ONE jitted program (shared by the dense and paged engines)
-        instead of separate dispatches."""
+        (the LoRA adapter index included) in ONE jitted program (shared
+        by the dense and paged engines) instead of separate
+        dispatches."""
         eos = -1 if cfg.eos_token_id is None else cfg.eos_token_id
         (self.lens, self.last, self.done_dev, self.active_dev,
          self.samp) = self._admit_state(
@@ -986,13 +1109,16 @@ class ContinuousBatchingEngine:
             jnp.int32(cfg.top_k), jnp.float32(cfg.top_p),
             jnp.asarray(cfg.do_sample), jnp.int32(eos),
             jnp.int32(cfg.seed % (2 ** 31)),
-            jnp.int32(self._spec_k_for(cfg)))
+            jnp.int32(self._spec_k_for(cfg)), jnp.int32(aidx))
 
     def _register(self, slot: int, rid: int, first, tok_done, cfg,
                   t0: float) -> int:
         """Host-side bookkeeping tail of a completed admission (one-shot
         or chunked): record the request, retire degenerate ones, count
         metrics. Runs OUTSIDE the abort guard — no device call left."""
+        # the admission's adapter reference transfers from the slot
+        # stash to the live request; _retire releases it
+        self._rid_aidx[rid] = self._aidx_stash.pop(slot, 0)
         self._slot_req[slot] = rid
         self._tokens[rid] = [int(first)]
         self._budget[rid] = cfg.max_new_tokens - 1
@@ -1033,9 +1159,10 @@ class ContinuousBatchingEngine:
                 ("engine", "bucket")).labels(
                 engine=self._monitor_engine, bucket=str(bucket)).inc()
 
-    def _run_prefill(self, ids, plen: int, mini):
+    def _run_prefill(self, ids, plen: int, mini, aidx: int = 0):
         """Pad the prompt to its bucket and run the one-shot prefill
-        program; returns (last-position logits [1, V], mini)."""
+        program (under the request's adapter, when any); returns
+        (last-position logits [1, V], mini)."""
         width = self._prefill_width(plen)
         self._count_prefill(width if self.prefill_buckets is not None
                             else "exact")
@@ -1045,7 +1172,8 @@ class ContinuousBatchingEngine:
             trace.event("engine.prefill", engine=self._monitor_engine,
                         plen=plen, bucket=width)
         return self._prefill(self.params, _pad_ids(ids, width), mini,
-                             jnp.int32(plen - 1))
+                             jnp.int32(plen - 1), self._bank(),
+                             jnp.int32(aidx))
 
     def _admit_cache(self, slot: int, ids, plen: int, cfg):
         """Cache-layout hook: prefill the prompt and install its KV into
@@ -1053,7 +1181,8 @@ class ContinuousBatchingEngine:
         dense base scatters a max_len mini cache; the paged subclass
         reserves pages and scatters a bucket-sized one."""
         mini = self.model.init_cache(1, self.max_len)
-        last_logits, mini = self._run_prefill(ids, plen, mini)
+        last_logits, mini = self._run_prefill(
+            ids, plen, mini, aidx=self._aidx_stash.get(slot, 0))
         self._install_mini(slot, mini, plen)
         return last_logits
 
@@ -1069,7 +1198,11 @@ class ContinuousBatchingEngine:
 
     def _abort_admit(self, slot: int) -> None:
         """Undo a failed admission's capacity claim (slot back to the
-        free list; the paged override also releases pages)."""
+        free list, adapter reference released; the paged override also
+        releases pages)."""
+        aidx = self._aidx_stash.pop(slot, 0)
+        if aidx and self.adapters is not None:
+            self.adapters.release(aidx)
         heapq.heappush(self._free, slot)
 
     def _retire(self, slot, event: str = "finished"):
@@ -1080,6 +1213,13 @@ class ContinuousBatchingEngine:
         del self._budget[rid]
         self._cfg.pop(rid, None)
         self._spec.pop(rid, None)
+        aidx = self._rid_aidx.pop(rid, 0)
+        if aidx and self.adapters is not None:
+            # last live reference completes a deferred unload; the
+            # device vector keeps the stale index for this dead slot —
+            # harmless (dead rows are masked, and the index is only
+            # rewritten when a future load recycles it)
+            self.adapters.release(aidx)
         self.active_dev = self.active_dev.at[slot].set(False)
         # drop the slot's sampled flag so an all-greedy batch regains
         # the _sample_rows fast path once sampled requests retire
@@ -1158,11 +1298,51 @@ class ContinuousBatchingEngine:
         self._cfg.clear()
         self._spec.clear()
         self._finished.clear()
+        # every live adapter reference was just forgotten with its
+        # slot; the bank and name map SURVIVE (adapters are weights —
+        # a supervised restart must not lose them), deferred unloads
+        # complete now that nothing references them
+        self._aidx_stash.clear()
+        self._rid_aidx.clear()
+        if self.adapters is not None:
+            self.adapters.release_all()
         if monitor.enabled():
             monitor.counter(
                 "paddle_tpu_requests_total",
                 "serving requests by lifecycle event",
                 ("event",)).labels(event="engine_reset").inc()
+
+    # -- multi-tenant LoRA (host-driven, between segments) -------------------
+    def load_adapter(self, name: str, params: dict, alpha=None) -> int:
+        """Hot-load one LoRA adapter into the device bank; returns its
+        bank index. ``params`` maps target projection names to
+        ``(A, B)`` factor pairs (see
+        :meth:`~paddle_tpu.serving.adapters.AdapterRegistry.load`).
+        Only rewrites bank ROWS — the compiled serving programs are
+        untouched, so a load costs zero recompiles (post-``warmup``,
+        zero compiles at all).
+
+        Like ``cancel_request``: call only from the thread driving the
+        engine, BETWEEN decode segments — the serving scheduler's
+        ``Server.load_adapter`` marshals into the inter-segment gap."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "engine built without lora_capacity; pass "
+                "lora_capacity=K at construction")
+        return self.adapters.load(name, params, alpha=alpha)
+
+    def unload_adapter(self, name: str) -> bool:
+        """Hot-unload an adapter. Returns True when its bank index
+        freed immediately; False when live requests still decode under
+        it — the unload DEFERS (new requests naming it are rejected at
+        admission; the index frees, and becomes recyclable, when the
+        last live slot retires). Same thread contract as
+        :meth:`load_adapter`."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "engine built without lora_capacity; pass "
+                "lora_capacity=K at construction")
+        return self.adapters.unload(name)
 
     # -- chunked admission (host-driven, one chunk per inter-segment gap) ----
     def begin_admit(self, prompt_ids, cfg: GenerationConfig):
@@ -1193,7 +1373,12 @@ class ContinuousBatchingEngine:
         if not self._can_admit(plen, cfg):
             raise RuntimeError(
                 "page pool exhausted; drain with decode_segment()")
+        aidx = self._acquire_adapter(cfg)
         slot = heapq.heappop(self._free)
+        # the adapter reference is claimed for the WHOLE chunked
+        # admission (an unload defers while chunks are still running);
+        # _register transfers it to the rid, _abort_admit releases it
+        self._aidx_stash[slot] = aidx
         try:
             mini, start = self._begin_admit_cache(slot, ids, plen, cfg)
         except BaseException:
@@ -1228,6 +1413,7 @@ class ContinuousBatchingEngine:
             raise RuntimeError("admission already completed or aborted")
         C = self.prefill_chunk
         try:
+            aidx = self._aidx_stash.get(adm.slot, 0)
             chunk = adm.ids[:, adm.off:adm.off + C]
             r = chunk.shape[1]
             last = adm.off + r >= adm.plen
@@ -1235,7 +1421,7 @@ class ContinuousBatchingEngine:
                 chunk = _pad_ids(chunk, C)
             adm.last_logits, adm.mini = self._prefill_chunk(
                 self.params, chunk, adm.mini, jnp.int32(adm.off),
-                jnp.int32(r - 1))
+                jnp.int32(r - 1), self._bank(), jnp.int32(aidx))
             adm.off += C
             adm.chunks_done += 1
             if monitor.enabled():
@@ -1251,7 +1437,7 @@ class ContinuousBatchingEngine:
                                                  adm.last_logits,
                                                  adm.cfg)
             self._install_state(adm.slot, adm.plen, first, tok_done,
-                                adm.cfg)
+                                adm.cfg, aidx=aidx)
         except BaseException:
             adm.closed = True
             self._abort_admit(adm.slot)
@@ -1296,7 +1482,8 @@ class ContinuousBatchingEngine:
             ids = np.zeros((1, w), np.int32)
             mini = self._warmup_mini(w)
             _, mini = self._prefill(self.params, ids, mini,
-                                    jnp.int32(w - 1))
+                                    jnp.int32(w - 1), self._bank(),
+                                    jnp.int32(0))
             # also warms the per-bucket cache-install program; slot 0 is
             # free, so the zero-prompt KV it scatters is dead weight the
             # next admission overwrites (paged: dropped — no pages
@@ -1309,7 +1496,8 @@ class ContinuousBatchingEngine:
             self._prefill_chunk(self.params,
                                 np.zeros((1, self.prefill_chunk),
                                          np.int32),
-                                mini, jnp.int32(0), jnp.int32(0))
+                                mini, jnp.int32(0), jnp.int32(0),
+                                self._bank(), jnp.int32(0))
             out["prefill_chunk"] = time.perf_counter() - t0
         # slot-state install program (values match the initial state,
         # except the active flag — reset below)
@@ -1326,7 +1514,8 @@ class ContinuousBatchingEngine:
             (_, self.last, self.lens, self.done_dev, self.caches) = \
                 self._segment_fn(segment_steps)(
                     self.params, self.last, self.lens, self.done_dev,
-                    self.active_dev, self.samp, self.caches, key)
+                    self.active_dev, self.samp, self._bank(),
+                    self.caches, key)
             out[f"segment_{segment_steps}"] = time.perf_counter() - t0
         if self.draft_k:
             # the widened speculative verify step: with every slot
@@ -1337,10 +1526,17 @@ class ContinuousBatchingEngine:
             (_, _, self.last, self.lens, self.caches) = \
                 self._spec_step_fn()(
                     self.params, self.last, self.lens, self.active_dev,
-                    self.samp, self.caches, jax.random.PRNGKey(0),
+                    self.samp, self._bank(), self.caches,
+                    jax.random.PRNGKey(0),
                     jnp.zeros((mb, self.draft_k), jnp.int32),
                     jnp.zeros((mb,), bool), jnp.zeros((mb,), jnp.int32))
             out[f"spec_step_{self.draft_k}"] = time.perf_counter() - t0
+        if self.adapters is not None:
+            # per-target bank-row install programs: the first hot
+            # load() in a serving gap must not pay an XLA compile
+            t0 = time.perf_counter()
+            self.adapters.warmup()
+            out["lora_install"] = time.perf_counter() - t0
         out.update(self._warmup_prefix())
         out["total"] = time.perf_counter() - t_all
         if monitor.enabled():
@@ -1362,19 +1558,24 @@ class ContinuousBatchingEngine:
         return {}
 
     def _segment_fn(self, n_steps: int):
-        # keyed on n_steps ALONE: sampling parameters ride as per-slot
-        # device vectors (_sample_rows), so a server facing arbitrary
-        # per-request GenerationConfigs never recompiles the segment
+        # keyed on n_steps ALONE: sampling parameters AND the LoRA
+        # adapter index ride as per-slot device vectors (_sample_rows /
+        # the bank gather), so a server facing arbitrary per-request
+        # GenerationConfigs — any adapter mix included — never
+        # recompiles the segment
         if n_steps not in self._segment_cache:
             max_len = self.max_len
 
-            def segment(params, last, lens, done, active, samp, caches,
-                        key):
+            def segment(params, last, lens, done, active, samp, bank,
+                        caches, key):
+                lora = (bank, samp["adapter"]) if bank else None
+
                 def step(carry, _):
                     last, lens, done, caches, key = carry
                     live = active & ~done & (lens < max_len)
                     logits, caches = self._fwd_ragged(
-                        params, last[:, None], caches, lens, live)
+                        params, last[:, None], caches, lens, live,
+                        lora)
                     key, sub = jax.random.split(key)
                     nxt = _sample_rows(logits[:, 0], sub, samp)
                     nxt = jnp.where(live, nxt, last)
@@ -1391,18 +1592,22 @@ class ContinuousBatchingEngine:
                         caches)
 
             self._segment_cache[n_steps] = monitor.monitored_jit(
-                segment, name="cb_segment", donate_argnums=(6,))
+                segment, name="cb_segment", donate_argnums=(7,))
         return self._segment_cache[n_steps]
 
     # -- batched speculative decoding (per-slot capability) ------------------
-    def _fwd_spec(self, params, inp, caches, lens, live):
+    def _fwd_spec(self, params, inp, caches, lens, live, lora=None):
         """W-token verify forward at per-row offsets (cache-layout
         hook; the paged subclass routes through the page pool)."""
         from ..core.autograd import no_grad
 
         with substituted_state(self.model, params), no_grad():
-            logits, caches = self.model.forward_decode_spec(
-                Tensor(inp), caches, lens, live)
+            if lora is None:
+                logits, caches = self.model.forward_decode_spec(
+                    Tensor(inp), caches, lens, live)
+            else:
+                logits, caches = self.model.forward_decode_spec(
+                    Tensor(inp), caches, lens, live, lora=lora)
         return (logits.value if isinstance(logits, Tensor) else logits,
                 caches)
 
@@ -1437,13 +1642,14 @@ class ContinuousBatchingEngine:
         if key_ not in self._segment_cache:
             k = self.draft_k
 
-            def spec_step(params, last, lens, active, samp, caches,
-                          key, drafts, live_in, lim):
+            def spec_step(params, last, lens, active, samp, bank,
+                          caches, key, drafts, live_in, lim):
                 b = last.shape[0]
+                lora = (bank, samp["adapter"]) if bank else None
                 live = live_in & active & (lens < self.max_len)
                 inp = jnp.concatenate([last[:, None], drafts], axis=1)
                 logits, caches = self._fwd_spec(params, inp, caches,
-                                                lens, live)
+                                                lens, live, lora)
                 greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 key, sub = jax.random.split(key)
                 g0 = jnp.where(samp["sample"],
@@ -1466,7 +1672,7 @@ class ContinuousBatchingEngine:
                 return toks, n_acc, new_last, lens + n_acc, caches
 
             self._segment_cache[key_] = monitor.monitored_jit(
-                spec_step, name="cb_spec_step", donate_argnums=(5,))
+                spec_step, name="cb_spec_step", donate_argnums=(6,))
         return self._segment_cache[key_]
 
     def _coverage_limit(self, slot: int) -> int:
@@ -1563,8 +1769,9 @@ class ContinuousBatchingEngine:
             key = jax.random.fold_in(base, self._segments_run)
             toks, n_acc, self.last, self.lens, self.caches = fn(
                 self.params, self.last, self.lens, self.active_dev,
-                self.samp, self.caches, key, jnp.asarray(drafts),
-                jnp.asarray(live), jnp.asarray(lim))
+                self.samp, self._bank(), self.caches, key,
+                jnp.asarray(drafts), jnp.asarray(live),
+                jnp.asarray(lim))
             forwards += 1
             # lint: allow-host-sync(the per-verify-step readback IS
             # the speculative path's documented price — host n-gram
@@ -1669,7 +1876,8 @@ class ContinuousBatchingEngine:
         toks, self.last, self.lens, self.done_dev, self.caches = \
             self._segment_fn(n_steps)(
                 self.params, self.last, self.lens, self.done_dev,
-                self.active_dev, self.samp, self.caches, key)
+                self.active_dev, self.samp, self._bank(), self.caches,
+                key)
         # lint: allow-host-sync(collection itself: ONE readback per
         # n_steps-step segment — tokens must reach handles/streams)
         toks = np.asarray(toks)
@@ -1732,6 +1940,9 @@ class ContinuousBatchingEngine:
                 monitor.remove_series(name, engine=self._monitor_engine)
             except Exception:
                 pass
+        reg = getattr(self, "adapters", None)   # __del__-safe: a
+        if reg is not None:                     # half-built engine has
+            reg.close()                         # no registry attr yet
         alloc = getattr(self, "alloc", None)
         if alloc is not None:
             alloc.close()
@@ -1919,7 +2130,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  debug_pages: bool = False,
                  prefix_cache: bool = False,
                  kv_dtype: str = "bf16",
-                 draft_k: int = 0, ngram_max: int = 3):
+                 draft_k: int = 0, ngram_max: int = 3,
+                 lora_capacity: int = 0, lora_rank: int = 8,
+                 lora_targets=("q", "k", "v", "o")):
         from ..quantization.kv import KV_DTYPES
         from .paged_cache import PageAllocator
 
@@ -1969,7 +2182,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                          max_len=max_pages * page_size,
                          prefill_buckets=prefill_buckets,
                          prefill_chunk=prefill_chunk,
-                         draft_k=draft_k, ngram_max=ngram_max)
+                         draft_k=draft_k, ngram_max=ngram_max,
+                         lora_capacity=lora_capacity,
+                         lora_rank=lora_rank,
+                         lora_targets=lora_targets)
         self._measure_quant_savings()
 
         def reset_scales(pools, mask):
@@ -2086,23 +2302,31 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         out["kv_dtype"] = self.kv_dtype
         return out
 
-    def _fwd_ragged(self, params, tok, caches, lens, live):
+    def _fwd_ragged(self, params, tok, caches, lens, live, lora=None):
         from ..core.autograd import no_grad
 
         pools, pt = caches
         with substituted_state(self.model, params), no_grad():
-            logits, pools = self.model.forward_decode_paged(
-                Tensor(tok), pools, pt, lens, live)
+            if lora is None:
+                logits, pools = self.model.forward_decode_paged(
+                    Tensor(tok), pools, pt, lens, live)
+            else:
+                logits, pools = self.model.forward_decode_paged(
+                    Tensor(tok), pools, pt, lens, live, lora=lora)
         return (logits.value if isinstance(logits, Tensor) else logits,
                 (pools, pt))
 
-    def _fwd_spec(self, params, inp, caches, lens, live):
+    def _fwd_spec(self, params, inp, caches, lens, live, lora=None):
         from ..core.autograd import no_grad
 
         pools, pt = caches
         with substituted_state(self.model, params), no_grad():
-            logits, pools = self.model.forward_decode_spec_paged(
-                Tensor(inp), pools, pt, lens, live)
+            if lora is None:
+                logits, pools = self.model.forward_decode_spec_paged(
+                    Tensor(inp), pools, pt, lens, live)
+            else:
+                logits, pools = self.model.forward_decode_spec_paged(
+                    Tensor(inp), pools, pt, lens, live, lora=lora)
         return (logits.value if isinstance(logits, Tensor) else logits,
                 (pools, pt))
 
@@ -2159,20 +2383,25 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _lookup_degraded(self, slot: int, ids, plen: int, cfg):
         """Shared warm-admission preamble (one-shot AND chunked):
-        longest resident cached prefix, degraded to full blocks when
-        the pool cannot spare the partial page's CoW."""
-        pids, c_map, hashes = self.alloc.lookup_prefix(ids[0])
+        longest resident cached prefix — in the admission's ADAPTER
+        namespace (the chain hash is salted with the adapter id, so a
+        base-model block can never warm-hit an adapter's admission or
+        vice versa) — degraded to full blocks when the pool cannot
+        spare the partial page's CoW."""
+        salt = self._adapter_salt(slot)
+        pids, c_map, hashes = self.alloc.lookup_prefix(ids[0],
+                                                       salt=salt)
         pids, c_map = self._degrade_partial_hit(slot, plen, cfg,
                                                 pids, c_map)
-        return pids, c_map, hashes
+        return pids, c_map, hashes, salt
 
     def _admit_cache(self, slot: int, ids, plen: int, cfg):
         if self.prefix_cache:
-            pids, c_map, hashes = self._lookup_degraded(slot, ids,
-                                                        plen, cfg)
+            pids, c_map, hashes, salt = self._lookup_degraded(
+                slot, ids, plen, cfg)
             self._prefix_stash[slot] = {
                 "ids": ids, "c_map": c_map, "hashes": hashes,
-                "saved": min(c_map, plen - 1)}
+                "saved": min(c_map, plen - 1), "salt": salt}
             if c_map > 0:
                 return self._admit_cache_warm(slot, ids, plen, cfg,
                                               pids, c_map)
@@ -2182,7 +2411,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # O(len(buckets))), then scatter the prompt's KV rows into
         # freshly reserved pages
         mini = self.model.init_cache(1, self._prefill_width(plen))
-        last_logits, mini = self._run_prefill(ids, plen, mini)
+        last_logits, mini = self._run_prefill(
+            ids, plen, mini, aidx=self._aidx_stash.get(slot, 0))
         self._reserve_admit(slot, plen, cfg)
         self._install_mini(slot, mini, plen)
         return last_logits
@@ -2240,7 +2470,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         tail_ids = _pad_ids(ids[:, c_cmp:], wt)
         last_logits, mini = self._prefill_chunk(
             self.params, tail_ids, mini, jnp.int32(c_cmp),
-            jnp.int32(tail - 1))
+            jnp.int32(tail - 1), self._bank(),
+            jnp.int32(self._aidx_stash.get(slot, 0)))
         self.alloc.map_shared(slot, pids)
         self._reserve_admit(slot, plen, cfg)
         self._install_mini(slot, mini, plen)
@@ -2351,11 +2582,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         if info is not None:
             # a cold admission POPULATES the cache; a warm one extends
             # it — either way the prompt's fully-written private blocks
-            # become future hits
+            # become future hits (in the admission's adapter namespace)
             ps = self.page_size
             self.alloc.register_blocks(
                 slot, info["hashes"], info["ids"][0],
-                info["c_map"] // ps, plen // ps)
+                info["c_map"] // ps, plen // ps,
+                salt=info.get("salt", b""))
             if info["c_map"] > 0:
                 self.alloc.count_prefix_hit(info["saved"])
 
@@ -2415,8 +2647,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _begin_admit_cache(self, slot: int, ids, plen: int, cfg):
         if not self.prefix_cache:
             return super()._begin_admit_cache(slot, ids, plen, cfg)
-        pids, c_map, hashes = self._lookup_degraded(slot, ids, plen,
-                                                    cfg)
+        pids, c_map, hashes, salt = self._lookup_degraded(slot, ids,
+                                                          plen, cfg)
         C = self.prefill_chunk
         # chunk windows must stay C-aligned (an overhanging window
         # would clamp and corrupt earlier KV), so the cursor starts at
@@ -2424,7 +2656,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # recomputes but its writes are masked out at install
         start = (min(c_map, plen - 1) // C) * C
         self._prefix_stash[slot] = {"ids": ids, "c_map": c_map,
-                                    "hashes": hashes, "saved": start}
+                                    "hashes": hashes, "saved": start,
+                                    "salt": salt}
         self.alloc.map_shared(slot, pids)
         self._reserve_admit(slot, plen, cfg)
         # copy-on-write the partial shared page EAGERLY, while the
@@ -2483,7 +2716,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             t0 = time.perf_counter()
             _, mini = self._prefill_chunk(
                 self.params, np.zeros((1, w), np.int32), mini,
-                jnp.int32(0), jnp.int32(0))
+                jnp.int32(0), jnp.int32(0), self._bank(),
+                jnp.int32(0))
             pools, _ = self.caches
             new_pools = []
             for entry, (mk, mv) in zip(pools, mini):

@@ -50,6 +50,16 @@ __all__ = ["PageAllocator", "PagedKVCache", "write_tokens",
 _ROOT = b"\x00" * 16
 
 
+def _chain_root(salt: bytes) -> bytes:
+    """Chain root for a (possibly salted) prefix namespace. The LoRA
+    serving path salts with the adapter id (``name@generation``) so
+    one adapter's cached blocks can never parent-match — and therefore
+    never alias — another's (or the base model's)."""
+    if not salt:
+        return _ROOT
+    return hashlib.blake2b(salt, digest_size=16).digest()
+
+
 def _block_hash(parent: bytes, tokens: np.ndarray) -> bytes:
     """Chain hash of one page_size-token prompt block: a function of
     the block's tokens AND the whole prefix before it (via ``parent``),
@@ -917,7 +927,9 @@ class PageAllocator:
             self.check()
 
     # -- prefix cache (content-addressable shared pages) ----------------------
-    def lookup_prefix(self, tokens) -> Tuple[List[int], int, List[bytes]]:
+    def lookup_prefix(self, tokens,
+                      salt: bytes = b"") -> Tuple[List[int], int,
+                                                  List[bytes]]:
         """Longest resident cached prefix of ``tokens`` (1-D int ids).
 
         Walks the full-block chain hash (token-verified per block),
@@ -930,14 +942,25 @@ class PageAllocator:
         (``<= len(tokens)``), and the full-block chain hashes (for
         registering the blocks the caller will prefill). Touches the
         LRU order of parked hits; claims no references —
-        :meth:`map_shared` does."""
+        :meth:`map_shared` does.
+
+        ``salt`` namespaces the whole chain: a non-empty salt replaces
+        the chain ROOT, so hashes under different salts can never match
+        each other's blocks. The LoRA serving path salts with the
+        adapter's ``name@generation`` — cached KV is a function of the
+        WEIGHTS that produced it, so a base-model block must never
+        warm-hit an adapter's admission (or vice versa), and a reload
+        of the same adapter name gets a fresh namespace. ``b""`` (the
+        default) keeps the pre-LoRA root: base-model traffic on a
+        LoRA-enabled engine shares KV with pre-LoRA admissions."""
         self.prefix_lookups += 1
         toks = np.ascontiguousarray(
             np.asarray(tokens).reshape(-1), np.int32)
         ps = self.page_size
         nfull = len(toks) // ps
+        root = _chain_root(salt)
         hashes: List[bytes] = []
-        h = _ROOT
+        h = root
         for b in range(nfull):
             h = _block_hash(h, toks[b * ps:(b + 1) * ps])
             hashes.append(h)
@@ -954,7 +977,7 @@ class PageAllocator:
         cov = matched * ps
         rem = toks[cov:]
         if len(rem):
-            parent = hashes[matched - 1] if matched else _ROOT
+            parent = hashes[matched - 1] if matched else root
             best, best_m = None, 0
             for pid in self._next.get(parent, ()):
                 bt = self._tok_of.get(pid)
@@ -1035,12 +1058,17 @@ class PageAllocator:
         return old, new
 
     def register_blocks(self, slot: int, hashes: List[bytes], tokens,
-                        start_block: int, end_block: int) -> None:
+                        start_block: int, end_block: int,
+                        salt: bytes = b"") -> None:
         """Index ``slot``'s fully-written prompt blocks
         ``[start_block, end_block)`` under their chain hashes so future
         admissions can map them read-only. Only PRIVATE pages
         (refcount 1, unindexed) register; an already-taken hash keeps
-        its first page (first writer wins — both hold identical KV)."""
+        its first page (first writer wins — both hold identical KV).
+        ``salt`` must match the ``lookup_prefix`` call that produced
+        ``hashes`` — it only affects block 0's recorded parent (the
+        salted chain root), which is what keeps partial-block child
+        lookups inside one adapter's namespace."""
         if not self.prefix_cache:
             return
         owned = self._owned.get(slot, [])
@@ -1058,7 +1086,7 @@ class PageAllocator:
             self._index[h] = pid
             self._hash_of[pid] = h
             self._tok_of[pid] = toks[b * ps:(b + 1) * ps].copy()
-            parent = hashes[b - 1] if b else _ROOT
+            parent = hashes[b - 1] if b else _chain_root(salt)
             self._parent_of[pid] = parent
             self._next.setdefault(parent, set()).add(pid)
         if self.debug:

@@ -92,6 +92,49 @@ def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype):
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 
+def _lora_add(x, y, lora, name):
+    """Add the per-row LoRA delta for target projection ``name``:
+    ``y + (x @ A[idx]^T) @ B[idx]^T`` with each ROW's factors gathered
+    by its ``adapter_idx`` — the S-LoRA batched-adapter shape, per-slot
+    weights as a device-vector gather inside the one compiled program
+    (the PR 2 invariant extended from sampling params to weights).
+
+    ``lora`` is ``(bank, idx)``: ``bank`` maps target names to THIS
+    layer's stacked factors ``A [K+1, r, d_in]`` / ``B [K+1, d_out, r]``
+    (index 0 = base model, rows pinned to zeros — the gathered delta is
+    exactly 0.0, so base rows stay bitwise what a LoRA-free forward
+    produces); ``idx`` is the per-row ``[B]`` int32 adapter index.
+    Works for any sequence width (prefill S, decode 1, spec-verify W).
+    The LoRA scaling (alpha/r) is folded into B at install time."""
+    if lora is None:
+        return y
+    bank, idx = lora
+    ab = bank.get(name)
+    if ab is None:
+        return y
+    A, B = ab
+
+    def add(xv, yv, Av, Bv, iv):
+        a_sel = jnp.take(Av, iv, axis=0)      # [B, r, d_in]
+        b_sel = jnp.take(Bv, iv, axis=0)      # [B, d_out, r]
+        t = jnp.einsum("bsd,brd->bsr", xv, a_sel)
+        return yv + jnp.einsum("bsr,bor->bso", t,
+                               b_sel).astype(yv.dtype)
+
+    return apply_op(add, x, y, A, B, idx, op_name=f"lora_{name}")
+
+
+def _lora_layer(lora, i):
+    """Layer ``i``'s slice of the engine-level LoRA inputs: the bank
+    holds per-layer factor stacks ``[L, K+1, r, d]``; each decoder
+    layer gathers from its own ``[K+1, r, d]`` slice (``i`` is a trace
+    constant, so the slice costs nothing)."""
+    if lora is None:
+        return None
+    bank, idx = lora
+    return {t: (A[i], B[i]) for t, (A, B) in bank.items()}, idx
+
+
 def apply_rotary_emb(x, cos, sin):
     """x: [B, S, H, D]; rotate-half RoPE (reference analog:
     fused_rope_kernel.cu:87 fused_rotary_position_embedding).
@@ -133,7 +176,20 @@ class LlamaAttention(Layer):
         self.o_proj = RowParallelLinear(self.num_heads * hd, h,
                                         has_bias=False, input_is_parallel=True)
 
-    def forward_with_cache(self, x, cos_full, sin_full, cache, pos):
+    def _qkv_lora(self, x, lora):
+        """Shared q/k/v projection + per-row LoRA delta (every cached/
+        decode path's head; ``lora=None`` is exactly the pre-LoRA
+        projection)."""
+        q = _lora_add(x, self.q_proj(x), lora, "q")
+        k = _lora_add(x, self.k_proj(x), lora, "k")
+        v = _lora_add(x, self.v_proj(x), lora, "v")
+        return q, k, v
+
+    def _o_lora(self, ctx, lora):
+        return _lora_add(ctx, self.o_proj(ctx), lora, "o")
+
+    def forward_with_cache(self, x, cos_full, sin_full, cache, pos,
+                           lora=None):
         """Serving path: attend over a preallocated KV cache.
 
         x: [B, S, h] (S>1 = prefill, S==1 = decode); cache: (k, v) jnp
@@ -141,12 +197,12 @@ class LlamaAttention(Layer):
         the cache. Returns (out, new_cache). The decode step is the
         masked_multihead_attention analog (reference
         fused_multi_transformer_op.cu.h:745); prefill uses the flash path.
+        ``lora`` (here and on every decode variant below) is the
+        per-row batched-adapter input — see :func:`_lora_add`.
         """
         b, s = x.shape[0], x.shape[1]
         hd = self.config.head_dim
-        q = self.q_proj(x)
-        k = self.k_proj(x)
-        v = self.v_proj(x)
+        q, k, v = self._qkv_lora(x, lora)
         k_cache, v_cache = cache
 
         def attend(qv, kv, vv, kc, vc):
@@ -187,10 +243,10 @@ class LlamaAttention(Layer):
         ctx, kc, vc = apply_op(attend, q, k, v, k_cache, v_cache,
                                op_name="cached_attention")
         val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
-        return self.o_proj(ctx), (val(kc), val(vc))
+        return self._o_lora(ctx, lora), (val(kc), val(vc))
 
     def forward_decode_ragged(self, x, cos_full, sin_full, cache, lens,
-                              live):
+                              live, lora=None):
         """Ragged decode step: mixed-length rows, padding-free semantics.
 
         x: [B, 1, h]; lens: [B] int32 tokens already in each ROW's cache
@@ -204,9 +260,7 @@ class LlamaAttention(Layer):
         """
         b = x.shape[0]
         hd = self.config.head_dim
-        q = self.q_proj(x)
-        k = self.k_proj(x)
-        v = self.v_proj(x)
+        q, k, v = self._qkv_lora(x, lora)
         kc0, vc0 = cache
 
         def attend(qv, kv, vv, kc, vc):
@@ -237,10 +291,10 @@ class LlamaAttention(Layer):
         ctx, kc, vc = apply_op(attend, q, k, v, kc0, vc0,
                                op_name="ragged_attention")
         val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
-        return self.o_proj(ctx), (val(kc), val(vc))
+        return self._o_lora(ctx, lora), (val(kc), val(vc))
 
     def forward_decode_spec(self, x, cos_full, sin_full, cache, lens,
-                            live):
+                            live, lora=None):
         """Speculative VERIFY step over the dense ragged cache: W query
         positions per row at per-row offsets (x: [B, W, h]; position i
         of row b sits at absolute position ``lens[b] + i``).
@@ -261,9 +315,7 @@ class LlamaAttention(Layer):
         """
         b, w = x.shape[0], x.shape[1]
         hd = self.config.head_dim
-        q = self.q_proj(x)
-        k = self.k_proj(x)
-        v = self.v_proj(x)
+        q, k, v = self._qkv_lora(x, lora)
         kc0, vc0 = cache
 
         def attend(qv, kv, vv, kc, vc):
@@ -304,10 +356,10 @@ class LlamaAttention(Layer):
         ctx, kc, vc = apply_op(attend, q, k, v, kc0, vc0,
                                op_name="spec_attention")
         val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
-        return self.o_proj(ctx), (val(kc), val(vc))
+        return self._o_lora(ctx, lora), (val(kc), val(vc))
 
     def forward_decode_spec_paged(self, x, cos_full, sin_full, cache,
-                                  page_table, lens, live):
+                                  page_table, lens, live, lora=None):
         """Paged twin of :meth:`forward_decode_spec`: W per-row query
         positions over the shared page pool. Writes to dead rows,
         unmapped pages, or positions past the table width are DROPPED
@@ -316,9 +368,7 @@ class LlamaAttention(Layer):
         accepted tokens instead of corrupting a neighbour's page."""
         b, w = x.shape[0], x.shape[1]
         hd = self.config.head_dim
-        q = self.q_proj(x)
-        k = self.k_proj(x)
-        v = self.v_proj(x)
+        q, k, v = self._qkv_lora(x, lora)
         quant = len(cache) == 4   # (k, v, k_scale, v_scale) int8 pools
 
         def _prep(qv, kv, vv, kp):
@@ -384,14 +434,14 @@ class LlamaAttention(Layer):
             ctx, kp, vp, ks, vs = apply_op(
                 attend_q, q, k, v, *cache,
                 op_name="spec_paged_attention")
-            return self.o_proj(ctx), (val(kp), val(vp), val(ks),
-                                      val(vs))
+            return self._o_lora(ctx, lora), (val(kp), val(vp), val(ks),
+                                             val(vs))
         ctx, kp, vp = apply_op(attend, q, k, v, *cache,
                                op_name="spec_paged_attention")
-        return self.o_proj(ctx), (val(kp), val(vp))
+        return self._o_lora(ctx, lora), (val(kp), val(vp))
 
     def forward_decode_paged(self, x, cos_full, sin_full, cache,
-                             page_table, lens, live):
+                             page_table, lens, live, lora=None):
         """Paged decode step: like forward_decode_ragged but the KV cache
         is this layer's slice of a shared page pool (ops/paged_attention
         + inference/paged_cache — the vLLM-style serving layout the
@@ -400,9 +450,7 @@ class LlamaAttention(Layer):
         sentinel, so the step stays one compiled program."""
         b = x.shape[0]
         hd = self.config.head_dim
-        q = self.q_proj(x)
-        k = self.k_proj(x)
-        v = self.v_proj(x)
+        q, k, v = self._qkv_lora(x, lora)
         quant = len(cache) == 4   # (k, v, k_scale, v_scale) int8 pools
 
         def _prep(qv, kv, vv, kp):
@@ -453,11 +501,11 @@ class LlamaAttention(Layer):
         if quant:
             ctx, kp, vp, ks, vs = apply_op(
                 attend_q, q, k, v, *cache, op_name="paged_attention")
-            return self.o_proj(ctx), (val(kp), val(vp), val(ks),
-                                      val(vs))
+            return self._o_lora(ctx, lora), (val(kp), val(vp), val(ks),
+                                             val(vs))
         ctx, kp, vp = apply_op(attend, q, k, v, *cache,
                                op_name="paged_attention")
-        return self.o_proj(ctx), (val(kp), val(vp))
+        return self._o_lora(ctx, lora), (val(kp), val(vp))
 
     def forward(self, x, cos, sin, attn_mask=None):
         b = x.shape[0]
@@ -502,8 +550,14 @@ class LlamaMLP(Layer):
         self.down_proj = RowParallelLinear(i, h, has_bias=False,
                                            input_is_parallel=True)
 
-    def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+    def forward(self, x, lora=None):
+        if lora is None:
+            return self.down_proj(F.silu(self.gate_proj(x))
+                                  * self.up_proj(x))
+        g = _lora_add(x, self.gate_proj(x), lora, "gate")
+        u = _lora_add(x, self.up_proj(x), lora, "up")
+        h = F.silu(g) * u
+        return _lora_add(h, self.down_proj(h), lora, "down")
 
 
 class LlamaDecoderLayer(Layer):
@@ -521,46 +575,49 @@ class LlamaDecoderLayer(Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return constraint(x, P("dp", None, None))
 
-    def forward_with_cache(self, x, cos_full, sin_full, cache, pos):
+    def forward_with_cache(self, x, cos_full, sin_full, cache, pos,
+                           lora=None):
         attn, cache = self.self_attn.forward_with_cache(
-            self.input_layernorm(x), cos_full, sin_full, cache, pos)
+            self.input_layernorm(x), cos_full, sin_full, cache, pos,
+            lora=lora)
         x = x + attn
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
 
     def forward_decode_ragged(self, x, cos_full, sin_full, cache, lens,
-                              live):
+                              live, lora=None):
         attn, cache = self.self_attn.forward_decode_ragged(
-            self.input_layernorm(x), cos_full, sin_full, cache, lens, live)
+            self.input_layernorm(x), cos_full, sin_full, cache, lens,
+            live, lora=lora)
         x = x + attn
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
 
     def forward_decode_paged(self, x, cos_full, sin_full, cache,
-                             page_table, lens, live):
+                             page_table, lens, live, lora=None):
         attn, cache = self.self_attn.forward_decode_paged(
             self.input_layernorm(x), cos_full, sin_full, cache,
-            page_table, lens, live)
+            page_table, lens, live, lora=lora)
         x = x + attn
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
 
     def forward_decode_spec(self, x, cos_full, sin_full, cache, lens,
-                            live):
+                            live, lora=None):
         attn, cache = self.self_attn.forward_decode_spec(
             self.input_layernorm(x), cos_full, sin_full, cache, lens,
-            live)
+            live, lora=lora)
         x = x + attn
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
 
     def forward_decode_spec_paged(self, x, cos_full, sin_full, cache,
-                                  page_table, lens, live):
+                                  page_table, lens, live, lora=None):
         attn, cache = self.self_attn.forward_decode_spec_paged(
             self.input_layernorm(x), cos_full, sin_full, cache,
-            page_table, lens, live)
+            page_table, lens, live, lora=lora)
         x = x + attn
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
 
 
@@ -603,7 +660,7 @@ class LlamaModel(Layer):
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in range(cfg.num_hidden_layers)]
 
-    def forward_with_cache(self, input_ids, caches, pos):
+    def forward_with_cache(self, input_ids, caches, pos, lora=None):
         cfg = self.config
         x = self.embed_tokens(input_ids)
         max_len = caches[0][0].shape[1]
@@ -611,13 +668,15 @@ class LlamaModel(Layer):
             max_len, cfg.head_dim, cfg.rope_theta,
             x.value.dtype if isinstance(x, Tensor) else x.dtype)
         new_caches = []
-        for layer, cache in zip(self.layers, caches):
-            x, cache = layer.forward_with_cache(x, cos_full, sin_full,
-                                                cache, pos)
+        for i, (layer, cache) in enumerate(zip(self.layers, caches)):
+            x, cache = layer.forward_with_cache(
+                x, cos_full, sin_full, cache, pos,
+                lora=_lora_layer(lora, i))
             new_caches.append(cache)
         return self.norm(x), new_caches
 
-    def forward_decode_ragged(self, input_ids, caches, lens, live):
+    def forward_decode_ragged(self, input_ids, caches, lens, live,
+                              lora=None):
         cfg = self.config
         x = self.embed_tokens(input_ids)
         max_len = caches[0][0].shape[1]
@@ -625,9 +684,10 @@ class LlamaModel(Layer):
             max_len, cfg.head_dim, cfg.rope_theta,
             x.value.dtype if isinstance(x, Tensor) else x.dtype)
         new_caches = []
-        for layer, cache in zip(self.layers, caches):
+        for i, (layer, cache) in enumerate(zip(self.layers, caches)):
             x, cache = layer.forward_decode_ragged(
-                x, cos_full, sin_full, cache, lens, live)
+                x, cos_full, sin_full, cache, lens, live,
+                lora=_lora_layer(lora, i))
             new_caches.append(cache)
         return self.norm(x), new_caches
 
@@ -659,7 +719,7 @@ class LlamaModel(Layer):
                 for _ in range(cfg.num_hidden_layers)]
 
     def forward_decode_paged(self, input_ids, caches, page_table, lens,
-                             live):
+                             live, lora=None):
         cfg = self.config
         x = self.embed_tokens(input_ids)
         max_len = page_table.shape[1] * caches[0][0].shape[1]
@@ -667,13 +727,15 @@ class LlamaModel(Layer):
             max_len, cfg.head_dim, cfg.rope_theta,
             x.value.dtype if isinstance(x, Tensor) else x.dtype)
         new_caches = []
-        for layer, cache in zip(self.layers, caches):
+        for i, (layer, cache) in enumerate(zip(self.layers, caches)):
             x, cache = layer.forward_decode_paged(
-                x, cos_full, sin_full, cache, page_table, lens, live)
+                x, cos_full, sin_full, cache, page_table, lens, live,
+                lora=_lora_layer(lora, i))
             new_caches.append(cache)
         return self.norm(x), new_caches
 
-    def forward_decode_spec(self, input_ids, caches, lens, live):
+    def forward_decode_spec(self, input_ids, caches, lens, live,
+                            lora=None):
         """Speculative verify step (dense ragged cache): input_ids
         [B, W] at per-row offsets ``lens`` — see
         LlamaAttention.forward_decode_spec."""
@@ -684,14 +746,15 @@ class LlamaModel(Layer):
             max_len, cfg.head_dim, cfg.rope_theta,
             x.value.dtype if isinstance(x, Tensor) else x.dtype)
         new_caches = []
-        for layer, cache in zip(self.layers, caches):
+        for i, (layer, cache) in enumerate(zip(self.layers, caches)):
             x, cache = layer.forward_decode_spec(
-                x, cos_full, sin_full, cache, lens, live)
+                x, cos_full, sin_full, cache, lens, live,
+                lora=_lora_layer(lora, i))
             new_caches.append(cache)
         return self.norm(x), new_caches
 
     def forward_decode_spec_paged(self, input_ids, caches, page_table,
-                                  lens, live):
+                                  lens, live, lora=None):
         """Speculative verify step over the page pool — see
         LlamaAttention.forward_decode_spec_paged."""
         cfg = self.config
@@ -701,9 +764,10 @@ class LlamaModel(Layer):
             max_len, cfg.head_dim, cfg.rope_theta,
             x.value.dtype if isinstance(x, Tensor) else x.dtype)
         new_caches = []
-        for layer, cache in zip(self.layers, caches):
+        for i, (layer, cache) in enumerate(zip(self.layers, caches)):
             x, cache = layer.forward_decode_spec_paged(
-                x, cos_full, sin_full, cache, page_table, lens, live)
+                x, cos_full, sin_full, cache, page_table, lens, live,
+                lora=_lora_layer(lora, i))
             new_caches.append(cache)
         return self.norm(x), new_caches
 
@@ -750,16 +814,47 @@ class LlamaForCausalLM(Layer):
     def init_cache(self, batch_size: int, max_len: int):
         return self.model.init_cache(batch_size, max_len)
 
-    def forward_with_cache(self, input_ids, caches, pos):
-        """(logits_of_last_positions, new_caches) — the serving forward."""
-        hidden, caches = self.model.forward_with_cache(input_ids, caches, pos)
+    def lora_shapes(self, targets):
+        """LoRA bank geometry hook for the serving engines: returns
+        ``(num_layers, {target: (d_in, d_out)})`` for the requested
+        target projections (subset of q/k/v/o, gate/up/down). The
+        engine stacks every resident adapter's factors into
+        ``[L, K+1, r, d_in]`` / ``[L, K+1, d_out, r]`` device arrays
+        per target and gathers each slot's delta inside the compiled
+        decode programs (see :func:`_lora_add`)."""
+        cfg = self.config
+        hd = cfg.head_dim
+        dims = {
+            "q": (cfg.hidden_size, cfg.num_attention_heads * hd),
+            "k": (cfg.hidden_size, cfg.kv_heads * hd),
+            "v": (cfg.hidden_size, cfg.kv_heads * hd),
+            "o": (cfg.num_attention_heads * hd, cfg.hidden_size),
+            "gate": (cfg.hidden_size, cfg.intermediate_size),
+            "up": (cfg.hidden_size, cfg.intermediate_size),
+            "down": (cfg.intermediate_size, cfg.hidden_size),
+        }
+        unknown = [t for t in targets if t not in dims]
+        if unknown:
+            raise ValueError(
+                f"unknown lora target(s) {unknown}; supported: "
+                f"{sorted(dims)}")
+        return cfg.num_hidden_layers, {t: dims[t] for t in targets}
+
+    def forward_with_cache(self, input_ids, caches, pos, lora=None):
+        """(logits_of_last_positions, new_caches) — the serving forward.
+        ``lora`` (every serving forward below too) is the optional
+        batched-adapter input ``(bank, adapter_idx)`` —
+        see :func:`_lora_add`."""
+        hidden, caches = self.model.forward_with_cache(
+            input_ids, caches, pos, lora=lora)
         return self.logits(hidden), caches
 
-    def forward_decode_ragged(self, input_ids, caches, lens, live):
+    def forward_decode_ragged(self, input_ids, caches, lens, live,
+                              lora=None):
         """(logits [B, 1, V], new_caches) — the mixed-length decode step
         (per-row positions; see LlamaAttention.forward_decode_ragged)."""
         hidden, caches = self.model.forward_decode_ragged(
-            input_ids, caches, lens, live)
+            input_ids, caches, lens, live, lora=lora)
         return self.logits(hidden), caches
 
     def init_paged_cache(self, num_pages: int, page_size: int,
@@ -768,24 +863,25 @@ class LlamaForCausalLM(Layer):
                                            kv_dtype=kv_dtype)
 
     def forward_decode_paged(self, input_ids, caches, page_table, lens,
-                             live):
+                             live, lora=None):
         """(logits [B, 1, V], new_caches) — paged decode step (page-pool
         KV; see LlamaAttention.forward_decode_paged)."""
         hidden, caches = self.model.forward_decode_paged(
-            input_ids, caches, page_table, lens, live)
+            input_ids, caches, page_table, lens, live, lora=lora)
         return self.logits(hidden), caches
 
-    def forward_decode_spec(self, input_ids, caches, lens, live):
+    def forward_decode_spec(self, input_ids, caches, lens, live,
+                            lora=None):
         """(logits [B, W, V], new_caches) — batched speculative verify
         step at per-row offsets (dense ragged cache)."""
         hidden, caches = self.model.forward_decode_spec(
-            input_ids, caches, lens, live)
+            input_ids, caches, lens, live, lora=lora)
         return self.logits(hidden), caches
 
     def forward_decode_spec_paged(self, input_ids, caches, page_table,
-                                  lens, live):
+                                  lens, live, lora=None):
         """(logits [B, W, V], new_caches) — batched speculative verify
         step over the page pool."""
         hidden, caches = self.model.forward_decode_spec_paged(
-            input_ids, caches, page_table, lens, live)
+            input_ids, caches, page_table, lens, live, lora=lora)
         return self.logits(hidden), caches

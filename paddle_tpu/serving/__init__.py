@@ -66,6 +66,17 @@ exponential backoff, and ``drain(i)`` / ``rolling_restart()`` for
 zero-downtime rollouts. ``serve_http(router)`` serves the same routes
 with fleet-aggregated ``/healthz``.
 
+Multi-tenant LoRA (README "Multi-tenant LoRA serving"): engines built
+with ``lora_capacity=K`` serve up to K resident fine-tunes from ONE
+compiled program set — stacked factor banks gathered per slot by an
+``adapter_idx`` device vector (:class:`AdapterRegistry` owns the bank
++ hot load/unload with deferral), ``GenerationConfig(adapter=...)`` /
+the HTTP ``adapter`` field select per request, prefix-cache namespaces
+are adapter-salted (cross-adapter warm hits structurally zero),
+``Server(tenant_quotas=...)`` caps per-tenant admissions without
+starving other tenants, and the Router prefers adapter-resident
+replicas.
+
 Tracing & flight recorder (README "Tracing & flight recorder"): with
 ``FLAGS_enable_trace`` on, every lifecycle seam records a structured
 event into ``paddle_tpu.tracing``'s bounded ring — read one request's
@@ -94,6 +105,7 @@ Quick start::
 """
 from ..inference.generation import (EngineFault, PagePoolExhausted,
                                     RequestFault, classify_fault)
+from .adapters import AdapterRegistry
 from .http import serve_http
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
                     RUNNING, DeadlineExpired, QueueFull,
@@ -105,6 +117,7 @@ from .scheduler import PreemptionBudgetExceeded, Server
 
 __all__ = [
     "Server", "serve_http", "RequestHandle", "RequestQueue",
+    "AdapterRegistry",
     "RequestRejected", "QueueFull", "RequestCancelled",
     "DeadlineExpired", "RequestFailed",
     "RequestFault", "EngineFault", "classify_fault",

@@ -14,8 +14,13 @@ Routes:
        "top_p": 1.0, "do_sample": false, "eos_token_id": null,
        "seed": 0,                     # GenerationConfig fields
        "speculative": false, "draft_k": null,  # spec-decode opt-in
+       "adapter": null,               # LoRA fine-tune (null = base)
+       "tenant": null,                # quota bucket (default: adapter)
        "priority": 0, "timeout_s": null,   # admission deadline
        "stream": false}
+
+  Bodies are STRICT: an unknown field is a 400 naming it — a typo'd
+  ``adaptor`` must not silently serve base-model output.
 
   Non-streaming: one JSON response
   ``{"request_id", "tokens", "n_tokens", "ttft_s"}``.
@@ -57,6 +62,14 @@ Routes:
   ``cached_pages``, ``shared_pages``, ``prefix_hits``,
   ``prefix_lookups``, and ``prefix_tokens_saved``.
 
+- ``POST /adapters/load`` / ``POST /adapters/unload`` — multi-tenant
+  LoRA admin (engines built with ``lora_capacity``): hot load (inline
+  ``weights`` or an npz ``path``) / unload, applied by the scheduler
+  thread in the inter-segment gap; an unload while live requests
+  decode under the adapter DEFERS (``"deferred": true``). The
+  registry snapshot (resident/draining names, capacity) rides
+  ``/healthz`` under ``lora``.
+
 - ``GET /metrics`` / ``GET /metrics.json`` — the monitor package's
   Prometheus / JSON exporters, same payloads as
   ``monitor.start_http_server`` (one scrape endpoint per serving
@@ -88,7 +101,15 @@ __all__ = ["serve_http"]
 
 _CFG_FIELDS = ("max_new_tokens", "temperature", "top_k", "top_p",
                "do_sample", "eos_token_id", "seed", "speculative",
-               "draft_k")
+               "draft_k", "adapter")
+
+# every field a /generate body may carry. Unknown fields are a 400
+# NAMING the field, not silently ignored: a typo'd "adaptor" quietly
+# serving BASE-model output to a fine-tune's customer is the silent
+# failure multi-tenant serving cannot afford
+_KNOWN_FIELDS = frozenset(_CFG_FIELDS) | {"prompt", "priority",
+                                          "timeout_s", "stream",
+                                          "tenant"}
 
 # a /generate body is token ids + a dozen scalars; 8 MB is orders of
 # magnitude above any real request, and an unbounded Content-Length
@@ -98,6 +119,11 @@ MAX_BODY_BYTES = 8 << 20
 
 
 def _parse_request(body: dict):
+    unknown = sorted(k for k in body if k not in _KNOWN_FIELDS)
+    if unknown:
+        raise ValueError(
+            f"unknown request field {unknown[0]!r} (allowed: "
+            f"{', '.join(sorted(_KNOWN_FIELDS))})")
     prompt = body.get("prompt")
     if (not isinstance(prompt, list) or not prompt
             or not all(isinstance(t, int) and not isinstance(t, bool)
@@ -122,7 +148,65 @@ def _parse_request(body: dict):
         raise ValueError(
             f"'timeout_s' must be a positive number or null, got "
             f"{timeout_s!r}")
-    return prompt, cfg, priority, timeout_s, bool(body.get("stream"))
+    tenant = body.get("tenant")
+    if tenant is not None and (not isinstance(tenant, str)
+                               or not tenant):
+        raise ValueError(
+            f"'tenant' must be a non-empty string or null, got "
+            f"{tenant!r}")
+    return (prompt, cfg, priority, timeout_s,
+            bool(body.get("stream")), tenant)
+
+
+def _adapter_weights(body: dict) -> dict:
+    """Normalize a /adapters/load body to the registry's params format
+    ``{target: (A, B)}``: inline ``weights`` (nested lists) or an
+    ``npz`` file ``path`` with ``<target>.a`` / ``<target>.b`` keys."""
+    import numpy as np
+
+    weights = body.get("weights")
+    path = body.get("path")
+    if (weights is None) == (path is None):
+        raise ValueError(
+            "exactly one of 'weights' (inline) or 'path' (npz file) "
+            "is required")
+    if path is not None:
+        if not isinstance(path, str):
+            raise ValueError(f"'path' must be a string, got {path!r}")
+        data = np.load(path)
+        out = {}
+        for key in data.files:
+            t, _, kind = key.rpartition(".")
+            if kind not in ("a", "A", "b", "B") or not t:
+                raise ValueError(
+                    f"npz key {key!r} is not '<target>.a'/'<target>.b'")
+            out.setdefault(t, [None, None])[0 if kind in ("a", "A")
+                                            else 1] = data[key]
+        bad = [t for t, ab in out.items() if ab[0] is None
+               or ab[1] is None]
+        if bad:
+            raise ValueError(
+                f"npz missing the a or b half for target(s) {bad}")
+        return {t: (a, b) for t, (a, b) in out.items()}
+    if not isinstance(weights, dict) or not weights:
+        raise ValueError(
+            "'weights' must be a non-empty object "
+            "{target: {'a': [[...]], 'b': [[...]]}}")
+    out = {}
+    for t, ab in weights.items():
+        if (not isinstance(ab, dict) or "a" not in ab
+                or "b" not in ab):
+            raise ValueError(
+                f"weights[{t!r}] must be an object with 'a' and 'b' "
+                "factor arrays")
+        extra = sorted(k for k in ab if k not in ("a", "b"))
+        if extra:
+            raise ValueError(
+                f"weights[{t!r}] has unknown key {extra[0]!r} "
+                "(allowed: a, b)")
+        out[t] = (np.asarray(ab["a"], np.float32),
+                  np.asarray(ab["b"], np.float32))
+    return out
 
 
 def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
@@ -207,7 +291,33 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                 "request_id": rid_i,
                 "events": server.request_timeline(rid_i)})
 
+        def _read_body(self):
+            """Bounded JSON body read shared by the POST routes;
+            returns the dict or None after replying with the error."""
+            n = int(self.headers.get("Content-Length", 0))
+            if n < 0:
+                # rfile.read(-1) would block until the client closes
+                # the socket, pinning a handler thread
+                self.close_connection = True
+                self._json(400, {"error": "negative Content-Length"},
+                           headers={"Connection": "close"})
+                return None
+            if n > MAX_BODY_BYTES:
+                self.close_connection = True
+                self._json(413, {"error":
+                                 f"body exceeds {MAX_BODY_BYTES} "
+                                 "bytes"},
+                           headers={"Connection": "close"})
+                return None
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            return body
+
         def do_POST(self):
+            if self.path.startswith("/adapters/"):
+                self._adapters_response()
+                return
             if not self.path.startswith("/generate"):
                 # body NOT consumed: drop the connection after replying
                 # or keep-alive would parse the body as the next request
@@ -216,25 +326,10 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                            headers={"Connection": "close"})
                 return
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                if n < 0:
-                    # rfile.read(-1) would block until the client closes
-                    # the socket, pinning a handler thread
-                    self.close_connection = True
-                    self._json(400, {"error": "negative Content-Length"},
-                               headers={"Connection": "close"})
+                body = self._read_body()
+                if body is None:
                     return
-                if n > MAX_BODY_BYTES:
-                    self.close_connection = True
-                    self._json(413, {"error":
-                                     f"body exceeds {MAX_BODY_BYTES} "
-                                     "bytes"},
-                               headers={"Connection": "close"})
-                    return
-                body = json.loads(self.rfile.read(n) or b"{}")
-                if not isinstance(body, dict):
-                    raise ValueError("body must be a JSON object")
-                prompt, cfg, priority, timeout_s, stream = \
+                prompt, cfg, priority, timeout_s, stream, tenant = \
                     _parse_request(body)
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._json(400, {"error": str(e)})
@@ -242,7 +337,9 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
             try:
                 handle = server.submit(
                     np.asarray(prompt, np.int32), cfg,
-                    priority=priority, timeout_s=timeout_s)
+                    priority=priority, timeout_s=timeout_s,
+                    **({"tenant": tenant} if tenant is not None
+                       else {}))
             except RequestRejected as e:
                 if e.reason == "queue_full":
                     self._json(429, {"error": str(e),
@@ -259,6 +356,78 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                 self._stream_response(handle)
             else:
                 self._block_response(handle)
+
+        def _adapters_response(self) -> None:
+            """Admin surface for multi-tenant LoRA: ``POST
+            /adapters/load`` ``{"name": ..., "weights": {target:
+            {"a": [[...]], "b": [[...]]}}[, "alpha": N]}`` (or
+            ``{"name": ..., "path": "adapter.npz"}`` with
+            ``<target>.a`` / ``<target>.b`` arrays) and ``POST
+            /adapters/unload`` ``{"name": ...}``. Applied by the
+            scheduler thread in the inter-segment gap; 400 for
+            validation errors (unknown target, rank over the bank,
+            duplicate name, registry full), 503 while the server
+            cannot apply them. Registry state lives in ``/healthz``
+            under ``lora``."""
+            op = self.path[len("/adapters/"):].split("?", 1)[0]
+            if op not in ("load", "unload"):
+                self.close_connection = True
+                self._json(404, {"error": f"no route {self.path}"},
+                           headers={"Connection": "close"})
+                return
+            if (getattr(server, "load_adapter", None) is None
+                    or getattr(getattr(server, "engine", None),
+                               "adapters", None) is None):
+                # permanently unsupported here (a Router front, or an
+                # engine built without lora_capacity) — a 400, not a
+                # retryable 503
+                self.close_connection = True
+                self._json(400, {"error": "this endpoint fronts no "
+                                          "adapter-capable Server "
+                                          "(engine needs "
+                                          "lora_capacity > 0)"},
+                           headers={"Connection": "close"})
+                return
+            try:
+                body = self._read_body()
+                if body is None:
+                    return
+                # admin bodies are STRICT like /generate: a typo'd
+                # "aplha" silently installing scale-1.0 deltas is the
+                # same silent-failure class as the typo'd "adaptor"
+                allowed = ({"name"} if op == "unload"
+                           else {"name", "weights", "path", "alpha"})
+                unknown = sorted(k for k in body if k not in allowed)
+                if unknown:
+                    raise ValueError(
+                        f"unknown field {unknown[0]!r} (allowed: "
+                        f"{', '.join(sorted(allowed))})")
+                name = body.get("name")
+                if not isinstance(name, str) or not name:
+                    raise ValueError(
+                        "'name' must be a non-empty string")
+                if op == "unload":
+                    freed = server.unload_adapter(name)
+                    out = {"name": name, "unloaded": bool(freed),
+                           "deferred": not freed}
+                else:
+                    params = _adapter_weights(body)
+                    idx = server.load_adapter(name, params,
+                                              alpha=body.get("alpha"))
+                    out = {"name": name, "index": idx}
+            except (ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            except (TimeoutError, RequestRejected, RuntimeError) as e:
+                # transient: the scheduler could not apply it right
+                # now (wedged / shutting down)
+                self._json(503, {"error": str(e)})
+                return
+            reg = getattr(server.engine, "adapters", None)
+            if reg is not None:
+                out["adapters"] = reg.resident()
+            self._json(200, out)
 
         def _block_response(self, handle) -> None:
             try:

@@ -97,12 +97,18 @@ class RequestHandle:
     def __init__(self, req_id: int, prompt, prompt_len: int, cfg,
                  priority: int = 0, deadline: Optional[float] = None,
                  on_cancel: Optional[Callable[["RequestHandle"], None]]
-                 = None):
+                 = None, tenant: Optional[str] = None):
         self.id = req_id
         self.prompt = prompt
         self.prompt_len = prompt_len
         self.cfg = cfg
         self.priority = priority
+        # tenant identity for per-tenant admission quotas (None =
+        # untracked): the scheduler defaults it to the request's LoRA
+        # adapter name — in multi-tenant LoRA serving the fine-tune IS
+        # the tenant — but an explicit tenant can group requests across
+        # adapters (or quota base-model traffic)
+        self.tenant = tenant
         self.deadline = deadline          # absolute time.monotonic()
         self.engine_rid: Optional[int] = None
         self.submit_ts = time.monotonic()
@@ -363,6 +369,31 @@ class RequestQueue:
         with self._lock:
             if self._heap and pred(self._heap[0][2]):
                 return heapq.heappop(self._heap)[2]
+            return None
+
+    def pop_admittable(self, fits: Callable[[RequestHandle], bool],
+                       allowed: Callable[[RequestHandle], bool]
+                       ) -> Optional[RequestHandle]:
+        """Quota-aware admission pop: walk the queue in priority/FIFO
+        order and pop the first entry that both ``fits`` (engine
+        capacity) and is ``allowed`` (per-tenant quota). The scan STOPS
+        at the first entry that does not fit — capacity keeps the
+        no-head-of-line-bypass contract of :meth:`pop_if` — but entries
+        deferred only by ``allowed`` are SKIPPED, so one tenant sitting
+        over its quota defers its own work without starving every
+        tenant queued behind it. O(n log n) over the waiting queue —
+        bounded by ``max_size``, and only runs when quotas are
+        configured."""
+        with self._lock:
+            for entry in sorted(self._heap):
+                h = entry[2]
+                if not fits(h):
+                    return None
+                if not allowed(h):
+                    continue
+                self._heap.remove(entry)
+                heapq.heapify(self._heap)
+                return h
             return None
 
     def drain_all(self) -> List[RequestHandle]:

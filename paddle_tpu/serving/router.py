@@ -365,7 +365,8 @@ class Router:
     # -- client surface ------------------------------------------------------
     def submit(self, prompt, cfg: Optional[GenerationConfig] = None,
                priority: int = 0,
-               timeout_s: Optional[float] = None) -> RouterHandle:
+               timeout_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> RouterHandle:
         """Route one request into the fleet; returns its
         :class:`RouterHandle`. Raises
         :class:`~paddle_tpu.serving.queue.RequestRejected` (reason
@@ -401,7 +402,7 @@ class Router:
             deadline = (None if timeout_s is None
                         else time.monotonic() + timeout_s)
             h = RouterHandle(self._next_id, prompt, plen, cfg,
-                             priority, deadline)
+                             priority, deadline, tenant=tenant)
             h._trace_rid = f"{self.monitor_router}:{h.id}"
             self._next_id += 1
             self._handles.add(h)
@@ -803,14 +804,21 @@ class Router:
                             router=self.monitor_router)
 
     # -- routing -------------------------------------------------------------
-    def _acquire(self, exclude, hard=frozenset()):
+    def _acquire(self, exclude, hard=frozenset(), adapter=None):
         """Pick the least-loaded routable replica: status ``ok``
         (warming/degraded/failed/draining/restarting/dead excluded),
         breaker not OPEN (an elapsed OPEN transitions to HALF-OPEN
         here and admits this caller as its ONE probe). ``exclude``
         skips the replica a failure just came from — unless it is the
         only candidate; ``hard`` (replicas this request can NEVER fit
-        — heterogeneous fleets) is skipped unconditionally. Returns
+        — heterogeneous fleets) is skipped unconditionally.
+        ``adapter`` biases the pick with ADAPTER AFFINITY: replicas
+        with the named LoRA adapter RESIDENT score ahead of those
+        without (an atomic registry-membership read — no HTTP, no
+        device sync), falling back to plain least-loaded when nobody
+        has it; the load tie-break still applies within each class,
+        so affinity never pins a tenant to one overloaded replica
+        while an idle adapter-resident peer exists. Returns
         ``(rep, server, probe)`` or ``(None, None, False)``."""
         now = time.monotonic()
         flipped = []
@@ -849,10 +857,18 @@ class Router:
                     if srv2.status != "ok":
                         continue
                     alloc = getattr(srv2.engine, "alloc", None)
-                    # least-loaded: what's queued + what's decoding
-                    # now; free pages break ties toward the roomier
-                    # KV pool
-                    score = (srv2.queue.depth + srv2.num_active(),
+                    # adapter affinity first (0 = resident, 1 = not:
+                    # an admission on a resident replica reuses its
+                    # bank row AND its adapter-salted prefix cache),
+                    # then least-loaded: what's queued + what's
+                    # decoding now; free pages break ties toward the
+                    # roomier KV pool
+                    reg = getattr(srv2.engine, "adapters", None)
+                    afar = int(not (adapter is not None
+                                    and reg is not None
+                                    and adapter in reg))
+                    score = (afar if adapter is not None else 0,
+                             srv2.queue.depth + srv2.num_active(),
                              -(alloc.free_pages if alloc is not None
                                else 0))
                 except Exception:
@@ -929,8 +945,9 @@ class Router:
                 h._finish(FINISHED)
                 self._count("completed", h.replica)
                 return
-            rep, srv, probe = self._acquire(exclude,
-                                            hard=frozenset(nofit))
+            rep, srv, probe = self._acquire(
+                exclude, hard=frozenset(nofit),
+                adapter=getattr(h.cfg, "adapter", None))
             if rep is None:
                 if self._all_dead():
                     h._finish(FAILED, FleetUnavailable(
@@ -966,7 +983,8 @@ class Router:
             try:
                 inner = srv.submit(ids, rcfg, priority=h.priority,
                                    timeout_s=t_s,
-                                   trace_rid=h._trace_rid)
+                                   trace_rid=h._trace_rid,
+                                   tenant=h.tenant)
             except RequestRejected as e:
                 # replica-attributed only when the REPLICA is the
                 # problem; queue_full is load, not sickness — routing

@@ -6,7 +6,8 @@ AnalysisPredictor; here a dedicated scheduler THREAD owns a
 drives the stepwise API (``add_request`` / ``decode_segment`` /
 ``collect_finished``) in an Orca-style iteration loop:
 
-    gap:   apply cancellations → advance an in-flight CHUNKED admission
+    gap:   apply adapter admin (hot LoRA load/unload) → apply
+           cancellations → advance an in-flight CHUNKED admission
            by ONE fixed-shape prefill chunk → reap expired → re-admit
            REPLAYS surviving an engine restart → admit from the queue
            (capacity probed via the engine's public ``can_admit`` /
@@ -231,7 +232,8 @@ class Server:
                  age_after_s: Optional[float] = None,
                  draft_k: Optional[int] = None,
                  speculative: bool = False,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 tenant_quotas=None):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
                 f"stall_timeout_s must be > 0 or None, got "
@@ -316,6 +318,29 @@ class Server:
         # speculatively — the per-request GenerationConfig.speculative
         # flag still opts individual requests in on a False server
         self.speculative = bool(speculative)
+        # per-tenant admission quotas (None = off): an int caps every
+        # tenant's concurrently ADMITTED requests uniformly; a dict
+        # caps the named tenants (others unlimited). A tenant over its
+        # quota DEFERS in the queue — tenants behind it still admit
+        # (RequestQueue.pop_admittable skips quota-deferred entries,
+        # never capacity-blocked ones) — so one noisy fine-tune cannot
+        # monopolize the engine's slots or starve its neighbours.
+        if tenant_quotas is not None:
+            if isinstance(tenant_quotas, bool) or not (
+                    isinstance(tenant_quotas, int)
+                    or isinstance(tenant_quotas, dict)):
+                raise ValueError(
+                    f"tenant_quotas must be None, a positive int, or a "
+                    f"dict {{tenant: cap}}, got {tenant_quotas!r}")
+            caps = (tenant_quotas.values()
+                    if isinstance(tenant_quotas, dict)
+                    else (tenant_quotas,))
+            if any(isinstance(c, bool) or not isinstance(c, int)
+                   or c < 1 for c in caps):
+                raise ValueError(
+                    f"tenant quota caps must be ints >= 1, got "
+                    f"{tenant_quotas!r}")
+        self.tenant_quotas = tenant_quotas
         self.engine = engine
         self.segment_steps = segment_steps
         self.idle_wait_s = idle_wait_s
@@ -360,6 +385,11 @@ class Server:
         #                                   the storm trigger (scheduler
         #                                   thread only)
         self._last_storm_dump = -1e18
+        self._admin_ops = []              # guarded-by: self._lock
+        #                                   pending adapter load/unload
+        #                                   requests, applied by the
+        #                                   scheduler thread in the
+        #                                   inter-segment gap
         self._fault_counts = {}           # guarded-by: self._lock
         #                                   (kind, site) -> n, host-side
         #                                   (monitor-independent; see
@@ -397,7 +427,8 @@ class Server:
     def submit(self, prompt, cfg: Optional[GenerationConfig] = None,
                priority: int = 0,
                timeout_s: Optional[float] = None,
-               trace_rid: Optional[str] = None) -> RequestHandle:
+               trace_rid: Optional[str] = None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Enqueue one request; returns its :class:`RequestHandle`.
 
         ``cfg`` is the request's OWN GenerationConfig (validated at
@@ -409,7 +440,11 @@ class Server:
         events are recorded under (default
         ``<server_label>:<handle id>``) — the replica router passes its
         OWN stable key here so one request's timeline stays whole
-        across a failover to a different replica.
+        across a failover to a different replica. ``tenant`` names the
+        request's quota bucket (``Server(tenant_quotas=...)``); it
+        defaults to the request's LoRA ``cfg.adapter`` — the fine-tune
+        IS the tenant in multi-tenant serving — and ``None`` (no
+        adapter either) leaves the request un-quotaed.
 
         Raises :class:`RequestRejected` (reason ``queue_full`` /
         ``draining`` / ``degraded`` / ``shutdown``) for backpressure,
@@ -459,7 +494,10 @@ class Server:
                     "not accepting new requests")
             handle = RequestHandle(self._next_id, prompt, plen, cfg,
                                    priority, deadline,
-                                   on_cancel=self._on_cancel)
+                                   on_cancel=self._on_cancel,
+                                   tenant=(tenant if tenant is not None
+                                           else getattr(cfg, "adapter",
+                                                        None)))
             # the trace key pairs the server label with the request id:
             # concurrent servers in one process restart their ids at 0,
             # and the process-wide ring must not merge their timelines
@@ -480,9 +518,12 @@ class Server:
                 raise
         self._count("queued")
         if trace.enabled():
+            attrs = {}
+            if getattr(cfg, "adapter", None) is not None:
+                attrs["adapter"] = cfg.adapter
             trace.event("queue.enqueue", rid=handle._trace_rid,
                         plen=plen, priority=priority,
-                        depth=self.queue.depth)
+                        depth=self.queue.depth, **attrs)
         self._depth_gauge()
         self._wake.set()
         return handle
@@ -605,6 +646,86 @@ class Server:
         """Flight-recorder dump paths written so far (newest last)."""
         with self._lock:
             return list(self._flight_dumps)
+
+    # -- multi-tenant LoRA admin (thread-safe; applied in the gap) -----------
+    def load_adapter(self, name: str, params: dict, alpha=None,
+                     timeout: Optional[float] = 30.0) -> int:
+        """Hot-load a LoRA adapter into the engine's device bank;
+        returns its bank index. Thread-safe: the request is queued and
+        APPLIED BY THE SCHEDULER THREAD in the next inter-segment gap
+        (the engine is never touched from the caller's thread), then
+        the result — or the engine's ValidationError — propagates back
+        here. Running requests are untouched; post-``warmup`` a load
+        pays zero compiles. See ``engine.load_adapter`` for the
+        ``params`` format."""
+        return self._admin_op("load", (name, params, alpha), timeout)
+
+    def unload_adapter(self, name: str,
+                       timeout: Optional[float] = 30.0) -> bool:
+        """Hot-unload an adapter. Returns True when its index freed
+        immediately, False when live requests still decode under it —
+        the unload DEFERS (new submissions naming it fail at admission;
+        the index frees when the last one retires). Same marshalling
+        as :meth:`load_adapter`."""
+        return self._admin_op("unload", (name,), timeout)
+
+    def _admin_op(self, op: str, args, timeout):
+        if getattr(self.engine, "adapters", None) is None:
+            raise RuntimeError(
+                "engine built without lora_capacity; pass "
+                "lora_capacity=K at engine construction")
+        evt = threading.Event()
+        box: dict = {}
+        entry = (op, args, evt, box)
+        with self._lock:
+            if self._stopping or self._stopped.is_set():
+                raise RequestRejected(
+                    "shutdown", "server is shut down; adapter admin "
+                    "ops no longer apply")
+            self._admin_ops.append(entry)
+        self._wake.set()
+        if not evt.wait(timeout):
+            # a timed-out op must not apply LATER with nobody waiting
+            # (the caller was told it failed — a silent late apply
+            # would make its retry fail "already loaded"): withdraw it
+            # if the scheduler has not picked it up yet
+            with self._lock:
+                try:
+                    self._admin_ops.remove(entry)
+                    withdrawn = True
+                except ValueError:
+                    withdrawn = False   # mid-apply: result imminent
+            if withdrawn:
+                raise TimeoutError(
+                    f"adapter {op} not applied within {timeout}s "
+                    "(withdrawn; is the scheduler wedged?)")
+            # the scheduler already owns it — give the in-flight apply
+            # a short grace so the caller gets the REAL verdict
+            if not evt.wait(5.0):
+                raise TimeoutError(
+                    f"adapter {op} still applying after {timeout}s")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _apply_admin(self) -> None:
+        """Apply pending adapter load/unload requests (scheduler
+        thread, inter-segment gap — the only place the registry may
+        mutate). A failed op reports its error to the waiting caller;
+        the engine and every running request are unharmed (the bank
+        swap is all-or-nothing)."""
+        with self._lock:
+            ops, self._admin_ops = self._admin_ops, []
+        for op, args, evt, box in ops:
+            try:
+                if op == "load":
+                    box["result"] = self.engine.load_adapter(*args)
+                else:
+                    box["result"] = self.engine.unload_adapter(*args)
+            except Exception as e:
+                box["error"] = e
+            finally:
+                evt.set()
 
     def request_timeline(self, request_id: int):
         """Ordered trace-event timeline for one of THIS server's
@@ -910,11 +1031,20 @@ class Server:
                         if trace.enabled() and self._active:
                             # batch-wide event: carries the live
                             # request set so each one's timeline()
-                            # includes its segments
+                            # includes its segments — plus the LoRA
+                            # adapter mix decoding in it (which
+                            # fine-tunes shared this program run)
+                            ad = tuple(sorted(
+                                {h.cfg.adapter for h
+                                 in self._active.values()
+                                 if getattr(h.cfg, "adapter", None)
+                                 is not None}))
+                            attrs = {"adapters": ad} if ad else {}
                             sp = trace.span(
                                 "segment", steps=self.segment_steps,
                                 rids=tuple(h._trace_rid for h
-                                           in self._active.values()))
+                                           in self._active.values()),
+                                **attrs)
                         with sp:
                             self._guard(
                                 "decode",
@@ -996,6 +1126,15 @@ class Server:
             self._fatal = err
         wrapped = (RuntimeError(f"serving scheduler died: {err!r}")
                    if fail else None)
+        # pending adapter admin ops must not strand their callers in
+        # load_adapter()'s wait — report the terminal state as an error
+        with self._lock:
+            admin, self._admin_ops = self._admin_ops, []
+        for _op, _args, evt, box in admin:
+            box["error"] = (wrapped if fail else
+                            RuntimeError("server stopped before the "
+                                         "adapter op applied"))
+            evt.set()
         if self._adm is not None:
             adm, h = self._adm
             self._adm = None
@@ -1225,6 +1364,10 @@ class Server:
         the engine's abort guards). Engine-scoped faults escalate via
         :meth:`_contain`."""
         chunk = getattr(self.engine, "prefill_chunk", None)
+        # the adapter id rides every admission span: a multi-tenant
+        # timeline must say WHOSE weights the prefill ran under
+        t_attrs = ({"adapter": cfg.adapter}
+                   if getattr(cfg, "adapter", None) is not None else {})
         if chunk is not None and plen > chunk:
             # long prompt: claim capacity now, prefill one fixed-shape
             # chunk per gap (decode segments run in between) instead of
@@ -1233,7 +1376,7 @@ class Server:
             if trace.enabled():
                 sp = trace.span("admit.begin", rid=h._trace_rid,
                                 plen=plen, chunk=chunk,
-                                replay=h._engine_base > 0)
+                                replay=h._engine_base > 0, **t_attrs)
             with sp:
                 try:
                     adm = self.engine.begin_admit(ids, cfg)
@@ -1248,7 +1391,7 @@ class Server:
             sp = trace.span("admit", rid=h._trace_rid, plen=plen,
                             bucket=(wfn(plen) if wfn is not None
                                     else plen),
-                            replay=h._engine_base > 0)
+                            replay=h._engine_base > 0, **t_attrs)
         with sp:
             try:
                 rid = self.engine.add_request(ids, cfg)
@@ -1406,6 +1549,13 @@ class Server:
         self._depth_gauge()
 
     def _gap_body(self) -> None:
+        # 0. adapter admin (hot load/unload) applies FIRST — "in the
+        #    inter-segment gap" is the registry's whole thread contract,
+        #    and a load should be visible to this gap's admissions
+        # lint: allow-unlocked(atomic emptiness probe on the hot path;
+        # _apply_admin re-reads and swaps the list under _lock)
+        if self._admin_ops:
+            self._apply_admin()
         # 1. cancellations of RUNNING requests retire their slots
         for rid, h in list(self._active.items()):
             if h._cancel_requested:
@@ -1514,7 +1664,15 @@ class Server:
             return True
 
         while True:
-            h = self.queue.pop_if(admittable)
+            if self.tenant_quotas is None:
+                h = self.queue.pop_if(admittable)
+            else:
+                # quota-aware pop: a tenant over its cap defers ITS
+                # entries only — tenants queued behind it still admit
+                # (capacity-blocked heads still stop the scan: no
+                # head-of-line bypass on capacity)
+                h = self.queue.pop_admittable(admittable,
+                                              self._tenant_ok)
             if h is None:
                 # head (if any) does not fit RIGHT NOW. With the
                 # engine completely idle it can never fit — fail it
@@ -1544,6 +1702,25 @@ class Server:
                             wait_s=round(
                                 time.monotonic() - h.submit_ts, 6))
             self._start_admission(h, h.prompt, h.cfg, h.prompt_len)
+
+    def _tenant_ok(self, h: RequestHandle) -> bool:
+        """Per-tenant quota probe (scheduler thread): True when
+        admitting ``h`` now keeps its tenant at or under its cap.
+        Counts ADMITTED work — active slots plus the in-flight chunked
+        admission; replays are exempt (they held capacity when the
+        fault/preemption hit, and re-admission must not deadlock
+        behind the quota they already consumed once)."""
+        q = self.tenant_quotas
+        if q is None or h.tenant is None:
+            return True
+        cap = q if isinstance(q, int) else q.get(h.tenant)
+        if cap is None:
+            return True
+        n = sum(1 for hh in self._active.values()
+                if hh.tenant == h.tenant)
+        if self._adm is not None and self._adm[1].tenant == h.tenant:
+            n += 1
+        return n < cap
 
     # -- memory pressure (optimistic paged mode; scheduler thread) -----------
     def _relieve_pressure(self) -> None:

@@ -110,6 +110,9 @@ SERVING_FAMILIES = (
     "paddle_tpu_kv_shared_pages",       # refcount>1 pages (sharing
     #                                     multiplier) per pool
     "paddle_tpu_prefill_",              # bucket/chunk admissions, warmup
+    "paddle_tpu_lora_",                 # multi-tenant LoRA: requests
+    #                                     per {engine,adapter} and the
+    #                                     adapters_resident gauge
     "paddle_tpu_spec_",                 # speculative-decode draft tokens
     #                                     {engine,outcome=proposed|
     #                                     accepted} — per-engine

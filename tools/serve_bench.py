@@ -78,6 +78,15 @@ Usage::
     # serve_kv_quant_max_logit_div / serve_kv_quant_token_flips
     python tools/serve_bench.py --kv-ab --warmup
     python tools/serve_bench.py --kv-dtype int8   # single int8 run
+    # multi-tenant LoRA (PERF.md multi-tenant-LoRA methodology): K
+    # synthetic adapters hot-loaded into the engine's device bank,
+    # each request drawn to one (uniform or zipf) — read
+    # serve_lora_adapters_resident / serve_lora_mix_entropy, and A/B
+    # the SAME pre-drawn load base-vs-LoRA for the per-token cost of
+    # the batched-adapter gather (serve_lora_tpot_overhead)
+    python tools/serve_bench.py --adapters 8 --adapter-dist zipf --warmup
+    python tools/serve_bench.py --lora-ab --warmup   # K=0 vs K=8
+    python tools/serve_bench.py --adapters 4 --tenant-quotas 2  # quotas
 
 Output: one human table plus BENCH-shaped JSON records
 (``{"metric": ..., "value": ..., "unit": ...}``) on stdout. Chaos runs
@@ -268,7 +277,12 @@ def _toy_engine(args, speculative: bool = False):
         kv_watermark=args.kv_watermark,
         prefix_cache=(args.cache_prefixes == "on"),
         kv_dtype=args.kv_dtype,
-        draft_k=(args.draft_k if speculative else 0))
+        draft_k=(args.draft_k if speculative else 0),
+        lora_capacity=args.adapters,
+        lora_rank=args.lora_rank,
+        lora_targets=tuple(t.strip()
+                           for t in args.lora_targets.split(",")
+                           if t.strip()))
     return eng, cfg.vocab_size
 
 
@@ -282,7 +296,8 @@ def _toy_server_kwargs(args, max_restarts=None):
         max_replays=args.max_replays,
         max_preemptions=args.max_preemptions,
         restart_backoff_s=args.restart_backoff,
-        stall_timeout_s=args.stall_timeout)
+        stall_timeout_s=args.stall_timeout,
+        tenant_quotas=args.tenant_quotas)
 
 
 def _build_toy_server(args, speculative: bool = False):
@@ -570,6 +585,33 @@ def main(argv=None) -> int:
                          "records plus serve_kv_quant_tpot_speedup, "
                          "serve_kv_quant_capacity_ratio and the "
                          "bounded-numerics divergence probe")
+    # multi-tenant LoRA knobs (in-process single-server mode;
+    # paddle_tpu.serving.adapters)
+    ap.add_argument("--adapters", type=int, default=0, metavar="K",
+                    help="hot-load K seeded synthetic LoRA adapters "
+                         "and draw every request's adapter from them "
+                         "(0 = base model only)")
+    ap.add_argument("--adapter-dist", choices=("uniform", "zipf"),
+                    default="uniform",
+                    help="per-request adapter draw: uniform, or zipf "
+                         "(s=1.1 — the realistic many-tenants shape: "
+                         "a few hot fine-tunes, a long cold tail)")
+    ap.add_argument("--lora-rank", type=int, default=4,
+                    help="bank rank of the synthetic adapters")
+    ap.add_argument("--lora-targets", default="q,v",
+                    help="comma-separated LoRA target projections "
+                         "(subset of q,k,v,o,gate,up,down)")
+    ap.add_argument("--tenant-quotas", type=int, default=None,
+                    metavar="N",
+                    help="cap every tenant (= adapter) at N "
+                         "concurrently admitted requests; a tenant "
+                         "over quota defers without starving others")
+    ap.add_argument("--lora-ab", action="store_true",
+                    help="A/B mode: run the SAME pre-drawn load twice "
+                         "— base model (K=0) then K adapters (default "
+                         "8) — and report serve_lora_tpot_overhead "
+                         "(the per-token price of the batched-adapter "
+                         "gather)")
     args = ap.parse_args(argv)
 
     rng = random.Random(args.seed)
@@ -581,9 +623,10 @@ def main(argv=None) -> int:
               "--trace-ab need the in-process engine (no --url)",
               file=sys.stderr)
         return 2
-    if sum([args.spec_ab, args.trace_ab, args.kv_ab]) > 1:
-        print("--spec-ab/--trace-ab/--kv-ab are separate A/Bs; run "
-              "them one at a time", file=sys.stderr)
+    if sum([args.spec_ab, args.trace_ab, args.kv_ab,
+            args.lora_ab]) > 1:
+        print("--spec-ab/--trace-ab/--kv-ab/--lora-ab are separate "
+              "A/Bs; run them one at a time", file=sys.stderr)
         return 2
     if args.kv_ab and (args.url is not None or args.router
                        or args.replicas > 1):
@@ -604,6 +647,14 @@ def main(argv=None) -> int:
     if args.kill_replica_at is not None and not args.router:
         print("--kill-replica-at needs --router/--replicas > 1",
               file=sys.stderr)
+        return 2
+    if (args.adapters or args.lora_ab) and (args.url is not None
+                                            or args.router):
+        print("--adapters/--lora-ab need the single in-process engine "
+              "(no --url, no --router/--replicas)", file=sys.stderr)
+        return 2
+    if args.adapters < 0:
+        print("--adapters must be >= 0", file=sys.stderr)
         return 2
 
     # open loop: the full arrival schedule AND every prompt are drawn
@@ -633,6 +684,20 @@ def main(argv=None) -> int:
     prompts = [shared_prefix
                + _body(_draw_len(rng, args.prompt_dist, lo, hi))
                for _ in range(args.requests)]
+    # the per-request ADAPTER assignment is drawn up front too: the
+    # --lora-ab arms replay the identical mix (the base arm just
+    # ignores it), and the mix entropy record describes the LOAD, not
+    # one arm's sampling
+    n_adapters = args.adapters
+    if args.lora_ab and n_adapters == 0:
+        n_adapters = 8          # the PERF.md reference A/B: K=0 vs K=8
+    if n_adapters:
+        wts = ([1.0 / (j + 1) ** 1.1 for j in range(n_adapters)]
+               if args.adapter_dist == "zipf" else None)
+        assign = rng.choices([f"ad{j}" for j in range(n_adapters)],
+                             weights=wts, k=args.requests)
+    else:
+        assign = [None] * args.requests
 
     spec_def = args.speculative == "on"
     trace_def = args.trace_out is not None
@@ -644,6 +709,9 @@ def main(argv=None) -> int:
     elif args.kv_ab:
         arms = [("bf16", spec_def, trace_def),
                 ("int8", spec_def, trace_def)]
+    elif args.lora_ab:
+        arms = [("base", spec_def, trace_def),
+                ("lora", spec_def, trace_def)]
     else:
         arms = [("", spec_def, trace_def)]
     res = {}
@@ -658,8 +726,11 @@ def main(argv=None) -> int:
             arm_args.kv_dtype = arm
             if arm == "int8":
                 arm_args.num_pages = 2 * args.num_pages
+        if args.lora_ab:
+            arm_args = argparse.Namespace(**vars(args))
+            arm_args.adapters = 0 if arm == "base" else n_adapters
         res[arm] = _run_arm(arm_args, arm, spec_on, trace_on, prompts,
-                            arrivals)
+                            arrivals, assign)
     if args.trace_ab:
         # the overhead verdict: decode cadence with the recorder on vs
         # off, on identical replayed load — the number that justifies
@@ -689,6 +760,22 @@ def main(argv=None) -> int:
                 {"metric": "serve_spec_throughput_speedup",
                  "value": round(b["throughput"] / a["throughput"], 3),
                  "unit": "x (spec/plain)"}))
+    if args.lora_ab:
+        # the multi-tenant verdict: decode cadence with the
+        # batched-adapter gather in the program vs without, on the
+        # identical replayed load — the per-token price of serving K
+        # fine-tunes from one engine
+        a, b = res["base"], res["lora"]
+        if a.get("tpot_p50") and b.get("tpot_p50"):
+            print(json.dumps({"metric": "serve_lora_tpot_overhead",
+                              "value": round(b["tpot_p50"]
+                                             / a["tpot_p50"], 3),
+                              "unit": "x (lora/base)"}))
+        if a.get("throughput") and b.get("throughput"):
+            print(json.dumps(
+                {"metric": "serve_lora_throughput_ratio",
+                 "value": round(b["throughput"] / a["throughput"], 3),
+                 "unit": "x (lora/base)"}))
     if args.kv_ab:
         # the quantization verdict on identical replayed load: decode
         # cadence bf16/int8 (HBM-bound hardware converts the halved
@@ -803,13 +890,36 @@ def _ttft_decomposition():
     return qs, ps, gs
 
 
+def _load_bench_adapters(server, args) -> None:
+    """Hot-load ``--adapters`` seeded synthetic LoRA adapters through
+    the Server's admin path (the same inter-segment-gap marshalling a
+    production load uses). Factors are small (0.05 std) so the toy
+    model's outputs stay well-formed while the gather does real
+    work."""
+    import numpy as np
+
+    reg = server.engine.adapters
+    for j in range(args.adapters):
+        g = np.random.default_rng(1000 + j)
+        params = {
+            t: (g.standard_normal((args.lora_rank, d_in))
+                .astype(np.float32) * 0.05,
+                g.standard_normal((d_out, args.lora_rank))
+                .astype(np.float32) * 0.05)
+            for t, (d_in, d_out) in reg.shapes.items()}
+        server.load_adapter(f"ad{j}", params)
+
+
 def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
-             arrivals) -> dict:
+             arrivals, assign=None) -> dict:
     """Build one server (in-process mode), drive the pre-drawn load
     through it, print the table + BENCH records (metric names suffixed
-    ``_<arm>`` in A/B mode), shut down. Returns the numbers the A/B
-    verdict needs."""
+    ``_<arm>`` in A/B mode), shut down. ``assign`` is the pre-drawn
+    per-request adapter name list (ignored when --adapters is 0 for
+    this arm). Returns the numbers the A/B verdict needs."""
     sfx = f"_{arm}" if arm else ""
+    if assign is None:
+        assign = [None] * len(prompts)
     server = None
     plan = None
     kill_fn = None
@@ -827,6 +937,8 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             server, vocab, kill_fn = _build_toy_router(args)
         else:
             server, vocab, plan = _build_toy_server(args, spec_on)
+            if args.adapters:
+                _load_bench_adapters(server, args)
         assert vocab == _TOY_VOCAB, \
             f"toy model vocab {vocab} != {_TOY_VOCAB} the prompts used"
 
@@ -868,7 +980,9 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             from paddle_tpu.inference.generation import GenerationConfig
             import numpy as np
 
-            cfg = GenerationConfig(max_new_tokens=args.max_new)
+            cfg = GenerationConfig(
+                max_new_tokens=args.max_new,
+                adapter=(assign[i] if args.adapters else None))
             th = threading.Thread(
                 target=_drive_inproc,
                 args=(server, np.asarray(prompt, np.int32), cfg, stats))
@@ -1009,6 +1123,30 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             print(json.dumps({"metric": f"serve_prefix_cow_copies{sfx}",
                               "value": getattr(alloc, "cow_copies", 0),
                               "unit": "count"}))
+    reg = (getattr(eng, "adapters", None) if eng is not None
+           else None)
+    if reg is not None and args.adapters:
+        # multi-tenant accounting: how many fine-tunes ONE engine
+        # served this run, and how concentrated the mix was (entropy
+        # over the drawn assignment — log2(K) = perfectly uniform,
+        # lower = a few hot tenants; zipf loads land in between)
+        import math
+        from collections import Counter
+
+        info = reg.resident()
+        used = [a for a in assign if a is not None]
+        cnt = Counter(used)
+        n_u = len(used)
+        ent = (-sum((c / n_u) * math.log2(c / n_u)
+                    for c in cnt.values()) if n_u else 0.0)
+        print(f"lora [{args.adapters} adapters, {args.adapter_dist}]: "
+              f"{info['resident']} resident, {len(cnt)} distinct in "
+              f"the mix, entropy {ent:.3f} bits "
+              f"(max {math.log2(args.adapters):.3f})")
+        print(json.dumps({"metric": f"serve_lora_adapters_resident{sfx}",
+                          "value": info["resident"], "unit": "count"}))
+        print(json.dumps({"metric": f"serve_lora_mix_entropy{sfx}",
+                          "value": round(ent, 4), "unit": "bits"}))
     spec_stats = (getattr(eng, "spec_stats", None)
                   if eng is not None else None)
     if spec_stats is not None and getattr(eng, "draft_k", 0):
