@@ -1,17 +1,34 @@
 #!/bin/bash
-# First-TPU-session runbook (VERDICT r3 #1/#8, PERF.md attack plan) —
-# run the moment the tunnel is up. Order matters:
-#   1. flash parity ON-CHIP (the diagonal-block specialization is
-#      default-on but has only ever run in interpret mode — Weak #2)
-#   2. the round-record bench
-#   3. kernel/layout experiments that decide flags
-#   4. autotune sweep persisted in-repo
-#   5. the bigger configs
+# TPU-session runbook (VERDICT r3 #1/#8, PERF.md attack plan) — run the
+# moment the tunnel is up.
+#
+# RESUMABLE + PRIORITY-ORDERED (ROADMAP item 5's enabling refactor):
+# every step has a NAME recorded in $STATE when it finishes (any rc —
+# a failed step's log is still its harvest; delete its line to retry),
+# so a 35-minute window RESUMES at the first unharvested step instead
+# of replaying training parity from the top. SESSION_RESET=1 clears the
+# state and starts over.
+#
+# ORDER (value-per-minute): the serving stack has NEVER touched a chip
+# — every serve_bench number in PERF.md is CPU-tiny with explicit
+# "mechanism, not speedup" caveats — so after the cheap preflights the
+# serving-record steps (6c-6i) run FIRST, and the training-side parity
+# replays and config benches come after. A window that dies at minute
+# 35 should die owing training replays, not serving records.
+#
 # Every step appends to experiments/tpu_session.log; steps are
 # independent — a failure moves on (the log is the evidence either way).
 set -u
 cd "$(dirname "$0")/.."
 LOG=experiments/tpu_session.log
+STATE=experiments/.tpu_session_state
+
+if [ "${SESSION_RESET:-0}" = "1" ]; then
+  rm -f "$STATE"
+  echo "=== session state reset ===" | tee -a "$LOG"
+fi
+touch "$STATE"
+
 run() {
   # Each step runs in its OWN process group (setsid) and the whole group
   # is SIGKILLed on timeout — `timeout` alone signals only the direct
@@ -41,153 +58,177 @@ run() {
     wait "$pid"; rc=$?
   fi
   echo "=== rc=$rc ===" | tee -a "$LOG"
+  LAST_RC=$rc
 }
 
-# 0. PREFLIGHT: the invariant linter (~3s, CPU-only — no device claim).
-#    A TPU window must never burn minutes on a program that would
-#    recompile per request (PT001) or block its scheduler gap on host
-#    syncs (PT002): fail the serving-invariant gate HERE, before any
-#    chip time is spent. Like every step it logs-and-continues, but an
-#    unbaselined finding in the log taints the window's serving records.
-STEP_TIMEOUT=300 run python -m tools.lint paddle_tpu/ --summary
+step() {
+  # step NAME cmd...: skip if NAME already harvested (in $STATE), else
+  # run and record "NAME rc=N utc" on completion. A TIMED-OUT step
+  # (rc=137) is recorded too — it already burned its budget once; to
+  # force a retry next window, delete its line from $STATE.
+  local name=$1; shift
+  if grep -q "^${name} " "$STATE" 2>/dev/null; then
+    echo "=== skip ${name} (harvested: $(grep "^${name} " "$STATE"))" \
+      | tee -a "$LOG"
+    return 0
+  fi
+  run "$@"
+  echo "${name} rc=${LAST_RC} $(date -u +%FT%TZ)" >>"$STATE"
+}
 
-# 1. QUICK kernel parity slice on real hardware (conftest escape
-#    hatch): the bench-path shapes (device_scale, d=64/128) plus the r5
-#    sub-lane modes (pad/kpad/fp32 — kpad's in-kernel concat is the one
-#    Mosaic-unverified lowering). TIGHT timeout: a 35-min window must
-#    reach the record bench even if cold remote compiles are slow; the
-#    FULL parity suite runs later (step 6b).
-STEP_TIMEOUT=900 run env PADDLE_TPU_TESTS_ON_DEVICE=1 \
+# ---------------------------------------------------------------------------
+# 0. PREFLIGHTS (cheap, no device claim / tiny claim)
+# ---------------------------------------------------------------------------
+# 0a. invariant linter (~3s, CPU-only): a TPU window must never burn
+#     minutes on a program that would recompile per request (PT001) or
+#     block its scheduler gap on host syncs (PT002). Logs-and-continues,
+#     but an unbaselined finding taints the window's serving records.
+STEP_TIMEOUT=300 step lint python -m tools.lint paddle_tpu/ --summary
+# 0b. QUICK kernel parity slice on real hardware (conftest escape
+#     hatch): the bench-path shapes plus the r5 sub-lane modes. TIGHT
+#     timeout — the serving records below must get their window even if
+#     cold remote compiles are slow; the FULL parity suite is step 6b.
+STEP_TIMEOUT=900 step kernel_slice env PADDLE_TPU_TESTS_ON_DEVICE=1 \
     python -m pytest tests/test_flash_attention.py \
     -k "device_scale or Sublane" -q -p no:cacheprovider
+
+# ---------------------------------------------------------------------------
+# SERVING RECORDS FIRST (6c-6i): nothing serving-side has ever run on a
+# TPU; each step below converts one CPU-tiny "mechanism" number into a
+# hardware record.
+# ---------------------------------------------------------------------------
+# 6c. FIRST on-chip online-serving records: the prefix-caching A/B is
+#     the highest-value serving pair — TTFT p50/p99 + serve_kv_occupancy
+#     + serve_prefix_hit_rate, cold then warm (PERF.md "Automatic prefix
+#     caching"; 11.2x TTFT p50 on CPU tiny — the on-chip ratio decides
+#     whether the cache defaults on for serving configs)
+step serve_prefix_cold python tools/serve_bench.py \
+    --shared-prefix-len 448 --cache-prefixes off --num-pages 320 \
+    --max-pages 64 --page-size 8 --requests 16 --rate 4 --max-new 8 \
+    --segment-steps 2 --prompt-len 4:8 --layers 2 --prefill-chunk 64 \
+    --warmup
+step serve_prefix_warm python tools/serve_bench.py \
+    --shared-prefix-len 448 --cache-prefixes on --num-pages 320 \
+    --max-pages 64 --page-size 8 --requests 16 --rate 4 --max-new 8 \
+    --segment-steps 2 --prompt-len 4:8 --layers 2 --prefill-chunk 64 \
+    --warmup
+# 6d. on-TPU SPECULATIVE SERVING A/B (decode on TPU is HBM-bound, so
+#     serve_spec_tokens_per_forward should convert into the TPOT ratio
+#     here — the CPU wall ratio is honestly <1x)
+step serve_spec_ab python tools/serve_bench.py --spec-ab --draft-k 6 \
+    --repeat-unit 4 --layers 2 --prompt-len 28:32 --max-new 32 \
+    --rate 8 --requests 16 --num-pages 64 --max-pages 16 --page-size 8 \
+    --warmup
+# 6e. on-TPU TRACE CAPTURE + tracing-overhead A/B (per-phase TTFT
+#     decomposition on-chip; --trace-ab decides whether tracing can
+#     default ON for serving configs, target <= 1.02x). Commit
+#     experiments/serve_trace_tpu.json with the session log.
+step serve_trace python tools/serve_bench.py \
+    --trace-out experiments/serve_trace_tpu.json --layers 2 \
+    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
+    --num-pages 64 --max-pages 16 --page-size 8 --warmup
+step serve_trace_ab python tools/serve_bench.py --trace-ab --layers 2 \
+    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
+    --num-pages 64 --max-pages 16 --page-size 8 --warmup
+# 6f. on-TPU MULTI-REPLICA serve_bench: replica 0 killed mid-run — read
+#     serve_fleet_survival_rate (must stay 1.0), failover count/latency,
+#     breaker opens; the 1-replica arm honestly shows the outage the CPU
+#     run understates (on-chip rebuild includes device reinit).
+step serve_fleet_1rep python tools/serve_bench.py --router --replicas 1 \
+    --kill-replica-at 2 --layers 2 --prompt-len 4:16 --max-new 12 \
+    --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
+    --seed 3
+step serve_fleet_3rep python tools/serve_bench.py --router --replicas 3 \
+    --kill-replica-at 2 --layers 2 --prompt-len 4:16 --max-new 12 \
+    --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
+    --seed 3
+# 6g. on-TPU QUANTIZED-KV A/B at EQUAL HBM (int8 arm gets 2x pages):
+#     HBM-bound decode should convert halved page bytes into
+#     serve_kv_quant_tpot_speedup (CPU-tiny 1.19x is compute-bound
+#     mechanism); also capacity_ratio (~1.94x) + bounded-numerics probes.
+step serve_kv_ab python tools/serve_bench.py --kv-ab --layers 2 \
+    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
+    --num-pages 64 --max-pages 16 --page-size 8 --warmup
+# 6h. on-TPU MULTI-TENANT LoRA A/B: base (K=0) vs 8 resident rank-4
+#     adapters on identical zipf load — serve_lora_tpot_overhead
+#     (CPU-tiny band 1.01-1.06x; on HBM-bound decode the bank-gather
+#     read is the term to watch), mix entropy ~2.17 bits, zero
+#     post-warmup compiles in the jit counters.
+step serve_lora_ab python tools/serve_bench.py --lora-ab \
+    --adapter-dist zipf --layers 2 --prompt-len 8:24 --max-new 16 \
+    --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
+    --warmup
+# 6i. on-TPU TENSOR-PARALLEL serving records (NEW — PR 14). Two halves:
+#     (a) mechanism A/B at a size both arms fit — identical pre-drawn
+#     load through TP=1 then TP=4; on ICI the per-block psums should be
+#     near-free, so serve_tp_tpot_speedup tells what TP costs per token
+#     (the CPU-mesh reference is 0.4x: host-mesh collectives, mechanism
+#     only); (b) the capacity record — a 13B-preset engine at TP=4
+#     serves while the SAME command at --tp 1 cannot load its weights
+#     on one chip (run it once to log the OOM as evidence; that failure
+#     is the claim). Raise --layers toward the full 40 as the window
+#     allows; weights dominate, so even a truncated stack proves the
+#     per-chip fit.
+step serve_tp_ab python tools/serve_bench.py --tp-ab --tp 4 --layers 2 \
+    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
+    --num-pages 64 --max-pages 16 --page-size 8 --warmup
+STEP_TIMEOUT=3600 step serve_tp_13b python tools/serve_bench.py --tp 4 \
+    --preset 13b --layers 8 --prompt-len 16:32 --max-new 16 --rate 4 \
+    --requests 8 --num-pages 128 --max-pages 16 --page-size 8 --warmup
+
+# ---------------------------------------------------------------------------
+# TRAINING-SIDE PARITY + PERF LEVERS (after the serving records)
+# ---------------------------------------------------------------------------
 # 2. round record (bench has its own group-killing watchdog: accelerator
 #    attempt BENCH_WATCHDOG_SECS then a 600s CPU retry — keep the outer
 #    step timeout above their sum so the CPU retry can finish)
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py
-# ---- steps 3+ ordered by VALUE-PER-MINUTE: the 2026-07-31 window
-# ---- lasted 35 min and died before any lever was measured — the
-# ---- MFU-moving experiments go before the bigger-config benches
+STEP_TIMEOUT=3900 step bench_round env BENCH_WATCHDOG_SECS=3000 \
+    python bench.py
 # 3. flag-deciding experiments (cheap compiles, decide defaults)
-run python experiments/exp_flash_hb.py     # FLAGS_flash_head_batched
+step exp_flash_hb python experiments/exp_flash_hb.py
 # exp_dots: 8 variants x EXP_VARIANT_SECS(600) worst case — the step
 # timeout must cover the per-variant budgets, not fight them
-STEP_TIMEOUT=5100 run python experiments/exp_dots.py   # scan_unroll+remat
+STEP_TIMEOUT=5100 step exp_dots python experiments/exp_dots.py
 # 4. lever A/B on the full bench (log evidence, not the round record;
 #    flip a default in code only on a >=3% full-step win per PERF.md)
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 BENCH_REMAT=attn_out \
-    python bench.py
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 BENCH_SCAN_UNROLL=2 \
-    python bench.py
+STEP_TIMEOUT=3900 step bench_remat env BENCH_WATCHDOG_SECS=3000 \
+    BENCH_REMAT=attn_out python bench.py
+STEP_TIMEOUT=3900 step bench_unroll env BENCH_WATCHDOG_SECS=3000 \
+    BENCH_SCAN_UNROLL=2 python bench.py
 # 5. autotune sweep -> .autotune_cache.json (commit it); 5 trials x
 #    EXP_TRIAL_SECS(900)
-STEP_TIMEOUT=4800 run python experiments/exp_autotune_sweep.py
+STEP_TIMEOUT=4800 step autotune_sweep python experiments/exp_autotune_sweep.py
 # 6. bigger configs (cold-cache compiles can be slow through the tunnel)
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py 1.3b
-# 6b. FULL kernel parity on-chip (the quick slice in step 1 covered the
+STEP_TIMEOUT=3900 step bench_1b3 env BENCH_WATCHDOG_SECS=3000 \
+    python bench.py 1.3b
+# 6b. FULL kernel parity on-chip (the quick slice in step 0b covered the
 #     bench path; this covers everything else incl. the head-batched
 #     kernel, whose device routing stays off until green + measured win)
-run env PADDLE_TPU_TESTS_ON_DEVICE=1 PADDLE_TPU_HB_ON_DEVICE=1 \
+step kernel_full env PADDLE_TPU_TESTS_ON_DEVICE=1 PADDLE_TPU_HB_ON_DEVICE=1 \
     python -m pytest \
     tests/test_flash_attention.py tests/test_flash_hb.py \
     tests/test_pallas_kernels.py tests/test_paged_attention.py \
     -q -p no:cacheprovider
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py ragged
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py decode
+STEP_TIMEOUT=3900 step bench_ragged env BENCH_WATCHDOG_SECS=3000 \
+    python bench.py ragged
+STEP_TIMEOUT=3900 step bench_decode env BENCH_WATCHDOG_SECS=3000 \
+    python bench.py decode
 # speculative decode: tokens/forward + WALL speedup (decode is HBM-bound
 # on TPU, so unlike the CPU fallback the wall number should track the
 # tokens/forward ratio)
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py spec
-# 6c. FIRST on-chip online-serving records (every serve_bench number so
-#     far is CPU-tiny): the prefix-caching A/B is the highest-value
-#     serving pair — TTFT p50/p99 + serve_kv_occupancy +
-#     serve_prefix_hit_rate, cold then warm (PERF.md "Automatic prefix
-#     caching" methodology; 11.2x TTFT p50 on CPU tiny — the on-chip
-#     ratio decides whether the cache defaults on for serving configs)
-STEP_TIMEOUT=2400 run python tools/serve_bench.py --shared-prefix-len 448 \
-    --cache-prefixes off --num-pages 320 --max-pages 64 --page-size 8 \
-    --requests 16 --rate 4 --max-new 8 --segment-steps 2 \
-    --prompt-len 4:8 --layers 2 --prefill-chunk 64 --warmup
-STEP_TIMEOUT=2400 run python tools/serve_bench.py --shared-prefix-len 448 \
-    --cache-prefixes on --num-pages 320 --max-pages 64 --page-size 8 \
-    --requests 16 --rate 4 --max-new 8 --segment-steps 2 \
-    --prompt-len 4:8 --layers 2 --prefill-chunk 64 --warmup
-# 6d. on-TPU SPECULATIVE SERVING A/B (first hardware numbers for the
-#     batched spec path — every spec-serving number so far is CPU-tiny
-#     and CPU is compute-bound, so its wall ratio is honestly <1x;
-#     decode on TPU is HBM-bound, so serve_spec_tokens_per_forward
-#     should convert into the TPOT ratio here. One invocation runs
-#     both arms on identical load; read serve_tpot_p50_plain/_spec,
-#     serve_spec_tokens_per_forward, serve_spec_acceptance_rate)
-STEP_TIMEOUT=2400 run python tools/serve_bench.py --spec-ab --draft-k 6 \
-    --repeat-unit 4 --layers 2 --prompt-len 28:32 --max-new 32 \
-    --rate 8 --requests 16 --num-pages 64 --max-pages 16 --page-size 8 \
-    --warmup
-# 6e. on-TPU TRACE CAPTURE + tracing-overhead A/B (first hardware
-#     numbers for paddle_tpu.tracing): the Chrome-trace artifact gives
-#     the first real per-phase TTFT decomposition on-chip
-#     (serve_ttft_queue/prefill/gap_p50 — CPU-tiny gap shares are
-#     prefill-dominated and say nothing about HBM-bound decode), and
-#     the --trace-ab serve_trace_tpot_overhead record decides whether
-#     tracing can default ON for serving configs (target: <= 1.02x).
-#     Commit experiments/serve_trace_tpu.json with the session log.
-STEP_TIMEOUT=2400 run python tools/serve_bench.py \
-    --trace-out experiments/serve_trace_tpu.json --layers 2 \
-    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
-    --num-pages 64 --max-pages 16 --page-size 8 --warmup
-STEP_TIMEOUT=2400 run python tools/serve_bench.py --trace-ab --layers 2 \
-    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
-    --num-pages 64 --max-pages 16 --page-size 8 --warmup
-# 6f. on-TPU MULTI-REPLICA serve_bench (first hardware numbers for the
-#     serving.Router fleet tier, after the 6e trace capture): 3
-#     replica Servers on one chip (small pools so three engines fit),
-#     replica 0 killed mid-run — read serve_fleet_survival_rate (must
-#     stay 1.0), serve_failover_count, serve_failover_latency_p99,
-#     serve_breaker_opens, and compare the 1-replica arm's TTFT
-#     collapse vs the 3-replica arm (PERF.md "Fleet survival under
-#     replica loss"; CPU-tiny reference: TTFT p50 3.62s -> 1.49s).
-#     On-chip the rebuild window includes device reinit, so the
-#     1-replica arm honestly shows the outage the CPU run understates.
-STEP_TIMEOUT=2400 run python tools/serve_bench.py --router --replicas 1 \
-    --kill-replica-at 2 --layers 2 --prompt-len 4:16 --max-new 12 \
-    --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
-    --seed 3
-STEP_TIMEOUT=2400 run python tools/serve_bench.py --router --replicas 3 \
-    --kill-replica-at 2 --layers 2 --prompt-len 4:16 --max-new 12 \
-    --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
-    --seed 3
-# 6g. on-TPU QUANTIZED-KV serve_bench A/B (first hardware numbers for
-#     int8 KV pages, after the 6f fleet run): identical load through
-#     bf16 pools vs int8 pools at EQUAL HBM (the int8 arm gets 2x
-#     pages automatically). Decode on TPU is HBM-bandwidth-bound, so
-#     the halved page read bytes should convert into
-#     serve_kv_quant_tpot_speedup here (CPU-tiny measured 1.15x but is
-#     compute-bound — mechanism, not speedup); also read
-#     serve_kv_quant_capacity_ratio (expect ~1.94x vs bf16),
-#     serve_kv_occupancy_p99_int8 (~half the bf16 arm at matched
-#     load), and the bounded-numerics records
-#     serve_kv_quant_max_logit_div / serve_kv_quant_token_flips —
-#     on-chip bf16 pools make the bf16 arm's baseline real (the CPU
-#     arm stores f32).
-STEP_TIMEOUT=2400 run python tools/serve_bench.py --kv-ab --layers 2 \
-    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
-    --num-pages 64 --max-pages 16 --page-size 8 --warmup
-# 6h. on-TPU MULTI-TENANT LoRA serve_bench A/B (after 6g): identical
-#     pre-drawn zipf load through base (K=0) vs 8 resident rank-4
-#     adapters — read serve_lora_tpot_overhead (CPU-tiny band was
-#     1.01-1.06x; on HBM-bound TPU decode the bank-gather read is the
-#     term to watch), serve_lora_mix_entropy (~2.17 bits expected),
-#     and confirm zero post-warmup compiles in the jit counters (the
-#     one-program-per-mix claim on hardware).
-STEP_TIMEOUT=2400 run python tools/serve_bench.py --lora-ab \
-    --adapter-dist zipf --layers 2 --prompt-len 8:24 --max-new 16 \
-    --rate 8 --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
-    --warmup
+STEP_TIMEOUT=3900 step bench_spec env BENCH_WATCHDOG_SECS=3000 \
+    python bench.py spec
 # 7. the remaining BASELINE.md configs — one window should produce the
 #    full config table (VERDICT r4 Missing #3). Expected budgets: each
 #    is a small model + cached-compile candidate; ~5-10 min warm,
 #    ~20-30 min cold through the tunnel.
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py resnet
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py moe
-STEP_TIMEOUT=3900 run env BENCH_WATCHDOG_SECS=3000 python bench.py vit
+STEP_TIMEOUT=3900 step bench_resnet env BENCH_WATCHDOG_SECS=3000 \
+    python bench.py resnet
+STEP_TIMEOUT=3900 step bench_moe env BENCH_WATCHDOG_SECS=3000 \
+    python bench.py moe
+STEP_TIMEOUT=3900 step bench_vit env BENCH_WATCHDOG_SECS=3000 \
+    python bench.py vit
 echo "=== session done; review $LOG, flip flags per PERF.md decision" \
-     "rules, re-run bench.py, commit .autotune_cache.json ===" | tee -a "$LOG"
+     "rules, re-run bench.py, commit .autotune_cache.json;" \
+     "$STATE holds the harvest ledger (delete a line to retry) ===" \
+     | tee -a "$LOG"

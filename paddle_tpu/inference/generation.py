@@ -719,7 +719,11 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  draft_k: int = 0, ngram_max: int = 3,
                  lora_capacity: int = 0, lora_rank: int = 8,
-                 lora_targets=("q", "k", "v", "o")):
+                 lora_targets=("q", "k", "v", "o"),
+                 tp_degree: int = 1, tp_devices=None):
+        from .tp import (TP_AXIS, make_tp_mesh, shard_params_tp,
+                         validate_tp_model)
+
         if (isinstance(draft_k, bool)
                 or not isinstance(draft_k, (int, np.integer))
                 or not 0 <= draft_k <= 256):
@@ -732,6 +736,24 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"lora_capacity must be an int >= 0 (0 disables "
                 f"multi-tenant LoRA), got {lora_capacity!r}")
+        # tensor parallelism (inference/tp.py): tp_degree > 1 builds a
+        # 1-D "mp" mesh and shards weights (per their layer pspecs) and
+        # every KV store on the (kv_)head axis; per-slot vectors, page
+        # tables, and all host bookkeeping REPLICATE, so the engine's
+        # programs keep their one-program-per-shape invariant at any
+        # degree. tp_devices pins the mesh to a device subset (the
+        # ReplicaSpec fleet-partitioning seam). Must be resolved before
+        # _init_decode_state builds the device pools.
+        # mesh first (validates the degree and device availability),
+        # then the model-geometry divisibility check
+        self.tp_mesh = make_tp_mesh(tp_degree, tp_devices)
+        validate_tp_model(model, tp_degree)
+        self.tp_degree = int(tp_degree)
+        # the (mesh, axis) handle the model forwards thread into the
+        # attention ops' shard_map wrap (None = pre-TP trace, bitwise
+        # the single-device engine)
+        self._tp = (None if self.tp_mesh is None
+                    else (self.tp_mesh, TP_AXIS))
         self.model = model
         self.max_batch = max_batch
         self.max_len = max_len
@@ -759,6 +781,12 @@ class ContinuousBatchingEngine:
         # throughput side by side; retired via close()/__del__
         self._monitor_engine = monitor.instance_label("engine")
         self.params = {k: p.value for k, p in model.named_parameters()}
+        if self.tp_mesh is not None:
+            # column-parallel q/k/v/gate/up, row-parallel o/down,
+            # vocab-parallel embed/lm_head — straight from the layer
+            # pspec annotations the training stack already carries
+            self.params = shard_params_tp(model, self.params,
+                                          self.tp_mesh)
         # multi-tenant LoRA (lora_capacity > 0): an AdapterRegistry owns
         # the stacked per-target factor bank ([L, K+1, r, d] per
         # projection, index 0 = base model) plus hot load/unload; every
@@ -903,12 +931,51 @@ class ContinuousBatchingEngine:
             # when a non-empty bank is passed alongside.
             "adapter": jnp.zeros((mb,), jnp.int32),
         }
+        if self.tp_mesh is not None:
+            # the per-slot vectors REPLICATE on the mesh (the PR 2
+            # invariant is TP-invariant): committing them here keeps
+            # every program's input shardings identical from warmup
+            # through serving — no sharding-keyed recompiles
+            self.lens = self._tp_rep(self.lens)
+            self.last = self._tp_rep(self.last)
+            self.done_dev = self._tp_rep(self.done_dev)
+            self.active_dev = self._tp_rep(self.active_dev)
+            self.samp = {k: self._tp_rep(v)
+                         for k, v in self.samp.items()}
         self._free = list(range(mb))
+
+    # -- tensor-parallel placement helpers -----------------------------------
+    def _tp_rep(self, x):
+        """Commit a device value fully replicated on the TP mesh
+        (identity when tp_degree == 1)."""
+        if self.tp_mesh is None:
+            return x
+        from .tp import tp_replicate
+
+        return tp_replicate(x, self.tp_mesh)
+
+    def _tp_kv(self, caches):
+        """Shard a per-layer KV list (slabs / pools / minis) on the
+        kv-head axis (identity when tp_degree == 1)."""
+        if self.tp_mesh is None:
+            return caches
+        from .tp import tp_shard_kv
+
+        return tp_shard_kv(caches, self.tp_mesh)
+
+    def _mini_cache(self, width: int):
+        """One admission's B=1 dense mini cache, TP-placed: the mini is
+        where prefill writes the prompt's KV before it installs into
+        the pool, so it shards on the head axis exactly like the pool
+        it feeds — the gather/scatter install programs then move
+        head-local rows with zero cross-chip traffic."""
+        return self._tp_kv(self.model.init_cache(1, width))
 
     def _make_caches(self):
         """Cache layout hook — the paged subclass replaces the dense
         [max_batch, max_len] slabs with page pools."""
-        return self.model.init_cache(self.max_batch, self.max_len)
+        return self._tp_kv(
+            self.model.init_cache(self.max_batch, self.max_len))
 
     def _bank(self) -> dict:
         """The LoRA factor bank to pass into the jitted serving
@@ -918,16 +985,24 @@ class ContinuousBatchingEngine:
         pre-LoRA computation)."""
         return self.adapters.bank if self.adapters is not None else {}
 
+    def _fwd_kwargs(self, lora) -> dict:
+        """Optional kwargs for the model's serving forwards: ``lora``
+        only when batched adapters ride along, ``tp`` only when the
+        engine runs on a mesh — so a model without either kwarg keeps
+        working and the pre-TP/pre-LoRA traces stay byte-identical."""
+        kw = {}
+        if lora is not None:
+            kw["lora"] = lora
+        if self._tp is not None:
+            kw["tp"] = self._tp
+        return kw
+
     def _fwd_prefill(self, params, ids, caches, pos=0, lora=None):
         from ..core.autograd import no_grad
 
         with substituted_state(self.model, params), no_grad():
-            if lora is None:
-                logits, caches = self.model.forward_with_cache(
-                    Tensor(ids), caches, pos)
-            else:
-                logits, caches = self.model.forward_with_cache(
-                    Tensor(ids), caches, pos, lora=lora)
+            logits, caches = self.model.forward_with_cache(
+                Tensor(ids), caches, pos, **self._fwd_kwargs(lora))
         return (logits.value if isinstance(logits, Tensor) else logits,
                 caches)
 
@@ -935,12 +1010,9 @@ class ContinuousBatchingEngine:
         from ..core.autograd import no_grad
 
         with substituted_state(self.model, params), no_grad():
-            if lora is None:
-                logits, caches = self.model.forward_decode_ragged(
-                    Tensor(tok), caches, lens, live)
-            else:
-                logits, caches = self.model.forward_decode_ragged(
-                    Tensor(tok), caches, lens, live, lora=lora)
+            logits, caches = self.model.forward_decode_ragged(
+                Tensor(tok), caches, lens, live,
+                **self._fwd_kwargs(lora))
         return (logits.value if isinstance(logits, Tensor) else logits,
                 caches)
 
@@ -970,7 +1042,16 @@ class ContinuousBatchingEngine:
         replica selection."""
         out = {"free_slots": len(self._free),
                "active_slots": len(self._slot_req),
-               "max_batch": self.max_batch}
+               "max_batch": self.max_batch,
+               "tp_degree": self.tp_degree}
+        if self.tp_mesh is not None:
+            # mesh-shape surface for /healthz + routers: host-side
+            # metadata only (the Mesh object is static), no device sync
+            out["tp"] = {
+                "degree": self.tp_degree,
+                "axis": self.tp_mesh.axis_names[0],
+                "devices": [str(d)
+                            for d in self.tp_mesh.devices.flat]}
         alloc = getattr(self, "alloc", None)
         if alloc is not None:
             out["free_pages"] = alloc.free_pages
@@ -1180,7 +1261,7 @@ class ContinuousBatchingEngine:
         slot's cache; returns the prompt's last-position logits. The
         dense base scatters a max_len mini cache; the paged subclass
         reserves pages and scatters a bucket-sized one."""
-        mini = self.model.init_cache(1, self.max_len)
+        mini = self._mini_cache(self.max_len)
         last_logits, mini = self._run_prefill(
             ids, plen, mini, aidx=self._aidx_stash.get(slot, 0))
         self._install_mini(slot, mini, plen)
@@ -1401,7 +1482,7 @@ class ContinuousBatchingEngine:
         override maps cached prefix pages first and starts chunking
         past them."""
         self._reserve_admit(slot, plen, cfg)
-        return self.model.init_cache(1, self.max_len), 0
+        return self._mini_cache(self.max_len), 0
 
     def admit_chunk(self, adm: _ChunkedAdmission) -> bool:
         """Run ONE fixed-shape prefill chunk of an admission started
@@ -1492,7 +1573,7 @@ class ContinuousBatchingEngine:
             out[f"prefill_{w}"] = time.perf_counter() - t0
         if self.prefill_chunk is not None:
             t0 = time.perf_counter()
-            mini = self.model.init_cache(1, self.max_len)
+            mini = self._mini_cache(self.max_len)
             self._prefill_chunk(self.params,
                                 np.zeros((1, self.prefill_chunk),
                                          np.int32),
@@ -1550,7 +1631,7 @@ class ContinuousBatchingEngine:
     def _warmup_mini(self, width: int):
         """Mini cache matching what an admission of a width-token prompt
         allocates (dense: the max_len slab; paged: bucket-sized)."""
-        return self.model.init_cache(1, self.max_len)
+        return self._mini_cache(self.max_len)
 
     def _warmup_prefix(self) -> dict:
         """Pre-compile the prefix-cache warm-admission programs (paged
@@ -1602,12 +1683,9 @@ class ContinuousBatchingEngine:
         from ..core.autograd import no_grad
 
         with substituted_state(self.model, params), no_grad():
-            if lora is None:
-                logits, caches = self.model.forward_decode_spec(
-                    Tensor(inp), caches, lens, live)
-            else:
-                logits, caches = self.model.forward_decode_spec(
-                    Tensor(inp), caches, lens, live, lora=lora)
+            logits, caches = self.model.forward_decode_spec(
+                Tensor(inp), caches, lens, live,
+                **self._fwd_kwargs(lora))
         return (logits.value if isinstance(logits, Tensor) else logits,
                 caches)
 
@@ -2132,7 +2210,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  kv_dtype: str = "bf16",
                  draft_k: int = 0, ngram_max: int = 3,
                  lora_capacity: int = 0, lora_rank: int = 8,
-                 lora_targets=("q", "k", "v", "o")):
+                 lora_targets=("q", "k", "v", "o"),
+                 tp_degree: int = 1, tp_devices=None):
         from ..quantization.kv import KV_DTYPES
         from .paged_cache import PageAllocator
 
@@ -2185,7 +2264,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                          draft_k=draft_k, ngram_max=ngram_max,
                          lora_capacity=lora_capacity,
                          lora_rank=lora_rank,
-                         lora_targets=lora_targets)
+                         lora_targets=lora_targets,
+                         tp_degree=tp_degree, tp_devices=tp_devices)
         self._measure_quant_savings()
 
         def reset_scales(pools, mask):
@@ -2206,6 +2286,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             reset_scales, name="cb_reset_scales", donate_argnums=(0,))
 
     def _make_caches(self):
+        # TP: pools (and int8 scales) shard on the kv-head axis; the
+        # page TABLE replicates — page indices are mesh-invariant, so
+        # the allocator/prefix-cache host logic needs no fork
         if self.kv_dtype == "int8":
             try:
                 pools = self.model.init_paged_cache(
@@ -2215,10 +2298,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     f"kv_dtype='int8' needs a model whose "
                     f"init_paged_cache accepts kv_dtype (llama does); "
                     f"{type(self.model).__name__} does not") from e
-            return pools, jnp.asarray(self.alloc.page_table)
-        return (self.model.init_paged_cache(self.num_pages,
-                                            self.page_size),
-                jnp.asarray(self.alloc.page_table))
+            return (self._tp_kv(pools),
+                    self._tp_rep(jnp.asarray(self.alloc.page_table)))
+        return (self._tp_kv(self.model.init_paged_cache(
+                    self.num_pages, self.page_size)),
+                self._tp_rep(jnp.asarray(self.alloc.page_table)))
 
     def _measure_quant_savings(self) -> None:
         """Price the int8 layout from the REAL pool arrays: HBM bytes
@@ -2307,12 +2391,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         pools, pt = caches
         with substituted_state(self.model, params), no_grad():
-            if lora is None:
-                logits, pools = self.model.forward_decode_paged(
-                    Tensor(tok), pools, pt, lens, live)
-            else:
-                logits, pools = self.model.forward_decode_paged(
-                    Tensor(tok), pools, pt, lens, live, lora=lora)
+            logits, pools = self.model.forward_decode_paged(
+                Tensor(tok), pools, pt, lens, live,
+                **self._fwd_kwargs(lora))
         return (logits.value if isinstance(logits, Tensor) else logits,
                 (pools, pt))
 
@@ -2321,12 +2402,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         pools, pt = caches
         with substituted_state(self.model, params), no_grad():
-            if lora is None:
-                logits, pools = self.model.forward_decode_spec_paged(
-                    Tensor(inp), pools, pt, lens, live)
-            else:
-                logits, pools = self.model.forward_decode_spec_paged(
-                    Tensor(inp), pools, pt, lens, live, lora=lora)
+            logits, pools = self.model.forward_decode_spec_paged(
+                Tensor(inp), pools, pt, lens, live,
+                **self._fwd_kwargs(lora))
         return (logits.value if isinstance(logits, Tensor) else logits,
                 (pools, pt))
 
@@ -2410,7 +2488,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # point; the bucket keys the compiled program count to
         # O(len(buckets))), then scatter the prompt's KV rows into
         # freshly reserved pages
-        mini = self.model.init_cache(1, self._prefill_width(plen))
+        mini = self._mini_cache(self._prefill_width(plen))
         last_logits, mini = self._run_prefill(
             ids, plen, mini, aidx=self._aidx_stash.get(slot, 0))
         self._reserve_admit(slot, plen, cfg)
@@ -2461,7 +2539,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # not the raw coverage — the clamp above shrinks it
         self._prefix_stash[slot]["saved"] = c_cmp
         tail = plen - c_cmp
-        mini = self.model.init_cache(1, self.max_len)
+        mini = self._mini_cache(self.max_len)
         mini = self._gather_mini(mini, pids)
         self._count_prefill("warm")
         if trace.enabled():
@@ -2557,7 +2635,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             # overwrite, or on unmapped pages where write_tokens drops
             # them
             width = min(self._prefill_width(plen), mini[0][0].shape[1])
-            pt = jnp.asarray(self.alloc.page_table)
+            pt = self._tp_rep(jnp.asarray(self.alloc.page_table))
             slots_v = jnp.full((width,), slot, jnp.int32)
             pos_v = jnp.arange(width, dtype=jnp.int32)
             pools, _ = self.caches
@@ -2610,7 +2688,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         p0 = c_map if c_map < plen else plen
         if p0 % ps and self.alloc.needs_cow(slot, p0):
             self._cow_page(slot, p0 // ps)
-        pt = jnp.asarray(self.alloc.page_table)
+        pt = self._tp_rep(jnp.asarray(self.alloc.page_table))
         if c_map < plen:
             mini_len = mini[0][0].shape[1]
             width = (plen - c_map if self.prefill_buckets is None
@@ -2642,7 +2720,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self.caches = (pools, pt)
 
     def _warmup_mini(self, width: int):
-        return self.model.init_cache(1, width)
+        return self._mini_cache(width)
 
     def _begin_admit_cache(self, slot: int, ids, plen: int, cfg):
         if not self.prefix_cache:
@@ -2667,7 +2745,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         p0 = c_map if c_map < plen else plen
         if p0 % self.page_size and self.alloc.needs_cow(slot, p0):
             self._cow_page(slot, p0 // self.page_size)
-        mini = self.model.init_cache(1, self.max_len)
+        mini = self._mini_cache(self.max_len)
         if pids:
             # full cached coverage gathered (fixed-shape program);
             # rows the chunks recompute from `start` just overwrite
@@ -2698,8 +2776,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         quant = self.kv_dtype == "int8"
         t0 = time.perf_counter()
-        mini = self._gather_mini(self.model.init_cache(1, self.max_len),
-                                 [])
+        mini = self._gather_mini(self._mini_cache(self.max_len), [])
         pools, pt = self.caches
         new_pools = []
         for entry in pools:
@@ -2711,7 +2788,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                            jnp.int32(0)))
         self.caches = (new_pools, pt)
         out["prefix_gather_copy"] = time.perf_counter() - t0
-        pt_dev = jnp.asarray(self.alloc.page_table)
+        pt_dev = self._tp_rep(jnp.asarray(self.alloc.page_table))
         for w in (self.prefill_buckets or ()):
             t0 = time.perf_counter()
             _, mini = self._prefill_chunk(
@@ -2920,5 +2997,6 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     slot, int(lens[slot]),
                     write_ahead=1 + self._spec_k_of(rid))
         pools, _ = self.caches
-        self.caches = (pools, jnp.asarray(self.alloc.page_table))
+        self.caches = (pools,
+                       self._tp_rep(jnp.asarray(self.alloc.page_table)))
         return super().decode_segment(n_steps, cfg)

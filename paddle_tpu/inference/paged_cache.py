@@ -28,6 +28,17 @@ page ALLOCATION is host-side Python between jitted segments (the free
 list is plain state, like the engine's slot free list); page READS and
 token WRITES are pure jittable functions of (pools, page_table) so they
 ride inside compiled segment programs.
+
+TENSOR PARALLELISM (engine ``tp_degree=k``, see ``inference/tp.py``)
+is invisible here BY CONSTRUCTION: pools shard on the kv-HEAD axis
+(axis 2; int8 scales on axis 1), never on the page axis, so a page id
+means "the same row of every shard's local pool slice" — the page
+table replicates, and every function in this module (write/scatter/
+copy/gather and all PageAllocator bookkeeping: refcounts, chain
+hashes, CoW, LRU parking, ``check()``) runs UNMODIFIED under GSPMD
+with head-sharded operands. Do not add per-shard branches to this
+file; anything that would need one belongs in the attention ops'
+shard_map wrap instead.
 """
 from __future__ import annotations
 

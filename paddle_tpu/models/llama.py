@@ -189,7 +189,7 @@ class LlamaAttention(Layer):
         return _lora_add(ctx, self.o_proj(ctx), lora, "o")
 
     def forward_with_cache(self, x, cos_full, sin_full, cache, pos,
-                           lora=None):
+                           lora=None, tp=None):
         """Serving path: attend over a preallocated KV cache.
 
         x: [B, S, h] (S>1 = prefill, S==1 = decode); cache: (k, v) jnp
@@ -198,7 +198,12 @@ class LlamaAttention(Layer):
         masked_multihead_attention analog (reference
         fused_multi_transformer_op.cu.h:745); prefill uses the flash path.
         ``lora`` (here and on every decode variant below) is the
-        per-row batched-adapter input — see :func:`_lora_add`.
+        per-row batched-adapter input — see :func:`_lora_add`;
+        ``tp`` is the serving engine's tensor-parallel handle
+        ``(mesh, axis)`` (see ``inference/tp.py``) — threaded into the
+        attention ops' shard_map wrap so each mesh shard runs the
+        kernel on its local head slice (None = single-device trace,
+        byte-identical to pre-TP).
         """
         b, s = x.shape[0], x.shape[1]
         hd = self.config.head_dim
@@ -221,14 +226,15 @@ class LlamaAttention(Layer):
                 from ..ops._decode import gqa_decode_attention
 
                 ctx = gqa_decode_attention(
-                    qh[:, 0], kc, vc, lens)[:, None]      # [B, 1, Hq, hd]
+                    qh[:, 0], kc, vc, lens,
+                    tp=tp)[:, None]                       # [B, 1, Hq, hd]
             elif isinstance(pos, int) and pos == 0:
                 # fresh prefill (the generation engine's case): plain causal
                 # flash over just the prompt — attending the full
                 # preallocated cache width would cost max_len/s extra work
                 from ..ops.pallas import flash_attention as _flash
 
-                ctx = _flash(qh, kh, vh, causal=True)
+                ctx = _flash(qh, kh, vh, causal=True, tp=tp)
             else:
                 # chunked prefill / spec-verify at a traced offset: the
                 # online-softmax prefix attention shares its reduction
@@ -237,7 +243,7 @@ class LlamaAttention(Layer):
                 # bitwise (ops/pallas.prefix_chunk_attention)
                 from ..ops.pallas import prefix_chunk_attention
 
-                ctx = prefix_chunk_attention(qh, kc, vc, pos)
+                ctx = prefix_chunk_attention(qh, kc, vc, pos, tp=tp)
             return ctx.reshape(b, s, self.num_heads * hd), kc, vc
 
         ctx, kc, vc = apply_op(attend, q, k, v, k_cache, v_cache,
@@ -246,7 +252,7 @@ class LlamaAttention(Layer):
         return self._o_lora(ctx, lora), (val(kc), val(vc))
 
     def forward_decode_ragged(self, x, cos_full, sin_full, cache, lens,
-                              live, lora=None):
+                              live, lora=None, tp=None):
         """Ragged decode step: mixed-length rows, padding-free semantics.
 
         x: [B, 1, h]; lens: [B] int32 tokens already in each ROW's cache
@@ -285,7 +291,7 @@ class LlamaAttention(Layer):
             from ..ops._decode import gqa_decode_attention
 
             ctx = gqa_decode_attention(
-                qh, kc, vc, lens + live.astype(jnp.int32))
+                qh, kc, vc, lens + live.astype(jnp.int32), tp=tp)
             return ctx.reshape(b, 1, self.num_heads * hd), kc, vc
 
         ctx, kc, vc = apply_op(attend, q, k, v, kc0, vc0,
@@ -294,7 +300,7 @@ class LlamaAttention(Layer):
         return self._o_lora(ctx, lora), (val(kc), val(vc))
 
     def forward_decode_spec(self, x, cos_full, sin_full, cache, lens,
-                            live, lora=None):
+                            live, lora=None, tp=None):
         """Speculative VERIFY step over the dense ragged cache: W query
         positions per row at per-row offsets (x: [B, W, h]; position i
         of row b sits at absolute position ``lens[b] + i``).
@@ -349,7 +355,7 @@ class LlamaAttention(Layer):
             # reduce bitwise-identically to the one-token path
             ctx = jnp.stack(
                 [gqa_decode_attention(qh[:, i], kc, vc,
-                                      lens + lv * (i + 1))
+                                      lens + lv * (i + 1), tp=tp)
                  for i in range(w)], axis=1)       # [B, W, Hq, hd]
             return ctx.reshape(b, w, self.num_heads * hd), kc, vc
 
@@ -359,7 +365,8 @@ class LlamaAttention(Layer):
         return self._o_lora(ctx, lora), (val(kc), val(vc))
 
     def forward_decode_spec_paged(self, x, cos_full, sin_full, cache,
-                                  page_table, lens, live, lora=None):
+                                  page_table, lens, live, lora=None,
+                                  tp=None):
         """Paged twin of :meth:`forward_decode_spec`: W per-row query
         positions over the shared page pool. Writes to dead rows,
         unmapped pages, or positions past the table width are DROPPED
@@ -400,7 +407,7 @@ class LlamaAttention(Layer):
             lv = live.astype(jnp.int32)
             ctx = jnp.stack(
                 [paged_decode_mha(qh[:, i], kp, vp, page_table,
-                                  lens + lv * (i + 1))
+                                  lens + lv * (i + 1), tp=tp)
                  for i in range(w)], axis=1)
             return ctx.reshape(b, w, self.num_heads * hd), kp, vp
 
@@ -424,7 +431,7 @@ class LlamaAttention(Layer):
             lv = live.astype(jnp.int32)
             ctx = jnp.stack(
                 [paged_decode_mha(qh[:, i], kp, vp, page_table,
-                                  lens + lv * (i + 1), ks, vs)
+                                  lens + lv * (i + 1), ks, vs, tp=tp)
                  for i in range(w)], axis=1)
             return (ctx.reshape(b, w, self.num_heads * hd), kp, vp,
                     ks, vs)
@@ -441,7 +448,8 @@ class LlamaAttention(Layer):
         return self._o_lora(ctx, lora), (val(kp), val(vp))
 
     def forward_decode_paged(self, x, cos_full, sin_full, cache,
-                             page_table, lens, live, lora=None):
+                             page_table, lens, live, lora=None,
+                             tp=None):
         """Paged decode step: like forward_decode_ragged but the KV cache
         is this layer's slice of a shared page pool (ops/paged_attention
         + inference/paged_cache — the vLLM-style serving layout the
@@ -477,7 +485,8 @@ class LlamaAttention(Layer):
             from ..ops.paged_attention import paged_decode_mha
 
             ctx = paged_decode_mha(qh, kp, vp, page_table,
-                                   lens + live.astype(jnp.int32))
+                                   lens + live.astype(jnp.int32),
+                                   tp=tp)
             return ctx.reshape(b, 1, self.num_heads * hd), kp, vp
 
         def attend_q(qv, kv, vv, kp, vp, ks, vs):
@@ -493,7 +502,7 @@ class LlamaAttention(Layer):
             vp, vs = quant_store_rows(vp, vs, page, offs, vh)
             ctx = paged_decode_mha(qh, kp, vp, page_table,
                                    lens + live.astype(jnp.int32),
-                                   ks, vs)
+                                   ks, vs, tp=tp)
             return (ctx.reshape(b, 1, self.num_heads * hd), kp, vp,
                     ks, vs)
 
@@ -576,46 +585,48 @@ class LlamaDecoderLayer(Layer):
         return constraint(x, P("dp", None, None))
 
     def forward_with_cache(self, x, cos_full, sin_full, cache, pos,
-                           lora=None):
+                           lora=None, tp=None):
         attn, cache = self.self_attn.forward_with_cache(
             self.input_layernorm(x), cos_full, sin_full, cache, pos,
-            lora=lora)
+            lora=lora, tp=tp)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
 
     def forward_decode_ragged(self, x, cos_full, sin_full, cache, lens,
-                              live, lora=None):
+                              live, lora=None, tp=None):
         attn, cache = self.self_attn.forward_decode_ragged(
             self.input_layernorm(x), cos_full, sin_full, cache, lens,
-            live, lora=lora)
+            live, lora=lora, tp=tp)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
 
     def forward_decode_paged(self, x, cos_full, sin_full, cache,
-                             page_table, lens, live, lora=None):
+                             page_table, lens, live, lora=None,
+                             tp=None):
         attn, cache = self.self_attn.forward_decode_paged(
             self.input_layernorm(x), cos_full, sin_full, cache,
-            page_table, lens, live, lora=lora)
+            page_table, lens, live, lora=lora, tp=tp)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
 
     def forward_decode_spec(self, x, cos_full, sin_full, cache, lens,
-                            live, lora=None):
+                            live, lora=None, tp=None):
         attn, cache = self.self_attn.forward_decode_spec(
             self.input_layernorm(x), cos_full, sin_full, cache, lens,
-            live, lora=lora)
+            live, lora=lora, tp=tp)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
 
     def forward_decode_spec_paged(self, x, cos_full, sin_full, cache,
-                                  page_table, lens, live, lora=None):
+                                  page_table, lens, live, lora=None,
+                                  tp=None):
         attn, cache = self.self_attn.forward_decode_spec_paged(
             self.input_layernorm(x), cos_full, sin_full, cache,
-            page_table, lens, live, lora=lora)
+            page_table, lens, live, lora=lora, tp=tp)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return x, cache
@@ -660,7 +671,8 @@ class LlamaModel(Layer):
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in range(cfg.num_hidden_layers)]
 
-    def forward_with_cache(self, input_ids, caches, pos, lora=None):
+    def forward_with_cache(self, input_ids, caches, pos, lora=None,
+                           tp=None):
         cfg = self.config
         x = self.embed_tokens(input_ids)
         max_len = caches[0][0].shape[1]
@@ -671,12 +683,12 @@ class LlamaModel(Layer):
         for i, (layer, cache) in enumerate(zip(self.layers, caches)):
             x, cache = layer.forward_with_cache(
                 x, cos_full, sin_full, cache, pos,
-                lora=_lora_layer(lora, i))
+                lora=_lora_layer(lora, i), tp=tp)
             new_caches.append(cache)
         return self.norm(x), new_caches
 
     def forward_decode_ragged(self, input_ids, caches, lens, live,
-                              lora=None):
+                              lora=None, tp=None):
         cfg = self.config
         x = self.embed_tokens(input_ids)
         max_len = caches[0][0].shape[1]
@@ -687,7 +699,7 @@ class LlamaModel(Layer):
         for i, (layer, cache) in enumerate(zip(self.layers, caches)):
             x, cache = layer.forward_decode_ragged(
                 x, cos_full, sin_full, cache, lens, live,
-                lora=_lora_layer(lora, i))
+                lora=_lora_layer(lora, i), tp=tp)
             new_caches.append(cache)
         return self.norm(x), new_caches
 
@@ -719,7 +731,7 @@ class LlamaModel(Layer):
                 for _ in range(cfg.num_hidden_layers)]
 
     def forward_decode_paged(self, input_ids, caches, page_table, lens,
-                             live, lora=None):
+                             live, lora=None, tp=None):
         cfg = self.config
         x = self.embed_tokens(input_ids)
         max_len = page_table.shape[1] * caches[0][0].shape[1]
@@ -730,12 +742,12 @@ class LlamaModel(Layer):
         for i, (layer, cache) in enumerate(zip(self.layers, caches)):
             x, cache = layer.forward_decode_paged(
                 x, cos_full, sin_full, cache, page_table, lens, live,
-                lora=_lora_layer(lora, i))
+                lora=_lora_layer(lora, i), tp=tp)
             new_caches.append(cache)
         return self.norm(x), new_caches
 
     def forward_decode_spec(self, input_ids, caches, lens, live,
-                            lora=None):
+                            lora=None, tp=None):
         """Speculative verify step (dense ragged cache): input_ids
         [B, W] at per-row offsets ``lens`` — see
         LlamaAttention.forward_decode_spec."""
@@ -749,12 +761,12 @@ class LlamaModel(Layer):
         for i, (layer, cache) in enumerate(zip(self.layers, caches)):
             x, cache = layer.forward_decode_spec(
                 x, cos_full, sin_full, cache, lens, live,
-                lora=_lora_layer(lora, i))
+                lora=_lora_layer(lora, i), tp=tp)
             new_caches.append(cache)
         return self.norm(x), new_caches
 
     def forward_decode_spec_paged(self, input_ids, caches, page_table,
-                                  lens, live, lora=None):
+                                  lens, live, lora=None, tp=None):
         """Speculative verify step over the page pool — see
         LlamaAttention.forward_decode_spec_paged."""
         cfg = self.config
@@ -767,7 +779,7 @@ class LlamaModel(Layer):
         for i, (layer, cache) in enumerate(zip(self.layers, caches)):
             x, cache = layer.forward_decode_spec_paged(
                 x, cos_full, sin_full, cache, page_table, lens, live,
-                lora=_lora_layer(lora, i))
+                lora=_lora_layer(lora, i), tp=tp)
             new_caches.append(cache)
         return self.norm(x), new_caches
 
@@ -840,21 +852,22 @@ class LlamaForCausalLM(Layer):
                 f"{sorted(dims)}")
         return cfg.num_hidden_layers, {t: dims[t] for t in targets}
 
-    def forward_with_cache(self, input_ids, caches, pos, lora=None):
+    def forward_with_cache(self, input_ids, caches, pos, lora=None,
+                           tp=None):
         """(logits_of_last_positions, new_caches) — the serving forward.
         ``lora`` (every serving forward below too) is the optional
         batched-adapter input ``(bank, adapter_idx)`` —
         see :func:`_lora_add`."""
         hidden, caches = self.model.forward_with_cache(
-            input_ids, caches, pos, lora=lora)
+            input_ids, caches, pos, lora=lora, tp=tp)
         return self.logits(hidden), caches
 
     def forward_decode_ragged(self, input_ids, caches, lens, live,
-                              lora=None):
+                              lora=None, tp=None):
         """(logits [B, 1, V], new_caches) — the mixed-length decode step
         (per-row positions; see LlamaAttention.forward_decode_ragged)."""
         hidden, caches = self.model.forward_decode_ragged(
-            input_ids, caches, lens, live, lora=lora)
+            input_ids, caches, lens, live, lora=lora, tp=tp)
         return self.logits(hidden), caches
 
     def init_paged_cache(self, num_pages: int, page_size: int,
@@ -863,25 +876,27 @@ class LlamaForCausalLM(Layer):
                                            kv_dtype=kv_dtype)
 
     def forward_decode_paged(self, input_ids, caches, page_table, lens,
-                             live, lora=None):
+                             live, lora=None, tp=None):
         """(logits [B, 1, V], new_caches) — paged decode step (page-pool
         KV; see LlamaAttention.forward_decode_paged)."""
         hidden, caches = self.model.forward_decode_paged(
-            input_ids, caches, page_table, lens, live, lora=lora)
+            input_ids, caches, page_table, lens, live, lora=lora,
+            tp=tp)
         return self.logits(hidden), caches
 
     def forward_decode_spec(self, input_ids, caches, lens, live,
-                            lora=None):
+                            lora=None, tp=None):
         """(logits [B, W, V], new_caches) — batched speculative verify
         step at per-row offsets (dense ragged cache)."""
         hidden, caches = self.model.forward_decode_spec(
-            input_ids, caches, lens, live, lora=lora)
+            input_ids, caches, lens, live, lora=lora, tp=tp)
         return self.logits(hidden), caches
 
     def forward_decode_spec_paged(self, input_ids, caches, page_table,
-                                  lens, live, lora=None):
+                                  lens, live, lora=None, tp=None):
         """(logits [B, W, V], new_caches) — batched speculative verify
         step over the page pool."""
         hidden, caches = self.model.forward_decode_spec_paged(
-            input_ids, caches, page_table, lens, live, lora=lora)
+            input_ids, caches, page_table, lens, live, lora=lora,
+            tp=tp)
         return self.logits(hidden), caches

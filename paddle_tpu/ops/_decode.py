@@ -24,10 +24,26 @@ def _on_tpu() -> bool:
         return False
 
 
-def gqa_decode_attention(q, k_cache, v_cache, seq_lens):
+def gqa_decode_attention(q, k_cache, v_cache, seq_lens, tp=None):
     """q: [B, Hq, D]; k/v_cache: [B, S, Hkv, D]; seq_lens: [B] valid rows
     (the current token's K/V already written at seq_lens-1).
-    Returns [B, Hq, D] in q's dtype."""
+    Returns [B, Hq, D] in q's dtype.
+
+    ``tp=(mesh, axis)`` wraps the step in ``shard_map`` over the head
+    axis (q on Hq, caches on Hkv, lens replicated): attention is
+    head-parallel, so each mesh shard runs this exact function on its
+    local slice with zero communication — the tensor-parallel serving
+    engines' dense-cache decode path (see ``inference/tp.py``)."""
+    if tp is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh, ax = tp
+        head, kv = P(None, ax, None), P(None, None, ax, None)
+        return shard_map(
+            lambda q_, k_, v_, l_: gqa_decode_attention(q_, k_, v_, l_),
+            mesh=mesh, in_specs=(head, kv, kv, P()), out_specs=head,
+            check_rep=False)(q, k_cache, v_cache, seq_lens)
     b, hq, d = q.shape
     s_max, hkv = k_cache.shape[1], k_cache.shape[2]
     if hq == hkv and _on_tpu():

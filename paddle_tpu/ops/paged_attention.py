@@ -160,9 +160,10 @@ def _paged_decode_ref(q, k_pool, v_pool, page_table, seq_lens,
     return jnp.einsum("blh,blhd->bhd", p, v).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "tp"))
 def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
-                     k_scale=None, v_scale=None, interpret=None):
+                     k_scale=None, v_scale=None, interpret=None,
+                     tp=None):
     """Single-step decode attention over a paged KV pool.
 
     q: [B, Hq, D] (this step's query)
@@ -178,10 +179,35 @@ def paged_decode_mha(q, k_pool, v_pool, page_table, seq_lens,
         or neither. Dequant fuses into the kernel after the page DMA,
         so the HBM read stays int8 (the bandwidth win quantized KV
         exists for); the output is f32-accumulated either way.
+    tp: tensor-parallel handle ``(mesh, axis)`` (static) — wraps the
+        kernel in ``shard_map`` over the head axis: q shards on Hq,
+        pools (and scales) on Hkv, table/lens replicate, and each mesh
+        shard runs the UNMODIFIED kernel on its local head slice (pages
+        are never split, so the page-table indirection is per-shard
+        identical). Zero communication inside attention; on TPU this is
+        what keeps the sharded pools' HBM win real — without it the
+        Mosaic custom call would force an all-gather of the pool every
+        decode step.
     Returns [B, H, D].
     """
     if (k_scale is None) != (v_scale is None):
         raise ValueError("pass both k_scale and v_scale or neither")
+    if tp is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh, ax = tp
+        head, pool, sc = (P(None, ax, None), P(None, None, ax, None),
+                          P(None, ax))
+        operands = [q, k_pool, v_pool, page_table, seq_lens]
+        in_specs = [head, pool, pool, P(), P()]
+        if k_scale is not None:
+            operands += [k_scale, v_scale]
+            in_specs += [sc, sc]
+        return shard_map(
+            lambda *a: paged_decode_mha(*a, interpret=interpret),
+            mesh=mesh, in_specs=tuple(in_specs), out_specs=head,
+            check_rep=False)(*operands)
     if pltpu is None:
         # the scalar-prefetch grid spec needs jax.experimental.pallas
         # .tpu even in interpret mode — fall back to the dense-gather

@@ -95,8 +95,14 @@ def _chunked_attention(q, k, v, causal: bool, sm_scale: float,
 
 
 def flash_attention(q, k, v, causal: bool = False, sm_scale: float = None,
-                    dropout_p: float = 0.0, seed=None):
+                    dropout_p: float = 0.0, seed=None, tp=None):
     """[B, S, H, D] paddle layout; GQA allowed (K/V may carry fewer heads).
+
+    ``tp=(mesh, axis)`` shard_maps the whole call over the head axis
+    (q on H, k/v on their own Hkv) — the tensor-parallel serving
+    engines' prefill path: each mesh shard runs the unmodified
+    kernel/fallback on its local head slice, zero attention-side
+    communication (see ``inference/tp.py``).
 
     TPU: this framework's own Pallas flash kernel
     (ops/flash_attention_kernel.py — reference analog:
@@ -105,6 +111,18 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: float = None,
     Unsupported shapes / non-TPU: chunked online-softmax XLA fallback
     (dropout not available there — callers route dropout elsewhere).
     """
+    if tp is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh, ax = tp
+        hs = P(None, None, ax, None)
+        return shard_map(
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=causal, sm_scale=sm_scale,
+                dropout_p=dropout_p, seed=seed),
+            mesh=mesh, in_specs=(hs, hs, hs), out_specs=hs,
+            check_rep=False)(q, k, v)
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
 
@@ -146,10 +164,15 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: float = None,
     return jnp.swapaxes(out, 1, 2)
 
 
-def prefix_chunk_attention(q, k_cache, v_cache, pos, sm_scale: float = None):
+def prefix_chunk_attention(q, k_cache, v_cache, pos, sm_scale: float = None,
+                           tp=None):
     """Chunked/padded-prefill attention: queries at ABSOLUTE positions
     ``[pos, pos+S)`` attend causally over the written prefix of a KV
     cache (the chunk's own K/V already written at ``[pos, pos+S)``).
+
+    ``tp=(mesh, axis)`` shard_maps the recurrence over the head axis
+    (``pos`` replicates) — the tensor-parallel chunked-prefill /
+    warm-admission / spec-verify path (see ``inference/tp.py``).
 
     q: [B, S, H, D]; k/v_cache: [B, W, Hkv, D] (GQA allowed); pos: traced
     int32 scalar. Returns [B, S, H, D] in q's dtype.
@@ -165,6 +188,17 @@ def prefix_chunk_attention(q, k_cache, v_cache, pos, sm_scale: float = None):
     compiled program per (chunk shape, cache width), reused at every
     offset, instead of one per distinct prompt length.
     """
+    if tp is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh, ax = tp
+        hs = P(None, None, ax, None)
+        return shard_map(
+            lambda q_, k_, v_, p_: prefix_chunk_attention(
+                q_, k_, v_, p_, sm_scale=sm_scale),
+            mesh=mesh, in_specs=(hs, hs, hs, P()), out_specs=hs,
+            check_rep=False)(q, k_cache, v_cache, pos)
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     qt = jnp.swapaxes(q, 1, 2)          # [B, H, S, D]

@@ -125,19 +125,41 @@ class ReplicaSpec:
     ``server_kwargs={"kv_dtype": "int8"}`` builds every replica on
     quantized KV pages (greedy failover replay stays exact across the
     fleet: identical weights + identical quantization make every
-    replica's bounded numerics the SAME numerics)."""
+    replica's bounded numerics the SAME numerics).
+
+    ``devices`` pins THIS replica to a device subset (ints index
+    ``jax.devices()``; device objects pass through): the factory is
+    then called as ``engine_factory(devices)`` and owns forwarding
+    them (a tensor-parallel engine passes ``tp_devices=devices``), so
+    an N-replica × TP-k fleet partitions one slice — scale-up per
+    replica × scale-out across replicas in one topology — instead of
+    every replica claiming device 0::
+
+        devs = jax.devices()
+        specs = [ReplicaSpec(make_tp_engine, devices=devs[2*i:2*i+2])
+                 for i in range(4)]        # 4 replicas × TP=2 on 8 chips
+        router = Router(specs)
+    """
 
     def __init__(self, engine_factory, server_kwargs: Optional[dict]
-                 = None):
+                 = None, devices: Optional[Sequence] = None):
         if not callable(engine_factory):
             raise ValueError("engine_factory must be callable "
                              f"(got {engine_factory!r})")
         self.engine_factory = engine_factory
         self.server_kwargs = dict(server_kwargs or {})
+        self.devices = None if devices is None else list(devices)
+        if self.devices is not None and not self.devices:
+            raise ValueError("devices must be a non-empty sequence "
+                             "or None (any device)")
 
     def build(self) -> Server:
-        """Build (and start) one fresh replica Server."""
-        return Server(self.engine_factory(), **self.server_kwargs)
+        """Build (and start) one fresh replica Server. With ``devices``
+        pinned the factory is called with them — every supervised
+        rebuild of this replica lands back on ITS device subset."""
+        eng = (self.engine_factory(self.devices)
+               if self.devices is not None else self.engine_factory())
+        return Server(eng, **self.server_kwargs)
 
 
 class RouterHandle(RequestHandle):
@@ -505,6 +527,11 @@ class Router:
                           "free_slots", "free_pages", "occupancy")
                          if k in snap},
             }
+            if "tp" in snap:
+                # mesh shape per replica: fleet /healthz shows how a
+                # scale-up (TP) x scale-out (replicas) topology
+                # partitions the slice
+                entry["tp"] = snap["tp"]
             dumps = []
             try:
                 dumps = rep.server.flight_dumps

@@ -261,7 +261,8 @@ def _toy_engine(args, speculative: bool = False):
     from paddle_tpu.models import LlamaForCausalLM, llama_config
 
     paddle.seed(0)
-    cfg = llama_config("tiny", num_hidden_layers=args.layers)
+    cfg = llama_config(getattr(args, "preset", "tiny"),
+                       num_hidden_layers=args.layers)
     model = LlamaForCausalLM(cfg)
     if args.prefill_buckets == "auto":
         buckets = "auto"
@@ -282,7 +283,8 @@ def _toy_engine(args, speculative: bool = False):
         lora_rank=args.lora_rank,
         lora_targets=tuple(t.strip()
                            for t in args.lora_targets.split(",")
-                           if t.strip()))
+                           if t.strip()),
+        tp_degree=getattr(args, "tp", 1))
     return eng, cfg.vocab_size
 
 
@@ -443,6 +445,24 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     # in-process toy engine knobs
     ap.add_argument("--layers", type=int, default=1)
+    ap.add_argument("--preset", default="tiny",
+                    help="llama_config preset for the in-process toy "
+                         "engine (tiny default; 13b/65b are the "
+                         "memory-fit configs a TP mesh exists to "
+                         "serve — MEMORY_CONFIG3.json)")
+    ap.add_argument("--tp", type=int, default=1, metavar="N",
+                    help="tensor-parallel degree of the in-process "
+                         "engine: weights + KV pools shard over an "
+                         "N-device 'mp' mesh (CPU CI: force devices "
+                         "with XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8)")
+    ap.add_argument("--tp-ab", action="store_true",
+                    help="A/B mode: run the SAME pre-drawn load "
+                         "through a TP=1 engine then a TP=N engine "
+                         "(N from --tp, default 2) and report "
+                         "serve_tp_tpot_speedup + "
+                         "serve_tp_max_model_bytes (the HBM capacity "
+                         "a TP=N mesh adds at fixed per-chip memory)")
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--num-pages", type=int, default=48)
     ap.add_argument("--page-size", type=int, default=8)
@@ -624,9 +644,17 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     if sum([args.spec_ab, args.trace_ab, args.kv_ab,
-            args.lora_ab]) > 1:
-        print("--spec-ab/--trace-ab/--kv-ab/--lora-ab are separate "
-              "A/Bs; run them one at a time", file=sys.stderr)
+            args.lora_ab, args.tp_ab]) > 1:
+        print("--spec-ab/--trace-ab/--kv-ab/--lora-ab/--tp-ab are "
+              "separate A/Bs; run them one at a time", file=sys.stderr)
+        return 2
+    if args.tp < 1:
+        print("--tp must be >= 1", file=sys.stderr)
+        return 2
+    if args.tp_ab and (args.url is not None or args.router
+                       or args.replicas > 1):
+        print("--tp-ab needs the single in-process engine (no --url, "
+              "no --router/--replicas)", file=sys.stderr)
         return 2
     if args.kv_ab and (args.url is not None or args.router
                        or args.replicas > 1):
@@ -712,6 +740,10 @@ def main(argv=None) -> int:
     elif args.lora_ab:
         arms = [("base", spec_def, trace_def),
                 ("lora", spec_def, trace_def)]
+    elif args.tp_ab:
+        tp_n = args.tp if args.tp > 1 else 2
+        arms = [("tp1", spec_def, trace_def),
+                (f"tp{tp_n}", spec_def, trace_def)]
     else:
         arms = [("", spec_def, trace_def)]
     res = {}
@@ -729,6 +761,9 @@ def main(argv=None) -> int:
         if args.lora_ab:
             arm_args = argparse.Namespace(**vars(args))
             arm_args.adapters = 0 if arm == "base" else n_adapters
+        if args.tp_ab:
+            arm_args = argparse.Namespace(**vars(args))
+            arm_args.tp = 1 if arm == "tp1" else tp_n
         res[arm] = _run_arm(arm_args, arm, spec_on, trace_on, prompts,
                             arrivals, assign)
     if args.trace_ab:
@@ -776,6 +811,34 @@ def main(argv=None) -> int:
                 {"metric": "serve_lora_throughput_ratio",
                  "value": round(b["throughput"] / a["throughput"], 3),
                  "unit": "x (lora/base)"}))
+    if args.tp_ab:
+        # the tensor-parallel verdict on identical replayed load:
+        # decode cadence TP=1/TP=N (on CPU meshes this measures the
+        # MECHANISM + partition overhead — psums are free-ish on ICI,
+        # not on a host mesh), and the capacity headline: the weights+
+        # pool bytes a TP=N engine holds are spread over N chips, so
+        # at FIXED per-chip HBM the servable model is N x what one
+        # chip loads — the record a 13B/65B memory-fit config cashes
+        a, b = res["tp1"], res[f"tp{tp_n}"]
+        print(json.dumps({"metric": "serve_tp_degree",
+                          "value": tp_n, "unit": "devices"}))
+        if a.get("tpot_p50") and b.get("tpot_p50"):
+            print(json.dumps({"metric": "serve_tp_tpot_speedup",
+                              "value": round(a["tpot_p50"]
+                                             / b["tpot_p50"], 3),
+                              "unit": "x (tp1/tpN)"}))
+        if a.get("model_bytes"):
+            # per-chip footprint of the TP=1 arm x N: the largest
+            # (weights + KV pool) total a TP=N mesh can serve at the
+            # unsharded arm's per-chip HBM budget
+            print(json.dumps({"metric": "serve_tp_max_model_bytes",
+                              "value": a["model_bytes"] * tp_n,
+                              "unit": "bytes (at TP=1 per-chip HBM)"}))
+        if b.get("model_bytes"):
+            print(json.dumps(
+                {"metric": "serve_tp_bytes_per_chip",
+                 "value": b["model_bytes"] // tp_n,
+                 "unit": "bytes/chip (weights+pool, TP arm)"}))
     if args.kv_ab:
         # the quantization verdict on identical replayed load: decode
         # cadence bf16/int8 (HBM-bound hardware converts the halved
@@ -939,8 +1002,11 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             server, vocab, plan = _build_toy_server(args, spec_on)
             if args.adapters:
                 _load_bench_adapters(server, args)
-        assert vocab == _TOY_VOCAB, \
-            f"toy model vocab {vocab} != {_TOY_VOCAB} the prompts used"
+        # prompts were drawn in [0, _TOY_VOCAB) before the server
+        # existed; any preset with at least that many tokens serves
+        # them (tiny == exactly; 13b/65b have 32000)
+        assert vocab >= _TOY_VOCAB, \
+            f"model vocab {vocab} < {_TOY_VOCAB} the prompts used"
 
     stats = _Stats()
     # KV pool occupancy sampler (in-process paged engine): the
@@ -958,6 +1024,15 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
     # capacity-ratio record divides these
     bpp_fn = getattr(eng, "kv_page_cost", None)
     kv_page_cost = bpp_fn() if callable(bpp_fn) else None
+    # weights + KV pool bytes this engine holds on device (logical
+    # totals; a TP mesh spreads them over tp_degree chips) — the
+    # --tp-ab capacity record's numerator
+    model_bytes = None
+    if eng is not None and getattr(eng, "params", None) is not None:
+        model_bytes = sum(int(v.nbytes) for v in eng.params.values())
+        if kv_page_cost is not None:
+            model_bytes += (kv_page_cost["bytes_per_page"]
+                            * eng.num_pages)
     if alloc is not None:
         def _sample_occ():
             while not occ_stop.wait(0.005):
@@ -1279,6 +1354,7 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
                      else None),
         "throughput": (stats.tokens / wall if wall > 0 else None),
         "kv_page_cost": kv_page_cost,
+        "model_bytes": model_bytes,
     }
 
 
