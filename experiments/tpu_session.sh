@@ -12,7 +12,7 @@
 # ORDER (value-per-minute): the serving stack has NEVER touched a chip
 # — every serve_bench number in PERF.md is CPU-tiny with explicit
 # "mechanism, not speedup" caveats — so after the cheap preflights the
-# serving-record steps (6c-6i) run FIRST, and the training-side parity
+# serving-record steps (6c-6j) run FIRST, and the training-side parity
 # replays and config benches come after. A window that dies at minute
 # 35 should die owing training replays, not serving records.
 #
@@ -93,7 +93,7 @@ STEP_TIMEOUT=900 step kernel_slice env PADDLE_TPU_TESTS_ON_DEVICE=1 \
     -k "device_scale or Sublane" -q -p no:cacheprovider
 
 # ---------------------------------------------------------------------------
-# SERVING RECORDS FIRST (6c-6i): nothing serving-side has ever run on a
+# SERVING RECORDS FIRST (6c-6j): nothing serving-side has ever run on a
 # TPU; each step below converts one CPU-tiny "mechanism" number into a
 # hardware record.
 # ---------------------------------------------------------------------------
@@ -175,6 +175,23 @@ step serve_tp_ab python tools/serve_bench.py --tp-ab --tp 4 --layers 2 \
 STEP_TIMEOUT=3600 step serve_tp_13b python tools/serve_bench.py --tp 4 \
     --preset 13b --layers 8 --prompt-len 16:32 --max-new 16 --rate 4 \
     --requests 8 --num-pages 128 --max-pages 16 --page-size 8 --warmup
+# 6j. on-TPU SLO/goodput capture + recording-overhead A/B (NEW — PR
+#     15; queued after the 6i lora/tp records, no new device claims in
+#     preflight). Two halves: (a) an SLO-scored multi-tenant run —
+#     per-tenant goodput + the digest-exact serve_slo_ttft_p99 /
+#     serve_slo_tpot_p99 (thresholds sized for on-chip decode:
+#     CPU-tiny TPOT is ~ms-scale, TPU sub-ms — a miss here is real
+#     headroom data, not noise); (b) --slo-ab on identical pre-drawn
+#     load — the monitor+SLO recording path must hold the PR 8 bar
+#     on-chip too (serve_slo_tpot_overhead <= 1.02x decides whether
+#     SLO scoring defaults ON for serving configs).
+step serve_slo python tools/serve_bench.py --slo-ttft 0.5 \
+    --slo-tpot 0.05 --adapters 4 --adapter-dist zipf --layers 2 \
+    --prompt-len 8:24 --max-new 16 --rate 8 --requests 24 \
+    --num-pages 48 --max-pages 8 --page-size 8 --warmup
+step serve_slo_ab python tools/serve_bench.py --slo-ab --layers 2 \
+    --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
+    --num-pages 64 --max-pages 16 --page-size 8 --warmup
 
 # ---------------------------------------------------------------------------
 # TRAINING-SIDE PARITY + PERF LEVERS (after the serving records)

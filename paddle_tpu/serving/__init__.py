@@ -77,6 +77,19 @@ are adapter-salted (cross-adapter warm hits structurally zero),
 starving other tenants, and the Router prefers adapter-resident
 replicas.
 
+SLO & goodput (README "SLO & goodput"): every Server carries a
+``paddle_tpu.monitor.slo.SLOTracker`` — mergeable fixed-log-bucket
+latency digests per (metric, tenant) for TTFT/TPOT/queue-wait/e2e
+plus per-tenant token/KV-page-second cost accounting, fed only while
+``FLAGS_enable_monitor`` is on. ``Server(slo_policy=SLOPolicy(...))``
+scores every service-terminal request into per-tenant GOODPUT
+(fraction meeting the thresholds) and fast/slow BURN-RATE windows.
+``GET /stats`` (Server or Router front) serves the rollup; the
+Router's version MERGES replica digests — exact fleet percentiles,
+never averages — and runs the slow-replica SKEW DETECTOR (rolling
+TPOT p50 vs fleet median; ``slow`` deprioritizes routing without
+opening a breaker).
+
 Tracing & flight recorder (README "Tracing & flight recorder"): with
 ``FLAGS_enable_trace`` on, every lifecycle seam records a structured
 event into ``paddle_tpu.tracing``'s bounded ring — read one request's
@@ -105,6 +118,7 @@ Quick start::
 """
 from ..inference.generation import (EngineFault, PagePoolExhausted,
                                     RequestFault, classify_fault)
+from ..monitor.slo import SLOPolicy
 from .adapters import AdapterRegistry
 from .http import serve_http
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
@@ -123,6 +137,6 @@ __all__ = [
     "RequestFault", "EngineFault", "classify_fault",
     "PagePoolExhausted", "PreemptionBudgetExceeded",
     "Router", "ReplicaSpec", "RouterHandle",
-    "FailoverBudgetExceeded", "FleetUnavailable",
+    "FailoverBudgetExceeded", "FleetUnavailable", "SLOPolicy",
     "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED", "FAILED",
 ]

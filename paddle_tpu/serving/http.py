@@ -75,6 +75,17 @@ Routes:
   ``monitor.start_http_server`` (one scrape endpoint per serving
   process).
 
+- ``GET /stats`` — the SLO/goodput rollup
+  (``paddle_tpu.monitor.slo``): per-tenant goodput + fast/slow
+  burn rates + token/KV-page-second cost, and per-(metric, tenant)
+  latency percentiles (TTFT/TPOT/queue-wait/e2e) with an exact
+  all-tenant ``"*"`` aggregate. Fronting a ``Router`` the same route
+  serves the FLEET rollup — percentiles computed by MERGING replica
+  digests (exact, never averaged), per-replica percentile blocks for
+  the fleet-vs-replica comparison, and the skew detector's
+  ``slow_replicas`` set. Render with
+  ``tools/monitor_report.py --slo``.
+
 - ``GET /trace?rid=N`` — one request's ordered lifecycle timeline
   (``paddle_tpu.tracing``; ``rid`` is the public ``request_id`` the
   ``/generate`` response carried): queue → admit (bucket) → segments →
@@ -254,6 +265,20 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                 healthy = body.get(
                     "healthy", body.get("status") in ("ok", "draining"))
                 self._json(200 if healthy else 503, body)
+            elif self.path.startswith("/stats"):
+                # SLO/goodput rollup (paddle_tpu.monitor.slo): a
+                # Server serves its own tracker; a Router MERGES every
+                # replica's digests — exact fleet percentiles (never
+                # averaged), per-tenant goodput/burn from summed
+                # counters, and the skew detector's slow set. Same
+                # shape either way (tools/monitor_report.py --slo).
+                fn = getattr(server, "stats", None)
+                if fn is None:
+                    self._json(404, {
+                        "error": "no /stats: this front exposes no "
+                                 "SLO tracker"})
+                else:
+                    self._json(200, fn())
             elif self.path.startswith("/trace"):
                 self._trace_response()
             elif (payload := monitor.http_payload(self.path)) is not None:

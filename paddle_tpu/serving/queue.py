@@ -112,6 +112,9 @@ class RequestHandle:
         self.deadline = deadline          # absolute time.monotonic()
         self.engine_rid: Optional[int] = None
         self.submit_ts = time.monotonic()
+        self.admit_ts: Optional[float] = None   # FIRST admission (the
+        #                      SLO tracker's KV-page-second integral
+        #                      starts here; replays keep the original)
         self.first_token_ts: Optional[float] = None
         self.finish_ts: Optional[float] = None
         self._cv = threading.Condition()
@@ -287,6 +290,8 @@ class RequestHandle:
         with self._cv:
             self.engine_rid = engine_rid
             self._status = RUNNING
+            if self.admit_ts is None:
+                self.admit_ts = time.monotonic()
 
 
 class RequestQueue:

@@ -66,6 +66,7 @@ the replica.
 """
 from __future__ import annotations
 
+import statistics
 import threading
 import time
 from typing import List, Optional, Sequence, Union
@@ -74,6 +75,7 @@ import numpy as np
 
 from .. import monitor
 from .. import tracing as trace
+from ..monitor import slo as _slo
 from ..inference.generation import (GenerationConfig, PagePoolExhausted,
                                     _prompt_ids, _prompt_len)
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, _TERMINAL,
@@ -202,7 +204,7 @@ class _Replica:
     __slots__ = ("index", "spec", "server", "breaker", "failures",
                  "opens", "open_until", "backoff_mult", "probing",
                  "restarts", "deliberate_restarts", "restart_at",
-                 "draining", "dead")
+                 "draining", "dead", "slow")
 
     def __init__(self, index: int, spec: ReplicaSpec, server):
         self.index = index
@@ -225,6 +227,11 @@ class _Replica:
         self.draining = False      # deliberately excluded (drain /
         #                            rolling restart)
         self.dead = False          # restart budget exhausted
+        self.slow = False          # skew detector verdict: rolling
+        #                            TPOT p50 > skew_factor x fleet
+        #                            median — ALIVE but lagging; routed
+        #                            last, never walled off (slow !=
+        #                            open breaker)
 
     # both helpers mutate breaker/supervision state: caller holds the
     # router lock
@@ -240,6 +247,8 @@ class _Replica:
         self.probing = False
         self.restart_at = None
         self.dead = False
+        self.slow = False     # a fresh server has a fresh engine: the
+        #                       old skew verdict is stale evidence
 
     def mark_dead(self) -> None:
         """Restart budget exhausted: permanently out of rotation,
@@ -248,6 +257,7 @@ class _Replica:
         self.breaker = BREAKER_OPEN
         self.open_until = float("inf")
         self.restart_at = None
+        self.slow = False     # dead outranks slow; the gauge reads 0
 
 
 class Router:
@@ -287,7 +297,18 @@ class Router:
     - ``retry_wait_s`` — pump back-off while NO replica is routable
       (all warming/restarting/open): the request waits instead of
       failing, bounded by its own deadline and by the fleet going
-      permanently dead.
+      permanently dead;
+    - ``skew_factor`` / ``skew_min_requests`` / ``skew_interval_s`` —
+      the SLOW-REPLICA skew detector: every ``skew_interval_s`` the
+      monitor thread compares each replica's rolling-window TPOT p50
+      (>= ``skew_min_requests`` observations required) against the
+      median of its PEERS' p50s (leave-one-out); above
+      ``skew_factor``× that median the replica flips
+      SLOW — deprioritized in routing (scored behind every non-slow
+      candidate) but still routable, surfaced in ``load()`` /
+      ``GET /stats``, flight-recorder dump on the flip. Slow is the
+      state breakers cannot see: the replica answers everything,
+      just late.
     """
 
     def __init__(self,
@@ -303,6 +324,9 @@ class Router:
                  monitor_interval_s: float = 0.05,
                  degraded_poll_s: float = 0.25,
                  retry_wait_s: float = 0.02,
+                 skew_factor: float = 2.0,
+                 skew_min_requests: int = 5,
+                 skew_interval_s: float = 1.0,
                  start: bool = True):
         if isinstance(specs, ReplicaSpec):
             n = 1 if replicas is None else replicas
@@ -329,9 +353,19 @@ class Router:
                         ("replica_backoff_s", replica_backoff_s),
                         ("monitor_interval_s", monitor_interval_s),
                         ("degraded_poll_s", degraded_poll_s),
-                        ("retry_wait_s", retry_wait_s)):
+                        ("retry_wait_s", retry_wait_s),
+                        ("skew_interval_s", skew_interval_s)):
             if not v > 0:
                 raise ValueError(f"{name} must be > 0, got {v!r}")
+        if not skew_factor > 1.0:
+            # factor <= 1 would flag roughly half a healthy,
+            # noise-jittered fleet slow at every check
+            raise ValueError(
+                f"skew_factor must be > 1.0, got {skew_factor!r}")
+        if skew_min_requests < 1:
+            raise ValueError(
+                f"skew_min_requests must be >= 1, got "
+                f"{skew_min_requests!r}")
         self.max_failovers = max_failovers
         self.breaker_threshold = breaker_threshold
         self.breaker_backoff_s = breaker_backoff_s
@@ -342,6 +376,9 @@ class Router:
         self.monitor_interval_s = monitor_interval_s
         self.degraded_poll_s = degraded_poll_s
         self.retry_wait_s = retry_wait_s
+        self.skew_factor = skew_factor
+        self.skew_min_requests = skew_min_requests
+        self.skew_interval_s = skew_interval_s
         self.monitor_router = monitor.instance_label("router")
         # one spec shared by every replica: a capacity verdict
         # (ValueError / PagePoolExhausted) from one replica holds for
@@ -357,6 +394,9 @@ class Router:
         self._failovers_total = 0         # guarded-by: self._lock
         self._draining = False            # guarded-by: self._lock
         self._stopping = False            # guarded-by: self._lock
+        self._flight_dumps = []           # guarded-by: self._lock
+        #                                   router-level flight-recorder
+        #                                   dump paths (skew flips)
         self._stop_evt = threading.Event()
         # building a replica compiles nothing by itself (Server warmup
         # is a spec knob) but does allocate device state — build them
@@ -378,6 +418,7 @@ class Router:
             raise
         for rep in self._replicas:
             self._breaker_metric(rep)
+            self._slow_metric(rep)
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, daemon=True,
             name=f"paddle_tpu-router-monitor-{self.monitor_router}")
@@ -517,6 +558,7 @@ class Router:
             entry = {
                 "replica": rep.index,
                 "status": state,
+                "slow": rep.slow,
                 "breaker": {"state": _BREAKER_NAMES[breaker],
                             "failures": rep.failures,
                             "opens": rep.opens},
@@ -571,11 +613,70 @@ class Router:
             status = "degraded"
         healthy = (not stopping and routable >= 1
                    and not all(r.dead for r in reps))
-        return {"status": status, "healthy": healthy,
-                "router": self.monitor_router, "replicas": entries,
-                "queue_depth": agg_q, "active_requests": agg_a,
-                "free_slots": agg_f, "inflight_requests": inflight,
-                "failovers": failovers, "breaker_opens": opens}
+        out = {"status": status, "healthy": healthy,
+               "router": self.monitor_router, "replicas": entries,
+               "queue_depth": agg_q, "active_requests": agg_a,
+               "free_slots": agg_f, "inflight_requests": inflight,
+               "failovers": failovers, "breaker_opens": opens,
+               "slow_replicas": [e["replica"] for e in entries
+                                 if e.get("slow")]}
+        with self._lock:
+            if self._flight_dumps:
+                out["flight_dump"] = self._flight_dumps[-1]
+        return out
+
+    def stats(self) -> dict:
+        """The fleet SLO rollup — ``GET /stats``. EXACT by
+        construction: per-(metric, tenant) latency percentiles come
+        from MERGING every live replica's fixed-log-bucket digests
+        (identical bucketization → elementwise counter add → the
+        merged digest IS the digest of the concatenated request
+        streams), and per-tenant goodput/burn come from SUMMING the
+        replicas' met/missed counters — never from averaging replica
+        percentiles or rates, which is the classic fleet-dashboard
+        lie this endpoint exists to replace. Shape::
+
+            {"router", "policy", "window_s",
+             "tenants": {tenant: {requests, met, missed, failed,
+                                  goodput, burn_fast, burn_slow,
+                                  tokens, kv_page_seconds}},
+             "metrics": {metric: {tenant: {count, mean, p50, p90,
+                                           p99, max},
+                                  "*": <exact all-tenant merge>}},
+             "replicas": [{replica, slow, dead, tpot_p50_s,
+                           metrics: <per-replica percentiles>}],
+             "skew": {"factor", "min_requests",
+                      "slow_replicas": [...]}}
+
+        The per-replica ``metrics`` blocks are what the fleet-vs-
+        replica comparison in ``tools/monitor_report.py --slo`` reads
+        — the gap between a replica's p99 and the fleet's is the skew
+        detector's story told in percentiles."""
+        with self._lock:
+            reps = list(self._replicas)
+        shards, entries = [], []
+        for rep in reps:
+            entry = {"replica": rep.index, "slow": rep.slow,
+                     "dead": rep.dead}
+            tracker = getattr(rep.server, "slo", None)
+            if tracker is not None and not rep.dead:
+                try:
+                    shard = tracker.digests_dict()
+                    entry["tpot_p50_s"] = tracker.rolling_tpot_p50()
+                    entry["metrics"] = tracker.percentiles()
+                except Exception:   # mid-swap replica: skip its shard
+                    shard = None
+                if shard is not None:
+                    shards.append(shard)
+            entries.append(entry)
+        out = _slo.fleet_rollup(shards)
+        out["router"] = self.monitor_router
+        out["replicas"] = entries
+        out["skew"] = {"factor": self.skew_factor,
+                       "min_requests": self.skew_min_requests,
+                       "slow_replicas": [e["replica"] for e in entries
+                                         if e.get("slow")]}
+        return out
 
     # -- drain / rolling restart ---------------------------------------------
     def drain(self, index: Optional[int] = None,
@@ -647,6 +748,11 @@ class Router:
             except Exception:
                 pass
         self._breaker_metric(rep)
+        # reset_health cleared rep.slow OUT OF BAND (a fresh engine's
+        # skew verdict starts over) — the gauge must follow, or it
+        # exports a phantom slow=1 the next _check_skew never corrects
+        # (it only writes on a flag CHANGE)
+        self._slow_metric(rep)
         if monitor.enabled():
             self._restarts_counter().labels(
                 router=self.monitor_router,
@@ -703,7 +809,8 @@ class Router:
         for name in ("paddle_tpu_router_requests_total",
                      "paddle_tpu_router_failovers_total",
                      "paddle_tpu_router_breaker_state",
-                     "paddle_tpu_router_replica_restarts_total"):
+                     "paddle_tpu_router_replica_restarts_total",
+                     "paddle_tpu_router_replica_slow"):
             try:
                 monitor.remove_series(name, router=self.monitor_router)
             except Exception:
@@ -746,6 +853,15 @@ class Router:
             "crash recovery + deliberate rolling restarts)",
             ("router", "replica"))
 
+    @staticmethod
+    def _slow_gauge():
+        return monitor.gauge(
+            "paddle_tpu_router_replica_slow",
+            "skew-detector verdict: 1 while the replica's rolling "
+            "TPOT p50 exceeds the fleet median by skew_factor "
+            "(slow-but-alive — deprioritized in routing, breaker "
+            "untouched), else 0", ("router", "replica"))
+
     def _count(self, outcome: str, replica) -> None:
         if monitor.enabled():
             self._requests_counter().labels(
@@ -758,6 +874,35 @@ class Router:
             self._breaker_gauge().labels(
                 router=self.monitor_router,
                 replica=str(rep.index)).set(rep.breaker)
+
+    def _slow_metric(self, rep: _Replica) -> None:
+        if monitor.enabled():
+            self._slow_gauge().labels(
+                router=self.monitor_router,
+                replica=str(rep.index)).set(int(rep.slow))
+
+    def _flight_dump(self, reason: str):
+        """Router-level flight-recorder dump (no-op while tracing is
+        off), mirroring the Server's: the skew detector fires one when
+        a replica flips SLOW — a lagging-but-alive replica is exactly
+        the postmortem the breakers never capture (they only see
+        failures). Never raises."""
+        if not trace.enabled():
+            return None
+        try:
+            path = trace.dump(reason)
+        except Exception:
+            return None
+        if path is not None:
+            with self._lock:
+                self._flight_dumps.append(path)
+        return path
+
+    @property
+    def flight_dumps(self):
+        """Router-level flight-recorder dump paths (newest last)."""
+        with self._lock:
+            return list(self._flight_dumps)
 
     # -- breaker transitions (router lock) -----------------------------------
     def _replica_failure(self, rep: _Replica, srv, err,
@@ -890,11 +1035,19 @@ class Router:
                     # then least-loaded: what's queued + what's
                     # decoding now; free pages break ties toward the
                     # roomier KV pool
+                    # skew first, THEN adapter affinity, then load: a
+                    # slow replica with the adapter resident loses to a
+                    # healthy one without it — a warm bank row saves
+                    # milliseconds, a skewed replica costs the whole
+                    # TPOT gap, and the SLO is the thing being served.
+                    # Slow stays a candidate (routable of last resort;
+                    # slow != open breaker).
                     reg = getattr(srv2.engine, "adapters", None)
                     afar = int(not (adapter is not None
                                     and reg is not None
                                     and adapter in reg))
-                    score = (afar if adapter is not None else 0,
+                    score = (int(rep.slow),
+                             afar if adapter is not None else 0,
                              srv2.queue.depth + srv2.num_active(),
                              -(alloc.free_pages if alloc is not None
                                else 0))
@@ -1224,10 +1377,91 @@ class Router:
         exponential backoff. Detection: ``Server.status`` in
         ``failed``/``stopped`` outside a deliberate drain/restart.
         Budget: ``max_replica_restarts`` per replica; past it the
-        replica is DEAD (breaker pinned open, fleet serves on)."""
+        replica is DEAD (breaker pinned open, fleet serves on).
+        The SKEW DETECTOR rides the same thread on its own (coarser)
+        cadence — reading N rolling digests is host work, but not
+        every-50ms work."""
+        last_skew = 0.0
         while not self._stop_evt.wait(self.monitor_interval_s):
             for rep in list(self._replicas):
                 self._supervise(rep)
+            now = time.monotonic()
+            if now - last_skew >= self.skew_interval_s:
+                last_skew = now
+                try:
+                    self._check_skew()
+                except Exception:
+                    # skew is ADVISORY: a torn read off a mid-rebuild
+                    # replica (or a dump-path surprise) must never
+                    # kill the supervision thread that restarts
+                    # crashed replicas
+                    pass
+
+    def _check_skew(self) -> None:
+        """Slow-replica skew detection (monitor thread): compare each
+        live replica's rolling-window TPOT p50 (the SLO tracker's
+        :meth:`~paddle_tpu.monitor.slo.SLOTracker.rolling_tpot_p50`)
+        against the fleet median of the OTHER judged replicas' p50s —
+        leave-one-out, so a lagging replica cannot drag its own
+        baseline up, and a 2-replica fleet stays detectable (a global
+        median over two is the mean of both, which ``p > factor ×
+        median`` could never exceed at ``factor >= 2``). A replica
+        above ``skew_factor``× its peers' median flips SLOW. This is the failure
+        mode the circuit breakers are blind to: a replica that is
+        *slow but alive* (thermal throttling, a neighbour hogging the
+        host, a wedged-but-recovering pool) answers every request and
+        never trips a failure counter — but it drags the fleet p99.
+        SLOW is a ROUTING HINT, not a wall: the replica scores behind
+        every non-slow candidate in ``_acquire`` yet stays routable
+        (slow ≠ open breaker), surfaces in ``load()``/``GET /stats``,
+        and the flip dumps the flight recorder (one dump per flip —
+        the black box alongside PR 8's storm/stall triggers).
+
+        A replica needs ``skew_min_requests`` TPOT observations inside
+        the rolling window to be judged (a starved or freshly
+        restarted replica reads UNKNOWN → not slow), and a verdict
+        needs >= 1 OTHER judged replica — a fleet of one has nothing
+        to skew against."""
+        with self._lock:
+            reps = list(self._replicas)
+        p50s = {}
+        for rep in reps:
+            if rep.dead or rep.restart_at is not None:
+                continue
+            tracker = getattr(rep.server, "slo", None)
+            if tracker is None:
+                continue
+            try:
+                p = tracker.rolling_tpot_p50(
+                    min_count=self.skew_min_requests)
+            except Exception:   # mid-swap replica: skip this round
+                p = None
+            if p is not None:
+                p50s[rep.index] = p
+        for rep in reps:
+            p = p50s.get(rep.index)
+            others = [v for i, v in p50s.items() if i != rep.index]
+            med = statistics.median(others) if others else None
+            slow = (med is not None and med > 0 and p is not None
+                    and p > self.skew_factor * med)
+            with self._lock:
+                changed = (not rep.dead and rep.slow != slow)
+                if changed:
+                    rep.slow = slow
+            if not changed:
+                continue
+            self._slow_metric(rep)
+            if trace.enabled():
+                trace.event("replica.slow", replica=rep.index,
+                            slow=slow,
+                            tpot_p50_s=(None if p is None
+                                        else round(p, 6)),
+                            fleet_median_s=(None if med is None
+                                            else round(med, 6)),
+                            factor=self.skew_factor,
+                            router=self.monitor_router)
+            if slow:
+                self._flight_dump(f"replica_slow_{rep.index}")
 
     def _supervise(self, rep: _Replica) -> None:
         now = time.monotonic()
@@ -1245,6 +1479,7 @@ class Router:
                 if rep.restarts >= self.max_replica_restarts:
                     rep.mark_dead()
                     self._breaker_metric(rep)
+                    self._slow_metric(rep)   # mark_dead cleared slow
                     if trace.enabled():
                         trace.event(
                             "replica.dead", replica=rep.index,
@@ -1285,6 +1520,7 @@ class Router:
                                       + self._backoff_delay(
                                           rep.restarts))
             self._breaker_metric(rep)
+            self._slow_metric(rep)   # the mark_dead branch cleared slow
             if trace.enabled():
                 trace.event("replica.rebuild_failed",
                             replica=rep.index, cause=repr(e),
@@ -1306,6 +1542,7 @@ class Router:
                 pass
             return
         self._breaker_metric(rep)
+        self._slow_metric(rep)   # reset_health cleared slow out of band
         if monitor.enabled():
             self._restarts_counter().labels(
                 router=self.monitor_router,

@@ -66,6 +66,7 @@ event.
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Optional
@@ -74,6 +75,7 @@ import numpy as np
 
 from .. import monitor
 from .. import tracing as trace
+from ..monitor import slo as _slo
 from ..inference.generation import (ADMISSION_MODES, GenerationConfig,
                                     PagePoolExhausted, _prompt_ids,
                                     _prompt_len, classify_fault)
@@ -195,6 +197,25 @@ class Server:
       plain). Individual requests opt in/out via
       ``GenerationConfig.speculative`` regardless.
 
+    SLO & goodput (``paddle_tpu.monitor.slo``, gated like every
+    monitor seam on ``FLAGS_enable_monitor``):
+
+    - the server always carries an :class:`SLOTracker` (``self.slo``)
+      digesting TTFT / TPOT / queue-wait / e2e per (metric, tenant)
+      into mergeable fixed-log-bucket digests, plus per-tenant token
+      and KV-page-second cost counters — tenant defaults to the
+      request's LoRA adapter (PR 13), base traffic aggregates under
+      ``"-"``;
+    - ``slo_policy`` (an :class:`~paddle_tpu.monitor.slo.SLOPolicy`)
+      additionally scores every service-terminal request: **goodput**
+      (fraction meeting the thresholds; FAILED requests miss by
+      definition, cancelled/expired are client verdicts and don't
+      count) and fast/slow **burn-rate** windows per tenant;
+    - read it via ``load()``'s ``slo`` block (``/healthz``),
+      :meth:`stats` (the ``GET /stats`` shape), or the fleet Router's
+      ``GET /stats``, which MERGES replica digests for exact fleet
+      percentiles.
+
     Tracing & flight recorder (``paddle_tpu.tracing``, enabled via
     ``FLAGS_enable_trace``): every lifecycle seam the scheduler drives
     records a structured event keyed by the request — queue
@@ -233,7 +254,8 @@ class Server:
                  draft_k: Optional[int] = None,
                  speculative: bool = False,
                  kv_dtype: Optional[str] = None,
-                 tenant_quotas=None):
+                 tenant_quotas=None,
+                 slo_policy=None):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
                 f"stall_timeout_s must be > 0 or None, got "
@@ -341,6 +363,21 @@ class Server:
                     f"tenant quota caps must be ints >= 1, got "
                     f"{tenant_quotas!r}")
         self.tenant_quotas = tenant_quotas
+        if slo_policy is not None and not isinstance(slo_policy,
+                                                     _slo.SLOPolicy):
+            raise ValueError(
+                f"slo_policy must be a monitor.slo.SLOPolicy or None, "
+                f"got {slo_policy!r}")
+        # SLO/goodput tracker (paddle_tpu.monitor.slo): mergeable
+        # per-(metric, tenant) latency digests + per-tenant cost
+        # accounting, always constructed (a cheap host object) but
+        # only FED while FLAGS_enable_monitor is on — the disabled
+        # serving path pays one bool branch per seam, nothing else.
+        # slo_policy additionally scores each finished request into
+        # goodput + fast/slow burn-rate windows. Read via load()'s
+        # ``slo`` block, stats(), and the fleet Router's GET /stats
+        # (which MERGES these digests — exact fleet percentiles).
+        self.slo = _slo.SLOTracker(policy=slo_policy)
         self.engine = engine
         self.segment_steps = segment_steps
         self.idle_wait_s = idle_wait_s
@@ -601,7 +638,14 @@ class Server:
                      "paddle_tpu_serving_kv_pressure",
                      "paddle_tpu_serving_requests_total",
                      "paddle_tpu_serving_ttft_seconds",
-                     "paddle_tpu_serving_tpot_seconds"):
+                     "paddle_tpu_serving_tpot_seconds",
+                     # SLO/goodput + per-tenant cost families (PR 15):
+                     # tenant is an open label dimension, retired by
+                     # the server label alone
+                     "paddle_tpu_serving_goodput",
+                     "paddle_tpu_serving_slo_misses_total",
+                     "paddle_tpu_serving_tenant_tokens_total",
+                     "paddle_tpu_serving_tenant_kv_page_seconds_total"):
             try:
                 monitor.remove_series(name, server=self.monitor_server)
             except Exception:
@@ -761,7 +805,7 @@ class Server:
         ``{"status", "healthy", "server", "queue_depth",
         "active_requests", "restarts", "free_slots", "active_slots",
         "max_batch"[, "free_pages", "total_pages", "occupancy"]
-        [, "pressure"][, "flight_dump"]}``
+        [, "pressure"][, "slo"][, "flight_dump"]}``
 
         ``healthy`` is the HTTP readiness verdict (``status`` in
         ``ok``/``draining`` — what ``/healthz`` turns into 200 vs 503).
@@ -789,10 +833,31 @@ class Server:
         p = self.pressure()
         if p is not None:
             snap["pressure"] = p
+        if monitor.enabled():
+            # SLO/goodput block (host dict walks only — the tracker's
+            # lock is held per read, never across engine work): policy,
+            # per-tenant goodput + fast/slow burn + token/KV-page-
+            # second cost, headline ttft/tpot p50/p99 per tenant.
+            # Absent while nothing was recorded or the monitor is off.
+            s = self.slo.snapshot()
+            if s is not None:
+                snap["slo"] = s
         with self._lock:
             if self._flight_dumps:
                 snap["flight_dump"] = self._flight_dumps[-1]
         return snap
+
+    def stats(self) -> dict:
+        """Single-server SLO/goodput rollup — the same record shape
+        the fleet Router serves on ``GET /stats`` (built through the
+        SAME :func:`paddle_tpu.monitor.slo.fleet_rollup` merge path,
+        as a 1-shard fleet), so single-server and fleet tooling read
+        one format: ``{"server", "policy", "window_s", "tenants":
+        {tenant: goodput/burn/cost}, "metrics": {metric: {tenant:
+        count/p50/p90/p99, "*": exact all-tenant merge}}}``."""
+        out = _slo.fleet_rollup([self.slo.digests_dict()])
+        out["server"] = self.monitor_server
+        return out
 
     def pressure(self):
         """KV memory-pressure snapshot (None for a dense engine):
@@ -914,10 +979,105 @@ class Server:
             "on the replay list, waiting for pages, per server",
             ("server",))
 
+    @staticmethod
+    def _goodput_gauge():
+        return monitor.gauge(
+            "paddle_tpu_serving_goodput",
+            "lifetime fraction of service-terminal requests meeting "
+            "the server's SLOPolicy, per tenant (finished+failed; "
+            "cancelled/expired excluded)", ("server", "tenant"))
+
+    @staticmethod
+    def _slo_miss_counter():
+        return monitor.counter(
+            "paddle_tpu_serving_slo_misses_total",
+            "requests missing the SLO by dimension "
+            "(ttft/tpot/e2e thresholds, or 'failed' for requests the "
+            "service never delivered)", ("server", "tenant", "slo"))
+
+    @staticmethod
+    def _tenant_tokens_counter():
+        return monitor.counter(
+            "paddle_tpu_serving_tenant_tokens_total",
+            "generated tokens per tenant (tenant defaults to the LoRA "
+            "adapter name; '-' aggregates base traffic) — the compute "
+            "half of per-tenant cost accounting",
+            ("server", "tenant"))
+
+    @staticmethod
+    def _tenant_kv_counter():
+        return monitor.counter(
+            "paddle_tpu_serving_tenant_kv_page_seconds_total",
+            "KV page-seconds held per tenant (trapezoid of the host "
+            "page count over admit->finish; no device sync) — the "
+            "memory half of per-tenant cost accounting",
+            ("server", "tenant"))
+
     def _count(self, event: str) -> None:
         if monitor.enabled():
             self._requests_counter().labels(
                 server=self.monitor_server, event=event).inc()
+
+    def _kv_page_seconds(self, h: RequestHandle, n_tokens: int) -> float:
+        """Approximate KV page-seconds this request held (paged engine
+        only): trapezoid of the host-side page count — pages grow
+        roughly linearly from ceil(prompt/page_size) at admission to
+        ceil((prompt+generated)/page_size) at retirement — times the
+        admit->finish wall time. Pure host arithmetic (token counts
+        the scheduler already tracks), no allocator walk, no device
+        sync; pages released while preempted are slightly
+        over-counted, which is the conservative direction for a cost
+        meter."""
+        ps = getattr(self.engine, "page_size", None)
+        if not ps or h.admit_ts is None or h.finish_ts is None:
+            return 0.0
+        p0 = math.ceil(h.prompt_len / ps)
+        p1 = math.ceil((h.prompt_len + n_tokens) / ps)
+        return (p0 + p1) / 2.0 * max(h.finish_ts - h.admit_ts, 0.0)
+
+    def _slo_finish(self, h: RequestHandle, n_tokens: int) -> None:
+        """Score one FINISHED request into the SLO tracker and the
+        per-tenant cost/goodput series (scheduler thread)."""
+        if not monitor.enabled():
+            return
+        ttft = (None if h.first_token_ts is None
+                else h.first_token_ts - h.submit_ts)
+        tpot = (None if (h.first_token_ts is None or n_tokens < 2)
+                else (h.finish_ts - h.first_token_ts) / (n_tokens - 1))
+        e2e = h.finish_ts - h.submit_ts
+        kv_ps = self._kv_page_seconds(h, n_tokens)
+        _met, misses = self.slo.record_finish(
+            h.tenant, ttft, tpot, e2e, n_tokens, kv_ps)
+        t = _slo.tenant_key(h.tenant)
+        self._tenant_tokens_counter().labels(
+            server=self.monitor_server, tenant=t).inc(n_tokens)
+        if kv_ps > 0:
+            self._tenant_kv_counter().labels(
+                server=self.monitor_server, tenant=t).inc(kv_ps)
+        for dim in misses:
+            self._slo_miss_counter().labels(
+                server=self.monitor_server, tenant=t, slo=dim).inc()
+        g = self.slo.goodput(h.tenant)
+        if g is not None:
+            self._goodput_gauge().labels(
+                server=self.monitor_server, tenant=t).set(g)
+
+    def _slo_fail(self, h: RequestHandle) -> None:
+        """A FAILED terminal is an SLO miss by definition (the service
+        never delivered) — called right after the contained-failure
+        ``_count("failed")`` sites. The fatal ``_finalize`` path does
+        NOT score: a dying server's burn rate is not an alerting
+        signal, it is an outage the healthz status already names."""
+        if not monitor.enabled():
+            return
+        self.slo.record_failure(h.tenant)
+        t = _slo.tenant_key(h.tenant)
+        self._slo_miss_counter().labels(
+            server=self.monitor_server, tenant=t, slo="failed").inc()
+        g = self.slo.goodput(h.tenant)
+        if g is not None:
+            self._goodput_gauge().labels(
+                server=self.monitor_server, tenant=t).set(g)
 
     def _depth_gauge(self) -> None:
         if monitor.enabled():
@@ -1198,6 +1358,7 @@ class Server:
         if kind == "request":
             h._finish(FAILED, exc)
             self._count("failed")
+            self._slo_fail(h)
             return
         # the handle now rides ONLY inside the signal until _recover
         # parks it — flag the window so a timed drain() can't report
@@ -1331,6 +1492,7 @@ class Server:
                         f"engine restarts; last fault at {sig.site}: "
                         f"{sig.cause!r}"))
                     self._count("failed")
+                    self._slo_fail(h)
                 else:
                     self._replay.append(h)
         finally:
@@ -1458,6 +1620,8 @@ class Server:
                     # the crash) — it is simply finished
                     h._finish(FINISHED)
                     self._count("completed")
+                    if monitor.enabled():
+                        self._slo_finish(h, n_toks)
                     continue
                 plen = h.prompt_len + n_toks
                 if (chunk is not None and plen > chunk
@@ -1490,6 +1654,7 @@ class Server:
                             f"(page pool / max_len) is too small "
                             f"even when idle"))
                         self._count("failed")
+                        self._slo_fail(h)
                         continue
                     still.append(h)
                     continue
@@ -1695,12 +1860,18 @@ class Server:
                             "pool / max_len) is too small even "
                             "when idle"))
                         self._count("failed")
+                        self._slo_fail(bad)
                     continue
                 break
+            wait_s = time.monotonic() - h.submit_ts
+            if monitor.enabled():
+                # queue-wait digest: the admission-delay share of the
+                # tenant's latency story (replays never pass here — a
+                # replay wait is recovery, not queueing)
+                self.slo.observe("queue_wait", h.tenant, wait_s)
             if trace.enabled():
                 trace.event("queue.dequeue", rid=h._trace_rid,
-                            wait_s=round(
-                                time.monotonic() - h.submit_ts, 6))
+                            wait_s=round(wait_s, 6))
             self._start_admission(h, h.prompt, h.cfg, h.prompt_len)
 
     def _tenant_ok(self, h: RequestHandle) -> bool:
@@ -1821,6 +1992,7 @@ class Server:
                     f"{eng.num_pages}x{eng.page_size} tokens) — grow "
                     f"num_pages or lower max_new_tokens"))
                 self._count("failed")
+                self._slo_fail(h)
             if not progressed:
                 # a short rid this scheduler does not own and cannot
                 # reclaim: let decode_segment's own exhaustion guard
@@ -1891,6 +2063,7 @@ class Server:
                 f"this request mix — grow num_pages, lower "
                 f"kv_watermark, or raise max_preemptions"))
             self._count("failed")
+            self._slo_fail(h)
             return
         self._replay.append(h)
 
@@ -1900,8 +2073,13 @@ class Server:
         push is the TTFT observation."""
         h._n_pushed += len(toks)
         if h._push(toks) and monitor.enabled():
+            ttft = h.first_token_ts - h.submit_ts
             self._ttft_hist().labels(server=self.monitor_server).observe(
-                h.first_token_ts - h.submit_ts)
+                ttft)
+            # per-tenant TTFT digest (observed at the edge so /stats
+            # reflects it while the request still streams; record_finish
+            # scores the SLO verdict from the same stamps later)
+            self.slo.observe("ttft", h.tenant, ttft)
 
     def _collect(self) -> None:
         """Post-segment: finish retired requests, stream deltas for the
@@ -1922,6 +2100,7 @@ class Server:
                     self._tpot_hist().labels(
                         server=self.monitor_server).observe(
                         (h.finish_ts - h.first_token_ts) / (n - 1))
+                self._slo_finish(h, n)
         for rid, h in list(self._active.items()):
             delta = self.engine.partial_tokens(
                 rid, h._n_pushed - h._engine_base)

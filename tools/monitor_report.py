@@ -17,6 +17,10 @@ Usage::
     # export or flight-recorder dump): per-phase latency table +
     # the top-K slowest requests with their dominant phase
     python tools/monitor_report.py --trace serve_trace.json --top 5
+    # SLO/goodput view (a saved GET /stats body, or fetched live):
+    # per-tenant goodput/burn table + fleet-vs-replica percentiles
+    python tools/monitor_report.py --slo stats.json
+    python tools/monitor_report.py --url http://127.0.0.1:8000 --slo
 """
 from __future__ import annotations
 
@@ -226,6 +230,112 @@ def render_trace(doc: dict, top: int = 5) -> str:
     return "\n".join(lines)
 
 
+def _fmt_opt(v, fmt: str = ".4f", none: str = "-") -> str:
+    if v is None:
+        return none
+    try:
+        return format(v, fmt)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def render_slo(doc: dict) -> str:
+    """Per-tenant goodput/burn table + fleet-vs-replica percentile
+    comparison for a ``GET /stats`` snapshot (``paddle_tpu.monitor.slo``
+    — a Server's own rollup or a Router's merged fleet rollup; both
+    serve the same shape).
+
+    The fleet row of the comparison is computed by MERGING replica
+    digests (exact), so a replica whose p50/p99 sits far above it is
+    the skew detector's slow-but-alive story told in percentiles —
+    slow replicas are marked ``*SLOW*``."""
+    lines = []
+    owner = doc.get("router") or doc.get("server") or "?"
+    pol = doc.get("policy")
+    if pol:
+        th = ", ".join(f"{k.replace('_p99_s', '')}<={v}s"
+                       for k, v in pol.items()
+                       if k.endswith("_p99_s") and v is not None)
+        lines.append(
+            f"slo [{owner}]: {th}, goodput target "
+            f"{pol.get('goodput_target')}, burn windows "
+            f"{pol.get('fast_window_s')}s/{pol.get('slow_window_s')}s")
+    else:
+        lines.append(f"slo [{owner}]: no policy armed (digests only — "
+                     "pass Server(slo_policy=...) to score goodput)")
+    tens = doc.get("tenants") or {}
+    if tens:
+        lines.append("")
+        w = max(6, max(len(t) for t in tens))
+        lines.append(f"{'TENANT':<{w}}  {'REQS':>6}  {'GOODPUT':>8}"
+                     f"  {'BURN_F':>7}  {'BURN_S':>7}  {'FAILED':>6}"
+                     f"  {'TOKENS':>8}  {'KV_PAGE_S':>10}")
+        lines.append("-" * (w + 62))
+        for t in sorted(tens):
+            v = tens[t]
+            lines.append(
+                f"{t:<{w}}  {v.get('requests', 0):>6}"
+                f"  {_fmt_opt(v.get('goodput')):>8}"
+                f"  {_fmt_opt(v.get('burn_fast'), '.2f'):>7}"
+                f"  {_fmt_opt(v.get('burn_slow'), '.2f'):>7}"
+                f"  {v.get('failed', 0):>6}"
+                f"  {v.get('tokens', 0):>8}"
+                f"  {_fmt_opt(v.get('kv_page_seconds'), '.1f'):>10}")
+    mets = doc.get("metrics") or {}
+    if mets:
+        lines.append("")
+        lines.append(f"{'METRIC':<12}{'TENANT':<10}  {'COUNT':>6}"
+                     f"  {'p50(s)':>10}  {'p90(s)':>10}  {'p99(s)':>10}")
+        lines.append("-" * 64)
+        for metric in ("ttft", "tpot", "queue_wait", "e2e"):
+            per = mets.get(metric)
+            if not per:
+                continue
+            for t in sorted(per, key=lambda k: (k != "*", k)):
+                s = per[t]
+                lines.append(
+                    f"{metric:<12}{t:<10}  {s.get('count', 0):>6}"
+                    f"  {_fmt_opt(s.get('p50'), '.5f'):>10}"
+                    f"  {_fmt_opt(s.get('p90'), '.5f'):>10}"
+                    f"  {_fmt_opt(s.get('p99'), '.5f'):>10}")
+    reps = doc.get("replicas") or []
+    if reps:
+        lines.append("")
+        lines.append("fleet vs replicas (all-tenant '*'; fleet rows "
+                     "are digest MERGES, not averages):")
+        lines.append(f"{'WHO':<16}  {'METRIC':<6}  {'COUNT':>6}"
+                     f"  {'p50(s)':>10}  {'p99(s)':>10}")
+        lines.append("-" * 56)
+        for metric in ("ttft", "tpot"):
+            agg = mets.get(metric, {}).get("*")
+            if agg:
+                lines.append(f"{'fleet':<16}  {metric:<6}"
+                             f"  {agg.get('count', 0):>6}"
+                             f"  {_fmt_opt(agg.get('p50'), '.5f'):>10}"
+                             f"  {_fmt_opt(agg.get('p99'), '.5f'):>10}")
+            for e in reps:
+                rm = (e.get("metrics") or {}).get(metric, {}).get("*")
+                tag = (f"replica{e.get('replica')}"
+                       + (" *SLOW*" if e.get("slow") else ""))
+                if rm:
+                    lines.append(
+                        f"{tag:<16}  {metric:<6}"
+                        f"  {rm.get('count', 0):>6}"
+                        f"  {_fmt_opt(rm.get('p50'), '.5f'):>10}"
+                        f"  {_fmt_opt(rm.get('p99'), '.5f'):>10}")
+        skew = doc.get("skew") or {}
+        slow = skew.get("slow_replicas")
+        lines.append("")
+        lines.append(
+            f"skew: factor {skew.get('factor')}, slow replicas "
+            f"{slow if slow else 'none'} (slow = rolling TPOT p50 > "
+            f"factor x fleet median; deprioritized, breaker untouched)")
+    if not tens and not mets:
+        lines.append("(no SLO data recorded — is FLAGS_enable_monitor "
+                     "on?)")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=None,
@@ -248,11 +358,54 @@ def main(argv=None) -> int:
                          "requests with their dominant phase")
     ap.add_argument("--top", type=int, default=5,
                     help="slowest-requests rows in the --trace view")
+    ap.add_argument("--slo", nargs="?", const="", default=None,
+                    metavar="JSON",
+                    help="render a GET /stats SLO snapshot instead: "
+                         "per-tenant goodput/burn table + "
+                         "fleet-vs-replica percentile comparison. "
+                         "Pass a file (a saved /stats body, or a "
+                         "monitor JSONL dump — falls back to the slo "
+                         "metric families), or bare --slo with --url "
+                         "to fetch <url>/stats live")
     args = ap.parse_args(argv)
 
     if args.trace:
         with open(args.trace) as f:
             print(render_trace(json.load(f), top=args.top))
+        return 0
+    if args.slo is not None:
+        if not args.slo and not args.url:
+            print("--slo needs a snapshot file or --url",
+                  file=sys.stderr)
+            return 2
+        if not args.slo:
+            from urllib.request import urlopen
+
+            with urlopen(args.url.rstrip("/") + "/stats",
+                         timeout=10) as resp:
+                print(render_slo(json.load(resp)))
+            return 0
+        with open(args.slo) as f:
+            text = f.read()
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            doc = None
+        if isinstance(doc, dict) and ("tenants" in doc
+                                      or "metrics" in doc):
+            print(render_slo(doc))
+            return 0
+        # not a /stats body: treat it as a monitor JSONL dump and show
+        # the SLO metric families (goodput gauge, miss counters,
+        # per-tenant cost, the router slow gauge) in the plain table
+        slo_families = ("paddle_tpu_serving_goodput",
+                        "paddle_tpu_serving_slo_misses_total",
+                        "paddle_tpu_serving_tenant_",
+                        "paddle_tpu_router_replica_slow")
+        records = [r for r in load_jsonl(text.splitlines())
+                   if any(r["metric"].startswith(f)
+                          for f in slo_families)]
+        print(render(records, args.filter_))
         return 0
     if args.url:
         from urllib.request import urlopen

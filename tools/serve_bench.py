@@ -88,6 +88,14 @@ Usage::
     python tools/serve_bench.py --lora-ab --warmup   # K=0 vs K=8
     python tools/serve_bench.py --adapters 4 --tenant-quotas 2  # quotas
 
+    # SLO/goodput capture (PERF.md SLO methodology): arm an SLOPolicy,
+    # read serve_goodput + the digest-exact serve_slo_ttft_p99 /
+    # serve_slo_tpot_p99 (per-tenant table on stdout; GET /stats is
+    # the live equivalent) — and the off-vs-on recording overhead A/B
+    python tools/serve_bench.py --slo-ttft 0.5 --slo-tpot 0.05 \
+        --adapters 4 --adapter-dist zipf --warmup
+    python tools/serve_bench.py --slo-ab --warmup
+
 Output: one human table plus BENCH-shaped JSON records
 (``{"metric": ..., "value": ..., "unit": ...}``) on stdout. Chaos runs
 add ``serve_faults_injected`` / ``serve_requests_survived`` /
@@ -290,6 +298,13 @@ def _toy_engine(args, speculative: bool = False):
 
 def _toy_server_kwargs(args, max_restarts=None):
     """Server knobs from the CLI — shared by both builders."""
+    slo_policy = None
+    if (getattr(args, "slo_ttft", None) is not None
+            or getattr(args, "slo_tpot", None) is not None):
+        from paddle_tpu.monitor.slo import SLOPolicy
+
+        slo_policy = SLOPolicy(ttft_p99_s=args.slo_ttft,
+                               tpot_p99_s=args.slo_tpot)
     return dict(
         max_queue=args.max_queue, segment_steps=args.segment_steps,
         warmup=args.warmup,
@@ -299,7 +314,8 @@ def _toy_server_kwargs(args, max_restarts=None):
         max_preemptions=args.max_preemptions,
         restart_backoff_s=args.restart_backoff,
         stall_timeout_s=args.stall_timeout,
-        tenant_quotas=args.tenant_quotas)
+        tenant_quotas=args.tenant_quotas,
+        slo_policy=slo_policy)
 
 
 def _build_toy_server(args, speculative: bool = False):
@@ -626,6 +642,24 @@ def main(argv=None) -> int:
                     help="cap every tenant (= adapter) at N "
                          "concurrently admitted requests; a tenant "
                          "over quota defers without starving others")
+    # SLO/goodput knobs (paddle_tpu.monitor.slo; in-process modes)
+    ap.add_argument("--slo-ttft", type=float, default=None,
+                    metavar="S",
+                    help="per-request TTFT SLO threshold (s): arms an "
+                         "SLOPolicy on the server(s) and reports "
+                         "serve_goodput + the digest-exact "
+                         "serve_slo_ttft_p99/serve_slo_tpot_p99")
+    ap.add_argument("--slo-tpot", type=float, default=None,
+                    metavar="S",
+                    help="per-request TPOT SLO threshold (s); see "
+                         "--slo-ttft")
+    ap.add_argument("--slo-ab", action="store_true",
+                    help="A/B mode: run the SAME pre-drawn load twice "
+                         "— monitor+SLO recording OFF, then ON with "
+                         "the --slo-ttft/--slo-tpot policy (defaults "
+                         "1.0/0.25 s if unset) — and report "
+                         "serve_slo_tpot_overhead (the PR 8 bar: "
+                         "<= 1.02x, near-zero when off)")
     ap.add_argument("--lora-ab", action="store_true",
                     help="A/B mode: run the SAME pre-drawn load twice "
                          "— base model (K=0) then K adapters (default "
@@ -638,16 +672,24 @@ def main(argv=None) -> int:
     lo, hi = (int(x) for x in args.prompt_len.split(":"))
     if args.url is not None and (args.fault_rate > 0 or args.spec_ab
                                  or args.speculative == "on"
-                                 or args.trace_out or args.trace_ab):
+                                 or args.trace_out or args.trace_ab
+                                 or args.slo_ab
+                                 or args.slo_ttft is not None
+                                 or args.slo_tpot is not None):
         print("--fault-rate/--speculative/--spec-ab/--trace-out/"
-              "--trace-ab need the in-process engine (no --url)",
-              file=sys.stderr)
+              "--trace-ab/--slo-* need the in-process engine "
+              "(no --url)", file=sys.stderr)
         return 2
     if sum([args.spec_ab, args.trace_ab, args.kv_ab,
-            args.lora_ab, args.tp_ab]) > 1:
-        print("--spec-ab/--trace-ab/--kv-ab/--lora-ab/--tp-ab are "
-              "separate A/Bs; run them one at a time", file=sys.stderr)
+            args.lora_ab, args.tp_ab, args.slo_ab]) > 1:
+        print("--spec-ab/--trace-ab/--kv-ab/--lora-ab/--tp-ab/--slo-ab "
+              "are separate A/Bs; run them one at a time",
+              file=sys.stderr)
         return 2
+    if args.slo_ab and args.slo_ttft is None and args.slo_tpot is None:
+        # the on arm needs thresholds to score against; generous
+        # defaults keep the A/B about RECORDING cost, not miss churn
+        args.slo_ttft, args.slo_tpot = 1.0, 0.25
     if args.tp < 1:
         print("--tp must be >= 1", file=sys.stderr)
         return 2
@@ -740,6 +782,9 @@ def main(argv=None) -> int:
     elif args.lora_ab:
         arms = [("base", spec_def, trace_def),
                 ("lora", spec_def, trace_def)]
+    elif args.slo_ab:
+        arms = [("slooff", spec_def, trace_def),
+                ("sloon", spec_def, trace_def)]
     elif args.tp_ab:
         tp_n = args.tp if args.tp > 1 else 2
         arms = [("tp1", spec_def, trace_def),
@@ -764,8 +809,16 @@ def main(argv=None) -> int:
         if args.tp_ab:
             arm_args = argparse.Namespace(**vars(args))
             arm_args.tp = 1 if arm == "tp1" else tp_n
+        mon_on = True
+        if args.slo_ab and arm == "slooff":
+            # the OFF arm is the disabled path the PR 1/8 bar promises
+            # is near-zero: FLAGS_enable_monitor off, no policy — the
+            # serving seams pay one bool branch each
+            arm_args = argparse.Namespace(**vars(args))
+            arm_args.slo_ttft = arm_args.slo_tpot = None
+            mon_on = False
         res[arm] = _run_arm(arm_args, arm, spec_on, trace_on, prompts,
-                            arrivals, assign)
+                            arrivals, assign, mon_on=mon_on)
     if args.trace_ab:
         # the overhead verdict: decode cadence with the recorder on vs
         # off, on identical replayed load — the number that justifies
@@ -779,6 +832,22 @@ def main(argv=None) -> int:
         if a.get("throughput") and b.get("throughput"):
             print(json.dumps(
                 {"metric": "serve_trace_throughput_ratio",
+                 "value": round(b["throughput"] / a["throughput"], 3),
+                 "unit": "x (on/off)"}))
+    if args.slo_ab:
+        # the overhead verdict: decode cadence with the monitor + SLO
+        # recording path on vs fully off, on identical replayed load —
+        # the number that justifies leaving SLO scoring on in
+        # production serving (PR 8 precedent: <= 1.02x is the bar)
+        a, b = res["slooff"], res["sloon"]
+        if a.get("tpot_p50") and b.get("tpot_p50"):
+            print(json.dumps({"metric": "serve_slo_tpot_overhead",
+                              "value": round(b["tpot_p50"]
+                                             / a["tpot_p50"], 3),
+                              "unit": "x (on/off)"}))
+        if a.get("throughput") and b.get("throughput"):
+            print(json.dumps(
+                {"metric": "serve_slo_throughput_ratio",
                  "value": round(b["throughput"] / a["throughput"], 3),
                  "unit": "x (on/off)"}))
     if args.spec_ab:
@@ -974,12 +1043,14 @@ def _load_bench_adapters(server, args) -> None:
 
 
 def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
-             arrivals, assign=None) -> dict:
+             arrivals, assign=None, mon_on: bool = True) -> dict:
     """Build one server (in-process mode), drive the pre-drawn load
     through it, print the table + BENCH records (metric names suffixed
     ``_<arm>`` in A/B mode), shut down. ``assign`` is the pre-drawn
     per-request adapter name list (ignored when --adapters is 0 for
-    this arm). Returns the numbers the A/B verdict needs."""
+    this arm). ``mon_on=False`` (the --slo-ab OFF arm) runs with
+    FLAGS_enable_monitor disabled — the one-bool-branch path.
+    Returns the numbers the A/B verdict needs."""
     sfx = f"_{arm}" if arm else ""
     if assign is None:
         assign = [None] * len(prompts)
@@ -988,7 +1059,10 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
     kill_fn = None
     if args.url is None:
         from paddle_tpu import monitor, tracing
-        monitor.enable()
+        if mon_on:
+            monitor.enable()
+        else:
+            monitor.disable()
         monitor.reset()    # per-arm program/compile counters
         tracing.clear()    # per-arm ring (the off arm must not export
         #                    the on arm's leftovers)
@@ -1111,7 +1185,7 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
                       "unit": "tokens/s"}))
     print(json.dumps({"metric": f"serve_rejected{sfx}",
                       "value": stats.rejected, "unit": "count"}))
-    if server is not None:
+    if server is not None and mon_on:
         # the bucketing win in the methodology: how many prefill
         # programs this run compiled (and what that cost) — bounded by
         # len(buckets)+1 with bucketing on, O(#distinct lengths) off
@@ -1312,6 +1386,38 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
                      "value": round(_percentile(rec, q), 6),
                      "unit": "s"}))
 
+    if (server is not None and mon_on
+            and (args.slo_ttft is not None
+                 or args.slo_tpot is not None)):
+        # SLO/goodput accounting (PERF.md SLO methodology): the
+        # GET /stats rollup — digest-exact percentiles (a Router's
+        # version MERGES replica digests, never averages) scored
+        # against the armed policy. serve_goodput is the headline:
+        # the fraction of service-terminal requests the fleet served
+        # INSIDE the SLO — the quantity disaggregation papers
+        # optimize, where raw throughput can lie
+        st = server.stats()
+        tens = st.get("tenants") or {}
+        met = sum(v.get("met", 0) for v in tens.values())
+        missed = sum(v.get("missed", 0) for v in tens.values())
+        parts = []
+        for t, v in sorted(tens.items()):
+            gp = v.get("goodput")
+            parts.append(f"{t}:{'-' if gp is None else format(gp, '.3f')}"
+                         f"(burn_f={v.get('burn_fast')})")
+        print(f"slo [ttft<={args.slo_ttft} tpot<={args.slo_tpot}]: "
+              f"goodput {met}/{met + missed}, per-tenant "
+              + ", ".join(parts))
+        if met + missed:
+            print(json.dumps({"metric": f"serve_goodput{sfx}",
+                              "value": round(met / (met + missed), 4),
+                              "unit": "ratio"}))
+        for metric, rec in (("ttft", "serve_slo_ttft_p99"),
+                            ("tpot", "serve_slo_tpot_p99")):
+            agg = (st.get("metrics") or {}).get(metric, {}).get("*")
+            if agg and agg.get("p99") is not None:
+                print(json.dumps({"metric": f"{rec}{sfx}",
+                                  "value": agg["p99"], "unit": "s"}))
     if server is not None and trace_on:
         # trace-derived TTFT decomposition: WHICH phase ate the time.
         # queue = submit->dequeue, prefill = the admission span(s),
