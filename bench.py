@@ -122,13 +122,13 @@ def main(model_size: str = "350m"):
             # steady-state window (~20 s at 400 ms/step) makes the
             # tokens/s and MFU numbers robust to warmup/dispatch noise
             batch, seq, steps = 8, 2048, 50
-        kind = jax.devices()[0].device_kind.lower()
-        if "lite" in kind or "v5e" in kind:
-            peak = 394e12  # v5e bf16
-        elif "v5" in kind:
-            peak = 459e12  # v5p bf16
-        else:
-            peak = 275e12  # v4
+        # shared per-generation peak table (device/peaks.py — the same
+        # denominator the serving ledger's MFU uses, so training-bench
+        # MFU and per-program MFU stay comparable; numbers for the
+        # recorded generations are unchanged from earlier rounds)
+        from paddle_tpu.device import peaks as _peaks
+
+        peak = _peaks.peaks()["peak_flops"]
     else:
         cfg = llama_config("tiny")
         batch, seq, steps = 4, 128, 3
@@ -258,6 +258,15 @@ def main(model_size: str = "350m"):
                 open(os.path.join(here, "TPU_SESSION_RECORD.json")))
         except (OSError, ValueError):
             pass
+    try:
+        # provenance header: which machine/backend/rev produced this
+        # number — tools/bench_diff.py warns when two compared rounds'
+        # env headers disagree (cross-machine MFU is not a comparison)
+        from paddle_tpu.monitor.provenance import env_stamp
+
+        rec["env"] = env_stamp()
+    except Exception:
+        pass
     print(json.dumps(rec))
 
 

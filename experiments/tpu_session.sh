@@ -192,6 +192,28 @@ step serve_slo python tools/serve_bench.py --slo-ttft 0.5 \
 step serve_slo_ab python tools/serve_bench.py --slo-ab --layers 2 \
     --prompt-len 16:32 --max-new 16 --rate 8 --requests 16 \
     --num-pages 64 --max-pages 16 --page-size 8 --warmup
+# 6k. on-TPU program-ledger capture + regression gate (NEW — PR 16).
+#     Three halves: (a) a ledger-on mixed-feature run writes the
+#     /profile roofline snapshot — the FIRST on-chip per-program
+#     MFU/bound table (PERF.md's dots-bucket headroom ranking,
+#     derived by the instrument instead of by hand); (b) --profile-ab
+#     on identical pre-drawn load — the one-bool bar on-chip
+#     (serve_profile_tpot_overhead <= 1.05x decides whether the
+#     ledger defaults ON for serving configs); (c) bench_diff against
+#     the prior round's committed records — post-harvest, direction-
+#     aware, rc recorded in the session log (nonzero = a metric
+#     regressed >10% on-chip; read the REGRESSIONS table, don't
+#     hand-compare).
+step serve_profile python tools/serve_bench.py --profile \
+    --profile-out PROFILE_TPU.json --adapters 4 --layers 2 \
+    --shared-prefix-len 16 --prefill-chunk 16 --kv-dtype int8 \
+    --speculative on --draft-k 4 --prompt-len 8:24 --max-new 16 \
+    --rate 8 --requests 24 --num-pages 96 --max-pages 16 \
+    --page-size 8 --warmup
+step serve_profile_ab python tools/serve_bench.py --profile-ab \
+    --layers 2 --prompt-len 16:32 --max-new 16 --rate 8 \
+    --requests 16 --num-pages 64 --max-pages 16 --page-size 8 --warmup
+step bench_diff python -m tools.bench_diff --dir .
 
 # ---------------------------------------------------------------------------
 # TRAINING-SIDE PARITY + PERF LEVERS (after the serving records)

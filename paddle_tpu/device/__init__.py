@@ -9,10 +9,10 @@ from __future__ import annotations
 from ..core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace,
                           device_count, get_device, is_compiled_with_cuda,
                           is_compiled_with_tpu, set_device)
-from . import cuda, xpu
+from . import cuda, peaks, xpu
 from .cuda import Event, Stream, current_stream, stream_guard
 
-__all__ = ["get_device", "set_device", "get_all_device_type",
+__all__ = ["get_device", "set_device", "get_all_device_type", "peaks",
            "get_all_custom_device_type", "get_available_device",
            "get_available_custom_device", "is_compiled_with_cuda",
            "is_compiled_with_tpu", "is_compiled_with_xpu",

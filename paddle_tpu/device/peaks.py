@@ -1,0 +1,146 @@
+"""Per-backend peak compute/bandwidth table — the roofline's ceiling.
+
+The program ledger (``paddle_tpu.monitor.ledger``) turns XLA
+``cost_analysis()`` FLOPs/bytes plus measured dispatch time into
+achieved FLOP/s and bytes/s; THIS module supplies the denominator —
+the peak the hardware could do — so MFU and the roofline verdict
+(memory-bound vs compute-bound) mean the same thing across backends:
+
+- **TPU**: a static per-generation table keyed by substring match on
+  ``device_kind`` (bf16 dense peaks + HBM bandwidth). The v4/v5 compute
+  numbers intentionally match the ones ``bench.py`` has used for every
+  recorded ``BENCH_r*.json`` MFU, so ledger MFU and the training-bench
+  MFU stay comparable across rounds.
+- **CPU** (the tier-1/test backend): no meaningful datasheet number
+  exists, so the peak is CALIBRATED once per process — a small timed
+  matmul for FLOP/s, a timed device-array copy for bytes/s — and
+  cached. Calibrated MFU is only comparable within one host, which is
+  exactly what a CPU A/B needs (and why the record carries
+  ``source: "calibrated"``).
+- Environment overrides ``PADDLE_TPU_PEAK_FLOPS`` /
+  ``PADDLE_TPU_PEAK_BYTES`` win over both (``source: "env"``) — the
+  escape hatch for unlisted hardware or a deliberately pinned baseline.
+
+``machine_balance`` (peak FLOPs / peak bytes, FLOP-per-byte) is the
+roofline ridge: a program whose arithmetic intensity sits below it is
+memory-bound — more MXU would not help; feeding it would.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["peaks", "peak_flops", "machine_balance", "TPU_PEAKS"]
+
+# (device_kind substring, bf16 dense FLOP/s, HBM bytes/s) — first match
+# wins, so more specific generations sort before catch-alls ("v5e"
+# before "v5"; device_kind examples: "TPU v4", "TPU v5e", "TPU v5p",
+# "TPU v6e"/"TPU Trillium").
+TPU_PEAKS = (
+    ("v6e", 918e12, 1640e9),
+    ("trillium", 918e12, 1640e9),
+    ("v5e", 394e12, 819e9),
+    ("lite", 394e12, 819e9),
+    ("v5", 459e12, 2765e9),
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 45e12, 700e9),
+)
+
+_lock = threading.Lock()
+_cache: Optional[Dict[str, Any]] = None
+
+
+def _calibrate_cpu() -> Dict[str, float]:
+    """One-shot CPU peak probe: best-of-3 timed f32 matmul (2·n³ FLOPs)
+    and device-array copy (2·nbytes moved). ~100 ms once per process;
+    runs at ledger enable / first profile read, never on a dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 512
+    x = jnp.ones((n, n), jnp.float32)
+    # lint: allow-recompile(one-shot probe, result cached per process)
+    mm = jax.jit(lambda a: a @ a)
+    # lint: allow-recompile(one-shot probe, result cached per process)
+    cp = jax.jit(lambda a: a + 0.0)
+    mm(x).block_until_ready()           # compile outside the clock
+    cp(x).block_until_ready()
+    best_mm = best_cp = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        mm(x).block_until_ready()
+        best_mm = min(best_mm, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        cp(x).block_until_ready()
+        best_cp = min(best_cp, time.perf_counter() - t0)
+    flops = 2.0 * n ** 3 / max(best_mm, 1e-9)
+    byts = 2.0 * x.nbytes / max(best_cp, 1e-9)   # read + write
+    return {"peak_flops": flops, "peak_bytes_per_s": byts}
+
+
+def peaks(refresh: bool = False) -> Dict[str, Any]:
+    """The backend peak record, cached per process::
+
+        {"device_kind", "platform", "peak_flops", "peak_bytes_per_s",
+         "machine_balance", "source": "table" | "calibrated" | "env"}
+
+    Never raises: with no usable backend it falls back to a nominal
+    1 TFLOP/s (``source: "fallback"``) so a profile read cannot take
+    serving down."""
+    global _cache
+    with _lock:
+        if _cache is not None and not refresh:
+            return _cache
+    kind, platform = "unknown", "unknown"
+    flops = byts = None
+    source = "fallback"
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", platform) or platform
+        low = kind.lower()
+        for sub, f, b in TPU_PEAKS:
+            if sub in low:
+                flops, byts, source = f, b, "table"
+                break
+        if flops is None:
+            cal = _calibrate_cpu()
+            flops = cal["peak_flops"]
+            byts = cal["peak_bytes_per_s"]
+            source = "calibrated"
+    except Exception:
+        pass
+    env_f = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+    env_b = os.environ.get("PADDLE_TPU_PEAK_BYTES")
+    if env_f or env_b:
+        source = "env"
+        if env_f:
+            flops = float(env_f)
+        if env_b:
+            byts = float(env_b)
+    if not flops or flops <= 0:
+        flops = 1e12
+    if not byts or byts <= 0:
+        byts = 1e11
+    rec = {"device_kind": kind, "platform": platform,
+           "peak_flops": flops, "peak_bytes_per_s": byts,
+           "machine_balance": flops / byts, "source": source}
+    with _lock:
+        _cache = rec
+    return rec
+
+
+def peak_flops() -> float:
+    """Shorthand for ``peaks()["peak_flops"]``."""
+    return peaks()["peak_flops"]
+
+
+def machine_balance() -> float:
+    """The roofline ridge point in FLOP/byte: programs below it are
+    memory-bound on this backend, above it compute-bound."""
+    return peaks()["machine_balance"]

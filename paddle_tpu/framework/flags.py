@@ -43,6 +43,7 @@ define_flag("FLAGS_use_autotune", True)            # kernel autotune cache (ops/
 define_flag("FLAGS_log_level", 0)
 define_flag("FLAGS_enable_monitor", False)         # paddle_tpu.monitor metrics registry
 define_flag("FLAGS_enable_trace", False)           # paddle_tpu.tracing request recorder
+define_flag("FLAGS_enable_ledger", False)          # paddle_tpu.monitor.ledger program ledger
 
 
 def get_flags(flags: Union[str, List[str]]):
@@ -68,3 +69,7 @@ def set_flags(flags: Dict[str, Any]):
         from ..tracing import _sync_enabled as _sync_trace
 
         _sync_trace(bool(flags["FLAGS_enable_trace"]))
+    if "FLAGS_enable_ledger" in flags:
+        from ..monitor.ledger import _sync_enabled as _sync_ledger
+
+        _sync_ledger(bool(flags["FLAGS_enable_ledger"]))

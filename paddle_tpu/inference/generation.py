@@ -842,7 +842,8 @@ class ContinuousBatchingEngine:
             return logits[:, last_idx], mini
 
         self._prefill = monitor.monitored_jit(
-            prefill_one, name="cb_prefill", donate_argnums=(2,))
+            prefill_one, name="cb_prefill",
+            owner=self._monitor_engine, donate_argnums=(2,))
 
         def prefill_chunk_fn(params, ids, mini, pos, last_idx, bank,
                              aidx):
@@ -856,7 +857,7 @@ class ContinuousBatchingEngine:
 
         self._prefill_chunk = monitor.monitored_jit(
             prefill_chunk_fn, name="cb_prefill_chunk",
-            donate_argnums=(2,))
+            owner=self._monitor_engine, donate_argnums=(2,))
 
         def admit(caches, mini, slot):
             return jax.tree.map(
@@ -866,6 +867,7 @@ class ContinuousBatchingEngine:
         # mini is NOT donated: its rows are dtype-cast into the pool, so
         # the buffers can't alias (donation would only warn)
         self._admit = monitor.monitored_jit(admit, name="cb_admit",
+                                            owner=self._monitor_engine,
                                             donate_argnums=(0,))
 
         def admit_state(lens, last, done, active, samp, slot, plen,
@@ -893,6 +895,7 @@ class ContinuousBatchingEngine:
 
         self._admit_state = monitor.monitored_jit(
             admit_state, name="cb_admit_state",
+            owner=self._monitor_engine,
             donate_argnums=(0, 1, 2, 3, 4))
         self._segment_cache = {}
 
@@ -1673,7 +1676,8 @@ class ContinuousBatchingEngine:
                         caches)
 
             self._segment_cache[n_steps] = monitor.monitored_jit(
-                segment, name="cb_segment", donate_argnums=(7,))
+                segment, name="cb_segment",
+                owner=self._monitor_engine, donate_argnums=(7,))
         return self._segment_cache[n_steps]
 
     # -- batched speculative decoding (per-slot capability) ------------------
@@ -1750,7 +1754,8 @@ class ContinuousBatchingEngine:
                 return toks, n_acc, new_last, lens + n_acc, caches
 
             self._segment_cache[key_] = monitor.monitored_jit(
-                spec_step, name="cb_spec_step", donate_argnums=(6,))
+                spec_step, name="cb_spec_step",
+                owner=self._monitor_engine, donate_argnums=(6,))
         return self._segment_cache[key_]
 
     def _coverage_limit(self, slot: int) -> int:
@@ -2018,6 +2023,16 @@ class ContinuousBatchingEngine:
                 monitor.remove_series(name, engine=self._monitor_engine)
             except Exception:
                 pass
+        # the program ledger rows this engine owned (prefill/chunk/
+        # admit/segment/spec/quant/lora_install programs) and their
+        # {program=...} series retire with it — same contract as the
+        # per-engine series above
+        try:
+            from ..monitor import ledger
+
+            ledger.release(self._monitor_engine)
+        except Exception:
+            pass
         reg = getattr(self, "adapters", None)   # __del__-safe: a
         if reg is not None:                     # half-built engine has
             reg.close()                         # no registry attr yet
@@ -2283,7 +2298,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             return out
 
         self._reset_scales = monitor.monitored_jit(
-            reset_scales, name="cb_reset_scales", donate_argnums=(0,))
+            reset_scales, name="cb_reset_scales",
+            owner=self._monitor_engine, donate_argnums=(0,))
 
     def _make_caches(self):
         # TP: pools (and int8 scales) shard on the kv-head axis; the

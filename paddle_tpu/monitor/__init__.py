@@ -479,23 +479,44 @@ def uninstall_op_hook() -> None:
 
 
 def monitored_jit(fn: Optional[Callable] = None, *, name: Optional[str] = None,
-                  **jit_kwargs):
-    """``jax.jit`` wrapper that counts cache misses and compile seconds.
+                  owner: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` wrapper that counts cache misses and compile seconds
+    per PROGRAM and feeds the program ledger.
 
     A miss is detected by the traced body actually running (jax only
     re-enters the Python function when the (shape, dtype, static-arg)
     signature is new); the wall time of that call — trace + lower +
     compile — is charged to ``paddle_tpu_jit_compile_seconds_total``.
-    Cache hits pay one bool check over plain ``jax.jit``. Usable as a
+    Both miss counters carry a ``program`` label alongside ``fn``: one
+    entry point compiles many programs (a prefill per bucket width, a
+    spec step per k), and attributing warmup cost per program is what
+    lets a zero-post-warmup-compiles assertion NAME the violator.
+
+    The program id (``<name>:<hash>`` over treedef + avals + sharding +
+    static reprs — see :func:`ledger.program_id`) is memoized per arg
+    signature, so a cache hit computes one cheap signature tuple and
+    one dict lookup, not a hash. Cache hits with monitor AND ledger off
+    pay one bool check over plain ``jax.jit``.
+
+    ``owner`` ties every program this wrapper creates to an engine
+    label so ``engine.close()`` → ``ledger.release(owner)`` can retire
+    its ledger rows and series; ownerless wrappers (``to_static``,
+    bench drivers) register process-lifetime programs. Usable as a
     decorator or called directly; ``name`` labels the metrics (defaults
     to the function's __name__)."""
     def wrap(fn):
         import jax
 
+        from . import ledger as _ledger
+
         label = name or getattr(fn, "__name__", "jit")
         # thread-local: jax traces in the CALLING thread, so per-thread
         # flags keep concurrent servers from cross-attributing misses
         missed = threading.local()
+        variants: Dict[Any, str] = {}   # cheap arg-sig -> program id
+        donate = jit_kwargs.get("donate_argnums", ())
+        if isinstance(donate, int):
+            donate = (donate,)
 
         @functools.wraps(fn)
         def traced(*a, **k):
@@ -504,27 +525,69 @@ def monitored_jit(fn: Optional[Callable] = None, *, name: Optional[str] = None,
 
         jitted = jax.jit(traced, **jit_kwargs)
 
+        def _pid(a, k):
+            leaves, treedef = jax.tree_util.tree_flatten((a, k))
+            sig = (treedef, tuple(
+                (x.shape, str(x.dtype)) if hasattr(x, "shape")
+                and hasattr(x, "dtype")
+                else x if isinstance(x, (int, float, bool, str,
+                                         bytes, type(None)))
+                else repr(x)
+                for x in leaves))
+            pid = variants.get(sig)
+            if pid is None:
+                pid = _ledger.program_id(label, a, k)
+                variants[sig] = pid
+            return pid
+
         @functools.wraps(fn)
         def call(*a, **k):
-            if not _enabled:
+            if not (_enabled or _ledger._enabled):
                 return jitted(*a, **k)
             missed.flag = False
             t0 = time.perf_counter()
             out = jitted(*a, **k)
-            if missed.flag:
-                dt = time.perf_counter() - t0
+            was_miss = missed.flag
+            led = _ledger._enabled
+            if not (was_miss or led):
+                return out
+            dt = time.perf_counter() - t0
+            pid = _pid(a, k)
+            if was_miss and _enabled:
                 counter("paddle_tpu_jit_cache_miss_total",
                         "jit traces+compiles (cache misses) per entry "
-                        "point", ("fn",)).labels(fn=label).inc()
+                        "point and program",
+                        ("fn", "program")).labels(
+                            fn=label, program=pid).inc()
                 counter("paddle_tpu_jit_compile_seconds_total",
                         "wall seconds spent tracing+compiling per entry "
-                        "point", ("fn",)).labels(fn=label).inc(dt)
+                        "point and program",
+                        ("fn", "program")).labels(
+                            fn=label, program=pid).inc(dt)
+            if led:
+                _ledger.record(pid, label, owner, jitted, a, k, dt,
+                               was_miss, donate)
             return out
 
         call._jitted = jitted  # escape hatch: .lower / cache inspection
+        call._program_ids = variants  # pids seen so far, by arg sig
         return call
 
     return wrap(fn) if fn is not None else wrap
+
+
+def jit_miss_by_fn(snap: Optional[dict] = None) -> Dict[str, float]:
+    """Cache-miss counts summed per entry point (``fn`` label) — the
+    pre-PR 16 per-fn view of ``paddle_tpu_jit_cache_miss_total``, for
+    callers/tests that don't care which program of an entry point
+    compiled. Pass a ``snapshot()`` to diff two moments."""
+    snap = snapshot() if snap is None else snap
+    out: Dict[str, float] = {}
+    m = snap.get("metrics", {}).get("paddle_tpu_jit_cache_miss_total")
+    for rec in (m or {}).get("samples", []):
+        fn = rec["labels"].get("fn", "?")
+        out[fn] = out.get(fn, 0.0) + rec["value"]
+    return out
 
 
 # -- built-in callback gauges: HBM / live arrays ----------------------------

@@ -1,0 +1,535 @@
+"""paddle_tpu.monitor.ledger — process-wide compiled-program ledger.
+
+PR 15 tells an operator *whether* serving is slow (goodput/burn) and
+PR 8 *which request phase* was slow (trace decomposition); THIS module
+answers the remaining question — *which compiled program* is eating
+the step, and how far it sits from the hardware roofline.
+
+Every :func:`paddle_tpu.monitor.monitored_jit` program (engine
+prefill/chunk/admit/segment/spec/quant/lora-install programs,
+``to_static`` graphs, bench drivers) registers here under a **stable
+program id** — ``<name>:<hash>`` where the hash covers the entry-point
+name, the flattened arg treedef, every array leaf's aval
+(shape/dtype) + sharding spec, and the repr of non-array (static)
+leaves. The id is a pure function of that signature: the same program
+gets the same id across process restarts, replicas, and replay — which
+is what lets a Router merge per-replica ledgers exactly and lets
+``bench_diff`` line up two rounds (MIGRATING.md bullet).
+
+Per program the ledger holds:
+
+- XLA ``cost_analysis()`` at first sight — FLOPs, bytes accessed,
+  output bytes (``jitted.lower(...).cost_analysis()``: trace+lower
+  only, no second backend compile) — plus donated-argument bytes where
+  the jit wrapper declared donation;
+- compile count + compile wall seconds (the ``monitored_jit`` miss
+  path attributes them per program id, so warmup cost is attributable
+  and a zero-post-warmup-compiles assertion can NAME the violator);
+- a per-program :class:`~paddle_tpu.monitor.slo.LatencyDigest` of
+  host-observed dispatch walls (one fixed bucketization → replica
+  ledgers MERGE exactly, the PR 15 property, for free). The compiling
+  call's wall is excluded from the digest — a 2 s compile inside a
+  1 ms program's latency distribution would be a lie — and charged to
+  compile seconds instead.
+
+From these it derives achieved FLOP/s and bytes/s (total work over
+total digest seconds), arithmetic intensity (FLOPs / bytes — a program
+property), MFU against the per-backend peak table
+(:mod:`paddle_tpu.device.peaks`) and the roofline verdict:
+intensity below the machine balance → memory-bound, above →
+compute-bound.
+
+Cost model — the PR 15 one-bool bar: with ``FLAGS_enable_ledger`` off
+every dispatch pays exactly one extra bool branch inside
+``monitored_jit``. On, a dispatch pays one arg-signature flatten
+(O(leaves) tuple build), one dict hit, one digest observe and two
+counter bumps — ``serve_bench --profile-ab`` keeps the measured TPOT
+overhead ≤ 1.05x. Cost analysis, peak calibration, and lowering happen
+once per program, never per dispatch.
+
+Ownership & retirement: engines pass ``owner=<engine label>`` into
+``monitored_jit``; ``release(owner)`` (called from ``engine.close()``)
+drops every program whose LAST owner retired and removes its
+``{program=...}`` monitor series — the ``TestSeriesRetirement``
+contract extended to the ledger. Ownerless programs (``to_static``,
+bench drivers) are process-lifetime by design. The per-program
+``paddle_tpu_jit_cache_miss_total{fn,program}`` compile counters are
+process-wide compile HISTORY and intentionally survive engine close.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from .slo import LatencyDigest
+
+__all__ = [
+    "enable", "disable", "enabled", "reset",
+    "program_id", "record", "release", "owned_programs",
+    "profile", "merge_profiles",
+    "DISPATCH_COUNTER", "SECONDS_COUNTER", "MFU_GAUGE",
+]
+
+# one fixed digest config for every program digest — identical
+# bucketization is what makes cross-replica merges exact. Dispatch
+# walls span ~µs (tiny admit programs on CPU) to minutes (big compiles
+# excluded, but cold first segments on real models are seconds).
+_DIGEST_KW = dict(lo=1e-6, hi=1e3, buckets_per_decade=16)
+
+DISPATCH_COUNTER = "paddle_tpu_program_dispatches_total"
+SECONDS_COUNTER = "paddle_tpu_program_seconds_total"
+MFU_GAUGE = "paddle_tpu_program_mfu"
+
+_enabled = False     # synced from FLAGS_enable_ledger below
+_lock = threading.Lock()
+_records: Dict[str, "_ProgramRecord"] = {}
+_owners: Dict[str, Set[str]] = {}    # pid -> live owner labels
+_peaks: Optional[Dict[str, Any]] = None
+
+
+class _ProgramRecord:
+    __slots__ = ("pid", "name", "signature", "owners_seen", "flops",
+                 "bytes_accessed", "output_bytes", "donated_bytes",
+                 "arg_bytes", "compiles", "compile_seconds",
+                 "dispatches", "digest")
+
+    def __init__(self, pid: str, name: str, signature: str):
+        self.pid = pid
+        self.name = name
+        self.signature = signature
+        self.owners_seen: Set[str] = set()
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self.output_bytes: Optional[float] = None
+        self.donated_bytes: Optional[int] = None
+        self.arg_bytes: Optional[int] = None
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.dispatches = 0
+        self.digest = LatencyDigest(**_DIGEST_KW)
+
+    # -- wire format (what /profile serves; what Router merges) -------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "program": self.pid, "name": self.name,
+            "signature": self.signature,
+            "owners": sorted(self.owners_seen),
+            "flops": self.flops, "bytes_accessed": self.bytes_accessed,
+            "output_bytes": self.output_bytes,
+            "donated_bytes": self.donated_bytes,
+            "arg_bytes": self.arg_bytes,
+            "compiles": self.compiles,
+            "compile_seconds": round(self.compile_seconds, 6),
+            "dispatches": self.dispatches,
+            "digest": self.digest.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "_ProgramRecord":
+        rec = cls(d["program"], d.get("name", d["program"]),
+                  d.get("signature", ""))
+        rec.owners_seen = set(d.get("owners") or ())
+        for f in ("flops", "bytes_accessed", "output_bytes",
+                  "donated_bytes", "arg_bytes"):
+            setattr(rec, f, d.get(f))
+        rec.compiles = int(d.get("compiles", 0))
+        rec.compile_seconds = float(d.get("compile_seconds", 0.0))
+        rec.dispatches = int(d.get("dispatches", 0))
+        if d.get("digest"):
+            rec.digest = LatencyDigest.from_dict(d["digest"])
+        return rec
+
+    def merge(self, other: "_ProgramRecord") -> "_ProgramRecord":
+        """Exact cross-shard merge (same pid → same program → identical
+        cost analysis; counters add, digests add bucketwise)."""
+        self.owners_seen |= other.owners_seen
+        for f in ("flops", "bytes_accessed", "output_bytes",
+                  "donated_bytes", "arg_bytes"):
+            if getattr(self, f) is None:
+                setattr(self, f, getattr(other, f))
+        self.compiles += other.compiles
+        self.compile_seconds += other.compile_seconds
+        self.dispatches += other.dispatches
+        self.digest.merge(other.digest)
+        return self
+
+
+# -- enable / disable --------------------------------------------------------
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _sync_enabled(value: bool) -> None:
+    """Flag push target (framework.flags.set_flags): flips the one
+    fast-path bool ``monitored_jit`` branches on; enabling also warms
+    the peak cache so per-dispatch MFU never calibrates on a serving
+    path."""
+    global _enabled
+    _enabled = bool(value)
+    if _enabled:
+        _ensure_peaks()
+
+
+def enable() -> None:
+    """Turn the ledger on (equivalent to
+    ``set_flags({"FLAGS_enable_ledger": True})``)."""
+    from ..framework.flags import set_flags
+
+    set_flags({"FLAGS_enable_ledger": True})
+
+
+def disable() -> None:
+    from ..framework.flags import set_flags
+
+    set_flags({"FLAGS_enable_ledger": False})
+
+
+def reset() -> None:
+    """Drop every program record and owner binding (the per-arm bench
+    idiom, next to ``monitor.reset()``); peak cache survives."""
+    with _lock:
+        pids = list(_records)
+        _records.clear()
+        _owners.clear()
+    for pid in pids:
+        _retire_series(pid)
+
+
+def _ensure_peaks() -> Optional[Dict[str, Any]]:
+    global _peaks
+    with _lock:
+        if _peaks is not None:
+            return _peaks
+    try:
+        from ..device import peaks as peaks_mod
+
+        rec = peaks_mod.peaks()
+    except Exception:
+        rec = None
+    with _lock:
+        if _peaks is None:
+            _peaks = rec
+        return _peaks
+
+
+# -- program identity --------------------------------------------------------
+
+
+def _leaf_sig(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        sh = getattr(x, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        core = f"{dtype}{list(shape)}"
+        return f"{core}@{spec}" if spec is not None else core
+    if isinstance(x, (int, float, bool, str, bytes, type(None))):
+        return repr(x)
+    return f"{type(x).__name__}:{x!r}"
+
+
+def program_id(name: str, args: Sequence[Any],
+               kwargs: Dict[str, Any]) -> str:
+    """Stable program id for one (entry point, arg signature): the
+    entry-point name plus a short blake2b over the flattened treedef
+    and every leaf's aval/sharding (arrays) or repr (statics). Pure
+    function of the call signature — identical across restarts,
+    replicas, and replay."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((tuple(args), kwargs))
+    canon = "|".join([name, str(treedef)]
+                     + [_leaf_sig(x) for x in leaves])
+    h = hashlib.blake2b(canon.encode(), digest_size=4).hexdigest()
+    return f"{name}:{h}"
+
+
+def _human_sig(args: Sequence[Any], kwargs: Dict[str, Any]) -> str:
+    """Short human-readable signature for the profile table (array
+    avals only — statics are in the id hash but would bloat a table)."""
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten((tuple(args), kwargs))
+    parts = []
+    for x in leaves:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{dtype}{list(shape)}")
+        if len(parts) >= 8:
+            parts.append("...")
+            break
+    return " ".join(parts)
+
+
+# -- recording (called by monitored_jit, ledger-enabled path only) -----------
+
+
+def _cost_analysis(jitted, args, kwargs) -> Dict[str, Optional[float]]:
+    """FLOPs / bytes accessed / output bytes from XLA's lowered cost
+    analysis. ``lower()`` traces + lowers only (no backend compile) —
+    cheap enough to pay once per program at registration. Any failure
+    degrades to Nones: the ledger must never take a dispatch down."""
+    out: Dict[str, Optional[float]] = {
+        "flops": None, "bytes_accessed": None, "output_bytes": None}
+    try:
+        ca = jitted.lower(*args, **kwargs).cost_analysis() or {}
+        if "flops" in ca:
+            out["flops"] = float(ca["flops"])
+        if "bytes accessed" in ca:
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+        out_bytes = 0.0
+        seen_out = False
+        for k, v in ca.items():
+            # per-shape-index output keys vary across jax versions
+            # ("bytes accessed output", "bytes accessedout{}", ...)
+            if k.startswith("bytes accessed") and "out" in k[14:]:
+                out_bytes += float(v)
+                seen_out = True
+        if seen_out:
+            out["output_bytes"] = out_bytes
+    except Exception:
+        pass
+    return out
+
+
+def record(pid: str, name: str, owner: Optional[str], jitted,
+           args: Sequence[Any], kwargs: Dict[str, Any], dt: float,
+           compiled: bool, donate: Sequence[int] = ()) -> None:
+    """One dispatch of program ``pid``: register on first sight
+    (cost analysis + donated/arg bytes), count the dispatch, feed the
+    digest (non-compile calls only), bump the ``{program=...}`` series.
+    Called by ``monitored_jit`` only while the ledger is enabled."""
+    if not _enabled:
+        return
+    with _lock:
+        rec = _records.get(pid)
+        is_new = rec is None
+        if is_new:
+            rec = _records[pid] = _ProgramRecord(
+                pid, name, _human_sig(args, kwargs))
+        if owner:
+            rec.owners_seen.add(owner)
+            _owners.setdefault(pid, set()).add(owner)
+        rec.dispatches += 1
+        if compiled:
+            rec.compiles += 1
+            rec.compile_seconds += dt
+        else:
+            rec.digest.observe(dt)
+    if is_new:
+        # outside the ledger lock: lowering can take seconds on big
+        # models and must not block other programs' dispatch recording
+        cost = _cost_analysis(jitted, args, kwargs)
+        arg_bytes = 0
+        donated_bytes = 0
+        try:
+            import jax
+
+            leaves, _ = jax.tree_util.tree_flatten(
+                (tuple(args), kwargs))
+            arg_bytes = sum(int(x.nbytes) for x in leaves
+                            if hasattr(x, "nbytes"))
+            for i in donate:
+                if 0 <= i < len(args):
+                    d_leaves, _ = jax.tree_util.tree_flatten(args[i])
+                    donated_bytes += sum(int(x.nbytes) for x in d_leaves
+                                         if hasattr(x, "nbytes"))
+        except Exception:
+            pass
+        with _lock:
+            rec2 = _records.get(pid)
+            if rec2 is not None:
+                rec2.flops = cost["flops"]
+                rec2.bytes_accessed = cost["bytes_accessed"]
+                rec2.output_bytes = cost["output_bytes"]
+                rec2.arg_bytes = arg_bytes
+                rec2.donated_bytes = donated_bytes or None
+    if _enabled:   # series bumps (monitor no-ops them when IT is off)
+        from . import counter, gauge
+
+        counter(DISPATCH_COUNTER,
+                "ledger: dispatches per compiled program "
+                "(compiling calls included)",
+                ("program",)).labels(program=pid).inc()
+        counter(SECONDS_COUNTER,
+                "ledger: host-observed dispatch wall seconds per "
+                "compiled program (compile walls excluded — see "
+                "paddle_tpu_jit_compile_seconds_total{program})",
+                ("program",)).labels(program=pid).inc(
+                    0.0 if compiled else dt)
+        pk = _peaks
+        flops = rec.flops
+        if (not compiled and pk is not None and flops
+                and dt > 0):
+            gauge(MFU_GAUGE,
+                  "ledger: model FLOP utilization of the LATEST "
+                  "dispatch vs the backend peak table",
+                  ("program",)).labels(program=pid).set(
+                      round(flops / dt / pk["peak_flops"], 6))
+
+
+# -- ownership / retirement --------------------------------------------------
+
+
+def _retire_series(pid: str) -> None:
+    from . import remove_series
+
+    for series in (DISPATCH_COUNTER, SECONDS_COUNTER, MFU_GAUGE):
+        try:
+            remove_series(series, program=pid)
+        except Exception:
+            pass
+
+
+def release(owner: str) -> int:
+    """Retire one owner (engine) label: programs whose LAST live owner
+    this was are dropped from the ledger and their ``{program=...}``
+    series removed — the ``TestSeriesRetirement`` contract. Programs
+    still co-owned (a twin replica serving the same model) or ownerless
+    (``to_static``; process-lifetime) are untouched. Returns programs
+    dropped. Idempotent."""
+    dropped: List[str] = []
+    with _lock:
+        for pid in list(_owners):
+            live = _owners[pid]
+            if owner in live:
+                live.discard(owner)
+                if not live:
+                    del _owners[pid]
+                    _records.pop(pid, None)
+                    dropped.append(pid)
+    for pid in dropped:
+        _retire_series(pid)
+    return len(dropped)
+
+
+def owned_programs(owner: str) -> List[str]:
+    """Program ids currently owned by ``owner`` (test/debug surface)."""
+    with _lock:
+        return sorted(pid for pid, live in _owners.items()
+                      if owner in live)
+
+
+# -- read side ---------------------------------------------------------------
+
+
+def _derived(d: Dict[str, Any], pk: Optional[Dict[str, Any]]
+             ) -> Dict[str, Any]:
+    """Roofline-derived view of one wire record: achieved FLOP/s and
+    bytes/s over the digest's total seconds, arithmetic intensity, MFU
+    and bandwidth utilization vs the backend peaks, and the verdict —
+    intensity under the machine balance is memory-bound."""
+    dig = LatencyDigest.from_dict(d["digest"])
+    out = dict(d)
+    out["summary"] = dig.summary()
+    total_s = dig.sum
+    out["total_seconds"] = round(total_s, 6)
+    flops = d.get("flops")
+    byts = d.get("bytes_accessed")
+    if flops and byts:
+        out["intensity"] = round(flops / byts, 4)
+    else:
+        out["intensity"] = None
+    if total_s > 0 and dig.count:
+        if flops:
+            out["achieved_flops_per_s"] = flops * dig.count / total_s
+        if byts:
+            out["achieved_bytes_per_s"] = byts * dig.count / total_s
+    if pk:
+        af = out.get("achieved_flops_per_s")
+        ab = out.get("achieved_bytes_per_s")
+        if af:
+            out["mfu"] = round(af / pk["peak_flops"], 6)
+        if ab:
+            out["bw_util"] = round(ab / pk["peak_bytes_per_s"], 6)
+        if out["intensity"] is not None:
+            out["bound"] = ("memory-bound"
+                            if out["intensity"] < pk["machine_balance"]
+                            else "compute-bound")
+    return out
+
+
+def profile(owners: Optional[Sequence[str]] = None,
+            top_k: Optional[int] = None,
+            derived: bool = True) -> Dict[str, Any]:
+    """The ledger snapshot — what ``Server.profile()`` / ``GET
+    /profile`` serve and what :func:`merge_profiles` merges::
+
+        {"programs": {pid: <record wire dict [+ derived roofline
+                            fields when derived=True]>},
+         "peaks": <device peak record or None>,
+         "top": [pid, ...]   # by total digest seconds, descending
+         "total_seconds": <sum over programs>}
+
+    ``owners`` filters to programs owned by any of the given engine
+    labels (a Server scopes to its engine; None = the whole process).
+    ``top_k`` truncates ``top`` (the table everyone reads first);
+    ``programs`` always carries every matching record, because a
+    truncated shard would make the Router's fleet merge WRONG."""
+    pk = _ensure_peaks() if derived else None
+    with _lock:
+        recs = list(_records.values())
+        own = {p: set(s) for p, s in _owners.items()}
+    if owners is not None:
+        want = set(owners)
+        recs = [r for r in recs
+                if own.get(r.pid, set()) & want or r.owners_seen & want]
+    wire = {r.pid: r.to_dict() for r in recs}
+    if derived:
+        wire = {pid: _derived(d, pk) for pid, d in wire.items()}
+    totals = {pid: (d["total_seconds"] if derived
+                    else LatencyDigest.from_dict(d["digest"]).sum)
+              for pid, d in wire.items()}
+    top = sorted(totals, key=lambda p: -totals[p])
+    if top_k is not None:
+        top = top[:top_k]
+    return {"programs": wire, "peaks": pk, "top": top,
+            "total_seconds": round(sum(totals.values()), 6)}
+
+
+def merge_profiles(shards: Sequence[Optional[Dict[str, Any]]],
+                   top_k: Optional[int] = None) -> Dict[str, Any]:
+    """EXACT fleet merge of per-replica :func:`profile` shards — the
+    ``fleet_rollup`` idiom applied to program records: same program id
+    → counters add, digests add bucketwise (identical fixed
+    bucketization), cost analysis taken from the first shard that has
+    it. Never an average of percentiles. ``None``/empty shards (a
+    mid-restart replica) are skipped."""
+    merged: Dict[str, _ProgramRecord] = {}
+    pk = None
+    for shard in shards:
+        if not shard:
+            continue
+        if pk is None:
+            pk = shard.get("peaks")
+        for pid, d in (shard.get("programs") or {}).items():
+            rec = _ProgramRecord.from_dict(d)
+            if pid in merged:
+                merged[pid].merge(rec)
+            else:
+                merged[pid] = rec
+    wire = {pid: _derived(r.to_dict(), pk)
+            for pid, r in merged.items()}
+    top = sorted(wire, key=lambda p: -wire[p]["total_seconds"])
+    if top_k is not None:
+        top = top[:top_k]
+    return {"programs": wire, "peaks": pk, "top": top,
+            "total_seconds": round(
+                sum(d["total_seconds"] for d in wire.values()), 6)}
+
+
+# -- flag sync (import-time): FLAGS_enable_ledger may already be set via
+#    the environment; importing the module honors it ------------------------
+def _init_from_flags():
+    from ..framework.flags import get_flags
+
+    _sync_enabled(bool(
+        get_flags("FLAGS_enable_ledger")["FLAGS_enable_ledger"]))
+
+
+_init_from_flags()

@@ -1,0 +1,82 @@
+"""Environment provenance stamp for BENCH records and flight dumps.
+
+A bench record or a flight-recorder dump is evidence; evidence without
+a chain of custody is an anecdote. ``bench_diff`` comparing two rounds
+is only sound when both ran the same backend on comparable machines —
+so every BENCH record and every flight-recorder dump carries this
+``env`` header and ``bench_diff`` warns when the headers disagree.
+
+The stamp is computed ONCE per process and cached (the fields cannot
+change mid-run; ``git rev-parse`` forks a subprocess, which must not
+happen per record). Every field degrades to ``None`` rather than
+raising — a missing git binary must not take a bench down.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["env_stamp"]
+
+_lock = threading.Lock()
+_cache: Optional[Dict[str, Any]] = None
+
+
+def _git_rev() -> Optional[str]:
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            capture_output=True, timeout=5)
+        if out.returncode == 0:
+            return out.stdout.decode().strip() or None
+    except Exception:
+        pass
+    return None
+
+
+def env_stamp(extra: Optional[Dict[str, Any]] = None,
+              refresh: bool = False) -> Dict[str, Any]:
+    """The cached provenance header::
+
+        {"jax", "python", "backend", "device_kind", "device_count",
+         "hostname", "pid", "git_rev"}
+
+    ``extra`` (e.g. ``{"tp_degree": 2}`` or a mesh shape) is merged
+    into a COPY — the cache itself never mutates, so two callers with
+    different extras cannot contaminate each other."""
+    global _cache
+    with _lock:
+        cached = _cache
+    if cached is None or refresh:
+        stamp: Dict[str, Any] = {
+            "jax": None, "python": sys.version.split()[0],
+            "backend": None, "device_kind": None, "device_count": None,
+            "hostname": socket.gethostname(), "pid": os.getpid(),
+            "git_rev": _git_rev(),
+        }
+        try:
+            import jax
+
+            stamp["jax"] = jax.__version__
+            devs = jax.devices()
+            stamp["backend"] = devs[0].platform
+            stamp["device_kind"] = getattr(devs[0], "device_kind",
+                                           devs[0].platform)
+            stamp["device_count"] = len(devs)
+        except Exception:
+            pass
+        with _lock:
+            _cache = stamp
+        cached = stamp
+    if extra:
+        out = dict(cached)
+        out.update(extra)
+        return out
+    return dict(cached)

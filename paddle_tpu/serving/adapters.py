@@ -111,6 +111,7 @@ class AdapterRegistry:
 
         self._install = monitor.monitored_jit(install,
                                               name="lora_install",
+                                              owner=self._engine,
                                               donate_argnums=(0, 1))
 
     # -- lifecycle (engine-driving thread, between segments) -----------------

@@ -279,6 +279,20 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                                  "SLO tracker"})
                 else:
                     self._json(200, fn())
+            elif self.path.startswith("/profile"):
+                # program-ledger roofline table (monitor.ledger): a
+                # Server serves its engine's shard; a Router MERGES
+                # every replica's shard exactly (same program id →
+                # digests add bucketwise). Feed it to
+                # tools/monitor_report.py --profile. Empty "programs"
+                # (not a 404) while FLAGS_enable_ledger is off.
+                fn = getattr(server, "profile", None)
+                if fn is None:
+                    self._json(404, {
+                        "error": "no /profile: this front exposes no "
+                                 "program ledger"})
+                else:
+                    self._json(200, fn())
             elif self.path.startswith("/trace"):
                 self._trace_response()
             elif (payload := monitor.http_payload(self.path)) is not None:

@@ -678,6 +678,34 @@ class Router:
                                          if e.get("slow")]}
         return out
 
+    def profile(self, top_k: Optional[int] = None) -> dict:
+        """The fleet program-ledger rollup — ``GET /profile``. EXACT
+        the same way :meth:`stats` is: every live replica's
+        :meth:`Server.profile` shard is merged per program id — digest
+        buckets add elementwise (one fixed bucketization), dispatch/
+        compile counters sum, cost analysis comes from the first shard
+        that has it — never an average of per-replica MFUs. Dead and
+        mid-swap replicas are skipped, same as the SLO rollup."""
+        from ..monitor import ledger as _ledger
+
+        with self._lock:
+            reps = list(self._replicas)
+        shards = []
+        for rep in reps:
+            if rep.dead:
+                continue
+            fn = getattr(rep.server, "profile", None)
+            if fn is None:
+                continue
+            try:
+                shards.append(fn())
+            except Exception:   # mid-swap replica: skip its shard
+                pass
+        out = _ledger.merge_profiles(shards, top_k=top_k)
+        out["router"] = self.monitor_router
+        out["replicas"] = len(shards)
+        return out
+
     # -- drain / rolling restart ---------------------------------------------
     def drain(self, index: Optional[int] = None,
               timeout: Optional[float] = None) -> bool:
@@ -793,6 +821,16 @@ class Router:
         for rep in self._replicas:
             try:
                 rep.server.shutdown(drain=False, timeout=timeout)
+            except Exception:
+                pass
+            # the router built these engines (engine_factory), so it
+            # closes them: per-engine monitor series AND the program
+            # ledger rows they own retire here — Router.shutdown()
+            # leaves zero {program=...} series behind
+            try:
+                eng = getattr(rep.server, "engine", None)
+                if eng is not None:
+                    eng.close()
             except Exception:
                 pass
         # pumps unwind on their cancelled/failed inner handles; give

@@ -75,6 +75,7 @@ import numpy as np
 
 from .. import monitor
 from .. import tracing as trace
+from ..monitor import ledger as _ledger
 from ..monitor import slo as _slo
 from ..inference.generation import (ADMISSION_MODES, GenerationConfig,
                                     PagePoolExhausted, _prompt_ids,
@@ -842,10 +843,37 @@ class Server:
             s = self.slo.snapshot()
             if s is not None:
                 snap["slo"] = s
+        if _ledger.enabled():
+            # compact program-ledger block: top programs by total
+            # dispatch seconds (host dict walk; full table on /profile)
+            prof = self.profile(top_k=5)
+            if prof["programs"]:
+                snap["profile"] = {
+                    "programs": len(prof["programs"]),
+                    "total_seconds": prof["total_seconds"],
+                    "top": [{k: prof["programs"][pid].get(k)
+                             for k in ("program", "total_seconds",
+                                       "dispatches", "mfu", "bound")}
+                            for pid in prof["top"]],
+                }
         with self._lock:
             if self._flight_dumps:
                 snap["flight_dump"] = self._flight_dumps[-1]
         return snap
+
+    def profile(self, top_k: Optional[int] = None) -> dict:
+        """This server's program-ledger shard — the per-program roofline
+        table ``GET /profile`` serves and the fleet Router merge-exacts
+        across replicas: ``{"programs": {pid: cost/compiles/digest/
+        MFU/bound}, "peaks", "top", "total_seconds"}``. Scoped to the
+        programs this server's ENGINE owns (plus ownerless process-wide
+        programs when the engine exposes no monitor label). Empty when
+        ``FLAGS_enable_ledger`` is off."""
+        own = getattr(self.engine, "_monitor_engine", None)
+        prof = _ledger.profile(
+            owners=[own] if own else None, top_k=top_k)
+        prof["server"] = self.monitor_server
+        return prof
 
     def stats(self) -> dict:
         """Single-server SLO/goodput rollup — the same record shape

@@ -137,7 +137,11 @@ def configure(capacity: Optional[int] = None,
             if capacity < 1:
                 raise ValueError(
                     f"capacity must be >= 1, got {capacity}")
-            _ring = deque(_ring, maxlen=capacity)
+            if capacity != _ring.maxlen:
+                # rebuild (never re-point): a shrink must DROP the
+                # oldest events, keeping the newest tail that fits —
+                # deque(iterable, maxlen=n) keeps the last n items
+                _ring = deque(_ring, maxlen=capacity)
         if dump_dir is not None:
             _dump_dir = dump_dir
 
@@ -332,10 +336,15 @@ def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
             _dump_dir or tempfile.gettempdir(),
             f"paddle_tpu_flight_{os.getpid()}_{next(_dump_seq)}"
             f"_{safe}.json")
+    from ..monitor.provenance import env_stamp
+
     return export_chrome(path, other={
         "reason": reason,
         "dumped_at_unix": time.time(),
         "pid": os.getpid(),
+        # chain of custody: which machine/backend/rev produced this
+        # black box — without it a dump cannot be tied to a config
+        "env": env_stamp(),
     })
 
 

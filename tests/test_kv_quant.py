@@ -646,14 +646,10 @@ class TestServerAndMetrics:
         srv = Server(eng, segment_steps=3, warmup=True)
         try:
             assert srv.wait_ready(300) and srv.status == "ok"
-            pre = {s["labels"]["fn"]: s["value"]
-                   for s in monitor.snapshot()["metrics"]
-                   ["paddle_tpu_jit_cache_miss_total"]["samples"]}
+            pre = monitor.jit_miss_by_fn()
             h = srv.submit(PROMPTS[1], _greedy(8))
             assert len(h.result(timeout=120)) == 8
-            post = {s["labels"]["fn"]: s["value"]
-                    for s in monitor.snapshot()["metrics"]
-                    ["paddle_tpu_jit_cache_miss_total"]["samples"]}
+            post = monitor.jit_miss_by_fn()
             assert post == pre, {k: (pre.get(k), v)
                                  for k, v in post.items()
                                  if pre.get(k) != v}

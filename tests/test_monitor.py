@@ -143,15 +143,23 @@ class TestJitTracker:
         f(jnp.ones((2, 2)))
         f(jnp.ones((2, 2)))       # cache hit: no new compile
         f(jnp.ones((3, 3)))       # new shape: compile
+        assert monitor.jit_miss_by_fn().get("t_f") == 2
         snap = mon.snapshot()["metrics"]
+        # the counters split per PROGRAM (ledger PR): each compiled
+        # shape is its own series, so a "who compiled post-warmup"
+        # assertion can NAME the violating program, not just the fn
         miss = [s for s in
                 snap["paddle_tpu_jit_cache_miss_total"]["samples"]
                 if s["labels"]["fn"] == "t_f"]
-        assert miss[0]["value"] == 2
+        assert len(miss) == 2 and all(s["value"] == 1 for s in miss)
+        pids = {s["labels"]["program"] for s in miss}
+        assert len(pids) == 2
+        assert all(pid.startswith("t_f:") for pid in pids)
         secs = [s for s in
                 snap["paddle_tpu_jit_compile_seconds_total"]["samples"]
                 if s["labels"]["fn"] == "t_f"]
-        assert secs[0]["value"] > 0
+        assert len(secs) == 2 and all(s["value"] > 0 for s in secs)
+        assert {s["labels"]["program"] for s in secs} == pids
 
 
 PROM_LINE = re.compile(
@@ -383,6 +391,62 @@ class TestSeriesRetirement:
         assert leaked == [], (
             f"per-instance series survived shutdown+close (add them "
             f"to the owner's retirement list): {leaked}")
+
+    def test_ledger_series_retire_with_engine(self, mon):
+        """Same contract extended to the program ledger: after
+        ``Server.shutdown()`` + ``engine.close()`` the registry holds
+        ZERO {program=...} series for the programs the engine owned
+        (dispatches/seconds counters and the MFU gauge), and the
+        ledger itself has dropped the records."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.generation import (
+            GenerationConfig, PagedContinuousBatchingEngine)
+        from paddle_tpu.models import LlamaForCausalLM, llama_config
+        from paddle_tpu.monitor import ledger
+        from paddle_tpu.serving import Server
+
+        paddle.seed(0)
+        ledger.reset()
+        ledger.enable()
+        try:
+            cfg = llama_config("tiny", num_hidden_layers=1)
+            model = LlamaForCausalLM(cfg)
+            eng = PagedContinuousBatchingEngine(
+                model, max_batch=2, num_pages=16, page_size=4,
+                max_pages=8)
+            srv = Server(eng, segment_steps=4)
+            h = srv.submit(np.arange(1, 7, dtype=np.int32),
+                           GenerationConfig(max_new_tokens=4,
+                                            eos_token_id=None))
+            h.result(timeout=120)
+            owned = set(ledger.owned_programs(eng._monitor_engine))
+            assert owned, "engine registered no ledger programs"
+
+            def ledger_series():
+                leaked = []
+                snap = monitor.snapshot()["metrics"]
+                for name in (ledger.DISPATCH_COUNTER,
+                             ledger.SECONDS_COUNTER,
+                             ledger.MFU_GAUGE):
+                    for samp in snap.get(name, {}).get("samples", []):
+                        if samp["labels"].get("program") in owned:
+                            leaked.append((name, samp["labels"]))
+                return leaked
+
+            assert ledger_series(), "no ledger series were created"
+            srv.shutdown()
+            eng.close()
+            leaked = ledger_series()
+            assert leaked == [], (
+                f"ledger series survived shutdown+close: {leaked}")
+            assert ledger.owned_programs(eng._monitor_engine) == []
+            for pid in owned:
+                assert pid not in ledger.profile()["programs"]
+        finally:
+            ledger.disable()
+            ledger.reset()
 
 
 @pytest.mark.slow

@@ -41,9 +41,15 @@ def mon():
 
 
 def _jit_misses():
+    # summed per entry point: the counter carries ("fn", "program")
+    # since the ledger split, and one fn compiles many programs
     samples = monitor.snapshot()["metrics"].get(
         "paddle_tpu_jit_cache_miss_total", {}).get("samples", [])
-    return {s["labels"]["fn"]: int(s["value"]) for s in samples}
+    out = {}
+    for s in samples:
+        fn = s["labels"]["fn"]
+        out[fn] = out.get(fn, 0) + int(s["value"])
+    return out
 
 
 def _val(x):

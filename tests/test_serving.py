@@ -287,9 +287,7 @@ class TestPerRequestConfigs:
         assert o3[:4] == [int(t) for t in probe[:3]] + [eos]
         # ONE cb_segment compile across every config mix (the sampling
         # parameters are data, not trace constants)
-        misses = {s["labels"]["fn"]: s["value"]
-                  for s in monitor.snapshot()["metrics"]
-                  ["paddle_tpu_jit_cache_miss_total"]["samples"]}
+        misses = monitor.jit_miss_by_fn()
         assert misses.get("cb_segment") == 1, misses
 
     def test_per_request_seed_threads_into_decode(self):

@@ -230,9 +230,7 @@ class TestMixedBatchOneProgram:
         np.testing.assert_array_equal(outs[0], ref[0])   # spec row
         np.testing.assert_array_equal(outs[1], ref[1])   # plain row
         assert len(outs[2]) == 10                        # sampled row
-        misses = {s["labels"]["fn"]: s["value"]
-                  for s in monitor.snapshot()["metrics"]
-                  ["paddle_tpu_jit_cache_miss_total"]["samples"]}
+        misses = monitor.jit_miss_by_fn()
         # ONE spec-step compile serves the whole spec/plain/sampled mix
         # (segments after the spec row retires revert to the plain scan
         # program, itself compiled at most once per n_steps)
@@ -247,9 +245,7 @@ class TestMixedBatchOneProgram:
             eng = ContinuousBatchingEngine(model, max_batch=1,
                                            max_len=64, draft_k=k)
             _run(eng, [REP[:8]], [_spec(10)])
-        misses = {s["labels"]["fn"]: s["value"]
-                  for s in monitor.snapshot()["metrics"]
-                  ["paddle_tpu_jit_cache_miss_total"]["samples"]}
+        misses = monitor.jit_miss_by_fn()
         assert misses.get("cb_spec_step") == 2, misses
 
 
@@ -292,15 +288,11 @@ class TestServerIntegration:
                      speculative=True)
         try:
             assert srv.wait_ready(120) and srv.status == "ok"
-            pre = {s["labels"]["fn"]: s["value"]
-                   for s in monitor.snapshot()["metrics"]
-                   ["paddle_tpu_jit_cache_miss_total"]["samples"]}
+            pre = monitor.jit_miss_by_fn()
             h = srv.submit(REP, _greedy(12))      # no explicit opt-in
             out = h.result(timeout=120)
             assert len(out) == 12
-            post = {s["labels"]["fn"]: s["value"]
-                    for s in monitor.snapshot()["metrics"]
-                    ["paddle_tpu_jit_cache_miss_total"]["samples"]}
+            post = monitor.jit_miss_by_fn()
             assert post.get("cb_spec_step") == pre.get("cb_spec_step")
             assert eng.spec_stats()["forwards"] > 0   # it DID speculate
         finally:

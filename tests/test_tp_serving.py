@@ -283,10 +283,7 @@ class TestOneProgram:
         eng.warmup(segment_steps=4)
 
         def misses():
-            snap = monitor.snapshot()["metrics"].get(
-                "paddle_tpu_jit_cache_miss_total", {})
-            return {s["labels"]["fn"]: s["value"]
-                    for s in snap.get("samples", [])}
+            return monitor.jit_miss_by_fn()
 
         before = misses()
         eng.load_adapter("a1", TestComposition.adapter(

@@ -125,6 +125,26 @@ class TestRecorder:
         with pytest.raises(ValueError):
             trace.configure(capacity=0)
 
+    def test_reconfigure_smaller_twice_rebuilds(self, tr):
+        """Regression (ISSUE 16 satellite): a second configure() with a
+        SMALLER capacity must rebuild the ring — newest tail kept,
+        subsequent recording bounded by the new capacity — and a
+        same-capacity call must be an idempotent no-op (events
+        untouched)."""
+        trace.configure(capacity=8)
+        for i in range(8):
+            trace.event("e", rid=i)
+        trace.configure(capacity=4)       # first shrink
+        assert [e["rid"] for e in trace.events()] == [4, 5, 6, 7]
+        trace.configure(capacity=2)       # second, smaller again
+        assert [e["rid"] for e in trace.events()] == [6, 7]
+        trace.event("e", rid=99)          # the NEW bound is live
+        assert [e["rid"] for e in trace.events()] == [7, 99]
+        trace.configure(capacity=2)       # same capacity: no-op
+        assert [e["rid"] for e in trace.events()] == [7, 99]
+        trace.configure(capacity=16)      # growing keeps everything
+        assert [e["rid"] for e in trace.events()] == [7, 99]
+
     def test_timeline_order_and_rids_fanout(self, tr):
         trace.event("queue.enqueue", rid="s:1")
         with trace.span("admit", rid="s:1", plen=6, bucket=8):

@@ -21,6 +21,11 @@ Usage::
     # per-tenant goodput/burn table + fleet-vs-replica percentiles
     python tools/monitor_report.py --slo stats.json
     python tools/monitor_report.py --url http://127.0.0.1:8000 --slo
+    # program-ledger roofline view (a saved GET /profile body, or
+    # fetched live): per-program FLOPs/MFU/%-of-step table with a
+    # memory-bound/compute-bound verdict per program
+    python tools/monitor_report.py --profile profile.json
+    python tools/monitor_report.py --url http://127.0.0.1:8000 --profile
 """
 from __future__ import annotations
 
@@ -336,6 +341,79 @@ def render_slo(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def _fmt_units(v, none: str = "-") -> str:
+    """1.23e12 -> '1.23T' — roofline numbers span 9 orders."""
+    if v is None:
+        return none
+    for thresh, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                           (1e3, "k")):
+        if abs(v) >= thresh:
+            return f"{v / thresh:.2f}{suffix}"
+    return f"{v:.3g}"
+
+
+def render_profile(doc: dict) -> str:
+    """Roofline table for a ``GET /profile`` snapshot (``monitor.ledger``
+    — a Server's own shard or a Router's merge-exact fleet rollup;
+    both serve the same shape).
+
+    One row per compiled program, sorted by total dispatch seconds:
+    share of total ledger time, dispatch/compile counts, p50 wall,
+    XLA cost-analysis FLOPs, achieved MFU against the backend peak
+    table, arithmetic intensity, and the roofline verdict — intensity
+    below the machine balance means the program is MEMORY-bound (more
+    MXU would not help; feeding it would)."""
+    progs = doc.get("programs") or {}
+    pk = doc.get("peaks") or {}
+    owner = doc.get("router") or doc.get("server") or "?"
+    lines = []
+    if pk:
+        lines.append(
+            f"profile [{owner}]: {pk.get('device_kind')} "
+            f"({pk.get('source')}) peak "
+            f"{_fmt_units(pk.get('peak_flops'))}FLOP/s, "
+            f"{_fmt_units(pk.get('peak_bytes_per_s'))}B/s, "
+            f"balance {pk.get('machine_balance', 0):.1f} FLOP/B")
+    else:
+        lines.append(f"profile [{owner}]")
+    if not progs:
+        lines.append("(no programs recorded — is FLAGS_enable_ledger "
+                     "on and the workload warmed?)")
+        return "\n".join(lines)
+    total = doc.get("total_seconds") or sum(
+        p.get("total_seconds", 0.0) for p in progs.values()) or 1.0
+    order = doc.get("top") or sorted(
+        progs, key=lambda p: -progs[p].get("total_seconds", 0.0))
+    w = max(len(p) for p in progs)
+    lines.append(
+        f"{'PROGRAM':<{w}}  {'%TIME':>6}  {'DISP':>7}  {'COMP':>4}"
+        f"  {'p50(s)':>10}  {'FLOPS':>8}  {'MFU':>7}  {'AI':>7}"
+        f"  VERDICT")
+    lines.append("-" * (w + 70))
+    for pid in order:
+        p = progs.get(pid)
+        if p is None:
+            continue
+        ts = p.get("total_seconds", 0.0)
+        summ = p.get("summary") or {}
+        lines.append(
+            f"{pid:<{w}}  {ts / total:>6.1%}"
+            f"  {p.get('dispatches', 0):>7}  {p.get('compiles', 0):>4}"
+            f"  {_fmt_opt(summ.get('p50'), '.6f'):>10}"
+            f"  {_fmt_units(p.get('flops')):>8}"
+            f"  {_fmt_opt(p.get('mfu'), '.4f'):>7}"
+            f"  {_fmt_opt(p.get('intensity'), '.1f'):>7}"
+            f"  {p.get('bound', '-')}")
+    comp = sum(p.get("compile_seconds", 0.0) for p in progs.values())
+    lines.append("")
+    lines.append(
+        f"{len(progs)} programs, {total:.4f}s dispatch time, "
+        f"{comp:.3f}s compile time"
+        + (f" across {doc['replicas']} replicas"
+           if doc.get("replicas") else ""))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=None,
@@ -367,8 +445,31 @@ def main(argv=None) -> int:
                          "monitor JSONL dump — falls back to the slo "
                          "metric families), or bare --slo with --url "
                          "to fetch <url>/stats live")
+    ap.add_argument("--profile", nargs="?", const="", default=None,
+                    metavar="JSON",
+                    help="render a GET /profile program-ledger "
+                         "snapshot instead: per-program roofline "
+                         "table (%%-of-time, MFU, arithmetic "
+                         "intensity, memory/compute-bound verdict). "
+                         "Pass a saved /profile body, or bare "
+                         "--profile with --url to fetch live")
     args = ap.parse_args(argv)
 
+    if args.profile is not None:
+        if not args.profile and not args.url:
+            print("--profile needs a snapshot file or --url",
+                  file=sys.stderr)
+            return 2
+        if not args.profile:
+            from urllib.request import urlopen
+
+            with urlopen(args.url.rstrip("/") + "/profile",
+                         timeout=10) as resp:
+                print(render_profile(json.load(resp)))
+            return 0
+        with open(args.profile) as f:
+            print(render_profile(json.load(f)))
+        return 0
     if args.trace:
         with open(args.trace) as f:
             print(render_trace(json.load(f), top=args.top))

@@ -429,9 +429,12 @@ def _prefill_program_stats():
     snap = monitor.snapshot()["metrics"]
 
     def by_fn(name):
+        # sum per entry point: the counters carry ("fn", "program")
+        # since the ledger split, and one fn compiles many programs
         out = {}
         for s in snap.get(name, {}).get("samples", []):
-            out[s["labels"]["fn"]] = s["value"]
+            fn = s["labels"]["fn"]
+            out[fn] = out.get(fn, 0.0) + s["value"]
         return out
 
     misses = by_fn("paddle_tpu_jit_cache_miss_total")
@@ -666,6 +669,23 @@ def main(argv=None) -> int:
                          "8) — and report serve_lora_tpot_overhead "
                          "(the per-token price of the batched-adapter "
                          "gather)")
+    # program-ledger knobs (paddle_tpu.monitor.ledger; in-process)
+    ap.add_argument("--profile", action="store_true",
+                    help="enable the program ledger "
+                         "(FLAGS_enable_ledger) for the run and print "
+                         "the per-program roofline table (dispatches, "
+                         "compiles, FLOPs, MFU, memory/compute-bound "
+                         "verdict) after the load drains")
+    ap.add_argument("--profile-out", default=None, metavar="PATH",
+                    help="also write the raw /profile JSON snapshot "
+                         "(the Server.profile() shard) to PATH — feed "
+                         "it to tools/monitor_report.py --profile or "
+                         "archive it next to the BENCH records")
+    ap.add_argument("--profile-ab", action="store_true",
+                    help="A/B mode: run the SAME pre-drawn load twice "
+                         "— ledger OFF, then ON — and report "
+                         "serve_profile_tpot_overhead (the PR 15 "
+                         "one-bool-branch bar: <= 1.05x)")
     args = ap.parse_args(argv)
 
     rng = random.Random(args.seed)
@@ -681,10 +701,15 @@ def main(argv=None) -> int:
               "(no --url)", file=sys.stderr)
         return 2
     if sum([args.spec_ab, args.trace_ab, args.kv_ab,
-            args.lora_ab, args.tp_ab, args.slo_ab]) > 1:
-        print("--spec-ab/--trace-ab/--kv-ab/--lora-ab/--tp-ab/--slo-ab "
-              "are separate A/Bs; run them one at a time",
+            args.lora_ab, args.tp_ab, args.slo_ab,
+            args.profile_ab]) > 1:
+        print("--spec-ab/--trace-ab/--kv-ab/--lora-ab/--tp-ab/--slo-ab/"
+              "--profile-ab are separate A/Bs; run them one at a time",
               file=sys.stderr)
+        return 2
+    if (args.profile or args.profile_ab) and args.url is not None:
+        print("--profile/--profile-ab need the in-process engine "
+              "(no --url)", file=sys.stderr)
         return 2
     if args.slo_ab and args.slo_ttft is None and args.slo_tpot is None:
         # the on arm needs thresholds to score against; generous
@@ -785,6 +810,9 @@ def main(argv=None) -> int:
     elif args.slo_ab:
         arms = [("slooff", spec_def, trace_def),
                 ("sloon", spec_def, trace_def)]
+    elif args.profile_ab:
+        arms = [("ledgeroff", spec_def, trace_def),
+                ("ledgeron", spec_def, trace_def)]
     elif args.tp_ab:
         tp_n = args.tp if args.tp > 1 else 2
         arms = [("tp1", spec_def, trace_def),
@@ -809,6 +837,12 @@ def main(argv=None) -> int:
         if args.tp_ab:
             arm_args = argparse.Namespace(**vars(args))
             arm_args.tp = 1 if arm == "tp1" else tp_n
+        if args.profile_ab:
+            # the OFF arm is the disabled path the one-bool-branch
+            # discipline promises is free; the ON arm pays the
+            # signature-lookup + digest-observe cost being measured
+            arm_args = argparse.Namespace(**vars(args))
+            arm_args.profile = arm == "ledgeron"
         mon_on = True
         if args.slo_ab and arm == "slooff":
             # the OFF arm is the disabled path the PR 1/8 bar promises
@@ -832,6 +866,22 @@ def main(argv=None) -> int:
         if a.get("throughput") and b.get("throughput"):
             print(json.dumps(
                 {"metric": "serve_trace_throughput_ratio",
+                 "value": round(b["throughput"] / a["throughput"], 3),
+                 "unit": "x (on/off)"}))
+    if args.profile_ab:
+        # the overhead verdict: decode cadence with the program ledger
+        # on vs off, on identical replayed load — per dispatch the on
+        # path pays one arg-signature tuple + dict hit + digest
+        # observe; the bar is <= 1.05x (ISSUE 16 acceptance)
+        a, b = res["ledgeroff"], res["ledgeron"]
+        if a.get("tpot_p50") and b.get("tpot_p50"):
+            print(json.dumps({"metric": "serve_profile_tpot_overhead",
+                              "value": round(b["tpot_p50"]
+                                             / a["tpot_p50"], 3),
+                              "unit": "x (on/off)"}))
+        if a.get("throughput") and b.get("throughput"):
+            print(json.dumps(
+                {"metric": "serve_profile_throughput_ratio",
                  "value": round(b["throughput"] / a["throughput"], 3),
                  "unit": "x (on/off)"}))
     if args.slo_ab:
@@ -1059,11 +1109,17 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
     kill_fn = None
     if args.url is None:
         from paddle_tpu import monitor, tracing
+        from paddle_tpu.monitor import ledger
         if mon_on:
             monitor.enable()
         else:
             monitor.disable()
         monitor.reset()    # per-arm program/compile counters
+        ledger.reset()     # per-arm program records
+        if getattr(args, "profile", False):
+            ledger.enable()
+        else:
+            ledger.disable()
         tracing.clear()    # per-arm ring (the off arm must not export
         #                    the on arm's leftovers)
         if trace_on:
@@ -1164,6 +1220,13 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
           f"{stats.rejected} rejected, {stats.failed} failed, "
           f"{stats.tokens} tokens in {wall:.2f}s "
           f"({stats.tokens / wall:.1f} tok/s)\n")
+    # provenance header: ties this arm's records to the machine/
+    # backend/rev that produced them — tools/bench_diff.py reads it
+    # and warns when two compared rounds disagree
+    from paddle_tpu.monitor.provenance import env_stamp
+    print(json.dumps({"metric": "bench_env",
+                      **env_stamp(extra={"tp_degree": args.tp,
+                                         "arm": arm or "run"})}))
     rows = [("ttft", stats.ttft, "s"), ("tpot", stats.tpot, "s"),
             ("e2e_latency", stats.e2e, "s")]
     print(f"{'METRIC':<14}{'p50':>10}{'p90':>10}{'p99':>10}")
@@ -1442,17 +1505,49 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             print(f"wrote trace to {tpath} (open in chrome://tracing "
                   f"or ui.perfetto.dev; tools/monitor_report.py "
                   f"--trace {tpath} for the phase table)")
+    if server is not None and getattr(args, "profile", False):
+        # program-ledger report: read BEFORE shutdown — engine.close()
+        # retires the ledger rows the engine owns. The per-program
+        # table is the "which compiled program is eating the step"
+        # answer; the dispatch total cross-checks the monitored_jit
+        # counters (ISSUE 16 acceptance: the two must agree)
+        from paddle_tpu.monitor import ledger
+        prof_fn = getattr(server, "profile", None)
+        prof = prof_fn() if prof_fn is not None else ledger.profile()
+        progs = prof.get("programs") or {}
+        if progs:
+            from tools.monitor_report import render_profile
+            print()
+            print(render_profile(prof))
+            print()
+            print(json.dumps({"metric": f"serve_profile_programs{sfx}",
+                              "value": len(progs), "unit": "count"}))
+            print(json.dumps(
+                {"metric": f"serve_profile_dispatch_seconds{sfx}",
+                 "value": round(prof.get("total_seconds", 0.0), 6),
+                 "unit": "s"}))
+        if args.profile_out:
+            ppath = args.profile_out + sfx
+            with open(ppath, "w") as f:
+                json.dump(prof, f, indent=1)
+            print(f"wrote /profile snapshot to {ppath} "
+                  f"(tools/monitor_report.py --profile {ppath})")
     if server is not None:
         if args.monitor_out:
             from paddle_tpu import monitor
+            from paddle_tpu.monitor.provenance import env_stamp
             path = args.monitor_out + sfx
-            n = monitor.write_jsonl(path)
+            n = monitor.write_jsonl(path,
+                                    extra={"env": env_stamp()})
             print(f"wrote {n} monitor samples to {path}")
         server.shutdown(drain=False)
         if trace_on:
             from paddle_tpu import tracing
             tracing.disable()   # in-process callers (slow-tier tests)
             #                     must not inherit a live recorder
+        if getattr(args, "profile", False):
+            from paddle_tpu.monitor import ledger
+            ledger.disable()    # same contract as tracing above
     return {
         "tpot_p50": (_percentile(stats.tpot, 50) if stats.tpot
                      else None),
