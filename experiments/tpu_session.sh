@@ -12,7 +12,7 @@
 # ORDER (value-per-minute): the serving stack has NEVER touched a chip
 # — every serve_bench number in PERF.md is CPU-tiny with explicit
 # "mechanism, not speedup" caveats — so after the cheap preflights the
-# serving-record steps (6c-6j) run FIRST, and the training-side parity
+# serving-record steps (6c-6l) run FIRST, and the training-side parity
 # replays and config benches come after. A window that dies at minute
 # 35 should die owing training replays, not serving records.
 #
@@ -93,7 +93,7 @@ STEP_TIMEOUT=900 step kernel_slice env PADDLE_TPU_TESTS_ON_DEVICE=1 \
     -k "device_scale or Sublane" -q -p no:cacheprovider
 
 # ---------------------------------------------------------------------------
-# SERVING RECORDS FIRST (6c-6j): nothing serving-side has ever run on a
+# SERVING RECORDS FIRST (6c-6l): nothing serving-side has ever run on a
 # TPU; each step below converts one CPU-tiny "mechanism" number into a
 # hardware record.
 # ---------------------------------------------------------------------------
@@ -214,6 +214,27 @@ step serve_profile_ab python tools/serve_bench.py --profile-ab \
     --layers 2 --prompt-len 16:32 --max-new 16 --rate 8 \
     --requests 16 --num-pages 64 --max-pages 16 --page-size 8 --warmup
 step bench_diff python -m tools.bench_diff --dir .
+# 6l. on-TPU CROSS-PROCESS FLEET records (NEW — PR 17). Two halves:
+#     (a) the equal-silicon mono-vs-fleet A/B — 2 replica SUBPROCESSES
+#     (each claiming its own device via the inherited environment)
+#     against one double-size in-process server; on-chip the numbers
+#     to read are serve_fleet_ttft_overhead (the HTTP hop + admission
+#     probe per request) and serve_fleet_throughput_ratio (whether 2
+#     schedulers beat 1 big batch at this rate — CPU reference: TTFT
+#     ~2.1x, throughput ~0.52x, both dominated by the shared-core
+#     tax a real 2-chip fleet doesn't pay); (b) the same A/B with a
+#     replica process SIGKILLed mid-run — survival must stay 1.0
+#     through failover replay + supervisor respawn, now priced with
+#     on-chip device reinit in the respawn path. The disaggregated-
+#     handoff byte-identity bar itself is tier-1 (tests/test_remote.py
+#     runs on CPU); these steps put on-chip numbers on the topology.
+STEP_TIMEOUT=3600 step serve_fleet_xproc python tools/serve_bench.py \
+    --fleet 2 --layers 2 --prompt-len 4:16 --max-new 12 --rate 8 \
+    --requests 24 --num-pages 48 --max-pages 8 --page-size 8 --warmup
+STEP_TIMEOUT=3600 step serve_fleet_xproc_kill python tools/serve_bench.py \
+    --fleet 2 --layers 2 --prompt-len 4:16 --max-new 12 --rate 8 \
+    --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
+    --kill-replica-at 2 --seed 3
 
 # ---------------------------------------------------------------------------
 # TRAINING-SIDE PARITY + PERF LEVERS (after the serving records)

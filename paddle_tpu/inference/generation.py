@@ -1046,6 +1046,7 @@ class ContinuousBatchingEngine:
         out = {"free_slots": len(self._free),
                "active_slots": len(self._slot_req),
                "max_batch": self.max_batch,
+               "max_len": self.max_len,
                "tp_degree": self.tp_degree}
         if self.tp_mesh is not None:
             # mesh-shape surface for /healthz + routers: host-side
@@ -2401,6 +2402,137 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         out = super().load()
         out["kv_dtype"] = self.kv_dtype
         return out
+
+    def export_kv_pages(self, tokens, salt: bytes = b"") -> dict:
+        """Export the resident cached KV pages covering a prompt's
+        longest FULL-BLOCK prefix: the read half of a cross-process
+        page handoff (disaggregated prefill/decode). Returns a payload
+        of chain-hashed blocks plus per-layer page rows — raw pool
+        dtype (int8 rows ship with their per-page scales), so the
+        transfer is a page COPY, never a format conversion.
+
+        Must run on the scheduler thread in the inter-segment gap
+        (``Server.export_kv`` marshals there): the pools are DONATED
+        by device writes, so no other thread may read ``self.caches``.
+        Partial-block tails never export — the importer parks blocks
+        refcount-0 with no CoW discipline attached, so only token-
+        complete, hash-verified pages are safe to ship."""
+        from .paged_cache import _chain_root
+
+        ids = np.ascontiguousarray(
+            np.asarray(tokens).reshape(-1), np.int32)
+        pids, cov, hashes = self.alloc.lookup_prefix(ids, salt=salt)
+        ps = self.page_size
+        nfull = min(len(pids), cov // ps, len(hashes))
+        pids = pids[:nfull]
+        root = _chain_root(salt)
+        blocks = []
+        for b in range(nfull):
+            blocks.append({
+                "hash": hashes[b].hex(),
+                "parent": (hashes[b - 1] if b else root).hex(),
+                "tokens": ids[b * ps:(b + 1) * ps].tolist()})
+        pools, _pt = self.caches
+        idx = np.asarray(pids, np.int32)
+        layers = []
+        for pool in pools:
+            if self.kv_dtype == "int8":
+                kp, vp, ks, vs = pool
+                layers.append({"k": np.asarray(kp[idx]),
+                               "v": np.asarray(vp[idx]),
+                               "k_scale": np.asarray(ks[idx]),
+                               "v_scale": np.asarray(vs[idx])})
+            else:
+                kp, vp = pool
+                layers.append({"k": np.asarray(kp[idx]),
+                               "v": np.asarray(vp[idx])})
+        return {"version": 1, "kv_dtype": self.kv_dtype,
+                "page_size": ps, "salt": salt.hex(),
+                "coverage": nfull * ps, "blocks": blocks,
+                "layers": layers}
+
+    def import_kv_pages(self, payload: dict) -> dict:
+        """Install exported KV pages into this engine's pools and
+        prefix index: the write half of the cross-process handoff.
+        Every block re-derives its chain hash from (parent, tokens)
+        before adoption — a corrupted or mis-framed page can never
+        enter the content index — and an already-resident hash is a
+        dedup no-op (``PageAllocator.adopt_block``), which makes a
+        replayed handoff idempotent. Imported pages PARK (refcount 0,
+        LRU-reclaimable): the next admission of the matching prompt
+        warm-hits them read-only through the ordinary prefix-cache
+        path. Same gap-only threading contract as
+        :meth:`export_kv_pages`. Returns
+        ``{"imported", "deduped", "coverage"}``."""
+        from .paged_cache import (_block_hash, install_page,
+                                  install_page_q)
+
+        if payload.get("kv_dtype") != self.kv_dtype:
+            raise ValueError(
+                f"kv_dtype mismatch: payload "
+                f"{payload.get('kv_dtype')!r} vs engine "
+                f"{self.kv_dtype!r} — KV handoff is a page copy, "
+                f"never a format conversion")
+        if int(payload.get("page_size", -1)) != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: payload "
+                f"{payload.get('page_size')} vs engine "
+                f"{self.page_size}")
+        pools, _pt = self.caches
+        layers = payload.get("layers") or []
+        if len(layers) != len(pools):
+            raise ValueError(
+                f"layer count mismatch: payload {len(layers)} vs "
+                f"engine {len(pools)}")
+        blocks = payload.get("blocks") or []
+        kp0 = pools[0][0]
+        for lay in layers:
+            for key in (("k", "v", "k_scale", "v_scale")
+                        if self.kv_dtype == "int8" else ("k", "v")):
+                arr = lay.get(key)
+                if arr is None or len(arr) != len(blocks):
+                    raise ValueError(
+                        f"payload layer missing/short {key!r} rows")
+            if (tuple(lay["k"].shape[1:]) != tuple(kp0.shape[1:])
+                    or lay["k"].dtype != kp0.dtype):
+                raise ValueError(
+                    f"page geometry mismatch: payload "
+                    f"{lay['k'].dtype}{lay['k'].shape[1:]} vs pool "
+                    f"{kp0.dtype}{tuple(kp0.shape[1:])}")
+        imported = deduped = 0
+        for b, blk in enumerate(blocks):
+            h = bytes.fromhex(blk["hash"])
+            parent = bytes.fromhex(blk["parent"])
+            toks = np.ascontiguousarray(
+                np.asarray(blk["tokens"]).reshape(-1), np.int32)
+            if _block_hash(parent, toks) != h:
+                raise ValueError(
+                    f"block {b}: chain hash does not match "
+                    f"(parent, tokens) — corrupted handoff rejected")
+            pid = self.alloc.adopt_block(h, parent, toks)
+            if pid is None:
+                deduped += 1
+                continue
+            pools, pt = self.caches
+            new_pools = []
+            if self.kv_dtype == "int8":
+                for (kp, vp, ks, vs), lay in zip(pools, layers):
+                    kp, vp, ks, vs = install_page_q(
+                        kp, vp, ks, vs, jnp.int32(pid),
+                        lay["k"][b], lay["v"][b],
+                        lay["k_scale"][b], lay["v_scale"][b])
+                    new_pools.append((kp, vp, ks, vs))
+                self.caches = (new_pools, pt)
+                self.alloc.note_scale_copied(pid)
+            else:
+                for (kp, vp), lay in zip(pools, layers):
+                    kp, vp = install_page(kp, vp, jnp.int32(pid),
+                                          lay["k"][b], lay["v"][b])
+                    new_pools.append((kp, vp))
+                self.caches = (new_pools, pt)
+            imported += 1
+        return {"imported": imported, "deduped": deduped,
+                "coverage": len(blocks) * self.page_size}
 
     def _fwd_ragged(self, params, tok, caches, lens, live, lora=None):
         from ..core.autograd import no_grad

@@ -46,7 +46,7 @@ import functools
 import hashlib
 import heapq
 from collections import OrderedDict
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -54,8 +54,9 @@ import numpy as np
 
 __all__ = ["PageAllocator", "PagedKVCache", "write_tokens",
            "gather_dense", "scatter_rows", "copy_page", "gather_pages",
-           "write_tokens_q", "scatter_rows_q", "copy_page_q",
-           "gather_pages_q", "gather_dense_q"]
+           "install_page", "write_tokens_q", "scatter_rows_q",
+           "copy_page_q", "gather_pages_q", "gather_dense_q",
+           "install_page_q"]
 
 # chain-hash root: the "parent" of a prompt's first block
 _ROOT = b"\x00" * 16
@@ -156,6 +157,19 @@ def copy_page(k_pool, v_pool, src, dst):
     the process shares ONE compiled program per pool shape)."""
     k_pool = k_pool.at[dst].set(k_pool[src])
     v_pool = v_pool.at[dst].set(v_pool[src])
+    return k_pool, v_pool
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def install_page(k_pool, v_pool, dst, k_rows, v_rows):
+    """Write one page's worth of host rows into the pools at traced
+    ``dst`` (the device half of a KV-page IMPORT: the wire carried the
+    page's raw rows, this lands them — a pure copy in the pool dtype,
+    the import-side mirror of :func:`copy_page`). ``dst`` is a traced
+    scalar so every imported page in the process shares ONE compiled
+    program per pool shape."""
+    k_pool = k_pool.at[dst].set(k_rows.astype(k_pool.dtype))
+    v_pool = v_pool.at[dst].set(v_rows.astype(v_pool.dtype))
     return k_pool, v_pool
 
 
@@ -270,6 +284,21 @@ def copy_page_q(k_pool, v_pool, k_scale, v_scale, src, dst):
     v_pool = v_pool.at[dst].set(v_pool[src])
     k_scale = k_scale.at[dst].set(k_scale[src])
     v_scale = v_scale.at[dst].set(v_scale[src])
+    return k_pool, v_pool, k_scale, v_scale
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def install_page_q(k_pool, v_pool, k_scale, v_scale, dst, k_rows,
+                   v_rows, k_s, v_s):
+    """Quantizing :func:`install_page`: an imported int8 page carries
+    its per-(page, kv_head) scale rows on the wire exactly like
+    :func:`copy_page_q` carries them across a CoW — int8 rows are
+    meaningless under another page's scale, so the import must never
+    re-quantize (that would be a format conversion, not a page copy)."""
+    k_pool = k_pool.at[dst].set(k_rows.astype(k_pool.dtype))
+    v_pool = v_pool.at[dst].set(v_rows.astype(v_pool.dtype))
+    k_scale = k_scale.at[dst].set(k_s.astype(k_scale.dtype))
+    v_scale = v_scale.at[dst].set(v_s.astype(v_scale.dtype))
     return k_pool, v_pool, k_scale, v_scale
 
 
@@ -1102,6 +1131,55 @@ class PageAllocator:
             self._next.setdefault(parent, set()).add(pid)
         if self.debug:
             self.check()
+
+    def adopt_block(self, h: bytes, parent: bytes,
+                    tokens) -> Optional[int]:
+        """Adopt one IMPORTED full block into the prefix index as a
+        PARKED page (refcount 0, LRU-reclaimable): the bookkeeping half
+        of a cross-process KV-page import. The caller owns the device
+        copy (:func:`install_page` / :func:`install_page_q` onto the
+        returned pid, then — int8 — :meth:`note_scale_copied`, same
+        deferred-check contract as CoW).
+
+        Idempotent by content address: a hash already resident (token-
+        verified or not — first writer wins, both hold identical KV)
+        returns ``None`` and claims nothing, which is what makes a
+        replayed/duplicated handoff a dedup no-op fleet-wide. ``parent``
+        is the previous block's chain hash (or the salted chain root
+        for block 0) — recording it keeps imported blocks reachable by
+        the partial-block child walk exactly like locally written ones.
+        Raises RuntimeError when the pool has no reclaimable page."""
+        if not self.prefix_cache:
+            raise RuntimeError(
+                "adopt_block needs the prefix cache (an unindexed "
+                "import could never be found again — enable "
+                "cache_prefixes on the importing engine)")
+        toks = np.ascontiguousarray(
+            np.asarray(tokens).reshape(-1), np.int32)
+        if len(toks) != self.page_size:
+            raise ValueError(
+                f"adopt_block takes exactly one FULL block "
+                f"({self.page_size} tokens), got {len(toks)}")
+        if h in self._index:
+            return None
+        pid = self._claim_page()
+        if self.kv_dtype == "int8":
+            # not a fresh-reset page: the wire carried the source
+            # page's scale rows and install_page_q lands them; until
+            # note_scale_copied the page is deliberately un-established
+            # so a forgotten scale install fails check() loudly
+            self._fresh_scales.remove(pid)
+        self._index[h] = pid
+        self._hash_of[pid] = h
+        self._tok_of[pid] = toks.copy()
+        self._parent_of[pid] = parent
+        self._next.setdefault(parent, set()).add(pid)
+        self._parked[pid] = h
+        self._parked.move_to_end(pid)
+        self._publish_occupancy()
+        if self.debug and self.kv_dtype != "int8":
+            self.check()
+        return pid
 
     def clear_prefix_index(self) -> None:
         """Drop the whole content index and return parked pages to the

@@ -90,6 +90,22 @@ never averages — and runs the slow-replica SKEW DETECTOR (rolling
 TPOT p50 vs fleet median; ``slow`` deprioritizes routing without
 opening a breaker).
 
+Cross-process fleet (README "Fleet serving", DESIGN "Fleet
+topology"): :class:`RemoteReplica` is a Server-shaped CLIENT for an
+out-of-process replica speaking the same HTTP surface — the Router
+consumes it through the identical duck-typed seam (zero forks:
+breakers, skew detection, failover replay, adapter affinity all work
+across processes), and :class:`RemoteReplicaSpec` makes supervised
+restart a process respawn. On top, ``paddle_tpu.serving.remote``
+implements disaggregated prefill/decode:
+:class:`~paddle_tpu.serving.remote.DisaggregatedFront` runs chunked
+prefill to completion on one replica, ships the finished KV pages
+(int8 + per-page scales, chain hashes included) over
+``POST /kv/export`` → ``POST /kv/import`` to a decode replica —
+idempotent and dedup-able by the prefix-cache chain hash, a page copy
+never a format conversion — and byte-identity with the monolithic
+engine is the test bar.
+
 Tracing & flight recorder (README "Tracing & flight recorder"): with
 ``FLAGS_enable_trace`` on, every lifecycle seam records a structured
 event into ``paddle_tpu.tracing``'s bounded ring — read one request's
@@ -125,6 +141,8 @@ from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
                     RUNNING, DeadlineExpired, QueueFull,
                     RequestCancelled, RequestFailed, RequestHandle,
                     RequestQueue, RequestRejected)
+from .remote import (DisaggregatedFront, RemoteReplica,
+                     RemoteReplicaSpec)
 from .router import (FailoverBudgetExceeded, FleetUnavailable,
                      ReplicaSpec, Router, RouterHandle)
 from .scheduler import PreemptionBudgetExceeded, Server
@@ -137,6 +155,7 @@ __all__ = [
     "RequestFault", "EngineFault", "classify_fault",
     "PagePoolExhausted", "PreemptionBudgetExceeded",
     "Router", "ReplicaSpec", "RouterHandle",
+    "RemoteReplica", "RemoteReplicaSpec", "DisaggregatedFront",
     "FailoverBudgetExceeded", "FleetUnavailable", "SLOPolicy",
     "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED", "FAILED",
 ]

@@ -70,6 +70,19 @@ Routes:
   registry snapshot (resident/draining names, capacity) rides
   ``/healthz`` under ``lora``.
 
+- ``POST /kv/export`` / ``POST /kv/import`` — cross-process KV-page
+  handoff (disaggregated prefill/decode; paged engines with
+  ``prefix_cache=True``). Export takes ``{"tokens": [...]
+  [, "salt": "<hex>"]}`` and returns the resident full-block pages
+  covering the prompt's longest cached prefix as a framed
+  octet-stream (length-prefixed JSON header — chain hashes, parents,
+  tokens, dtype/geometry — followed by the raw page bytes: int8 rows
+  ship WITH their per-page scales; a page copy, never a format
+  conversion). Import takes the same framing and installs the pages
+  into the pool + prefix index, chain-hash verified and idempotent on
+  replay (resident blocks dedup). Both apply on the scheduler thread
+  in the inter-segment gap.
+
 - ``GET /metrics`` / ``GET /metrics.json`` — the monitor package's
   Prometheus / JSON exporters, same payloads as
   ``monitor.start_http_server`` (one scrape endpoint per serving
@@ -128,6 +141,11 @@ _KNOWN_FIELDS = frozenset(_CFG_FIELDS) | {"prompt", "priority",
 # holds the model and KV pool
 MAX_BODY_BYTES = 8 << 20
 
+# a /kv/import body carries real page bytes (layers x pages x rows);
+# still bounded — an unbounded Content-Length must not let a peer
+# buffer arbitrary bytes into the serving process
+MAX_KV_BODY_BYTES = 256 << 20
+
 
 def _parse_request(body: dict):
     unknown = sorted(k for k in body if k not in _KNOWN_FIELDS)
@@ -165,8 +183,15 @@ def _parse_request(body: dict):
         raise ValueError(
             f"'tenant' must be a non-empty string or null, got "
             f"{tenant!r}")
-    return (prompt, cfg, priority, timeout_s,
-            bool(body.get("stream")), tenant)
+    stream = body.get("stream", False)
+    if not isinstance(stream, bool):
+        # the same silent-failure class as the typo'd "adaptor":
+        # bool("false") is True, so a client sending the STRING
+        # "false" would silently get a streamed response it cannot
+        # parse — name the type error instead of coercing
+        raise ValueError(
+            f"'stream' must be a boolean, got {stream!r}")
+    return (prompt, cfg, priority, timeout_s, stream, tenant)
 
 
 def _adapter_weights(body: dict) -> dict:
@@ -272,6 +297,23 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                 # averaged), per-tenant goodput/burn from summed
                 # counters, and the skew detector's slow set. Same
                 # shape either way (tools/monitor_report.py --slo).
+                # ``?shard=1`` instead returns the RAW digest shard
+                # (``SLOTracker.digests_dict()``, to_dict-serialized
+                # buckets and all): what a remote harvester feeds to
+                # ``fleet_rollup`` — merging pre-rolled percentiles
+                # would average, and fleet percentiles must merge.
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+                if q.get("shard", ["0"])[0] not in ("0", ""):
+                    slo = getattr(server, "slo", None)
+                    if slo is None:
+                        self._json(404, {
+                            "error": "no digest shard: this front "
+                                     "exposes no SLO tracker"})
+                    else:
+                        self._json(200, slo.digests_dict())
+                    return
                 fn = getattr(server, "stats", None)
                 if fn is None:
                     self._json(404, {
@@ -357,6 +399,9 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
             if self.path.startswith("/adapters/"):
                 self._adapters_response()
                 return
+            if self.path.startswith("/kv/"):
+                self._kv_response()
+                return
             if not self.path.startswith("/generate"):
                 # body NOT consumed: drop the connection after replying
                 # or keep-alive would parse the body as the next request
@@ -395,6 +440,104 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                 self._stream_response(handle)
             else:
                 self._block_response(handle)
+
+        def _kv_response(self) -> None:
+            """Disaggregated prefill/decode page handoff: ``POST
+            /kv/export`` ``{"tokens": [...][, "salt": "<hex>"]}``
+            returns the resident full-block pages covering the prompt
+            as a framed octet-stream (JSON header + raw page bytes —
+            ``serving.remote.encode_kv_payload``); ``POST /kv/import``
+            takes the same framing back and installs the pages into
+            this server's pool + prefix index (chain-hash verified,
+            idempotent on replay). Both apply on the scheduler thread
+            in the inter-segment gap — the pools are donated by device
+            writes and must never be read from a handler thread. 400
+            for validation errors (strict bodies, geometry/dtype
+            mismatch, corrupt chain hash), 503 while the scheduler
+            cannot apply them."""
+            op = self.path[len("/kv/"):].split("?", 1)[0]
+            if op not in ("export", "import"):
+                self.close_connection = True
+                self._json(404, {"error": f"no route {self.path}"},
+                           headers={"Connection": "close"})
+                return
+            if (getattr(server, "export_kv", None) is None
+                    or not getattr(getattr(server, "engine", None),
+                                   "prefix_cache", False)):
+                # permanently unsupported here (a Router front, or an
+                # engine without the paged prefix cache) — a 400, not
+                # a retryable 503
+                self.close_connection = True
+                self._json(400, {"error": "this endpoint fronts no "
+                                          "KV-handoff-capable Server "
+                                          "(needs a paged engine with "
+                                          "prefix_cache=True)"},
+                           headers={"Connection": "close"})
+                return
+            from .remote import decode_kv_payload, encode_kv_payload
+            try:
+                if op == "export":
+                    body = self._read_body()
+                    if body is None:
+                        return
+                    # strict like /generate: a typo'd "token" must not
+                    # silently export an empty prefix
+                    allowed = {"tokens", "salt"}
+                    unknown = sorted(k for k in body
+                                     if k not in allowed)
+                    if unknown:
+                        raise ValueError(
+                            f"unknown field {unknown[0]!r} (allowed: "
+                            f"{', '.join(sorted(allowed))})")
+                    tokens = body.get("tokens")
+                    if (not isinstance(tokens, list) or not tokens
+                            or not all(isinstance(t, int)
+                                       and not isinstance(t, bool)
+                                       and 0 <= t < 2**31
+                                       for t in tokens)):
+                        raise ValueError(
+                            "'tokens' must be a non-empty list of "
+                            "int32 token ids")
+                    salt = body.get("salt", "")
+                    if not isinstance(salt, str):
+                        raise ValueError(
+                            f"'salt' must be a hex string, got "
+                            f"{salt!r}")
+                    payload = server.export_kv(
+                        np.asarray(tokens, np.int32),
+                        salt=bytes.fromhex(salt))
+                    raw = encode_kv_payload(payload)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(raw)))
+                    self.end_headers()
+                    self.wfile.write(raw)
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                if n <= 0 or n > MAX_KV_BODY_BYTES:
+                    self.close_connection = True
+                    self._json(
+                        400 if n <= 0 else 413,
+                        {"error": ("missing/empty body"
+                                   if n <= 0 else
+                                   f"body exceeds {MAX_KV_BODY_BYTES}"
+                                   f" bytes")},
+                        headers={"Connection": "close"})
+                    return
+                out = server.import_kv(
+                    decode_kv_payload(self.rfile.read(n)))
+            except (ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            except (TimeoutError, RequestRejected,
+                    RuntimeError) as e:
+                # transient: the scheduler could not apply it right
+                # now (wedged / shutting down)
+                self._json(503, {"error": str(e)})
+                return
+            self._json(200, out)
 
         def _adapters_response(self) -> None:
             """Admin surface for multi-tenant LoRA: ``POST

@@ -703,6 +703,7 @@ class Server:
         here. Running requests are untouched; post-``warmup`` a load
         pays zero compiles. See ``engine.load_adapter`` for the
         ``params`` format."""
+        self._require_adapters()
         return self._admin_op("load", (name, params, alpha), timeout)
 
     def unload_adapter(self, name: str,
@@ -712,21 +713,54 @@ class Server:
         the unload DEFERS (new submissions naming it fail at admission;
         the index frees when the last one retires). Same marshalling
         as :meth:`load_adapter`."""
+        self._require_adapters()
         return self._admin_op("unload", (name,), timeout)
 
-    def _admin_op(self, op: str, args, timeout):
+    def _require_adapters(self) -> None:
         if getattr(self.engine, "adapters", None) is None:
             raise RuntimeError(
                 "engine built without lora_capacity; pass "
                 "lora_capacity=K at engine construction")
+
+    # -- KV-page handoff admin (thread-safe; applied in the gap) -------------
+    def export_kv(self, tokens, salt: bytes = b"",
+                  timeout: Optional[float] = 30.0) -> dict:
+        """Export the resident cached KV pages covering ``tokens``'
+        longest full-block prefix (``engine.export_kv_pages`` payload).
+        Thread-safe: marshalled to the scheduler thread's inter-segment
+        gap like adapter admin — the pools are donated by device
+        writes, so no other thread may ever read them. The read half of
+        a disaggregated prefill->decode handoff (``POST /kv/export``)."""
+        self._require_kv_handoff()
+        return self._admin_op("kv_export", (tokens, salt), timeout)
+
+    def import_kv(self, payload: dict,
+                  timeout: Optional[float] = 30.0) -> dict:
+        """Install an exported KV-page payload into this engine's pools
+        and prefix index (``engine.import_kv_pages``): chain-hash
+        verified, idempotent on replay (already-resident blocks dedup).
+        Same gap marshalling as :meth:`export_kv`. The write half of
+        the handoff (``POST /kv/import``)."""
+        self._require_kv_handoff()
+        return self._admin_op("kv_import", (payload,), timeout)
+
+    def _require_kv_handoff(self) -> None:
+        if (getattr(self.engine, "export_kv_pages", None) is None
+                or not getattr(self.engine, "prefix_cache", False)):
+            raise RuntimeError(
+                "KV-page handoff needs a paged engine with "
+                "prefix_cache=True (the content index is what makes "
+                "the handoff idempotent)")
+
+    def _admin_op(self, op: str, args, timeout):
         evt = threading.Event()
         box: dict = {}
         entry = (op, args, evt, box)
         with self._lock:
             if self._stopping or self._stopped.is_set():
                 raise RequestRejected(
-                    "shutdown", "server is shut down; adapter admin "
-                    "ops no longer apply")
+                    "shutdown", "server is shut down; admin ops no "
+                    "longer apply")
             self._admin_ops.append(entry)
         self._wake.set()
         if not evt.wait(timeout):
@@ -742,31 +776,36 @@ class Server:
                     withdrawn = False   # mid-apply: result imminent
             if withdrawn:
                 raise TimeoutError(
-                    f"adapter {op} not applied within {timeout}s "
+                    f"admin op {op} not applied within {timeout}s "
                     "(withdrawn; is the scheduler wedged?)")
             # the scheduler already owns it — give the in-flight apply
             # a short grace so the caller gets the REAL verdict
             if not evt.wait(5.0):
                 raise TimeoutError(
-                    f"adapter {op} still applying after {timeout}s")
+                    f"admin op {op} still applying after {timeout}s")
         if "error" in box:
             raise box["error"]
         return box["result"]
 
+    _ADMIN_DISPATCH = {"load": "load_adapter",
+                       "unload": "unload_adapter",
+                       "kv_export": "export_kv_pages",
+                       "kv_import": "import_kv_pages"}
+
     def _apply_admin(self) -> None:
-        """Apply pending adapter load/unload requests (scheduler
-        thread, inter-segment gap — the only place the registry may
-        mutate). A failed op reports its error to the waiting caller;
-        the engine and every running request are unharmed (the bank
-        swap is all-or-nothing)."""
+        """Apply pending admin requests — adapter load/unload and
+        KV-page export/import — on the scheduler thread in the
+        inter-segment gap (the only place the registry or the donated
+        pools may be touched). A failed op reports its error to the
+        waiting caller; the engine and every running request are
+        unharmed (the bank swap is all-or-nothing, and a rejected
+        import adopts nothing)."""
         with self._lock:
             ops, self._admin_ops = self._admin_ops, []
         for op, args, evt, box in ops:
             try:
-                if op == "load":
-                    box["result"] = self.engine.load_adapter(*args)
-                else:
-                    box["result"] = self.engine.unload_adapter(*args)
+                box["result"] = getattr(
+                    self.engine, self._ADMIN_DISPATCH[op])(*args)
             except Exception as e:
                 box["error"] = e
             finally:
@@ -1321,7 +1360,7 @@ class Server:
         for _op, _args, evt, box in admin:
             box["error"] = (wrapped if fail else
                             RuntimeError("server stopped before the "
-                                         "adapter op applied"))
+                                         "admin op applied"))
             evt.set()
         if self._adm is not None:
             adm, h = self._adm

@@ -2,7 +2,7 @@
 
 Three layers:
 
-- per-checker FIXTURE tests: each of PT001-PT005 fires on a seeded
+- per-checker FIXTURE tests: each of PT001-PT006 fires on a seeded
   violation and stays quiet on the blessed idiom (the checker's
   contract, independent of the live tree);
 - engine tests: fingerprint stability under line drift, annotation
@@ -396,6 +396,74 @@ class TestPT005:
 
 
 # ---------------------------------------------------------------------------
+# PT006 — blocking socket I/O in a hot path
+# ---------------------------------------------------------------------------
+class TestPT006:
+    HOT = (
+        "from urllib.request import urlopen\n"
+        "import http.client\n"
+        "class R:\n"
+        "    def status(self):  # lint: hot-path\n"
+        "        r = urlopen(self.url)\n"
+        "        return self._poll()\n"
+        "    def _poll(self):\n"
+        "        conn = http.client.HTTPConnection(self.host)\n"
+        "        conn.request('GET', '/healthz')\n"
+        "        return conn.getresponse()\n"
+        "    def cold(self):\n"
+        "        return urlopen(self.url)\n")
+
+    def test_fires_in_hot_and_transitively_not_in_cold(self):
+        f = only(lint_source(self.HOT), "PT006")
+        details = sorted(x.detail for x in f)
+        assert details == [".getresponse()", "HTTPConnection",
+                           "urlopen"]
+        poll = [x for x in f if x.context == "R._poll"]
+        assert poll and all("reached from R.status" in x.message
+                            for x in poll)
+        assert all(x.context != "R.cold" for x in f)
+
+    def test_quiet_without_annotation(self):
+        src = self.HOT.replace("  # lint: hot-path", "")
+        assert only(lint_source(src), "PT006") == []
+
+    def test_bounded_timeout_quiets_constructors_not_reads(self):
+        src = (
+            "from urllib.request import urlopen\n"
+            "import socket\n"
+            "class R:\n"
+            "    def load(self):  # lint: hot-path\n"
+            "        r = urlopen(self.url, timeout=2.0)\n"
+            "        c = socket.create_connection(self.addr,\n"
+            "                                     timeout=self.t)\n"
+            "        return c.recv(4096)\n")
+        f = only(lint_source(src), "PT006")
+        # the timeout-bounded opener/constructor are fine; the raw
+        # recv has no per-call bound and still needs the escape hatch
+        assert [x.detail for x in f] == [".recv()"]
+
+    def test_explicit_timeout_none_still_fires(self):
+        src = (
+            "from urllib.request import urlopen\n"
+            "class R:\n"
+            "    def load(self):  # lint: hot-path\n"
+            "        return urlopen(self.url, timeout=None)\n")
+        f = only(lint_source(src), "PT006")
+        assert [x.detail for x in f] == ["urlopen"]
+
+    def test_escape_hatch_requires_reason(self):
+        src = (
+            "class R:\n"
+            "    def load(self):  # lint: hot-path\n"
+            "        # lint: allow-blocking-io(reader thread's whole "
+            "job is this wait)\n"
+            "        a = self.sock.recv(4096)\n"
+            "        b = self.sock.recv(4096)  # lint: allow-blocking-io\n")
+        f = only(lint_source(src), "PT006")
+        assert len(f) == 1 and "REASON is required" in f[0].message
+
+
+# ---------------------------------------------------------------------------
 # engine: annotations, fingerprints, baseline
 # ---------------------------------------------------------------------------
 class TestEngine:
@@ -548,6 +616,14 @@ class TestRepoGate:
             "paddle_tpu/serving/scheduler.py": {"Server._gap",
                                                 "Server.load"},
             "paddle_tpu/serving/router.py": {"Router.load"},
+            # the cross-process replica's router-facing seam: cached-
+            # snapshot reads only — PT006's ground truth (PR 17)
+            "paddle_tpu/serving/remote.py": {
+                "RemoteReplica.status", "RemoteReplica.load",
+                "RemoteReplica.num_active",
+                "RemoteReplica.flight_dumps",
+                "_RemoteQueue.depth", "_RemoteAlloc.free_pages",
+                "_RemoteAdapters.__contains__"},
             "paddle_tpu/inference/generation.py": {
                 "ContinuousBatchingEngine.decode_segment",
                 "ContinuousBatchingEngine._decode_segment_spec",

@@ -1,0 +1,456 @@
+"""Cross-process fleet: HTTP RemoteReplica + disaggregated prefill/decode.
+
+ISSUE-17 acceptance on CPU: a 2-subprocess fleet (1 prefill + 1 decode
+replica, ``JAX_PLATFORMS=cpu``) serves a streamed request end-to-end
+with KV pages shipped via ``POST /kv/export`` → ``POST /kv/import``,
+BYTE-IDENTICAL to the monolithic engine on the same prompt; the
+handoff is idempotent (chain-hash dedup on re-ship); failover replay
+succeeds when the decode replica is KILLED mid-stream (replayed on the
+prefill replica, whose pages never left). Plus the satellites: the
+Router consumes :class:`RemoteReplica` through its unmodified
+duck-typed seam (process-kill failover with byte parity), wire-format
+round-trips (``LatencyDigest``/``_ProgramRecord``
+``to_dict → HTTP → from_dict → fleet_rollup``) across a REAL process
+boundary with merge-exact (never averaged) fleet percentiles, and the
+strict-body 400 class extended to the ``/kv`` endpoints and the
+non-bool ``stream`` field.
+"""
+import http.client
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.generation import (
+    GenerationConfig, PagedContinuousBatchingEngine)
+from paddle_tpu.models import LlamaForCausalLM, llama_config
+from paddle_tpu.serving import (RequestFailed, Router, Server)
+from paddle_tpu.serving.remote import (
+    DisaggregatedFront, RemoteReplica, RemoteReplicaSpec,
+    decode_kv_payload, encode_kv_payload, spawn_replica)
+
+CFG = llama_config("tiny", num_hidden_layers=2)
+PROMPT = list(range(1, 18))        # 17 tokens -> 2 FULL blocks of 8
+REPLICA_ARGS = ["--layers", "2", "--num-pages", "32",
+                "--page-size", "8", "--max-pages", "8",
+                "--max-batch", "2", "--segment-steps", "2"]
+REPLICA_ENV = {"FLAGS_enable_monitor": "1", "FLAGS_enable_ledger": "1"}
+
+
+def make_engine(**kw):
+    paddle.seed(0)                 # deterministic init: every process
+    #                                holds bitwise-identical weights
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("num_pages", 32)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_pages", 8)
+    kw.setdefault("prefix_cache", True)
+    return PagedContinuousBatchingEngine(LlamaForCausalLM(CFG), **kw)
+
+
+@pytest.fixture(scope="module")
+def ref_server():
+    """The monolithic reference — byte-identity bar for every
+    cross-process path."""
+    srv = Server(make_engine(), segment_steps=2, idle_wait_s=0.005)
+    yield srv
+    srv.shutdown(drain=False)
+
+
+@pytest.fixture(scope="module")
+def ref24(ref_server):
+    h = ref_server.submit(np.asarray(PROMPT, np.int32),
+                          GenerationConfig(max_new_tokens=24))
+    return [int(t) for t in h.result(timeout=180)]
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """One shared 2-subprocess fleet: (prefill, decode) replicas with
+    identical seeded weights, monitor + ledger enabled."""
+    p1, u1 = spawn_replica(REPLICA_ARGS, env=REPLICA_ENV)
+    p2, u2 = spawn_replica(REPLICA_ARGS, env=REPLICA_ENV)
+    pre = RemoteReplica(u1, proc=p1)
+    dec = RemoteReplica(u2, proc=p2)
+    assert pre.wait_ready(120) and dec.wait_ready(120)
+    yield pre, dec
+    pre.shutdown(drain=False)
+    dec.shutdown(drain=False)
+
+
+def _post(url, path, body, ctype="application/json"):
+    """One raw request against a replica URL; returns (status, dict)."""
+    from urllib.parse import urlsplit
+
+    u = urlsplit(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=30)
+    try:
+        raw = (body if isinstance(body, bytes)
+               else json.dumps(body).encode())
+        conn.request("POST", path, body=raw,
+                     headers={"Content-Type": ctype})
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            return resp.status, json.loads(data)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return resp.status, {"raw": data}
+    finally:
+        conn.close()
+
+
+class TestKVWireFormat:
+    """encode_kv_payload/decode_kv_payload framing: exact array
+    round-trip (bf16 AND int8+scales), exhaustive validation of
+    untrusted bytes."""
+
+    @staticmethod
+    def _payload(kv_dtype="bf16"):
+        import ml_dtypes
+
+        dt = (np.dtype(ml_dtypes.bfloat16) if kv_dtype == "bf16"
+              else np.int8)
+        rng = np.random.default_rng(0)
+        lay = {"k": rng.standard_normal((2, 8, 4)).astype(dt),
+               "v": rng.standard_normal((2, 8, 4)).astype(dt)}
+        if kv_dtype == "int8":
+            lay["k_scale"] = rng.standard_normal(
+                (2, 8)).astype(np.float32)
+            lay["v_scale"] = rng.standard_normal(
+                (2, 8)).astype(np.float32)
+        return {"version": 1, "kv_dtype": kv_dtype, "page_size": 8,
+                "salt": "", "coverage": 16,
+                "blocks": [{"hash": "aa", "parent": None,
+                            "tokens": list(range(8))},
+                           {"hash": "bb", "parent": "aa",
+                            "tokens": list(range(8, 16))}],
+                "layers": [lay]}
+
+    @pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+    def test_round_trip_exact(self, kv_dtype):
+        p = self._payload(kv_dtype)
+        out = decode_kv_payload(encode_kv_payload(p))
+        assert out["kv_dtype"] == kv_dtype
+        assert out["blocks"] == p["blocks"]
+        assert out["coverage"] == 16
+        for key, arr in p["layers"][0].items():
+            got = out["layers"][0][key]
+            assert got.dtype == arr.dtype
+            assert got.shape == arr.shape
+            np.testing.assert_array_equal(
+                got.view(np.uint8), arr.view(np.uint8))
+
+    def test_truncated_body_is_value_error(self):
+        raw = encode_kv_payload(self._payload())
+        with pytest.raises(ValueError, match="truncated"):
+            decode_kv_payload(raw[:-10])
+
+    def test_trailing_bytes_are_value_error(self):
+        raw = encode_kv_payload(self._payload())
+        with pytest.raises(ValueError, match="trailing"):
+            decode_kv_payload(raw + b"x")
+
+    def test_short_and_bogus_headers_are_value_errors(self):
+        with pytest.raises(ValueError, match="too short"):
+            decode_kv_payload(b"\x00\x00")
+        with pytest.raises(ValueError, match="out of bounds"):
+            decode_kv_payload(b"\xff\xff\xff\xff{}")
+        with pytest.raises(ValueError, match="not JSON"):
+            decode_kv_payload(b"\x00\x00\x00\x02xx")
+
+    def test_wrong_version_rejected(self):
+        p = self._payload()
+        p["version"] = 99
+        raw = encode_kv_payload(p)
+        with pytest.raises(ValueError, match="version"):
+            decode_kv_payload(raw)
+
+
+class TestRemoteReplicaParity:
+    """The Server-shaped duck type across the wire."""
+
+    def test_remote_submit_byte_identical(self, fleet, ref24):
+        pre, _ = fleet
+        h = pre.submit(np.asarray(PROMPT, np.int32),
+                       GenerationConfig(max_new_tokens=24))
+        assert [int(t) for t in h.result(timeout=180)] == ref24
+
+    def test_healthz_read_surface_is_cached(self, fleet):
+        pre, _ = fleet
+        snap = pre.load()
+        for k in ("status", "queue_depth", "active_requests",
+                  "free_slots", "free_pages", "max_len"):
+            assert k in snap, k
+        assert pre.status in ("ok", "draining")
+        assert pre.queue.depth == snap["queue_depth"]
+        assert pre.engine.max_len == snap["max_len"]
+        assert pre.engine.alloc.free_pages >= 0
+        assert "adapter-x" not in pre.engine.adapters
+
+    def test_local_capacity_verdict_raises_value_error(self, fleet):
+        pre, _ = fleet
+        with pytest.raises(ValueError, match="max_len"):
+            pre.submit(np.asarray(PROMPT, np.int32),
+                       GenerationConfig(max_new_tokens=10_000))
+
+    def test_streaming_cancel_shears_the_socket(self, fleet):
+        pre, _ = fleet
+        h = pre.submit(np.asarray(PROMPT, np.int32),
+                       GenerationConfig(max_new_tokens=40))
+        it = h.stream(timeout=120)
+        next(it)
+        h.cancel()
+        deadline = time.monotonic() + 30
+        while not h.done and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert h.status == "cancelled"
+        # the replica reclaims the slot (broken-pipe guard server-side)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if (pre.load().get("active_requests", 1) == 0
+                    and pre.load().get("queue_depth", 1) == 0):
+                break
+            time.sleep(0.05)
+        assert pre.load().get("active_requests") == 0
+
+
+class TestDisaggregatedHandoff:
+    """The acceptance scenario: prefill -> /kv/export -> /kv/import ->
+    decode, byte-identical, idempotent, kill-tolerant."""
+
+    def test_handoff_byte_identical_and_idempotent(self, fleet, ref24):
+        pre, dec = fleet
+        front = DisaggregatedFront(pre, dec)
+        h = front.generate(np.asarray(PROMPT, np.int32),
+                           GenerationConfig(max_new_tokens=24))
+        got = [int(t) for t in h.result(timeout=180)]
+        assert got == ref24
+        assert front.handoffs >= 1          # pages actually shipped
+        # idempotent re-ship: the chain hashes dedup every block
+        out = front.ship(PROMPT)
+        assert out["imported"] == 0
+        assert out["deduped"] >= 1
+        assert out["coverage"] == 16        # 2 full blocks of 8
+
+    def test_export_frames_chain_hashes(self, fleet):
+        pre, _ = fleet
+        # self-sufficient: one budget-1 request registers the prompt's
+        # blocks in the prefix index (prior tests may have evicted or
+        # never parked them), then the export frames the chain
+        h = pre.submit(np.asarray(PROMPT, np.int32),
+                       GenerationConfig(max_new_tokens=1))
+        h.result(timeout=180)
+        raw = pre.export_kv_raw(PROMPT)
+        payload = decode_kv_payload(raw)
+        from paddle_tpu.inference.paged_cache import _chain_root
+
+        assert payload["coverage"] == 16
+        assert len(payload["blocks"]) == 2
+        # the chain anchors at the salt's root digest, and each block
+        # names its parent — what makes the import idempotent AND
+        # corruption-evident (the importer recomputes every hash)
+        assert (payload["blocks"][0]["parent"]
+                == _chain_root(b"").hex())
+        assert (payload["blocks"][1]["parent"]
+                == payload["blocks"][0]["hash"])
+        assert all(len(b["tokens"]) == 8 for b in payload["blocks"])
+
+    def test_decode_kill_mid_stream_replays_on_prefill(
+            self, fleet, ref24):
+        pre, _ = fleet
+        # a DEDICATED decode victim: killing the shared one would
+        # starve the rest of the module
+        proc, url = spawn_replica(REPLICA_ARGS, env=REPLICA_ENV)
+        victim = RemoteReplica(url, proc=proc)
+        assert victim.wait_ready(120)
+        try:
+            front = DisaggregatedFront(pre, victim)
+            h = front.generate(np.asarray(PROMPT, np.int32),
+                               GenerationConfig(max_new_tokens=24))
+            it = h.stream(timeout=120)
+            got = [int(next(it)) for _ in range(4)]
+            proc.kill()                     # decode dies mid-stream
+            got += [int(t) for t in it]
+            assert got == ref24             # replayed on the prefill
+            #                                 replica, byte-identical
+            assert front.failovers >= 1
+        finally:
+            victim.shutdown(drain=False)
+
+
+class TestRouterOverRemote:
+    """Zero Router forks: RemoteReplicaSpec passes the isinstance
+    gate, and breakers/failover/least-loaded run on the duck type."""
+
+    def test_router_failover_on_process_kill(self, ref24):
+        spec = RemoteReplicaSpec(args=REPLICA_ARGS)
+        router = Router(spec, replicas=2, monitor_interval_s=0.1,
+                        max_replica_restarts=0)
+        try:
+            assert router.wait_ready(120)
+            assert router.status == "ok"
+            h = router.submit(np.asarray(PROMPT, np.int32),
+                              GenerationConfig(max_new_tokens=24))
+            it = h.stream(timeout=120)
+            got = [int(next(it)) for _ in range(2)]
+            # kill the serving replica's PROCESS mid-stream
+            router._replicas[h.replica].server.proc.kill()
+            got += [int(t) for t in it]
+            assert got == ref24
+            assert h._failovers >= 1
+            snap = router.load()
+            assert snap["healthy"]          # the survivor still routes
+        finally:
+            router.shutdown(drain=False)
+
+
+class TestWireFormatRollup:
+    """Satellite: LatencyDigest/_ProgramRecord to_dict -> HTTP ->
+    from_dict -> fleet_rollup across a REAL process boundary, with
+    merge-exact (never averaged) fleet percentiles."""
+
+    @staticmethod
+    def _drive(rep, n, max_new):
+        for _ in range(n):
+            h = rep.submit(np.asarray(PROMPT, np.int32),
+                           GenerationConfig(max_new_tokens=max_new))
+            h.result(timeout=180)
+
+    def test_latency_digest_round_trip_merge_exact(self, fleet):
+        from paddle_tpu.monitor.slo import LatencyDigest, fleet_rollup
+
+        pre, dec = fleet
+        # different budgets -> different TPOT populations per replica
+        self._drive(pre, 2, 8)
+        self._drive(dec, 2, 16)
+        s1 = pre.slo.digests_dict()
+        s2 = dec.slo.digests_dict()
+        for s in (s1, s2):
+            assert "metrics" in s and "tpot" in s["metrics"], s.keys()
+        out = fleet_rollup([s1, s2])
+        # merge-exact: the fleet digest is the elementwise-summed
+        # buckets, so its percentile equals the merged-digest
+        # percentile EXACTLY — and its count is the plain sum
+        tenant = next(iter(s1["metrics"]["tpot"]))
+        d1 = LatencyDigest.from_dict(s1["metrics"]["tpot"][tenant])
+        d2 = LatencyDigest.from_dict(s2["metrics"]["tpot"][tenant])
+        merged = LatencyDigest.from_dict(
+            s1["metrics"]["tpot"][tenant])
+        merged.merge(d2)
+        fleet_tpot = out["metrics"]["tpot"]["*"]
+        assert fleet_tpot["count"] == d1.count + d2.count
+        # summary() rounds for the JSON view; the underlying value is
+        # the merged digest's percentile, bit-for-bit
+        assert fleet_tpot["p99"] == round(merged.percentile(99), 6)
+        # ... and NEVER the average of per-replica percentiles
+        if d1.percentile(99) != d2.percentile(99):
+            avg = round((d1.percentile(99) + d2.percentile(99)) / 2.0,
+                        6)
+            assert fleet_tpot["p99"] != avg
+
+    def test_rolling_tpot_p50_over_the_wire(self, fleet):
+        pre, _ = fleet
+        # driven by the previous test; the skew detector's input works
+        # through the same shard
+        p50 = pre.slo.rolling_tpot_p50(min_count=1)
+        assert p50 is None or p50 > 0
+
+    def test_program_record_round_trip_and_merge(self, fleet):
+        from paddle_tpu.monitor.ledger import (_ProgramRecord,
+                                               merge_profiles)
+
+        pre, dec = fleet
+        s1, s2 = pre.profile(), dec.profile()
+        assert s1["programs"], "child ledger must be enabled"
+        pid, rec = next(iter(s1["programs"].items()))
+        # to_dict -> (HTTP/JSON) -> from_dict -> to_dict is stable
+        back = _ProgramRecord.from_dict(rec).to_dict()
+        for k in ("program", "dispatches", "compiles", "flops"):
+            assert back.get(k) == rec.get(k), k
+        out = merge_profiles([s1, s2])
+        common = set(s1["programs"]) & set(s2["programs"])
+        assert common, "identical toy replicas share program ids"
+        cid = next(iter(common))
+        assert (out["programs"][cid]["dispatches"]
+                == s1["programs"][cid]["dispatches"]
+                + s2["programs"][cid]["dispatches"])
+
+
+class TestStrictBodies:
+    """Satellite: the silent-failure request-body class — unknown keys
+    and type confusions are a 400 NAMING the offender, on the /kv
+    endpoints and the non-bool ``stream`` field alike."""
+
+    def test_kv_export_unknown_field_is_named_400(self, fleet):
+        pre, _ = fleet
+        status, body = _post(pre.base_url, "/kv/export",
+                             {"tokens": PROMPT, "slat": ""})
+        assert status == 400
+        assert "slat" in body["error"]
+
+    def test_kv_export_bad_tokens_is_400(self, fleet):
+        pre, _ = fleet
+        for bad in ([], ["a"], "nope", [True]):
+            status, body = _post(pre.base_url, "/kv/export",
+                                 {"tokens": bad})
+            assert status == 400, bad
+            assert "tokens" in body["error"]
+
+    def test_kv_export_bad_salt_is_400(self, fleet):
+        pre, _ = fleet
+        status, body = _post(pre.base_url, "/kv/export",
+                             {"tokens": PROMPT, "salt": 7})
+        assert status == 400
+        assert "salt" in body["error"]
+
+    def test_kv_import_empty_and_garbage_bodies_are_400(self, fleet):
+        pre, _ = fleet
+        status, body = _post(pre.base_url, "/kv/import", b"",
+                             ctype="application/octet-stream")
+        assert status == 400
+        status, body = _post(pre.base_url, "/kv/import", b"junk",
+                             ctype="application/octet-stream")
+        assert status == 400
+
+    def test_kv_unknown_op_is_404(self, fleet):
+        pre, _ = fleet
+        status, _ = _post(pre.base_url, "/kv/exfiltrate", {})
+        assert status == 404
+
+    def test_generate_non_bool_stream_is_named_400(self, fleet):
+        pre, _ = fleet
+        status, body = _post(
+            pre.base_url, "/generate",
+            {"prompt": PROMPT, "max_new_tokens": 4,
+             "stream": "false"})
+        assert status == 400
+        assert "stream" in body["error"]
+
+    def test_generate_unknown_field_still_named_400(self, fleet):
+        # the original typo'd-"adaptor" regression, across a real
+        # process boundary
+        pre, _ = fleet
+        status, body = _post(
+            pre.base_url, "/generate",
+            {"prompt": PROMPT, "max_new_tokens": 4, "adaptor": "x"})
+        assert status == 400
+        assert "adaptor" in body["error"]
+
+    def test_kv_endpoints_on_incapable_server_are_permanent_400(self):
+        from paddle_tpu.serving import serve_http
+
+        # prefix_cache OFF -> the capability gate answers 400 (not a
+        # retryable 503): this front can never serve a handoff
+        srv = Server(make_engine(prefix_cache=False), segment_steps=2)
+        httpd = serve_http(srv, port=0)
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            status, body = _post(url, "/kv/export",
+                                 {"tokens": PROMPT})
+            assert status == 400
+            assert "prefix_cache" in body["error"]
+        finally:
+            httpd.shutdown()
+            srv.shutdown(drain=False)

@@ -24,6 +24,11 @@ PT004     lock discipline: fields declared ``# guarded-by: self._lock``
           threaded serving classes).
 PT005     flag gating: monitor/trace recording work not branching on its
           enable flag first — the near-zero-when-off bar (PR 1/8).
+PT006     blocking socket I/O in a hot path: ``urlopen`` / connection
+          constructors without a bounded ``timeout=``, or raw
+          ``.recv``/``.accept``/``.getresponse`` reads reached from a
+          ``# lint: hot-path`` function — the cached-snapshot-only bar
+          the cross-process fleet's routing seam rides on (PR 17).
 ========  ==================================================================
 
 Run ``python -m tools.lint paddle_tpu/``; see ``tools/lint/baseline.json``

@@ -8,6 +8,7 @@ from .host_sync import check_host_sync
 from .series import check_series_lifecycle
 from .locks import check_lock_discipline
 from .gating import check_flag_gating
+from .socket_io import check_socket_io
 
 CHECKERS = {
     "PT001": check_recompile_hazard,
@@ -15,6 +16,7 @@ CHECKERS = {
     "PT003": check_series_lifecycle,
     "PT004": check_lock_discipline,
     "PT005": check_flag_gating,
+    "PT006": check_socket_io,
 }
 
 __all__ = ["CHECKERS"]

@@ -1,0 +1,112 @@
+"""PT006 — blocking socket I/O reached from an annotated hot path
+(the cached-snapshot-only bar the cross-process fleet rides on,
+PR 17).
+
+The Router picks replicas UNDER ITS LOCK by reading each replica's
+``status`` / ``load()`` / queue-depth surface; for an in-process
+Server those are lock-light host reads, and :class:`RemoteReplica`
+keeps the contract by serving them from a poller-maintained CACHED
+snapshot. A network round-trip smuggled into one of those seams stalls
+every routing decision behind a peer's TCP stack — seconds, not the
+microseconds the never-block-the-gap bar budgets. Ground truth is the
+same ``# lint: hot-path`` annotation PT002 walks (transitively,
+intra-module).
+
+Flagged operations inside a hot function:
+
+- ``urllib.request.urlopen(...)`` without a ``timeout=`` kwarg (or
+  with an explicit ``timeout=None``) — blocks forever on a dead peer;
+- ``http.client.HTTPConnection(...)`` / ``HTTPSConnection(...)`` /
+  ``socket.create_connection(...)`` without a bounded ``timeout=`` —
+  every later request on the connection inherits the block;
+- ``.recv()`` / ``.recvfrom()`` / ``.accept()`` / ``.getresponse()``
+  — raw socket reads. These are flagged even when a ``settimeout``
+  happened earlier (the lint can't see across statements): the
+  reviewer writes the one-line reason, same policy as PT002's
+  ``np.asarray``.
+
+A bounded ``timeout=`` argument (any expression that is not the
+constant ``None``) quiets the constructor/urlopen forms — the checker
+enforces that the bound EXISTS, not its value.
+
+Escape hatch (reason REQUIRED): ``# lint: allow-blocking-io(<reason>)``
+on or above the flagged line — e.g. a reader thread whose whole job is
+to sit in ``getresponse()`` for the stream's lifetime.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, Module, dotted_name
+from .host_sync import hot_functions
+
+#: constructor/opener forms where a ``timeout=`` kwarg is the fix
+_TIMEOUT_CALLS = {
+    "urlopen", "urllib.request.urlopen", "request.urlopen",
+    "HTTPConnection", "HTTPSConnection",
+    "http.client.HTTPConnection", "http.client.HTTPSConnection",
+    "client.HTTPConnection", "client.HTTPSConnection",
+    "socket.create_connection", "create_connection",
+}
+#: receive-side methods that block until the peer talks; no per-call
+#: timeout exists, so these always need the escape hatch in hot code
+_RECV_METHODS = {"recv", "recvfrom", "recv_into", "accept",
+                 "getresponse"}
+
+
+def _has_bounded_timeout(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    return False
+
+
+def check_socket_io(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+    hot = hot_functions(mod)
+    if not hot:
+        return findings
+
+    def _flag(node, fn, detail, what):
+        esc = mod.directive_for(node, "allow-blocking-io")
+        msg_extra = ""
+        if esc is not None:
+            if esc[1]:
+                return
+            msg_extra = (" [allow-blocking-io present but a REASON is "
+                         "required: # lint: allow-blocking-io(<why>)]")
+        root = hot[fn]
+        where = mod.qualname(fn)
+        via = "" if where == root else f" (reached from {root})"
+        findings.append(Finding(
+            checker="PT006", file=mod.rel, line=node.lineno,
+            message=f"{what} in hot path {where}(){via}{msg_extra}",
+            hint="serve the hot read from a cached snapshot (a poller "
+                 "thread refreshes it), pass a bounded timeout=, or "
+                 "annotate why it must block: "
+                 "# lint: allow-blocking-io(<reason>)",
+            context=where, detail=detail))
+
+    for fn in hot:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = dotted_name(f)
+            if name in _TIMEOUT_CALLS:
+                if not _has_bounded_timeout(node):
+                    _flag(node, fn, name.split(".")[-1],
+                          f"{name}() without a bounded timeout=")
+            elif (isinstance(f, ast.Attribute)
+                    and f.attr in _RECV_METHODS
+                    # plain Name receivers only would miss
+                    # self.sock.recv(); flag any attribute form — the
+                    # method names are specific enough that non-socket
+                    # receivers are rare, and the escape hatch covers
+                    # them
+                    and name not in _TIMEOUT_CALLS):
+                _flag(node, fn, f".{f.attr}()",
+                      f"blocking socket read .{f.attr}()")
+    return findings

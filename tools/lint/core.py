@@ -40,6 +40,7 @@ _COMMENT_ONLY_RE = re.compile(r"^\s*#")
 KNOWN_DIRECTIVES = frozenset({
     "hot-path",            # PT002 root: scan this function (transitively)
     "allow-host-sync",     # PT002 escape; reason required
+    "allow-blocking-io",   # PT006 escape; reason required
     "allow-recompile",     # PT001 escape; reason required
     "allow-unlocked",      # PT004 escape; reason required
     "allow-ungated",       # PT005 escape; reason required

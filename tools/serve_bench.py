@@ -62,6 +62,15 @@ Usage::
     # serve_failover_latency_p99, serve_breaker_opens across the runs
     python tools/serve_bench.py --router --replicas 1 --kill-replica-at 2
     python tools/serve_bench.py --router --replicas 3 --kill-replica-at 2
+    # cross-process fleet A/B (PERF.md cross-process-fleet
+    # methodology): the SAME load
+    # through one equal-silicon in-process server (2x pages/batch/
+    # queue) vs a Router over 2 replica SUBPROCESSES speaking HTTP —
+    # read serve_fleet_ttft_overhead / serve_fleet_tpot_overhead /
+    # serve_fleet_throughput_ratio; add --kill-replica-at to SIGKILL a
+    # replica process mid-run and watch failover replay + respawn
+    python tools/serve_bench.py --fleet 2 --warmup
+    python tools/serve_bench.py --fleet 2 --kill-replica-at 2
     # request-lifecycle tracing (PERF.md tracing methodology): capture
     # a Chrome-trace/Perfetto file of the whole run and report the
     # trace-derived TTFT decomposition (queue vs prefill vs gap share)
@@ -407,6 +416,65 @@ def _build_toy_router(args):
         kill_fn if args.kill_replica_at is not None else None)
 
 
+def _build_fleet_router(args):
+    """Cross-process fleet mode (--fleet N): a Router over N replica
+    SUBPROCESSES (``python -m paddle_tpu.serving.remote``), each one
+    an independently seeded engine at the base CLI knobs — the same
+    deterministic-init property the in-process fleet rides on, so
+    greedy failover replay stays bitwise-identical across processes.
+    Only the knobs the replica entrypoint exposes are forwarded (main
+    validates the rest are at defaults). With --kill-replica-at T, the
+    timer SIGKILLs replica 0's process; the supervisor respawns it.
+    Returns (router, vocab, kill_fn)."""
+    from paddle_tpu.models import llama_config
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.remote import RemoteReplicaSpec
+
+    child = ["--preset", args.preset, "--layers", str(args.layers),
+             "--max-batch", str(args.max_batch),
+             "--num-pages", str(args.num_pages),
+             "--page-size", str(args.page_size),
+             "--max-pages", str(args.max_pages),
+             "--kv-dtype", args.kv_dtype,
+             "--max-queue", str(args.max_queue),
+             "--segment-steps", str(args.segment_steps),
+             "--prefix-cache", args.cache_prefixes,
+             "--warmup", "on" if args.warmup else "off"]
+    if args.prefill_chunk is not None:
+        child += ["--prefill-chunk", str(args.prefill_chunk)]
+    if args.slo_ttft is not None:
+        child += ["--slo-ttft", str(args.slo_ttft)]
+    if args.slo_tpot is not None:
+        child += ["--slo-tpot", str(args.slo_tpot)]
+    spec = RemoteReplicaSpec(
+        args=child,
+        # the children record their own SLO digests; the router MERGES
+        # them over the wire — the serve_goodput/serve_slo_* records
+        # below are fleet-exact, not averaged
+        env={"FLAGS_enable_monitor": "1"})
+    router = Router(spec, replicas=args.fleet,
+                    max_failovers=args.max_failovers,
+                    breaker_threshold=args.breaker_threshold,
+                    replica_backoff_s=args.replica_backoff,
+                    monitor_interval_s=0.05)
+    router.wait_ready(timeout=240.0)
+
+    fired = {"kill": False}
+
+    def kill_fn():
+        fired["kill"] = True
+        print(f"[chaos] SIGKILL replica 0 process at t="
+              f"{args.kill_replica_at}s", file=sys.stderr)
+        victim = router._replicas[0].server
+        if getattr(victim, "proc", None) is not None:
+            victim.proc.kill()
+
+    kill_fn.fired = fired
+    vocab = llama_config(args.preset, num_hidden_layers=1).vocab_size
+    return router, vocab, (
+        kill_fn if args.kill_replica_at is not None else None)
+
+
 def _draw_len(rng, dist: str, lo: int, hi: int) -> int:
     """One prompt length from the configured distribution. lognormal is
     the realistic serving shape (many short, a long tail) — the mix that
@@ -562,7 +630,20 @@ def main(argv=None) -> int:
                     metavar="T",
                     help="kill replica 0 (permanent engine faults) T "
                          "seconds into the measured run; its requests "
-                         "fail over, the supervisor rebuilds it")
+                         "fail over, the supervisor rebuilds it "
+                         "(--fleet mode: SIGKILLs the replica "
+                         "PROCESS; the supervisor respawns it)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="cross-process A/B: run the SAME pre-drawn "
+                         "load through (a) ONE in-process server with "
+                         "N x --num-pages / N x --max-batch / N x "
+                         "--max-queue (the equal-chip monolithic "
+                         "baseline) then (b) a Router over N replica "
+                         "SUBPROCESSES (paddle_tpu.serving.remote, "
+                         "one engine each at the base knobs) — "
+                         "reports per-arm serve_ttft/tpot/throughput "
+                         "plus serve_fleet_* ratios, the price of the "
+                         "HTTP hop + fan-out at equal silicon")
     ap.add_argument("--max-failovers", type=int, default=3,
                     help="replica migrations one request may survive "
                          "before FailoverBudgetExceeded")
@@ -731,6 +812,26 @@ def main(argv=None) -> int:
     if args.replicas < 1:
         print("--replicas must be >= 1", file=sys.stderr)
         return 2
+    if args.fleet < 0:
+        print("--fleet must be >= 1 (0 = off)", file=sys.stderr)
+        return 2
+    if args.fleet:
+        # the fleet arm's engines live in CHILD processes: the local
+        # chaos/trace/ledger/adapter machinery cannot reach them, and
+        # the replica entrypoint exposes the core engine knobs only
+        if (args.url is not None or args.router or args.replicas > 1
+                or args.fault_rate > 0 or args.speculative == "on"
+                or args.adapters or args.tp > 1 or args.trace_out
+                or args.profile
+                or sum([args.spec_ab, args.trace_ab, args.kv_ab,
+                        args.lora_ab, args.tp_ab, args.slo_ab,
+                        args.profile_ab])):
+            print("--fleet is its own A/B over subprocess replicas; "
+                  "it composes with the load/engine-size/SLO knobs "
+                  "only (no --url/--router/--replicas/--fault-rate/"
+                  "--speculative/--adapters/--tp/--trace-out/"
+                  "--profile/other --*-ab)", file=sys.stderr)
+            return 2
     args.router = args.router or args.replicas > 1
     if args.router and (args.url is not None or args.fault_rate > 0
                         or args.spec_ab or args.speculative == "on"):
@@ -739,9 +840,10 @@ def main(argv=None) -> int:
               "--url nor --fault-rate/--spec-ab/--speculative",
               file=sys.stderr)
         return 2
-    if args.kill_replica_at is not None and not args.router:
-        print("--kill-replica-at needs --router/--replicas > 1",
-              file=sys.stderr)
+    if (args.kill_replica_at is not None and not args.router
+            and not args.fleet):
+        print("--kill-replica-at needs --router/--replicas > 1 "
+              "or --fleet", file=sys.stderr)
         return 2
     if (args.adapters or args.lora_ab) and (args.url is not None
                                             or args.router):
@@ -817,6 +919,9 @@ def main(argv=None) -> int:
         tp_n = args.tp if args.tp > 1 else 2
         arms = [("tp1", spec_def, trace_def),
                 (f"tp{tp_n}", spec_def, trace_def)]
+    elif args.fleet:
+        arms = [("mono", spec_def, trace_def),
+                ("fleet", spec_def, trace_def)]
     else:
         arms = [("", spec_def, trace_def)]
     res = {}
@@ -837,6 +942,20 @@ def main(argv=None) -> int:
         if args.tp_ab:
             arm_args = argparse.Namespace(**vars(args))
             arm_args.tp = 1 if arm == "tp1" else tp_n
+        if args.fleet:
+            # EQUAL SILICON across the arms: the fleet arm holds N
+            # engines of the base size in N processes; the monolithic
+            # baseline gets the same total pool/batch/queue in ONE —
+            # the per-chip memory wall is exactly what it does NOT
+            # model, which is the fleet's whole reason to exist
+            arm_args = argparse.Namespace(**vars(args))
+            if arm == "mono":
+                arm_args.fleet = 0
+                arm_args.num_pages = args.num_pages * args.fleet
+                arm_args.max_batch = args.max_batch * args.fleet
+                arm_args.max_queue = args.max_queue * args.fleet
+            else:
+                arm_args.router = True   # fleet accounting in _run_arm
         if args.profile_ab:
             # the OFF arm is the disabled path the one-bool-branch
             # discipline promises is free; the ON arm pays the
@@ -958,6 +1077,35 @@ def main(argv=None) -> int:
                 {"metric": "serve_tp_bytes_per_chip",
                  "value": b["model_bytes"] // tp_n,
                  "unit": "bytes/chip (weights+pool, TP arm)"}))
+    if args.fleet:
+        # the cross-process verdict on identical replayed load: what
+        # the HTTP hop + router fan-out cost against ONE process
+        # holding the same total silicon. TTFT carries the per-request
+        # connection + admission-probe price; TPOT should track the
+        # mono arm closely (streaming rides one long-lived response);
+        # throughput says whether N schedulers beat one big batch at
+        # this arrival rate. Equal-silicon is the FAIR baseline and
+        # also the fleet's ceiling — its floor (the mono arm cannot
+        # model it) is the per-chip memory wall that forces the fleet
+        # shape in the first place
+        a, b = res["mono"], res["fleet"]
+        print(json.dumps({"metric": "serve_fleet_replicas",
+                          "value": args.fleet, "unit": "processes"}))
+        if a.get("ttft_p50") and b.get("ttft_p50"):
+            print(json.dumps({"metric": "serve_fleet_ttft_overhead",
+                              "value": round(b["ttft_p50"]
+                                             / a["ttft_p50"], 3),
+                              "unit": "x (fleet/mono)"}))
+        if a.get("tpot_p50") and b.get("tpot_p50"):
+            print(json.dumps({"metric": "serve_fleet_tpot_overhead",
+                              "value": round(b["tpot_p50"]
+                                             / a["tpot_p50"], 3),
+                              "unit": "x (fleet/mono)"}))
+        if a.get("throughput") and b.get("throughput"):
+            print(json.dumps(
+                {"metric": "serve_fleet_throughput_ratio",
+                 "value": round(b["throughput"] / a["throughput"], 3),
+                 "unit": "x (fleet/mono)"}))
     if args.kv_ab:
         # the quantization verdict on identical replayed load: decode
         # cadence bf16/int8 (HBM-bound hardware converts the halved
@@ -1126,7 +1274,9 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             tracing.enable()
         else:
             tracing.disable()
-        if args.router:
+        if getattr(args, "fleet", 0):
+            server, vocab, kill_fn = _build_fleet_router(args)
+        elif args.router:
             server, vocab, kill_fn = _build_toy_router(args)
         else:
             server, vocab, plan = _build_toy_server(args, spec_on)
@@ -1248,10 +1398,12 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
                       "unit": "tokens/s"}))
     print(json.dumps({"metric": f"serve_rejected{sfx}",
                       "value": stats.rejected, "unit": "count"}))
-    if server is not None and mon_on:
+    if server is not None and mon_on and not getattr(args, "fleet", 0):
         # the bucketing win in the methodology: how many prefill
         # programs this run compiled (and what that cost) — bounded by
         # len(buckets)+1 with bucketing on, O(#distinct lengths) off
+        # (--fleet arm: the compiles happened in the CHILD processes;
+        # the local registry would report a misleading zero)
         pre_n, pre_s, all_n, all_s = _prefill_program_stats()
         n_lens = len({len(p) for p in prompts})
         print(f"prefill programs compiled: {pre_n} "
@@ -1396,7 +1548,7 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             f"r{e['replica']}:{e['status']}"
             f"(breaker={e['breaker']['state']},"
             f"restarts={e['restarts']})" for e in snap["replicas"])
-        print(f"fleet [{args.replicas} replicas]: survival "
+        print(f"fleet [{len(snap['replicas'])} replicas]: survival "
               f"{done}/{accepted} = {survival:.3f}, "
               f"{snap['failovers']} failovers, "
               f"{snap['breaker_opens']} breaker opens; {per_rep}")
