@@ -12,7 +12,7 @@
 # ORDER (value-per-minute): the serving stack has NEVER touched a chip
 # — every serve_bench number in PERF.md is CPU-tiny with explicit
 # "mechanism, not speedup" caveats — so after the cheap preflights the
-# serving-record steps (6c-6l) run FIRST, and the training-side parity
+# serving-record steps (6c-6m) run FIRST, and the training-side parity
 # replays and config benches come after. A window that dies at minute
 # 35 should die owing training replays, not serving records.
 #
@@ -93,7 +93,7 @@ STEP_TIMEOUT=900 step kernel_slice env PADDLE_TPU_TESTS_ON_DEVICE=1 \
     -k "device_scale or Sublane" -q -p no:cacheprovider
 
 # ---------------------------------------------------------------------------
-# SERVING RECORDS FIRST (6c-6l): nothing serving-side has ever run on a
+# SERVING RECORDS FIRST (6c-6m): nothing serving-side has ever run on a
 # TPU; each step below converts one CPU-tiny "mechanism" number into a
 # hardware record.
 # ---------------------------------------------------------------------------
@@ -235,6 +235,24 @@ STEP_TIMEOUT=3600 step serve_fleet_xproc_kill python tools/serve_bench.py \
     --fleet 2 --layers 2 --prompt-len 4:16 --max-new 12 --rate 8 \
     --requests 24 --num-pages 48 --max-pages 8 --page-size 8 \
     --kill-replica-at 2 --seed 3
+# 6m. on-TPU DEVICE-RESIDENT SPECULATION A/B (NEW — PR 18): identical
+#     repetitive load three ways — plain, host-mode spec (per-verify-
+#     step proposer readback), device-mode spec (fused propose+verify+
+#     accept segment program, ONE readback per segment). This is where
+#     the sync elimination actually matters: on-chip each host-mode
+#     verify step pays a full device->host->device round-trip the
+#     fused program doesn't. Read serve_spec_mode_tpot_speedup (the
+#     host/device TPOT ratio — CPU reference ~0.9x, mechanism only;
+#     on-chip >1x is the PR's latency claim) and the receipt pair
+#     serve_spec_host_syncs_per_token_{spec,specdev} (device arm MUST
+#     print 0.0 on-chip too — a nonzero there means a hidden sync
+#     crept into the fused path). tokens/forward and acceptance must
+#     match across the spec arms (same drafts, same acceptance math);
+#     the 6k bench_diff gate picks all of these up next round.
+step serve_spec_device_ab python tools/serve_bench.py --spec-ab \
+    --spec-mode device --draft-k 6 --repeat-unit 4 --layers 2 \
+    --prompt-len 16:24 --max-new 24 --rate 8 --requests 16 \
+    --num-pages 64 --max-pages 8 --page-size 8 --warmup
 
 # ---------------------------------------------------------------------------
 # TRAINING-SIDE PARITY + PERF LEVERS (after the serving records)

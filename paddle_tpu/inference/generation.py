@@ -25,7 +25,7 @@ from .. import monitor
 from .. import tracing as trace
 from ..core.tensor import Tensor
 from ..nn.functional_call import substituted_state
-from .ngram import NgramIndex, NgramProposer
+from .ngram import NgramIndex, NgramProposer, propose_device
 
 __all__ = ["GenerationConfig", "CausalLMEngine",
            "ContinuousBatchingEngine",
@@ -77,6 +77,19 @@ REQUEST_SITES = frozenset({"admit", "prefill", "chunk"})
 
 # paged-engine admission policies (see PagedContinuousBatchingEngine)
 ADMISSION_MODES = ("reserved", "optimistic")
+
+# speculative-decoding execution modes (see ContinuousBatchingEngine):
+# "host" proposes on host with a device→host readback per verify step;
+# "device" fuses propose→verify→accept into one compiled segment loop
+# (the history ring IS the draft source — one readback per segment)
+SPEC_MODES = ("host", "device")
+
+# device-mode draft sources: "ngram" = suffix-match lookup over the
+# slot's device history ring (ngram.propose_device, the host proposer's
+# windowed twin); "self" = reuse the verify forward's trailing greedy
+# tokens as the NEXT step's drafts (EAGLE-lite, no trained heads — the
+# ring still bootstraps each segment's first step)
+SPEC_DRAFTS = ("ngram", "self")
 
 
 class PagePoolExhausted(RuntimeError):
@@ -718,6 +731,8 @@ class ContinuousBatchingEngine:
                  prefill_buckets="auto",
                  prefill_chunk: Optional[int] = None,
                  draft_k: int = 0, ngram_max: int = 3,
+                 spec_mode: str = "host", spec_draft: str = "ngram",
+                 spec_history: int = 128,
                  lora_capacity: int = 0, lora_rank: int = 8,
                  lora_targets=("q", "k", "v", "o"),
                  tp_degree: int = 1, tp_devices=None):
@@ -730,6 +745,20 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"draft_k must be an int in [0, 256] (0 disables "
                 f"speculative decoding), got {draft_k!r}")
+        if spec_mode not in SPEC_MODES:
+            raise ValueError(
+                f"spec_mode must be one of {SPEC_MODES}, got "
+                f"{spec_mode!r}")
+        if spec_draft not in SPEC_DRAFTS:
+            raise ValueError(
+                f"spec_draft must be one of {SPEC_DRAFTS}, got "
+                f"{spec_draft!r}")
+        if (isinstance(spec_history, bool)
+                or not isinstance(spec_history, (int, np.integer))
+                or not 8 <= spec_history <= 65536):
+            raise ValueError(
+                f"spec_history must be an int in [8, 65536] (the "
+                f"device history-ring width), got {spec_history!r}")
         if (isinstance(lora_capacity, bool)
                 or not isinstance(lora_capacity, (int, np.integer))
                 or lora_capacity < 0):
@@ -769,14 +798,23 @@ class ContinuousBatchingEngine:
         # scan.
         self.draft_k = int(draft_k)
         self.ngram_max = int(ngram_max)
+        # speculative execution mode + device-draft source (idle-only
+        # attributes, like draft_k — the serving Server mirrors them):
+        # "device" replaces the host per-verify-step loop with ONE
+        # fused compiled segment whose draft source is the per-slot
+        # history ring below
+        self.spec_mode = spec_mode
+        self.spec_draft = spec_draft
+        self.spec_history = int(spec_history)
         self._spec = {}                # rid -> NgramProposer (spec rows)
         # engine-lifetime host accounting (serve_bench / spec_stats):
         # proposed/accepted draft tokens, verify forwards, per-slot
         # participations (slot_steps), tokens emitted (spec segments
-        # only)
+        # only), blocking per-verify-step host readbacks (host mode's
+        # documented price; structurally 0 in device mode)
         self._spec_totals = {"proposed": 0, "accepted": 0,
                              "forwards": 0, "slot_steps": 0,
-                             "emitted": 0}
+                             "emitted": 0, "host_syncs": 0}
         # engine label: concurrent engines (multi-model serving) publish
         # throughput side by side; retired via close()/__del__
         self._monitor_engine = monitor.instance_label("engine")
@@ -870,9 +908,12 @@ class ContinuousBatchingEngine:
                                             owner=self._monitor_engine,
                                             donate_argnums=(0,))
 
-        def admit_state(lens, last, done, active, samp, slot, plen,
-                        first, tok_done, temp, top_k, top_p, do_samp,
-                        eos, seed, spec_k, adapter):
+        H = self.spec_history
+
+        def admit_state(lens, last, done, active, samp, hist, hl, slot,
+                        plen, first, tok_done, temp, top_k, top_p,
+                        do_samp, eos, seed, spec_k, adapter, hrow,
+                        hlen):
             # one program for the per-slot scalars AND the request's
             # sampling parameters — admission sits in the
             # latency-critical gap between decode segments, and separate
@@ -888,15 +929,24 @@ class ContinuousBatchingEngine:
                 "spec_k": samp["spec_k"].at[slot].set(spec_k),
                 "adapter": samp["adapter"].at[slot].set(adapter),
             }
+            # history-ring seed: hrow is the prompt's last H-1 tokens
+            # (host-padded to the fixed [H] shape — never a recompile);
+            # the admission's FIRST token is a device scalar, so it
+            # lands in its slot here rather than forcing a host sync
+            hrow = jnp.where(
+                hlen > 0,
+                hrow.at[jnp.clip(hlen - 1, 0, H - 1)].set(first),
+                hrow)
             return (lens.at[slot].set(plen),
                     last.at[slot].set(first),
                     done.at[slot].set(tok_done),
-                    active.at[slot].set(True), samp)
+                    active.at[slot].set(True), samp,
+                    hist.at[slot].set(hrow), hl.at[slot].set(hlen))
 
         self._admit_state = monitor.monitored_jit(
             admit_state, name="cb_admit_state",
             owner=self._monitor_engine,
-            donate_argnums=(0, 1, 2, 3, 4))
+            donate_argnums=(0, 1, 2, 3, 4, 5, 6))
         self._segment_cache = {}
 
     def _init_decode_state(self) -> None:
@@ -934,6 +984,18 @@ class ContinuousBatchingEngine:
             # when a non-empty bank is passed alongside.
             "adapter": jnp.zeros((mb,), jnp.int32),
         }
+        # per-slot token-history ring (device-mode speculative draft
+        # source): each row holds the LAST spec_history tokens of
+        # prompt + everything emitted, left-aligned, hist_len valid.
+        # Installed at admission (_admit_state seeds prompt tail +
+        # first token — a replayed request re-admits prompt+generated,
+        # so the ring rebuilds exactly like the host proposer's
+        # context), appended inside the fused segment. Allocated
+        # unconditionally (mb x H int32 is trivial) so flipping
+        # draft_k/spec_mode on an idle engine never needs a state
+        # rebuild.
+        self.hist = jnp.zeros((mb, self.spec_history), jnp.int32)
+        self.hist_len = jnp.zeros((mb,), jnp.int32)
         if self.tp_mesh is not None:
             # the per-slot vectors REPLICATE on the mesh (the PR 2
             # invariant is TP-invariant): committing them here keeps
@@ -945,6 +1007,8 @@ class ContinuousBatchingEngine:
             self.active_dev = self._tp_rep(self.active_dev)
             self.samp = {k: self._tp_rep(v)
                          for k, v in self.samp.items()}
+            self.hist = self._tp_rep(self.hist)
+            self.hist_len = self._tp_rep(self.hist_len)
         self._free = list(range(mb))
 
     # -- tensor-parallel placement helpers -----------------------------------
@@ -1107,7 +1171,7 @@ class ContinuousBatchingEngine:
             last_logits = self._admit_cache(slot, ids, plen, cfg)
             first, tok_done = self._sample_first(rid, last_logits, cfg)
             self._install_state(slot, plen, first, tok_done, cfg,
-                                aidx=aidx)
+                                aidx=aidx, ids=ids)
         except BaseException:
             # a failed admission must not leak capacity: the popped
             # slot (and, paged, any page reservation _admit_cache made;
@@ -1179,22 +1243,40 @@ class ContinuousBatchingEngine:
         k = getattr(cfg, "draft_k", None)
         return self.draft_k if k is None else min(int(k), self.draft_k)
 
+    def _spec_k_of(self, rid: int) -> int:
+        """Host-side draft window of an ACTIVE request (0 = plain)."""
+        prop = self._spec.get(rid)
+        return 0 if prop is None else prop.k
+
     def _install_state(self, slot: int, plen: int, first, tok_done,
-                       cfg, aidx: int = 0) -> None:
+                       cfg, aidx: int = 0, ids=None) -> None:
         """Install the request's per-slot scalars AND sampling parameters
         (the LoRA adapter index included) in ONE jitted program (shared
         by the dense and paged engines) instead of separate
-        dispatches."""
+        dispatches. ``ids`` (the host-side prompt, when the caller has
+        one) seeds the slot's history ring with the prompt's trailing
+        window — the device-mode draft source; a replayed request
+        re-admits prompt+generated, so the ring rebuilds exactly like
+        the host proposer's context."""
         eos = -1 if cfg.eos_token_id is None else cfg.eos_token_id
+        H = self.spec_history
+        hrow = np.zeros((H,), np.int32)
+        hlen = 0
+        if ids is not None:
+            tail = np.asarray(ids, np.int32).reshape(-1)[-(H - 1):]
+            hrow[:len(tail)] = tail
+            hlen = len(tail) + 1     # + the first token (set in-program)
         (self.lens, self.last, self.done_dev, self.active_dev,
-         self.samp) = self._admit_state(
+         self.samp, self.hist, self.hist_len) = self._admit_state(
             self.lens, self.last, self.done_dev, self.active_dev,
-            self.samp, jnp.int32(slot), jnp.int32(plen), first,
+            self.samp, self.hist, self.hist_len, jnp.int32(slot),
+            jnp.int32(plen), first,
             tok_done, jnp.float32(cfg.temperature),
             jnp.int32(cfg.top_k), jnp.float32(cfg.top_p),
             jnp.asarray(cfg.do_sample), jnp.int32(eos),
             jnp.int32(cfg.seed % (2 ** 31)),
-            jnp.int32(self._spec_k_for(cfg)), jnp.int32(aidx))
+            jnp.int32(self._spec_k_for(cfg)), jnp.int32(aidx),
+            jnp.asarray(hrow), jnp.int32(hlen))
 
     def _register(self, slot: int, rid: int, first, tok_done, cfg,
                   t0: float) -> int:
@@ -1522,7 +1604,7 @@ class ContinuousBatchingEngine:
                                                  adm.last_logits,
                                                  adm.cfg)
             self._install_state(adm.slot, adm.plen, first, tok_done,
-                                adm.cfg, aidx=aidx)
+                                adm.cfg, aidx=aidx, ids=adm.ids)
         except BaseException:
             adm.closed = True
             self._abort_admit(adm.slot)
@@ -1602,7 +1684,7 @@ class ContinuousBatchingEngine:
                     self.active_dev, self.samp, self._bank(),
                     self.caches, key)
             out[f"segment_{segment_steps}"] = time.perf_counter() - t0
-        if self.draft_k:
+        if self.draft_k and self.spec_mode == "host":
             # the widened speculative verify step: with every slot
             # inactive (live mask all-False) acceptance is 0 and every
             # KV write drops, so running it only compiles
@@ -1616,6 +1698,25 @@ class ContinuousBatchingEngine:
                     jnp.zeros((mb, self.draft_k), jnp.int32),
                     jnp.zeros((mb,), bool), jnp.zeros((mb,), jnp.int32))
             out[f"spec_step_{self.draft_k}"] = time.perf_counter() - t0
+        if (self.draft_k and self.spec_mode == "device"
+                and segment_steps is not None):
+            # the fused device-resident speculative segment: like the
+            # plain segment warm, all-inactive rows make every step a
+            # masked no-op, so running it only compiles — the program
+            # a speculating request hits is hot before the first
+            # admission
+            t0 = time.perf_counter()
+            mb = self.max_batch
+            (_, self.last, self.lens, self.done_dev, self.hist,
+             self.hist_len, self.caches) = \
+                self._spec_segment_device_fn(segment_steps)(
+                    self.params, self.last, self.lens, self.done_dev,
+                    self.active_dev, self.samp, self._bank(),
+                    self.caches, self.hist, self.hist_len,
+                    jnp.zeros((mb,), jnp.int32),
+                    jnp.zeros((mb,), jnp.int32), jax.random.PRNGKey(0))
+            out[f"spec_segment_{segment_steps}"] = \
+                time.perf_counter() - t0
         if self.adapters is not None:
             # per-target bank-row install programs: the first hot
             # load() in a serving gap must not pay an XLA compile
@@ -1684,7 +1785,12 @@ class ContinuousBatchingEngine:
     # -- batched speculative decoding (per-slot capability) ------------------
     def _fwd_spec(self, params, inp, caches, lens, live, lora=None):
         """W-token verify forward at per-row offsets (cache-layout
-        hook; the paged subclass routes through the page pool)."""
+        hook; the paged subclass routes through the page pool).
+        Returns ``(logits, caches, aux)`` — ``aux`` is the window-write
+        rows the int8 paged path hands back for the post-acceptance
+        commit (:meth:`_commit_spec_rows`); ``None`` here (dense
+        caches write exact floats, rejected rows are plain overwritten
+        garbage)."""
         from ..core.autograd import no_grad
 
         with substituted_state(self.model, params), no_grad():
@@ -1692,7 +1798,52 @@ class ContinuousBatchingEngine:
                 Tensor(inp), caches, lens, live,
                 **self._fwd_kwargs(lora))
         return (logits.value if isinstance(logits, Tensor) else logits,
-                caches)
+                caches, None)
+
+    def _commit_spec_rows(self, caches, aux, n_acc):
+        """Post-acceptance KV commit for the verify window: restore
+        each layer's pre-window snapshot (touched pages + scale
+        tables), then REPLAY only the accepted rows (``i < n_acc[b]``)
+        sequentially through the running-absmax int8 primitive.
+
+        The verify forward stored the whole W-window with running
+        scales so in-window reads match sequential plain decode
+        bitwise on acceptance-matched positions — but a rejected
+        draft's absmax must never persist in a page's MONOTONIC
+        running scale (the plain path never writes those rows).
+        Restore-then-replay makes the persistent pool/scale state
+        byte-for-byte what W single-token decode stores of the
+        accepted tokens would have produced: same scale-growth events,
+        same requant cascades, same rounding order — so spec-vs-plain
+        token parity survives quantization. No-op on dense/bf16 caches
+        (``aux`` is None — their rejected rows are exact-overwritten
+        garbage, nothing persists)."""
+        if aux is None or not any(a is not None for a in aux):
+            return caches
+        from ..quantization.kv import quant_store_rows
+
+        pools, pt = caches
+        new_pools = []
+        for (kp, vp, ks, vs), \
+                (snap_k, snap_v, snap_ks, snap_vs,
+                 kh, vh, page, offs) in zip(pools, aux):
+            w = page.shape[1]
+            pf = page.reshape(-1)
+            # un-write the window: duplicate pages in the snapshot
+            # gathered identical pre-store bytes, so duplicate
+            # scatter-backs are deterministic
+            kp = kp.at[pf].set(snap_k, mode="drop")
+            vp = vp.at[pf].set(snap_v, mode="drop")
+            ks, vs = snap_ks, snap_vs
+            for i in range(w):
+                pg = jnp.where(jnp.asarray(i, jnp.int32) < n_acc,
+                               page[:, i], kp.shape[0])
+                kp, ks = quant_store_rows(kp, ks, pg, offs[:, i],
+                                          kh[:, i])
+                vp, vs = quant_store_rows(vp, vs, pg, offs[:, i],
+                                          vh[:, i])
+            new_pools.append((kp, vp, ks, vs))
+        return new_pools, pt
 
     def _spec_step_fn(self):
         """ONE compiled speculative verify step, keyed on the engine's
@@ -1731,8 +1882,8 @@ class ContinuousBatchingEngine:
                 lora = (bank, samp["adapter"]) if bank else None
                 live = live_in & active & (lens < self.max_len)
                 inp = jnp.concatenate([last[:, None], drafts], axis=1)
-                logits, caches = self._fwd_spec(params, inp, caches,
-                                                lens, live, lora)
+                logits, caches, aux = self._fwd_spec(
+                    params, inp, caches, lens, live, lora)
                 greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 key, sub = jax.random.split(key)
                 g0 = jnp.where(samp["sample"],
@@ -1748,6 +1899,7 @@ class ContinuousBatchingEngine:
                 n_acc = jnp.minimum(m + 1,
                                     jnp.maximum(lim - lens, 0))
                 n_acc = jnp.where(live, n_acc, 0)
+                caches = self._commit_spec_rows(caches, aux, n_acc)
                 new_last = jnp.where(
                     n_acc > 0,
                     toks[jnp.arange(b), jnp.maximum(n_acc - 1, 0)],
@@ -1766,6 +1918,251 @@ class ContinuousBatchingEngine:
         so a window reaching past grown coverage degrades to fewer
         accepted tokens, never to reads of dropped writes."""
         return self.max_len
+
+    def _spec_segment_device_fn(self, n_steps: int):
+        """ONE fused compiled speculative segment
+        (``spec_mode="device"``): propose → W-position verify → accept
+        → KV-write for ``n_steps`` steps inside a single ``lax.scan``,
+        keyed on ``(n_steps, draft_k)`` alone (plus the engine-level
+        ``spec_draft`` source, an idle-only knob). The draft source is
+        the per-slot history ring — ``ngram.propose_device``, the host
+        proposer's windowed twin — or, under ``spec_draft="self"``,
+        the previous verify's trailing greedy tokens (EAGLE-lite; the
+        ring still bootstraps each segment's first step). Budget, eos
+        and page-coverage caps are device masks per step (``bud`` /
+        ``cov`` are per-row vectors from pure host bookkeeping —
+        coverage is FIXED across a segment because page growth only
+        happens in the inter-segment gap), so the host reads back once
+        per SEGMENT instead of once per verify step.
+
+        Acceptance is byte-for-byte the host path's
+        (:meth:`_spec_step_fn`): emitted tokens are always the model's
+        own greedy picks ``g_0..g_{n_acc-1}``, drafts only decide HOW
+        MANY — which is why device/host/plain greedy parity is
+        structural, even for a context that outgrew the ring. Per-step
+        tokens, acceptance counts, liveness AND the final done flags
+        all ride one packed int32 output tensor, so collection is
+        literally one readback."""
+        key_ = ("spec_device", n_steps, self.draft_k, self.spec_draft)
+        if key_ not in self._segment_cache:
+            k = self.draft_k
+            W = k + 1
+            max_len = self.max_len
+            n_max = self.ngram_max
+            H = self.spec_history
+            self_draft = self.spec_draft == "self"
+
+            def spec_segment(params, last, lens, done, active, samp,
+                             bank, caches, hist, hl, bud, cov, key):
+                b = last.shape[0]
+                lora = (bank, samp["adapter"]) if bank else None
+                rows = jnp.arange(b)
+                iw = jnp.arange(k, dtype=jnp.int32)[None]
+
+                def step(carry, _):
+                    (last, lens, done, caches, hist, hl, drafts,
+                     emitted, key) = carry
+                    live = (active & ~done & (lens < max_len)
+                            & (emitted < bud))
+                    if not self_draft:
+                        drafts = propose_device(hist, hl, k, n_max)
+                    inp = jnp.concatenate([last[:, None], drafts],
+                                          axis=1)
+                    logits, caches, aux = self._fwd_spec(
+                        params, inp, caches, lens, live, lora)
+                    greedy = jnp.argmax(logits, axis=-1).astype(
+                        jnp.int32)
+                    key, sub = jax.random.split(key)
+                    g0 = jnp.where(
+                        samp["sample"],
+                        _sample_rows(logits[:, 0], sub, samp),
+                        greedy[:, 0])
+                    toks = jnp.concatenate([g0[:, None], greedy[:, 1:]],
+                                           axis=1)          # [B, W]
+                    match = ((drafts == greedy[:, :k])
+                             & (iw < samp["spec_k"][:, None]))
+                    m = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
+                                            axis=1), axis=1)
+                    # per-row absolute cap, fused: remaining budget
+                    # (bud - emitted) + page coverage; cov is already
+                    # min(coverage, max_len) host-side
+                    lim = jnp.minimum(
+                        lens + jnp.maximum(bud - emitted, 0), cov)
+                    n_acc = jnp.minimum(m + 1,
+                                        jnp.maximum(lim - lens, 0))
+                    n_acc = jnp.where(live, n_acc, 0)
+                    # eos mid-accepted-draft: truncate at the FIRST
+                    # accepted eos and freeze the row — the host
+                    # loop's cut, as a device mask
+                    hit = ((samp["eos"][:, None] >= 0)
+                           & (toks == samp["eos"][:, None])
+                           & (jnp.arange(W)[None] < n_acc[:, None]))
+                    any_hit = hit.any(axis=1)
+                    n_acc = jnp.where(
+                        any_hit,
+                        jnp.argmax(hit, axis=1).astype(jnp.int32) + 1,
+                        n_acc)
+                    done = done | any_hit
+                    # int8 paged pools: running-absmax commit of the
+                    # FINAL accepted prefix only (post-eos-truncation)
+                    # — rejected rows stay scale-frozen
+                    caches = self._commit_spec_rows(caches, aux, n_acc)
+                    new_last = jnp.where(
+                        n_acc > 0,
+                        toks[rows, jnp.maximum(n_acc - 1, 0)], last)
+                    lens = lens + n_acc
+                    done = done | (lens >= max_len)
+                    emitted = emitted + n_acc
+                    # history-ring append of the VARIABLE per-row
+                    # accepted count: masked scatter into an H+W
+                    # extension (out-of-range columns drop), then a
+                    # per-row gather shift keeps the last H tokens
+                    ext = jnp.concatenate(
+                        [hist, jnp.zeros((b, W), jnp.int32)], axis=1)
+                    cols = hl[:, None] + jnp.arange(W)[None]
+                    cols = jnp.where(
+                        jnp.arange(W)[None] < n_acc[:, None], cols,
+                        H + W)
+                    ext = ext.at[rows[:, None], cols].set(toks,
+                                                          mode="drop")
+                    shift = jnp.maximum(hl + n_acc - H, 0)
+                    hist = jnp.take_along_axis(
+                        ext, jnp.arange(H)[None] + shift[:, None],
+                        axis=1)
+                    hl = jnp.minimum(hl + n_acc, H)
+                    if self_draft:
+                        # next drafts = this verify's trailing greedy
+                        # tokens past the accepted prefix (clamped to
+                        # the window) — position lens+n_acc's
+                        # continuation guess came from THIS forward
+                        nxt = jnp.take_along_axis(
+                            toks, jnp.clip(n_acc[:, None] + iw, 0, k),
+                            axis=1)
+                        drafts = jnp.where(live[:, None], nxt, drafts)
+                    ys = jnp.concatenate(
+                        [toks, n_acc[:, None],
+                         live.astype(jnp.int32)[:, None]], axis=1)
+                    return ((new_last, lens, done, caches, hist, hl,
+                             drafts, emitted, key), ys)
+
+                drafts0 = (propose_device(hist, hl, k, n_max)
+                           if self_draft
+                           else jnp.zeros((b, k), jnp.int32))
+                carry = (last, lens, done, caches, hist, hl, drafts0,
+                         jnp.zeros((b,), jnp.int32), key)
+                (last, lens, done, caches, hist, hl, _, _, _), seg = \
+                    jax.lax.scan(step, carry, None, length=n_steps)
+                # final done flags ride the SAME packed tensor as the
+                # per-step tokens: collection is one readback
+                tail = jnp.zeros((1, b, W + 2),
+                                 jnp.int32).at[0, :, 0].set(
+                    done.astype(jnp.int32))
+                return (jnp.concatenate([seg, tail], axis=0), last,
+                        lens, done, hist, hl, caches)
+
+            self._segment_cache[key_] = monitor.monitored_jit(
+                spec_segment, name="cb_spec_device_segment",
+                owner=self._monitor_engine, donate_argnums=(7,))
+        return self._segment_cache[key_]
+
+    # lint: hot-path
+    def _decode_segment_spec_device(self, n_steps: int,
+                                    cfg=None):
+        """Device-resident speculative decode segment: ONE dispatch of
+        the fused :meth:`_spec_segment_device_fn` program, then ONE
+        readback for collection — no per-verify-step host round-trip
+        (``spec_stats()["host_syncs"]`` stays 0 in this mode; that
+        round-trip is exactly what ``spec_mode="host"`` pays).
+
+        The per-row budget/coverage caps ship as fixed-shape device
+        vectors built from pure host bookkeeping — never a device
+        pull, never a recompile — and the segment's speculative
+        accounting (proposed/accepted/slot_steps) is derived ONCE from
+        the packed per-step tallies the program returns, preserving
+        the ``emitted == slot_steps + accepted`` identity across both
+        modes."""
+        t0 = time.perf_counter()
+        mb = self.max_batch
+        k = self.draft_k
+        W = k + 1
+        bud = np.zeros((mb,), np.int32)
+        cov = np.zeros((mb,), np.int32)
+        for slot, rid in self._slot_req.items():
+            bud[slot] = max(self._budget[rid], 0)
+            cov[slot] = min(self._coverage_limit(slot), self.max_len)
+        # fresh noise per segment, like the plain scan (the program
+        # splits per step; sampled rows fold their own seed in)
+        self._segments_run += 1
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.seed if cfg is not None else 0),
+            self._segments_run)
+        (seg, self.last, self.lens, self.done_dev, self.hist,
+         self.hist_len, self.caches) = self._spec_segment_device_fn(
+            n_steps)(
+            self.params, self.last, self.lens, self.done_dev,
+            self.active_dev, self.samp, self._bank(), self.caches,
+            self.hist, self.hist_len, jnp.asarray(bud),
+            jnp.asarray(cov), key)
+        # lint: allow-host-sync(collection itself: ONE readback per
+        # FUSED segment — n_steps x (tokens, acceptance, liveness)
+        # plus the final done flags ride one packed tensor; this is
+        # the plain path's once-per-segment collect pull, not the
+        # host-mode per-verify-step sync)
+        seg = np.asarray(seg)
+        done_h = seg[-1, :, 0].astype(bool)
+        total = proposed = accepted = slot_steps = 0
+        steps_live = np.zeros((n_steps,), bool)
+        for slot, rid in list(self._slot_req.items()):
+            live_s = seg[:n_steps, slot, W + 1].astype(bool)
+            acc_s = seg[:n_steps, slot, W]
+            sk = self._spec_k_of(rid)
+            seq = []
+            for s in range(n_steps):
+                if not live_s[s]:
+                    continue
+                steps_live[s] = True
+                slot_steps += 1
+                proposed += sk
+                na = int(acc_s[s])
+                seq.extend(int(t) for t in seg[s, slot, :na])
+                accepted += max(na - 1, 0)
+            self._tokens[rid].extend(seq)
+            self._budget[rid] -= len(seq)
+            total += len(seq)
+            if self._budget[rid] <= 0 or bool(done_h[slot]):
+                self._retire(slot)
+        # forwards counts verify steps that served at least one live
+        # row — the host loop's early-exit semantics; the fused
+        # program's trailing all-dead steps are masked no-ops
+        forwards = int(steps_live.sum())
+        self._spec_totals["proposed"] += proposed
+        self._spec_totals["accepted"] += accepted
+        self._spec_totals["forwards"] += forwards
+        self._spec_totals["slot_steps"] += slot_steps
+        self._spec_totals["emitted"] += total
+        if monitor.enabled():
+            dt = time.perf_counter() - t0
+            monitor.counter(
+                "paddle_tpu_generated_tokens_total",
+                "tokens generated by the continuous-batching engines "
+                "(admission first-token + decode segments)").inc(total)
+            self._tokens_per_sec_gauge().labels(
+                engine=self._monitor_engine).set(
+                total / dt if dt > 0 else 0.0)
+            if proposed:
+                c = self._spec_tokens_counter()
+                c.labels(engine=self._monitor_engine,
+                         outcome="proposed").inc(proposed)
+                c.labels(engine=self._monitor_engine,
+                         outcome="accepted").inc(accepted)
+        if trace.enabled():
+            trace.record(
+                "engine.spec_segment",
+                dur_ns=int((time.perf_counter() - t0) * 1e9),
+                engine=self._monitor_engine, mode="device",
+                steps=n_steps, forwards=forwards, proposed=proposed,
+                accepted=accepted, emitted=total, host_syncs=0)
+        return len(self._slot_req)
 
     @staticmethod
     def _spec_tokens_counter():
@@ -1787,12 +2184,21 @@ class ContinuousBatchingEngine:
         one slot's tokens per verify forward it rode (1.0 = plain
         cadence; the batch-level tokens/forward would conflate batch
         size with speculation). At B=1 it reduces to the offline
-        path's ``tokens/forwards`` metric."""
+        path's ``tokens/forwards`` metric.
+
+        ``host_syncs`` counts blocking per-verify-step device→host
+        readbacks (``spec_mode="host"``'s documented price — one per
+        verify forward); ``host_syncs_per_token`` normalizes by
+        emitted tokens and is structurally 0.0 under
+        ``spec_mode="device"``, where the fused segment reads back
+        once per segment like the plain path."""
         t = dict(self._spec_totals)
         t["acceptance_rate"] = (t["accepted"] / t["proposed"]
                                 if t["proposed"] else 0.0)
         t["tokens_per_forward"] = (t["emitted"] / t["slot_steps"]
                                    if t["slot_steps"] else 0.0)
+        t["host_syncs_per_token"] = (t["host_syncs"] / t["emitted"]
+                                     if t["emitted"] else 0.0)
         return t
 
     # lint: hot-path
@@ -1805,20 +2211,25 @@ class ContinuousBatchingEngine:
         exactly like the plain path's collection does.
 
         The host round-trip per verify step is the price of host-side
-        proposers; each forward yields up to ``spec_k + 1`` tokens for
-        accepting rows, which is the trade this path exists to make
-        (decode is HBM-bound on TPU, so accepted tokens/forward ≈ wall
-        speedup there). Plain and sampled slots ride along at one
-        token per step — a mixed batch never splits programs."""
+        proposers (``spec_mode="host"``; ``"device"`` fuses the whole
+        segment and pays NO per-step sync — see
+        :meth:`_decode_segment_spec_device`); each forward yields up
+        to ``spec_k + 1`` tokens for accepting rows, which is the
+        trade this path exists to make (decode is HBM-bound on TPU, so
+        accepted tokens/forward ≈ wall speedup there). Plain and
+        sampled slots ride along at one token per step — a mixed batch
+        never splits programs."""
         t0 = time.perf_counter()
         k = self.draft_k
         mb = self.max_batch
         fn = self._spec_step_fn()
-        # lint: allow-host-sync(one lens/done pull per SEGMENT: the
-        # host proposers need real lengths to place drafts; tracked
-        # incrementally below, not re-pulled per step)
+        # lint: allow-host-sync(spec_mode="host" only — one lens/done
+        # pull per SEGMENT: the host proposers need real lengths to
+        # place drafts; tracked incrementally below, not re-pulled per
+        # step. Device mode ships no per-row pulls at all.)
         lens_h = np.asarray(self.lens).copy()
-        # lint: allow-host-sync(same once-per-segment pull as lens_h)
+        # lint: allow-host-sync(same once-per-segment spec_mode="host"
+        # pull as lens_h)
         done_h = np.asarray(self.done_dev)
         emitted = {rid: [] for rid in self._slot_req.values()}
         finished = set()
@@ -1857,11 +2268,14 @@ class ContinuousBatchingEngine:
                 jnp.asarray(drafts), jnp.asarray(live),
                 jnp.asarray(lim))
             forwards += 1
-            # lint: allow-host-sync(the per-verify-step readback IS
-            # the speculative path's documented price — host n-gram
-            # proposers must see acceptance before drafting again)
+            # lint: allow-host-sync(the spec_mode="host" branch's
+            # per-verify-step readback — host n-gram proposers must
+            # see acceptance before drafting again. This is exactly
+            # the sync spec_mode="device" eliminates; spec_stats'
+            # host_syncs counts it, and it reads 0 in device mode.)
             toks_h = np.asarray(toks)
-            # lint: allow-host-sync(same per-verify-step readback)
+            # lint: allow-host-sync(same spec_mode="host"
+            # per-verify-step readback)
             acc_h = np.asarray(n_acc)
             for slot, rid in self._slot_req.items():
                 if not live[slot]:
@@ -1899,6 +2313,10 @@ class ContinuousBatchingEngine:
         self._spec_totals["forwards"] += forwards
         self._spec_totals["slot_steps"] += slot_steps
         self._spec_totals["emitted"] += total
+        # one blocking device→host readback per verify forward — the
+        # host-mode price serve_bench's host-syncs-per-token record
+        # surfaces (structurally 0 on the device-mode path)
+        self._spec_totals["host_syncs"] += forwards
         if monitor.enabled():
             dt = time.perf_counter() - t0
             monitor.counter(
@@ -1923,9 +2341,10 @@ class ContinuousBatchingEngine:
             trace.record(
                 "engine.spec_segment",
                 dur_ns=int((time.perf_counter() - t0) * 1e9),
-                engine=self._monitor_engine, steps=n_steps,
-                forwards=forwards, proposed=proposed,
-                accepted=accepted, emitted=total)
+                engine=self._monitor_engine, mode="host",
+                steps=n_steps, forwards=forwards, proposed=proposed,
+                accepted=accepted, emitted=total,
+                host_syncs=forwards)
         return len(self._slot_req)
 
     # lint: hot-path
@@ -1946,8 +2365,12 @@ class ContinuousBatchingEngine:
             return 0
         if self._spec:
             # at least one live slot is speculating: the whole batch
-            # rides the ONE widened verify program (plain/sampled rows
-            # at 1 token/step) — host proposers need the per-step loop
+            # rides ONE widened verify program (plain/sampled rows at
+            # 1 token/step). Device mode fuses all n_steps into one
+            # compiled segment; host mode drives the per-step loop
+            # its host proposers need.
+            if self.spec_mode == "device":
+                return self._decode_segment_spec_device(n_steps, cfg)
             return self._decode_segment_spec(n_steps, cfg)
         n_live = len(self._slot_req)
         t0 = time.perf_counter()
@@ -2225,6 +2648,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  prefix_cache: bool = False,
                  kv_dtype: str = "bf16",
                  draft_k: int = 0, ngram_max: int = 3,
+                 spec_mode: str = "host", spec_draft: str = "ngram",
+                 spec_history: int = 128,
                  lora_capacity: int = 0, lora_rank: int = 8,
                  lora_targets=("q", "k", "v", "o"),
                  tp_degree: int = 1, tp_devices=None):
@@ -2278,6 +2703,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                          prefill_buckets=prefill_buckets,
                          prefill_chunk=prefill_chunk,
                          draft_k=draft_k, ngram_max=ngram_max,
+                         spec_mode=spec_mode, spec_draft=spec_draft,
+                         spec_history=spec_history,
                          lora_capacity=lora_capacity,
                          lora_rank=lora_rank,
                          lora_targets=lora_targets,
@@ -2550,22 +2977,18 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         pools, pt = caches
         with substituted_state(self.model, params), no_grad():
-            logits, pools = self.model.forward_decode_spec_paged(
-                Tensor(inp), pools, pt, lens, live,
-                **self._fwd_kwargs(lora))
+            logits, pools, aux = \
+                self.model.forward_decode_spec_paged(
+                    Tensor(inp), pools, pt, lens, live,
+                    **self._fwd_kwargs(lora))
         return (logits.value if isinstance(logits, Tensor) else logits,
-                (pools, pt))
+                (pools, pt), aux)
 
     def _coverage_limit(self, slot: int) -> int:
         # the spec step may only ACCEPT tokens whose KV writes landed
         # in mapped pages — cap each row's acceptance at its grown
         # coverage (writes past it are dropped by the sentinel)
         return min(self.alloc.covered_tokens(slot), self.max_len)
-
-    def _spec_k_of(self, rid: int) -> int:
-        """Host-side draft window of an ACTIVE request (0 = plain)."""
-        prop = self._spec.get(rid)
-        return 0 if prop is None else prop.k
 
     def _reserved(self, plen: int, cfg) -> int:
         return min(plen + cfg.max_new_tokens, self.max_len)

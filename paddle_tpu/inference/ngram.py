@@ -18,14 +18,22 @@ Two layers:
   the serving engines keep per request id; a preempted/replayed request
   simply rebuilds it from ``prompt + generated`` (the index is a pure
   function of the context).
+
+Plus the DEVICE twin: :func:`propose_device` runs the same suffix-match
+lookup as a fixed-shape jax computation over per-slot history windows
+held on device — the draft source of the continuous-batching engines'
+``spec_mode="device"`` fused segment, where a host proposer would cost
+a device→host sync per verify step.
 """
 from __future__ import annotations
 
 from typing import List
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["NgramIndex", "NgramProposer"]
+__all__ = ["NgramIndex", "NgramProposer", "propose_device"]
 
 
 class NgramIndex:
@@ -96,3 +104,53 @@ class NgramProposer:
         k = self.k if k is None else int(k)
         self.proposed += k
         return self._index.propose(self.ctx, k)
+
+
+def propose_device(hist, hl, k: int, ngram_max: int):
+    """Fixed-shape device twin of :meth:`NgramIndex.propose` over
+    per-row history windows: ``hist`` is ``[B, H]`` int32 (each row the
+    LAST ``hl[b] <= H`` context tokens, left-aligned), returns ``[B, k]``
+    int32 drafts. For any row whose full context fits its window this
+    produces EXACTLY the host proposer's drafts — longest suffix match
+    first, most recent occurrence within a length, continuation padded
+    with its own last token, total miss degrading to the tail token —
+    so the host/device draft sources only diverge once a context
+    outgrows the ring, and even then only in ACCEPTANCE (emitted tokens
+    are always the model's own greedy picks; see the engines'
+    speculative docs). Pure jnp (traceable inside ``lax.scan``); cost
+    is O(H * ngram_max) per row per call, independent of context
+    length."""
+    H = hist.shape[1]
+    n_max = int(ngram_max)
+    k = int(k)
+
+    def one(row, ln):
+        j = jnp.arange(H)
+        i = jnp.arange(n_max)
+        # token at window position j-i (the gram ending at j, read
+        # back-to-front) vs the current tail suffix token at ln-1-i;
+        # distinct sentinels for the two out-of-range sides so a
+        # padding position can never fake a match
+        pos = j[:, None] - i[None, :]
+        tokj = jnp.where(pos >= 0, row[jnp.clip(pos, 0, H - 1)], -1)
+        tpos = ln - 1 - i
+        tail = jnp.where(tpos >= 0, row[jnp.clip(tpos, 0, H - 1)], -2)
+        run = jnp.cumprod((tokj == tail[None, :]).astype(jnp.int32),
+                          axis=1)      # run[j, n-1]: n-gram match at j
+        n_arr = i + 1
+        # a valid length-n match needs the gram fully inside the window
+        # (j >= n-1) and must exclude the current suffix itself
+        # (j <= ln-2 — the host index registers one behind the tail)
+        ok = ((run > 0) & (j[:, None] >= n_arr[None, :] - 1)
+              & (j[:, None] <= ln - 2))
+        # longest n wins, most recent j breaks ties — exactly the host
+        # loop order (n descending, map holds the latest occurrence)
+        score = jnp.where(ok, n_arr[None, :] * H + j[:, None], -1)
+        j_sel = jnp.argmax(score) // n_max
+        start = jnp.where(jnp.max(score) >= 0, j_sel + 1, ln - 1)
+        # clamping to the window tail replicates the host's pad-with-
+        # last (and the total-miss [tail]*k fallback, via start=ln-1)
+        idx = jnp.clip(start + jnp.arange(k), 0, jnp.maximum(ln - 1, 0))
+        return row[idx].astype(jnp.int32)
+
+    return jax.vmap(one)(hist, hl)

@@ -412,40 +412,56 @@ class LlamaAttention(Layer):
             return ctx.reshape(b, w, self.num_heads * hd), kp, vp
 
         def attend_q(qv, kv, vv, kp, vp, ks, vs):
-            # int8 pools: the W-wide draft-window writes quantize on
-            # store through the same running-absmax primitive the
-            # single-token step uses (rows flattened to [B*W] — rows
-            # landing in one page compose in the scatter-max), and
-            # every per-position read dequantizes inside the kernel
+            # int8 pools: the verify window must store-then-attend one
+            # position at a time through the SAME running-absmax
+            # primitive as single-token decode — a scale-growth event
+            # at window row i requantizes the page before position
+            # i+1's read, exactly as the sequential plain path would,
+            # so acceptance-matched positions reduce bitwise to it.
+            # The window rows are still PROVISIONAL (acceptance may
+            # reject all but a prefix, and the plain path never writes
+            # rejected rows — their absmax joining a page's MONOTONIC
+            # running scale would be unrecoverable), so the touched
+            # pages + scale tables snapshot BEFORE any store and ride
+            # out as aux with the float rows: the engine restores the
+            # snapshot post-acceptance and replays only the accepted
+            # prefix (ContinuousBatchingEngine._commit_spec_rows).
             from ..ops.paged_attention import paged_decode_mha
             from ..quantization.kv import quant_store_rows
 
             qh, kh, vh, page, offs = _prep(qv, kv, vv, kp)
-            pf, of = page.reshape(-1), offs.reshape(-1)
-            kp, ks = quant_store_rows(kp, ks, pf, of,
-                                      kh.reshape(b * w, self.kv_heads,
-                                                 hd))
-            vp, vs = quant_store_rows(vp, vs, pf, of,
-                                      vh.reshape(b * w, self.kv_heads,
-                                                 hd))
+            safe = jnp.minimum(page.reshape(-1), kp.shape[0] - 1)
+            snap_k, snap_v = kp[safe], vp[safe]
+            snap_ks, snap_vs = ks, vs
             lv = live.astype(jnp.int32)
-            ctx = jnp.stack(
-                [paged_decode_mha(qh[:, i], kp, vp, page_table,
-                                  lens + lv * (i + 1), ks, vs, tp=tp)
-                 for i in range(w)], axis=1)
+            ctxs = []
+            for i in range(w):
+                kp, ks = quant_store_rows(kp, ks, page[:, i],
+                                          offs[:, i], kh[:, i])
+                vp, vs = quant_store_rows(vp, vs, page[:, i],
+                                          offs[:, i], vh[:, i])
+                ctxs.append(paged_decode_mha(
+                    qh[:, i], kp, vp, page_table,
+                    lens + lv * (i + 1), ks, vs, tp=tp))
+            ctx = jnp.stack(ctxs, axis=1)
             return (ctx.reshape(b, w, self.num_heads * hd), kp, vp,
-                    ks, vs)
+                    ks, vs, snap_k, snap_v, snap_ks, snap_vs,
+                    kh, vh, page, offs)
 
         val = lambda t: t.value if isinstance(t, Tensor) else t  # noqa: E731
         if quant:
-            ctx, kp, vp, ks, vs = apply_op(
+            (ctx, kp, vp, ks, vs, snap_k, snap_v, snap_ks, snap_vs,
+             kh, vh, page, offs) = apply_op(
                 attend_q, q, k, v, *cache,
                 op_name="spec_paged_attention")
-            return self._o_lora(ctx, lora), (val(kp), val(vp), val(ks),
-                                             val(vs))
+            return (self._o_lora(ctx, lora),
+                    (val(kp), val(vp), val(ks), val(vs)),
+                    tuple(val(t) for t in
+                          (snap_k, snap_v, snap_ks, snap_vs,
+                           kh, vh, page, offs)))
         ctx, kp, vp = apply_op(attend, q, k, v, *cache,
                                op_name="spec_paged_attention")
-        return self._o_lora(ctx, lora), (val(kp), val(vp))
+        return self._o_lora(ctx, lora), (val(kp), val(vp)), None
 
     def forward_decode_paged(self, x, cos_full, sin_full, cache,
                              page_table, lens, live, lora=None,
@@ -624,12 +640,12 @@ class LlamaDecoderLayer(Layer):
     def forward_decode_spec_paged(self, x, cos_full, sin_full, cache,
                                   page_table, lens, live, lora=None,
                                   tp=None):
-        attn, cache = self.self_attn.forward_decode_spec_paged(
+        attn, cache, aux = self.self_attn.forward_decode_spec_paged(
             self.input_layernorm(x), cos_full, sin_full, cache,
             page_table, lens, live, lora=lora, tp=tp)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x), lora=lora)
-        return x, cache
+        return x, cache, aux
 
 
 class LlamaModel(Layer):
@@ -768,7 +784,10 @@ class LlamaModel(Layer):
     def forward_decode_spec_paged(self, input_ids, caches, page_table,
                                   lens, live, lora=None, tp=None):
         """Speculative verify step over the page pool — see
-        LlamaAttention.forward_decode_spec_paged."""
+        LlamaAttention.forward_decode_spec_paged. The third result is
+        the per-layer window-write aux (int8 pools: the float K/V rows
+        + their page/offset targets, for the engine's post-acceptance
+        running-absmax commit; ``None`` entries on bf16 pools)."""
         cfg = self.config
         x = self.embed_tokens(input_ids)
         max_len = page_table.shape[1] * caches[0][0].shape[1]
@@ -776,12 +795,14 @@ class LlamaModel(Layer):
             max_len, cfg.head_dim, cfg.rope_theta,
             x.value.dtype if isinstance(x, Tensor) else x.dtype)
         new_caches = []
+        aux_rows = []
         for i, (layer, cache) in enumerate(zip(self.layers, caches)):
-            x, cache = layer.forward_decode_spec_paged(
+            x, cache, aux = layer.forward_decode_spec_paged(
                 x, cos_full, sin_full, cache, page_table, lens, live,
                 lora=_lora_layer(lora, i), tp=tp)
             new_caches.append(cache)
-        return self.norm(x), new_caches
+            aux_rows.append(aux)
+        return self.norm(x), new_caches, aux_rows
 
 
 class LlamaForCausalLM(Layer):
@@ -894,9 +915,11 @@ class LlamaForCausalLM(Layer):
 
     def forward_decode_spec_paged(self, input_ids, caches, page_table,
                                   lens, live, lora=None, tp=None):
-        """(logits [B, W, V], new_caches) — batched speculative verify
-        step over the page pool."""
-        hidden, caches = self.model.forward_decode_spec_paged(
+        """(logits [B, W, V], new_caches, aux) — batched speculative
+        verify step over the page pool; ``aux`` is the per-layer
+        window-write rows for the engine's post-acceptance int8 commit
+        (``None`` entries on bf16 pools)."""
+        hidden, caches, aux = self.model.forward_decode_spec_paged(
             input_ids, caches, page_table, lens, live, lora=lora,
             tp=tp)
-        return self.logits(hidden), caches
+        return self.logits(hidden), caches, aux

@@ -78,8 +78,9 @@ from .. import tracing as trace
 from ..monitor import ledger as _ledger
 from ..monitor import slo as _slo
 from ..inference.generation import (ADMISSION_MODES, GenerationConfig,
-                                    PagePoolExhausted, _prompt_ids,
-                                    _prompt_len, classify_fault)
+                                    PagePoolExhausted, SPEC_MODES,
+                                    _prompt_ids, _prompt_len,
+                                    classify_fault)
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QueueFull,
                     RequestHandle, RequestQueue, RequestRejected)
 
@@ -193,6 +194,12 @@ class Server:
     - ``draft_k`` — convenience mirror of the engine's draft-window
       knob (None leaves the engine's own setting); set it before
       ``warmup`` so the widened verify program pre-compiles;
+    - ``spec_mode`` — mirror of the engine's speculative execution
+      mode (``"host"`` | ``"device"``; None leaves the engine's own
+      setting): ``"device"`` drafts from the per-slot device history
+      ring and fuses the whole propose→verify→accept segment into one
+      compiled program — zero per-verify-step host syncs, tokens
+      stream per SEGMENT instead of per step;
     - ``speculative`` — True makes speculation the server DEFAULT for
       every eligible request (greedy; sampled requests always decode
       plain). Individual requests opt in/out via
@@ -253,6 +260,7 @@ class Server:
                  admission_mode: Optional[str] = None,
                  age_after_s: Optional[float] = None,
                  draft_k: Optional[int] = None,
+                 spec_mode: Optional[str] = None,
                  speculative: bool = False,
                  kv_dtype: Optional[str] = None,
                  tenant_quotas=None,
@@ -332,6 +340,27 @@ class Server:
                 raise ValueError(
                     "draft_k can only be set on an idle engine")
             engine.draft_k = draft_k
+        if spec_mode is not None:
+            # convenience mirror of the engine's speculative execution
+            # mode (see ContinuousBatchingEngine spec_mode): "device"
+            # fuses propose→verify→accept into one compiled segment —
+            # drafts come from the per-slot device history ring, and
+            # the scheduler's gap no longer drives per-step host
+            # proposals for speculating slots. Set before the
+            # scheduler thread starts so warmup pre-compiles the
+            # mode's program (the fused segment needs segment_steps,
+            # which warmup passes).
+            if spec_mode not in SPEC_MODES:
+                raise ValueError(
+                    f"spec_mode must be one of {SPEC_MODES}, got "
+                    f"{spec_mode!r}")
+            if getattr(engine, "spec_mode", None) is None:
+                raise ValueError(
+                    "spec_mode needs a continuous-batching engine")
+            if getattr(engine, "_slot_req", None):
+                raise ValueError(
+                    "spec_mode can only be set on an idle engine")
+            engine.spec_mode = spec_mode
         if speculative and not getattr(engine, "draft_k", 0):
             raise ValueError(
                 "speculative=True needs an engine built with "

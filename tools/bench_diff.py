@@ -51,7 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 _LOWER_BETTER = (
     "ttft", "tpot", "latency", "seconds", "compile", "overhead",
     "occupancy", "recovery", "p50", "p90", "p99", "stall", "loss",
-    "bytes", "cost", "miss", "preempt", "evict",
+    "bytes", "cost", "miss", "preempt", "evict", "syncs",
 )
 _HIGHER_BETTER = (
     "tokens_per_sec", "throughput", "goodput", "survival", "capacity",

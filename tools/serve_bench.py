@@ -52,8 +52,10 @@ Usage::
     python tools/serve_bench.py --shared-prefix-len 64 --cache-prefixes on
     # speculative-decoding A/B (PERF.md spec-serving methodology):
     # repetitive prompts (the accepting case) through the SAME load
-    # twice — plain then speculative — reporting serve_tpot_*_plain /
-    # _spec, serve_spec_tokens_per_forward and the acceptance rate
+    # three times — plain, host-mode spec, device-mode spec —
+    # reporting serve_tpot_*_{plain,spec,specdev}, tokens/forward,
+    # acceptance, serve_spec_host_syncs_per_token (0.0 on the device
+    # arm) and serve_spec_mode_tpot_speedup (host/device)
     python tools/serve_bench.py --spec-ab --draft-k 6 --repeat-unit 4 \
         --prompt-len 16:24 --max-new 24 --warmup
     # fleet survival A/B (PERF.md fleet-survival methodology): the SAME
@@ -296,6 +298,7 @@ def _toy_engine(args, speculative: bool = False):
         prefix_cache=(args.cache_prefixes == "on"),
         kv_dtype=args.kv_dtype,
         draft_k=(args.draft_k if speculative else 0),
+        spec_mode=getattr(args, "spec_mode", "host"),
         lora_capacity=args.adapters,
         lora_rank=args.lora_rank,
         lora_targets=tuple(t.strip()
@@ -608,10 +611,19 @@ def main(argv=None) -> int:
     ap.add_argument("--draft-k", type=int, default=6,
                     help="draft window (tokens proposed per verify "
                          "forward) when speculation is on")
+    ap.add_argument("--spec-mode", choices=("host", "device"),
+                    default="host",
+                    help="where drafts come from when speculation is "
+                         "on: 'host' round-trips the n-gram proposer "
+                         "every verify step, 'device' runs the fused "
+                         "propose+verify+accept segment program (one "
+                         "host readback per SEGMENT)")
     ap.add_argument("--spec-ab", action="store_true",
-                    help="A/B mode: run the SAME load twice — plain "
-                         "then speculative — and report serve_tpot_* "
-                         "per arm plus the spec speedup ratio")
+                    help="A/B mode: run the SAME load three times — "
+                         "plain, host-mode speculative, device-mode "
+                         "speculative — and report serve_tpot_* per "
+                         "arm plus the spec and host/device speedup "
+                         "ratios")
     ap.add_argument("--repeat-unit", type=int, default=0, metavar="N",
                     help="build each prompt by tiling a seeded N-token "
                          "unit (self-repetitive text — the n-gram "
@@ -899,7 +911,11 @@ def main(argv=None) -> int:
     spec_def = args.speculative == "on"
     trace_def = args.trace_out is not None
     if args.spec_ab:
-        arms = [("plain", False, trace_def), ("spec", True, trace_def)]
+        # three arms on the identical pre-drawn load: "spec" is pinned
+        # to host-mode drafting (the arm name existing baselines key
+        # on), "specdev" runs the fused device-resident program
+        arms = [("plain", False, trace_def), ("spec", True, trace_def),
+                ("specdev", True, trace_def)]
     elif args.trace_ab:
         arms = [("traceoff", spec_def, False),
                 ("traceon", spec_def, True)]
@@ -927,6 +943,10 @@ def main(argv=None) -> int:
     res = {}
     for arm, spec_on, trace_on in arms:
         arm_args = args
+        if args.spec_ab:
+            arm_args = argparse.Namespace(**vars(args))
+            arm_args.spec_mode = ("device" if arm == "specdev"
+                                  else "host")
         if args.kv_ab:
             # EQUAL HBM across the arms: int8 pages cost half the
             # bytes, so the int8 pool gets twice the pages — the
@@ -1033,6 +1053,16 @@ def main(argv=None) -> int:
                 {"metric": "serve_spec_throughput_speedup",
                  "value": round(b["throughput"] / a["throughput"], 3),
                  "unit": "x (spec/plain)"}))
+        # the host-vs-device verdict: same drafts, same acceptance —
+        # the ratio isolates what the per-step proposer round-trip
+        # costs (on CPU-tiny it prices the MECHANISM; on-chip the
+        # eliminated syncs are the latency frontier — see PERF.md)
+        d = res["specdev"]
+        if b.get("tpot_p50") and d.get("tpot_p50"):
+            print(json.dumps({"metric": "serve_spec_mode_tpot_speedup",
+                              "value": round(b["tpot_p50"]
+                                             / d["tpot_p50"], 3),
+                              "unit": "x (host/device)"}))
     if args.lora_ab:
         # the multi-tenant verdict: decode cadence with the
         # batched-adapter gather in the program vs without, on the
@@ -1535,6 +1565,13 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
                           "unit": "ratio"}))
         print(json.dumps({"metric": f"serve_spec_draft_tokens{sfx}",
                           "value": ss["proposed"], "unit": "tokens"}))
+        # the sync-elimination receipt: host mode blocks on one
+        # proposer readback per verify forward, device mode reads back
+        # once per SEGMENT — this must print 0.0 there
+        print(json.dumps(
+            {"metric": f"serve_spec_host_syncs_per_token{sfx}",
+             "value": round(ss["host_syncs_per_token"], 4),
+             "unit": "syncs/token"}))
     if server is not None and args.router:
         # fleet accounting (PERF.md fleet-survival methodology): the
         # survival rate over ACCEPTED requests is the headline — with
