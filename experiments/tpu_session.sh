@@ -254,6 +254,23 @@ step serve_spec_device_ab python tools/serve_bench.py --spec-ab \
     --prompt-len 16:24 --max-new 24 --rate 8 --requests 16 \
     --num-pages 64 --max-pages 8 --page-size 8 --warmup
 
+# 6n. on-TPU OVERLOAD-CONTROL A/B (NEW — PR 19): three arms — cap at
+#     --rate, then the identical pre-drawn 60%-hot-tenant load at 2x
+#     that rate without/with Server(control_policy=...). CALIBRATE
+#     FIRST: run one plain arm at a high rate to find the chip's
+#     req/s capacity, set --rate to ~0.85x of it and --slo-ttft to
+#     ~3x the at-capacity TTFT p99 (the CPU reference used 4 req/s /
+#     1.5s against a ~4.6 req/s toy; see PERF.md round 19 for the
+#     knob-sensitivity notes — max_queue should let occupancy lead
+#     burn by a second or two). The bar is the CPU one: ctrlon
+#     cold-tenant goodput retention >= 0.9 with nonzero hot sheds,
+#     ctrloff collapsing. Mechanism is chip-independent host
+#     bookkeeping; what TPU adds is REAL HBM-bound service times
+#     under the brownout max_new cap.
+STEP_TIMEOUT=3600 step serve_overload_ab python tools/serve_bench.py \
+    --overload-ab --requests 240 --rate 4 --max-new 96 --max-batch 1 \
+    --layers 6 --max-queue 16 --slo-ttft 1.5 --warmup
+
 # ---------------------------------------------------------------------------
 # TRAINING-SIDE PARITY + PERF LEVERS (after the serving records)
 # ---------------------------------------------------------------------------

@@ -2672,6 +2672,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.admission_mode = admission_mode
         self.kv_watermark = float(kv_watermark)
         self.prefix_cache = bool(prefix_cache)
+        # overload-control actuator (serving.control brownout rung 4):
+        # while True, NEW admissions skip prefix-cache lookup/insert
+        # and take the plain cold path (already warmed — pausing
+        # compiles nothing, and no CoW/shared pages are minted under
+        # pressure). Resident cached blocks stay mapped; in-flight
+        # warm admissions finish normally. Host bool, flipped by the
+        # serving scheduler thread between segments.
+        self.prefix_pause = False
         # KV page storage: "bf16" = the model's cache dtype, bitwise
         # the pre-quantization path; "int8" stores pages int8 with
         # per-(page, kv_head) running-absmax scales riding the page
@@ -3045,7 +3053,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         return pids, c_map, hashes, salt
 
     def _admit_cache(self, slot: int, ids, plen: int, cfg):
-        if self.prefix_cache:
+        if self.prefix_cache and not self.prefix_pause:
             pids, c_map, hashes, salt = self._lookup_degraded(
                 slot, ids, plen, cfg)
             self._prefix_stash[slot] = {
@@ -3294,7 +3302,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         return self._mini_cache(width)
 
     def _begin_admit_cache(self, slot: int, ids, plen: int, cfg):
-        if not self.prefix_cache:
+        if not self.prefix_cache or self.prefix_pause:
             return super()._begin_admit_cache(slot, ids, plen, cfg)
         pids, c_map, hashes, salt = self._lookup_degraded(slot, ids,
                                                           plen, cfg)

@@ -136,6 +136,8 @@ from ..inference.generation import (EngineFault, PagePoolExhausted,
                                     RequestFault, classify_fault)
 from ..monitor.slo import SLOPolicy
 from .adapters import AdapterRegistry
+from .control import (RUNG_ACTIONS, ControlPlane, ControlPolicy,
+                      ElasticController)
 from .http import serve_http
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
                     RUNNING, DeadlineExpired, QueueFull,
@@ -157,5 +159,7 @@ __all__ = [
     "Router", "ReplicaSpec", "RouterHandle",
     "RemoteReplica", "RemoteReplicaSpec", "DisaggregatedFront",
     "FailoverBudgetExceeded", "FleetUnavailable", "SLOPolicy",
+    "ControlPolicy", "ControlPlane", "ElasticController",
+    "RUNG_ACTIONS",
     "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED", "FAILED",
 ]

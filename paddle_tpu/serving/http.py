@@ -31,8 +31,11 @@ Routes:
 
   Status codes are the backpressure contract: 400 malformed request
   (GenerationConfig validation / prompt that can never fit), 429 queue
-  full (with ``Retry-After``), 503 draining/degraded/shutdown, 504
-  admission deadline expired. A FAILED server (scheduler died) and a
+  full OR tenant shed by the overload control plane — both with
+  ``Retry-After`` (queue-depth-derived when full; the burn window's
+  remaining life when shed — the body's ``retry_after_s`` float keeps
+  the precision the integer header rounds up) — 503
+  draining/degraded/shutdown, 504 admission deadline expired. A FAILED server (scheduler died) and a
   DEGRADED one (stalled step, mid-recovery) both reject immediately
   with 503 and a machine-readable ``reason``
   (``shutdown``/``degraded``) — a request must never queue into a
@@ -57,7 +60,10 @@ Routes:
   ``{"admission_mode", "occupancy", "free_pages",
   "waiting_on_pages", "preemptions"}`` — the KV memory-pressure
   surface that tells "degraded by memory pressure" (occupancy near
-  1.0, preemptions climbing) apart from the stall/fault reason; with
+  1.0, preemptions climbing) apart from the stall/fault reason. A
+  ``Server(control_policy=...)`` adds ``"control"``: ``{"rung",
+  "rung_action", "sheds": {tenant: {reason: n}}, "shed_active"}`` —
+  the active brownout rung and per-tenant shed counts; with
   the prefix cache on it also carries ``prefix_cache``,
   ``cached_pages``, ``shared_pages``, ``prefix_hits``,
   ``prefix_lookups``, and ``prefix_tokens_saved``.
@@ -425,10 +431,28 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                     **({"tenant": tenant} if tenant is not None
                        else {}))
             except RequestRejected as e:
-                if e.reason == "queue_full":
+                if e.reason in ("queue_full", "shed"):
+                    # both are 429 backpressure, with honest hints:
+                    # a SHED tenant's Retry-After is its burn window's
+                    # remaining life (retrying sooner just re-rejects);
+                    # a full queue's is depth-derived (deeper backlog
+                    # -> back off longer). The body carries the float
+                    # (retry_after_s) so programmatic clients — and
+                    # RemoteReplica, which re-raises with it — keep
+                    # the precision the integer header rounds away.
+                    ra = e.retry_after_s
+                    if ra is None:   # queue_full: scale with backlog
+                        try:
+                            depth = server.queue.depth
+                        except Exception:
+                            depth = 0
+                        ra = 1.0 + depth / 8.0
+                    ra = max(0.0, float(ra))
                     self._json(429, {"error": str(e),
-                                     "reason": e.reason},
-                               headers={"Retry-After": "1"})
+                                     "reason": e.reason,
+                                     "retry_after_s": round(ra, 3)},
+                               headers={"Retry-After":
+                                        str(max(1, int(-(-ra // 1))))})
                 else:   # draining / degraded / shutdown (failed server)
                     self._json(503, {"error": str(e),
                                      "reason": e.reason})

@@ -43,11 +43,16 @@ _TERMINAL = (FINISHED, CANCELLED, EXPIRED, FAILED)
 class RequestRejected(RuntimeError):
     """Backpressure rejection at submit time (the HTTP layer maps this
     to 429/503). ``reason`` is machine-readable; the message says what
-    the client should do about it."""
+    the client should do about it. ``retry_after_s`` (when set) is the
+    server's honest wait estimate — a shed rejection derives it from
+    the remaining burn window and the HTTP layer turns it into a
+    ``Retry-After`` header."""
 
-    def __init__(self, reason: str, message: str):
+    def __init__(self, reason: str, message: str,
+                 retry_after_s: Optional[float] = None):
         super().__init__(message)
         self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class QueueFull(RequestRejected):
@@ -311,6 +316,16 @@ class RequestQueue:
     forever. Aging is applied in :meth:`reap` (the scheduler calls it
     every inter-segment gap); FIFO order within an effective priority
     is preserved. ``None`` (default) keeps strict static priority.
+
+    :meth:`penalize` pushes one tenant's entries into a PENALTY BAND
+    (effective priority ``base + band``) until a deadline — the
+    control plane's deprioritize-not-drop actuator for a tenant whose
+    burn window fired. While the window is active, aging operates
+    WITHIN the band: an aged penalized entry improves toward (but is
+    clamped strictly above) its base priority, so a shed tenant's
+    backlog can never age its way back to parity with healthy
+    tenants before the window closes. Past the deadline the penalty
+    clears and normal aging (from base) resumes.
     """
 
     def __init__(self, max_size: int,
@@ -325,6 +340,8 @@ class RequestQueue:
         self._lock = threading.Lock()
         self._heap: List[Tuple[int, int, RequestHandle]] = []
         self._seq = itertools.count()
+        # tenant -> (band, until_ts): active penalty windows
+        self._penalty: dict = {}          # guarded-by: self._lock
 
     @property
     def depth(self) -> int:
@@ -335,8 +352,46 @@ class RequestQueue:
         with self._lock:
             if len(self._heap) >= self.max_size:
                 raise QueueFull(self.max_size)
+            eff = handle.priority
+            pen = (self._penalty.get(handle.tenant)
+                   if self._penalty else None)
+            if pen is not None and time.monotonic() < pen[1]:
+                eff += pen[0]
             heapq.heappush(self._heap,
-                           (handle.priority, next(self._seq), handle))
+                           (eff, next(self._seq), handle))
+
+    def penalize(self, tenant: Optional[str], band: int,
+                 until: float) -> None:
+        """Deprioritize every queued (and future) entry of ``tenant``
+        by ``band`` priority levels until ``until`` (absolute
+        ``time.monotonic()``). Idempotent; re-penalizing extends or
+        re-bases the window."""
+        if tenant is None or band < 1:
+            return
+        with self._lock:
+            self._penalty[tenant] = (int(band), float(until))
+            changed = False
+            for i, (eff, seq, h) in enumerate(self._heap):
+                if h.tenant == tenant:
+                    self._heap[i] = (h.priority + int(band), seq, h)
+                    changed = True
+            if changed:
+                heapq.heapify(self._heap)
+
+    def unpenalize(self, tenant: Optional[str]) -> None:
+        """Clear a tenant's penalty window early and restore its
+        queued entries to base priority (aging re-applies from there
+        on the next :meth:`reap`)."""
+        with self._lock:
+            if self._penalty.pop(tenant, None) is None:
+                return
+            changed = False
+            for i, (eff, seq, h) in enumerate(self._heap):
+                if h.tenant == tenant and eff != h.priority:
+                    self._heap[i] = (h.priority, seq, h)
+                    changed = True
+            if changed:
+                heapq.heapify(self._heap)
 
     def reap(self, now: float) -> List[RequestHandle]:
         """Remove every cancelled/expired entry (anywhere in the queue,
@@ -344,13 +399,36 @@ class RequestQueue:
         against ``max_size``) and return them for finalization. Also
         applies priority AGING (``age_after_s``): entries whose waited
         time crossed another aging step get their effective priority
-        bumped and the heap re-ordered."""
+        bumped and the heap re-ordered — penalized tenants age within
+        their penalty band (clamped strictly above base priority)
+        until the window expires."""
         with self._lock:
+            expired_pen = [t for t, (_, until) in self._penalty.items()
+                           if now >= until]
+            if expired_pen:
+                gone_pen = set(expired_pen)
+                for t in expired_pen:
+                    del self._penalty[t]
+                changed = False
+                for i, (eff, seq, h) in enumerate(self._heap):
+                    if h.tenant in gone_pen and eff > h.priority:
+                        self._heap[i] = (h.priority, seq, h)
+                        changed = True
+                if changed:
+                    heapq.heapify(self._heap)
             if self.age_after_s is not None:
                 aged = False
                 for i, (eff, seq, h) in enumerate(self._heap):
-                    new = h.priority - int(
-                        (now - h.submit_ts) / self.age_after_s)
+                    credit = int((now - h.submit_ts) / self.age_after_s)
+                    pen = self._penalty.get(h.tenant)
+                    if pen is not None:
+                        # age WITHIN the band: a shed tenant's entry
+                        # improves but never reaches base parity while
+                        # the window is open
+                        new = max(h.priority + 1,
+                                  h.priority + pen[0] - credit)
+                    else:
+                        new = h.priority - credit
                     if new < eff:
                         self._heap[i] = (new, seq, h)
                         aged = True

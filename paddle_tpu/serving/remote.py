@@ -376,6 +376,12 @@ class RemoteReplica:
         self.stream_timeout_s = stream_timeout_s
         self.admission_probe_s = admission_probe_s
         self.poll_interval_s = poll_interval_s
+        # testing seam: a paddle_tpu.testing.faults.NetworkFaultPlan
+        # fired at the wire sites ("generate", "kv_import") — bounded
+        # delay / connection drop / mid-stream half-close, so the chaos
+        # suite can prove failover replay absorbs a torn wire, not just
+        # a dead engine
+        self.fault_plan = None
         self.queue = _RemoteQueue(self)
         self.engine = _RemoteEngine(self)
         self.slo = _RemoteSLO(self)
@@ -527,6 +533,13 @@ class RemoteReplica:
                              else f"{self.base_url}:{rid}")
         handle._trace_ttft = trace_rid is None
         try:
+            if self.fault_plan is not None:
+                # network seam: a delay sleeps right here, a drop
+                # raises ConnectionResetError into the unreachable
+                # path below (exactly a refused/reset socket), and a
+                # half-close spec rides in ``state`` for the reader
+                # thread to consume mid-stream
+                state["half_close"] = self.fault_plan.fire("generate")
             payload = json.dumps(body).encode()
             conn.request("POST", "/generate", body=payload,
                          headers={"Content-Type": "application/json"})
@@ -577,7 +590,13 @@ class RemoteReplica:
             body = {}
         msg = body.get("error", f"HTTP {status}")
         if status == 429:
-            raise RequestRejected("queue_full", msg)
+            # carry the server's reason ("queue_full" vs the control
+            # plane's "shed") and its Retry-After hint through — the
+            # router's backpressure classification and a client's
+            # backoff both depend on them surviving the hop
+            raise RequestRejected(
+                body.get("reason", "queue_full"), msg,
+                retry_after_s=body.get("retry_after_s"))
         if status == 503:
             raise RequestRejected(body.get("reason", "degraded"), msg)
         if status == 400:
@@ -656,6 +675,8 @@ class RemoteReplica:
             if conn.sock is not None:
                 conn.sock.settimeout(self.stream_timeout_s)
             first = True
+            cut = state.get("half_close")  # injected mid-stream tear
+            relayed = 0
             while True:
                 line = resp.readline()
                 if not line:
@@ -672,6 +693,15 @@ class RemoteReplica:
                         # rid is remote-private; -1 = "remote")
                         handle._mark_running(-1)
                     handle._push([int(rec["token"])])
+                    relayed += 1
+                    if cut is not None and relayed >= cut["after"]:
+                        # injected half-close: walk away with the
+                        # server mid-stream (the finally shears the
+                        # socket) — no done line, so the tear reads as
+                        # a replica failure and the router's failover
+                        # replay must absorb it; server-side the
+                        # broken-pipe guard reclaims the slot
+                        break
                 elif rec.get("done"):
                     done_line = rec
                     break
@@ -733,6 +763,15 @@ class RemoteReplica:
         """``POST /kv/import`` — install framed pages into the
         replica's pool + prefix index. Idempotent: chain hashes dedup
         a replayed ship into ``{"deduped": n}``."""
+        if self.fault_plan is not None:
+            # network seam: delay sleeps, drop raises (surfaces as the
+            # shipper's RuntimeError/OSError); a half-close truncates
+            # the payload mid-ship — the server sees torn framing and
+            # rejects, and the front's retry must re-ship (idempotent
+            # by chain hash, so a retry after a PARTIAL install dedups)
+            spec = self.fault_plan.fire("kv_import")
+            if spec is not None and spec.get("action") == "half_close":
+                raw = raw[:max(1, len(raw) // 2)]
         status, out = _http_raw("POST", self.base_url, "/kv/import",
                                 raw, "application/octet-stream",
                                 timeout=self.stream_timeout_s)

@@ -78,6 +78,7 @@ from .. import tracing as trace
 from ..monitor import slo as _slo
 from ..inference.generation import (GenerationConfig, PagePoolExhausted,
                                     _prompt_ids, _prompt_len)
+from .control import ControlPolicy, ElasticController, max_burn
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, _TERMINAL,
                     RequestFailed, RequestHandle, RequestRejected)
 from .scheduler import PreemptionBudgetExceeded, Server
@@ -204,7 +205,7 @@ class _Replica:
     __slots__ = ("index", "spec", "server", "breaker", "failures",
                  "opens", "open_until", "backoff_mult", "probing",
                  "restarts", "deliberate_restarts", "restart_at",
-                 "draining", "dead", "slow")
+                 "draining", "dead", "slow", "scaled_down")
 
     def __init__(self, index: int, spec: ReplicaSpec, server):
         self.index = index
@@ -232,6 +233,10 @@ class _Replica:
         #                            median — ALIVE but lagging; routed
         #                            last, never walled off (slow !=
         #                            open breaker)
+        self.scaled_down = False   # elastically parked: drained +
+        #                            shut down by the autoscaler, slot
+        #                            kept so a scale-up revives it
+        #                            from ITS spec (and device subset)
 
     # both helpers mutate breaker/supervision state: caller holds the
     # router lock
@@ -308,7 +313,17 @@ class Router:
       candidate) but still routable, surfaced in ``load()`` /
       ``GET /stats``, flight-recorder dump on the flip. Slow is the
       state breakers cannot see: the replica answers everything,
-      just late.
+      just late;
+    - ``elastic`` / ``elastic_interval_s`` — ELASTIC FLEET sizing
+      (``serving.control``): pass a :class:`ControlPolicy` (or a
+      pre-built :class:`ElasticController`) and the supervisor
+      thread grows/shrinks the serving replica count from queue
+      depth + burn rate, between 1 and ``len(specs)``. Scale-down
+      DRAINS the least-loaded replica (in-flight work always
+      finishes — the rolling-restart bar) and parks its slot;
+      scale-up revives a parked slot from its own spec. Decisions
+      are streak-gated and cooldown-rate-limited (flap-resistant);
+      :meth:`scale_to` is the deliberate operator override.
     """
 
     def __init__(self,
@@ -327,6 +342,8 @@ class Router:
                  skew_factor: float = 2.0,
                  skew_min_requests: int = 5,
                  skew_interval_s: float = 1.0,
+                 elastic=None,
+                 elastic_interval_s: float = 0.5,
                  start: bool = True):
         if isinstance(specs, ReplicaSpec):
             n = 1 if replicas is None else replicas
@@ -366,6 +383,28 @@ class Router:
             raise ValueError(
                 f"skew_min_requests must be >= 1, got "
                 f"{skew_min_requests!r}")
+        if not elastic_interval_s > 0:
+            raise ValueError(
+                f"elastic_interval_s must be > 0, got "
+                f"{elastic_interval_s!r}")
+        # elastic fleet sizing (serving.control.ElasticController):
+        # the supervisor thread grows/shrinks the ROUTABLE replica
+        # count between 1 and the spec list's length — scale-down
+        # DRAINS (PR 9 machinery: in-flight work always finishes, the
+        # slot parks scaled_down), scale-up revives a parked slot from
+        # ITS spec, so devices=... partitions are honoured on the way
+        # back. Pass a ControlPolicy (wrapped here) or a pre-built
+        # ElasticController; None = fixed fleet.
+        if isinstance(elastic, ControlPolicy):
+            elastic = ElasticController(elastic, min_replicas=1,
+                                        max_replicas=len(specs))
+        elif elastic is not None and not isinstance(elastic,
+                                                    ElasticController):
+            raise ValueError(
+                f"elastic must be a ControlPolicy, an "
+                f"ElasticController, or None, got {elastic!r}")
+        self._elastic = elastic
+        self.elastic_interval_s = elastic_interval_s
         self.max_failovers = max_failovers
         self.breaker_threshold = breaker_threshold
         self.breaker_backoff_s = breaker_backoff_s
@@ -419,6 +458,7 @@ class Router:
         for rep in self._replicas:
             self._breaker_metric(rep)
             self._slow_metric(rep)
+        self._replicas_metric()
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, daemon=True,
             name=f"paddle_tpu-router-monitor-{self.monitor_router}")
@@ -545,6 +585,8 @@ class Router:
                 snap = {"status": "unknown"}
             if rep.dead:
                 state = "dead"
+            elif rep.scaled_down:
+                state = "scaled_down"
             elif rep.restart_at is not None:
                 state = "restarting"
             elif rep.draining:
@@ -603,7 +645,10 @@ class Router:
             status = "failed"
         elif draining:
             status = "draining"
-        elif routable == len(reps):
+        elif routable == sum(1 for r in reps if not r.scaled_down):
+            # a deliberately parked (scaled-down) replica is capacity
+            # the autoscaler CHOSE not to run — the fleet it sized is
+            # fully routable, so it reads ok, not degraded
             status = "ok"
         else:
             # routable == 0 but not all dead reads "degraded", not
@@ -619,7 +664,9 @@ class Router:
                "free_slots": agg_f, "inflight_requests": inflight,
                "failovers": failovers, "breaker_opens": opens,
                "slow_replicas": [e["replica"] for e in entries
-                                 if e.get("slow")]}
+                                 if e.get("slow")],
+               "scaled_down": [r.index for r in reps
+                               if r.scaled_down]}
         with self._lock:
             if self._flight_dumps:
                 out["flight_dump"] = self._flight_dumps[-1]
@@ -848,7 +895,11 @@ class Router:
                      "paddle_tpu_router_failovers_total",
                      "paddle_tpu_router_breaker_state",
                      "paddle_tpu_router_replica_restarts_total",
-                     "paddle_tpu_router_replica_slow"):
+                     "paddle_tpu_router_replica_slow",
+                     # elastic fleet (PR 19): the replicas gauge would
+                     # export a stale fleet size forever
+                     "paddle_tpu_router_scale_events_total",
+                     "paddle_tpu_router_replicas"):
             try:
                 monitor.remove_series(name, router=self.monitor_router)
             except Exception:
@@ -892,6 +943,22 @@ class Router:
             ("router", "replica"))
 
     @staticmethod
+    def _scale_counter():
+        return monitor.counter(
+            "paddle_tpu_router_scale_events_total",
+            "elastic fleet scale decisions applied "
+            "(action=up revives a parked slot, action=down drains "
+            "one replica and parks it)", ("router", "action"))
+
+    @staticmethod
+    def _replicas_gauge():
+        return monitor.gauge(
+            "paddle_tpu_router_replicas",
+            "replica slots currently in the serving fleet (not "
+            "parked by the autoscaler, not permanently dead)",
+            ("router",))
+
+    @staticmethod
     def _slow_gauge():
         return monitor.gauge(
             "paddle_tpu_router_replica_slow",
@@ -918,6 +985,14 @@ class Router:
             self._slow_gauge().labels(
                 router=self.monitor_router,
                 replica=str(rep.index)).set(int(rep.slow))
+
+    def _replicas_metric(self) -> None:
+        if monitor.enabled():
+            with self._lock:
+                n = sum(1 for r in self._replicas
+                        if not r.dead and not r.scaled_down)
+            self._replicas_gauge().labels(
+                router=self.monitor_router).set(n)
 
     def _flight_dump(self, reason: str):
         """Router-level flight-recorder dump (no-op while tracing is
@@ -1420,6 +1495,7 @@ class Router:
         cadence — reading N rolling digests is host work, but not
         every-50ms work."""
         last_skew = 0.0
+        last_elastic = 0.0
         while not self._stop_evt.wait(self.monitor_interval_s):
             for rep in list(self._replicas):
                 self._supervise(rep)
@@ -1433,6 +1509,15 @@ class Router:
                     # replica (or a dump-path surprise) must never
                     # kill the supervision thread that restarts
                     # crashed replicas
+                    pass
+            if (self._elastic is not None
+                    and now - last_elastic >= self.elastic_interval_s):
+                last_elastic = now
+                try:
+                    self._elastic_tick(now)
+                except Exception:
+                    # same bar as skew: sizing is advisory, crash
+                    # supervision must keep running
                     pass
 
     def _check_skew(self) -> None:
@@ -1500,6 +1585,194 @@ class Router:
                             router=self.monitor_router)
             if slow:
                 self._flight_dump(f"replica_slow_{rep.index}")
+
+    # -- elastic fleet sizing (monitor thread / scale_to) --------------------
+    def _elastic_signals(self):
+        """Host-side autoscaler inputs: the currently-serving replica
+        records, their summed queue depth + active work, and the
+        hottest tenant fast-burn rate across their SLO trackers (0.0
+        while the monitor is off or no window has data). All
+        lock-light reads — same discipline as routing."""
+        with self._lock:
+            serving = [rep for rep in self._replicas
+                       if not (rep.dead or rep.draining
+                               or rep.scaled_down
+                               or rep.restart_at is not None)]
+        depth = 0
+        burn = 0.0
+        for rep in serving:
+            try:
+                depth += rep.server.queue.depth + rep.server.num_active()
+            except Exception:   # mid-swap replica: skip its numbers
+                continue
+            if monitor.enabled():
+                tracker = getattr(rep.server, "slo", None)
+                if tracker is not None:
+                    try:
+                        burn = max(burn,
+                                   max_burn(tracker.tenant_stats()))
+                    except Exception:
+                        pass
+        return serving, depth, burn
+
+    def _elastic_tick(self, now: float) -> None:
+        """One autoscaler pass (supervisor thread): feed occupancy +
+        queue depth + burn into the :class:`ElasticController` —
+        which owns the hysteresis (consecutive-signal streaks) and
+        the rate limit (cooldown) — and apply at most ONE replica of
+        change. Scale-down drains (never kills in-flight work);
+        scale-up revives a parked slot from its own spec."""
+        serving, depth, burn = self._elastic_signals()
+        d = self._elastic.decide(now, routable=len(serving),
+                                 queue_depth=depth, burn_max=burn)
+        if d > 0:
+            self._scale_up(depth=depth, burn=burn)
+        elif d < 0:
+            self._scale_down(depth=depth, burn=burn)
+
+    def _scale_down(self, depth: int = 0, burn: float = 0.0):
+        """Park the least-loaded serving replica: excluded from
+        routing immediately (draining), then drained WITHOUT a
+        timeout on a helper thread — every queued + in-flight request
+        runs to completion (the PR 9 rolling-restart bar: elastic
+        scale-down never fails a handle) — and only then shut down.
+        The slot stays in the fleet as ``scaled_down`` so a later
+        scale-up revives it from ITS spec (device pinning included).
+        Returns the drain thread, or None if no replica can be
+        spared."""
+        with self._lock:
+            cands = [rep for rep in self._replicas
+                     if not (rep.dead or rep.draining
+                             or rep.scaled_down
+                             or rep.restart_at is not None)]
+            if len(cands) < 2:   # never park the last serving replica
+                return None
+
+            def _load(rep):
+                try:
+                    return (rep.server.queue.depth
+                            + rep.server.num_active())
+                except Exception:
+                    return 0
+
+            # least-loaded victim (fewest requests to wait out), ties
+            # to the highest index — deterministic under equal load
+            victim = min(cands, key=lambda r: (_load(r), -r.index))
+            victim.draining = True
+            victim.scaled_down = True
+            srv = victim.server
+        if trace.enabled():
+            trace.event("control.scale", action="down",
+                        replica=victim.index,
+                        queue_depth=depth, burn=round(burn, 3),
+                        router=self.monitor_router)
+        if monitor.enabled():
+            self._scale_counter().labels(
+                router=self.monitor_router, action="down").inc()
+        self._replicas_metric()
+        t = threading.Thread(
+            target=self._finish_scale_down, args=(victim, srv),
+            daemon=True,
+            name=f"paddle_tpu-router-scaledown-{self.monitor_router}"
+                 f"-{victim.index}")
+        t.start()
+        return t
+
+    def _finish_scale_down(self, rep: _Replica, srv) -> None:
+        """Drain-then-stop half of a scale-down (helper thread): the
+        unbounded drain is the point — in-flight work finishes no
+        matter how long it decodes; only an empty server stops."""
+        try:
+            srv.drain(None)
+        except Exception:
+            pass
+        try:
+            srv.shutdown(drain=False, timeout=5.0)
+        except Exception:
+            pass
+        try:
+            eng = getattr(srv, "engine", None)
+            if eng is not None:
+                eng.close()
+        except Exception:
+            pass
+
+    def _scale_up(self, depth: int = 0, burn: float = 0.0,
+                  timeout: Optional[float] = None) -> bool:
+        """Revive the lowest-index parked (scaled-down) slot: rebuild
+        from its spec OUTSIDE the lock (same as supervised restarts —
+        routing never blocks on a build), wait for warmup, swap it in
+        with a clean breaker. Returns True when a slot was revived
+        (False: nothing parked, or a racing shutdown/restart won)."""
+        with self._lock:
+            parked = [rep for rep in self._replicas
+                      if rep.scaled_down and not rep.dead]
+            if not parked or self._stopping:
+                return False
+            rep = min(parked, key=lambda r: r.index)
+            old = rep.server
+        new = rep.spec.build()
+        new.wait_ready(timeout)
+        with self._lock:
+            if (self._stopping or rep.dead or not rep.scaled_down
+                    or rep.server is not old):
+                stale = new   # a shutdown/deliberate-restart won the
+                #               race mid-build: its server stays
+            else:
+                stale = None
+                rep.reset_health(server=new)
+                rep.draining = False
+                rep.scaled_down = False
+        if stale is not None:
+            try:
+                stale.shutdown(drain=False, timeout=5.0)
+            except Exception:
+                pass
+            return False
+        self._breaker_metric(rep)
+        self._slow_metric(rep)
+        self._replicas_metric()
+        if trace.enabled():
+            trace.event("control.scale", action="up",
+                        replica=rep.index,
+                        queue_depth=depth, burn=round(burn, 3),
+                        router=self.monitor_router)
+        if monitor.enabled():
+            self._scale_counter().labels(
+                router=self.monitor_router, action="up").inc()
+        return True
+
+    def scale_to(self, n: int, timeout: Optional[float] = None) -> int:
+        """Deliberately size the fleet to ``n`` serving replicas
+        (clamped to ``[1, len(specs)]``), bypassing the autoscaler's
+        hysteresis — the operator knob (and the deterministic test
+        surface). Scale-downs drain on helper threads; with
+        ``timeout`` the call waits (bounded) for those drains. Returns
+        the serving-replica count after the call."""
+        n = max(1, min(n, len(self._replicas)))
+        threads = []
+        while True:
+            with self._lock:
+                serving = sum(1 for r in self._replicas
+                              if not (r.dead or r.draining
+                                      or r.scaled_down
+                                      or r.restart_at is not None))
+            if serving > n:
+                t = self._scale_down()
+                if t is None:
+                    break
+                threads.append(t)
+            elif serving < n:
+                if not self._scale_up(timeout=timeout):
+                    break
+            else:
+                break
+        for t in threads:
+            t.join(timeout)
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if not (r.dead or r.draining or r.scaled_down
+                               or r.restart_at is not None))
 
     def _supervise(self, rep: _Replica) -> None:
         now = time.monotonic()

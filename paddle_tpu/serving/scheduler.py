@@ -81,6 +81,7 @@ from ..inference.generation import (ADMISSION_MODES, GenerationConfig,
                                     PagePoolExhausted, SPEC_MODES,
                                     _prompt_ids, _prompt_len,
                                     classify_fault)
+from .control import RUNG_ACTIONS, ControlPlane, ControlPolicy
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QueueFull,
                     RequestHandle, RequestQueue, RequestRejected)
 
@@ -246,6 +247,12 @@ class Server:
     # state even though no single preemption is a fault
     STORM_PREEMPTS = 8
     STORM_WINDOW_S = 5.0
+    # shed-storm flight-dump trigger (control plane): this many shed
+    # 429s inside the sliding window dumps the ring once per window —
+    # each 429 is the control plane working as intended, but a reject
+    # STORM is exactly the overload postmortem the black box exists for
+    SHED_STORM = 8
+    SHED_STORM_WINDOW_S = 5.0
 
     def __init__(self, engine, max_queue: int = 64,
                  segment_steps: int = 8,
@@ -264,7 +271,8 @@ class Server:
                  speculative: bool = False,
                  kv_dtype: Optional[str] = None,
                  tenant_quotas=None,
-                 slo_policy=None):
+                 slo_policy=None,
+                 control_policy=None):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
                 f"stall_timeout_s must be > 0 or None, got "
@@ -408,6 +416,22 @@ class Server:
         # ``slo`` block, stats(), and the fleet Router's GET /stats
         # (which MERGES these digests — exact fleet percentiles).
         self.slo = _slo.SLOTracker(policy=slo_policy)
+        if control_policy is not None and not isinstance(
+                control_policy, ControlPolicy):
+            raise ValueError(
+                f"control_policy must be a serving.control.ControlPolicy "
+                f"or None, got {control_policy!r}")
+        # SLO-driven overload control plane (serving.control): consumes
+        # the tracker's burn windows + queue occupancy in the gap and
+        # actuates burn-rate shedding (429 + Retry-After at submit),
+        # the brownout ladder, and quota tightening. Entirely host-side
+        # — engaging any rung compiles nothing. None = no control.
+        self.control = (None if control_policy is None
+                        else ControlPlane(
+                            control_policy,
+                            fast_window_s=(slo_policy.fast_window_s
+                                           if slo_policy is not None
+                                           else 60.0)))
         self.engine = engine
         self.segment_steps = segment_steps
         self.idle_wait_s = idle_wait_s
@@ -452,6 +476,13 @@ class Server:
         #                                   the storm trigger (scheduler
         #                                   thread only)
         self._last_storm_dump = -1e18
+        self._shed_lock = threading.Lock()
+        self._shed_ts = []                # guarded-by: self._shed_lock
+        #                                   recent shed-429 stamps for
+        #                                   the shed-storm trigger
+        #                                   (submit runs on CLIENT
+        #                                   threads, unlike preemptions)
+        self._last_shed_dump = -1e18      # guarded-by: self._shed_lock
         self._admin_ops = []              # guarded-by: self._lock
         #                                   pending adapter load/unload
         #                                   requests, applied by the
@@ -514,7 +545,9 @@ class Server:
         adapter either) leaves the request un-quotaed.
 
         Raises :class:`RequestRejected` (reason ``queue_full`` /
-        ``draining`` / ``degraded`` / ``shutdown``) for backpressure,
+        ``draining`` / ``degraded`` / ``shutdown`` / ``shed`` — the
+        last with ``retry_after_s`` set from the tenant's burn window,
+        Server(control_policy=...) only) for backpressure,
         ValueError for a prompt that could never fit the engine. A
         degraded server (stalled step, mid-recovery) rejects
         IMMEDIATELY with the reason instead of queueing into a server
@@ -534,6 +567,25 @@ class Server:
                 f"exceeds engine max_len({self.engine.max_len})")
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
+        eff_tenant = (tenant if tenant is not None
+                      else getattr(cfg, "adapter", None))
+        if self.control is not None and eff_tenant is not None:
+            # burn-rate admission control: a tenant whose fast-burn
+            # window fired is shed AT THE DOOR for the rest of the
+            # window — its queued entries are deprioritized (not these;
+            # see _control_tick) and new arrivals bounce with a
+            # Retry-After telling the client when the window clears.
+            # Checked OUTSIDE self._lock: the storm trigger below may
+            # write a flight dump, which takes self._lock itself.
+            ra = self.control.shed_check(eff_tenant, time.monotonic())
+            if ra is not None:
+                self._count("rejected_shed")
+                self._note_shed(eff_tenant, "burn_rate")
+                raise RequestRejected(
+                    "shed",
+                    f"tenant {eff_tenant!r} exceeded its SLO error "
+                    f"budget (fast-burn window); retry in {ra:.1f}s",
+                    retry_after_s=ra)
         # the put happens under the SAME lock as the stopping check:
         # otherwise a submit racing shutdown() could enqueue after the
         # scheduler's final queue drain and strand the handle QUEUED
@@ -562,9 +614,7 @@ class Server:
             handle = RequestHandle(self._next_id, prompt, plen, cfg,
                                    priority, deadline,
                                    on_cancel=self._on_cancel,
-                                   tenant=(tenant if tenant is not None
-                                           else getattr(cfg, "adapter",
-                                                        None)))
+                                   tenant=eff_tenant)
             # the trace key pairs the server label with the request id:
             # concurrent servers in one process restart their ids at 0,
             # and the process-wide ring must not merge their timelines
@@ -675,7 +725,12 @@ class Server:
                      "paddle_tpu_serving_goodput",
                      "paddle_tpu_serving_slo_misses_total",
                      "paddle_tpu_serving_tenant_tokens_total",
-                     "paddle_tpu_serving_tenant_kv_page_seconds_total"):
+                     "paddle_tpu_serving_tenant_kv_page_seconds_total",
+                     # overload control plane (PR 19): sheds carry an
+                     # open tenant/reason dimension, the rung gauge
+                     # would export a stale brownout forever
+                     "paddle_tpu_serving_sheds_total",
+                     "paddle_tpu_serving_brownout_rung"):
             try:
                 monitor.remove_series(name, server=self.monitor_server)
             except Exception:
@@ -874,7 +929,7 @@ class Server:
         ``{"status", "healthy", "server", "queue_depth",
         "active_requests", "restarts", "free_slots", "active_slots",
         "max_batch"[, "free_pages", "total_pages", "occupancy"]
-        [, "pressure"][, "slo"][, "flight_dump"]}``
+        [, "pressure"][, "slo"][, "control"][, "flight_dump"]}``
 
         ``healthy`` is the HTTP readiness verdict (``status`` in
         ``ok``/``draining`` — what ``/healthz`` turns into 200 vs 503).
@@ -924,6 +979,12 @@ class Server:
                                        "dispatches", "mfu", "bound")}
                             for pid in prof["top"]],
                 }
+        if self.control is not None:
+            # overload-control block (host dict walk under the plane's
+            # own lock): active brownout rung + its action name, per-
+            # tenant shed counts by reason, currently-shed tenants —
+            # the /healthz surface ISSUE 19's satellite asks for
+            snap["control"] = self.control.snapshot()
         with self._lock:
             if self._flight_dumps:
                 snap["flight_dump"] = self._flight_dumps[-1]
@@ -1109,10 +1170,62 @@ class Server:
             "memory half of per-tenant cost accounting",
             ("server", "tenant"))
 
+    @staticmethod
+    def _sheds_counter():
+        return monitor.counter(
+            "paddle_tpu_serving_sheds_total",
+            "burn-rate shed rejections by tenant and reason — the "
+            "control plane's 429-with-Retry-After path "
+            "(Server(control_policy=...))",
+            ("server", "tenant", "reason"))
+
+    @staticmethod
+    def _rung_gauge():
+        return monitor.gauge(
+            "paddle_tpu_serving_brownout_rung",
+            "active brownout-ladder rung (0 = disengaged; order: "
+            "quota_tighten, max_new_cap, spec_off, prefix_pause — see "
+            "serving.control.RUNG_ACTIONS)", ("server",))
+
     def _count(self, event: str) -> None:
         if monitor.enabled():
             self._requests_counter().labels(
                 server=self.monitor_server, event=event).inc()
+
+    def _note_shed(self, tenant: str, reason: str) -> None:
+        """Count + trace one shed rejection (runs on the SUBMITTING
+        client thread) and feed the shed-storm flight trigger: each
+        429 is the control plane working as intended, but a reject
+        STORM is the overload postmortem the PR-8 black box exists
+        for. Same sliding-window + re-arm-only-on-written-dump
+        discipline as the preemption-storm trigger — the dump fires
+        at most once per SHED_STORM_WINDOW_S even under concurrent
+        submits (decision and re-arm share self._shed_lock)."""
+        total = self.control.note_shed(tenant, reason)
+        if monitor.enabled():
+            self._sheds_counter().labels(
+                server=self.monitor_server, tenant=tenant,
+                reason=reason).inc()
+        if trace.enabled():
+            trace.event("control.shed", tenant=tenant, reason=reason,
+                        total=total, server=self.monitor_server)
+        now = time.monotonic()
+        # lock order: self._shed_lock -> self._lock (via _flight_dump);
+        # nothing takes them in the other order
+        with self._shed_lock:
+            self._shed_ts.append(now)
+            cut = now - self.SHED_STORM_WINDOW_S
+            while self._shed_ts and self._shed_ts[0] < cut:
+                self._shed_ts.pop(0)
+            if (len(self._shed_ts) >= self.SHED_STORM
+                    and now - self._last_shed_dump
+                    > self.SHED_STORM_WINDOW_S):
+                if trace.enabled():
+                    trace.event("control.shed_storm",
+                                count=len(self._shed_ts),
+                                window_s=self.SHED_STORM_WINDOW_S)
+                if self._flight_dump("shed_storm") is not None:
+                    self._last_shed_dump = now
 
     def _kv_page_seconds(self, h: RequestHandle, n_tokens: int) -> float:
         """Approximate KV page-seconds this request held (paged engine
@@ -1805,6 +1918,11 @@ class Server:
             with (trace.span("gap") if busy else trace.NULL_SPAN):
                 self._gap_body()
             self._relieve_pressure()
+            if self.control is not None:
+                # observe->act loop last, on the post-admission state
+                # (rate-limited inside ControlPlane.tick): pure host
+                # bookkeeping, no engine work
+                self._control_tick()
         finally:
             self._admitting = False
         self._depth_gauge()
@@ -1968,6 +2086,14 @@ class Server:
             if trace.enabled():
                 trace.event("queue.dequeue", rid=h._trace_rid,
                             wait_s=round(wait_s, 6))
+            if self.control is not None:
+                # brownout rungs 2/3 degrade the request AT admission
+                # (cap max_new_tokens, strip speculation): the handle's
+                # cfg is replaced so a later preemption REPLAYS the
+                # degraded budget — never the original. Already-admitted
+                # requests are untouched (rung transitions are bitwise-
+                # neutral for them); a no-op rung returns cfg unchanged.
+                h.cfg = self.control.degrade_cfg(h.cfg)
             self._start_admission(h, h.prompt, h.cfg, h.prompt_len)
 
     def _tenant_ok(self, h: RequestHandle) -> bool:
@@ -1983,19 +2109,73 @@ class Server:
         cap = q if isinstance(q, int) else q.get(h.tenant)
         if cap is None:
             return True
+        if self.control is not None:
+            # brownout rung 1: every quotaed tenant's effective cap is
+            # halved (min 1) while the ladder is engaged — the gentlest
+            # rung, shaving concurrency before any request degrades
+            cap = self.control.quota_cap(cap)
         n = sum(1 for hh in self._active.values()
                 if hh.tenant == h.tenant)
         if self._adm is not None and self._adm[1].tenant == h.tenant:
             n += 1
         return n < cap
 
+    def _control_tick(self) -> None:
+        """One control-plane pass in the gap (scheduler thread;
+        rate-limited inside :meth:`ControlPlane.tick`): feed the SLO
+        tracker's per-tenant burn windows + queue occupancy in, apply
+        what comes out — shed windows deprioritize the tenant's
+        ALREADY-QUEUED entries into the penalty band (new arrivals 429
+        at submit), rung transitions trace/export and flip the one
+        engine-side actuator (prefix-cache admission pause, a host
+        bool — the paused path is the already-warmed cold admission,
+        so no rung compiles anything)."""
+        dec = self.control.tick(
+            time.monotonic(),
+            queue_depth=self.queue.depth,
+            max_queue=self.queue.max_size,
+            tenant_stats=(self.slo.tenant_stats()
+                          if monitor.enabled() else None))
+        if dec is None:
+            return
+        band = self.control.policy.penalty_band
+        for tenant, until in dec["shed"]:
+            self.queue.penalize(tenant, band, until)
+            if trace.enabled():
+                trace.event("control.shed", tenant=tenant,
+                            reason="burn_window",
+                            window_s=round(
+                                until - time.monotonic(), 3),
+                            server=self.monitor_server)
+        for tenant in dec["unshed"]:
+            self.queue.unpenalize(tenant)
+        if dec["rung"] != dec["prev_rung"]:
+            if trace.enabled():
+                trace.event("control.rung", rung=dec["rung"],
+                            prev=dec["prev_rung"],
+                            action=RUNG_ACTIONS[dec["rung"]],
+                            occupancy=round(dec["occupancy"], 4),
+                            server=self.monitor_server)
+            if monitor.enabled():
+                self._rung_gauge().labels(
+                    server=self.monitor_server).set(dec["rung"])
+            if getattr(self.engine, "prefix_cache", False):
+                # rung 4 actuator: pause prefix-cache admission (new
+                # requests take the cold path — no CoW pages minted
+                # under overload). The scheduler thread owns the
+                # engine; getattr/setattr routes through a FaultyEngine
+                # proxy to the wrapped engine.
+                self.engine.prefix_pause = dec["rung"] >= 4
+
     # -- memory pressure (optimistic paged mode; scheduler thread) -----------
     def _relieve_pressure(self) -> None:
         """Resolve KV memory pressure in the gap (optimistic admission
         mode only; a no-op otherwise): grow every live slot's page
         mapping for the coming segment, and while the pool cannot
-        cover the growth, PREEMPT victims — lowest priority first
-        (highest priority value), then youngest (highest rid), NEVER
+        cover the growth, PREEMPT victims — most SLO headroom first
+        (no admission deadline beats any deadline, then furthest from
+        it), ties by lowest priority (highest priority value) then
+        youngest (highest rid), NEVER
         the oldest surviving request, so the head of the line always
         makes forward progress and pressure can never deadlock or
         livelock the loop. A preempted request's slot and pages are
@@ -2036,8 +2216,19 @@ class Server:
                       if self._active else None)
             cands = [r for r in self._active if r != oldest]
             if cands:
+                # deadline-aware victim ordering (ISSUE 19): preempt
+                # the request with the MOST SLO headroom first — one
+                # with no deadline at all (inf headroom) before any
+                # with one, then furthest-from-deadline. Ties (the
+                # whole field, when no deadlines are set) fall back to
+                # the PR-5 ordering: lowest priority (highest value),
+                # then youngest — deterministic either way.
+                now = time.monotonic()
                 victim = max(cands, key=lambda r:
-                             (self._active[r].priority,
+                             ((float("inf")
+                               if self._active[r].deadline is None
+                               else self._active[r].deadline - now),
+                              self._active[r].priority,
                               self._active[r].submit_ts,
                               self._active[r].id))
                 self._preempt(victim, "pressure")

@@ -58,9 +58,16 @@ from typing import List, Optional, Sequence
 
 from .. import tracing as trace
 
-__all__ = ["SITES", "FaultPlan", "FaultyEngine", "InjectedFault"]
+__all__ = ["SITES", "NET_SITES", "FaultPlan", "NetworkFaultPlan",
+           "FaultyEngine", "InjectedFault"]
 
 SITES = ("admit", "prefill", "chunk", "decode", "collect", "preempt")
+
+# network seams (cross-process serving, paddle_tpu.serving.remote):
+# a SEPARATE namespace from the engine SITES — a RemoteReplica's
+# failure modes are the wire's (delay / drop / mid-stream half-close),
+# not the engine's, and the two plans never share counters
+NET_SITES = ("generate", "kv_import")
 
 
 class InjectedFault(RuntimeError):
@@ -72,13 +79,15 @@ class InjectedFault(RuntimeError):
 
 class _Rule:
     __slots__ = ("site", "first", "times", "action", "exc", "seconds",
-                 "rate", "rng", "fired")
+                 "rate", "rng", "fired", "after")
 
     def __init__(self, site: str, first: int, times: int, action: str,
                  exc=None, seconds: float = 0.0,
-                 rate: Optional[float] = None, seed: int = 0):
-        if site not in SITES:
-            raise ValueError(f"unknown site {site!r}; one of {SITES}")
+                 rate: Optional[float] = None, seed: int = 0,
+                 after: int = 0, valid_sites: Sequence[str] = SITES):
+        if site not in valid_sites:
+            raise ValueError(
+                f"unknown site {site!r}; one of {tuple(valid_sites)}")
         if first < 1 or times < 1:
             raise ValueError("nth and times must be >= 1")
         self.site = site
@@ -90,6 +99,7 @@ class _Rule:
         self.rate = rate          # probabilistic (chaos-soak) rule
         self.rng = random.Random(seed) if rate is not None else None
         self.fired = 0
+        self.after = after        # half_close: stream lines to relay
 
 
 class FaultPlan:
@@ -108,10 +118,12 @@ class FaultPlan:
     - ``plan.calls`` — per-site call counters (how often each seam ran).
     """
 
+    VALID_SITES: Sequence[str] = SITES
+
     def __init__(self):
         self._lock = threading.Lock()
         self._rules: List[_Rule] = []
-        self.calls = {s: 0 for s in SITES}
+        self.calls = {s: 0 for s in self.VALID_SITES}
         self.injected: List[tuple] = []
         self._release = threading.Event()
 
@@ -121,7 +133,8 @@ class FaultPlan:
         """Raise ``exc`` (default :class:`InjectedFault`) at calls
         ``nth .. nth+times-1`` to ``site``."""
         with self._lock:
-            self._rules.append(_Rule(site, nth, times, "raise", exc))
+            self._rules.append(_Rule(site, nth, times, "raise", exc,
+                                     valid_sites=self.VALID_SITES))
         return self
 
     def hang_at(self, site: str, nth: int = 1, seconds: float = 1.0,
@@ -131,7 +144,8 @@ class FaultPlan:
         failure). :meth:`release_hangs` ends every hang early."""
         with self._lock:
             self._rules.append(
-                _Rule(site, nth, times, "hang", seconds=seconds))
+                _Rule(site, nth, times, "hang", seconds=seconds,
+                      valid_sites=self.VALID_SITES))
         return self
 
     def random_raises(self, sites: Sequence[str], rate: float,
@@ -145,7 +159,8 @@ class FaultPlan:
             for i, site in enumerate(sites):
                 self._rules.append(
                     _Rule(site, 1, 2 ** 31, "raise", exc,
-                          rate=rate, seed=seed + i))
+                          rate=rate, seed=seed + i,
+                          valid_sites=self.VALID_SITES))
         return self
 
     def kill(self, site: str = "decode", nth: int = 1, exc=None,
@@ -182,7 +197,7 @@ class FaultPlan:
             first = self.calls.get(site, 0) + nth
             self._rules.append(
                 _Rule(site, first, 2 ** 31, action, exc,
-                      seconds=seconds))
+                      seconds=seconds, valid_sites=self.VALID_SITES))
         return self
 
     def release_hangs(self) -> None:
@@ -190,10 +205,10 @@ class FaultPlan:
         self._release.set()
 
     # -- the seam hook -------------------------------------------------------
-    def fire(self, site: str) -> None:
-        """Called by :class:`FaultyEngine` before delegating a seam
-        call: count the call, and perform the first matching un-retired
-        rule's action (raise / hang)."""
+    def _consume(self, site: str):
+        """Count a call to ``site`` and consume the first matching
+        un-retired rule: bump ``calls``, log to ``injected``, trace.
+        Returns ``(action, exc, seconds, after, n)`` or ``None``."""
         with self._lock:
             self.calls[site] = self.calls.get(site, 0) + 1
             n = self.calls[site]
@@ -209,16 +224,26 @@ class FaultPlan:
                     rule = r
                     break
             if rule is None:
-                return
+                return None
             rule.fired += 1
             self.injected.append((site, n, rule.action))
-            action, exc, seconds = rule.action, rule.exc, rule.seconds
+            hit = (rule.action, rule.exc, rule.seconds, rule.after, n)
         if trace.enabled():
             # injections are part of the story a flight dump tells: a
             # chaos postmortem must distinguish injected faults from
             # organic ones
             trace.event("fault.injected", site=site, call=n,
-                        action=action)
+                        action=hit[0])
+        return hit
+
+    def fire(self, site: str) -> None:
+        """Called by :class:`FaultyEngine` before delegating a seam
+        call: count the call, and perform the first matching un-retired
+        rule's action (raise / hang)."""
+        hit = self._consume(site)
+        if hit is None:
+            return
+        action, exc, seconds, _after, n = hit
         if action == "hang":
             # outside the lock: a hung scheduler must not also wedge
             # every other seam's bookkeeping
@@ -232,6 +257,106 @@ class FaultPlan:
             # should pass a class or zero-arg factory so every
             # injection gets a fresh instance (re-raising one object
             # chains tracebacks onto it forever)
+            raise exc
+        raise exc()   # class or zero-arg factory
+
+
+class NetworkFaultPlan(FaultPlan):
+    """Deterministic injections at the WIRE seams of a
+    :class:`~paddle_tpu.serving.remote.RemoteReplica` — the failure
+    modes a cross-process fleet must absorb are the network's, not the
+    engine's, so they get their own site namespace (:data:`NET_SITES`)
+    and their own plan (never share counters with an engine-side
+    :class:`FaultPlan`).
+
+    Sites:
+
+    - ``"generate"``  — one ``POST /generate`` submission (counted at
+      the client, before the request hits the wire);
+    - ``"kv_import"`` — one ``POST /kv/import`` KV-page shipment (the
+      disaggregated prefill→decode handoff).
+
+    Actions, same nth/times discipline as the base plan:
+
+    - :meth:`delay_at` — bounded stall before the call proceeds
+      (releasable early via :meth:`release_hangs`, like a hang);
+    - :meth:`drop_at` — the connection never happens: raises
+      ``ConnectionResetError`` (or ``exc``) at the seam, which the
+      client surfaces exactly like a refused/reset socket;
+    - :meth:`half_close_at` — the INSIDIOUS one: the request goes
+      through, the server streams, and the client-side reader kills
+      the socket after relaying ``after`` stream lines — a mid-stream
+      half-close the router's failover replay must absorb without the
+      handle ever seeing a gap.
+
+    The seam hook is :meth:`fire`, which unlike the base plan RETURNS
+    the half-close spec (``{"action": "half_close", "after": n}``)
+    instead of raising — the cut happens later, inside the reader
+    thread, not at the call site. ``delay`` blocks then returns
+    ``None``; ``drop`` raises. Inherited :meth:`raise_at` /
+    :meth:`hang_at` also work against :data:`NET_SITES` (validation is
+    class-driven)."""
+
+    VALID_SITES = NET_SITES
+
+    # -- schedule construction (chainable) -----------------------------------
+    def delay_at(self, site: str, nth: int = 1, seconds: float = 0.05,
+                 times: int = 1) -> "NetworkFaultPlan":
+        """Bounded network delay: block ``seconds`` at calls
+        ``nth .. nth+times-1`` to ``site``, then proceed normally.
+        :meth:`release_hangs` ends every delay early."""
+        with self._lock:
+            self._rules.append(
+                _Rule(site, nth, times, "delay", seconds=seconds,
+                      valid_sites=self.VALID_SITES))
+        return self
+
+    def drop_at(self, site: str, nth: int = 1, exc=None,
+                times: int = 1) -> "NetworkFaultPlan":
+        """Drop the connection at calls ``nth .. nth+times-1``:
+        raises ``ConnectionResetError`` (or ``exc``) at the seam."""
+        with self._lock:
+            self._rules.append(
+                _Rule(site, nth, times, "drop", exc,
+                      valid_sites=self.VALID_SITES))
+        return self
+
+    def half_close_at(self, site: str = "generate", nth: int = 1,
+                      after: int = 1,
+                      times: int = 1) -> "NetworkFaultPlan":
+        """Mid-stream half-close: the ``nth`` call to ``site``
+        proceeds, but the client tears the socket down after relaying
+        ``after`` stream lines (1-based; ``after=2`` lets two ndjson
+        lines through, then cuts)."""
+        if after < 1:
+            raise ValueError("after must be >= 1")
+        with self._lock:
+            self._rules.append(
+                _Rule(site, nth, times, "half_close", after=after,
+                      valid_sites=self.VALID_SITES))
+        return self
+
+    # -- the seam hook -------------------------------------------------------
+    def fire(self, site: str):
+        """Network-seam variant: ``delay`` blocks then returns
+        ``None``; ``drop`` (and inherited ``raise``) raises;
+        ``half_close`` returns its spec dict for the caller to carry
+        into the stream reader. Returns ``None`` when no rule fires."""
+        hit = self._consume(site)
+        if hit is None:
+            return None
+        action, exc, seconds, after, n = hit
+        if action in ("hang", "delay"):
+            self._release.wait(seconds)
+            return None
+        if action == "half_close":
+            return {"action": "half_close", "after": after}
+        if exc is None:
+            if action == "drop":
+                raise ConnectionResetError(
+                    f"injected network drop @ {site} (call {n})")
+            raise InjectedFault(f"injected fault @ {site} (call {n})")
+        if isinstance(exc, BaseException):
             raise exc
         raise exc()   # class or zero-arg factory
 

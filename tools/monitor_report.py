@@ -341,6 +341,82 @@ def render_slo(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def render_control(doc: dict) -> str:
+    """Overload-control view of a ``paddle_tpu.tracing`` chrome-JSON
+    export / flight-recorder dump: the brownout-ladder timeline
+    (every ``control.rung`` transition with the occupancy that drove
+    it), burn-rate sheds grouped by tenant and reason, shed-storm
+    flight-dump triggers, and the router's elastic ``control.scale``
+    decisions. Timestamps are seconds relative to the first event in
+    the ring — the same clock the --trace view uses."""
+    evs = doc.get("traceEvents", [])
+    other = doc.get("otherData") or {}
+    t0 = min((float(e.get("ts", 0.0)) for e in evs), default=0.0)
+    rungs, storms, scales = [], [], []
+    sheds: Dict[str, Dict[str, int]] = {}
+    for e in evs:
+        name = e.get("name", "?")
+        if not name.startswith("control."):
+            continue
+        ts = (float(e.get("ts", 0.0)) - t0) / 1e6    # µs -> s
+        a = e.get("args") or {}
+        if name == "control.rung":
+            rungs.append((ts, a.get("prev"), a.get("rung"),
+                          a.get("action", "?"), a.get("occupancy")))
+        elif name == "control.shed":
+            by = sheds.setdefault(str(a.get("tenant")), {})
+            r = str(a.get("reason", "?"))
+            by[r] = by.get(r, 0) + 1
+        elif name == "control.shed_storm":
+            storms.append((ts, a.get("count"), a.get("window_s")))
+        elif name == "control.scale":
+            scales.append((ts, a.get("action", "?"), a.get("replica"),
+                           a.get("queue_depth"), a.get("burn")))
+    lines = []
+    if other.get("reason"):
+        lines.append(f"flight-recorder dump: reason="
+                     f"{other['reason']!r} pid={other.get('pid')}")
+    if not (rungs or sheds or storms or scales):
+        lines.append("(no control.* events — was the control plane "
+                     "armed and tracing on?)")
+        return "\n".join(lines)
+    if rungs:
+        lines.append("brownout ladder transitions:")
+        lines.append(f"  {'t(s)':>9}  {'RUNG':>9}  {'ACTION':<14}"
+                     f"{'OCCUPANCY':>10}")
+        for ts, prev, rung, action, occ in rungs:
+            lines.append(f"  {ts:>9.3f}  {_fmt_opt(prev, 'd'):>4}->"
+                         f"{_fmt_opt(rung, 'd'):<4} {action:<14}"
+                         f"{_fmt_opt(occ, '.3f'):>10}")
+        lines.append("")
+    if sheds:
+        lines.append("burn-rate sheds by tenant:")
+        w = max(len(t) for t in sheds)
+        for tenant in sorted(sheds,
+                             key=lambda t: -sum(sheds[t].values())):
+            by = sheds[tenant]
+            detail = ", ".join(f"{r}={n}"
+                               for r, n in sorted(by.items()))
+            lines.append(f"  {tenant:<{w}}  {sum(by.values()):>6}  "
+                         f"({detail})")
+        lines.append("")
+    if storms:
+        lines.append(f"shed storms (flight-dump triggers): "
+                     f"{len(storms)}")
+        for ts, count, win in storms:
+            lines.append(f"  t={ts:.3f}s  {count} sheds inside "
+                         f"{win}s")
+        lines.append("")
+    if scales:
+        lines.append("elastic scale decisions:")
+        for ts, action, rep, depth, burn in scales:
+            lines.append(f"  t={ts:.3f}s  {action:<5} replica "
+                         f"{_fmt_opt(rep, 'd')}  (queue_depth="
+                         f"{_fmt_opt(depth, '.1f')}, burn="
+                         f"{_fmt_opt(burn, '.2f')})")
+    return "\n".join(lines).rstrip()
+
+
 def _fmt_units(v, none: str = "-") -> str:
     """1.23e12 -> '1.23T' — roofline numbers span 9 orders."""
     if v is None:
@@ -436,6 +512,13 @@ def main(argv=None) -> int:
                          "requests with their dominant phase")
     ap.add_argument("--top", type=int, default=5,
                     help="slowest-requests rows in the --trace view")
+    ap.add_argument("--control", default=None, metavar="JSON",
+                    help="render the overload-control view of a "
+                         "chrome-JSON trace export / flight-recorder "
+                         "dump instead: brownout-ladder rung "
+                         "timeline, burn-rate sheds by tenant/"
+                         "reason, shed-storm triggers, elastic "
+                         "scale decisions")
     ap.add_argument("--slo", nargs="?", const="", default=None,
                     metavar="JSON",
                     help="render a GET /stats SLO snapshot instead: "
@@ -473,6 +556,10 @@ def main(argv=None) -> int:
     if args.trace:
         with open(args.trace) as f:
             print(render_trace(json.load(f), top=args.top))
+        return 0
+    if args.control:
+        with open(args.control) as f:
+            print(render_control(json.load(f)))
         return 0
     if args.slo is not None:
         if not args.slo and not args.url:

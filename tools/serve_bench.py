@@ -153,6 +153,9 @@ class _Stats:
         self.tokens = 0
         self.rejected = 0
         self.failed = 0
+        self.shed = 0             # rejections with reason "shed" (the
+        #                           burn-rate door, --overload-ab's
+        #                           ctrlon arm) — a subset of rejected
 
     def record(self, ttft, tpot, e2e, n_tokens, preempted=False,
                failover=False):
@@ -168,23 +171,25 @@ class _Stats:
                 self.e2e_failover.append(e2e)
             self.tokens += n_tokens
 
-    def reject(self):
+    def reject(self, shed=False):
         with self.lock:
             self.rejected += 1
+            if shed:
+                self.shed += 1
 
     def fail(self):
         with self.lock:
             self.failed += 1
 
 
-def _drive_inproc(server, prompt, cfg, stats):
+def _drive_inproc(server, prompt, cfg, stats, tenant=None):
     from paddle_tpu.serving import RequestRejected
 
     t0 = time.monotonic()
     try:
-        handle = server.submit(prompt, cfg)
-    except RequestRejected:
-        stats.reject()
+        handle = server.submit(prompt, cfg, tenant=tenant)
+    except RequestRejected as e:
+        stats.reject(shed=getattr(e, "reason", None) == "shed")
         return
     first = last = None
     n = 0
@@ -317,6 +322,22 @@ def _toy_server_kwargs(args, max_restarts=None):
 
         slo_policy = SLOPolicy(ttft_p99_s=args.slo_ttft,
                                tpot_p99_s=args.slo_tpot)
+    control_policy = None
+    if getattr(args, "control_on", False):
+        from paddle_tpu.serving import ControlPolicy
+
+        # the ctrlon arm's plane: default ladder/shed thresholds, but
+        # (a) shed_min_count scaled so only the HOT tenant (60% of the
+        # mix) accumulates enough scored requests in the fast window
+        # to shed — the thin-tenant guard keeps the 10% cold tenants
+        # un-shed by construction (requests//8 sits between one cold
+        # tenant's ~10% share and the hot tenant's 60%) — and (b) a
+        # fast tick + short dwell so the plane reacts within a
+        # seconds-long bench run
+        control_policy = ControlPolicy(
+            shed_min_count=max(8, args.requests // 8),
+            tick_interval_s=0.1,
+            rung_dwell_s=1.0)
     return dict(
         max_queue=args.max_queue, segment_steps=args.segment_steps,
         warmup=args.warmup,
@@ -327,7 +348,8 @@ def _toy_server_kwargs(args, max_restarts=None):
         restart_backoff_s=args.restart_backoff,
         stall_timeout_s=args.stall_timeout,
         tenant_quotas=args.tenant_quotas,
-        slo_policy=slo_policy)
+        slo_policy=slo_policy,
+        control_policy=control_policy)
 
 
 def _build_toy_server(args, speculative: bool = False):
@@ -779,6 +801,24 @@ def main(argv=None) -> int:
                          "— ledger OFF, then ON — and report "
                          "serve_profile_tpot_overhead (the PR 15 "
                          "one-bool-branch bar: <= 1.05x)")
+    # overload control plane knobs (paddle_tpu.serving.control)
+    ap.add_argument("--overload-ab", action="store_true",
+                    help="A/B mode: three arms on pre-drawn load with "
+                         "a 60%%-hot tenant mix — 'cap' at --rate (the "
+                         "at-capacity baseline), then 'ctrloff'/"
+                         "'ctrlon' replaying the IDENTICAL load at "
+                         "--overload-factor x that rate without/with "
+                         "the SLO-driven control plane "
+                         "(Server(control_policy=...)) — and report "
+                         "serve_goodput_* per arm plus the cold-"
+                         "tenant goodput retention verdict (the "
+                         "overload bar: ctrlon cold goodput within "
+                         "10%% of cap while the hot tenant sheds)")
+    ap.add_argument("--overload-factor", type=float, default=2.0,
+                    metavar="X",
+                    help="overload multiple for the ctrloff/ctrlon "
+                         "arms: arrival times are the cap arm's "
+                         "schedule compressed by X (> 1; default 2.0)")
     args = ap.parse_args(argv)
 
     rng = random.Random(args.seed)
@@ -795,10 +835,10 @@ def main(argv=None) -> int:
         return 2
     if sum([args.spec_ab, args.trace_ab, args.kv_ab,
             args.lora_ab, args.tp_ab, args.slo_ab,
-            args.profile_ab]) > 1:
+            args.profile_ab, args.overload_ab]) > 1:
         print("--spec-ab/--trace-ab/--kv-ab/--lora-ab/--tp-ab/--slo-ab/"
-              "--profile-ab are separate A/Bs; run them one at a time",
-              file=sys.stderr)
+              "--profile-ab/--overload-ab are separate A/Bs; run them "
+              "one at a time", file=sys.stderr)
         return 2
     if (args.profile or args.profile_ab) and args.url is not None:
         print("--profile/--profile-ab need the in-process engine "
@@ -808,6 +848,21 @@ def main(argv=None) -> int:
         # the on arm needs thresholds to score against; generous
         # defaults keep the A/B about RECORDING cost, not miss churn
         args.slo_ttft, args.slo_tpot = 1.0, 0.25
+    if args.overload_ab:
+        if (args.url is not None or args.router or args.replicas > 1
+                or args.fleet):
+            print("--overload-ab needs the single in-process engine "
+                  "(no --url, no --router/--replicas/--fleet)",
+                  file=sys.stderr)
+            return 2
+        if args.overload_factor <= 1.0:
+            print("--overload-factor must be > 1", file=sys.stderr)
+            return 2
+        if args.slo_ttft is None and args.slo_tpot is None:
+            # goodput/burn need a policy; TTFT is the queue-sensitive
+            # dimension overload actually moves — TPOT stays off so a
+            # big-batch cap arm does not pollute the baseline
+            args.slo_ttft = 1.0
     if args.tp < 1:
         print("--tp must be >= 1", file=sys.stderr)
         return 2
@@ -837,7 +892,7 @@ def main(argv=None) -> int:
                 or args.profile
                 or sum([args.spec_ab, args.trace_ab, args.kv_ab,
                         args.lora_ab, args.tp_ab, args.slo_ab,
-                        args.profile_ab])):
+                        args.profile_ab, args.overload_ab])):
             print("--fleet is its own A/B over subprocess replicas; "
                   "it composes with the load/engine-size/SLO knobs "
                   "only (no --url/--router/--replicas/--fault-rate/"
@@ -907,6 +962,15 @@ def main(argv=None) -> int:
                              weights=wts, k=args.requests)
     else:
         assign = [None] * args.requests
+    # the per-request TENANT assignment for --overload-ab is drawn up
+    # front too: all three arms replay the identical 60%-hot mix (one
+    # hot tenant, four 10% cold ones), so the cold-goodput verdict
+    # compares the SAME cold requests across arms
+    tenants = [None] * args.requests
+    if args.overload_ab:
+        tenants = rng.choices(["hot", "c0", "c1", "c2", "c3"],
+                              weights=[0.6, 0.1, 0.1, 0.1, 0.1],
+                              k=args.requests)
 
     spec_def = args.speculative == "on"
     trace_def = args.trace_out is not None
@@ -931,6 +995,10 @@ def main(argv=None) -> int:
     elif args.profile_ab:
         arms = [("ledgeroff", spec_def, trace_def),
                 ("ledgeron", spec_def, trace_def)]
+    elif args.overload_ab:
+        arms = [("cap", spec_def, trace_def),
+                ("ctrloff", spec_def, trace_def),
+                ("ctrlon", spec_def, trace_def)]
     elif args.tp_ab:
         tp_n = args.tp if args.tp > 1 else 2
         arms = [("tp1", spec_def, trace_def),
@@ -990,8 +1058,20 @@ def main(argv=None) -> int:
             arm_args = argparse.Namespace(**vars(args))
             arm_args.slo_ttft = arm_args.slo_tpot = None
             mon_on = False
+        arm_arrivals = arrivals
+        if args.overload_ab:
+            # ctrloff/ctrlon replay the cap arm's schedule compressed
+            # by --overload-factor: the IDENTICAL requests arrive at
+            # 2x the at-capacity rate — the only knob that differs
+            # between the overload arms is the control plane itself
+            arm_args = argparse.Namespace(**vars(args))
+            arm_args.control_on = arm == "ctrlon"
+            if arm != "cap":
+                arm_arrivals = [t / args.overload_factor
+                                for t in arrivals]
         res[arm] = _run_arm(arm_args, arm, spec_on, trace_on, prompts,
-                            arrivals, assign, mon_on=mon_on)
+                            arm_arrivals, assign, mon_on=mon_on,
+                            tenants=tenants)
     if args.trace_ab:
         # the overhead verdict: decode cadence with the recorder on vs
         # off, on identical replayed load — the number that justifies
@@ -1039,6 +1119,36 @@ def main(argv=None) -> int:
                 {"metric": "serve_slo_throughput_ratio",
                  "value": round(b["throughput"] / a["throughput"], 3),
                  "unit": "x (on/off)"}))
+    if args.overload_ab:
+        # the overload verdict (ISSUE 19 acceptance): under identical
+        # 2x-capacity load, the control plane sheds the HOT tenant at
+        # the door and the COLD tenants keep (>= 90% of) the
+        # at-capacity goodput they had before the overload; without
+        # it, the queue backs up and goodput collapses for everyone.
+        # This prices the MECHANISM (admission-door discrimination),
+        # not a speedup — no arm decodes any faster than another.
+        cap, off, on = res["cap"], res["ctrloff"], res["ctrlon"]
+        for name, a in (("ctrloff", off), ("ctrlon", on)):
+            if cap.get("cold_goodput") and a.get("cold_goodput") \
+                    is not None:
+                print(json.dumps(
+                    {"metric": f"serve_overload_cold_retention_{name}",
+                     "value": round(a["cold_goodput"]
+                                    / cap["cold_goodput"], 4),
+                     "unit": f"x ({name}/cap cold goodput)"}))
+        print(json.dumps({"metric": "serve_overload_factor",
+                          "value": args.overload_factor,
+                          "unit": "x capacity"}))
+        if cap.get("cold_goodput") and on.get("cold_goodput") \
+                is not None and off.get("cold_goodput") is not None:
+            ret_on = on["cold_goodput"] / cap["cold_goodput"]
+            ret_off = off["cold_goodput"] / cap["cold_goodput"]
+            verdict = ("PASS" if ret_on >= 0.9 and on.get("sheds", 0)
+                       else "FAIL")
+            print(f"overload verdict: {verdict} — ctrlon cold-tenant "
+                  f"goodput retention {ret_on:.3f} (bar >= 0.9, "
+                  f"{on.get('sheds', 0)} hot sheds) vs ctrloff "
+                  f"{ret_off:.3f}")
     if args.spec_ab:
         # the A/B verdict: decode cadence and throughput, spec over
         # plain, on the identical replayed load
@@ -1271,17 +1381,22 @@ def _load_bench_adapters(server, args) -> None:
 
 
 def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
-             arrivals, assign=None, mon_on: bool = True) -> dict:
+             arrivals, assign=None, mon_on: bool = True,
+             tenants=None) -> dict:
     """Build one server (in-process mode), drive the pre-drawn load
     through it, print the table + BENCH records (metric names suffixed
     ``_<arm>`` in A/B mode), shut down. ``assign`` is the pre-drawn
     per-request adapter name list (ignored when --adapters is 0 for
-    this arm). ``mon_on=False`` (the --slo-ab OFF arm) runs with
+    this arm); ``tenants`` the pre-drawn per-request tenant list
+    (--overload-ab — the hot/cold mix every arm replays).
+    ``mon_on=False`` (the --slo-ab OFF arm) runs with
     FLAGS_enable_monitor disabled — the one-bool-branch path.
     Returns the numbers the A/B verdict needs."""
     sfx = f"_{arm}" if arm else ""
     if assign is None:
         assign = [None] * len(prompts)
+    if tenants is None:
+        tenants = [None] * len(prompts)
     server = None
     plan = None
     kill_fn = None
@@ -1370,7 +1485,8 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
                 adapter=(assign[i] if args.adapters else None))
             th = threading.Thread(
                 target=_drive_inproc,
-                args=(server, np.asarray(prompt, np.int32), cfg, stats))
+                args=(server, np.asarray(prompt, np.int32), cfg, stats,
+                      tenants[i]))
         else:
             th = threading.Thread(
                 target=_drive_http,
@@ -1670,6 +1786,53 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
             if agg and agg.get("p99") is not None:
                 print(json.dumps({"metric": f"{rec}{sfx}",
                                   "value": agg["p99"], "unit": "s"}))
+    extra = {}
+    if server is not None and getattr(args, "overload_ab", False):
+        # overload accounting (PERF.md overload methodology): the
+        # verdict needs goodput SPLIT by tenant class — the control
+        # plane's whole job is spending the hot tenant's availability
+        # (shedding it at the door) to keep the cold tenants inside
+        # SLO. Shed rejects + the control snapshot say what the plane
+        # actually did; the cap arm prints zeros for both.
+        st = server.stats()
+        tens = st.get("tenants") or {}
+        hm = hx = cm = cx = 0
+        for t, v in tens.items():
+            if t == "hot":
+                hm += v.get("met", 0)
+                hx += v.get("missed", 0)
+            else:
+                cm += v.get("met", 0)
+                cx += v.get("missed", 0)
+        cold_gp = cm / (cm + cx) if cm + cx else None
+        hot_gp = hm / (hm + hx) if hm + hx else None
+        ctrl = (server.load() or {}).get("control") or {}
+        shed_total = sum(sum(r.values()) for r in
+                         (ctrl.get("sheds") or {}).values())
+        def fmt(g):
+            return "-" if g is None else format(g, ".3f")
+
+        print(f"overload [{arm}]: cold goodput {fmt(cold_gp)} "
+              f"({cm}/{cm + cx}), hot goodput {fmt(hot_gp)} "
+              f"({hm}/{hm + hx}), {stats.shed} shed rejects, "
+              f"rung {ctrl.get('rung', 0)} "
+              f"({ctrl.get('rung_action', 'off')}) at drain")
+        if cold_gp is not None:
+            print(json.dumps({"metric": f"serve_goodput_cold{sfx}",
+                              "value": round(cold_gp, 4),
+                              "unit": "ratio"}))
+        if hot_gp is not None:
+            print(json.dumps({"metric": f"serve_goodput_hot{sfx}",
+                              "value": round(hot_gp, 4),
+                              "unit": "ratio"}))
+        print(json.dumps({"metric": f"serve_shed_rejects{sfx}",
+                          "value": stats.shed, "unit": "count"}))
+        met = sum(v.get("met", 0) for v in tens.values())
+        missed = sum(v.get("missed", 0) for v in tens.values())
+        extra = {"cold_goodput": cold_gp, "hot_goodput": hot_gp,
+                 "goodput": (met / (met + missed) if met + missed
+                             else None),
+                 "sheds": shed_total}
     if server is not None and trace_on:
         # trace-derived TTFT decomposition: WHICH phase ate the time.
         # queue = submit->dequeue, prefill = the admission span(s),
@@ -1745,6 +1908,7 @@ def _run_arm(args, arm: str, spec_on: bool, trace_on: bool, prompts,
         "throughput": (stats.tokens / wall if wall > 0 else None),
         "kv_page_cost": kv_page_cost,
         "model_bytes": model_bytes,
+        **extra,
     }
 
 
