@@ -271,6 +271,20 @@ STEP_TIMEOUT=3600 step serve_overload_ab python tools/serve_bench.py \
     --overload-ab --requests 240 --rate 4 --max-new 96 --max-batch 1 \
     --layers 6 --max-queue 16 --slo-ttft 1.5 --warmup
 
+# 6o. on-TPU WIRE-CHAOS A/B (NEW — PR 20): identical pre-drawn load
+#     over the real HTTP wire, clean vs injected delay/drop/half-
+#     close/corrupt at the generate + kv_import seams. The bar is
+#     exactly-once survival: serve_wire_survival_rate == 1.0 (every
+#     chaos-arm request's tokens bitwise-match the clean arm's) with
+#     nonzero resumes/retries and every corrupt KV ship rejected
+#     before install then re-shipped clean. Mechanism is chip-
+#     independent; what TPU adds is real page bytes in the shipped
+#     payloads (digests over device-exported pools, not toy arrays).
+STEP_TIMEOUT=3600 step serve_wire_chaos python tools/serve_bench.py \
+    --wire-chaos --layers 2 --prompt-len 4:16 --max-new 12 --rate 8 \
+    --requests 16 --num-pages 64 --max-pages 8 --page-size 8 \
+    --cache-prefixes on --warmup
+
 # ---------------------------------------------------------------------------
 # TRAINING-SIDE PARITY + PERF LEVERS (after the serving records)
 # ---------------------------------------------------------------------------

@@ -143,8 +143,8 @@ from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
                     RUNNING, DeadlineExpired, QueueFull,
                     RequestCancelled, RequestFailed, RequestHandle,
                     RequestQueue, RequestRejected)
-from .remote import (DisaggregatedFront, RemoteReplica,
-                     RemoteReplicaSpec)
+from .remote import (DisaggregatedFront, KVIntegrityError,
+                     RemoteReplica, RemoteReplicaSpec)
 from .router import (FailoverBudgetExceeded, FleetUnavailable,
                      ReplicaSpec, Router, RouterHandle)
 from .scheduler import PreemptionBudgetExceeded, Server
@@ -158,6 +158,7 @@ __all__ = [
     "PagePoolExhausted", "PreemptionBudgetExceeded",
     "Router", "ReplicaSpec", "RouterHandle",
     "RemoteReplica", "RemoteReplicaSpec", "DisaggregatedFront",
+    "KVIntegrityError",
     "FailoverBudgetExceeded", "FleetUnavailable", "SLOPolicy",
     "ControlPolicy", "ControlPlane", "ElasticController",
     "RUNG_ACTIONS",

@@ -119,6 +119,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Optional
 
 from .. import monitor
@@ -139,7 +140,8 @@ _CFG_FIELDS = ("max_new_tokens", "temperature", "top_k", "top_p",
 # failure multi-tenant serving cannot afford
 _KNOWN_FIELDS = frozenset(_CFG_FIELDS) | {"prompt", "priority",
                                           "timeout_s", "stream",
-                                          "tenant"}
+                                          "tenant", "idem_key",
+                                          "from_token"}
 
 # a /generate body is token ids + a dozen scalars; 8 MB is orders of
 # magnitude above any real request, and an unbounded Content-Length
@@ -197,7 +199,20 @@ def _parse_request(body: dict):
         # parse — name the type error instead of coercing
         raise ValueError(
             f"'stream' must be a boolean, got {stream!r}")
-    return (prompt, cfg, priority, timeout_s, stream, tenant)
+    idem_key = body.get("idem_key")
+    if idem_key is not None and (not isinstance(idem_key, str)
+                                 or not idem_key):
+        raise ValueError(
+            f"'idem_key' must be a non-empty string or null, got "
+            f"{idem_key!r}")
+    from_token = body.get("from_token", 0)
+    if (not isinstance(from_token, int) or isinstance(from_token, bool)
+            or from_token < 0):
+        raise ValueError(
+            f"'from_token' must be a non-negative int, got "
+            f"{from_token!r}")
+    return (prompt, cfg, priority, timeout_s, stream, tenant,
+            idem_key, from_token)
 
 
 def _adapter_weights(body: dict) -> dict:
@@ -251,13 +266,52 @@ def _adapter_weights(body: dict) -> dict:
     return out
 
 
-def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
+def serve_http(server, port: int = 0, addr: str = "127.0.0.1",
+               idem_ttl_s: float = 30.0, resume_grace_s: float = 2.0):
     """Serve ``server`` over HTTP on a daemon thread; returns the
     ``ThreadingHTTPServer`` (bound port: ``httpd.server_address[1]``;
-    ``port=0`` picks a free one). Stop with ``httpd.shutdown()``."""
+    ``port=0`` picks a free one). Stop with ``httpd.shutdown()``.
+
+    ``idem_ttl_s`` bounds the idempotency dedup window: a retried
+    ambiguous ``/generate`` POST carrying the same ``idem_key``
+    attaches to the live request (or its cached terminal result)
+    instead of admitting twice; terminal entries are pruned this many
+    seconds after finishing. ``resume_grace_s`` is how long a stream
+    whose client tore away keeps DECODING before the slot is
+    reclaimed — the window a mid-stream resume (same ``idem_key`` +
+    ``from_token``) must land in to keep warm KV and skip
+    re-prefill."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     import numpy as np
+
+    # the exactly-once window: idem_key -> {"handle", "orphaned_at"}.
+    # Closure-scoped (one window per front, like the Handler class
+    # itself); all access under idem_lock. ``orphaned_at`` non-None
+    # means the streaming client tore away and the request is decoding
+    # unattended — resumable until the grace expires, cancelled after.
+    idem_lock = threading.Lock()
+    idem_window = {}
+    wire_stats = {"idem_attaches": 0, "integrity_rejects": 0,
+                  "resume_misses": 0}
+
+    def _prune_idem(now: float) -> None:
+        expired = []
+        with idem_lock:
+            for key in list(idem_window):
+                ent = idem_window[key]
+                h = ent["handle"]
+                if h.done:
+                    fin = getattr(h, "finish_ts", None)
+                    if fin is None or now - fin > idem_ttl_s:
+                        del idem_window[key]
+                elif (ent["orphaned_at"] is not None
+                        and now - ent["orphaned_at"] > resume_grace_s):
+                    # no resume came: stop burning the slot
+                    del idem_window[key]
+                    expired.append(h)
+        for h in expired:                 # cancel outside the lock
+            h.cancel()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -295,7 +349,16 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                 body = server.load()
                 healthy = body.get(
                     "healthy", body.get("status") in ("ok", "draining"))
-                self._json(200 if healthy else 503, body)
+                body["wire"] = dict(wire_stats)
+                hdrs = None
+                if not healthy and body.get("status") == "warming":
+                    # Retry-After parity: warmup is bounded (segment
+                    # sweep), so tell the client when to come back
+                    # instead of letting it hammer the 503
+                    body["retry_after_s"] = 1.0
+                    hdrs = {"Retry-After": "1"}
+                self._json(200 if healthy else 503, body,
+                           headers=hdrs)
             elif self.path.startswith("/stats"):
                 # SLO/goodput rollup (paddle_tpu.monitor.slo): a
                 # Server serves its own tracker; a Router MERGES every
@@ -419,11 +482,47 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                 body = self._read_body()
                 if body is None:
                     return
-                prompt, cfg, priority, timeout_s, stream, tenant = \
-                    _parse_request(body)
+                (prompt, cfg, priority, timeout_s, stream, tenant,
+                 idem_key, from_token) = _parse_request(body)
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._json(400, {"error": str(e)})
                 return
+            _prune_idem(time.monotonic())
+            if idem_key is not None:
+                with idem_lock:
+                    ent = idem_window.get(idem_key)
+                    if ent is not None:
+                        ent["orphaned_at"] = None   # reattached
+                if ent is not None:
+                    # the exactly-once attach: this POST is a retry of
+                    # a request this server ALREADY holds (live or
+                    # terminal within the TTL) — no second admission,
+                    # no second slot/pages, no double SLO/quota count.
+                    # The response carries the SAME request_id, which
+                    # is how clients (and the dedup regression test)
+                    # prove single admission.
+                    wire_stats["idem_attaches"] += 1
+                    handle = ent["handle"]
+                    if trace.enabled():
+                        trace.event("idem.attach", rid=handle.id,
+                                    from_token=from_token,
+                                    live=not handle.done)
+                    if stream:
+                        self._stream_response(handle, skip=from_token,
+                                              idem=idem_key)
+                    else:
+                        self._block_response(handle)
+                    return
+                if from_token > 0:
+                    # a resume aimed at a request we no longer (or
+                    # never) held — refuse loudly so the client falls
+                    # back to the failover replay, never a silent
+                    # fresh decode that would double-emit tokens
+                    wire_stats["resume_misses"] += 1
+                    self._json(409, {"error": "unknown idem_key for "
+                                              "mid-stream resume",
+                                     "reason": "resume_miss"})
+                    return
             try:
                 handle = server.submit(
                     np.asarray(prompt, np.int32), cfg,
@@ -454,14 +553,27 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                                headers={"Retry-After":
                                         str(max(1, int(-(-ra // 1))))})
                 else:   # draining / degraded / shutdown (failed server)
-                    self._json(503, {"error": str(e),
-                                     "reason": e.reason})
+                    # Retry-After parity with the 429 paths: a DRAINING
+                    # server knows its drain ETA and says so — the same
+                    # honest hint, float body field + integer header
+                    out = {"error": str(e), "reason": e.reason}
+                    hdrs = None
+                    if e.retry_after_s is not None:
+                        ra = max(0.0, float(e.retry_after_s))
+                        out["retry_after_s"] = round(ra, 3)
+                        hdrs = {"Retry-After":
+                                str(max(1, int(-(-ra // 1))))}
+                    self._json(503, out, headers=hdrs)
                 return
             except ValueError as e:   # can never fit the engine
                 self._json(400, {"error": str(e)})
                 return
+            if idem_key is not None:
+                with idem_lock:
+                    idem_window[idem_key] = {"handle": handle,
+                                             "orphaned_at": None}
             if stream:
-                self._stream_response(handle)
+                self._stream_response(handle, idem=idem_key)
             else:
                 self._block_response(handle)
 
@@ -498,7 +610,8 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                                           "prefix_cache=True)"},
                            headers={"Connection": "close"})
                 return
-            from .remote import decode_kv_payload, encode_kv_payload
+            from .remote import (KVIntegrityError, decode_kv_payload,
+                                 encode_kv_payload)
             try:
                 if op == "export":
                     body = self._read_body()
@@ -551,6 +664,16 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                     return
                 out = server.import_kv(
                     decode_kv_payload(self.rfile.read(n)))
+            except KVIntegrityError as e:
+                # checksum mismatch: the decode raised BEFORE
+                # ``import_kv`` ran, so nothing installed — typed so
+                # the shipper can count it and re-ship (idempotent)
+                wire_stats["integrity_rejects"] += 1
+                if trace.enabled():
+                    trace.event("kv.integrity_reject", error=str(e))
+                self._json(400, {"error": str(e),
+                                 "reason": "integrity"})
+                return
             except (ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._json(400, {"error": str(e)})
@@ -650,7 +773,8 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
                              "tokens": [int(t) for t in toks],
                              "n_tokens": len(toks), "ttft_s": ttft})
 
-        def _stream_response(self, handle) -> None:
+        def _stream_response(self, handle, skip: int = 0,
+                             idem: Optional[str] = None) -> None:
             # the status line is deferred until the FIRST token (or a
             # terminal state) exists: a request that expires or fails
             # before emitting anything still gets its real 504/500,
@@ -658,6 +782,12 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
             it = handle.stream()
             first = None
             try:
+                # a mid-stream resume already delivered the first
+                # ``skip`` tokens on the torn connection: replay only
+                # the tail (the handle's stream is re-iterable from 0
+                # by design — each consumer keeps its own cursor)
+                for _ in range(skip):
+                    next(it)
                 first = next(it)
             except StopIteration:
                 pass              # zero-token terminal (e.g. cancelled)
@@ -696,7 +826,17 @@ def serve_http(server, port: int = 0, addr: str = "127.0.0.1"):
             except RequestFailed as e:
                 status = f"failed: {e}"
             except (BrokenPipeError, ConnectionResetError):
-                # client went away mid-stream: reclaim the slot
+                # client went away mid-stream. With an idem key the
+                # request keeps DECODING for the resume grace period —
+                # warm KV intact, so a reconnect replays only the tail;
+                # the pruner cancels it if no resume comes. Without a
+                # key: reclaim the slot immediately, as before.
+                if idem is not None:
+                    with idem_lock:
+                        ent = idem_window.get(idem)
+                        if ent is not None and not handle.done:
+                            ent["orphaned_at"] = time.monotonic()
+                            return
                 handle.cancel()
                 return
             try:

@@ -41,6 +41,7 @@ that stops answering must surface as a breaker/failover event, never
 as a hung router thread.
 """
 
+import hashlib
 import json
 import os
 import select
@@ -54,6 +55,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import tracing as trace
 from ..inference.generation import (GenerationConfig, PagePoolExhausted,
                                     _prompt_len)
 from .queue import (CANCELLED, EXPIRED, FAILED, FINISHED, RequestFailed,
@@ -62,7 +64,8 @@ from .router import ReplicaSpec
 from .scheduler import PreemptionBudgetExceeded
 
 __all__ = ["RemoteReplica", "RemoteReplicaSpec", "DisaggregatedFront",
-           "encode_kv_payload", "decode_kv_payload", "spawn_replica"]
+           "KVIntegrityError", "encode_kv_payload", "decode_kv_payload",
+           "spawn_replica"]
 
 
 # ---------------------------------------------------------------------------
@@ -82,6 +85,22 @@ __all__ = ["RemoteReplica", "RemoteReplicaSpec", "DisaggregatedFront",
 _KV_MAGIC_VERSION = 1
 _MAX_KV_HEADER_BYTES = 8 << 20
 _ARRAY_KEYS = ("k", "v", "k_scale", "v_scale")
+_KV_DIGEST_BYTES = 16
+
+
+class KVIntegrityError(ValueError):
+    """A KV payload arrived well-framed but WRONG: a checksum over the
+    page bytes disagrees with the header's digests. Distinct from the
+    plain framing ``ValueError`` so the import path can count it and
+    the shipper can re-ship (chain-hash dedup makes the retry
+    idempotent) instead of treating it as a validation bug."""
+
+
+def _kv_digest(*parts: bytes) -> str:
+    h = hashlib.blake2b(digest_size=_KV_DIGEST_BYTES)
+    for p in parts:
+        h.update(p)
+    return h.hexdigest()
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -93,12 +112,33 @@ def _np_dtype(name: str) -> np.dtype:
     return np.dtype(name)
 
 
+def _block_hash_bytes(blocks) -> List[bytes]:
+    """The chain hashes as bytes, defensively (a digestless manual
+    payload may carry anything here — encode and decode must agree on
+    the fallback so round-trips stay verifiable)."""
+    out = []
+    for b in (blocks if isinstance(blocks, list) else []):
+        try:
+            out.append(bytes.fromhex(b.get("hash", "")))
+        except (AttributeError, TypeError, ValueError):
+            out.append(b"")
+    return out
+
+
 def encode_kv_payload(payload: dict) -> bytes:
-    """Frame one ``engine.export_kv_pages()`` payload for the wire."""
+    """Frame one ``engine.export_kv_pages()`` payload for the wire.
+
+    The header carries integrity digests (``blake2b`` over the chain
+    hashes + raw pool bytes): one whole-payload checksum plus — when
+    every array's leading dim is the block count, which is how the
+    engine exports — a per-block checksum that lets the importer NAME
+    the corrupted block. ``decode_kv_payload`` verifies them before a
+    single page can install; payloads without digests (older writers,
+    hand-built tests) still decode."""
     header = {k: payload[k] for k in ("version", "kv_dtype",
                                       "page_size", "salt", "coverage",
                                       "blocks")}
-    metas, chunks = [], []
+    metas, chunks, arrays = [], [], []
     for lay in payload["layers"]:
         meta = {}
         for key in _ARRAY_KEYS:
@@ -108,8 +148,21 @@ def encode_kv_payload(payload: dict) -> bytes:
             meta[key] = {"dtype": arr.dtype.name,
                          "shape": list(arr.shape)}
             chunks.append(arr.tobytes())
+            arrays.append(arr)
         metas.append(meta)
     header["layers"] = metas
+    hashes = _block_hash_bytes(payload["blocks"])
+    digests = {"algo": f"blake2b-{_KV_DIGEST_BYTES}",
+               "payload": _kv_digest(*hashes, *chunks)}
+    nblocks = len(hashes)
+    if nblocks and all(a.ndim >= 1 and a.shape[0] == nblocks
+                       for a in arrays):
+        digests["blocks"] = [
+            _kv_digest(hashes[b],
+                       *(np.ascontiguousarray(a[b]).tobytes()
+                         for a in arrays))
+            for b in range(nblocks)]
+    header["digests"] = digests
     hdr = json.dumps(header).encode()
     return b"".join([len(hdr).to_bytes(4, "big"), hdr] + chunks)
 
@@ -143,6 +196,14 @@ def decode_kv_payload(raw: bytes) -> dict:
                                   "salt", "coverage", "blocks")}
     if not isinstance(header["layers"], list):
         raise ValueError("KV payload 'layers' must be a list")
+    # an integrity-protected payload (digests in the header) that
+    # arrives SHORT is wire damage, not a malformed request: type it
+    # so the shipper re-ships instead of treating the replica as
+    # broken (KVIntegrityError subclasses ValueError — callers that
+    # only know 400 semantics keep working)
+    torn_exc = (KVIntegrityError
+                if isinstance(header.get("digests"), dict)
+                else ValueError)
     layers, off = [], 4 + n
     for li, meta in enumerate(header["layers"]):
         if not isinstance(meta, dict) or "k" not in meta \
@@ -168,7 +229,7 @@ def decode_kv_payload(raw: bytes) -> dict:
                     "dim")
             nbytes = dt.itemsize * int(np.prod(shape, dtype=np.int64))
             if off + nbytes > len(raw):
-                raise ValueError(
+                raise torn_exc(
                     f"KV payload truncated at layer {li} {key!r}")
             lay[key] = np.frombuffer(
                 raw, dtype=dt, count=int(np.prod(shape,
@@ -177,8 +238,37 @@ def decode_kv_payload(raw: bytes) -> dict:
             off += nbytes
         layers.append(lay)
     if off != len(raw):
-        raise ValueError(
+        raise torn_exc(
             f"KV payload carries {len(raw) - off} trailing bytes")
+    dig = header.get("digests")
+    if isinstance(dig, dict) and dig.get("payload"):
+        # verify BEFORE anything can install: framing above proved the
+        # geometry; this proves the bytes. The whole-payload digest is
+        # one pass over the array region; per-block digests only
+        # recompute on mismatch, to name the culprit.
+        hashes = _block_hash_bytes(header["blocks"])
+        if _kv_digest(*hashes, raw[4 + n:]) != dig["payload"]:
+            bad = None
+            blk_digs = dig.get("blocks")
+            if isinstance(blk_digs, list) \
+                    and len(blk_digs) == len(hashes):
+                for b in range(len(hashes)):
+                    parts = [hashes[b]]
+                    for lay in layers:
+                        for key in _ARRAY_KEYS:
+                            if key in lay and lay[key].shape \
+                                    and lay[key].shape[0] == len(hashes):
+                                parts.append(np.ascontiguousarray(
+                                    lay[key][b]).tobytes())
+                    if _kv_digest(*parts) != blk_digs[b]:
+                        bad = b
+                        break
+            raise KVIntegrityError(
+                "KV payload integrity check failed"
+                + (f" at block {bad}" if bad is not None else "")
+                + ": checksum mismatch (bit-rot on the wire); "
+                "nothing was installed — re-ship (chain-hash dedup "
+                "makes the retry idempotent)")
     out["layers"] = layers
     return out
 
@@ -368,7 +458,11 @@ class RemoteReplica:
                  poll_interval_s: float = 0.2,
                  io_timeout_s: float = 5.0,
                  stream_timeout_s: float = 600.0,
-                 admission_probe_s: float = 0.25):
+                 admission_probe_s: float = 0.25,
+                 wire_retries: int = 2,
+                 retry_backoff_s: float = 0.05,
+                 retry_backoff_max_s: float = 1.0,
+                 max_resumes: int = 2):
         self.base_url = base_url.rstrip("/")
         self.proc = proc                  # owned subprocess (or None:
         #                                   attached — never killed)
@@ -376,6 +470,19 @@ class RemoteReplica:
         self.stream_timeout_s = stream_timeout_s
         self.admission_probe_s = admission_probe_s
         self.poll_interval_s = poll_interval_s
+        # exactly-once wire knobs: submit retries are safe because
+        # every attempt carries the SAME idempotency key (a retried
+        # ambiguous POST attaches to the live request server-side
+        # instead of double-executing); a torn stream resumes on the
+        # SAME replica from the last received token (warm KV, no
+        # re-prefill) before failover replay is ever considered
+        self.wire_retries = wire_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_max_s = retry_backoff_max_s
+        self.max_resumes = max_resumes
+        self.resumes = 0                  # mid-stream resumes served
+        self.submit_retries = 0           # wire-level resubmissions
+        self.integrity_rejects = 0        # KV ships the peer refused
         # testing seam: a paddle_tpu.testing.faults.NetworkFaultPlan
         # fired at the wire sites ("generate", "kv_import") — bounded
         # delay / connection drop / mid-stream half-close, so the chaos
@@ -516,14 +623,18 @@ class RemoteReplica:
         with self._lock:
             rid = self._next_id
             self._next_id += 1
+        # the idempotency key every wire attempt of THIS submission
+        # carries: the router's stable rid keeps it identical across
+        # retries (so a retried ambiguous POST attaches to the live
+        # request server-side instead of double-executing), and the
+        # per-submit salt keeps a failover REPLAY — a new submission
+        # with the same trace rid — from attaching to the zombie it
+        # replaces
+        idem = (f"{trace_rid if trace_rid is not None else self.base_url}"
+                f"#{rid}")
+        body["idem_key"] = idem
 
-        import http.client
-        from urllib.parse import urlsplit
-
-        u = urlsplit(self.base_url)
-        conn = http.client.HTTPConnection(u.hostname, u.port,
-                                          timeout=self.io_timeout_s)
-        state = {"conn": conn, "closed": False}
+        state = {"conn": None, "closed": False}
         handle = RequestHandle(
             rid, prompt, plen, cfg, priority, deadline,
             on_cancel=lambda h: self._abort(state),
@@ -532,48 +643,81 @@ class RemoteReplica:
         handle._trace_rid = (trace_rid if trace_rid is not None
                              else f"{self.base_url}:{rid}")
         handle._trace_ttft = trace_rid is None
-        try:
-            if self.fault_plan is not None:
-                # network seam: a delay sleeps right here, a drop
-                # raises ConnectionResetError into the unreachable
-                # path below (exactly a refused/reset socket), and a
-                # half-close spec rides in ``state`` for the reader
-                # thread to consume mid-stream
-                state["half_close"] = self.fault_plan.fire("generate")
-            payload = json.dumps(body).encode()
-            conn.request("POST", "/generate", body=payload,
-                         headers={"Content-Type": "application/json"})
-        except OSError as e:
-            self._close_conn(state)
-            raise RuntimeError(
-                f"replica {self.base_url} unreachable: {e}") from e
-        # the admission probe: readable within the window means the
-        # server already answered — only rejections and instant
-        # terminals do (the 200 status line waits for the first token)
-        early = None
-        try:
-            r, _, _ = select.select([conn.sock], [], [],
-                                    self.admission_probe_s)
-            if r:
-                early = conn.getresponse()
-                if early.status == 200:
-                    pass                  # first token already here —
-                    #                       fall through to the reader
-                else:
-                    raw = early.read()
-                    self._close_conn(state)
-                    self._raise_rejection(early.status, raw, handle)
-                    return handle         # 504/500 finished the handle
-        except RequestRejected:
-            raise
-        except ValueError:
-            raise
-        except OSError as e:
-            self._close_conn(state)
-            raise RuntimeError(
-                f"replica {self.base_url} died mid-submit: {e}") from e
+
+        import http.client
+        from urllib.parse import urlsplit
+
+        u = urlsplit(self.base_url)
+        attempt = 0
+        while True:
+            conn = http.client.HTTPConnection(u.hostname, u.port,
+                                              timeout=self.io_timeout_s)
+            state["conn"] = conn
+            state["closed"] = False
+            early = None
+            try:
+                if self.fault_plan is not None:
+                    # network seam: a delay sleeps right here, a drop
+                    # raises ConnectionResetError into the retry path
+                    # below (exactly a refused/reset socket), and a
+                    # half-close/corrupt spec rides in ``state`` for
+                    # the reader thread to consume mid-stream
+                    state["cut"] = self.fault_plan.fire("generate")
+                payload = json.dumps(body).encode()
+                conn.request("POST", "/generate", body=payload,
+                             headers={"Content-Type":
+                                      "application/json"})
+                # the admission probe: readable within the window
+                # means the server already answered — only rejections
+                # and instant terminals do (the 200 status line waits
+                # for the first token), so its absence means "admitted
+                # or queued" and the reader thread takes over
+                r, _, _ = select.select([conn.sock], [], [],
+                                        self.admission_probe_s)
+                if r:
+                    early = conn.getresponse()
+                    if early.status != 200:
+                        raw = early.read()
+                        self._close_conn(state)
+                        self._raise_rejection(early.status, raw,
+                                              handle)
+                        return handle     # 504/500 finished the handle
+            except RequestRejected:
+                raise
+            except ValueError:
+                raise
+            except OSError as e:
+                # the AMBIGUOUS wire failure (the server may or may
+                # not have admitted): safe to retry because the idem
+                # key dedups server-side. Bounded exponential backoff,
+                # and never a retry that cannot land before the
+                # request's own deadline — shed those instead.
+                self._close_conn(state)
+                wait = min(self.retry_backoff_s * (2.0 ** attempt),
+                           self.retry_backoff_max_s)
+                attempt += 1
+                if attempt > self.wire_retries:
+                    raise RuntimeError(
+                        f"replica {self.base_url} unreachable after "
+                        f"{attempt} attempt(s): {e}") from e
+                if (deadline is not None
+                        and time.monotonic() + wait >= deadline):
+                    raise RequestRejected(
+                        "deadline_doomed",
+                        f"replica {self.base_url}: wire retry would "
+                        f"outlive the request deadline ({e})",
+                        retry_after_s=None) from e
+                self.submit_retries += 1
+                if trace.enabled():
+                    trace.event("wire.retry", rid=handle._trace_rid,
+                                attempt=attempt, wait_s=wait,
+                                cause=repr(e))
+                time.sleep(wait)
+                continue
+            break
         reader = threading.Thread(
-            target=self._read_stream, args=(state, handle, early),
+            target=self._read_stream,
+            args=(state, handle, early, body, idem),
             daemon=True,
             name=f"paddle_tpu-remote-stream-{self.base_url}-{rid}")
         reader.start()
@@ -598,7 +742,11 @@ class RemoteReplica:
                 body.get("reason", "queue_full"), msg,
                 retry_after_s=body.get("retry_after_s"))
         if status == 503:
-            raise RequestRejected(body.get("reason", "degraded"), msg)
+            # draining/warming replicas now publish a drain-ETA /
+            # warmup-estimate Retry-After too — same passthrough
+            raise RequestRejected(
+                body.get("reason", "degraded"), msg,
+                retry_after_s=body.get("retry_after_s"))
         if status == 400:
             raise ValueError(msg)
         if status == 504:
@@ -644,81 +792,149 @@ class RemoteReplica:
         return RequestFailed(msg)
 
     def _read_stream(self, state: dict, handle: RequestHandle,
-                     early) -> None:
+                     early, body: Optional[dict] = None,
+                     idem: Optional[str] = None) -> None:
         """Reader thread: relay one /generate ndjson stream into the
         local handle. Terminal mapping mirrors ``_stream_response``'s
         writer side; a torn stream (socket error, EOF without a done
-        line) is a replica-attributed failure — unless the tear was
-        OUR cancel, which must read CANCELLED, not failover."""
-        conn = state["conn"]
+        line) first tries a MID-STREAM RESUME — reconnect to the SAME
+        replica with the idempotency key + ``from_token`` so the server
+        reattaches the live handle and replays only the tokens we
+        missed (warm KV intact, no re-prefill). Only when resumes are
+        exhausted or the replica looks genuinely unhealthy does the
+        tear surface as a replica-attributed failure for the router's
+        failover replay — unless the tear was OUR cancel, which must
+        read CANCELLED, not failover."""
+        import http.client
+        from urllib.parse import urlsplit
+
         err: Optional[BaseException] = None
         done_line = None
-        try:
-            if early is not None:
-                resp = early
-            else:
-                resp = conn.getresponse()
-            if resp.status != 200:
-                raw = resp.read()
-                try:
-                    self._raise_rejection(resp.status, raw, handle)
-                except (RequestRejected, ValueError) as e:
-                    # after the probe window these cannot raise into
-                    # the caller anymore — carry them on the handle
-                    # (the router relays RequestRejected -> failover,
-                    # ValueError -> request-scoped terminal)
-                    handle._finish(FAILED, e)
-                return
-            # streaming begins: per-token gaps may be long (a cold
-            # compile, a busy batch) — widen the per-recv timeout from
-            # the connect/admission one to the stream one
-            if conn.sock is not None:
-                conn.sock.settimeout(self.stream_timeout_s)
-            first = True
-            cut = state.get("half_close")  # injected mid-stream tear
-            relayed = 0
-            while True:
-                line = resp.readline()
-                if not line:
-                    break                 # EOF without a done line
-                line = line.strip()
-                if not line:
-                    continue
-                rec = json.loads(line)
-                if "token" in rec:
-                    if first:
-                        first = False
-                        # admission is invisible over the wire until
-                        # the first token: mark RUNNING here (engine
-                        # rid is remote-private; -1 = "remote")
-                        handle._mark_running(-1)
-                    handle._push([int(rec["token"])])
-                    relayed += 1
-                    if cut is not None and relayed >= cut["after"]:
-                        # injected half-close: walk away with the
-                        # server mid-stream (the finally shears the
-                        # socket) — no done line, so the tear reads as
-                        # a replica failure and the router's failover
-                        # replay must absorb it; server-side the
-                        # broken-pipe guard reclaims the slot
+        resumed = 0
+        while True:
+            conn = state["conn"]
+            err = None
+            done_line = None
+            try:
+                if early is not None:
+                    resp = early
+                    early = None
+                else:
+                    resp = conn.getresponse()
+                if resp.status != 200:
+                    raw = resp.read()
+                    try:
+                        self._raise_rejection(resp.status, raw, handle)
+                    except (RequestRejected, ValueError) as e:
+                        # after the probe window these cannot raise
+                        # into the caller anymore — carry them on the
+                        # handle (the router relays RequestRejected ->
+                        # failover, ValueError -> request-scoped
+                        # terminal)
+                        handle._finish(FAILED, e)
+                    return
+                # streaming begins: per-token gaps may be long (a cold
+                # compile, a busy batch) — widen the per-recv timeout
+                # from the connect/admission one to the stream one
+                if conn.sock is not None:
+                    conn.sock.settimeout(self.stream_timeout_s)
+                first = len(handle.tokens_so_far()) == 0
+                cut = state.get("cut")    # injected mid-stream tear
+                relayed = 0
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break             # EOF without a done line
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if (cut is not None
+                            and cut.get("action") == "corrupt"
+                            and cut.get("mode") == "flip"
+                            and relayed >= cut["after"]):
+                        # injected corruption: garble this line in
+                        # flight — json.loads below tears exactly like
+                        # real bit-rot would
+                        line = bytes(b ^ 0xFF for b in line)
+                    rec = json.loads(line)
+                    if "token" in rec:
+                        if first:
+                            first = False
+                            # admission is invisible over the wire
+                            # until the first token: mark RUNNING here
+                            # (engine rid is remote-private;
+                            # -1 = "remote")
+                            handle._mark_running(-1)
+                        handle._push([int(rec["token"])])
+                        relayed += 1
+                        if (cut is not None
+                                and cut.get("mode") != "flip"
+                                and relayed >= cut["after"]):
+                            # injected half-close (or truncation):
+                            # walk away with the server mid-stream —
+                            # no done line, so the tear enters the
+                            # resume path below; server-side the
+                            # broken-pipe guard parks the handle in
+                            # the dedup window for the grace period
+                            break
+                    elif rec.get("done"):
+                        done_line = rec
                         break
-                elif rec.get("done"):
-                    done_line = rec
-                    break
-        except Exception as e:  # noqa: BLE001 - any tear (socket
-            #   error, torn chunk framing, http.client's own internal
-            #   races when the cancel path shears the socket under a
-            #   blocked read) must RESOLVE the handle — an unresolved
-            #   handle strands the router's relay forever
-            err = e
-        finally:
-            self._close_conn(state)
-        if handle.done:
-            return
-        if handle._cancel_requested:
-            handle._finish(CANCELLED)
-            return
-        if done_line is None:
+            except Exception as e:  # noqa: BLE001 - any tear (socket
+                #   error, torn chunk framing, http.client's own
+                #   internal races when the cancel path shears the
+                #   socket under a blocked read) must RESOLVE the
+                #   handle — an unresolved handle strands the router's
+                #   relay forever
+                err = e
+            finally:
+                self._close_conn(state)
+            if handle.done:
+                return
+            if handle._cancel_requested:
+                handle._finish(CANCELLED)
+                return
+            if done_line is not None:
+                break
+            # torn stream. Resume against the SAME replica first: the
+            # server-side dedup window still holds the live handle (a
+            # broken pipe with an idem key orphans, not cancels), so a
+            # reconnect keyed on idem + from_token replays only the
+            # missing tail against warm KV. Failover (full re-prefill
+            # elsewhere) is the fallback, not the first move.
+            if (idem is not None and body is not None
+                    and resumed < self.max_resumes
+                    and self.status in ("ok", "draining")):
+                resumed += 1
+                self.resumes += 1
+                from_token = len(handle.tokens_so_far())
+                if trace.enabled():
+                    trace.event("wire.resume", rid=handle._trace_rid,
+                                attempt=resumed,
+                                from_token=from_token,
+                                cause=repr(err) if err else "eof")
+                try:
+                    u = urlsplit(self.base_url)
+                    conn = http.client.HTTPConnection(
+                        u.hostname, u.port,
+                        timeout=self.io_timeout_s)
+                    state["conn"] = conn
+                    state["closed"] = False
+                    if self.fault_plan is not None:
+                        state["cut"] = self.fault_plan.fire("generate")
+                    else:
+                        state["cut"] = None
+                    rbody = dict(body)
+                    rbody["from_token"] = from_token
+                    conn.request(
+                        "POST", "/generate",
+                        body=json.dumps(rbody).encode(),
+                        headers={"Content-Type": "application/json"})
+                    continue              # next loop getresponse()s
+                except OSError as e:
+                    err = e
+                    self._close_conn(state)
+                    # fall through to the failover terminal
             handle._finish(FAILED, RequestFailed(
                 f"replica {self.base_url} stream broke: "
                 f"{err!r}" if err is not None else
@@ -772,6 +988,17 @@ class RemoteReplica:
             spec = self.fault_plan.fire("kv_import")
             if spec is not None and spec.get("action") == "half_close":
                 raw = raw[:max(1, len(raw) // 2)]
+            elif spec is not None and spec.get("action") == "corrupt":
+                if spec.get("mode") == "truncate":
+                    # torn mid-transfer but past the header: framing
+                    # length no longer matches — the integrity layer
+                    # must reject BEFORE any page installs
+                    raw = raw[:max(5, (len(raw) * 3) // 4)]
+                else:                     # "flip"
+                    # single byte-flip in the array tail: framing
+                    # survives, the payload digest does not — exactly
+                    # the silent bit-rot the checksums exist for
+                    raw = raw[:-1] + bytes([raw[-1] ^ 0xFF])
         status, out = _http_raw("POST", self.base_url, "/kv/import",
                                 raw, "application/octet-stream",
                                 timeout=self.stream_timeout_s)
@@ -780,6 +1007,18 @@ class RemoteReplica:
         except json.JSONDecodeError:
             body = {"error": out.decode("utf-8", "replace")}
         if status != 200:
+            if body.get("reason") == "integrity":
+                # typed-and-counted: the shipper distinguishes "the
+                # bytes rotted (re-ship, dedup makes it idempotent)"
+                # from "the replica is broken (failover)"
+                self.integrity_rejects += 1
+                if trace.enabled():
+                    trace.event("kv.integrity_reject",
+                                url=self.base_url,
+                                error=str(body.get("error")))
+                raise KVIntegrityError(
+                    f"replica {self.base_url} /kv/import rejected: "
+                    f"{body.get('error')}")
             raise RuntimeError(
                 f"replica {self.base_url} /kv/import -> {status}: "
                 f"{body.get('error')}")
@@ -918,24 +1157,44 @@ class DisaggregatedFront:
     the in-process router."""
 
     def __init__(self, prefill: RemoteReplica, decode: RemoteReplica,
-                 *, max_failovers: int = 1):
+                 *, max_failovers: int = 1,
+                 max_integrity_failures: int = 3):
         self.prefill = prefill
         self.decode = decode
         self.max_failovers = max_failovers
+        # after this many integrity rejects the front stops trusting
+        # the wire and decodes on the prefill replica (local prefill —
+        # pages never travel), rather than serving off a suspect pool
+        self.max_integrity_failures = max_integrity_failures
         self.handoffs = 0                 # pages shipped (blocks)
         self.dedups = 0                   # blocks dedup'd on import
         self.failovers = 0
+        self.reships = 0                  # integrity-triggered retries
+        self.integrity_rejects = 0        # corrupt payloads refused
 
     def ship(self, prompt, salt: bytes = b"") -> dict:
         """Ship the prefill replica's pages covering ``prompt`` to the
         decode replica. Returns the import verdict
-        ``{"imported", "deduped", "coverage"}``."""
-        raw = self.prefill.export_kv_raw(
-            [int(t) for t in prompt], salt=salt)
-        out = self.decode.import_kv_raw(raw)
-        self.handoffs += int(out.get("imported", 0))
-        self.dedups += int(out.get("deduped", 0))
-        return out
+        ``{"imported", "deduped", "coverage"}``. A corrupt arrival is
+        rejected whole by the decode side (nothing installed), so one
+        re-ship of freshly exported bytes is safe — the chain-hash
+        dedup makes a retry after any partial progress idempotent."""
+        attempts = 0
+        while True:
+            raw = self.prefill.export_kv_raw(
+                [int(t) for t in prompt], salt=salt)
+            try:
+                out = self.decode.import_kv_raw(raw)
+            except KVIntegrityError:
+                self.integrity_rejects += 1
+                attempts += 1
+                if attempts > 1:
+                    raise
+                self.reships += 1
+                continue
+            self.handoffs += int(out.get("imported", 0))
+            self.dedups += int(out.get("deduped", 0))
+            return out
 
     def generate(self, prompt, cfg: Optional[GenerationConfig] = None,
                  timeout_s: Optional[float] = None) -> RequestHandle:
@@ -973,12 +1232,24 @@ class DisaggregatedFront:
                 handle._finish(FINISHED)
                 return
             # phase 2: ship the prompt's finished pages, decode the
-            # remaining budget against the warm prefix
+            # remaining budget against the warm prefix. Past the
+            # integrity-failure budget the wire is suspect: skip the
+            # ship and decode on the prefill replica itself (its pages
+            # never travelled, so correctness is untouched — only the
+            # disaggregation win is given up)
             salt = (str(cfg.adapter).encode()
                     if getattr(cfg, "adapter", None) else b"")
-            self.ship(ids, salt=salt)
             emitted = [t0]
             target = self.decode
+            if self.integrity_rejects >= self.max_integrity_failures:
+                target = self.prefill
+            else:
+                try:
+                    self.ship(ids, salt=salt)
+                except KVIntegrityError:
+                    # both the ship and its one re-ship arrived
+                    # corrupt — decode locally, nothing installed
+                    target = self.prefill
             failovers = 0
             while True:
                 kw = dict(vars(cfg))

@@ -339,6 +339,7 @@ class Router:
                  monitor_interval_s: float = 0.05,
                  degraded_poll_s: float = 0.25,
                  retry_wait_s: float = 0.02,
+                 tight_headroom_s: float = 0.25,
                  skew_factor: float = 2.0,
                  skew_min_requests: int = 5,
                  skew_interval_s: float = 1.0,
@@ -415,6 +416,16 @@ class Router:
         self.monitor_interval_s = monitor_interval_s
         self.degraded_poll_s = degraded_poll_s
         self.retry_wait_s = retry_wait_s
+        # SLO-headroom tiebreak (ROADMAP 2c): below this remaining
+        # deadline, failover/route scoring drops the adapter-affinity
+        # term — a warm LoRA bank row saves milliseconds, and a
+        # request this close to its deadline needs the least-loaded
+        # replica, not the warmest one
+        self.tight_headroom_s = tight_headroom_s
+        # Retry-After honor windows: replica index -> monotonic time
+        # before which _acquire deprioritizes it (it told us when to
+        # come back — believe it, unless nobody else is routable)
+        self._reject_until = {}
         self.skew_factor = skew_factor
         self.skew_min_requests = skew_min_requests
         self.skew_interval_s = skew_interval_s
@@ -1089,7 +1100,8 @@ class Router:
                             router=self.monitor_router)
 
     # -- routing -------------------------------------------------------------
-    def _acquire(self, exclude, hard=frozenset(), adapter=None):
+    def _acquire(self, exclude, hard=frozenset(), adapter=None,
+                 headroom_s=None):
         """Pick the least-loaded routable replica: status ``ok``
         (warming/degraded/failed/draining/restarting/dead excluded),
         breaker not OPEN (an elapsed OPEN transitions to HALF-OPEN
@@ -1103,8 +1115,14 @@ class Router:
         device sync), falling back to plain least-loaded when nobody
         has it; the load tie-break still applies within each class,
         so affinity never pins a tenant to one overloaded replica
-        while an idle adapter-resident peer exists. Returns
-        ``(rep, server, probe)`` or ``(None, None, False)``."""
+        while an idle adapter-resident peer exists. ``headroom_s``
+        (remaining SLO deadline) below ``tight_headroom_s`` drops the
+        affinity term entirely — the deadline-tight pick is purely
+        least-loaded (ROADMAP 2c: deadline headroom outranks warmth).
+        Returns ``(rep, server, probe)`` or ``(None, None, False)``."""
+        if (headroom_s is not None
+                and headroom_s < self.tight_headroom_s):
+            adapter = None
         now = time.monotonic()
         flipped = []
         with self._lock:
@@ -1127,6 +1145,12 @@ class Router:
                 cands.append((rep, half))
             picks = [(r, hf) for r, hf in cands
                      if r.index not in exclude] or cands
+            # replicas inside a Retry-After honor window lose to any
+            # sibling outside one — same only-candidate fallback as
+            # ``exclude`` so the hint never starves a request
+            picks = [(r, hf) for r, hf in picks
+                     if self._reject_until.get(r.index, 0.0) <= now
+                     ] or picks
             best = None
             best_score = None
             best_half = False
@@ -1240,7 +1264,9 @@ class Router:
                 return
             rep, srv, probe = self._acquire(
                 exclude, hard=frozenset(nofit),
-                adapter=getattr(h.cfg, "adapter", None))
+                adapter=getattr(h.cfg, "adapter", None),
+                headroom_s=(None if h.deadline is None
+                            else h.deadline - time.monotonic()))
             if rep is None:
                 if self._all_dead():
                     h._finish(FAILED, FleetUnavailable(
@@ -1293,6 +1319,17 @@ class Router:
                 # waiting pump retries ~50x/s — the replica's own
                 # serving_requests_total{event=rejected_*} already
                 # counts backpressure per attempt
+                # honor the replica's Retry-After before re-routing to
+                # IT: the reject window keeps _acquire off this
+                # replica until the hint elapses (bounded), while the
+                # pump itself stays on its fast tick so a healthy
+                # sibling picks the request up immediately
+                if getattr(e, "retry_after_s", None) is not None:
+                    with self._lock:
+                        self._reject_until[rep.index] = (
+                            time.monotonic()
+                            + min(max(float(e.retry_after_s), 0.0),
+                                  2.0))
                 # a rejection (queue_full on every replica, say) must
                 # not busy-spin the pump: one retry tick of backoff
                 time.sleep(self.retry_wait_s)

@@ -602,9 +602,17 @@ class Server:
                        if self._fatal is not None else ""))
             if self._draining:
                 self._count("rejected_draining")
+                # drain ETA: queued + active work at a rough
+                # quarter-second-per-request decode pace — the same
+                # honest-hint contract as the 429 Retry-After paths,
+                # so a client (or the router) waits out the drain
+                # instead of hammering a server that told it when
+                eta = 0.5 + 0.25 * (self.queue.depth
+                                    + len(self._active))
                 raise RequestRejected(
                     "draining",
-                    "server is draining; not accepting new requests")
+                    "server is draining; not accepting new requests",
+                    retry_after_s=eta)
             if self._degraded_reason is not None:
                 self._count("rejected_degraded")
                 raise RequestRejected(

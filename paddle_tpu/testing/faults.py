@@ -79,12 +79,13 @@ class InjectedFault(RuntimeError):
 
 class _Rule:
     __slots__ = ("site", "first", "times", "action", "exc", "seconds",
-                 "rate", "rng", "fired", "after")
+                 "rate", "rng", "fired", "after", "mode")
 
     def __init__(self, site: str, first: int, times: int, action: str,
                  exc=None, seconds: float = 0.0,
                  rate: Optional[float] = None, seed: int = 0,
-                 after: int = 0, valid_sites: Sequence[str] = SITES):
+                 after: int = 0, mode: Optional[str] = None,
+                 valid_sites: Sequence[str] = SITES):
         if site not in valid_sites:
             raise ValueError(
                 f"unknown site {site!r}; one of {tuple(valid_sites)}")
@@ -99,7 +100,8 @@ class _Rule:
         self.rate = rate          # probabilistic (chaos-soak) rule
         self.rng = random.Random(seed) if rate is not None else None
         self.fired = 0
-        self.after = after        # half_close: stream lines to relay
+        self.after = after        # half_close/corrupt: lines to relay
+        self.mode = mode          # corrupt: "flip" | "truncate"
 
 
 class FaultPlan:
@@ -208,7 +210,8 @@ class FaultPlan:
     def _consume(self, site: str):
         """Count a call to ``site`` and consume the first matching
         un-retired rule: bump ``calls``, log to ``injected``, trace.
-        Returns ``(action, exc, seconds, after, n)`` or ``None``."""
+        Returns ``(action, exc, seconds, after, mode, n)`` or
+        ``None``."""
         with self._lock:
             self.calls[site] = self.calls.get(site, 0) + 1
             n = self.calls[site]
@@ -227,7 +230,8 @@ class FaultPlan:
                 return None
             rule.fired += 1
             self.injected.append((site, n, rule.action))
-            hit = (rule.action, rule.exc, rule.seconds, rule.after, n)
+            hit = (rule.action, rule.exc, rule.seconds, rule.after,
+                   rule.mode, n)
         if trace.enabled():
             # injections are part of the story a flight dump tells: a
             # chaos postmortem must distinguish injected faults from
@@ -243,7 +247,7 @@ class FaultPlan:
         hit = self._consume(site)
         if hit is None:
             return
-        action, exc, seconds, _after, n = hit
+        action, exc, seconds, _after, _mode, n = hit
         if action == "hang":
             # outside the lock: a hung scheduler must not also wedge
             # every other seam's bookkeeping
@@ -287,13 +291,18 @@ class NetworkFaultPlan(FaultPlan):
       through, the server streams, and the client-side reader kills
       the socket after relaying ``after`` stream lines — a mid-stream
       half-close the router's failover replay must absorb without the
-      handle ever seeing a gap.
+      handle ever seeing a gap;
+    - :meth:`corrupt_at` — the payload arrives, but WRONG: a
+      deterministic byte-flip (well-framed, bit-rotted — only a
+      checksum can tell) or truncation of the KV ship / token stream.
+      The injection the integrity-checked wire is tested against.
 
     The seam hook is :meth:`fire`, which unlike the base plan RETURNS
-    the half-close spec (``{"action": "half_close", "after": n}``)
-    instead of raising — the cut happens later, inside the reader
-    thread, not at the call site. ``delay`` blocks then returns
-    ``None``; ``drop`` raises. Inherited :meth:`raise_at` /
+    the half-close/corrupt spec (``{"action": "half_close", "after":
+    n}`` / ``{"action": "corrupt", "mode": m, "after": n}``) instead
+    of raising — the mangling happens later, inside the reader thread
+    or the payload path, not at the call site. ``delay`` blocks then
+    returns ``None``; ``drop`` raises. Inherited :meth:`raise_at` /
     :meth:`hang_at` also work against :data:`NET_SITES` (validation is
     class-driven)."""
 
@@ -336,21 +345,52 @@ class NetworkFaultPlan(FaultPlan):
                       valid_sites=self.VALID_SITES))
         return self
 
+    def corrupt_at(self, site: str, nth: int = 1, mode: str = "flip",
+                   after: int = 1,
+                   times: int = 1) -> "NetworkFaultPlan":
+        """Deterministic payload corruption at the wire seam — the
+        injection the KV integrity layer is tested against. Same
+        no-real-sockets discipline as the other actions: the bytes are
+        mangled at the client seam, never by a real middlebox.
+
+        - ``mode="flip"`` — a byte-flip that keeps the framing intact:
+          on ``kv_import`` the last payload byte (array bytes, past
+          the header) is XOR'd, so only the checksum can tell; on
+          ``generate`` the stream line after ``after`` relayed tokens
+          arrives garbled (the reader sees torn ndjson).
+        - ``mode="truncate"`` — the payload/stream ends early: on
+          ``kv_import`` the framed body loses its tail (the receiver's
+          geometry validation sees a truncated layer); on ``generate``
+          it behaves like a half-close after ``after`` lines."""
+        if mode not in ("flip", "truncate"):
+            raise ValueError(
+                f"mode must be 'flip' or 'truncate', got {mode!r}")
+        if after < 1:
+            raise ValueError("after must be >= 1")
+        with self._lock:
+            self._rules.append(
+                _Rule(site, nth, times, "corrupt", after=after,
+                      mode=mode, valid_sites=self.VALID_SITES))
+        return self
+
     # -- the seam hook -------------------------------------------------------
     def fire(self, site: str):
         """Network-seam variant: ``delay`` blocks then returns
         ``None``; ``drop`` (and inherited ``raise``) raises;
-        ``half_close`` returns its spec dict for the caller to carry
-        into the stream reader. Returns ``None`` when no rule fires."""
+        ``half_close`` / ``corrupt`` return their spec dict for the
+        caller to carry into the stream reader / payload path.
+        Returns ``None`` when no rule fires."""
         hit = self._consume(site)
         if hit is None:
             return None
-        action, exc, seconds, after, n = hit
+        action, exc, seconds, after, mode, n = hit
         if action in ("hang", "delay"):
             self._release.wait(seconds)
             return None
         if action == "half_close":
             return {"action": "half_close", "after": after}
+        if action == "corrupt":
+            return {"action": "corrupt", "mode": mode, "after": after}
         if exc is None:
             if action == "drop":
                 raise ConnectionResetError(

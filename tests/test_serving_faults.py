@@ -1286,7 +1286,11 @@ class TestNetworkFaultPlan:
                                     segment_steps=2)
         httpd = serve_http(srv)
         port = httpd.server_address[1]
-        rep = RemoteReplica(f"http://127.0.0.1:{port}")
+        # wire hardening OFF: this test pins the RAW fault surface the
+        # retry/resume layers are built on (a retried drop succeeds
+        # and a half-close resumes — covered by test_wire_hardening)
+        rep = RemoteReplica(f"http://127.0.0.1:{port}",
+                            wire_retries=0, max_resumes=0)
         plan = NetworkFaultPlan()
         rep.fault_plan = plan
         try:

@@ -1,0 +1,474 @@
+"""Wire-hardening suite (ISSUE 20): exactly-once semantics over a
+faulty network, zero new compiled programs.
+
+Three layers, each pinned end to end over a real socket against the
+deterministic injection harness (``NetworkFaultPlan``):
+
+- IDEMPOTENT RESUBMISSION: every wire attempt of one submission
+  carries the same idempotency key, so a retried ambiguous POST
+  attaches to the live request server-side (same ``request_id``,
+  single admission) instead of double-executing; dropped connections
+  retry with bounded exponential backoff;
+- MID-STREAM RESUME: a torn ``/generate`` stream reconnects to the
+  SAME replica with ``idem_key`` + ``from_token`` and replays only the
+  missing tail against warm KV — resume strictly precedes failover in
+  the trace timeline, and the final tokens are bitwise identical to an
+  unfaulted run;
+- INTEGRITY-CHECKED KV SHIPPING: framed exports carry blake2b
+  checksums (whole payload + per block); a corrupt or truncated
+  arrival is rejected whole (typed ``KVIntegrityError``, nothing
+  installed — the allocator's ``check()`` stays green under
+  ``debug_pages``), the shipper re-ships once, and past the front's
+  integrity budget decode falls back to local prefill;
+
+plus the chaos matrix (delay / drop / half-close / corrupt x generate
+/ kv_import) with token parity throughout, and the zero-new-programs
+assertion: none of the recovery paths compiles anything the steady
+state didn't already have.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, tracing
+from paddle_tpu.inference.generation import (GenerationConfig,
+                                             PagedContinuousBatchingEngine)
+from paddle_tpu.serving import (DisaggregatedFront, KVIntegrityError,
+                                RemoteReplica, RequestFailed, Server,
+                                serve_http)
+from paddle_tpu.serving.remote import (decode_kv_payload,
+                                       encode_kv_payload)
+from paddle_tpu.testing.faults import NetworkFaultPlan
+
+PROMPT = list(range(1, 18))     # 17 tokens -> 2 full blocks @ page 8
+
+
+def tiny_model(layers=1, seed=0):
+    paddle.seed(seed)
+    from paddle_tpu.models import LlamaForCausalLM, llama_config
+    cfg = llama_config("tiny", num_hidden_layers=layers)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def live_server(prefix=False, **kw):
+    """(server, RAW engine, httpd, port) — debug_pages armed so any
+    reclaim/install bug on a recovery path fails loudly."""
+    model, _ = tiny_model()
+    eng = PagedContinuousBatchingEngine(
+        model, max_batch=3, num_pages=24, page_size=8, max_pages=8,
+        prefix_cache=prefix, debug_pages=True)
+    srv = Server(eng, segment_steps=2, **kw)
+    httpd = serve_http(srv)
+    return srv, eng, httpd, httpd.server_address[1]
+
+
+def shut(reps, httpds, srvs):
+    for r in reps:
+        r.close()
+    for h in httpds:
+        h.shutdown()
+    for s in srvs:
+        s.shutdown(drain=False)
+
+
+def _greedy(n):
+    return GenerationConfig(max_new_tokens=n, eos_token_id=None)
+
+
+def _toks(handle, timeout=120):
+    return [int(t) for t in handle.result(timeout=timeout)]
+
+
+def _post(port, path, body):
+    """Raw JSON POST (no client-side hardening in the way)."""
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", path, body=json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _healthz(port):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        return json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+# -- the corrupt_at spec (satellite 1) ----------------------------------------
+class TestCorruptSpec:
+    def test_fire_and_log(self):
+        plan = NetworkFaultPlan()
+        plan.corrupt_at("kv_import", nth=1, mode="flip")
+        plan.corrupt_at("generate", nth=1, mode="truncate", after=3)
+        assert plan.fire("kv_import") == {
+            "action": "corrupt", "mode": "flip", "after": 1}
+        assert plan.fire("generate") == {
+            "action": "corrupt", "mode": "truncate", "after": 3}
+        assert plan.fire("generate") is None      # rule retired
+        assert plan.injected == [("kv_import", 1, "corrupt"),
+                                 ("generate", 1, "corrupt")]
+
+    def test_validation(self):
+        plan = NetworkFaultPlan()
+        with pytest.raises(ValueError, match="mode"):
+            plan.corrupt_at("generate", mode="scramble")
+        with pytest.raises(ValueError, match="after"):
+            plan.corrupt_at("generate", after=0)
+        with pytest.raises(ValueError, match="unknown site"):
+            plan.corrupt_at("decode")
+
+
+# -- chaos matrix: the /generate column ---------------------------------------
+class TestGenerateChaos:
+    def test_matrix_token_parity(self):
+        """delay / drop / half-close / corrupt(flip) /
+        corrupt(truncate) against a live stream: every faulted run
+        lands the SAME tokens as the unfaulted reference, absorbed by
+        retry (pre-admission tears) or resume (mid-stream tears)."""
+        srv, eng, httpd, port = live_server()
+        rep = RemoteReplica(f"http://127.0.0.1:{port}")
+        try:
+            assert rep.wait_ready(timeout=120)
+            ref = _toks(rep.submit(PROMPT, _greedy(8)))
+            assert len(ref) == 8
+
+            def faulted(arm):
+                plan = NetworkFaultPlan()
+                arm(plan)
+                rep.fault_plan = plan
+                try:
+                    return _toks(rep.submit(PROMPT, _greedy(8)))
+                finally:
+                    rep.fault_plan = None
+
+            assert faulted(lambda p: p.delay_at(
+                "generate", nth=1, seconds=0.02)) == ref
+            assert faulted(lambda p: p.drop_at("generate", nth=1)) == ref
+            assert rep.submit_retries == 1
+            assert faulted(lambda p: p.half_close_at(
+                "generate", nth=1, after=2)) == ref
+            assert rep.resumes == 1
+            assert faulted(lambda p: p.corrupt_at(
+                "generate", nth=1, mode="flip", after=2)) == ref
+            assert faulted(lambda p: p.corrupt_at(
+                "generate", nth=1, mode="truncate", after=1)) == ref
+            assert rep.resumes == 3
+            # recovery never leaked capacity
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and (
+                    eng.free_slots() != eng.max_batch
+                    or eng.alloc.free_pages != eng.num_pages):
+                time.sleep(0.02)
+            assert eng.free_slots() == eng.max_batch
+            assert eng.alloc.free_pages == eng.num_pages
+            eng.alloc.check()
+        finally:
+            shut([rep], [httpd], [srv])
+
+    def test_resume_disabled_fails_fast(self):
+        """With the resume budget at zero a half-close is a terminal
+        stream failure — the raw surface the hardening layers wrap."""
+        srv, _, httpd, port = live_server()
+        rep = RemoteReplica(f"http://127.0.0.1:{port}",
+                            wire_retries=0, max_resumes=0)
+        try:
+            assert rep.wait_ready(timeout=120)
+            plan = NetworkFaultPlan()
+            plan.half_close_at("generate", nth=1, after=1)
+            rep.fault_plan = plan
+            h = rep.submit(PROMPT, _greedy(6))
+            with pytest.raises(RequestFailed, match="stream"):
+                h.result(timeout=120)
+            assert rep.resumes == 0
+        finally:
+            shut([rep], [httpd], [srv])
+
+
+# -- chaos matrix: the kv_import column + never-installs ----------------------
+class TestKVChaos:
+    def test_matrix_and_corrupt_never_installs(self):
+        """Every kv_import fault is refused whole: after delay / drop
+        / half-close / corrupt(flip) / corrupt(truncate) attempts, the
+        decode pool holds NOTHING (the eventual clean import installs
+        every block with zero dedup hits) and the allocator validator
+        stays green."""
+        srv_a, eng_a, httpd_a, port_a = live_server(prefix=True)
+        srv_b, eng_b, httpd_b, port_b = live_server(prefix=True)
+        rep_a = RemoteReplica(f"http://127.0.0.1:{port_a}")
+        rep_b = RemoteReplica(f"http://127.0.0.1:{port_b}")
+        try:
+            assert rep_a.wait_ready(timeout=120)
+            assert rep_b.wait_ready(timeout=120)
+            _toks(rep_a.submit(PROMPT, _greedy(1)))   # prefill A
+            raw = rep_a.export_kv_raw(PROMPT)
+            free0 = eng_b.alloc.free_pages
+
+            def faulted(arm):
+                plan = NetworkFaultPlan()
+                arm(plan)
+                rep_b.fault_plan = plan
+                try:
+                    return rep_b.import_kv_raw(raw)
+                finally:
+                    rep_b.fault_plan = None
+
+            with pytest.raises(ConnectionResetError):
+                faulted(lambda p: p.drop_at("kv_import", nth=1))
+            with pytest.raises(KVIntegrityError):
+                faulted(lambda p: p.half_close_at("kv_import", nth=1))
+            with pytest.raises(KVIntegrityError, match="integrity|truncated"):
+                faulted(lambda p: p.corrupt_at(
+                    "kv_import", nth=1, mode="flip"))
+            with pytest.raises(KVIntegrityError):
+                faulted(lambda p: p.corrupt_at(
+                    "kv_import", nth=1, mode="truncate"))
+            assert rep_b.integrity_rejects == 3
+            assert _healthz(port_b)["wire"]["integrity_rejects"] >= 2
+            # nothing installed by any rejected arrival: pool
+            # untouched, validator green, and the clean import now
+            # installs EVERY block fresh (a partial install would
+            # surface here as a dedup hit)
+            assert eng_b.alloc.free_pages == free0
+            eng_b.alloc.check()
+            out = rep_b.import_kv_raw(raw)
+            assert out["imported"] == 2 and out["deduped"] == 0
+            assert eng_b.alloc.free_pages == free0 - 2
+            eng_b.alloc.check()
+            # delay: slow but clean, and a replayed ship through a
+            # slow wire is IDEMPOTENT (chain-hash dedup, no growth)
+            out = faulted(lambda p: p.delay_at(
+                "kv_import", nth=1, seconds=0.02))
+            assert out["imported"] == 0 and out["deduped"] == 2
+            assert eng_b.alloc.free_pages == free0 - 2
+            eng_b.alloc.check()
+        finally:
+            shut([rep_a, rep_b], [httpd_a, httpd_b], [srv_a, srv_b])
+
+
+# -- idempotent resubmission (dedup regression) -------------------------------
+class TestIdempotentSubmit:
+    def test_retried_post_single_admission(self):
+        """The ambiguous-retry contract: a second POST carrying the
+        same idem_key returns the SAME request_id and tokens — one
+        admission, one slot, one SLO count — and the server says so
+        (`wire.idem_attaches`)."""
+        srv, eng, httpd, port = live_server()
+        try:
+            body = {"prompt": PROMPT, "max_new_tokens": 6,
+                    "stream": False, "idem_key": "dedup-test#0"}
+            s1, r1 = _post(port, "/generate", body)
+            s2, r2 = _post(port, "/generate", body)
+            assert s1 == 200 and s2 == 200
+            assert r1["request_id"] == r2["request_id"]
+            assert r1["tokens"] == r2["tokens"]
+            assert len(r1["tokens"]) == 6
+            h = _healthz(port)
+            assert h["wire"]["idem_attaches"] == 1
+            # single admission also means single completion: exactly
+            # one request's capacity was ever claimed (and released —
+            # retire lands on the next scheduler tick)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and (
+                    eng.free_slots() != eng.max_batch
+                    or eng.alloc.free_pages != eng.num_pages):
+                time.sleep(0.02)
+            assert eng.free_slots() == eng.max_batch
+            assert eng.alloc.free_pages == eng.num_pages
+        finally:
+            shut([], [httpd], [srv])
+
+    def test_resume_miss_is_409(self):
+        """A resume aimed at a request this server never held must
+        refuse loudly (409 resume_miss) — never a silent fresh decode
+        that would double-emit tokens."""
+        srv, _, httpd, port = live_server()
+        try:
+            status, body = _post(port, "/generate", {
+                "prompt": PROMPT, "max_new_tokens": 4,
+                "stream": False, "idem_key": "never-seen#7",
+                "from_token": 2})
+            assert status == 409
+            assert body["reason"] == "resume_miss"
+            assert _healthz(port)["wire"]["resume_misses"] == 1
+        finally:
+            shut([], [httpd], [srv])
+
+
+# -- resume-before-failover ordering ------------------------------------------
+class TestResumeOrdering:
+    def test_resume_precedes_failover_in_trace(self):
+        """A torn stream resumes on the SAME replica: the request's
+        timeline reads first_token -> wire.resume -> finish with no
+        failover event, the server counts ONE attach (the resume
+        reattach), and the tokens match the unfaulted reference."""
+        tracing.clear()
+        tracing.enable()
+        srv, _, httpd, port = live_server()
+        rep = RemoteReplica(f"http://127.0.0.1:{port}")
+        try:
+            assert rep.wait_ready(timeout=120)
+            ref = _toks(rep.submit(PROMPT, _greedy(8)))
+            plan = NetworkFaultPlan()
+            plan.half_close_at("generate", nth=1, after=2)
+            rep.fault_plan = plan
+            h = rep.submit(PROMPT, _greedy(8))
+            assert _toks(h) == ref
+            assert rep.resumes == 1
+            phases = [e["phase"]
+                      for e in tracing.events(rid=h._trace_rid)]
+            assert "failover" not in phases
+            assert phases.index("first_token") \
+                < phases.index("wire.resume") < phases.index("finish")
+            assert _healthz(port)["wire"]["idem_attaches"] == 1
+        finally:
+            shut([rep], [httpd], [srv])
+            tracing.disable()
+            tracing.clear()
+
+
+# -- the KV integrity codec ---------------------------------------------------
+def _payload(nblocks=2, layers=2, page=8, heads=2):
+    rng = np.arange(nblocks * page * heads,
+                    dtype=np.float32).reshape(nblocks, page, heads)
+    return {"version": 1, "kv_dtype": "float32", "page_size": page,
+            "salt": "", "coverage": nblocks * page,
+            "blocks": [{"hash": f"{b:02x}" * 4, "tokens": page}
+                       for b in range(nblocks)],
+            "layers": [{"k": rng + li, "v": rng - li}
+                       for li in range(layers)]}
+
+
+class TestIntegrityCodec:
+    def test_round_trip(self):
+        p = _payload()
+        out = decode_kv_payload(encode_kv_payload(p))
+        assert out["blocks"] == p["blocks"]
+        for got, want in zip(out["layers"], p["layers"]):
+            assert np.array_equal(got["k"], want["k"])
+            assert np.array_equal(got["v"], want["v"])
+
+    def test_flip_names_the_block(self):
+        raw = bytearray(encode_kv_payload(_payload()))
+        raw[-1] ^= 0xFF                   # last array byte -> block 1
+        with pytest.raises(KVIntegrityError, match="block 1"):
+            decode_kv_payload(bytes(raw))
+
+    def test_truncation_is_typed(self):
+        raw = encode_kv_payload(_payload())
+        with pytest.raises(KVIntegrityError, match="truncated|trailing"):
+            decode_kv_payload(raw[:len(raw) - 8])
+
+    def test_digestless_payload_still_decodes(self):
+        """Hand-built payloads without digests (older writers, the
+        remote suite's fixtures) decode unverified; their truncation
+        stays a PLAIN ValueError — no integrity claim was made."""
+        p = _payload(layers=1)
+        arr = np.ascontiguousarray(p["layers"][0]["k"])
+        hdr = json.dumps({
+            "version": 1, "kv_dtype": "float32", "page_size": 8,
+            "salt": "", "coverage": p["coverage"],
+            "blocks": p["blocks"],
+            "layers": [{"k": {"dtype": "float32",
+                              "shape": list(arr.shape)},
+                        "v": {"dtype": "float32",
+                              "shape": list(arr.shape)}}]}).encode()
+        raw = (len(hdr).to_bytes(4, "big") + hdr
+               + arr.tobytes() + arr.tobytes())
+        out = decode_kv_payload(raw)
+        assert np.array_equal(out["layers"][0]["v"], arr)
+        with pytest.raises(ValueError) as ei:
+            decode_kv_payload(raw[:len(raw) - 8])
+        assert not isinstance(ei.value, KVIntegrityError)
+
+
+# -- the disaggregated front under a rotten wire ------------------------------
+class TestFrontIntegrityFallback:
+    def test_reship_then_local_prefill_fallback(self):
+        """Ship corrupt -> re-ship once; re-ship corrupt too -> decode
+        falls back to the prefill replica (pages never travelled,
+        parity holds). Past max_integrity_failures the front stops
+        shipping entirely."""
+        srv_a, _, httpd_a, port_a = live_server(prefix=True)
+        srv_b, eng_b, httpd_b, port_b = live_server(prefix=True)
+        rep_a = RemoteReplica(f"http://127.0.0.1:{port_a}")
+        rep_b = RemoteReplica(f"http://127.0.0.1:{port_b}")
+        try:
+            assert rep_a.wait_ready(timeout=120)
+            assert rep_b.wait_ready(timeout=120)
+            ref = _toks(rep_a.submit(PROMPT, _greedy(8)))
+            front = DisaggregatedFront(rep_a, rep_b,
+                                       max_integrity_failures=2)
+            plan = NetworkFaultPlan()
+            plan.corrupt_at("kv_import", nth=1, mode="flip")
+            plan.corrupt_at("kv_import", nth=2, mode="truncate")
+            rep_b.fault_plan = plan
+            free0 = eng_b.alloc.free_pages
+            assert _toks(front.generate(PROMPT, _greedy(8))) == ref
+            assert front.reships == 1
+            assert front.integrity_rejects == 2
+            assert front.failovers == 0
+            assert rep_b.integrity_rejects == 2
+            # both arrivals were refused whole: decode pool untouched
+            assert eng_b.alloc.free_pages == free0
+            eng_b.alloc.check()
+            # integrity budget spent: the next request never ships
+            assert _toks(front.generate(PROMPT, _greedy(8))) == ref
+            assert plan.calls["kv_import"] == 2
+        finally:
+            shut([rep_a, rep_b], [httpd_a, httpd_b], [srv_a, srv_b])
+
+
+# -- zero new programs --------------------------------------------------------
+class TestZeroNewPrograms:
+    def test_recovery_paths_compile_nothing(self):
+        """The tentpole's no-new-programs bar: retry, resume, idem
+        attach, integrity reject and re-ship are all host-side wire
+        work — after one clean disaggregated run has warmed the
+        programs, a chaos round pays ZERO monitored jit misses."""
+        monitor.enable()
+        try:
+            srv_a, _, httpd_a, port_a = live_server(prefix=True)
+            srv_b, _, httpd_b, port_b = live_server(prefix=True)
+            rep_a = RemoteReplica(f"http://127.0.0.1:{port_a}")
+            rep_b = RemoteReplica(f"http://127.0.0.1:{port_b}")
+            try:
+                assert rep_a.wait_ready(timeout=120)
+                assert rep_b.wait_ready(timeout=120)
+                front = DisaggregatedFront(rep_a, rep_b)
+                ref = _toks(front.generate(PROMPT, _greedy(6)))
+                # second clean round walks the warm-prefix/dedup
+                # variants too, so the snapshot below covers every
+                # program a steady-state replay touches
+                assert _toks(front.generate(PROMPT, _greedy(6))) == ref
+                before = monitor.jit_miss_by_fn()
+                plan_a = NetworkFaultPlan()
+                plan_a.drop_at("generate", nth=1)
+                rep_a.fault_plan = plan_a
+                plan_b = NetworkFaultPlan()
+                plan_b.corrupt_at("kv_import", nth=1, mode="flip")
+                plan_b.half_close_at("generate", nth=1, after=1)
+                rep_b.fault_plan = plan_b
+                assert _toks(front.generate(PROMPT, _greedy(6))) == ref
+                assert rep_a.submit_retries >= 1
+                assert rep_b.resumes >= 1
+                assert front.reships == 1
+                after = monitor.jit_miss_by_fn()
+                assert after == before, (before, after)
+            finally:
+                shut([rep_a, rep_b], [httpd_a, httpd_b],
+                     [srv_a, srv_b])
+        finally:
+            monitor.reset()
+            monitor.disable()

@@ -417,6 +417,67 @@ def render_control(doc: dict) -> str:
     return "\n".join(lines).rstrip()
 
 
+def render_wire(doc: dict) -> str:
+    """Wire-resilience view of a ``paddle_tpu.tracing`` chrome-JSON
+    export: the exactly-once event timeline (idempotent submit
+    retries, mid-stream resumes with their from_token, server-side
+    idem attaches, KV integrity rejects) plus per-request resume
+    chains proving the resume-before-failover order
+    (route -> stream -> resume -> finish). Timestamps are seconds
+    relative to the first event in the ring, the --trace clock."""
+    evs = doc.get("traceEvents", [])
+    t0 = min((float(e.get("ts", 0.0)) for e in evs), default=0.0)
+    rows, counts = [], {}
+    chains: Dict[str, List[str]] = {}
+    for e in evs:
+        name = e.get("name", "?")
+        a = e.get("args") or {}
+        rid = a.get("rid")
+        if name in ("route", "failover", "first_token", "finish",
+                    "wire.resume"):
+            if rid is not None:
+                chains.setdefault(str(rid), []).append(name)
+        if name not in ("wire.retry", "wire.resume", "idem.attach",
+                        "kv.integrity_reject"):
+            continue
+        counts[name] = counts.get(name, 0) + 1
+        ts = (float(e.get("ts", 0.0)) - t0) / 1e6    # µs -> s
+        if name == "wire.retry":
+            detail = (f"attempt={a.get('attempt')} "
+                      f"wait={_fmt_opt(a.get('wait_s'), '.3f')}s "
+                      f"cause={a.get('cause')}")
+        elif name == "wire.resume":
+            detail = (f"attempt={a.get('attempt')} "
+                      f"from_token={a.get('from_token')} "
+                      f"cause={a.get('cause')}")
+        elif name == "idem.attach":
+            detail = (f"rid={a.get('rid')} "
+                      f"from_token={a.get('from_token')} "
+                      f"live={a.get('live')}")
+        else:
+            detail = str(a.get("error", ""))[:72]
+        rows.append((ts, name, rid, detail))
+    if not rows:
+        return ("(no wire.*/idem.*/kv.integrity_reject events — was "
+                "tracing on while the wire was faulted?)")
+    lines = ["wire-resilience events:",
+             f"  {'t(s)':>9}  {'EVENT':<20} {'RID':<22} DETAIL"]
+    for ts, name, rid, detail in sorted(rows):
+        lines.append(f"  {ts:>9.3f}  {name:<20} "
+                     f"{str(rid) if rid is not None else '-':<22} "
+                     f"{detail}")
+    lines.append("")
+    lines.append("counts: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(counts.items())))
+    resumed = {r: c for r, c in chains.items() if "wire.resume" in c}
+    if resumed:
+        lines.append("")
+        lines.append("resume chains (resume-before-failover order):")
+        for rid in sorted(resumed):
+            lines.append(f"  {rid}: " + " -> ".join(resumed[rid]))
+    return "\n".join(lines)
+
+
 def _fmt_units(v, none: str = "-") -> str:
     """1.23e12 -> '1.23T' — roofline numbers span 9 orders."""
     if v is None:
@@ -519,6 +580,14 @@ def main(argv=None) -> int:
                          "timeline, burn-rate sheds by tenant/"
                          "reason, shed-storm triggers, elastic "
                          "scale decisions")
+    ap.add_argument("--wire", default=None, metavar="JSON",
+                    help="render the wire-resilience view of a "
+                         "chrome-JSON trace export instead: the "
+                         "exactly-once event timeline (submit "
+                         "retries, mid-stream resumes, idem "
+                         "attaches, KV integrity rejects) and the "
+                         "per-request resume chains (serve_bench "
+                         "--wire-chaos --trace-out writes one)")
     ap.add_argument("--slo", nargs="?", const="", default=None,
                     metavar="JSON",
                     help="render a GET /stats SLO snapshot instead: "
@@ -560,6 +629,10 @@ def main(argv=None) -> int:
     if args.control:
         with open(args.control) as f:
             print(render_control(json.load(f)))
+        return 0
+    if args.wire:
+        with open(args.wire) as f:
+            print(render_wire(json.load(f)))
         return 0
     if args.slo is not None:
         if not args.slo and not args.url:

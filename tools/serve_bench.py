@@ -819,6 +819,17 @@ def main(argv=None) -> int:
                     help="overload multiple for the ctrloff/ctrlon "
                          "arms: arrival times are the cap arm's "
                          "schedule compressed by X (> 1; default 2.0)")
+    ap.add_argument("--wire-chaos", action="store_true",
+                    help="A/B mode over the REAL wire (serve_http + "
+                         "RemoteReplica): 'wireclean' drives the "
+                         "pre-drawn load unfaulted, 'wirechaos' "
+                         "replays it through injected delay/drop/"
+                         "half-close/corrupt at the generate and "
+                         "kv_import seams — reports serve_wire_"
+                         "resumes/failovers/reships/integrity_rejects"
+                         "/survival_rate and the bitwise token-parity "
+                         "verdict (a flaky network degrades latency, "
+                         "never correctness)")
     args = ap.parse_args(argv)
 
     rng = random.Random(args.seed)
@@ -835,10 +846,19 @@ def main(argv=None) -> int:
         return 2
     if sum([args.spec_ab, args.trace_ab, args.kv_ab,
             args.lora_ab, args.tp_ab, args.slo_ab,
-            args.profile_ab, args.overload_ab]) > 1:
+            args.profile_ab, args.overload_ab,
+            args.wire_chaos]) > 1:
         print("--spec-ab/--trace-ab/--kv-ab/--lora-ab/--tp-ab/--slo-ab/"
-              "--profile-ab/--overload-ab are separate A/Bs; run them "
-              "one at a time", file=sys.stderr)
+              "--profile-ab/--overload-ab/--wire-chaos are separate "
+              "A/Bs; run them one at a time", file=sys.stderr)
+        return 2
+    if args.wire_chaos and (args.url is not None or args.router
+                            or args.replicas > 1 or args.fleet
+                            or args.fault_rate > 0):
+        print("--wire-chaos builds its own wire (in-process servers "
+              "behind serve_http); it composes with neither --url "
+              "nor --router/--replicas/--fleet/--fault-rate",
+              file=sys.stderr)
         return 2
     if (args.profile or args.profile_ab) and args.url is not None:
         print("--profile/--profile-ab need the in-process engine "
@@ -948,6 +968,8 @@ def main(argv=None) -> int:
     prompts = [shared_prefix
                + _body(_draw_len(rng, args.prompt_dist, lo, hi))
                for _ in range(args.requests)]
+    if args.wire_chaos:
+        return _wire_chaos(args, prompts)
     # the per-request ADAPTER assignment is drawn up front too: the
     # --lora-ab arms replay the identical mix (the base arm just
     # ignores it), and the mix entropy record describes the LOAD, not
@@ -1283,6 +1305,172 @@ def main(argv=None) -> int:
                           "value": div["token_flips"],
                           "unit": "count"}))
     return 0
+
+
+def _wire_chaos(args, prompts) -> int:
+    """--wire-chaos: two arms over the REAL wire. Each arm builds a
+    fresh seeded prefill/decode server pair behind ``serve_http`` and
+    drives the identical pre-drawn load through a ``RemoteReplica``;
+    the chaos arm replays it through an injected
+    delay/drop/half-close/corrupt ``NetworkFaultPlan`` at both seams
+    (generate + kv_import). The driver replays a request once on a
+    terminal wire failure (the failover the router would run), so the
+    verdict is exactly-once SURVIVAL: every request finishes and its
+    tokens are bitwise-identical to the clean arm's — injected chaos
+    shows up in the resume/retry/reship counters, never the output."""
+    import argparse as _ap
+
+    import numpy as np
+
+    from paddle_tpu import tracing
+    from paddle_tpu.inference.generation import GenerationConfig
+    from paddle_tpu.serving import (DisaggregatedFront, RemoteReplica,
+                                    RequestFailed, RequestRejected)
+    from paddle_tpu.serving.http import serve_http
+    from paddle_tpu.testing.faults import NetworkFaultPlan
+
+    # the ship phase needs the paged prefix cache on both sides
+    if args.cache_prefixes != "on":
+        args = _ap.Namespace(**vars(args))
+        args.cache_prefixes = "on"
+    cfg = GenerationConfig(max_new_tokens=args.max_new,
+                           do_sample=False)
+    # the requests a ship cycle exports: longest prompts first — at
+    # least one FULL page-size block resident from their prefill
+    ship = sorted(range(len(prompts)),
+                  key=lambda i: -len(prompts[i]))[:4]
+
+    def _run(chaos: bool) -> dict:
+        arm = "wirechaos" if chaos else "wireclean"
+        tracing.clear()
+        if chaos:
+            tracing.enable()
+        srv1 = srv2 = httpd1 = httpd2 = rep = rep2 = None
+        try:
+            srv1, vocab, _ = _build_toy_server(args, False)
+            srv2, _, _ = _build_toy_server(args, False)
+            assert vocab >= _TOY_VOCAB
+            httpd1, httpd2 = serve_http(srv1), serve_http(srv2)
+            rep = RemoteReplica(
+                f"http://127.0.0.1:{httpd1.server_address[1]}")
+            rep2 = RemoteReplica(
+                f"http://127.0.0.1:{httpd2.server_address[1]}")
+            assert rep.wait_ready(timeout=120)
+            assert rep2.wait_ready(timeout=120)
+            plan = None
+            if chaos:
+                plan = NetworkFaultPlan()
+                # generate seam: one of each injection, spread over
+                # the (sequential, so deterministic) call sequence.
+                # Retries/resumes count as calls too — the plan fires
+                # strictly by call order, same as a real flaky link.
+                plan.delay_at("generate", nth=2, seconds=0.05)
+                plan.drop_at("generate", nth=4)       # submit retry
+                plan.half_close_at("generate", nth=6, after=1)
+                plan.corrupt_at("generate", nth=9, mode="flip",
+                                after=1)              # garbled line
+                # three consecutive tears exhaust the resume budget
+                # (default 2) and force the failover replay
+                plan.half_close_at("generate", nth=11, after=1,
+                                   times=3)
+                # kv_import seam: both corruption modes + a delay
+                plan.corrupt_at("kv_import", nth=1, mode="flip")
+                plan.delay_at("kv_import", nth=2, seconds=0.02)
+                plan.corrupt_at("kv_import", nth=3, mode="truncate")
+                rep.fault_plan = plan
+                rep2.fault_plan = plan
+            tokens, failovers, failures = [], 0, 0
+            for p in prompts:
+                ids = np.asarray(p, np.int32)
+                toks = None
+                for attempt in (0, 1):
+                    try:
+                        h = rep.submit(ids, cfg)
+                        toks = [int(t)
+                                for t in h.result(timeout=120)]
+                        break
+                    except (RequestFailed, RequestRejected,
+                            RuntimeError, TimeoutError):
+                        if attempt:
+                            failures += 1
+                        else:
+                            failovers += 1   # the replay the router
+                            #                  would run elsewhere
+                tokens.append(toks)
+            # ship phase: prefill pages for the longest prompts are
+            # resident on srv1 (their requests just ran there) — ship
+            # them to the decode server through the faulted seam
+            front = DisaggregatedFront(rep, rep2)
+            ship_fail = 0
+            for i in ship:
+                try:
+                    front.ship(prompts[i])
+                except Exception:
+                    ship_fail += 1
+            out = {
+                "tokens": tokens, "failovers": failovers,
+                "failures": failures, "ship_failures": ship_fail,
+                "resumes": rep.resumes,
+                "submit_retries": rep.submit_retries,
+                "reships": front.reships,
+                "integrity_rejects": rep2.integrity_rejects,
+                "injected": list(plan.injected) if plan else [],
+            }
+            if chaos and args.trace_out:
+                tracing.export_chrome(args.trace_out)
+                print(f"wrote wire trace to {args.trace_out} "
+                      f"(tools/monitor_report.py --wire "
+                      f"{args.trace_out})")
+            return out
+        finally:
+            for r in (rep, rep2):
+                if r is not None:
+                    r.close()
+            for hd in (httpd1, httpd2):
+                if hd is not None:
+                    hd.shutdown()
+            for s in (srv1, srv2):
+                if s is not None:
+                    s.shutdown(drain=False)
+            tracing.disable()
+            tracing.clear()
+
+    res = {"wireclean": _run(False), "wirechaos": _run(True)}
+    a, b = res["wireclean"], res["wirechaos"]
+    matched = sum(1 for x, y in zip(a["tokens"], b["tokens"])
+                  if x is not None and x == y)
+    survival = matched / max(1, len(prompts))
+    for arm in ("wireclean", "wirechaos"):
+        r = res[arm]
+        print(f"{arm}: {sum(1 for t in r['tokens'] if t is not None)}"
+              f"/{len(prompts)} finished, {r['resumes']} resumes, "
+              f"{r['submit_retries']} submit retries, "
+              f"{r['failovers']} failovers, {r['reships']} reships, "
+              f"{r['integrity_rejects']} integrity rejects, "
+              f"{len(r['injected'])} injections")
+    for name, val in (("serve_wire_resumes", b["resumes"]),
+                      ("serve_wire_failovers", b["failovers"]),
+                      ("serve_wire_reships", b["reships"]),
+                      ("serve_wire_integrity_rejects",
+                       b["integrity_rejects"]),
+                      ("serve_wire_submit_retries",
+                       b["submit_retries"])):
+        print(json.dumps({"metric": name, "value": int(val),
+                          "unit": "count"}))
+    print(json.dumps({"metric": "serve_wire_survival_rate",
+                      "value": round(survival, 4),
+                      "unit": "fraction (chaos tokens == clean)"}))
+    ok = (survival == 1.0 and b["ship_failures"] == 0
+          and len(b["injected"]) > 0
+          and (b["resumes"] or b["submit_retries"])
+          and b["integrity_rejects"])
+    print(f"wire verdict: {'PASS' if ok else 'FAIL'} — survival "
+          f"{survival:.3f} (bar 1.0) under {len(b['injected'])} "
+          f"injections; {b['resumes']} mid-stream resumes, "
+          f"{b['submit_retries']} idempotent submit retries, "
+          f"{b['integrity_rejects']} corrupt ships rejected "
+          f"({b['reships']} re-shipped clean)")
+    return 0 if ok else 1
 
 
 def _kv_quant_divergence(args, prompts, n_prompts: int = 3,
